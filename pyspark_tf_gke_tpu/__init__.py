@@ -1,0 +1,36 @@
+"""pyspark_tf_gke_tpu — a TPU-native ML-platform framework.
+
+A from-scratch re-design of the capabilities of the reference repo
+``greg-ogs/PySpark-TF-GKE`` for TPU hardware:
+
+* **Training plane** (replacing ``workloads/raw-tf``): JAX/XLA (PjRT TPU
+  runtime) with ``jax.jit``/``shard_map`` over a ``jax.sharding.Mesh``.
+  Parallelism is a compile-time sharding decision — every worker runs the
+  same SPMD program; gradients are combined with XLA collectives over ICI
+  instead of the reference's asynchronous parameter-server push/pull over
+  gRPC (reference: ``workloads/raw-tf/train_tf_ps.py:440-511``).
+* **Data plane**: host-side loaders with the exact semantics of the
+  reference's CSV/image loaders (``train_tf_ps.py:75-149, 200-322``),
+  per-host sharding (the ``InputContext.shard`` analog), and a TFRecord
+  bridge so a PySpark ETL pool can feed TPU workers.
+* **ETL plane** (replacing ``workloads/raw-spark``): the PySpark workloads
+  are preserved behind import gates, and a TPU-native KMeans + feature
+  pipeline (``etl/``) runs the same classical-ML workload on the MXU.
+* **Infra plane** (replacing ``infra/``): Terraform for a TPU v5e GKE node
+  pool and k8s manifests in ``infra/`` at the repo root.
+
+Subpackages
+-----------
+``utils``     config/flags, logging, seeding, small helpers
+``parallel``  mesh construction, sharding rules, distributed bootstrap
+``models``    MLP / CNN (parity oracles), ResNet-50, BERT-base
+``ops``       attention (blockwise + ring), Pallas TPU kernels
+``data``      CSV / image / synthetic loaders, host pipeline, TFRecord bridge
+``train``     train step, loop, metrics, checkpointing, CLI
+``etl``       TPU-native KMeans + gated PySpark workloads
+``evaluate``  saved-model visual checker
+"""
+
+__version__ = "0.1.0"
+
+from pyspark_tf_gke_tpu.utils.config import Config  # noqa: F401

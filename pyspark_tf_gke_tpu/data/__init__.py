@@ -1,0 +1,36 @@
+from pyspark_tf_gke_tpu.data.csv_loader import load_csv, open_text
+from pyspark_tf_gke_tpu.data.images import (
+    count_images,
+    list_labeled_images,
+    load_image,
+    make_image_arrays,
+)
+from pyspark_tf_gke_tpu.data.pipeline import (
+    BatchIterator,
+    host_shard,
+    put_global_batch,
+    train_validation_split,
+)
+from pyspark_tf_gke_tpu.data.synthetic import (
+    make_synthetic_csv,
+    make_synthetic_image_dataset,
+    synthetic_classification_arrays,
+    synthetic_tokens,
+)
+
+__all__ = [
+    "load_csv",
+    "open_text",
+    "count_images",
+    "list_labeled_images",
+    "load_image",
+    "make_image_arrays",
+    "BatchIterator",
+    "host_shard",
+    "put_global_batch",
+    "train_validation_split",
+    "make_synthetic_csv",
+    "make_synthetic_image_dataset",
+    "synthetic_classification_arrays",
+    "synthetic_tokens",
+]

@@ -1,0 +1,74 @@
+"""CSV data path with the exact semantics of the reference's loader
+(``workloads/raw-tf/train_tf_ps.py:53-149``): loss parity depends on
+matching its row-skip rules and label-vocabulary ordering bit-for-bit
+(SURVEY §7 "hard parts").
+
+Semantics preserved:
+
+* a row is dropped when the label column is missing/empty, when any
+  numeric feature is missing/empty/"nan" (case-insensitive), or when any
+  field fails to parse;
+* the label vocabulary is ``sorted(set(labels))`` — deterministic
+  alphabetical order;
+* features come back float32, label indices int32.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+from typing import List, Optional, Tuple
+from urllib.request import urlopen
+
+import numpy as np
+
+
+def open_text(path_or_url: str) -> io.TextIOBase:
+    """Open a local file or an HTTP(S) URL as a text stream
+    (reference: ``train_tf_ps.py:53-73``)."""
+    if path_or_url.startswith("http://") or path_or_url.startswith("https://"):
+        return io.TextIOWrapper(urlopen(path_or_url), encoding="utf-8")
+    return open(path_or_url, "r", encoding="utf-8")
+
+
+def load_csv(
+    source: str,
+    numeric_features: Optional[List[str]] = None,
+    label_col: str = "subpopulation",
+) -> Tuple[np.ndarray, np.ndarray, List[str]]:
+    """Parse a CSV into (features float32, label indices int32, sorted vocab)."""
+    if numeric_features is None:
+        numeric_features = ["value", "lower_ci", "upper_ci"]
+
+    features: List[List[float]] = []
+    labels_raw: List[str] = []
+
+    with open_text(source) as fh:
+        reader = csv.DictReader(fh)
+        for row in reader:
+            try:
+                label = row.get(label_col, "").strip()
+                if not label:
+                    continue
+                feats = []
+                ok = True
+                for col in numeric_features:
+                    value = row.get(col, "").strip()
+                    if value == "" or value.lower() == "nan":
+                        ok = False
+                        break
+                    feats.append(float(value))
+                if not ok:
+                    continue
+                features.append(feats)
+                labels_raw.append(label)
+            except Exception:
+                continue  # skip malformed rows
+
+    if not features:
+        raise RuntimeError("No valid rows were parsed from the dataset.")
+
+    vocab = sorted(set(labels_raw))
+    index_map = {s: i for i, s in enumerate(vocab)}
+    y_idx = np.array([index_map[s] for s in labels_raw], dtype=np.int32)
+    return np.asarray(features, dtype=np.float32), y_idx, vocab

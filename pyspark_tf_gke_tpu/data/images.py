@@ -1,0 +1,96 @@
+"""Image data path: flat directory + ``clean_labels.jsonl`` with (x_px, y_px)
+regression targets — semantics matching the reference's image loader
+(``workloads/raw-tf/train_tf_ps.py:168-322``):
+
+* a jsonl line is used only if the file exists on disk, has a supported
+  extension, and has both ``point.x_px`` and ``point.y_px``;
+* images decode to 3 channels, resize bilinearly (the ``tf.image.resize``
+  default) to (height, width), and scale to [0, 1] float32;
+* targets are raw pixel coordinates in the *resized* space — no
+  normalization (reference keeps original-pixel targets; see the
+  commented-out rescale block at ``train_tf_ps.py:259-276``).
+
+Decoding is host-side (PIL + numpy); the trainer moves ready batches to
+device. The deterministic 80/20 split lives in ``data.pipeline`` so the
+CSV and image paths share it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+from PIL import Image
+
+SUPPORTED_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".gif", ".ppm"}
+
+
+def list_labeled_images(data_dir: str) -> Tuple[List[str], np.ndarray]:
+    """Parse clean_labels.jsonl → (absolute file paths, [N,2] float32 targets)."""
+    labels_path = os.path.join(data_dir, "clean_labels.jsonl")
+    if not os.path.isfile(labels_path):
+        raise RuntimeError(f"clean_labels.jsonl not found in: {data_dir}")
+
+    filepaths: List[str] = []
+    targets: List[List[float]] = []
+    with open(labels_path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except Exception:
+                continue
+            name = str(obj.get("image", "")).strip()
+            if not name:
+                continue
+            _, ext = os.path.splitext(name.lower())
+            if ext not in SUPPORTED_EXTS:
+                continue
+            full_path = os.path.join(data_dir, name)
+            if not os.path.isfile(full_path):
+                continue
+            point = obj.get("point") or {}
+            x_px, y_px = point.get("x_px"), point.get("y_px")
+            if x_px is None or y_px is None:
+                continue
+            filepaths.append(full_path)
+            targets.append([float(x_px), float(y_px)])
+
+    if not filepaths:
+        raise RuntimeError("No valid labeled images were parsed from clean_labels.jsonl")
+    return filepaths, np.asarray(targets, dtype=np.float32)
+
+
+def count_images(data_dir: str) -> int:
+    """Count usable labeled images (reference: ``train_tf_ps.py:168-199``)."""
+    return len(list_labeled_images(data_dir)[0])
+
+
+def load_image(path: str, height: int, width: int) -> np.ndarray:
+    """Decode → RGB → bilinear resize to (height, width) → [0,1] float32."""
+    with Image.open(path) as img:
+        img = img.convert("RGB")
+        # PIL takes (width, height); BILINEAR matches tf.image.resize default.
+        img = img.resize((width, height), resample=Image.BILINEAR)
+        return np.asarray(img, dtype=np.float32) / 255.0
+
+
+def make_image_arrays(
+    data_dir: str,
+    image_size: Tuple[int, int],
+    indices: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Materialize (images [N,H,W,3], targets [N,2]) for a subset of the
+    dataset. Suitable for datasets that fit in host RAM (the reference's
+    laser-spot set); larger sets stream through ``data.tfrecord``."""
+    filepaths, targets = list_labeled_images(data_dir)
+    if indices is not None:
+        filepaths = [filepaths[i] for i in indices]
+        targets = targets[indices]
+    h, w = image_size
+    images = np.stack([load_image(p, h, w) for p in filepaths])
+    return images, targets

@@ -1,0 +1,131 @@
+"""Host-side batching and device placement.
+
+Replaces the reference's ``tf.data`` pipeline + per-worker
+``InputContext.shard`` pattern (``train_tf_ps.py:312-313, 596-601``) with
+the SPMD equivalents:
+
+* ``train_validation_split`` — the reference's deterministic seeded split
+  (``np.random.default_rng(seed)`` shuffle, tail = validation;
+  ``train_tf_ps.py:281-294, 655-661``), shared by CSV and image paths;
+* ``host_shard`` — each *process* keeps rows ``i ≡ process_index (mod
+  process_count)`` (the ``dataset.shard(num_input_pipelines, id)``
+  analog);
+* ``BatchIterator`` — per-epoch reshuffle + fixed-size batches;
+* ``put_global_batch`` — assembles per-host local batches into one global
+  jax.Array with a ``NamedSharding`` over the data axes
+  (``jax.make_array_from_process_local_data``), so the jitted step sees a
+  single logical batch regardless of host count.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+from pyspark_tf_gke_tpu.utils.seeding import DEFAULT_SEED, np_rng
+
+
+def train_validation_split(
+    n: int,
+    validation_split: float,
+    seed: int = DEFAULT_SEED,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic (train_idx, val_idx): seeded shuffle, last
+    ``n*validation_split`` (clamped to 1..n-1) rows become validation —
+    bit-identical to the reference split."""
+    idx = np.arange(n)
+    rng = np_rng(seed)
+    rng.shuffle(idx)
+    if not validation_split:
+        return idx, np.array([], dtype=np.int64)
+    val_size = int(n * float(validation_split))
+    val_size = max(1, min(n - 1, val_size))
+    return idx[:-val_size], idx[-val_size:]
+
+
+def host_shard(
+    *arrays: np.ndarray,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> Tuple[np.ndarray, ...]:
+    """Slice per-host rows: strided like tf.data's ``shard(n, id)``."""
+    if process_index is None:
+        process_index = jax.process_index()
+    if process_count is None:
+        process_count = jax.process_count()
+    if process_count <= 1:
+        return arrays
+    return tuple(a[process_index::process_count] for a in arrays)
+
+
+class BatchIterator:
+    """Infinite batches over host-local arrays with per-epoch reshuffle.
+
+    The reference shuffles with a 3000-row buffer and repeats
+    (``train_tf_ps.py:599-601``); with in-RAM arrays we can afford a full
+    permutation per epoch, which is strictly better shuffling and still
+    deterministic given the seed.
+    """
+
+    def __init__(
+        self,
+        arrays: Dict[str, np.ndarray],
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = DEFAULT_SEED,
+        drop_remainder: bool = True,
+    ):
+        sizes = {k: len(v) for k, v in arrays.items()}
+        if len(set(sizes.values())) != 1:
+            raise ValueError(f"Array length mismatch: {sizes}")
+        self.arrays = arrays
+        self.n = next(iter(sizes.values()))
+        self.batch_size = batch_size
+        if self.n < batch_size and drop_remainder:
+            raise ValueError(f"batch_size {batch_size} > dataset size {self.n}")
+        self.shuffle = shuffle
+        self.drop_remainder = drop_remainder
+        self._rng = np_rng(seed)
+        self._order = np.arange(self.n)
+        self._pos = self.n  # trigger reshuffle on first batch
+
+    @property
+    def steps_per_epoch(self) -> int:
+        if self.drop_remainder:
+            return max(1, self.n // self.batch_size)
+        return -(-self.n // self.batch_size)  # ceil: remainder yields a partial batch
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        epoch_exhausted = (
+            self._pos + self.batch_size > self.n
+            if self.drop_remainder
+            else self._pos >= self.n
+        )
+        if epoch_exhausted:
+            if self.shuffle:
+                self._rng.shuffle(self._order)
+            self._pos = 0
+        end = self._pos + self.batch_size
+        if not self.drop_remainder:
+            end = min(end, self.n)
+        sel = self._order[self._pos : end]
+        self._pos = end
+        return {k: v[sel] for k, v in self.arrays.items()}
+
+
+def put_global_batch(batch: Dict[str, np.ndarray], sharding: NamedSharding) -> Dict[str, jax.Array]:
+    """Host-local batch dict → globally-sharded jax.Arrays.
+
+    Each host passes its local slice; together they form the global batch,
+    split over the mesh data axes. Single-host this is just a sharded
+    device_put.
+    """
+    return {
+        k: jax.make_array_from_process_local_data(sharding, v) for k, v in batch.items()
+    }

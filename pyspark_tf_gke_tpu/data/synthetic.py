@@ -1,0 +1,103 @@
+"""Synthetic datasets for tests and benchmarks.
+
+The reference's datasets (the 18k-row google-health CSV and the private
+laser-spot image set) are not shipped here, so these generators produce
+structurally identical stand-ins: a CSV with the same header/quirks
+(missing values, nan strings), a flat image dir + ``clean_labels.jsonl``
+with a bright synthetic "laser spot" whose center is the regression
+target, classification arrays, and token batches for the BERT path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Tuple
+
+import numpy as np
+
+from pyspark_tf_gke_tpu.utils.seeding import DEFAULT_SEED, np_rng
+
+CSV_HEADER = (
+    "edition,report_type,measure_name,state_name,subpopulation,value,lower_ci,upper_ci,source,source_date"
+)
+
+_MEASURES = ["Able-Bodied", "Asthma", "Cancer", "Child Poverty", "Premature Death"]
+_SUBPOPS = ["Female", "Male", "Adults 18-44", "Adults 45-64", "Seniors 65+"]
+_STATES = ["Alabama", "California", "New York", "Texas", "Utah"]
+
+
+def make_synthetic_csv(path: str, rows: int = 500, missing_rate: float = 0.05,
+                       seed: int = DEFAULT_SEED) -> str:
+    rng = np_rng(seed)
+    lines = [CSV_HEADER]
+    for _ in range(rows):
+        measure = _MEASURES[rng.integers(len(_MEASURES))]
+        sub = _SUBPOPS[rng.integers(len(_SUBPOPS))]
+        state = _STATES[rng.integers(len(_STATES))]
+        value = rng.uniform(0, 100)
+        lower, upper = value - rng.uniform(0, 5), value + rng.uniform(0, 5)
+        fields = ["2023", "Annual", measure, state, sub,
+                  f"{value:.2f}", f"{lower:.2f}", f"{upper:.2f}", "synthetic", "2023-01-01"]
+        if rng.random() < missing_rate:  # reproduce the reference data's holes
+            col = 4 + int(rng.integers(4))
+            fields[col] = "" if rng.random() < 0.5 else "nan"
+        lines.append(",".join(fields))
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return path
+
+
+def make_synthetic_image_dataset(
+    data_dir: str,
+    num_images: int = 32,
+    height: int = 64,
+    width: int = 80,
+    seed: int = DEFAULT_SEED,
+) -> str:
+    """Flat dir of PNGs + clean_labels.jsonl, laser-spot style: dark frame
+    with a bright gaussian blob at the (x_px, y_px) target."""
+    from PIL import Image
+
+    os.makedirs(data_dir, exist_ok=True)
+    rng = np_rng(seed)
+    yy, xx = np.mgrid[0:height, 0:width].astype(np.float32)
+    lines = []
+    for i in range(num_images):
+        cx = float(rng.uniform(4, width - 4))
+        cy = float(rng.uniform(4, height - 4))
+        blob = np.exp(-(((xx - cx) ** 2 + (yy - cy) ** 2) / (2 * 3.0 ** 2)))
+        img = (blob[..., None] * np.array([255, 40, 40]) +
+               rng.normal(8, 4, (height, width, 3))).clip(0, 255).astype(np.uint8)
+        name = f"img_{i:04d}.png"
+        Image.fromarray(img).save(os.path.join(data_dir, name))
+        lines.append(json.dumps({
+            "image": name,
+            "point": {"x_px": cx, "y_px": cy},
+            "image_size": {"width": width, "height": height},
+        }))
+    with open(os.path.join(data_dir, "clean_labels.jsonl"), "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return data_dir
+
+
+def synthetic_classification_arrays(
+    n: int = 512, input_dim: int = 3, num_classes: int = 10, seed: int = DEFAULT_SEED
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Linearly-separable-ish float features + int labels (MLP/CSV path)."""
+    rng = np_rng(seed)
+    centers = rng.normal(0, 3, (num_classes, input_dim))
+    y = rng.integers(0, num_classes, n).astype(np.int32)
+    x = centers[y] + rng.normal(0, 1, (n, input_dim))
+    return x.astype(np.float32), y
+
+
+def synthetic_tokens(
+    batch: int = 8, seq_len: int = 128, vocab_size: int = 30522, seed: int = DEFAULT_SEED
+) -> Dict[str, np.ndarray]:
+    rng = np_rng(seed)
+    return {
+        "input_ids": rng.integers(0, vocab_size, (batch, seq_len)).astype(np.int32),
+        "attention_mask": np.ones((batch, seq_len), dtype=np.int32),
+        "labels": rng.integers(0, 2, (batch,)).astype(np.int32),
+    }

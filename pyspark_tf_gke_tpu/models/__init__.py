@@ -1,0 +1,32 @@
+from pyspark_tf_gke_tpu.models.mlp import MLPClassifier
+from pyspark_tf_gke_tpu.models.cnn import CNNRegressor, PReLU
+from pyspark_tf_gke_tpu.models.resnet import ResNet50
+from pyspark_tf_gke_tpu.models.bert import BertConfig, BertEncoder, BertForPretraining
+
+__all__ = [
+    "MLPClassifier",
+    "CNNRegressor",
+    "PReLU",
+    "ResNet50",
+    "BertConfig",
+    "BertEncoder",
+    "BertForPretraining",
+    "build_model",
+]
+
+
+def build_model(name: str, **kw):
+    """Factory keyed by config.model (the analog of the reference's
+    build_deep_model/build_cnn_model dispatch, train_tf_ps.py:328-378)."""
+    name = name.lower()
+    if name == "mlp":
+        return MLPClassifier(num_classes=kw.get("num_classes", 10))
+    if name == "cnn":
+        return CNNRegressor(num_outputs=kw.get("num_outputs", 2), flat=kw.get("flat", False),
+                            dtype=kw.get("dtype", None))
+    if name == "resnet50":
+        return ResNet50(num_classes=kw.get("num_classes", 1000), dtype=kw.get("dtype", None))
+    if name == "bert":
+        cfg = kw.get("config") or BertConfig()
+        return BertForPretraining(cfg)
+    raise ValueError(f"Unknown model {name!r}")

@@ -1,0 +1,189 @@
+"""BERT-base encoder for the BASELINE.json config-5 workload
+("BERT-base fine-tune fed by PySpark-preprocessed TFRecord shards").
+
+Absent from the reference (no attention model exists there — SURVEY §2b);
+designed TPU-first:
+
+* every parameter carries **logical axis annotations**
+  (``nn.with_logical_partitioning``) so one set of rules
+  (``parallel.sharding.LOGICAL_RULES``) places the model on any mesh:
+  ``tp`` shards heads and MLP width, ``fsdp`` shards the embed dim,
+  ``sp`` shards the sequence dimension of activations;
+* attention dispatches to ``ops.ring_attention`` when the mesh has an
+  ``sp`` axis > 1 — long-context sequence parallelism over ICI — and to
+  plain MXU attention otherwise;
+* bfloat16 compute, float32 params and softmax accumulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from pyspark_tf_gke_tpu.ops.attention import dot_product_attention, ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def _dense(features, kernel_axes, cfg: BertConfig, name=None):
+    return nn.Dense(
+        features,
+        dtype=cfg.dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02), kernel_axes
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), (kernel_axes[-1],)
+        ),
+        name=name,
+    )
+
+
+def _layernorm(cfg: BertConfig, name=None):
+    return nn.LayerNorm(
+        epsilon=cfg.layer_norm_eps,
+        dtype=cfg.dtype,
+        scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), ("norm",)),
+        bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("norm",)),
+        name=name,
+    )
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        cfg = self.cfg
+        b, s, _ = hidden.shape
+        h, d = cfg.num_heads, cfg.head_dim
+
+        q = _dense(cfg.hidden_size, ("embed", "mlp"), cfg, name="query")(hidden)
+        k = _dense(cfg.hidden_size, ("embed", "mlp"), cfg, name="key")(hidden)
+        v = _dense(cfg.hidden_size, ("embed", "mlp"), cfg, name="value")(hidden)
+        q = q.reshape(b, s, h, d)
+        k = k.reshape(b, s, h, d)
+        v = v.reshape(b, s, h, d)
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"))
+        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"))
+
+        use_ring = self.mesh is not None and self.mesh.shape.get("sp", 1) > 1
+        if use_ring:
+            out = ring_attention(q, k, v, self.mesh, kv_mask=mask, axis="sp")
+        else:
+            out = dot_product_attention(q, k, v, mask=mask[:, None, None, :])
+        out = out.reshape(b, s, cfg.hidden_size)
+        out = _dense(cfg.hidden_size, ("mlp", "embed"), cfg, name="out")(out)
+        return out
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        cfg = self.cfg
+        attn_out = BertSelfAttention(cfg, self.mesh, name="attention")(hidden, mask)
+        hidden = _layernorm(cfg, name="ln_attn")(hidden + attn_out)
+        mlp = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg, name="mlp_in")(hidden)
+        mlp = nn.gelu(mlp, approximate=True)
+        mlp = _dense(cfg.hidden_size, ("mlp", "embed"), cfg, name="mlp_out")(mlp)
+        hidden = _layernorm(cfg, name="ln_mlp")(hidden + mlp)
+        return nn.with_logical_constraint(hidden, ("batch", "seq", "embed"))
+
+
+class BertEncoder(nn.Module):
+    cfg: BertConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((b, s), dtype=bool)
+        else:
+            attention_mask = attention_mask.astype(bool)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((b, s), dtype=jnp.int32)
+
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")),
+            name="word_embeddings",
+        )
+        pos_embed = nn.Embed(
+            cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), (None, "embed")),
+            name="position_embeddings",
+        )
+        type_embed = nn.Embed(
+            cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), (None, "embed")),
+            name="token_type_embeddings",
+        )
+        positions = jnp.arange(s)[None, :]
+        hidden = embed(input_ids) + pos_embed(positions) + type_embed(token_type_ids)
+        hidden = _layernorm(cfg, name="ln_embed")(hidden)
+        hidden = nn.with_logical_constraint(hidden, ("batch", "seq", "embed"))
+
+        layer_cls = BertLayer
+        if cfg.remat:
+            layer_cls = nn.remat(BertLayer, static_argnums=())
+        for i in range(cfg.num_layers):
+            hidden = layer_cls(cfg, self.mesh, name=f"layer_{i}")(hidden, attention_mask)
+        return hidden
+
+
+class BertForPretraining(nn.Module):
+    """Encoder + MLM head + sequence-level classifier (doubles as the
+    fine-tune head for config 5)."""
+
+    cfg: BertConfig
+    mesh: Optional[Mesh] = None
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        cfg = self.cfg
+        hidden = BertEncoder(cfg, self.mesh, name="encoder")(
+            input_ids, token_type_ids, attention_mask
+        )
+        mlm = _dense(cfg.hidden_size, ("embed", "embed_out"), cfg, name="mlm_transform")(hidden)
+        mlm = nn.gelu(mlm, approximate=True)
+        mlm = _layernorm(cfg, name="mlm_ln")(mlm)
+        mlm_logits = _dense(cfg.vocab_size, ("embed", "vocab"), cfg, name="mlm_head")(mlm)
+        pooled = jnp.tanh(
+            _dense(cfg.hidden_size, ("embed", "embed_out"), cfg, name="pooler")(hidden[:, 0])
+        )
+        cls_logits = _dense(self.num_labels, ("embed", None), cfg, name="classifier")(pooled)
+        return {
+            "mlm_logits": mlm_logits.astype(jnp.float32),
+            "cls_logits": cls_logits.astype(jnp.float32),
+        }

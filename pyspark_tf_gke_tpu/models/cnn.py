@@ -1,0 +1,76 @@
+"""CNN (x, y) pixel-coordinate regressor — parity oracle for the reference's
+``build_cnn_model`` (``workloads/raw-tf/train_tf_ps.py:346-378``):
+
+5× [Conv 5×5 same → PReLU → MaxPool (last block: no pool)] with channel
+progression 8→16→32→64→64, then either Flatten→Dense(2048) ("B1", 43.4M
+params, ``tf-model/150-320-by-256-B1-model.txt:31-33``) or
+GlobalAveragePooling→Dense(128) ("A1"), then Dense(num_outputs).
+
+PReLU parity note: Keras ``PReLU()`` with default ``shared_axes=None``
+learns one alpha **per element** of the feature map — verified against the
+reference's published parameter count (43,368,850 = convs 170,384 +
+per-element alphas 1,249,280 + dense 41,949,186 for 256×320 inputs). Our
+``PReLU`` defaults to the same, with ``shared_axes`` available for the
+channel-shared variant (cheaper and usually what you want on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pyspark_tf_gke_tpu.models.mlp import KERAS_BIAS_INIT, KERAS_KERNEL_INIT
+
+
+class PReLU(nn.Module):
+    """Parametric ReLU: ``max(x,0) + alpha * min(x,0)`` with learned alpha.
+
+    ``shared_axes=None`` → per-element alpha (Keras default, parity mode).
+    ``shared_axes=(1,2)`` → one alpha per channel for NHWC inputs.
+    """
+
+    shared_axes: Optional[Sequence[int]] = None
+    alpha_init: Any = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        shape = list(x.shape[1:])  # drop batch dim
+        if self.shared_axes:
+            for ax in self.shared_axes:
+                shape[ax - 1] = 1
+        alpha = self.param("alpha", self.alpha_init, tuple(shape), jnp.float32)
+        alpha = alpha.astype(x.dtype)
+        return jnp.maximum(x, 0) + alpha * jnp.minimum(x, 0)
+
+
+class CNNRegressor(nn.Module):
+    num_outputs: int = 2
+    flat: bool = False  # True → "B1" Flatten/Dense(2048) head; False → "A1" GAP/Dense(128)
+    features: Tuple[int, ...] = (8, 16, 32, 64, 64)
+    dtype: Optional[Any] = None  # compute dtype (bfloat16 on TPU); params float32
+    prelu_shared_axes: Optional[Sequence[int]] = None  # None = Keras parity
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.dtype) if self.dtype else x
+        n = len(self.features)
+        for i, feat in enumerate(self.features):
+            x = nn.Conv(feat, (5, 5), padding="SAME", dtype=self.dtype,
+                        kernel_init=KERAS_KERNEL_INIT, bias_init=KERAS_BIAS_INIT)(x)
+            x = PReLU(shared_axes=self.prelu_shared_axes)(x)
+            if i < n - 1:  # the reference's 5th block has no MaxPool
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        if self.flat:
+            x = x.reshape((x.shape[0], -1))
+            x = nn.Dense(2048, dtype=self.dtype, kernel_init=KERAS_KERNEL_INIT,
+                         bias_init=KERAS_BIAS_INIT)(x)
+        else:
+            x = jnp.mean(x, axis=(1, 2))
+            x = nn.Dense(128, dtype=self.dtype, kernel_init=KERAS_KERNEL_INIT,
+                         bias_init=KERAS_BIAS_INIT)(x)
+        x = nn.relu(x)
+        out = nn.Dense(self.num_outputs, dtype=self.dtype,
+                       kernel_init=KERAS_KERNEL_INIT, bias_init=KERAS_BIAS_INIT)(x)
+        return out.astype(jnp.float32)

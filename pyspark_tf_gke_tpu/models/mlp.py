@@ -1,0 +1,38 @@
+"""MLP classifier — parity oracle for the reference's ``build_deep_model``
+(``workloads/raw-tf/train_tf_ps.py:328-343``): Dense 16→32→64→num_classes.
+
+Differences are deliberate TPU idioms, not capability gaps:
+
+* the head returns **logits**; softmax lives inside the loss
+  (``optax.softmax_cross_entropy_with_integer_labels``) for numerical
+  stability — same loss value as the reference's softmax+SCCE pairing;
+* initializers pinned to Keras defaults (glorot-uniform kernels, zero
+  biases) so loss curves are comparable from step 0.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+KERAS_KERNEL_INIT = nn.initializers.glorot_uniform()
+KERAS_BIAS_INIT = nn.initializers.zeros_init()
+
+
+class MLPClassifier(nn.Module):
+    num_classes: int
+    hidden: tuple = (16, 32, 64)
+    dtype: Optional[Any] = None  # compute dtype; params stay float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.dtype) if self.dtype else x
+        for h in self.hidden:
+            x = nn.Dense(h, dtype=self.dtype, kernel_init=KERAS_KERNEL_INIT,
+                         bias_init=KERAS_BIAS_INIT)(x)
+            x = nn.relu(x)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          kernel_init=KERAS_KERNEL_INIT, bias_init=KERAS_BIAS_INIT)(x)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,3 @@
+from pyspark_tf_gke_tpu.ops.attention import dot_product_attention, ring_attention
+
+__all__ = ["dot_product_attention", "ring_attention"]

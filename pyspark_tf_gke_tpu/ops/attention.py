@@ -1,0 +1,140 @@
+"""Attention ops.
+
+The reference has no attention anywhere (its largest model is a 43M-param
+CNN — SURVEY §2b), but long-context support is first-class in this
+framework, so two implementations live here:
+
+* ``dot_product_attention`` — plain batched attention; XLA fuses it well
+  on the MXU for moderate sequence lengths.
+* ``ring_attention`` — sequence-parallel attention over the ``sp`` mesh
+  axis: each device holds one sequence block of Q/K/V, K/V blocks rotate
+  around the ring via ``lax.ppermute`` over ICI, and softmax is
+  accumulated online (flash-style running max / normalizer), so the full
+  S×S score matrix never materializes and sequence length scales with the
+  number of devices. Pattern follows the public ring-attention recipe
+  (blockwise attention + ring P2P), re-derived for shard_map.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # [B, Sq, H, D]
+    k: jnp.ndarray,  # [B, Sk, H, D]
+    v: jnp.ndarray,  # [B, Sk, H, D]
+    mask: Optional[jnp.ndarray] = None,  # broadcastable to [B, H, Sq, Sk]
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Standard attention in float32 accumulation, bf16-friendly inputs."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        cm = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(cm[None, None], scores, NEG_INF)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    if mask is not None:
+        # Rows with no valid key (all-padding queries) output 0, not mean(V).
+        valid = jnp.broadcast_to(mask, scores.shape).any(axis=-1)  # [B,H,Sq]
+        out = jnp.where(valid.transpose(0, 2, 1)[..., None], out, 0)
+    return out
+
+
+def _ring_block(q, k, v, kv_mask, axis_name: str, axis_size: int, causal: bool):
+    """Per-device body: local Q block attends to all K/V blocks as they
+    rotate around the ring. Shapes: q [B,Sq,H,D]; k,v [B,Sk,H,D];
+    kv_mask [B,Sk] bool or None."""
+    scale = q.shape[-1] ** -0.5
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    my_index = lax.axis_index(axis_name)
+
+    o = jnp.zeros((b, sq, h, d), dtype=jnp.float32)
+    m = jnp.full((b, h, sq), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((b, h, sq), dtype=jnp.float32)
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def body(i, carry):
+        o, m, l, k, v, kv_mask = carry
+        # Which global block this K/V came from: after i rotations we hold
+        # the block originally on device (my_index - i) mod axis_size.
+        src = (my_index - i) % axis_size
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = my_index * sq + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+            k_pos = src * sk + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        if kv_mask is not None:
+            s = jnp.where(kv_mask[:, None, None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1)
+        o = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+        )
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        if kv_mask is not None:
+            kv_mask = lax.ppermute(kv_mask, axis_name, perm)
+        return o, m_new, l, k, v, kv_mask
+
+    o, m, l, *_ = lax.fori_loop(0, axis_size, body, (o, m, l, k, v, kv_mask))
+    # Rows with no valid key anywhere keep m == NEG_INF (every score was
+    # masked); their p/l accumulations are exp(0)=1 garbage — zero them out,
+    # matching dot_product_attention's all-padding behavior.
+    valid = m > NEG_INF / 2  # [B,H,Sq]
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    out = jnp.where(valid.transpose(0, 2, 1)[..., None], out, 0)
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, S, H, D] — S sharded over `axis` outside
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    kv_mask: Optional[jnp.ndarray] = None,  # [B, S] bool, S sharded likewise
+    axis: str = "sp",
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Sequence-parallel attention over mesh axis ``axis``.
+
+    Inputs carry the *global* sequence dimension; shard_map splits it over
+    the ring. Batch stays sharded over the data axes, heads over ``tp``.
+    """
+    axis_size = mesh.shape[axis]
+    if axis_size == 1:
+        return dot_product_attention(q, k, v,
+                                     mask=None if kv_mask is None else kv_mask[:, None, None, :],
+                                     causal=causal)
+    data_spec = ("dp", "fsdp")
+    qkv_spec = P(data_spec, axis, "tp", None)
+    mask_spec = P(data_spec, axis)
+    fn = functools.partial(_ring_block, axis_name=axis, axis_size=axis_size, causal=causal)
+    in_specs = (qkv_spec, qkv_spec, qkv_spec, mask_spec if kv_mask is not None else P())
+    if kv_mask is None:
+        fn_wrapped = lambda q, k, v, _: fn(q, k, v, None)
+        kv_mask_arg = jnp.zeros((), dtype=bool)
+    else:
+        fn_wrapped = fn
+        kv_mask_arg = kv_mask
+    return shard_map(
+        fn_wrapped, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec, check_vma=False
+    )(q, k, v, kv_mask_arg)

@@ -1,0 +1,37 @@
+from pyspark_tf_gke_tpu.parallel.mesh import (
+    AXES,
+    DATA_AXES,
+    make_mesh,
+    batch_sharding,
+    replicated_sharding,
+    local_mesh_for_testing,
+)
+from pyspark_tf_gke_tpu.parallel.sharding import (
+    LOGICAL_RULES,
+    fsdp_spec,
+    fsdp_shardings,
+    logical_shardings,
+)
+from pyspark_tf_gke_tpu.parallel.distributed import (
+    build_coordinator_address,
+    initialize_distributed,
+    process_ordinal_from_hostname,
+    validate_ipv4,
+)
+
+__all__ = [
+    "AXES",
+    "DATA_AXES",
+    "make_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "local_mesh_for_testing",
+    "LOGICAL_RULES",
+    "fsdp_spec",
+    "fsdp_shardings",
+    "logical_shardings",
+    "build_coordinator_address",
+    "initialize_distributed",
+    "process_ordinal_from_hostname",
+    "validate_ipv4",
+]

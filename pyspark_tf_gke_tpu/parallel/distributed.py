@@ -1,0 +1,118 @@
+"""Multi-host bootstrap via ``jax.distributed``.
+
+This replaces the reference's cluster-definition machinery
+(``build_cluster_def`` + ``TF_CONFIG`` chief self-registration,
+``train_tf_ps.py:385-437,492-499``): instead of a ClusterSpec naming every
+worker/ps/chief gRPC endpoint, JAX needs only a single coordinator address;
+every process runs the same SPMD program and discovers peers through the
+coordinator. Tensor traffic then rides XLA collectives over ICI/DCN — the
+coordinator is control-plane only (the "thin bastion" design, SURVEY §7).
+
+Addressing conventions are kept from the reference:
+
+* k8s headless-service DNS names ``<job>-<ordinal>.<job>-headless:<port>``
+  (reference: ``train_tf_ps.py:420-430``; our manifests in
+  ``infra/k8s/``) — process 0's pod is the coordinator;
+* ordinal parsed from ``$HOSTNAME`` exactly like the reference's worker
+  pods and MySQL StatefulSet do (``tf-trainer-worker.yaml:51-54``,
+  ``mysql-statefulset.yaml:26-28``);
+* strict IPv4 validation for explicitly-passed addresses
+  (``train_tf_ps.py:473-490``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+from typing import Optional
+
+import jax
+
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+logger = get_logger("parallel.distributed")
+
+_ORDINAL_RE = re.compile(r"-(\d+)$")
+
+DEFAULT_JOB_NAME = "tpu-worker"
+DEFAULT_PORT = 8476
+
+
+def process_ordinal_from_hostname(hostname: Optional[str] = None) -> Optional[int]:
+    """StatefulSet ordinal from a pod hostname like ``tpu-worker-3``."""
+    if hostname is None:
+        hostname = os.environ.get("HOSTNAME", socket.gethostname())
+    m = _ORDINAL_RE.search(hostname.strip())
+    return int(m.group(1)) if m else None
+
+
+def validate_ipv4(addr: str, what: str = "coordinator_addr") -> None:
+    """Reject IPv6 / bracketed / scheme-prefixed addresses, as the reference
+    does for its chief address (``train_tf_ps.py:473-490``)."""
+    if any(sym in addr for sym in ("/", "[", "]", " ")):
+        raise RuntimeError(f"{what} {addr!r} is malformed; provide a raw IPv4 or DNS name.")
+    host = addr.rsplit(":", 1)[0] if addr.count(":") == 1 else addr
+    if ":" in host and "." not in host:
+        raise RuntimeError(
+            f"{what} appears to be IPv6 ({addr!r}); provide a routable IPv4 address."
+        )
+    parts = host.split(".")
+    if len(parts) == 4 and all(p.isdigit() for p in parts):
+        if any(not 0 <= int(p) <= 255 for p in parts):
+            raise RuntimeError(f"{what} {addr!r} is not a valid IPv4 address.")
+
+
+def build_coordinator_address(
+    coordinator_addr: str = "",
+    port: int = DEFAULT_PORT,
+    job_name: str = DEFAULT_JOB_NAME,
+) -> str:
+    """The (single) address every process dials at startup.
+
+    Explicit address wins; otherwise fall back to the headless-service DNS
+    convention with process 0 as coordinator — the analog of the
+    reference's generated ``tf-trainer-0.tf-trainer-worker-headless:2222``
+    names (``train_tf_ps.py:420-422``).
+    """
+    if coordinator_addr:
+        validate_ipv4(coordinator_addr)
+        return coordinator_addr if ":" in coordinator_addr else f"{coordinator_addr}:{port}"
+    return f"{job_name}-0.{job_name}-headless:{port}"
+
+
+def initialize_distributed(
+    num_processes: int = 1,
+    process_id: int = -1,
+    coordinator_addr: str = "",
+    coordinator_port: int = DEFAULT_PORT,
+    job_name: str = DEFAULT_JOB_NAME,
+) -> None:
+    """Initialize ``jax.distributed`` when running multi-host; no-op otherwise.
+
+    ``process_id=-1`` derives the id from the pod hostname ordinal. On GKE
+    TPU node pools the TPU runtime usually injects the topology env vars
+    and plain ``jax.distributed.initialize()`` suffices; explicit flags
+    cover bare-VM and local fake-slice launches.
+    """
+    if num_processes <= 1:
+        logger.info("Single-process run; skipping jax.distributed initialization.")
+        return
+    if process_id < 0:
+        ordinal = process_ordinal_from_hostname()
+        if ordinal is None:
+            raise RuntimeError(
+                "process_id not given and hostname has no trailing ordinal; "
+                "set --process-id or run in a StatefulSet/JobSet pod."
+            )
+        process_id = ordinal
+    address = build_coordinator_address(coordinator_addr, coordinator_port, job_name)
+    logger.info(
+        "jax.distributed.initialize(coordinator=%s, num_processes=%d, process_id=%d)",
+        address, num_processes, process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,104 @@
+"""Device-mesh construction.
+
+The reference expresses parallelism as a *process topology* (N worker pods,
+M parameter-server pods, ``train_tf_ps.py:385-437``). The TPU-native design
+expresses it as a *device mesh*: one logical array of chips with named
+axes, over which arrays are sharded with ``NamedSharding``. XLA inserts the
+collectives (allreduce over ICI replaces PS variable push/pull over gRPC).
+
+Canonical axis names (any subset may be size 1 / absent):
+
+``dp``    pure data parallelism (params replicated)
+``fsdp``  data parallelism with parameter/optimizer sharding — the analog
+          of the reference's ``MinSizePartitioner`` across PS replicas
+          (``train_tf_ps.py:505-507``), but sharding *all* state, not just
+          large variables on dedicated servers.
+``tp``    tensor (model) parallelism within a layer
+``sp``    sequence/context parallelism (ring attention)
+``ep``    expert parallelism (MoE)
+``pp``    pipeline parallelism across layer groups
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "pp", "tp", "sp", "ep")
+
+# Axes a global batch is split over. fsdp is "data parallelism that also
+# shards params", so the batch dimension spans both.
+DATA_AXES = ("dp", "fsdp")
+
+
+def make_mesh(
+    axes: Optional[Mapping[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``Mesh`` over ``devices`` with the canonical axis order.
+
+    ``axes`` maps axis name → size. Missing axes get size 1. An empty/None
+    ``axes`` puts every device on ``dp``. Axis sizes must multiply to the
+    device count, except that one axis may be -1 ("take the rest"),
+    mirroring the UX of the reference's replica-count flags.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    sizes = {a: 1 for a in AXES}
+    if axes:
+        for name, size in axes.items():
+            if name not in sizes:
+                raise ValueError(f"Unknown mesh axis {name!r}; valid axes: {AXES}")
+            sizes[name] = int(size)
+    else:
+        sizes["dp"] = n
+
+    wildcard = [a for a, s in sizes.items() if s == -1]
+    if len(wildcard) > 1:
+        raise ValueError("At most one mesh axis may be -1")
+    if wildcard:
+        fixed = int(np.prod([s for s in sizes.values() if s != -1]))
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes product {fixed}")
+        sizes[wildcard[0]] = n // fixed
+
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError(f"Mesh axes {dict(sizes)} require {total} devices, have {n}")
+
+    shape = tuple(sizes[a] for a in AXES)
+    device_array = np.asarray(devices).reshape(shape)
+    return Mesh(device_array, AXES)
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1, extra: Optional[P] = None) -> NamedSharding:
+    """Sharding for a host-fed batch: leading dim split over the data axes.
+
+    This is the SPMD replacement for the reference's per-worker
+    ``dataset.shard(num_input_pipelines, input_pipeline_id)``
+    (``train_tf_ps.py:312-313``): each chip sees 1/(dp*fsdp) of the batch.
+    """
+    if extra is not None:
+        return NamedSharding(mesh, P(DATA_AXES, *extra))
+    return NamedSharding(mesh, P(DATA_AXES, *([None] * (ndim - 1))))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_mesh_for_testing(n: int = 8, axes: Optional[Mapping[str, int]] = None) -> Mesh:
+    """Mesh over the first ``n`` local devices — the unit-test "fake slice"
+    (SURVEY §4: ``xla_force_host_platform_device_count`` stands in for the
+    reference's kind+MetalLB local cluster)."""
+    devices = jax.devices()[:n]
+    if len(devices) < n:
+        raise RuntimeError(
+            f"Need {n} devices for the fake slice, have {len(devices)}. "
+            "Set XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu."
+        )
+    return make_mesh(axes or {"dp": n}, devices)

@@ -1,0 +1,20 @@
+from pyspark_tf_gke_tpu.train.losses import (
+    mae_metric,
+    mse_loss,
+    softmax_cross_entropy,
+    accuracy_metric,
+)
+from pyspark_tf_gke_tpu.train.state import TrainState
+from pyspark_tf_gke_tpu.train.trainer import Trainer, TrainerTask
+from pyspark_tf_gke_tpu.train.checkpoint import CheckpointManager
+
+__all__ = [
+    "mae_metric",
+    "mse_loss",
+    "softmax_cross_entropy",
+    "accuracy_metric",
+    "TrainState",
+    "Trainer",
+    "TrainerTask",
+    "CheckpointManager",
+]

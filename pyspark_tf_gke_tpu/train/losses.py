@@ -1,0 +1,32 @@
+"""Loss and metric functions.
+
+Numerics match the reference's Keras pairings so loss curves compare
+directly: SparseCategoricalCrossentropy over softmax outputs ≡ softmax
+cross-entropy on logits (``train_tf_ps.py:336-342``); MeanSquaredError /
+MeanAbsoluteError for the CNN regressor (``train_tf_ps.py:372-377``).
+All reductions are float32 means regardless of compute dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels
+    ).mean()
+
+
+def accuracy_metric(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    return (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32).mean()
+
+
+def mse_loss(preds: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    diff = preds.astype(jnp.float32) - targets.astype(jnp.float32)
+    return jnp.mean(diff * diff)
+
+
+def mae_metric(preds: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(jnp.abs(preds.astype(jnp.float32) - targets.astype(jnp.float32)))

@@ -1,0 +1,45 @@
+"""Training state pytree.
+
+One flat struct holding params, optimizer state, optional batch-norm
+statistics, and the step counter. In the reference this state lived
+*physically* on parameter servers and was mutated asynchronously over gRPC
+(``train_tf_ps.py:611-647``); here it is a pure pytree, sharded across the
+mesh by ``NamedSharding`` and threaded functionally through the jitted
+step (donated, so XLA updates it in place).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import optax
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    batch_stats: Any = None
+
+    def apply_gradients(self, grads: Any, **updates) -> "TrainState":
+        updates_tx, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates_tx)
+        return self.replace(
+            step=self.step + 1, params=new_params, opt_state=new_opt_state, **updates
+        )
+
+    @classmethod
+    def create(cls, params: Any, tx: optax.GradientTransformation,
+               batch_stats: Any = None) -> "TrainState":
+        import jax.numpy as jnp
+
+        return cls(
+            step=jnp.zeros((), dtype=jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            batch_stats=batch_stats,
+            tx=tx,
+        )

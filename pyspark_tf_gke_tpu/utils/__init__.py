@@ -1,0 +1,13 @@
+from pyspark_tf_gke_tpu.utils.config import Config, parse_args
+from pyspark_tf_gke_tpu.utils.logging import get_logger, banner
+from pyspark_tf_gke_tpu.utils.seeding import DEFAULT_SEED, make_rng, fold_in_host
+
+__all__ = [
+    "Config",
+    "parse_args",
+    "get_logger",
+    "banner",
+    "DEFAULT_SEED",
+    "make_rng",
+    "fold_in_host",
+]

@@ -1,0 +1,142 @@
+"""Config / flag system.
+
+Mirrors the reference's three-tier pattern (argparse flags with env-var
+defaults — ``workloads/raw-tf/train_tf_ps.py:822-840`` — plus env-only
+overrides and deployment-time config), re-designed for the TPU runtime:
+the distributed knobs describe a ``jax.distributed`` process group and a
+device-mesh shape instead of a TF ClusterSpec.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from typing import List, Optional, Sequence
+
+
+def _env(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, str(default)))
+
+
+def _env_float(name: str, default: float) -> float:
+    return float(os.environ.get(name, str(default)))
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "y")
+
+
+@dataclasses.dataclass
+class Config:
+    """All knobs for a training run.
+
+    Every field has an env-var default (the reference's
+    ``default=os.environ.get(...)`` pattern, ``train_tf_ps.py:822-840``)
+    so the same binary is configured identically from a shell, a k8s env
+    block, or programmatically in tests.
+    """
+
+    # --- data ---
+    data_path: str = _env("DATA_PATH", "")
+    data_is_images: bool = _env_bool("DATA_IS_IMAGES", False)
+    img_height: int = _env_int("IMG_HEIGHT", 256)
+    img_width: int = _env_int("IMG_WIDTH", 320)
+    validation_split: float = _env_float("VALIDATION_SPLIT", 0.2)
+
+    # --- run shape ---
+    output_dir: str = _env("OUTPUT_DIR", "./tpu-model")
+    epochs: int = _env_int("EPOCHS", 1)
+    batch_size: int = _env_int("BATCH_SIZE", 32)  # GLOBAL batch size
+    steps_per_epoch: int = _env_int("STEPS_PER_EPOCH", 0)  # 0 → derive from data
+    seed: int = _env_int("SEED", 1337)
+
+    # --- model ---
+    model: str = _env("MODEL", "")  # "" = auto by data mode | mlp | cnn | resnet50 | bert
+    flat_layer: bool = _env_bool("FLAT_LAYER", False)  # CNN: Flatten (B1) vs GAP (A1) head
+    learning_rate: float = _env_float("LEARNING_RATE", 1e-3)
+    compute_dtype: str = _env("COMPUTE_DTYPE", "bfloat16")
+
+    # --- mesh / parallelism (compile-time sharding, replaces the
+    #     reference's WORKER_REPLICAS/PS_REPLICAS process topology) ---
+    mesh_shape: str = _env("MESH_SHAPE", "")  # e.g. "dp=4,fsdp=2" | "" → all devices on dp
+    fsdp_min_size: int = _env_int("FSDP_MIN_SIZE", 256 << 10 >> 2)
+    # ^ min number of elements before a param is FSDP-sharded — the analog of the
+    #   reference's MinSizePartitioner(min_shard_bytes=256KB) (train_tf_ps.py:505-507).
+
+    # --- distributed bootstrap (jax.distributed; replaces ClusterSpec/TF_CONFIG,
+    #     train_tf_ps.py:385-437,492-499) ---
+    coordinator_addr: str = _env("COORDINATOR_ADDR", "")
+    coordinator_port: int = _env_int("COORDINATOR_PORT", 8476)
+    num_processes: int = _env_int("NUM_PROCESSES", 1)
+    process_id: int = _env_int("PROCESS_ID", -1)  # -1 → derive from hostname ordinal
+
+    # --- checkpoint / aux ---
+    checkpoint_every_steps: int = _env_int("CHECKPOINT_EVERY_STEPS", 0)  # 0 → only at end
+    resume: bool = _env_bool("RESUME", False)
+    profile_dir: str = _env("PROFILE_DIR", "")
+    log_every_steps: int = _env_int("LOG_EVERY_STEPS", 50)
+
+    def mesh_axes(self) -> dict:
+        """Parse ``mesh_shape`` ("dp=4,fsdp=2,tp=1") into an ordered dict."""
+        axes = {}
+        if self.mesh_shape:
+            for part in self.mesh_shape.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                name, _, size = part.partition("=")
+                axes[name.strip()] = int(size)
+        return axes
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+
+def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
+    """CLI mirroring the reference's ``parse_args`` (train_tf_ps.py:822-840).
+
+    The distributed flags changed meaning by design: instead of
+    worker/ps/chief gRPC addresses we take a jax.distributed coordinator
+    address and a mesh shape (SPMD: every process runs this same program).
+    """
+    cfg = Config()
+    p = argparse.ArgumentParser(
+        description="Train a JAX model on CSV or image data on TPU, optionally distributed via jax.distributed"
+    )
+    p.add_argument("--data-path", default=cfg.data_path, help="Path to CSV file or flat image dir with clean_labels.jsonl")
+    p.add_argument("--data-is-images", action="store_true", default=cfg.data_is_images)
+    p.add_argument("--img-height", type=int, default=cfg.img_height)
+    p.add_argument("--img-width", type=int, default=cfg.img_width)
+    p.add_argument("--output-dir", default=cfg.output_dir)
+    p.add_argument("--epochs", type=int, default=cfg.epochs)
+    p.add_argument("--batch-size", type=int, default=cfg.batch_size, help="GLOBAL batch size across all chips")
+    p.add_argument("--steps-per-epoch", type=int, default=cfg.steps_per_epoch)
+    p.add_argument("--seed", type=int, default=cfg.seed)
+    p.add_argument("--model", default=cfg.model,
+                   choices=["", "mlp", "cnn", "resnet50", "bert"],
+                   help="empty = auto: mlp for CSV data, cnn for image data")
+    p.add_argument("--flat-layer", action="store_true", default=cfg.flat_layer)
+    p.add_argument("--learning-rate", type=float, default=cfg.learning_rate)
+    p.add_argument("--compute-dtype", default=cfg.compute_dtype)
+    p.add_argument("--mesh-shape", default=cfg.mesh_shape, help='e.g. "dp=4,fsdp=2"; empty → all devices on dp')
+    p.add_argument("--coordinator-addr", default=cfg.coordinator_addr)
+    p.add_argument("--coordinator-port", type=int, default=cfg.coordinator_port)
+    p.add_argument("--num-processes", type=int, default=cfg.num_processes)
+    p.add_argument("--process-id", type=int, default=cfg.process_id)
+    p.add_argument("--checkpoint-every-steps", type=int, default=cfg.checkpoint_every_steps)
+    p.add_argument("--resume", action="store_true", default=cfg.resume)
+    p.add_argument("--profile-dir", default=cfg.profile_dir)
+    ns = p.parse_args(argv)
+    return cfg.replace(**{k.replace("-", "_"): v for k, v in vars(ns).items()})

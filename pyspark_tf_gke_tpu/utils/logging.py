@@ -1,0 +1,33 @@
+"""Logging helpers.
+
+Keeps the reference's conventions: per-component loggers with
+duplicated-handler guards (``workloads/raw-spark/spark_session.py:8-26``)
+and banner-line delimiters around major phases
+(``workloads/raw-spark/k_means.py:201-208``).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+def get_logger(name: str, level: int = logging.INFO) -> logging.Logger:
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    # Guard against duplicated handlers when called twice for the same name.
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        logger.addHandler(handler)
+        logger.propagate = False
+    return logger
+
+
+def banner(logger: logging.Logger, message: str, width: int = 80) -> None:
+    line = "=" * width
+    logger.info(line)
+    logger.info(message)
+    logger.info(line)

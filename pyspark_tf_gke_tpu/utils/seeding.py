@@ -1,0 +1,32 @@
+"""Deterministic seeding.
+
+The reference seeds everything with 1337 (``train_tf_ps.py:208,656``); we
+keep that default and extend it with JAX PRNG-key discipline: one root key
+per run, folded per host / per step so multi-host data pipelines stay
+deterministic and non-overlapping.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+DEFAULT_SEED = 1337
+
+
+def make_rng(seed: int = DEFAULT_SEED) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def fold_in_host(key: jax.Array, process_index: int | None = None) -> jax.Array:
+    """Per-host key so each host shards/shuffles its own data slice."""
+    if process_index is None:
+        process_index = jax.process_index()
+    return jax.random.fold_in(key, process_index)
+
+
+def np_rng(seed: int = DEFAULT_SEED) -> np.random.Generator:
+    """The numpy generator used for deterministic dataset splits —
+    identical to the reference's ``np.random.default_rng(seed)`` usage
+    (``train_tf_ps.py:281-283, 655-657``) so splits match exactly."""
+    return np.random.default_rng(seed)

@@ -1,0 +1,32 @@
+import os
+
+from pyspark_tf_gke_tpu.utils.config import Config, parse_args
+from pyspark_tf_gke_tpu.utils.seeding import np_rng
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.batch_size == 32
+    assert cfg.seed == 1337
+    assert cfg.img_height == 256 and cfg.img_width == 320
+
+
+def test_parse_args_overrides():
+    cfg = parse_args(["--epochs", "3", "--batch-size", "64", "--mesh-shape", "dp=2,fsdp=4"])
+    assert cfg.epochs == 3
+    assert cfg.batch_size == 64
+    assert cfg.mesh_axes() == {"dp": 2, "fsdp": 4}
+
+
+def test_env_defaults(monkeypatch):
+    monkeypatch.setenv("EPOCHS", "7")
+    monkeypatch.setenv("MESH_SHAPE", "dp=8")
+    cfg = Config(epochs=int(os.environ["EPOCHS"]), mesh_shape=os.environ["MESH_SHAPE"])
+    assert cfg.epochs == 7
+    assert cfg.mesh_axes() == {"dp": 8}
+
+
+def test_np_rng_deterministic():
+    a = np_rng(1337).permutation(100)
+    b = np_rng(1337).permutation(100)
+    assert (a == b).all()

@@ -1,0 +1,123 @@
+import numpy as np
+import pytest
+
+from pyspark_tf_gke_tpu.data.csv_loader import load_csv
+from pyspark_tf_gke_tpu.data.images import count_images, list_labeled_images, make_image_arrays
+from pyspark_tf_gke_tpu.data.pipeline import BatchIterator, host_shard, train_validation_split
+from pyspark_tf_gke_tpu.data.synthetic import (
+    make_synthetic_csv,
+    make_synthetic_image_dataset,
+)
+
+
+def test_load_csv_skip_rules(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text(
+        "subpopulation,value,lower_ci,upper_ci\n"
+        "A,1.0,0.5,1.5\n"
+        ",2.0,1.0,3.0\n"          # empty label → skipped
+        "B,nan,1.0,3.0\n"          # nan feature → skipped
+        "B,2.0,,3.0\n"             # empty feature → skipped
+        "C,4.0,3.5,4.5\n"
+        "B,notanumber,1,2\n"       # malformed → skipped
+        "A,5.0,4.0,6.0\n"
+    )
+    X, y, vocab = load_csv(str(p))
+    assert vocab == ["A", "C"]  # sorted unique labels of surviving rows
+    assert X.shape == (3, 3) and X.dtype == np.float32
+    assert y.tolist() == [0, 1, 0]
+    assert y.dtype == np.int32
+
+
+def test_load_csv_empty_raises(tmp_path):
+    p = tmp_path / "empty.csv"
+    p.write_text("subpopulation,value,lower_ci,upper_ci\n")
+    with pytest.raises(RuntimeError):
+        load_csv(str(p))
+
+
+def test_synthetic_csv_roundtrip(tmp_path):
+    path = make_synthetic_csv(str(tmp_path / "h.csv"), rows=200)
+    X, y, vocab = load_csv(path)
+    assert X.shape[1] == 3
+    assert len(vocab) >= 2
+    assert len(X) < 200  # some rows dropped by design (missing values)
+
+
+def test_image_dataset(tmp_path):
+    d = make_synthetic_image_dataset(str(tmp_path / "imgs"), num_images=10, height=32, width=40)
+    assert count_images(d) == 10
+    paths, targets = list_labeled_images(d)
+    assert targets.shape == (10, 2)
+    images, t2 = make_image_arrays(d, (32, 40))
+    assert images.shape == (10, 32, 40, 3)
+    assert images.dtype == np.float32
+    assert images.min() >= 0.0 and images.max() <= 1.0
+    # the blob is bright red — the argmax pixel should be near the target
+    i = 0
+    yx = np.unravel_index(images[i, :, :, 0].argmax(), (32, 40))
+    assert abs(yx[1] - t2[i, 0]) < 3 and abs(yx[0] - t2[i, 1]) < 3
+
+
+def test_image_dataset_skips_bad_lines(tmp_path):
+    d = make_synthetic_image_dataset(str(tmp_path / "imgs"), num_images=4, height=16, width=16)
+    with open(f"{d}/clean_labels.jsonl", "a") as fh:
+        fh.write('{"image": "missing.png", "point": {"x_px": 1, "y_px": 1}}\n')
+        fh.write('not json\n')
+        fh.write('{"image": "img_0000.png"}\n')  # no point → skipped
+        fh.write('{"image": "img_0000.txt", "point": {"x_px": 1, "y_px": 1}}\n')
+    assert count_images(d) == 4
+
+
+def test_split_deterministic_and_disjoint():
+    t1, v1 = train_validation_split(100, 0.2, seed=1337)
+    t2, v2 = train_validation_split(100, 0.2, seed=1337)
+    assert (t1 == t2).all() and (v1 == v2).all()
+    assert len(v1) == 20 and len(t1) == 80
+    assert set(t1) | set(v1) == set(range(100))
+    t3, _ = train_validation_split(100, 0.2, seed=7)
+    assert not (t1 == t3).all()
+
+
+def test_split_clamps():
+    t, v = train_validation_split(3, 0.01)
+    assert len(v) == 1 and len(t) == 2
+
+
+def test_host_shard():
+    x = np.arange(10)
+    (a,) = host_shard(x, process_index=0, process_count=2)
+    (b,) = host_shard(x, process_index=1, process_count=2)
+    assert (a == x[0::2]).all() and (b == x[1::2]).all()
+    (full,) = host_shard(x, process_index=0, process_count=1)
+    assert (full == x).all()
+
+
+def test_batch_iterator_coverage_and_determinism():
+    x = np.arange(20)
+    it1 = BatchIterator({"x": x}, batch_size=5, seed=1)
+    it2 = BatchIterator({"x": x}, batch_size=5, seed=1)
+    epoch1 = [next(it1)["x"] for _ in range(4)]
+    epoch1b = [next(it2)["x"] for _ in range(4)]
+    assert all((a == b).all() for a, b in zip(epoch1, epoch1b))
+    # each epoch covers all rows exactly once
+    assert sorted(np.concatenate(epoch1).tolist()) == x.tolist()
+    assert it1.steps_per_epoch == 4
+
+
+def test_batch_iterator_mismatch_raises():
+    with pytest.raises(ValueError):
+        BatchIterator({"x": np.arange(4), "y": np.arange(5)}, 2)
+    with pytest.raises(ValueError):
+        BatchIterator({"x": np.arange(4)}, 8)
+
+
+def test_batch_iterator_partial_final_batch():
+    x = np.arange(10)
+    it = BatchIterator({"x": x}, batch_size=4, shuffle=False, drop_remainder=False)
+    assert it.steps_per_epoch == 3
+    got = [next(it)["x"] for _ in range(3)]
+    assert [len(g) for g in got] == [4, 4, 2]
+    assert sorted(np.concatenate(got).tolist()) == x.tolist()
+    # next epoch starts from the top again
+    assert (next(it)["x"] == x[:4]).all()

@@ -1,0 +1,71 @@
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pyspark_tf_gke_tpu.parallel.distributed import (
+    build_coordinator_address,
+    process_ordinal_from_hostname,
+    validate_ipv4,
+)
+from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding, make_mesh
+from pyspark_tf_gke_tpu.parallel.sharding import fsdp_spec
+
+
+def test_make_mesh_default_all_dp(devices):
+    mesh = make_mesh()
+    assert mesh.shape["dp"] == len(devices)
+
+
+def test_make_mesh_wildcard(devices):
+    mesh = make_mesh({"dp": -1, "tp": 2})
+    assert mesh.shape["dp"] == len(devices) // 2
+    assert mesh.shape["tp"] == 2
+
+
+def test_make_mesh_bad_product(devices):
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 3})
+    with pytest.raises(ValueError):
+        make_mesh({"bogus": 8})
+
+
+def test_batch_sharding_spec(mesh_dp_fsdp):
+    s = batch_sharding(mesh_dp_fsdp, ndim=2)
+    assert s.spec == P(("dp", "fsdp"), None)
+
+
+def test_fsdp_spec_shards_large_divisible(mesh_dp_fsdp):
+    # fsdp axis = 4; big divisible dim → sharded on it
+    spec = fsdp_spec((1024, 512), mesh_dp_fsdp, min_size=1024)
+    assert spec == P("fsdp", None)
+    # small param → replicated (the MinSizePartitioner contract)
+    assert fsdp_spec((16,), mesh_dp_fsdp, min_size=1024) == P()
+    # indivisible dims → replicated
+    assert fsdp_spec((33, 7), mesh_dp_fsdp, min_size=1) == P()
+
+
+def test_fsdp_spec_no_fsdp_axis(mesh_dp):
+    assert fsdp_spec((1024, 1024), mesh_dp, min_size=1) == P()
+
+
+def test_process_ordinal():
+    assert process_ordinal_from_hostname("tpu-worker-3") == 3
+    assert process_ordinal_from_hostname("tf-trainer-ps-0") == 0
+    assert process_ordinal_from_hostname("nohyphenordinal") is None
+
+
+def test_coordinator_address_convention():
+    assert build_coordinator_address() == "tpu-worker-0.tpu-worker-headless:8476"
+    assert build_coordinator_address("10.0.0.5", 1234) == "10.0.0.5:1234"
+    assert build_coordinator_address("10.0.0.5:99") == "10.0.0.5:99"
+
+
+def test_validate_ipv4_rejects_bad():
+    with pytest.raises(RuntimeError):
+        validate_ipv4("fe80::1")
+    with pytest.raises(RuntimeError):
+        validate_ipv4("http://10.0.0.1/x")
+    with pytest.raises(RuntimeError):
+        validate_ipv4("300.1.1.1")
+    validate_ipv4("192.168.1.10")  # ok
+    validate_ipv4("my-host.example:8476")  # DNS names ok
