@@ -1,0 +1,110 @@
+"""North-star benchmark: flagship (CNN-B1) train step on real TPU.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric (per BASELINE.json): images/sec/chip for the reference's flagship
+training workload — the 43.4M-param B1 CNN regressor
+(``/root/reference/workloads/raw-tf/train_tf_ps.py:346-378``), batch 32,
+256×320×3, trained with Adam/MSE. Step time (ms) is included in the JSON
+as an extra field.
+
+``vs_baseline`` compares against the measured throughput of the
+reference's own TensorFlow implementation of the same workload on CPU,
+extrapolated to the reference baseline cluster's 16 vCPUs
+(``tools/reference_baseline.json`` — the reference publishes no numbers,
+and its baseline "TF pool" is CPU nodes; see tools/measure_reference_baseline.py).
+
+All diagnostics go to stderr; stdout carries exactly the one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main(batch_size: int = 32, warmup: int = 10, steps: int = 100) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from pyspark_tf_gke_tpu.models import CNNRegressor
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding, make_mesh
+    from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    devices = jax.devices()
+    log(f"devices: {devices}")
+    n_chips = len(devices)
+
+    mesh = make_mesh()  # all chips on dp
+    model = CNNRegressor(num_outputs=2, flat=True, dtype=jnp.bfloat16)
+    trainer = Trainer(model, TASKS["regression"](), mesh, learning_rate=1e-3)
+
+    rng = np.random.default_rng(0)
+    images = rng.uniform(0, 1, (batch_size, 256, 320, 3)).astype(np.float32)
+    targets = rng.uniform(0, 256, (batch_size, 2)).astype(np.float32)
+
+    state = trainer.init_state(make_rng(1337), {"image": images[:1], "target": targets[:1]})
+
+    sharding = batch_sharding(mesh)
+    batch = {
+        "image": jax.device_put(images, sharding),
+        "target": jax.device_put(targets, sharding),
+    }
+
+    # All `steps` train steps run inside ONE dispatch (on-device lax.scan):
+    # host-side loops on remote-attached chips report ready before the queue
+    # drains, understating step time up to ~50x. Full metric readback
+    # (np.asarray) forces true completion.
+    log("compiling + warmup...")
+    state, metrics = trainer.multi_step(state, batch, steps)
+    np.asarray(metrics["loss"])
+
+    log(f"measuring {steps} steps (single-dispatch scan)...")
+    t0 = time.perf_counter()
+    state, metrics = trainer.multi_step(state, batch, steps)
+    losses = np.asarray(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    step_ms = dt / steps * 1000.0
+    images_per_sec = batch_size * steps / dt
+    images_per_sec_per_chip = images_per_sec / n_chips
+
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "reference_baseline.json"
+    )
+    vs_baseline = None
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as fh:
+            ref = json.load(fh)
+        base = ref.get("images_per_sec_extrapolated_16vcpu") or ref.get("images_per_sec")
+        if base:
+            vs_baseline = images_per_sec_per_chip / base
+
+    result = {
+        "metric": "cnn_b1_train_images_per_sec_per_chip",
+        "value": round(images_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs_baseline, 3) if vs_baseline is not None else None,
+        "step_time_ms": round(step_ms, 3),
+        "batch_size": batch_size,
+        "n_chips": n_chips,
+        "workload": "CNN-B1 43.4M params, 256x320x3, Adam+MSE, bf16 compute",
+        "baseline": "reference TF CNN-B1 on 16 vCPU (extrapolated; tools/reference_baseline.json)",
+    }
+    log(f"loss trajectory: {losses[0]:.3f} -> {losses[-1]:.3f}")
+    return result
+
+
+if __name__ == "__main__":
+    out = main()
+    print(json.dumps(out))

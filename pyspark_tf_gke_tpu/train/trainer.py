@@ -148,14 +148,25 @@ class Trainer:
         self.fsdp_min_size = fsdp_min_size
         self.logical_rules = logical_rules
         self._train_step = None
+        self._raw_train_step = None
         self._eval_step = None
+        self._scan_steps: Dict[int, Any] = {}
         self.state_shardings = None
 
     # ---- state construction -------------------------------------------------
 
     def _sample_inputs(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        """First-row slice of a batch, for shape-only init."""
-        return {k: v[:1] for k, v in batch.items()}
+        """Minimal batch slice for shape-only init: one row per data-parallel
+        shard (shard_map paths, e.g. ring attention, need the global batch
+        divisible by dp*fsdp even at init)."""
+        n = self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1)
+        for k, v in batch.items():
+            if len(v) < n:
+                raise ValueError(
+                    f"sample batch key {k!r} has {len(v)} rows; need >= {n} "
+                    "(one per data-parallel shard) to trace init"
+                )
+        return {k: v[:n] for k, v in batch.items()}
 
     def _create_fn(self, sample_batch):
         model, task, tx = self.model, self.task, self.tx
@@ -240,6 +251,7 @@ class Trainer:
             _, metrics = task.loss_and_metrics(preds, batch)
             return metrics
 
+        self._raw_train_step = train_step
         self._train_step = jax.jit(
             train_step,
             donate_argnums=0,
@@ -252,6 +264,30 @@ class Trainer:
             self._build_steps()
         with self.mesh:
             return self._train_step(state, batch)
+
+    def multi_step(self, state: TrainState, batch: Dict[str, jax.Array], k: int):
+        """Run ``k`` train steps on the same batch inside ONE dispatch via an
+        on-device ``lax.scan``. Amortizes per-dispatch host/RPC latency —
+        essential for honest step-time measurement on remote-attached chips
+        and for small models where dispatch dominates. Returns
+        (state, stacked metrics with leading dim k)."""
+        if self._train_step is None:
+            self._build_steps()
+        fn = self._scan_steps.get(k)
+        if fn is None:
+            raw = self._raw_train_step
+
+            def scan_fn(state, batch):
+                def body(s, _):
+                    s2, m = raw(s, batch)
+                    return s2, m
+                return jax.lax.scan(body, state, None, length=k)
+
+            fn = jax.jit(scan_fn, donate_argnums=0,
+                         out_shardings=(self.state_shardings, None))
+            self._scan_steps[k] = fn
+        with self.mesh:
+            return fn(state, batch)
 
     def evaluate(self, state: TrainState, batches) -> Dict[str, float]:
         if self._eval_step is None:

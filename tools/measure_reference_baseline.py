@@ -1,0 +1,84 @@
+"""Measure the reference workload's throughput to calibrate bench.py's
+``vs_baseline``.
+
+The reference publishes no step-time/throughput numbers (BASELINE.md), and
+its "distributed" baseline cluster is CPU node pools (2× e2-standard-8,
+``infra/cloud/terraform/GCP/main.tf:176-208`` — defined but commented
+out). So we measure the same workload the reference trains — the B1 CNN
+regressor (``train_tf_ps.py:346-378``), built *in TensorFlow/Keras with
+identical architecture and batch size* — on this host's CPUs, and cache
+the result in ``tools/reference_baseline.json``. bench.py reports TPU
+throughput relative to that number.
+
+Run once per machine class: ``python tools/measure_reference_baseline.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+
+import numpy as np
+
+
+def build_reference_cnn(input_shape=(256, 320, 3), flat=True):
+    """The reference's build_cnn_model architecture (train_tf_ps.py:346-378),
+    reconstructed from its published Keras summary."""
+    import tensorflow as tf
+
+    layers = [tf.keras.layers.Input(shape=input_shape)]
+    for i, feats in enumerate((8, 16, 32, 64, 64)):
+        layers.append(tf.keras.layers.Conv2D(feats, 5, padding="same"))
+        layers.append(tf.keras.layers.PReLU())
+        if i < 4:
+            layers.append(tf.keras.layers.MaxPooling2D())
+    layers.append(tf.keras.layers.Flatten() if flat else tf.keras.layers.GlobalAveragePooling2D())
+    layers.append(tf.keras.layers.Dense(2048 if flat else 128, activation="relu"))
+    layers.append(tf.keras.layers.Dense(2, activation="linear"))
+    model = tf.keras.Sequential(layers)
+    model.compile(
+        optimizer=tf.keras.optimizers.Adam(1e-3),
+        loss=tf.keras.losses.MeanSquaredError(),
+        metrics=[tf.keras.metrics.MeanAbsoluteError(name="mae")],
+    )
+    return model
+
+
+def main(batch_size=32, warmup_steps=2, steps=6):
+    import tensorflow as tf
+
+    model = build_reference_cnn()
+    n_params = model.count_params()
+    assert n_params == 43_368_850, n_params  # must equal the reference's B1
+
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 1, (batch_size, 256, 320, 3)).astype(np.float32)
+    y = rng.uniform(0, 256, (batch_size, 2)).astype(np.float32)
+
+    for _ in range(warmup_steps):
+        model.train_on_batch(x, y)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        model.train_on_batch(x, y)
+    dt = time.perf_counter() - t0
+
+    result = {
+        "workload": "reference CNN-B1 (43,368,850 params) train step, batch 32, 256x320x3, float32",
+        "framework": "tensorflow-keras (reference implementation re-built per train_tf_ps.py:346-378)",
+        "hardware": f"CPU ({os.cpu_count()} logical cores) — stand-in for the reference's CPU node-pool baseline (2x e2-standard-8, main.tf:176-208)",
+        "step_time_ms": dt / steps * 1000.0,
+        "images_per_sec": batch_size * steps / dt,
+        "tf_version": tf.__version__,
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "reference_baseline.json")
+    with open(out, "w") as fh:
+        json.dump(result, fh, indent=2)
+    print(json.dumps(result, indent=2))
+
+
+if __name__ == "__main__":
+    main()
