@@ -1,0 +1,157 @@
+"""TFRecord bridge: the contract between the Spark ETL pool and the TPU
+training plane (BASELINE.json configs 3 and 5; SURVEY §7 step 7).
+
+Schema contract (one tf.train.Example per row):
+* float arrays   → ``float_list`` feature named after the column;
+* int arrays     → ``int64_list``;
+* uint8 tensors  → ``bytes_list`` raw bytes (shape restored by the reader
+  from the declared schema).
+
+The Spark side writes the same schema via
+``etl.tfrecord_bridge.write_dataframe_shards``; this module is the
+TPU-side reader (and a host-side writer used by tests and single-host
+pipelines). Multi-host reads shard **by file** per process — the SPMD
+analog of the reference's ``dataset.shard(num_input_pipelines, id)``
+(``train_tf_ps.py:312-313``) — so hosts never read overlapping shards.
+
+Import of tensorflow is deferred: the training image needs it only when
+the TFRecord path is used.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+Schema = Dict[str, Tuple[str, Tuple[int, ...]]]  # name -> (kind, per-row shape)
+
+
+def _tf():
+    import tensorflow as tf
+
+    return tf
+
+
+def write_tfrecord_shards(
+    arrays: Dict[str, np.ndarray],
+    path_prefix: str,
+    num_shards: int = 4,
+) -> Sequence[str]:
+    """Write row-aligned arrays as ``{path_prefix}-{i:05d}-of-{n:05d}.tfrecord``."""
+    tf = _tf()
+    n = len(next(iter(arrays.values())))
+    for k, v in arrays.items():
+        if len(v) != n:
+            raise ValueError(f"array {k!r} length {len(v)} != {n}")
+    os.makedirs(os.path.dirname(os.path.abspath(path_prefix)), exist_ok=True)
+
+    paths = []
+    for shard in range(num_shards):
+        path = f"{path_prefix}-{shard:05d}-of-{num_shards:05d}.tfrecord"
+        paths.append(path)
+        with tf.io.TFRecordWriter(path) as writer:
+            for i in range(shard, n, num_shards):
+                feats = {}
+                for key, arr in arrays.items():
+                    row = arr[i]
+                    if arr.dtype == np.uint8:
+                        feats[key] = tf.train.Feature(
+                            bytes_list=tf.train.BytesList(value=[row.tobytes()])
+                        )
+                    elif np.issubdtype(arr.dtype, np.integer):
+                        feats[key] = tf.train.Feature(
+                            int64_list=tf.train.Int64List(value=np.ravel(row).tolist())
+                        )
+                    else:
+                        feats[key] = tf.train.Feature(
+                            float_list=tf.train.FloatList(
+                                value=np.ravel(row).astype(np.float32).tolist()
+                            )
+                        )
+                ex = tf.train.Example(features=tf.train.Features(feature=feats))
+                writer.write(ex.SerializeToString())
+    return paths
+
+
+def schema_for(arrays: Dict[str, np.ndarray]) -> Schema:
+    out: Schema = {}
+    for k, v in arrays.items():
+        if v.dtype == np.uint8:
+            kind = "bytes"
+        elif np.issubdtype(v.dtype, np.integer):
+            kind = "int"
+        else:
+            kind = "float"
+        out[k] = (kind, tuple(v.shape[1:]))
+    return out
+
+
+def read_tfrecord_batches(
+    pattern: str,
+    schema: Schema,
+    batch_size: int,
+    shuffle: bool = True,
+    seed: int = 1337,
+    repeat: bool = True,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Stream host-sharded numpy batches from TFRecord shards.
+
+    Files matching ``pattern`` are sorted and distributed round-robin over
+    processes (file-level sharding: each host owns whole shards). Returns
+    an infinite (if ``repeat``) iterator of dicts, ready for
+    ``put_global_batch``.
+    """
+    import jax
+
+    tf = _tf()
+    if process_index is None:
+        process_index = jax.process_index()
+    if process_count is None:
+        process_count = jax.process_count()
+
+    files = sorted(glob.glob(pattern))
+    if not files:
+        raise FileNotFoundError(f"no TFRecord shards match {pattern!r}")
+    local_files = files[process_index::process_count]
+    if not local_files:
+        raise ValueError(
+            f"{len(files)} shards < {process_count} processes; write more shards"
+        )
+
+    feature_spec = {}
+    for key, (kind, shape) in schema.items():
+        if kind == "bytes":
+            feature_spec[key] = tf.io.FixedLenFeature([], tf.string)
+        elif kind == "int":
+            feature_spec[key] = tf.io.FixedLenFeature(shape, tf.int64)
+        else:
+            feature_spec[key] = tf.io.FixedLenFeature(shape, tf.float32)
+
+    def parse(raw):
+        ex = tf.io.parse_single_example(raw, feature_spec)
+        out = {}
+        for key, (kind, shape) in schema.items():
+            v = ex[key]
+            if kind == "bytes":
+                v = tf.reshape(tf.io.decode_raw(v, tf.uint8), shape)
+            elif kind == "int":
+                v = tf.cast(v, tf.int32)
+            out[key] = v
+        return out
+
+    ds = tf.data.TFRecordDataset(local_files, num_parallel_reads=tf.data.AUTOTUNE)
+    ds = ds.map(parse, num_parallel_calls=tf.data.AUTOTUNE)
+    if shuffle:
+        ds = ds.shuffle(buffer_size=3000, seed=seed)  # reference buffer size
+    ds = ds.batch(batch_size, drop_remainder=True)
+    if repeat:
+        ds = ds.repeat()
+    ds = ds.prefetch(tf.data.AUTOTUNE)
+
+    for batch in ds.as_numpy_iterator():
+        yield batch

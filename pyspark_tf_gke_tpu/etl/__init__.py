@@ -1,0 +1,25 @@
+"""ETL / classical-ML plane.
+
+Two implementations of the reference's Spark workload family
+(``workloads/raw-spark/`` — JDBC ingest, feature pipeline, KMeans):
+
+* **TPU-native** (always available): ``feature_pipeline`` + ``kmeans`` run
+  the same classical-ML workload as JAX programs — Lloyd iterations are
+  one big distance matmul on the MXU. Semantics match Spark MLlib
+  (StringIndexer frequency-desc ordering, mean imputation, one-hot
+  weighting by repetition, k-means|| style seeding) so results are
+  comparable.
+* **PySpark** (import-gated; the north star keeps the ETL pool on Spark):
+  ``spark_session``, ``jdbc_ingest``, ``kmeans_spark``,
+  ``tfrecord_bridge`` mirror the reference's session factory, partitioned
+  JDBC read, KMeans pipeline, and add the Spark→TFRecord shard writer
+  that feeds the TPU training plane.
+
+``load_csv_mysql`` is the CSV→MySQL bootstrap loader
+(mysql-connector-gated), reference ``infra/local/mysql-database/load_csv.py``.
+"""
+
+from pyspark_tf_gke_tpu.etl.feature_pipeline import FeaturePipeline
+from pyspark_tf_gke_tpu.etl.kmeans import KMeans, silhouette_score
+
+__all__ = ["FeaturePipeline", "KMeans", "silhouette_score"]

@@ -1,0 +1,118 @@
+"""Feature-engineering pipeline with Spark MLlib semantics, host-side.
+
+Reproduces the reference's KMeans feature pipeline
+(``workloads/raw-spark/k_means.py:17-74``) without a Spark cluster:
+
+1. drop rows with a null clustering target (``measure_name``);
+2. StringIndexer: category → index ordered by **descending frequency,
+   ties broken alphabetically** (Spark's default ``frequencyDesc``);
+3. OneHotEncoder: index → one-hot, Spark-style **dropLast=True** (the
+   last category encodes as all-zeros);
+4. mean imputation of null/NaN numeric columns;
+5. feature weighting by repeating the one-hot block
+   ``MEASURE_NAME_WEIGHT`` times (default 5, env-overridable, clamped to
+   >= 1 — ``k_means.py:56-61``): repeating a vector m times scales its
+   squared-distance contribution by m;
+6. assemble [one-hot * repeats, numeric...] into a dense matrix.
+
+The output matrix feeds ``etl.kmeans.KMeans`` (the MXU path) and is
+bit-comparable to what Spark's VectorAssembler would produce.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def string_index(values: Sequence[str]) -> Dict[str, int]:
+    """Spark StringIndexer ``frequencyDesc``: most frequent → 0; ties
+    alphabetical."""
+    counts = Counter(values)
+    ordered = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    return {cat: i for i, (cat, _) in enumerate(ordered)}
+
+
+class FeaturePipeline:
+    def __init__(
+        self,
+        category_col: str = "measure_name",
+        numeric_cols: Sequence[str] = ("value", "lower_ci", "upper_ci"),
+        repeats: Optional[int] = None,
+        drop_last: bool = True,
+    ):
+        if repeats is None:
+            try:
+                repeats = int(os.environ.get("MEASURE_NAME_WEIGHT", "5"))
+            except Exception:
+                repeats = 5
+        self.repeats = max(1, int(repeats))
+        self.category_col = category_col
+        self.numeric_cols = list(numeric_cols)
+        self.drop_last = drop_last
+        self.index_map: Optional[Dict[str, int]] = None
+        self.means: Optional[np.ndarray] = None
+
+    # -- fit ------------------------------------------------------------------
+
+    def fit(self, rows: Dict[str, np.ndarray]) -> "FeaturePipeline":
+        """``rows``: column name → array (categories as object/str array,
+        numerics as float arrays possibly containing NaN)."""
+        cats = rows[self.category_col]
+        keep = np.array([c is not None and c == c for c in cats])  # non-null
+        cats = cats[keep]
+        self.index_map = string_index(list(cats))
+        self.means = np.array(
+            [
+                np.nanmean(np.asarray(rows[c], dtype=np.float64)[keep])
+                for c in self.numeric_cols
+            ],
+            dtype=np.float32,
+        )
+        return self
+
+    # -- transform ------------------------------------------------------------
+
+    @property
+    def onehot_width(self) -> int:
+        n = len(self.index_map)
+        return n - 1 if self.drop_last else n
+
+    def transform(self, rows: Dict[str, np.ndarray]) -> np.ndarray:
+        if self.index_map is None:
+            raise RuntimeError("fit() first")
+        cats = rows[self.category_col]
+        keep = np.array([c is not None and c == c for c in cats])
+        cats = cats[keep]
+        n = len(cats)
+        width = self.onehot_width
+
+        onehot = np.zeros((n, width), dtype=np.float32)
+        for i, c in enumerate(cats):
+            idx = self.index_map.get(c)
+            # unseen categories → handleInvalid="keep" extra bucket == all-zero
+            if idx is not None and idx < width:
+                onehot[i, idx] = 1.0
+
+        numerics = []
+        for j, col in enumerate(self.numeric_cols):
+            v = np.asarray(rows[col], dtype=np.float32)[keep]
+            v = np.where(np.isnan(v), self.means[j], v)
+            numerics.append(v[:, None])
+
+        blocks = [onehot] * self.repeats + numerics
+        return np.concatenate(blocks, axis=1)
+
+    def fit_transform(self, rows: Dict[str, np.ndarray]) -> np.ndarray:
+        return self.fit(rows).transform(rows)
+
+    def transform_single(self, category: str, numeric_values: Sequence[float]) -> np.ndarray:
+        """Single-row transform — the ``infer_single_row`` path
+        (``k_means.py:138-162``)."""
+        rows = {self.category_col: np.array([category], dtype=object)}
+        for col, v in zip(self.numeric_cols, numeric_values):
+            rows[col] = np.array([v], dtype=np.float32)
+        return self.transform(rows)

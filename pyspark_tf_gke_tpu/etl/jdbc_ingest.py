@@ -1,0 +1,52 @@
+"""Partitioned JDBC ingest from MySQL — the analog of the reference's
+``RetrieveDataFromMySQLOutside`` (``workloads/raw-spark/google_health_SQL.py:9-49``).
+
+The data-parallel read: 16 range partitions on the auto-increment ``id``
+primary key (created by the CSV loader's DDL), so 16 executor tasks read
+disjoint row ranges from ``mysql-read`` concurrently.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+
+class RetrieveDataFromMySQL:
+    def __init__(self, logger: logging.Logger, db_config: dict, spark):
+        self.logger = logger
+        self.db = db_config
+        self.spark = spark
+
+    def read_data_from_mysql(self, num_partitions: Optional[int] = None):
+        num_partitions = num_partitions or int(os.environ.get("JDBC_PARTITIONS", "16"))
+        url = f"jdbc:mysql://{self.db['host']}:{self.db['port']}/{self.db['database']}"
+        table = self.db["table"]
+
+        bounds = (
+            self.spark.read.format("jdbc")
+            .option("url", url)
+            .option("user", self.db["user"])
+            .option("password", self.db["password"])
+            .option("driver", "com.mysql.cj.jdbc.Driver")
+            .option("query", f"SELECT MIN(id) AS lo, MAX(id) AS hi FROM {table}")
+            .load()
+            .first()
+        )
+        lo, hi = int(bounds["lo"]), int(bounds["hi"])
+        self.logger.info("JDBC range read on id in [%d, %d], %d partitions",
+                         lo, hi, num_partitions)
+        return (
+            self.spark.read.format("jdbc")
+            .option("url", url)
+            .option("user", self.db["user"])
+            .option("password", self.db["password"])
+            .option("driver", "com.mysql.cj.jdbc.Driver")
+            .option("dbtable", table)
+            .option("partitionColumn", "id")
+            .option("lowerBound", lo)
+            .option("upperBound", hi)
+            .option("numPartitions", num_partitions)
+            .load()
+        )

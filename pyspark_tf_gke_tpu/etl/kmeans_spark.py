@@ -1,0 +1,102 @@
+"""Spark KMeans workload — the reference's flagship ETL+ML job
+(``workloads/raw-spark/k_means.py``) for the Spark pool.
+
+Pipeline: null-filter on ``measure_name`` → StringIndexer → OneHotEncoder
+→ mean imputation of numerics → one-hot repetition weighting
+(``MEASURE_NAME_WEIGHT``, default 5) → VectorAssembler →
+KMeans(k=25, seed=1, maxIter=1000). Models stay in memory; a single-row
+inference path validates them (``k_means.py:138-162``).
+
+The TPU-native twin of this job is ``etl.kmeans`` + ``etl.feature_pipeline``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from pyspark_tf_gke_tpu.etl.spark_session import CreateSparkSession, _require_pyspark
+from pyspark_tf_gke_tpu.etl.jdbc_ingest import RetrieveDataFromMySQL
+
+
+class KMeansSparkWorkload:
+    pipeline_model = None
+    kmeans_model = None
+
+    def __init__(self, logger=None):
+        self.logger = logger
+
+    def k_means(self, input_df):
+        _require_pyspark()
+        from pyspark.ml import Pipeline
+        from pyspark.ml.clustering import KMeans
+        from pyspark.ml.feature import OneHotEncoder, StringIndexer, VectorAssembler
+        from pyspark.sql.functions import col, isnan, when
+
+        input_df = input_df.filter(col("measure_name").isNotNull())
+
+        stages = [
+            StringIndexer(inputCol="measure_name", outputCol="measure_name_index",
+                          handleInvalid="keep"),
+            OneHotEncoder(inputCol="measure_name_index", outputCol="measure_name_vec"),
+        ]
+        numeric_cols = ["value", "lower_ci", "upper_ci"]
+        for name in numeric_cols:
+            if name in input_df.columns:
+                mean_val = (
+                    input_df.select(name)
+                    .filter(~isnan(col(name)) & col(name).isNotNull())
+                    .agg({name: "avg"})
+                    .collect()[0][0]
+                )
+                input_df = input_df.withColumn(
+                    name,
+                    when(col(name).isNull() | isnan(col(name)), mean_val).otherwise(col(name)),
+                )
+
+        try:
+            repeats = int(os.environ.get("MEASURE_NAME_WEIGHT", "5"))
+        except Exception:
+            repeats = 5
+        repeats = max(1, repeats)
+        feature_cols = ["measure_name_vec"] * repeats + numeric_cols
+        stages.append(VectorAssembler(inputCols=feature_cols, outputCol="features",
+                                      handleInvalid="keep"))
+
+        pipeline_model = Pipeline(stages=stages).fit(input_df)
+        dataset = pipeline_model.transform(input_df).select("features")
+        model = KMeans().setK(25).setSeed(1).setMaxIter(1000).fit(dataset)
+        type(self).pipeline_model = pipeline_model
+        type(self).kmeans_model = model
+        return pipeline_model, model
+
+    def infer_single_row(self, spark, entry_str: str = "Able-Bodied", entry_num: int = 0):
+        cls = type(self)
+        if cls.pipeline_model is None or cls.kmeans_model is None:
+            raise RuntimeError("Run k_means() before inference.")
+        df = spark.createDataFrame(
+            [(entry_str, entry_num, entry_num + 7, entry_num + 5)],
+            ["measure_name", "value", "lower_ci", "upper_ci"],
+        )
+        preds = cls.kmeans_model.transform(cls.pipeline_model.transform(df))
+        row = preds.select("prediction").first()
+        return (int(row["prediction"]) if row else None), preds
+
+    @classmethod
+    def main(cls):
+        session_factory = CreateSparkSession()
+        spark, logger, db_conf = session_factory.new_spark_session("kmeans-workload")
+        try:
+            inst = cls(logger)
+            df = RetrieveDataFromMySQL(logger, db_conf, spark).read_data_from_mysql()
+            inst.k_means(df)
+            for label, num in zip(
+                ["Able-Bodied", "Asthma", "Cancer", "Premature Death"], [0, 10, 30, 60]
+            ):
+                pred, _ = inst.infer_single_row(spark, label, num)
+                logger.info("inference %r -> cluster %s", label, pred)
+        finally:
+            spark.stop()
+
+
+if __name__ == "__main__":
+    KMeansSparkWorkload.main()

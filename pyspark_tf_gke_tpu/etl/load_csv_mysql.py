@@ -1,0 +1,121 @@
+"""CSV → MySQL bootstrap loader — the analog of the reference's
+``infra/local/mysql-database/load_csv.py``: creates the database and the
+``health_disparities`` table (with the auto-increment ``id`` primary key
+the JDBC range read partitions on — ``load_csv.py:49-65``), then batch-
+inserts the CSV in 1000-row ``executemany`` chunks (``load_csv.py:86-128``).
+
+Import-gated on mysql-connector; the schema/DDL is importable regardless
+so tests can validate it.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Iterable, List
+
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+logger = get_logger("etl.load_csv_mysql")
+
+DB_NAME = os.environ.get("DB_NAME", "health_data")
+TABLE_NAME = os.environ.get("DB_TABLE", "health_disparities")
+
+COLUMNS = [
+    ("edition", "VARCHAR(16)"),
+    ("report_type", "VARCHAR(64)"),
+    ("measure_name", "VARCHAR(128)"),
+    ("state_name", "VARCHAR(64)"),
+    ("subpopulation", "VARCHAR(128)"),
+    ("value", "DOUBLE"),
+    ("lower_ci", "DOUBLE"),
+    ("upper_ci", "DOUBLE"),
+    ("source", "VARCHAR(255)"),
+    ("source_date", "VARCHAR(64)"),
+]
+
+CREATE_DATABASE_SQL = f"CREATE DATABASE IF NOT EXISTS {DB_NAME}"
+
+CREATE_TABLE_SQL = (
+    f"CREATE TABLE IF NOT EXISTS {TABLE_NAME} (\n"
+    "  id INT AUTO_INCREMENT PRIMARY KEY,\n"  # JDBC partitionColumn
+    + ",\n".join(f"  `{name}` {typ}" for name, typ in COLUMNS)
+    + "\n)"
+)
+
+INSERT_SQL = (
+    f"INSERT INTO {TABLE_NAME} ("
+    + ", ".join(f"`{name}`" for name, _ in COLUMNS)
+    + ") VALUES ("
+    + ", ".join(["%s"] * len(COLUMNS))
+    + ")"
+)
+
+
+def parse_rows(csv_path: str) -> Iterable[List]:
+    """Yield value tuples in COLUMNS order; empty/'nan' numerics → None."""
+    numeric = {"value", "lower_ci", "upper_ci"}
+    with open(csv_path, "r", encoding="utf-8") as fh:
+        for row in csv.DictReader(fh):
+            out = []
+            for name, _ in COLUMNS:
+                v = (row.get(name) or "").strip()
+                if name in numeric:
+                    out.append(float(v) if v and v.lower() != "nan" else None)
+                else:
+                    out.append(v or None)
+            yield out
+
+
+def load_csv_to_mysql(
+    csv_path: str,
+    host: str = None,
+    port: int = None,
+    user: str = None,
+    password: str = None,
+    batch_size: int = 1000,
+) -> int:
+    try:
+        import mysql.connector
+    except ImportError as e:
+        raise ImportError(
+            "mysql-connector-python is not installed; run this loader from "
+            "the bastion (see infra/), not the TPU image."
+        ) from e
+
+    conn = mysql.connector.connect(
+        host=host or os.environ.get("DB_HOST", "127.0.0.1"),
+        port=port or int(os.environ.get("DB_PORT", "3306")),
+        user=user or os.environ.get("DB_USER", "root"),
+        password=password if password is not None else os.environ.get("DB_PASSWORD", ""),
+    )
+    try:
+        cur = conn.cursor()
+        cur.execute(CREATE_DATABASE_SQL)
+        cur.execute(f"USE {DB_NAME}")
+        cur.execute(CREATE_TABLE_SQL)
+
+        total = 0
+        batch: List[List] = []
+        for values in parse_rows(csv_path):
+            batch.append(values)
+            if len(batch) >= batch_size:
+                cur.executemany(INSERT_SQL, batch)
+                conn.commit()
+                total += len(batch)
+                logger.info("inserted %d rows...", total)
+                batch = []
+        if batch:
+            cur.executemany(INSERT_SQL, batch)
+            conn.commit()
+            total += len(batch)
+        logger.info("done: %d rows into %s.%s", total, DB_NAME, TABLE_NAME)
+        return total
+    finally:
+        conn.close()
+
+
+if __name__ == "__main__":
+    import sys
+
+    load_csv_to_mysql(sys.argv[1])

@@ -1,0 +1,87 @@
+"""Spark session factory for an external driver — the analog of the
+reference's ``CreateSparkSession`` (``workloads/raw-spark/spark_session.py:37-91``).
+
+The north star keeps the ETL pool on PySpark: the driver (a bastion
+container/pod) dials the in-cluster Spark master; executors dial back to
+the driver, so the driver host/port and blockManager port must be pinned
+and routable (``spark-workload-service.yaml:12-17``). All endpoints are
+env-driven with the reference's variable names and defaults.
+
+Import-gated: environments without pyspark (like the TPU training image —
+zero JVM deps by design) can import ``etl`` without pulling this in.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+from typing import Optional, Tuple
+
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+try:
+    from pyspark.sql import SparkSession
+
+    HAVE_PYSPARK = True
+except ImportError:  # pragma: no cover - exercised only without pyspark
+    SparkSession = None
+    HAVE_PYSPARK = False
+
+
+DB_CONFIG = {
+    "host": os.environ.get("DB_HOST", "mysql-read"),
+    "port": int(os.environ.get("DB_PORT", "3306")),
+    "database": os.environ.get("DB_NAME", "health_data"),
+    "table": os.environ.get("DB_TABLE", "health_disparities"),
+    "user": os.environ.get("DB_USER", "root"),
+    "password": os.environ.get("DB_PASSWORD", ""),
+}
+
+
+def _require_pyspark():
+    if not HAVE_PYSPARK:
+        raise ImportError(
+            "pyspark is not installed in this environment. The Spark ETL "
+            "plane runs on the Spark pool (see infra/); on the TPU side use "
+            "etl.feature_pipeline + etl.kmeans instead."
+        )
+
+
+class CreateSparkSession:
+    """Builds a SparkSession whose driver runs *outside* the cluster."""
+
+    def __init__(self):
+        self.logger = get_logger("etl.spark_session")
+
+    def new_spark_session(
+        self, app_name: str = "tpu-pipeline-etl"
+    ) -> Tuple["SparkSession", logging.Logger, dict]:
+        _require_pyspark()
+        master = os.environ.get("SPARK_MASTER_URL", "spark://spark-master:7077")
+        driver_host = os.environ.get("SPARK_DRIVER_HOST", "spark-workload")
+        driver_bind = os.environ.get("SPARK_DRIVER_BIND_ADDRESS", "0.0.0.0")
+        driver_port = os.environ.get("SPARK_DRIVER_PORT", "7078")
+        bm_port = os.environ.get("SPARK_BLOCKMANAGER_PORT", "7079")
+
+        try:  # DNS sanity logging, as the reference does (spark_session.py:52-62)
+            self.logger.info(
+                "driver host %s resolves to %s", driver_host,
+                socket.gethostbyname(driver_host),
+            )
+        except socket.gaierror:
+            self.logger.warning("driver host %s does not resolve locally", driver_host)
+
+        spark = (
+            SparkSession.builder.appName(app_name)
+            .master(master)
+            .config("spark.driver.host", driver_host)
+            .config("spark.driver.bindAddress", driver_bind)
+            .config("spark.driver.port", driver_port)
+            .config("spark.blockManager.port", bm_port)
+            .config("spark.sql.shuffle.partitions",
+                    os.environ.get("SPARK_SHUFFLE_PARTITIONS", "16"))
+            .getOrCreate()
+        )
+        self.logger.info("Spark session created against %s", master)
+        return spark, self.logger, dict(DB_CONFIG)

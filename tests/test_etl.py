@@ -1,0 +1,140 @@
+import numpy as np
+import pytest
+
+from pyspark_tf_gke_tpu.etl.feature_pipeline import FeaturePipeline, string_index
+from pyspark_tf_gke_tpu.etl.kmeans import KMeans, silhouette_score
+from pyspark_tf_gke_tpu.etl.workload import KMeansWorkloadTPU, read_columns
+from pyspark_tf_gke_tpu.data.synthetic import make_synthetic_csv
+
+
+def _blobs(n_per=50, k=4, d=3, spread=0.2, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-10, 10, (k, d))
+    x = np.concatenate([c + rng.normal(0, spread, (n_per, d)) for c in centers])
+    labels = np.repeat(np.arange(k), n_per)
+    return x.astype(np.float32), labels, centers
+
+
+def test_string_index_frequency_desc():
+    vals = ["b", "a", "b", "c", "b", "a"]
+    idx = string_index(vals)
+    assert idx == {"b": 0, "a": 1, "c": 2}  # freq desc, ties alphabetical
+
+
+def test_feature_pipeline_shapes_and_impute():
+    rows = {
+        "measure_name": np.array(["x", "y", "x", "z", None], dtype=object),
+        "value": np.array([1.0, 2.0, np.nan, 4.0, 5.0], dtype=np.float32),
+        "lower_ci": np.array([0.0, 1.0, 2.0, 3.0, 4.0], dtype=np.float32),
+        "upper_ci": np.array([2.0, 3.0, 4.0, 5.0, 6.0], dtype=np.float32),
+    }
+    fp = FeaturePipeline(repeats=3)
+    out = fp.fit_transform(rows)
+    # null-category row dropped; onehot width = 3 cats - 1 (dropLast)
+    assert out.shape == (4, 3 * 2 + 3)
+    # imputed value = mean of non-nan among kept rows (1,2,4 -> 7/3)
+    assert np.isclose(out[2, 6], (1.0 + 2.0 + 4.0) / 3)
+    # 'x' is most frequent -> index 0; its onehot [1,0] repeated 3x
+    assert out[0, :6].tolist() == [1, 0, 1, 0, 1, 0]
+    # 'z' is last index (2) -> all-zero onehot under dropLast
+    assert out[3, :6].tolist() == [0] * 6
+
+
+def test_feature_pipeline_unseen_category():
+    rows = {
+        "measure_name": np.array(["x", "y"], dtype=object),
+        "value": np.array([1.0, 2.0], dtype=np.float32),
+        "lower_ci": np.array([1.0, 2.0], dtype=np.float32),
+        "upper_ci": np.array([1.0, 2.0], dtype=np.float32),
+    }
+    fp = FeaturePipeline(repeats=1)
+    fp.fit(rows)
+    single = fp.transform_single("never-seen", [1, 2, 3])
+    assert single.shape == (1, fp.onehot_width + 3)
+    assert single[0, : fp.onehot_width].sum() == 0  # handleInvalid=keep bucket
+
+
+def test_kmeans_recovers_blobs(mesh_dp):
+    x, true_labels, _ = _blobs(n_per=64, k=4)
+    km = KMeans(k=4, seed=1, max_iter=100, mesh=mesh_dp).fit(x)
+    assert km.n_iter < 100  # converged by tol
+    pred = km.predict(x)
+    # each true cluster maps to exactly one predicted cluster
+    for t in range(4):
+        assert len(set(pred[true_labels == t])) == 1
+    assert len(set(pred)) == 4
+    assert km.cost(x) < 0.3 * len(x)  # tight clusters -> low cost
+
+
+def test_kmeans_deterministic():
+    x, _, _ = _blobs()
+    c1 = KMeans(k=4, seed=1, max_iter=50).fit(x).centers
+    c2 = KMeans(k=4, seed=1, max_iter=50).fit(x).centers
+    np.testing.assert_allclose(c1, c2)
+
+
+def test_kmeans_k_too_large():
+    with pytest.raises(ValueError):
+        KMeans(k=10).fit(np.zeros((5, 2), dtype=np.float32))
+
+
+def test_silhouette_separated_vs_merged():
+    x, labels, _ = _blobs(spread=0.1)
+    good = silhouette_score(x, labels)
+    assert good > 0.9
+    rng = np.random.default_rng(0)
+    bad = silhouette_score(x, rng.permutation(labels))
+    assert bad < 0.1
+
+
+def test_silhouette_matches_naive():
+    x, labels, _ = _blobs(n_per=10, k=3, spread=1.0)
+    fast = silhouette_score(x, labels, block=7)  # odd block to test tiling
+    # naive O(n^2) squared-euclidean silhouette
+    n = len(x)
+    d2 = ((x[:, None] - x[None]) ** 2).sum(-1)
+    scores = []
+    for i in range(n):
+        own = labels[i]
+        a = d2[i][labels == own].sum() / max((labels == own).sum() - 1, 1)
+        b = min(d2[i][labels == c].mean() for c in set(labels) - {own})
+        scores.append((b - a) / max(a, b))
+    np.testing.assert_allclose(fast, np.mean(scores), atol=1e-4)
+
+
+def test_workload_end_to_end(tmp_path):
+    path = make_synthetic_csv(str(tmp_path / "h.csv"), rows=400)
+    cols = read_columns(path)
+    assert np.isnan(cols["value"]).any()  # synthetic data has holes
+    wl = KMeansWorkloadTPU(k=8, max_iter=50)
+    result = wl.run(cols)
+    assert result["k"] == 8
+    assert result["n_iter"] <= 50
+    assert np.isfinite(result["cost"])
+    assert -1 <= result["silhouette"] <= 1
+    pred = wl.infer_single_row("Asthma", 10)
+    assert 0 <= pred < 8
+
+
+def test_spark_modules_import_without_pyspark():
+    """The Spark plane must be import-gated, not import-broken."""
+    from pyspark_tf_gke_tpu.etl import spark_session, kmeans_spark, jdbc_ingest  # noqa
+
+    if not spark_session.HAVE_PYSPARK:
+        with pytest.raises(ImportError):
+            spark_session.CreateSparkSession().new_spark_session()
+
+
+def test_load_csv_mysql_schema_and_parse(tmp_path):
+    from pyspark_tf_gke_tpu.etl import load_csv_mysql as m
+
+    assert "AUTO_INCREMENT PRIMARY KEY" in m.CREATE_TABLE_SQL  # JDBC partition column
+    assert m.INSERT_SQL.count("%s") == len(m.COLUMNS)
+    p = tmp_path / "d.csv"
+    p.write_text(
+        "edition,report_type,measure_name,state_name,subpopulation,value,lower_ci,upper_ci,source,source_date\n"
+        "2023,Annual,Asthma,Utah,Female,1.5,nan,,src,2023-01-01\n"
+    )
+    rows = list(m.parse_rows(str(p)))
+    assert rows[0][2] == "Asthma"
+    assert rows[0][5] == 1.5 and rows[0][6] is None and rows[0][7] is None
