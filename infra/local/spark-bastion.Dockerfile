@@ -1,0 +1,11 @@
+# Spark submit bastion (reference bastion.Dockerfile:1-25): pyspark driver
+# environment with the repo's ETL modules and the MySQL JDBC connector.
+FROM spark:3.5.1-python3
+USER root
+RUN pip install --no-cache-dir pyspark==3.5.1 mysql-connector-python pandas numpy
+# MySQL Connector/J for the JDBC ingest (reference jars/mysql-connector-j-8.4.0.jar)
+ADD https://repo1.maven.org/maven2/com/mysql/mysql-connector-j/8.4.0/mysql-connector-j-8.4.0.jar \
+    /opt/spark/jars/
+COPY pyspark_tf_gke_tpu /app/pyspark_tf_gke_tpu
+ENV PYTHONPATH=/app
+WORKDIR /app

@@ -1,0 +1,10 @@
+# CPU fake-slice trainer image: same code path as the TPU image, virtual
+# 8-device mesh (SURVEY §4 — the kind+MetalLB substitute).
+FROM python:3.12-slim
+WORKDIR /app
+RUN pip install --no-cache-dir jax flax optax orbax-checkpoint einops numpy pillow
+COPY pyspark_tf_gke_tpu /app/pyspark_tf_gke_tpu
+ENV JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    PYTHONPATH=/app
+CMD ["python", "-m", "pyspark_tf_gke_tpu.train.cli"]

@@ -1,0 +1,3 @@
+# Placeholder: AWS provisioning is not implemented (the reference ships the
+# same empty stub, infra/cloud/terraform/AWS/main.tf). TPU hardware is
+# GCP-only; an AWS variant would target Trainium and a different runtime.

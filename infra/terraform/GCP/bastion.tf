@@ -1,0 +1,64 @@
+# Bastion VM — the operator's submission point (reference gke_bastion.tf:57-93).
+# Role is unchanged (kubectl + job launch); what it launches changed: instead
+# of an out-of-cluster TF chief that carries tensor traffic over per-pod
+# LoadBalancers, it only applies manifests and tails logs — the jax
+# coordinator runs in-cluster (launch/run_tpu_training_from_bastion.sh).
+
+resource "google_service_account" "bastion_sa" {
+  account_id   = "${var.cluster_name}-bastion-sa"
+  display_name = "Bastion service account"
+}
+
+resource "google_project_iam_member" "bastion_container_dev" {
+  project = var.project_id
+  role    = "roles/container.developer"
+  member  = "serviceAccount:${google_service_account.bastion_sa.email}"
+}
+
+resource "google_project_iam_member" "bastion_storage" {
+  project = var.project_id
+  role    = "roles/storage.objectAdmin"
+  member  = "serviceAccount:${google_service_account.bastion_sa.email}"
+}
+
+resource "google_compute_firewall" "bastion_ssh" {
+  name    = "${var.cluster_name}-bastion-ssh"
+  network = google_compute_network.vpc.name
+
+  allow {
+    protocol = "tcp"
+    ports    = ["22"]
+  }
+  source_ranges = ["0.0.0.0/0"]
+  target_tags   = ["bastion"]
+}
+
+resource "google_compute_instance" "bastion" {
+  name         = "${var.cluster_name}-bastion"
+  machine_type = var.bastion_machine_type
+  zone         = var.zone
+  tags         = ["bastion"]
+
+  boot_disk {
+    initialize_params {
+      image = "debian-cloud/debian-12"
+    }
+  }
+
+  network_interface {
+    subnetwork = google_compute_subnetwork.subnet.id
+    access_config {} # public IP for operator SSH
+  }
+
+  service_account {
+    email  = google_service_account.bastion_sa.email
+    scopes = ["cloud-platform"]
+  }
+
+  metadata_startup_script = templatefile("${path.module}/startup.sh", {
+    cluster_name = var.cluster_name
+    zone         = var.zone
+    project_id   = var.project_id
+    bucket       = google_storage_bucket.datasets.name
+  })
+}

@@ -1,0 +1,56 @@
+# Service accounts + Workload Identity. Reference: main.tf:62-95 and the
+# KSA annotation in infra/cloud/gcp_spark/spark-k8s-sa.yaml:1-14. The TPU
+# workers get their own KSA<->GSA binding for GCS dataset/checkpoint access.
+
+resource "google_service_account" "gke_sa" {
+  account_id   = "${var.cluster_name}-gke-sa"
+  display_name = "GKE node service account"
+}
+
+resource "google_project_iam_member" "gke_sa_logging" {
+  project = var.project_id
+  role    = "roles/logging.logWriter"
+  member  = "serviceAccount:${google_service_account.gke_sa.email}"
+}
+
+resource "google_project_iam_member" "gke_sa_monitoring" {
+  project = var.project_id
+  role    = "roles/monitoring.metricWriter"
+  member  = "serviceAccount:${google_service_account.gke_sa.email}"
+}
+
+# Spark jobs (KSA spark-sa in default ns) read datasets from the bucket.
+resource "google_service_account" "spark_sa" {
+  account_id   = "${var.cluster_name}-spark-sa"
+  display_name = "Spark workload identity SA"
+}
+
+resource "google_service_account_iam_member" "spark_wi_binding" {
+  service_account_id = google_service_account.spark_sa.name
+  role               = "roles/iam.workloadIdentityUser"
+  member             = "serviceAccount:${var.project_id}.svc.id.goog[default/spark-sa]"
+}
+
+resource "google_storage_bucket_iam_member" "spark_bucket_viewer" {
+  bucket = google_storage_bucket.datasets.name
+  role   = "roles/storage.objectViewer"
+  member = "serviceAccount:${google_service_account.spark_sa.email}"
+}
+
+# TPU workers (KSA tpu-worker-sa) read TFRecord shards and write checkpoints.
+resource "google_service_account" "tpu_sa" {
+  account_id   = "${var.cluster_name}-tpu-sa"
+  display_name = "TPU worker workload identity SA"
+}
+
+resource "google_service_account_iam_member" "tpu_wi_binding" {
+  service_account_id = google_service_account.tpu_sa.name
+  role               = "roles/iam.workloadIdentityUser"
+  member             = "serviceAccount:${var.project_id}.svc.id.goog[default/tpu-worker-sa]"
+}
+
+resource "google_storage_bucket_iam_member" "tpu_bucket_admin" {
+  bucket = google_storage_bucket.datasets.name
+  role   = "roles/storage.objectAdmin"
+  member = "serviceAccount:${google_service_account.tpu_sa.email}"
+}

@@ -1,0 +1,141 @@
+# GKE cluster + node pools. Reference: infra/cloud/terraform/GCP/main.tf.
+# Changes from the reference by design:
+#   * the commented-out CPU "TF pool" (2x e2-standard-8, main.tf:176-208)
+#     is replaced by a Cloud TPU v5e node pool (ct5lp-hightpu-4t) with
+#     placement driven by gke-tpu-accelerator / gke-tpu-topology selectors;
+#   * the Spark ETL pool, Workload Identity, autoscaling and private-nodes
+#     setup carry over (main.tf:2-143).
+
+terraform {
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.0"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project_id
+  region  = var.region
+}
+
+resource "google_container_cluster" "primary" {
+  name     = var.cluster_name
+  location = var.zone
+
+  remove_default_node_pool = true
+  initial_node_count       = 1
+  deletion_protection      = false
+
+  network    = google_compute_network.vpc.id
+  subnetwork = google_compute_subnetwork.subnet.id
+
+  ip_allocation_policy {
+    cluster_secondary_range_name  = "pods"
+    services_secondary_range_name = "services"
+  }
+
+  private_cluster_config {
+    enable_private_nodes    = true
+    enable_private_endpoint = false
+    master_ipv4_cidr_block  = "172.16.0.0/28"
+  }
+
+  workload_identity_config {
+    workload_pool = "${var.project_id}.svc.id.goog"
+  }
+
+  cluster_autoscaling {
+    enabled = true
+    resource_limits {
+      resource_type = "cpu"
+      minimum       = 1
+      maximum       = 10
+    }
+    resource_limits {
+      resource_type = "memory"
+      minimum       = 1
+      maximum       = 40
+    }
+  }
+}
+
+resource "google_container_node_pool" "default_pool" {
+  name     = "default-pool"
+  cluster  = google_container_cluster.primary.name
+  location = var.zone
+
+  node_count = 1
+  node_config {
+    machine_type    = "e2-medium"
+    service_account = google_service_account.gke_sa.email
+    oauth_scopes    = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+}
+
+# Spark ETL pool: tainted so only Spark pods land here (the reference's
+# workload=spark taint, main.tf:98-143).
+resource "google_container_node_pool" "spark_pool" {
+  name     = "spark-pool"
+  cluster  = google_container_cluster.primary.name
+  location = var.zone
+
+  node_count = var.spark_node_count
+  autoscaling {
+    min_node_count = 1
+    max_node_count = var.spark_node_count
+  }
+
+  node_config {
+    machine_type    = var.spark_machine_type
+    service_account = google_service_account.gke_sa.email
+    oauth_scopes    = ["https://www.googleapis.com/auth/cloud-platform"]
+
+    labels = { workload = "spark" }
+    taint {
+      key    = "workload"
+      value  = "spark"
+      effect = "NO_SCHEDULE"
+    }
+  }
+
+  management {
+    auto_repair  = true
+    auto_upgrade = true
+  }
+}
+
+# TPU training pool. One node per TPU-VM host of the slice; pods select it
+# via cloud.google.com/gke-tpu-accelerator + gke-tpu-topology and request
+# google.com/tpu chips (see infra/k8s/tpu/). Zero CUDA/NCCL anywhere.
+resource "google_container_node_pool" "tpu_pool" {
+  name     = "tpu-v5e-pool"
+  cluster  = google_container_cluster.primary.name
+  location = var.zone
+
+  node_count = var.tpu_node_count
+
+  node_config {
+    machine_type    = var.tpu_machine_type
+    service_account = google_service_account.gke_sa.email
+    oauth_scopes    = ["https://www.googleapis.com/auth/cloud-platform"]
+
+    labels = { workload = "tpu-train" }
+    taint {
+      key    = "google.com/tpu"
+      value  = "present"
+      effect = "NO_SCHEDULE"
+    }
+  }
+
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = var.tpu_topology
+  }
+
+  management {
+    auto_repair  = true
+    auto_upgrade = true
+  }
+}

@@ -1,0 +1,69 @@
+# VPC + subnet with secondary ranges for pods/services, NAT for the private
+# cluster, and the firewall pair the reference uses (internal-allow +
+# master->node webhook ports) — reference network.tf:2-67. Unchanged in
+# spirit; TPU pods speak over the same pod network (ICI traffic never
+# leaves the TPU slice and needs no VPC config).
+
+resource "google_compute_network" "vpc" {
+  name                    = "${var.cluster_name}-vpc"
+  auto_create_subnetworks = false
+}
+
+resource "google_compute_subnetwork" "subnet" {
+  name          = "${var.cluster_name}-subnet"
+  region        = var.region
+  network       = google_compute_network.vpc.id
+  ip_cidr_range = "10.10.0.0/16"
+
+  secondary_ip_range {
+    range_name    = "pods"
+    ip_cidr_range = "10.20.0.0/14"
+  }
+  secondary_ip_range {
+    range_name    = "services"
+    ip_cidr_range = "10.24.0.0/20"
+  }
+}
+
+resource "google_compute_router" "router" {
+  name    = "${var.cluster_name}-router"
+  region  = var.region
+  network = google_compute_network.vpc.id
+}
+
+resource "google_compute_router_nat" "nat" {
+  name                               = "${var.cluster_name}-nat"
+  router                             = google_compute_router.router.name
+  region                             = var.region
+  nat_ip_allocate_option             = "AUTO_ONLY"
+  source_subnetwork_ip_ranges_to_nat = "ALL_SUBNETWORKS_ALL_IP_RANGES"
+}
+
+resource "google_compute_firewall" "internal_allow" {
+  name    = "${var.cluster_name}-internal-allow"
+  network = google_compute_network.vpc.name
+
+  allow {
+    protocol = "tcp"
+  }
+  allow {
+    protocol = "udp"
+  }
+  allow {
+    protocol = "icmp"
+  }
+  source_ranges = ["10.10.0.0/16", "10.20.0.0/14", "10.24.0.0/20"]
+}
+
+# Control plane -> nodes: admission webhooks + the jax.distributed
+# coordinator port so kubectl exec / debugging from the master works.
+resource "google_compute_firewall" "master_to_nodes" {
+  name    = "${var.cluster_name}-master-to-nodes"
+  network = google_compute_network.vpc.name
+
+  allow {
+    protocol = "tcp"
+    ports    = ["443", "8443", "9443", "8476"]
+  }
+  source_ranges = ["172.16.0.0/28"]
+}

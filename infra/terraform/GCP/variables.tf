@@ -1,0 +1,76 @@
+# Deployment-time knobs — the analog of the reference's variables.tf
+# (infra/cloud/terraform/GCP/variables.tf:1-87), retargeted: the commented-out
+# CPU "TF pool" (e2-standard-8, reference main.tf:176-208) becomes a Cloud TPU
+# v5e node pool.
+
+variable "project_id" {
+  description = "GCP project id"
+  type        = string
+}
+
+variable "region" {
+  description = "Region for the cluster and network"
+  type        = string
+  default     = "us-central1"
+}
+
+variable "zone" {
+  description = "Zone for zonal resources (bastion VM, TPU pool)"
+  type        = string
+  default     = "us-central1-a"
+}
+
+variable "cluster_name" {
+  description = "GKE cluster name"
+  type        = string
+  default     = "tpu-pipeline"
+}
+
+# --- Spark ETL pool (kept from the reference: 2x e2-standard-4, tainted) ---
+
+variable "spark_machine_type" {
+  type    = string
+  default = "e2-standard-4"
+}
+
+variable "spark_node_count" {
+  type    = number
+  default = 2
+}
+
+# --- TPU training pool (replaces the reference's commented CPU TF pool) ---
+
+variable "tpu_machine_type" {
+  description = "TPU VM machine type; ct5lp-hightpu-4t = v5e, 4 chips/VM"
+  type        = string
+  default     = "ct5lp-hightpu-4t"
+}
+
+variable "tpu_topology" {
+  description = "TPU slice topology (cloud.google.com/gke-tpu-topology), e.g. 2x2 for v5e-4, 2x4 for v5e-8"
+  type        = string
+  default     = "2x2"
+}
+
+variable "tpu_accelerator" {
+  description = "gke-tpu-accelerator node-selector value"
+  type        = string
+  default     = "tpu-v5-lite-podslice"
+}
+
+variable "tpu_node_count" {
+  description = "Hosts in the TPU slice (topology chips / chips-per-VM)"
+  type        = number
+  default     = 1
+}
+
+variable "bastion_machine_type" {
+  type    = string
+  default = "n1-standard-1"
+}
+
+variable "datasets_bucket_suffix" {
+  description = "Bucket name = <project_id>-<suffix>"
+  type        = string
+  default     = "datasets"
+}

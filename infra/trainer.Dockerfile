@@ -1,0 +1,11 @@
+# TPU trainer image: jax[tpu] via PjRT — ZERO CUDA/NCCL deps (the north
+# star's hard requirement; the reference image was tensorflow:latest-gpu,
+# tf-trainer-worker.yaml:31).
+FROM python:3.12-slim
+WORKDIR /app
+RUN pip install --no-cache-dir "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir flax optax orbax-checkpoint einops numpy pillow \
+       tensorflow-cpu  # tf.data for the TFRecord bridge only; no GPU runtime
+COPY pyspark_tf_gke_tpu /app/pyspark_tf_gke_tpu
+ENV PYTHONPATH=/app
+ENTRYPOINT ["python", "-m", "pyspark_tf_gke_tpu.train.cli"]

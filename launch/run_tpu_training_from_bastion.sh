@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# TPU training launcher — the analog of the reference's
+# workloads/raw-tf/run_tf_training_from_bastion.sh, simplified by the SPMD
+# design: the reference had to discover a LoadBalancer IP per worker/ps pod
+# and advertise the bastion's own routable IP as the TF chief
+# (run_tf_training_from_bastion.sh:20-96) because the coordinator carried
+# tensor traffic. Here the coordinator is pod 0 inside the cluster, so the
+# bastion only applies manifests, waits, and streams logs.
+set -euo pipefail
+
+REPLICAS="${WORKER_REPLICAS:-1}"            # hosts in the slice
+EPOCHS="${EPOCHS:-10}"
+BATCH_SIZE="${BATCH_SIZE:-32}"
+MESH_SHAPE="${MESH_SHAPE:-}"
+DATA_PATH="${DATA_PATH:-gs://${PROJECT_ID:?set PROJECT_ID}-datasets/health.csv}"
+MANIFEST="$(dirname "$0")/../infra/k8s/tpu/tpu-worker.yaml"
+
+echo "Launching TPU training: replicas=${REPLICAS} epochs=${EPOCHS} batch=${BATCH_SIZE} mesh='${MESH_SHAPE}'"
+
+sed -e "s|\${PROJECT_ID}|${PROJECT_ID}|g" \
+    -e "s|\${REGISTRY}|${REGISTRY:-gcr.io/${PROJECT_ID}}|g" \
+    -e "s|\${CLUSTER_NAME}|${CLUSTER_NAME:-tpu-pipeline}|g" \
+    -e "s|replicas: 1|replicas: ${REPLICAS}|" \
+    -e "s|value: \"10\"   # EPOCHS|value: \"${EPOCHS}\"|" \
+    "${MANIFEST}" | kubectl apply -f -
+
+kubectl set env statefulset/tpu-worker \
+  NUM_PROCESSES="${REPLICAS}" EPOCHS="${EPOCHS}" BATCH_SIZE="${BATCH_SIZE}" \
+  MESH_SHAPE="${MESH_SHAPE}" DATA_PATH="${DATA_PATH}"
+
+echo "Waiting for rollout..."
+kubectl rollout status statefulset/tpu-worker --timeout=600s
+
+echo "Streaming coordinator logs (Ctrl-C detaches; training continues):"
+kubectl logs -f tpu-worker-0

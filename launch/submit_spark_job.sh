@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Spark job submission through the in-cluster bastion pod — the analog of
+# the reference's `docker exec spark-bastion-external ... spark-submit`
+# flow (infra/local/external_workloads/README.md:65-73).
+#
+# Usage: submit_spark_job.sh [module] e.g.
+#   submit_spark_job.sh pyspark_tf_gke_tpu.etl.kmeans_spark
+#   submit_spark_job.sh pyspark_tf_gke_tpu.etl.tfrecord_bridge
+set -euo pipefail
+
+MODULE="${1:-pyspark_tf_gke_tpu.etl.kmeans_spark}"
+POD="${SPARK_BASTION_POD:-spark-workload}"
+
+kubectl exec "${POD}" -- python -m "${MODULE}"

