@@ -1,0 +1,7 @@
+-- DB maintenance for the health dataset (reference: workloads/raw-spark/manege.sql)
+-- Reset the table between load runs without dropping the schema (keeps the
+-- auto-increment id column the JDBC range read partitions on).
+USE health_data;
+TRUNCATE TABLE health_disparities;
+-- Row count sanity check after a load:
+-- SELECT COUNT(*) FROM health_disparities;

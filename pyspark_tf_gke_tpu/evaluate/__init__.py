@@ -1,0 +1,3 @@
+from pyspark_tf_gke_tpu.evaluate.image_checker import ManualImageChecker
+
+__all__ = ["ManualImageChecker"]

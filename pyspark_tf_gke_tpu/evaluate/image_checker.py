@@ -1,0 +1,113 @@
+"""Visual model eval — the analog of the reference's ``ManualImageChecker``
+(``workloads/raw-tf/test-model.py:13-56``): load a trained CNN checkpoint,
+predict the (x, y) laser-spot coordinate for every image in a directory,
+and save overlay plots with the predicted point marked.
+
+Loads orbax checkpoints (ours) instead of ``.keras`` files; everything
+else — the per-image predict → overlay → save loop — matches.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from pyspark_tf_gke_tpu.data.images import list_labeled_images, load_image
+from pyspark_tf_gke_tpu.models import CNNRegressor
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+logger = get_logger("evaluate.image_checker")
+
+
+class ManualImageChecker:
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        image_size: Tuple[int, int] = (256, 320),
+        flat: bool = False,
+        output_dir: str = "./eval-plots",
+    ):
+        self.image_size = image_size
+        self.output_dir = output_dir
+        self.model = CNNRegressor(num_outputs=2, flat=flat)
+        self.params = self._load_params(checkpoint_dir)
+        self._predict = jax.jit(
+            lambda params, x: self.model.apply({"params": params}, x)
+        )
+
+    def _load_params(self, checkpoint_dir: str):
+        import orbax.checkpoint as ocp
+
+        mgr = ocp.CheckpointManager(os.path.abspath(checkpoint_dir))
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
+        restored = mgr.restore(step)
+        mgr.close()
+        # TrainState layout: {'params': ..., ...} or the state pytree itself
+        params = restored.get("params") if isinstance(restored, dict) else restored.params
+        logger.info("loaded checkpoint step %s", step)
+        return params
+
+    def predict(self, image: np.ndarray) -> Tuple[float, float]:
+        out = self._predict(self.params, image[None])
+        x, y = np.asarray(jax.device_get(out))[0]
+        return float(x), float(y)
+
+    def img_to_plot(self, image: np.ndarray, pred: Tuple[float, float],
+                    target: Optional[Tuple[float, float]], out_path: str) -> None:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots()
+        ax.imshow(image)
+        ax.plot(pred[0], pred[1], "rx", markersize=12, markeredgewidth=3,
+                label=f"pred ({pred[0]:.1f}, {pred[1]:.1f})")
+        if target is not None:
+            ax.plot(target[0], target[1], "g+", markersize=12, markeredgewidth=3,
+                    label=f"true ({target[0]:.1f}, {target[1]:.1f})")
+        ax.legend(loc="upper right")
+        ax.set_axis_off()
+        fig.savefig(out_path, bbox_inches="tight")
+        plt.close(fig)
+
+    def main(self, data_dir: str) -> dict:
+        os.makedirs(self.output_dir, exist_ok=True)
+        filepaths, targets = list_labeled_images(data_dir)
+        errors = []
+        for path, target in zip(filepaths, targets):
+            image = load_image(path, *self.image_size)
+            pred = self.predict(image)
+            name = os.path.splitext(os.path.basename(path))[0]
+            self.img_to_plot(image, pred, tuple(target),
+                             os.path.join(self.output_dir, f"{name}_eval.png"))
+            errors.append(np.hypot(pred[0] - target[0], pred[1] - target[1]))
+        result = {
+            "n_images": len(filepaths),
+            "mean_px_error": float(np.mean(errors)),
+            "max_px_error": float(np.max(errors)),
+            "plots_dir": self.output_dir,
+        }
+        logger.info("eval: %s", result)
+        return result
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--checkpoint-dir", required=True)
+    p.add_argument("--data-dir", required=True)
+    p.add_argument("--img-height", type=int, default=256)
+    p.add_argument("--img-width", type=int, default=320)
+    p.add_argument("--flat-layer", action="store_true")
+    p.add_argument("--output-dir", default="./eval-plots")
+    a = p.parse_args()
+    ManualImageChecker(
+        a.checkpoint_dir, (a.img_height, a.img_width), a.flat_layer, a.output_dir
+    ).main(a.data_dir)

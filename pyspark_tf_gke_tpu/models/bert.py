@@ -39,6 +39,7 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     dtype: Any = jnp.bfloat16
     remat: bool = False
+    use_flash: bool = False  # Pallas flash-attention kernel (TPU; sp=1 only)
 
     @property
     def head_dim(self) -> int:
@@ -92,6 +93,10 @@ class BertSelfAttention(nn.Module):
         use_ring = self.mesh is not None and self.mesh.shape.get("sp", 1) > 1
         if use_ring:
             out = ring_attention(q, k, v, self.mesh, kv_mask=mask, axis="sp")
+        elif cfg.use_flash:
+            from pyspark_tf_gke_tpu.ops.pallas.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, kv_mask=mask)
         else:
             out = dot_product_attention(q, k, v, mask=mask[:, None, None, :])
         out = out.reshape(b, s, cfg.hidden_size)

@@ -1,0 +1,4 @@
+from pyspark_tf_gke_tpu.ops.pallas.flash_attention import flash_attention
+from pyspark_tf_gke_tpu.ops.pallas.layernorm import fused_layernorm
+
+__all__ = ["flash_attention", "fused_layernorm"]

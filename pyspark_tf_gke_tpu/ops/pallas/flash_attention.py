@@ -1,0 +1,192 @@
+"""Flash attention forward as a Pallas TPU kernel.
+
+The S×S score matrix never touches HBM: each grid step owns one Q block in
+VMEM, loops over K/V blocks with the online-softmax recurrence (running
+max ``m``, normalizer ``l``, accumulator in f32), and writes one O block.
+Q·Kᵀ and P·V hit the MXU with f32 accumulation.
+
+Layout: inputs are ``[BH, S, D]`` (batch×heads collapsed — each grid row
+is independent). Optional additive bias ``[BH, S]`` implements padding
+masks (0 for keep, -inf/NEG_INF for drop). ``causal=True`` masks with
+block-level skipping (a K block fully in the future is never read).
+
+Backward: ``jax.custom_vjp`` recomputes attention blockwise in plain JAX
+(flash-style memory behavior; XLA fuses it well). Residuals are only
+(q, k, v, bias) — no S×S tensor is saved.
+
+The public entry ``flash_attention`` takes ``[B, S, H, D]`` like
+``ops.attention.dot_product_attention`` and reshapes. Falls back to the
+dense path on non-TPU backends unless ``interpret=True`` (used in tests).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # TPU-only import; interpret mode works without it
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+NEG_INF = -1e30
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, block_k: int, causal: bool,
+                scale: float):
+    # Shapes: q [1, bq, D], k/v [1, S, D], bias [1, S], o [1, bq, D]
+    bq = q_ref.shape[1]
+    s = k_ref.shape[1]
+    d = q_ref.shape[2]
+    qi = pl.program_id(1)  # Q-block index
+
+    q = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
+
+    m = jnp.full((bq, 1), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((bq, 1), dtype=jnp.float32)
+    acc = jnp.zeros((bq, d), dtype=jnp.float32)
+
+    num_kb = s // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                # [bq, bk]
+        scores += bias_ref[0, pl.ds(kb * block_k, block_k)][None, :]
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l, acc
+
+    if causal:
+        # K blocks fully in the future of this Q block are skipped entirely.
+        last_kb = jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, num_kb)
+    else:
+        last_kb = num_kb
+    m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m, l, acc))
+
+    valid = m > NEG_INF / 2                              # rows with >=1 unmasked key
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.where(valid, acc / l, 0.0)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _flash_fwd_bh(q, k, v, bias, *, causal: bool, block_q: int, block_k: int,
+                  interpret: bool):
+    """q,k,v: [BH, S, D]; bias: [BH, S] additive (0 / NEG_INF)."""
+    bh, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq len {s} must be divisible by blocks ({block_q},{block_k})")
+    scale = d ** -0.5
+
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
+                               scale=scale)
+    mem = {} if _VMEM is None else {"memory_space": _VMEM}
+    grid = (bh, s // block_q)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0), **mem),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0), **mem),
+            pl.BlockSpec((1, s), lambda i, j: (i, 0), **mem),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, bias)
+
+
+def _reference_bh(q, k, v, bias, causal):
+    """Blockwise-free dense reference used for the backward recompute."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    scores += bias[:, None, :]
+    if causal:
+        s = q.shape[1]
+        cm = jnp.tril(jnp.ones((s, s), dtype=bool))
+        scores = jnp.where(cm[None], scores, NEG_INF)
+    m = scores.max(-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    l = p.sum(-1, keepdims=True)
+    valid = m > NEG_INF / 2
+    out = jnp.where(valid, jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+                    / jnp.where(l == 0, 1.0, l), 0.0)
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_bh(q, k, v, bias, causal, block_q, block_k, interpret):
+    return _flash_fwd_bh(q, k, v, bias, causal=causal, block_q=block_q,
+                         block_k=block_k, interpret=interpret)
+
+
+def _flash_bh_fwd(q, k, v, bias, causal, block_q, block_k, interpret):
+    out = _flash_fwd_bh(q, k, v, bias, causal=causal, block_q=block_q,
+                        block_k=block_k, interpret=interpret)
+    return out, (q, k, v, bias)
+
+
+def _flash_bh_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v, bias = residuals
+    _, vjp = jax.vjp(lambda q, k, v: _reference_bh(q, k, v, bias, causal), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flash_bh.defvjp(_flash_bh_fwd, _flash_bh_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,  # [B, S, H, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    kv_mask: Optional[jnp.ndarray] = None,  # [B, S] bool
+    causal: bool = False,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused attention; drop-in for ``dot_product_attention`` on TPU."""
+    b, s, h, d = q.shape
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    if kv_mask is None:
+        bias = jnp.zeros((b, s), dtype=jnp.float32)
+    else:
+        bias = jnp.where(kv_mask.astype(bool), 0.0, NEG_INF).astype(jnp.float32)
+    bias = jnp.repeat(bias, h, axis=0)  # [BH, S]
+
+    out = _flash_bh(to_bh(q), to_bh(k), to_bh(v), bias, causal, block_q, block_k,
+                    interpret)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,108 @@
+"""Fused LayerNorm as a Pallas TPU kernel.
+
+One VMEM-resident pass per row block: mean, variance (rsqrt), scale+shift
+— a single kernel instead of the half-dozen HBM round-trips a naive
+implementation costs. f32 statistics regardless of input dtype.
+
+Backward via custom_vjp with the standard closed-form LN gradient
+(plain JAX; XLA fuses it into two passes).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+DEFAULT_BLOCK_ROWS = 256
+
+
+def _ln_kernel(x_ref, scale_ref, bias_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)                       # [rows, D]
+    mean = x.mean(axis=-1, keepdims=True)
+    xc = x - mean
+    var = (xc * xc).mean(axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    y = xc * inv * scale_ref[:].astype(jnp.float32)[None, :] + \
+        bias_ref[:].astype(jnp.float32)[None, :]
+    o_ref[:] = y.astype(o_ref.dtype)
+
+
+def _ln_forward(x2, scale, bias, eps, block_rows, interpret):
+    n, d = x2.shape
+    block_rows = min(block_rows, n)
+    if n % block_rows:
+        raise ValueError(f"rows {n} not divisible by block_rows {block_rows}")
+    mem = {} if _VMEM is None else {"memory_space": _VMEM}
+    return pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        grid=(n // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0), **mem),
+            pl.BlockSpec((d,), lambda i: (0,), **mem),
+            pl.BlockSpec((d,), lambda i: (0,), **mem),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0), **mem),
+        out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
+        interpret=interpret,
+    )(x2, scale, bias)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ln(x2, scale, bias, eps, block_rows, interpret):
+    return _ln_forward(x2, scale, bias, eps, block_rows, interpret)
+
+
+def _ln_fwd(x2, scale, bias, eps, block_rows, interpret):
+    return _ln_forward(x2, scale, bias, eps, block_rows, interpret), (x2, scale)
+
+
+def _ln_bwd(eps, block_rows, interpret, residuals, g):
+    x2, scale = residuals
+    x = x2.astype(jnp.float32)
+    g = g.astype(jnp.float32)
+    d = x.shape[-1]
+    mean = x.mean(-1, keepdims=True)
+    xc = x - mean
+    var = (xc * xc).mean(-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = xc * inv
+    gs = g * scale.astype(jnp.float32)[None, :]
+    dx = inv / d * (d * gs - gs.sum(-1, keepdims=True) - xhat * (gs * xhat).sum(-1, keepdims=True))
+    dscale = (g * xhat).sum(0)
+    dbias = g.sum(0)
+    return dx.astype(x2.dtype), dscale.astype(scale.dtype), dbias.astype(scale.dtype)
+
+
+_ln.defvjp(_ln_fwd, _ln_bwd)
+
+
+def fused_layernorm(
+    x: jnp.ndarray,                  # [..., D]
+    scale: jnp.ndarray,              # [D]
+    bias: jnp.ndarray,               # [D]
+    eps: float = 1e-6,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    n = x2.shape[0]
+    # pick the largest divisor block (rows need not be 2^k for the VPU)
+    br = min(block_rows, n)
+    while n % br:
+        br -= 1
+    return _ln(x2, scale, bias, eps, br, interpret).reshape(shape)

@@ -1,0 +1,85 @@
+"""Profiling / step-time observability.
+
+The reference has no profiling subsystem (SURVEY §5 — only the Spark Web
+UI and ``kubectl top`` polling); this is the first-class replacement:
+
+* ``profile_trace`` — context manager around ``jax.profiler`` trace
+  capture (open the output dir with TensorBoard / xprof to see per-op
+  MXU/HBM utilization);
+* ``StepTimer`` — rolling step-time stats with compile-step exclusion,
+  feeding the history's ``step_time_ms`` / ``examples_per_sec`` metrics
+  (the BASELINE.json north-star numbers);
+* ``annotate`` — named trace spans (``jax.profiler.TraceAnnotation``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+logger = get_logger("utils.profiling")
+
+
+@contextlib.contextmanager
+def profile_trace(output_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler trace into ``output_dir`` (no-op if falsy)."""
+    if not output_dir:
+        yield
+        return
+    jax.profiler.start_trace(output_dir)
+    logger.info("profiler trace started -> %s", output_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("profiler trace written to %s", output_dir)
+
+
+def annotate(name: str):
+    """Named span visible in the trace viewer."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Rolling wall-clock stats over steps; excludes the first (compile)."""
+
+    def __init__(self):
+        self._times = []
+        self._t0 = None
+        self._first_excluded = False
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> None:
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        if not self._first_excluded:
+            self._first_excluded = True
+            return
+        self._times.append(dt)
+
+    @property
+    def count(self) -> int:
+        return len(self._times)
+
+    @property
+    def mean_ms(self) -> float:
+        return sum(self._times) / len(self._times) * 1000.0 if self._times else 0.0
+
+    @property
+    def p50_ms(self) -> float:
+        if not self._times:
+            return 0.0
+        s = sorted(self._times)
+        return s[len(s) // 2] * 1000.0
+
+    def examples_per_sec(self, batch_size: int) -> float:
+        return batch_size / (self.mean_ms / 1000.0) if self._times else 0.0

@@ -1,0 +1,101 @@
+"""Pallas kernels run in interpret mode on the CPU fake slice; numerics are
+checked against the dense implementations in ops.attention / flax LN."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pyspark_tf_gke_tpu.ops.attention import dot_product_attention
+from pyspark_tf_gke_tpu.ops.pallas.flash_attention import flash_attention
+from pyspark_tf_gke_tpu.ops.pallas.layernorm import fused_layernorm
+
+
+def _qkv(b=2, s=64, h=2, d=16, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return tuple(jax.random.normal(k, (b, s, h, d), dtype=jnp.float32) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32,
+                          interpret=True)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_with_padding_mask():
+    q, k, v = _qkv(b=2, s=64)
+    mask = np.ones((2, 64), dtype=bool)
+    mask[:, 48:] = False
+    out = flash_attention(q, k, v, kv_mask=jnp.asarray(mask), block_q=32,
+                          block_k=32, interpret=True)
+    ref = dot_product_attention(q, k, v, mask=jnp.asarray(mask)[:, None, None, :])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_fully_masked_rows_zero():
+    q, k, v = _qkv(b=1, s=32)
+    mask = np.zeros((1, 32), dtype=bool)
+    out = flash_attention(q, k, v, kv_mask=jnp.asarray(mask), block_q=32,
+                          block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), 0.0)
+
+
+def test_flash_grad_matches_dense():
+    q, k, v = _qkv(b=1, s=32, h=1, d=8)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, block_q=16, block_k=16,
+                                interpret=True) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (dot_product_attention(q, k, v) ** 2).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_flash_bad_block_size():
+    q, k, v = _qkv(b=1, s=48)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, block_q=32, block_k=32, interpret=True)
+
+
+def test_fused_layernorm_matches_flax():
+    x = jax.random.normal(jax.random.key(0), (6, 10, 32)) * 3 + 1
+    scale = jax.random.normal(jax.random.key(1), (32,))
+    bias = jax.random.normal(jax.random.key(2), (32,))
+    out = fused_layernorm(x, scale, bias, eps=1e-6, interpret=True)
+    ln = nn.LayerNorm(epsilon=1e-6)
+    ref = ln.apply({"params": {"scale": scale, "bias": bias}}, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_fused_layernorm_grad():
+    x = jax.random.normal(jax.random.key(0), (8, 16))
+    scale = jnp.ones((16,))
+    bias = jnp.zeros((16,))
+
+    def loss_fused(x, s, b):
+        return (fused_layernorm(x, s, b, interpret=True) ** 2).sum()
+
+    def loss_ref(x, s, b):
+        ln = nn.LayerNorm(epsilon=1e-6)
+        return (ln.apply({"params": {"scale": s, "bias": b}}, x) ** 2).sum()
+
+    g1 = jax.grad(loss_fused, argnums=(0, 1, 2))(x, scale, bias)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(x, scale, bias)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_fused_layernorm_odd_rows():
+    # 7 rows: block search must fall back to a divisor (7)
+    x = jax.random.normal(jax.random.key(0), (7, 24))
+    out = fused_layernorm(x, jnp.ones((24,)), jnp.zeros((24,)), interpret=True)
+    assert out.shape == (7, 24)

@@ -1,0 +1,53 @@
+"""Installation smoke check — the analog of the reference's
+``spark_installation_check.py`` (``workloads/raw-spark/spark_checks/
+python_checks/spark_installation_check.py:12-46``): where that script
+builds a ``local[2]`` in-process Spark session and runs a toy job, this
+builds a 2-device virtual CPU mesh and runs a toy sharded training step.
+Exit 0 = the framework and its distributed machinery work on this box.
+
+Usage: python tools/smoke_check.py
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=2").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pyspark_tf_gke_tpu.data.pipeline import BatchIterator  # noqa: E402
+from pyspark_tf_gke_tpu.data.synthetic import synthetic_classification_arrays  # noqa: E402
+from pyspark_tf_gke_tpu.models import MLPClassifier  # noqa: E402
+from pyspark_tf_gke_tpu.parallel.mesh import make_mesh  # noqa: E402
+from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer  # noqa: E402
+from pyspark_tf_gke_tpu.utils.seeding import make_rng  # noqa: E402
+
+
+def main() -> int:
+    devices = jax.devices()
+    print(f"devices: {devices}")
+    assert len(devices) >= 2, "expected a 2-device virtual mesh"
+
+    mesh = make_mesh({"dp": 2}, devices[:2])
+    X, y = synthetic_classification_arrays(n=128, num_classes=4)
+    it = BatchIterator({"x": X, "y": y}, 32)
+    trainer = Trainer(MLPClassifier(num_classes=4), TASKS["classification"](),
+                      mesh, learning_rate=1e-2)
+    state = trainer.init_state(make_rng(0), next(iter(it)))
+    state, history = trainer.fit(state, it, epochs=2, steps_per_epoch=4)
+    ok = history["loss"][-1] < history["loss"][0]
+    print(f"loss {history['loss'][0]:.4f} -> {history['loss'][-1]:.4f}  "
+          f"({'OK' if ok else 'NOT DECREASING'})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
