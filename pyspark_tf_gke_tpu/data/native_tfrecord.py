@@ -1,0 +1,238 @@
+"""TFRecord pipeline on the first-party native IO plane.
+
+Same contract as ``pyspark_tf_gke_tpu.data.tfrecord`` (the tf.data-backed
+path) but with zero tensorflow dependency: framing + Example codec + the
+threaded prefetch reader come from the C++ library
+(``native/src/tfrecord_io.cc``), with the pure-Python codec
+(``data/codec.py``) as last-resort fallback. This is the path the
+training image uses — tensorflow stays a Spark-side-only dependency.
+
+Semantics mirrored from the reference's input pipeline
+(``/root/reference/workloads/raw-tf/train_tf_ps.py:301-322``):
+file-level host sharding (the ``dataset.shard`` analog), a 3000-row
+shuffle buffer, repeat, drop-remainder batching.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pyspark_tf_gke_tpu.data.codec import Schema
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+from pyspark_tf_gke_tpu.utils.seeding import DEFAULT_SEED, np_rng
+
+logger = get_logger("data.native_tfrecord")
+
+
+def native_available() -> bool:
+    from pyspark_tf_gke_tpu import native
+
+    return native.available()
+
+
+# ---------------------------------------------------------------------------
+# writing
+# ---------------------------------------------------------------------------
+
+
+def write_tfrecord_shards(
+    arrays: Dict[str, np.ndarray],
+    path_prefix: str,
+    num_shards: int = 4,
+    schema: Optional[Schema] = None,
+) -> Sequence[str]:
+    """Write row-aligned arrays as TFRecord shards via the native codec
+    (python-codec fallback). Same naming/striping as the tf.data writer:
+    ``{prefix}-{i:05d}-of-{n:05d}.tfrecord``, row i -> shard i % n."""
+    from pyspark_tf_gke_tpu.data.tfrecord import schema_for
+
+    if schema is None:
+        schema = schema_for(arrays)
+    n = len(next(iter(arrays.values())))
+    for k, v in arrays.items():
+        if len(v) != n:
+            raise ValueError(f"array {k!r} length {len(v)} != {n}")
+    os.makedirs(os.path.dirname(os.path.abspath(path_prefix)), exist_ok=True)
+
+    use_native = native_available()
+    if use_native:
+        from pyspark_tf_gke_tpu import native as io
+    else:
+        from pyspark_tf_gke_tpu.data import codec as io  # type: ignore[no-redef]
+        logger.warning("native IO unavailable; using pure-Python codec")
+
+    paths = []
+    for shard in range(num_shards):
+        path = f"{path_prefix}-{shard:05d}-of-{num_shards:05d}.tfrecord"
+        paths.append(path)
+        if use_native:
+            with io.RecordWriter(path) as w:
+                for i in range(shard, n, num_shards):
+                    row = {k: arrays[k][i] for k in schema}
+                    w.write(io.encode_example(schema, row))
+        else:
+            from pyspark_tf_gke_tpu.data.codec import encode_example, encode_record
+
+            with open(path, "wb") as f:
+                for i in range(shard, n, num_shards):
+                    row = {k: arrays[k][i] for k in schema}
+                    f.write(encode_record(encode_example(schema, row)))
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# reading
+# ---------------------------------------------------------------------------
+
+
+def _iter_rows(
+    files: Sequence[str], schema: Schema, nthreads: int, read_batch: int
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Stream decoded row-blocks from the shard set."""
+    if native_available():
+        from pyspark_tf_gke_tpu.native import ExamplePool
+
+        with ExamplePool(files, schema, nthreads=nthreads) as pool:
+            while True:
+                block = pool.next_rows(read_batch)
+                if block is None:
+                    return
+                yield block
+    else:
+        from pyspark_tf_gke_tpu.data.codec import iter_records, parse_example
+
+        rows = []
+        for path in files:
+            for rec in iter_records(path):
+                rows.append(parse_example(schema, rec))
+                if len(rows) == read_batch:
+                    yield {
+                        k: np.stack([r[k] for r in rows]) for k in schema
+                    }
+                    rows = []
+        if rows:
+            yield {k: np.stack([r[k] for r in rows]) for k in schema}
+
+
+class ShuffleBuffer:
+    """Fixed-capacity reservoir shuffle, the tf.data ``shuffle(buffer)``
+    analog (reference uses buffer 3000, train_tf_ps.py:599)."""
+
+    def __init__(self, capacity: int, seed: int = DEFAULT_SEED):
+        self.capacity = capacity
+        self._rng = np_rng(seed)
+        self._rows: list = []
+
+    def push_pop(self, row) -> Optional[object]:
+        if len(self._rows) < self.capacity:
+            self._rows.append(row)
+            return None
+        j = int(self._rng.integers(len(self._rows)))
+        out = self._rows[j]
+        self._rows[j] = row
+        return out
+
+    def drain(self) -> Iterator[object]:
+        order = self._rng.permutation(len(self._rows))
+        for j in order:
+            yield self._rows[j]
+        self._rows = []
+
+
+def read_tfrecord_batches(
+    pattern: str,
+    schema: Schema,
+    batch_size: int,
+    shuffle: bool = True,
+    seed: int = DEFAULT_SEED,
+    repeat: bool = True,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+    nthreads: int = 4,
+    shuffle_buffer: int = 3000,
+    int_dtype=np.int32,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Stream host-sharded numpy batches from TFRecord shards, natively.
+
+    Drop-in replacement for ``data.tfrecord.read_tfrecord_batches`` —
+    same file-level host sharding (sorted files striped over processes)
+    and the same cast of int features to int32 that the tf.data parse fn
+    applies.
+    """
+    import jax
+
+    if process_index is None:
+        process_index = jax.process_index()
+    if process_count is None:
+        process_count = jax.process_count()
+
+    files = sorted(glob.glob(pattern))
+    if not files:
+        raise FileNotFoundError(f"no TFRecord shards match {pattern!r}")
+    local_files = files[process_index::process_count]
+    if not local_files:
+        raise ValueError(
+            f"{len(files)} shards < {process_count} processes; write more shards"
+        )
+
+    def cast(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        out = {}
+        for k, (kind, _) in schema.items():
+            v = batch[k]
+            out[k] = v.astype(int_dtype) if kind == "int" else v
+        return out
+
+    pending: Dict[str, list] = {k: [] for k in schema}
+    pending_rows = 0
+
+    def emit_ready() -> Iterator[Dict[str, np.ndarray]]:
+        nonlocal pending, pending_rows
+        while pending_rows >= batch_size:
+            batch = {}
+            for k in schema:
+                stacked = (
+                    pending[k][0]
+                    if len(pending[k]) == 1
+                    else np.concatenate(pending[k])
+                )
+                batch[k] = stacked[:batch_size]
+                pending[k] = [stacked[batch_size:]]
+            pending_rows -= batch_size
+            yield cast(batch)
+
+    while True:  # epoch loop (single pass if not repeat)
+        if shuffle:
+            buf = ShuffleBuffer(shuffle_buffer, seed=seed)
+            seed += 1  # reshuffle differently each epoch, deterministically
+
+            def rows():
+                for block in _iter_rows(local_files, schema, nthreads, batch_size):
+                    n = len(next(iter(block.values())))
+                    for i in range(n):
+                        out = buf.push_pop({k: block[k][i] for k in schema})
+                        if out is not None:
+                            yield out
+                yield from buf.drain()
+
+            row_iter = rows()
+            stash: list = []
+            for row in row_iter:
+                stash.append(row)
+                if len(stash) == batch_size:
+                    yield cast({k: np.stack([r[k] for r in stash]) for k in schema})
+                    stash = []
+            # drop remainder (parity with drop_remainder=True)
+        else:
+            for block in _iter_rows(local_files, schema, nthreads, batch_size):
+                for k in schema:
+                    pending[k].append(block[k])
+                pending_rows += len(next(iter(block.values())))
+                yield from emit_ready()
+            pending = {k: [] for k in schema}
+            pending_rows = 0
+        if not repeat:
+            return

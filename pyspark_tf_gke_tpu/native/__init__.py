@@ -1,0 +1,344 @@
+"""ctypes bindings over the native C++ TFRecord IO plane.
+
+This is the framework's first-party native runtime component for the data
+path — the role played in the reference by TensorFlow's C++ tf.data
+runtime (TFRecordDataset + parse_single_example,
+``workloads/raw-tf/train_tf_ps.py:301-322``). Public surface:
+
+* ``available()`` — whether the shared library could be (or was) built;
+* ``RecordWriter`` / ``RecordReader`` — CRC32C-framed record codec;
+* ``encode_example`` / ``parse_example`` — schema-driven
+  tf.train.Example wire-format encode/decode (no tensorflow import);
+* ``ExamplePool`` — multi-threaded prefetching shard reader delivering
+  rows straight into numpy buffers.
+
+Feature kinds use the same schema vocabulary as
+``pyspark_tf_gke_tpu.data.tfrecord``: ``float`` (float32), ``int``
+(int64 on the wire), ``bytes`` (fixed-length uint8).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Dict, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pyspark_tf_gke_tpu.native.build import NativeBuildError, build_native
+
+Schema = Dict[str, Tuple[str, Tuple[int, ...]]]
+
+_KIND_CODE = {"float": 0, "int": 1, "bytes": 2}
+_KIND_DTYPE = {"float": np.float32, "int": np.int64, "bytes": np.uint8}
+
+_ERRORS = {
+    -1: "EOF",
+    -2: "corrupt record (bad frame or CRC mismatch)",
+    -3: "I/O error",
+    -4: "protobuf wire-format parse error",
+    -5: "schema mismatch (missing feature or wrong element count)",
+    -6: "invalid argument",
+}
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_error: Optional[str] = None
+
+
+class NativeIOError(RuntimeError):
+    pass
+
+
+def _check(rc: int, what: str) -> int:
+    if rc < 0:
+        raise NativeIOError(f"{what}: {_ERRORS.get(rc, rc)}")
+    return rc
+
+
+def _load():
+    global _lib, _load_error
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _load_error is not None:
+            raise NativeBuildError(_load_error)
+        try:
+            path = build_native()
+            lib = ctypes.CDLL(path)
+        except (NativeBuildError, OSError) as e:
+            _load_error = str(e)
+            raise NativeBuildError(_load_error) from None
+
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.tfr_crc32c.restype = ctypes.c_uint32
+        lib.tfr_crc32c.argtypes = [u8p, ctypes.c_uint64]
+        lib.tfr_masked_crc32c.restype = ctypes.c_uint32
+        lib.tfr_masked_crc32c.argtypes = [u8p, ctypes.c_uint64]
+
+        lib.tfr_writer_open.restype = ctypes.c_void_p
+        lib.tfr_writer_open.argtypes = [ctypes.c_char_p]
+        lib.tfr_writer_write.restype = ctypes.c_int
+        lib.tfr_writer_write.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64]
+        lib.tfr_writer_close.restype = ctypes.c_int
+        lib.tfr_writer_close.argtypes = [ctypes.c_void_p]
+
+        lib.tfr_reader_open.restype = ctypes.c_void_p
+        lib.tfr_reader_open.argtypes = [ctypes.c_char_p]
+        lib.tfr_reader_next.restype = ctypes.c_int64
+        lib.tfr_reader_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(u8p)]
+        lib.tfr_reader_close.restype = None
+        lib.tfr_reader_close.argtypes = [ctypes.c_void_p]
+
+        lib.tfr_parse_example.restype = ctypes.c_int
+        lib.tfr_parse_example.argtypes = [
+            u8p, ctypes.c_int64, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.POINTER(u8p),
+        ]
+        lib.tfr_encode_example.restype = ctypes.c_int64
+        lib.tfr_encode_example.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.POINTER(u8p), u8p, ctypes.c_int64,
+        ]
+
+        lib.tfr_pool_open.restype = ctypes.c_void_p
+        lib.tfr_pool_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.tfr_pool_next_rows.restype = ctypes.c_int64
+        lib.tfr_pool_next_rows.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(u8p),
+        ]
+        lib.tfr_pool_close.restype = None
+        lib.tfr_pool_close.argtypes = [ctypes.c_void_p]
+
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except NativeBuildError:
+        return False
+
+
+def load_error() -> Optional[str]:
+    if _lib is not None:
+        return None
+    try:
+        _load()
+        return None
+    except NativeBuildError as e:
+        return str(e)
+
+
+def crc32c(data: bytes) -> int:
+    lib = _load()
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    return lib.tfr_crc32c(buf, len(data))
+
+
+def masked_crc32c(data: bytes) -> int:
+    lib = _load()
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    return lib.tfr_masked_crc32c(buf, len(data))
+
+
+# ---------------------------------------------------------------------------
+# schema plumbing
+# ---------------------------------------------------------------------------
+
+
+def _schema_arrays(schema: Schema):
+    names = list(schema.keys())
+    kinds = [schema[n][0] for n in names]
+    for k in kinds:
+        if k not in _KIND_CODE:
+            raise ValueError(f"unknown feature kind {k!r}")
+    rowsizes = [int(np.prod(schema[n][1], dtype=np.int64)) or 1 for n in names]
+    c_names = (ctypes.c_char_p * len(names))(*[n.encode() for n in names])
+    c_kinds = (ctypes.c_int32 * len(names))(*[_KIND_CODE[k] for k in kinds])
+    c_sizes = (ctypes.c_int64 * len(names))(*rowsizes)
+    return names, kinds, rowsizes, c_names, c_kinds, c_sizes
+
+
+def _as_u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+# ---------------------------------------------------------------------------
+# record codec
+# ---------------------------------------------------------------------------
+
+
+class RecordWriter:
+    """CRC32C-framed record writer (TFRecord framing)."""
+
+    def __init__(self, path: str):
+        lib = _load()
+        self._lib = lib
+        self._h = lib.tfr_writer_open(path.encode())
+        if not self._h:
+            raise NativeIOError(f"cannot open {path} for writing")
+
+    def write(self, record: bytes) -> None:
+        buf = (ctypes.c_uint8 * len(record)).from_buffer_copy(record)
+        _check(self._lib.tfr_writer_write(self._h, buf, len(record)), "write")
+
+    def close(self) -> None:
+        if self._h:
+            _check(self._lib.tfr_writer_close(self._h), "close")
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RecordReader:
+    """Iterates raw records of one TFRecord file."""
+
+    def __init__(self, path: str):
+        lib = _load()
+        self._lib = lib
+        self._h = lib.tfr_reader_open(path.encode())
+        if not self._h:
+            raise NativeIOError(f"cannot open {path}")
+
+    def __iter__(self) -> Iterator[bytes]:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        while True:
+            n = self._lib.tfr_reader_next(self._h, ctypes.byref(out))
+            if n == -1:
+                return
+            _check(int(n), "read")
+            yield ctypes.string_at(out, n)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tfr_reader_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# Example encode / parse
+# ---------------------------------------------------------------------------
+
+
+def encode_example(schema: Schema, row: Dict[str, np.ndarray]) -> bytes:
+    """Serialize one row dict to a tf.train.Example wire message."""
+    names, kinds, rowsizes, c_names, c_kinds, c_sizes = _schema_arrays(schema)
+    lib = _load()
+    bufs = []
+    for n, k in zip(names, kinds):
+        arr = np.ascontiguousarray(row[n], dtype=_KIND_DTYPE[k]).reshape(-1)
+        bufs.append(arr)
+    c_bufs = (ctypes.POINTER(ctypes.c_uint8) * len(bufs))(*[_as_u8p(b) for b in bufs])
+    n = lib.tfr_encode_example(c_names, c_kinds, c_sizes, len(names), c_bufs, None, 0)
+    _check(int(n), "encode")
+    out = np.empty(n, dtype=np.uint8)
+    n2 = lib.tfr_encode_example(
+        c_names, c_kinds, c_sizes, len(names), c_bufs, _as_u8p(out), n
+    )
+    _check(int(n2), "encode")
+    return out.tobytes()
+
+
+def parse_example(schema: Schema, record: bytes) -> Dict[str, np.ndarray]:
+    """Parse one serialized Example into a dict of per-row arrays."""
+    names, kinds, rowsizes, c_names, c_kinds, c_sizes = _schema_arrays(schema)
+    lib = _load()
+    outs = [
+        np.empty(rs, dtype=_KIND_DTYPE[k]) for rs, k in zip(rowsizes, kinds)
+    ]
+    c_out = (ctypes.POINTER(ctypes.c_uint8) * len(outs))(*[_as_u8p(o) for o in outs])
+    buf = (ctypes.c_uint8 * len(record)).from_buffer_copy(record)
+    _check(
+        lib.tfr_parse_example(buf, len(record), c_names, c_kinds, c_sizes,
+                              len(names), c_out),
+        "parse",
+    )
+    return {
+        n: o.reshape(schema[n][1]) if schema[n][1] else o.reshape(())
+        for n, o in zip(names, outs)
+    }
+
+
+# ---------------------------------------------------------------------------
+# threaded prefetch pool
+# ---------------------------------------------------------------------------
+
+
+class ExamplePool:
+    """Multi-threaded shard reader: N producer threads read + CRC-check +
+    parse records into a bounded row queue; ``next_rows`` drains straight
+    into numpy arrays. Row order is file order with 1 thread, interleaved
+    (nondeterministic) otherwise — callers wanting determinism use
+    ``nthreads=1`` or shuffle downstream anyway."""
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        schema: Schema,
+        nthreads: int = 4,
+        capacity_rows: int = 1024,
+    ):
+        if not paths:
+            raise ValueError("no shard paths")
+        lib = _load()
+        self._lib = lib
+        self.schema = schema
+        (self._names, self._kinds, self._rowsizes,
+         c_names, c_kinds, c_sizes) = _schema_arrays(schema)
+        c_paths = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
+        self._h = lib.tfr_pool_open(
+            c_paths, len(paths), c_names, c_kinds, c_sizes, len(self._names),
+            nthreads, capacity_rows,
+        )
+        if not self._h:
+            raise NativeIOError("tfr_pool_open failed (bad args?)")
+
+    def next_rows(self, max_rows: int) -> Optional[Dict[str, np.ndarray]]:
+        """Up to ``max_rows`` decoded rows as stacked arrays; None when all
+        shards are drained."""
+        outs = [
+            np.empty((max_rows, rs), dtype=_KIND_DTYPE[k])
+            for rs, k in zip(self._rowsizes, self._kinds)
+        ]
+        c_out = (ctypes.POINTER(ctypes.c_uint8) * len(outs))(
+            *[_as_u8p(o) for o in outs]
+        )
+        n = _check(int(self._lib.tfr_pool_next_rows(self._h, max_rows, c_out)),
+                   "pool read")
+        if n == 0:
+            return None
+        return {
+            name: o[:n].reshape((n,) + self.schema[name][1])
+            for name, o in zip(self._names, outs)
+        }
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tfr_pool_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,56 @@
+"""Lazy g++ build of the native IO library.
+
+The shared object is compiled on first use into ``native/_build/`` and
+cached by source mtime — the moral equivalent of the reference pulling a
+prebuilt TF C++ runtime in its trainer image
+(``infra/local/raw-tf/tf-trainer-worker.yaml:31``), except we own the
+source. Set ``PTG_TPU_NO_NATIVE=1`` to force the pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "tfrecord_io.cc")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_LIB = os.path.join(_BUILD_DIR, "libtfrecord_io.so")
+
+CXX_FLAGS = ["-O3", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _stale() -> bool:
+    return (not os.path.exists(_LIB)) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+
+
+def build_native(force: bool = False) -> str:
+    """Compile (if needed) and return the shared-library path."""
+    if os.environ.get("PTG_TPU_NO_NATIVE"):
+        raise NativeBuildError("native IO disabled via PTG_TPU_NO_NATIVE")
+    if not force and not _stale():
+        return _LIB
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if not cxx:
+        raise NativeBuildError("no C++ compiler (g++) on PATH")
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    # Build to a temp name then rename: concurrent builders race benignly.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+    os.close(fd)
+    cmd = [cxx, *CXX_FLAGS, "-o", tmp, _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"g++ failed ({proc.returncode}):\n{proc.stderr[-4000:]}"
+            )
+        os.replace(tmp, _LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return _LIB

@@ -1,0 +1,639 @@
+// Native TFRecord IO plane.
+//
+// The reference's record/data path is TensorFlow's C++ runtime (tf.data
+// TFRecordDataset + parse_single_example, driven from
+// workloads/raw-tf/train_tf_ps.py:301-322 via the tensorflow/tensorflow
+// image). This is the framework's own native equivalent: a dependency-free
+// C++17 implementation of
+//
+//   * the TFRecord framing codec (varint-free fixed framing:
+//     u64 length | masked-crc32c(length) | payload | masked-crc32c(payload));
+//   * a hand-rolled protobuf wire-format parser/encoder for
+//     tf.train.Example (Features -> map<string, Feature> ->
+//     BytesList/FloatList/Int64List), schema-driven into flat row buffers;
+//   * a multi-threaded prefetching shard reader that decodes rows into a
+//     bounded queue, exposed batch-at-a-time into caller (numpy) buffers.
+//
+// Exposed as a plain C ABI consumed by ctypes (pyspark_tf_gke_tpu/native).
+// No protobuf/absl/tensorflow dependency: the Example message is simple
+// enough that a 200-line wire parser covers it completely.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// crc32c (Castagnoli, polynomial 0x82F63B78), slicing-by-8 table driven.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+uint32_t g_crc_table[8][256];
+std::once_flag g_crc_once;
+
+void crc32c_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+    g_crc_table[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = g_crc_table[0][i];
+    for (int t = 1; t < 8; t++) {
+      c = g_crc_table[0][c & 0xff] ^ (c >> 8);
+      g_crc_table[t][i] = c;
+    }
+  }
+}
+
+uint32_t crc32c(const uint8_t* data, size_t n) {
+  std::call_once(g_crc_once, crc32c_init);
+  uint32_t crc = 0xFFFFFFFFu;
+  while (n >= 8) {
+    crc ^= (uint32_t)data[0] | ((uint32_t)data[1] << 8) |
+           ((uint32_t)data[2] << 16) | ((uint32_t)data[3] << 24);
+    uint32_t hi = (uint32_t)data[4] | ((uint32_t)data[5] << 8) |
+                  ((uint32_t)data[6] << 16) | ((uint32_t)data[7] << 24);
+    crc = g_crc_table[7][crc & 0xff] ^ g_crc_table[6][(crc >> 8) & 0xff] ^
+          g_crc_table[5][(crc >> 16) & 0xff] ^ g_crc_table[4][crc >> 24] ^
+          g_crc_table[3][hi & 0xff] ^ g_crc_table[2][(hi >> 8) & 0xff] ^
+          g_crc_table[1][(hi >> 16) & 0xff] ^ g_crc_table[0][hi >> 24];
+    data += 8;
+    n -= 8;
+  }
+  while (n--) crc = g_crc_table[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+// TFRecord "masked" crc (same rotation+offset tf uses).
+inline uint32_t masked_crc(const uint8_t* d, size_t n) {
+  uint32_t c = crc32c(d, n);
+  return ((c >> 15) | (c << 17)) + 0xa282ead8u;
+}
+
+inline void put_le32(uint8_t* p, uint32_t v) {
+  p[0] = v & 0xff; p[1] = (v >> 8) & 0xff; p[2] = (v >> 16) & 0xff; p[3] = v >> 24;
+}
+inline void put_le64(uint8_t* p, uint64_t v) {
+  for (int i = 0; i < 8; i++) p[i] = (v >> (8 * i)) & 0xff;
+}
+inline uint32_t get_le32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+inline uint64_t get_le64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; i++) v |= (uint64_t)p[i] << (8 * i);
+  return v;
+}
+
+// Error codes shared with the Python wrapper.
+enum {
+  TFR_EOF = -1,
+  TFR_CORRUPT = -2,
+  TFR_IO = -3,
+  TFR_PARSE = -4,
+  TFR_SCHEMA = -5,
+  TFR_ARG = -6,
+};
+
+// ---------------------------------------------------------------------------
+// Record-level writer / reader (framing codec)
+// ---------------------------------------------------------------------------
+
+struct Writer {
+  FILE* f = nullptr;
+};
+
+struct Reader {
+  FILE* f = nullptr;
+  std::vector<uint8_t> buf;
+};
+
+// ---------------------------------------------------------------------------
+// protobuf wire format (just what tf.train.Example needs)
+// ---------------------------------------------------------------------------
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= (uint64_t)(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  bool skip_field(uint32_t wire) {
+    switch (wire) {
+      case 0: varint(); return ok;
+      case 1: if (end - p < 8) { ok = false; return false; } p += 8; return true;
+      case 2: {
+        uint64_t n = varint();
+        if (!ok || (uint64_t)(end - p) < n) { ok = false; return false; }
+        p += n;
+        return true;
+      }
+      case 5: if (end - p < 4) { ok = false; return false; } p += 4; return true;
+      default: ok = false; return false;
+    }
+  }
+};
+
+// Feature kinds in the C ABI: 0=float32, 1=int64, 2=bytes (fixed row size).
+struct FeatureSpec {
+  std::string name;
+  int32_t kind;
+  int64_t rowsize;  // elements per row (bytes kind: byte count)
+};
+
+struct Schema {
+  std::vector<FeatureSpec> feats;
+};
+
+// Feature oneof field number for a schema kind (0=float32 -> FloatList=2,
+// 1=int64 -> Int64List=3, 2=bytes -> BytesList=1).
+inline uint32_t kind_field(int32_t kind) {
+  return kind == 0 ? 2u : kind == 1 ? 3u : 1u;
+}
+
+// Parse one Feature submessage into the row slot. Returns 0 or error.
+int parse_feature_value(Cursor c, const FeatureSpec& spec, uint8_t* out) {
+  // Feature { BytesList=1, FloatList=2, Int64List=3 } ; each list has
+  // repeated field 1 (packed or not).
+  while (c.p < c.end) {
+    uint64_t tag = c.varint();
+    if (!c.ok) return TFR_PARSE;
+    uint32_t field = tag >> 3, wire = tag & 7;
+    if (wire != 2) { if (!c.skip_field(wire)) return TFR_PARSE; continue; }
+    uint64_t len = c.varint();
+    if (!c.ok || (uint64_t)(c.end - c.p) < len) return TFR_PARSE;
+    Cursor list{c.p, c.p + len};
+    c.p += len;
+
+    if (field != kind_field(spec.kind)) continue;  // not the expected oneof arm
+
+    int64_t count = 0;
+    if (field == 2) {  // FloatList
+      float* dst = reinterpret_cast<float*>(out);
+      while (list.p < list.end) {
+        uint64_t t = list.varint();
+        if (!list.ok) return TFR_PARSE;
+        uint32_t w = t & 7;
+        if (w == 2) {  // packed fixed32s
+          uint64_t n = list.varint();
+          if (!list.ok || n % 4 || (uint64_t)(list.end - list.p) < n) return TFR_PARSE;
+          int64_t k = (int64_t)(n / 4);
+          if (count + k > spec.rowsize) return TFR_SCHEMA;
+          memcpy(dst + count, list.p, n);
+          list.p += n;
+          count += k;
+        } else if (w == 5) {  // unpacked
+          if (list.end - list.p < 4) return TFR_PARSE;
+          if (count + 1 > spec.rowsize) return TFR_SCHEMA;
+          memcpy(dst + count, list.p, 4);
+          list.p += 4;
+          count += 1;
+        } else if (!list.skip_field(w)) {
+          return TFR_PARSE;
+        }
+      }
+    } else if (field == 3) {  // Int64List
+      int64_t* dst = reinterpret_cast<int64_t*>(out);
+      while (list.p < list.end) {
+        uint64_t t = list.varint();
+        if (!list.ok) return TFR_PARSE;
+        uint32_t w = t & 7;
+        if (w == 2) {  // packed varints
+          uint64_t n = list.varint();
+          if (!list.ok || (uint64_t)(list.end - list.p) < n) return TFR_PARSE;
+          Cursor packed{list.p, list.p + n};
+          list.p += n;
+          while (packed.p < packed.end) {
+            uint64_t v = packed.varint();
+            if (!packed.ok) return TFR_PARSE;
+            if (count + 1 > spec.rowsize) return TFR_SCHEMA;
+            dst[count++] = (int64_t)v;
+          }
+        } else if (w == 0) {
+          uint64_t v = list.varint();
+          if (!list.ok) return TFR_PARSE;
+          if (count + 1 > spec.rowsize) return TFR_SCHEMA;
+          dst[count++] = (int64_t)v;
+        } else if (!list.skip_field(w)) {
+          return TFR_PARSE;
+        }
+      }
+    } else if (field == 1) {  // BytesList: first value is the row payload
+      while (list.p < list.end) {
+        uint64_t t = list.varint();
+        if (!list.ok) return TFR_PARSE;
+        if ((t & 7) != 2) { if (!list.skip_field(t & 7)) return TFR_PARSE; continue; }
+        uint64_t n = list.varint();
+        if (!list.ok || (uint64_t)(list.end - list.p) < n) return TFR_PARSE;
+        if ((int64_t)n != spec.rowsize) return TFR_SCHEMA;
+        memcpy(out, list.p, n);
+        list.p += n;
+        count = (int64_t)n;
+        break;
+      }
+    }
+    if (count != spec.rowsize) return TFR_SCHEMA;
+    return 0;
+  }
+  return TFR_SCHEMA;  // expected list arm never appeared
+}
+
+// Parse a serialized tf.train.Example against `schema`; out[i] receives
+// rowsize elements of feature i. All schema features are required.
+int parse_example(const uint8_t* data, int64_t len, const Schema& schema,
+                  uint8_t** out) {
+  Cursor ex{data, data + len};
+  std::vector<bool> seen(schema.feats.size(), false);
+  while (ex.p < ex.end) {
+    uint64_t tag = ex.varint();
+    if (!ex.ok) return TFR_PARSE;
+    if ((tag >> 3) != 1 || (tag & 7) != 2) {  // Example.features
+      if (!ex.skip_field(tag & 7)) return TFR_PARSE;
+      continue;
+    }
+    uint64_t flen = ex.varint();
+    if (!ex.ok || (uint64_t)(ex.end - ex.p) < flen) return TFR_PARSE;
+    Cursor feats{ex.p, ex.p + flen};
+    ex.p += flen;
+    while (feats.p < feats.end) {
+      uint64_t ftag = feats.varint();
+      if (!feats.ok) return TFR_PARSE;
+      if ((ftag >> 3) != 1 || (ftag & 7) != 2) {  // Features.feature map entry
+        if (!feats.skip_field(ftag & 7)) return TFR_PARSE;
+        continue;
+      }
+      uint64_t elen = feats.varint();
+      if (!feats.ok || (uint64_t)(feats.end - feats.p) < elen) return TFR_PARSE;
+      Cursor entry{feats.p, feats.p + elen};
+      feats.p += elen;
+
+      const uint8_t* key = nullptr;
+      uint64_t keylen = 0;
+      const uint8_t* val = nullptr;
+      uint64_t vallen = 0;
+      while (entry.p < entry.end) {
+        uint64_t etag = entry.varint();
+        if (!entry.ok) return TFR_PARSE;
+        uint32_t f = etag >> 3, w = etag & 7;
+        if (w != 2) { if (!entry.skip_field(w)) return TFR_PARSE; continue; }
+        uint64_t n = entry.varint();
+        if (!entry.ok || (uint64_t)(entry.end - entry.p) < n) return TFR_PARSE;
+        if (f == 1) { key = entry.p; keylen = n; }
+        else if (f == 2) { val = entry.p; vallen = n; }
+        entry.p += n;
+      }
+      if (!key || !val) continue;
+      for (size_t i = 0; i < schema.feats.size(); i++) {
+        const FeatureSpec& spec = schema.feats[i];
+        if (spec.name.size() == keylen &&
+            memcmp(spec.name.data(), key, keylen) == 0) {
+          int rc = parse_feature_value(Cursor{val, val + vallen}, spec, out[i]);
+          if (rc) return rc;
+          seen[i] = true;
+          break;
+        }
+      }
+    }
+  }
+  for (bool s : seen)
+    if (!s) return TFR_SCHEMA;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Example encoding (schema-driven, matches what tf.io would produce closely
+// enough: packed FloatList/Int64List, single-bytes BytesList).
+// ---------------------------------------------------------------------------
+
+void put_varint(std::string& s, uint64_t v) {
+  while (v >= 0x80) {
+    s.push_back((char)((v & 0x7f) | 0x80));
+    v >>= 7;
+  }
+  s.push_back((char)v);
+}
+
+void put_len_delim(std::string& s, uint32_t field, const std::string& payload) {
+  put_varint(s, (field << 3) | 2);
+  put_varint(s, payload.size());
+  s += payload;
+}
+
+// Encodes one Example row. bufs[i] points at rowsize elements of feature i.
+std::string encode_example(const Schema& schema, uint8_t* const* bufs) {
+  std::string features;
+  for (size_t i = 0; i < schema.feats.size(); i++) {
+    const FeatureSpec& spec = schema.feats[i];
+    std::string list_payload;  // the repeated-field-1 payload of the list msg
+    if (spec.kind == 0) {
+      put_varint(list_payload, (1u << 3) | 2);
+      put_varint(list_payload, (uint64_t)spec.rowsize * 4);
+      list_payload.append(reinterpret_cast<const char*>(bufs[i]),
+                          spec.rowsize * 4);
+    } else if (spec.kind == 1) {
+      std::string packed;
+      const int64_t* v = reinterpret_cast<const int64_t*>(bufs[i]);
+      for (int64_t k = 0; k < spec.rowsize; k++)
+        put_varint(packed, (uint64_t)v[k]);
+      put_varint(list_payload, (1u << 3) | 2);
+      put_varint(list_payload, packed.size());
+      list_payload += packed;
+    } else {
+      put_varint(list_payload, (1u << 3) | 2);
+      put_varint(list_payload, (uint64_t)spec.rowsize);
+      list_payload.append(reinterpret_cast<const char*>(bufs[i]), spec.rowsize);
+    }
+    std::string feature;  // Feature { <oneof arm>: list }
+    put_len_delim(feature, kind_field(spec.kind), list_payload);
+
+    std::string entry;  // map entry { 1: key, 2: Feature }
+    put_len_delim(entry, 1, spec.name);
+    put_len_delim(entry, 2, feature);
+    put_len_delim(features, 1, entry);
+  }
+  std::string example;  // Example { 1: Features }
+  put_len_delim(example, 1, features);
+  return example;
+}
+
+// ---------------------------------------------------------------------------
+// Threaded prefetching shard reader ("the data-loader")
+// ---------------------------------------------------------------------------
+
+struct Row {
+  // One contiguous allocation per feature, rowsize elements each.
+  std::vector<std::string> cols;
+};
+
+struct Pool {
+  Schema schema;
+  std::vector<std::string> paths;
+  std::atomic<size_t> next_path{0};
+
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::deque<Row> queue;
+  size_t capacity;
+  int active_producers = 0;
+  int error = 0;
+  bool closed = false;
+
+  std::vector<std::thread> threads;
+
+  std::vector<size_t> elem_size;  // bytes per element per feature
+};
+
+void producer_main(Pool* pool) {
+  for (;;) {
+    size_t idx = pool->next_path.fetch_add(1);
+    if (idx >= pool->paths.size()) break;
+    FILE* f = fopen(pool->paths[idx].c_str(), "rb");
+    if (!f) {
+      std::lock_guard<std::mutex> lk(pool->mu);
+      if (!pool->error) pool->error = TFR_IO;
+      break;
+    }
+    std::vector<uint8_t> buf;
+    uint8_t header[12];
+    for (;;) {
+      size_t got = fread(header, 1, 12, f);
+      if (got == 0) break;  // clean EOF
+      int err = 0;
+      uint64_t len = 0;
+      if (got != 12) {
+        err = TFR_CORRUPT;
+      } else {
+        len = get_le64(header);
+        uint32_t len_crc = get_le32(header + 8);
+        if (masked_crc(header, 8) != len_crc) err = TFR_CORRUPT;
+      }
+      if (!err) {
+        buf.resize(len + 4);
+        if (fread(buf.data(), 1, len + 4, f) != len + 4) err = TFR_CORRUPT;
+        else if (masked_crc(buf.data(), len) != get_le32(buf.data() + len))
+          err = TFR_CORRUPT;
+      }
+      Row row;
+      if (!err) {
+        row.cols.resize(pool->schema.feats.size());
+        std::vector<uint8_t*> out(pool->schema.feats.size());
+        for (size_t i = 0; i < pool->schema.feats.size(); i++) {
+          row.cols[i].resize(pool->schema.feats[i].rowsize * pool->elem_size[i]);
+          out[i] = reinterpret_cast<uint8_t*>(&row.cols[i][0]);
+        }
+        err = parse_example(buf.data(), (int64_t)len, pool->schema, out.data());
+      }
+      std::unique_lock<std::mutex> lk(pool->mu);
+      if (err) {
+        if (!pool->error) pool->error = err;
+        pool->cv_pop.notify_all();
+        fclose(f);
+        goto done;
+      }
+      pool->cv_push.wait(lk, [&] {
+        return pool->closed || pool->queue.size() < pool->capacity;
+      });
+      if (pool->closed) { fclose(f); goto done; }
+      pool->queue.push_back(std::move(row));
+      pool->cv_pop.notify_one();
+    }
+    fclose(f);
+  }
+done: {
+    std::lock_guard<std::mutex> lk(pool->mu);
+    pool->active_producers--;
+    pool->cv_pop.notify_all();
+  }
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+uint32_t tfr_crc32c(const uint8_t* data, uint64_t n) { return crc32c(data, n); }
+uint32_t tfr_masked_crc32c(const uint8_t* data, uint64_t n) {
+  return masked_crc(data, n);
+}
+
+// ---- framing writer ----
+
+void* tfr_writer_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  Writer* w = new Writer();
+  w->f = f;
+  return w;
+}
+
+int tfr_writer_write(void* vw, const uint8_t* data, uint64_t len) {
+  Writer* w = static_cast<Writer*>(vw);
+  uint8_t header[12];
+  put_le64(header, len);
+  put_le32(header + 8, masked_crc(header, 8));
+  uint8_t footer[4];
+  put_le32(footer, masked_crc(data, len));
+  if (fwrite(header, 1, 12, w->f) != 12) return TFR_IO;
+  if (len && fwrite(data, 1, len, w->f) != len) return TFR_IO;
+  if (fwrite(footer, 1, 4, w->f) != 4) return TFR_IO;
+  return 0;
+}
+
+int tfr_writer_close(void* vw) {
+  Writer* w = static_cast<Writer*>(vw);
+  int rc = fclose(w->f) ? TFR_IO : 0;
+  delete w;
+  return rc;
+}
+
+// ---- framing reader ----
+
+void* tfr_reader_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  Reader* r = new Reader();
+  r->f = f;
+  return r;
+}
+
+// Returns payload length (>=0) with *out pointing at an internal buffer
+// valid until the next call; TFR_EOF at end; TFR_CORRUPT on bad crc/frame.
+int64_t tfr_reader_next(void* vr, const uint8_t** out) {
+  Reader* r = static_cast<Reader*>(vr);
+  uint8_t header[12];
+  size_t got = fread(header, 1, 12, r->f);
+  if (got == 0) return TFR_EOF;
+  if (got != 12) return TFR_CORRUPT;
+  uint64_t len = get_le64(header);
+  if (masked_crc(header, 8) != get_le32(header + 8)) return TFR_CORRUPT;
+  r->buf.resize(len + 4);
+  if (fread(r->buf.data(), 1, len + 4, r->f) != len + 4) return TFR_CORRUPT;
+  if (masked_crc(r->buf.data(), len) != get_le32(r->buf.data() + len))
+    return TFR_CORRUPT;
+  *out = r->buf.data();
+  return (int64_t)len;
+}
+
+void tfr_reader_close(void* vr) {
+  Reader* r = static_cast<Reader*>(vr);
+  fclose(r->f);
+  delete r;
+}
+
+// ---- schema-driven Example parse/encode (single record) ----
+
+// kinds: 0=float32 (out buffer float32[rowsize]), 1=int64 (int64[rowsize]),
+// 2=bytes (uint8[rowsize]).
+int tfr_parse_example(const uint8_t* data, int64_t len, const char** names,
+                      const int32_t* kinds, const int64_t* rowsizes, int nfeat,
+                      uint8_t** out) {
+  if (nfeat <= 0) return TFR_ARG;
+  Schema schema;
+  for (int i = 0; i < nfeat; i++)
+    schema.feats.push_back({names[i], kinds[i], rowsizes[i]});
+  return parse_example(data, len, schema, out);
+}
+
+// Encodes one Example; returns its length, writing up to bufcap bytes into
+// outbuf. Call with bufcap=0 to size the buffer first.
+int64_t tfr_encode_example(const char** names, const int32_t* kinds,
+                           const int64_t* rowsizes, int nfeat,
+                           uint8_t* const* bufs, uint8_t* outbuf,
+                           int64_t bufcap) {
+  if (nfeat <= 0) return TFR_ARG;
+  Schema schema;
+  for (int i = 0; i < nfeat; i++)
+    schema.feats.push_back({names[i], kinds[i], rowsizes[i]});
+  std::string enc = encode_example(schema, bufs);
+  if ((int64_t)enc.size() <= bufcap)
+    memcpy(outbuf, enc.data(), enc.size());
+  return (int64_t)enc.size();
+}
+
+// ---- threaded prefetch pool ----
+
+void* tfr_pool_open(const char** paths, int npaths, const char** names,
+                    const int32_t* kinds, const int64_t* rowsizes, int nfeat,
+                    int nthreads, int capacity_rows) {
+  if (npaths <= 0 || nfeat <= 0 || nthreads <= 0 || capacity_rows <= 0)
+    return nullptr;
+  Pool* pool = new Pool();
+  for (int i = 0; i < npaths; i++) pool->paths.push_back(paths[i]);
+  for (int i = 0; i < nfeat; i++) {
+    pool->schema.feats.push_back({names[i], kinds[i], rowsizes[i]});
+    pool->elem_size.push_back(kinds[i] == 0 ? 4 : kinds[i] == 1 ? 8 : 1);
+  }
+  pool->capacity = (size_t)capacity_rows;
+  if (nthreads > npaths) nthreads = npaths;
+  pool->active_producers = nthreads;
+  for (int i = 0; i < nthreads; i++)
+    pool->threads.emplace_back(producer_main, pool);
+  return pool;
+}
+
+// Pops up to max_rows decoded rows; bufs[i] must hold
+// max_rows*rowsize*elemsize bytes of feature i, filled row-major. Returns
+// rows delivered (0 once all shards are drained) or a negative error.
+int64_t tfr_pool_next_rows(void* vp, int64_t max_rows, uint8_t** bufs) {
+  Pool* pool = static_cast<Pool*>(vp);
+  int64_t delivered = 0;
+  while (delivered < max_rows) {
+    Row row;
+    {
+      std::unique_lock<std::mutex> lk(pool->mu);
+      pool->cv_pop.wait(lk, [&] {
+        return pool->error || !pool->queue.empty() ||
+               pool->active_producers == 0;
+      });
+      if (pool->error) return pool->error;
+      if (pool->queue.empty()) break;  // drained and producers done
+      row = std::move(pool->queue.front());
+      pool->queue.pop_front();
+      pool->cv_push.notify_one();
+    }
+    for (size_t i = 0; i < row.cols.size(); i++) {
+      memcpy(bufs[i] + (size_t)delivered * row.cols[i].size(),
+             row.cols[i].data(), row.cols[i].size());
+    }
+    delivered++;
+  }
+  return delivered;
+}
+
+void tfr_pool_close(void* vp) {
+  Pool* pool = static_cast<Pool*>(vp);
+  {
+    std::lock_guard<std::mutex> lk(pool->mu);
+    pool->closed = true;
+    pool->cv_push.notify_all();
+  }
+  for (auto& t : pool->threads) t.join();
+  delete pool;
+}
+
+}  // extern "C"

@@ -82,7 +82,9 @@ def run_csv_training(cfg: Config) -> dict:
     model = build_model("mlp", num_classes=num_classes)
     trainer = Trainer(model, TASKS["classification"](), mesh,
                       learning_rate=cfg.learning_rate, fsdp_min_size=cfg.fsdp_min_size)
-    state = trainer.init_state(make_rng(cfg.seed), {"x": Xt[:1], "y": yt[:1]})
+    # Unsliced host-shard arrays as the init sample: shape-only tracing, and
+    # the trainer trims to exactly one row per data shard itself.
+    state = trainer.init_state(make_rng(cfg.seed), {"x": Xt, "y": yt})
 
     ckpt = CheckpointManager(os.path.join(cfg.output_dir, "checkpoints"),
                              every_steps=cfg.checkpoint_every_steps)
@@ -138,7 +140,7 @@ def run_image_training(cfg: Config) -> dict:
     trainer = Trainer(model, TASKS["regression"](), mesh,
                       learning_rate=cfg.learning_rate, fsdp_min_size=cfg.fsdp_min_size)
     state = trainer.init_state(
-        make_rng(cfg.seed), {"image": images_t[:1], "target": targets_t[:1]}
+        make_rng(cfg.seed), {"image": images_t, "target": targets_t}
     )
 
     ckpt = CheckpointManager(os.path.join(cfg.output_dir, "checkpoints"),

@@ -1,0 +1,237 @@
+"""Native C++ TFRecord IO plane tests.
+
+Covers the codec against two independent oracles: the pure-Python codec
+(always) and TensorFlow's own writer/parser (the authority on the format,
+same role as the reference's tf.data path — train_tf_ps.py:301-322).
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from pyspark_tf_gke_tpu.data import codec
+from pyspark_tf_gke_tpu.data.tfrecord import schema_for
+
+native = pytest.importorskip("pyspark_tf_gke_tpu.native")
+
+NATIVE_OK = native.available()
+needs_native = pytest.mark.skipif(
+    not NATIVE_OK, reason=f"native build unavailable: {native.load_error()}"
+)
+
+SCHEMA = {"x": ("float", (3,)), "y": ("int", (2,)), "img": ("bytes", (2, 2))}
+
+
+def _row(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "x": rng.normal(size=3).astype(np.float32),
+        "y": rng.integers(-5, 5, size=2).astype(np.int64),
+        "img": rng.integers(0, 256, size=(2, 2)).astype(np.uint8),
+    }
+
+
+def _assert_rows_equal(a, b):
+    for k in SCHEMA:
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+class TestPurePythonCodec:
+    def test_example_roundtrip(self):
+        row = _row()
+        rec = codec.encode_example(SCHEMA, row)
+        _assert_rows_equal(codec.parse_example(SCHEMA, rec), row)
+
+    def test_record_framing_roundtrip(self, tmp_path):
+        payloads = [b"alpha", b"", b"x" * 10_000]
+        p = tmp_path / "f.tfrecord"
+        with open(p, "wb") as f:
+            for pl in payloads:
+                f.write(codec.encode_record(pl))
+        assert list(codec.iter_records(str(p))) == payloads
+
+    def test_corruption_detected(self, tmp_path):
+        p = tmp_path / "bad.tfrecord"
+        data = bytearray(codec.encode_record(b"hello records"))
+        data[-6] ^= 0xFF  # flip a payload byte
+        p.write_bytes(bytes(data))
+        with pytest.raises(ValueError, match="CRC"):
+            list(codec.iter_records(str(p)))
+
+    def test_crc32c_known_vectors(self):
+        # Standard CRC32C check vectors (RFC 3720 / kernel test vectors).
+        assert codec.crc32c(b"123456789") == 0xE3069283
+        assert codec.crc32c(b"") == 0
+
+
+@needs_native
+class TestNativeCodec:
+    def test_roundtrip_and_python_parity(self):
+        row = _row(1)
+        rec_n = native.encode_example(SCHEMA, row)
+        rec_p = codec.encode_example(SCHEMA, row)
+        _assert_rows_equal(native.parse_example(SCHEMA, rec_n), row)
+        _assert_rows_equal(native.parse_example(SCHEMA, rec_p), row)
+        _assert_rows_equal(codec.parse_example(SCHEMA, rec_n), row)
+
+    def test_crc_parity_with_python(self):
+        for payload in [b"", b"a", b"123456789", os.urandom(1000)]:
+            assert native.crc32c(payload) == codec.crc32c(payload)
+            assert native.masked_crc32c(payload) == codec.masked_crc32c(payload)
+
+    def test_framing_interop_with_python(self, tmp_path):
+        row = _row(2)
+        rec = native.encode_example(SCHEMA, row)
+        p = str(tmp_path / "n.tfrecord")
+        with native.RecordWriter(p) as w:
+            for _ in range(3):
+                w.write(rec)
+        assert list(codec.iter_records(p)) == [rec] * 3
+        with native.RecordReader(p) as r:
+            assert list(r) == [rec] * 3
+
+    def test_corrupt_record_raises(self, tmp_path):
+        p = str(tmp_path / "bad.tfrecord")
+        data = bytearray(codec.encode_record(b"payload payload"))
+        data[-6] ^= 0xFF
+        (tmp_path / "bad.tfrecord").write_bytes(bytes(data))
+        with native.RecordReader(p) as r:
+            with pytest.raises(native.NativeIOError, match="corrupt"):
+                list(r)
+
+    def test_missing_feature_is_schema_error(self):
+        rec = native.encode_example({"x": SCHEMA["x"]}, {"x": _row()["x"]})
+        with pytest.raises(native.NativeIOError, match="schema"):
+            native.parse_example(SCHEMA, rec)
+
+
+@needs_native
+class TestNativeTFInterop:
+    """The authoritative oracle: TF wrote the format we claim to speak."""
+
+    def test_parse_tf_serialized_example(self):
+        tf = pytest.importorskip("tensorflow")
+        row = _row(3)
+        feats = {
+            "x": tf.train.Feature(float_list=tf.train.FloatList(value=row["x"])),
+            "y": tf.train.Feature(int64_list=tf.train.Int64List(value=row["y"])),
+            "img": tf.train.Feature(
+                bytes_list=tf.train.BytesList(value=[row["img"].tobytes()])
+            ),
+        }
+        rec = tf.train.Example(
+            features=tf.train.Features(feature=feats)
+        ).SerializeToString()
+        _assert_rows_equal(native.parse_example(SCHEMA, rec), row)
+
+    def test_tf_reads_native_file(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        row = _row(4)
+        rec = native.encode_example(SCHEMA, row)
+        p = str(tmp_path / "n.tfrecord")
+        with native.RecordWriter(p) as w:
+            w.write(rec)
+        got = [bytes(r.numpy()) for r in tf.data.TFRecordDataset(p)]
+        assert got == [rec]
+        ex = tf.train.Example()
+        ex.ParseFromString(rec)
+        np.testing.assert_allclose(
+            list(ex.features.feature["x"].float_list.value), row["x"], rtol=1e-6
+        )
+
+
+@needs_native
+class TestExamplePool:
+    def _write(self, tmp_path, n=200, shards=5):
+        rng = np.random.default_rng(0)
+        arrays = {
+            "x": rng.normal(size=(n, 4)).astype(np.float32),
+            "key": np.arange(n, dtype=np.int64),
+        }
+        from pyspark_tf_gke_tpu.data.native_tfrecord import write_tfrecord_shards
+
+        paths = write_tfrecord_shards(arrays, str(tmp_path / "d"), num_shards=shards)
+        return arrays, paths, schema_for(arrays)
+
+    def test_pool_delivers_every_row_exactly_once(self, tmp_path):
+        arrays, paths, schema = self._write(tmp_path)
+        with native.ExamplePool(paths, schema, nthreads=3, capacity_rows=32) as pool:
+            keys, xs = [], []
+            while True:
+                block = pool.next_rows(33)
+                if block is None:
+                    break
+                keys.append(block["key"])
+                xs.append(block["x"])
+        keys = np.concatenate(keys)
+        xs = np.concatenate(xs)
+        assert sorted(keys.tolist()) == list(range(len(arrays["key"])))
+        np.testing.assert_array_equal(xs[np.argsort(keys)], arrays["x"])
+
+    def test_single_thread_preserves_file_order(self, tmp_path):
+        arrays, paths, schema = self._write(tmp_path, n=50, shards=1)
+        with native.ExamplePool(paths, schema, nthreads=1) as pool:
+            block = pool.next_rows(50)
+        np.testing.assert_array_equal(block["key"], arrays["key"])
+
+
+@needs_native
+class TestNativeBatchReader:
+    def _write(self, tmp_path, n=300):
+        rng = np.random.default_rng(1)
+        arrays = {
+            "x": rng.normal(size=(n, 3)).astype(np.float32),
+            "label": rng.integers(0, 7, size=(n,)).astype(np.int64),
+            "key": np.arange(n, dtype=np.int64),
+        }
+        from pyspark_tf_gke_tpu.data.native_tfrecord import write_tfrecord_shards
+
+        write_tfrecord_shards(arrays, str(tmp_path / "d"), num_shards=4)
+        return arrays, str(tmp_path / "d-*"), schema_for(arrays)
+
+    def test_single_pass_no_shuffle(self, tmp_path):
+        from pyspark_tf_gke_tpu.data.native_tfrecord import read_tfrecord_batches
+
+        arrays, pattern, schema = self._write(tmp_path)
+        batches = list(
+            read_tfrecord_batches(
+                pattern, schema, batch_size=32, shuffle=False, repeat=False,
+                process_index=0, process_count=1, nthreads=1,
+            )
+        )
+        assert all(b["x"].shape == (32, 3) for b in batches)
+        assert batches[0]["label"].dtype == np.int32  # int features cast, tf parity
+        keys = np.concatenate([b["key"] for b in batches])
+        assert len(keys) == (300 // 32) * 32  # drop_remainder
+        assert len(set(keys.tolist())) == len(keys)
+
+    def test_shuffle_changes_order_not_content(self, tmp_path):
+        from pyspark_tf_gke_tpu.data.native_tfrecord import read_tfrecord_batches
+
+        arrays, pattern, schema = self._write(tmp_path)
+        it = read_tfrecord_batches(
+            pattern, schema, batch_size=30, shuffle=True, repeat=True,
+            seed=7, process_index=0, process_count=1, nthreads=2,
+        )
+        first = next(it)["key"]
+        assert not np.array_equal(first, np.arange(30))
+        assert set(first.tolist()) <= set(range(300))
+
+    def test_host_sharding_disjoint(self, tmp_path):
+        from pyspark_tf_gke_tpu.data.native_tfrecord import read_tfrecord_batches
+
+        arrays, pattern, schema = self._write(tmp_path)
+
+        def keys_of(idx, count):
+            bs = list(
+                read_tfrecord_batches(
+                    pattern, schema, 10, shuffle=False, repeat=False,
+                    process_index=idx, process_count=count, nthreads=1,
+                )
+            )
+            return set(np.concatenate([b["key"] for b in bs]).tolist())
+
+        k0, k1 = keys_of(0, 2), keys_of(1, 2)
+        assert not (k0 & k1)
