@@ -2,6 +2,7 @@ from pyspark_tf_gke_tpu.models.mlp import MLPClassifier
 from pyspark_tf_gke_tpu.models.cnn import CNNRegressor, PReLU
 from pyspark_tf_gke_tpu.models.resnet import ResNet50
 from pyspark_tf_gke_tpu.models.bert import BertConfig, BertEncoder, BertForPretraining
+from pyspark_tf_gke_tpu.models.pipelined_bert import PipelinedBertClassifier
 
 __all__ = [
     "MLPClassifier",
@@ -11,6 +12,7 @@ __all__ = [
     "BertConfig",
     "BertEncoder",
     "BertForPretraining",
+    "PipelinedBertClassifier",
     "build_model",
 ]
 

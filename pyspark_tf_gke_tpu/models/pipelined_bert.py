@@ -1,0 +1,224 @@
+"""Pipeline-parallel transformer classifier.
+
+The pipelined sibling of :class:`~pyspark_tf_gke_tpu.models.bert.BertForPretraining`
+for meshes with a ``pp`` axis: the encoder's layer stack is *stage-stacked*
+(params carry a leading ``[n_stages, layers_per_stage, ...]`` shape, the
+stage dim sharded over ``pp``) and executed with the GPipe schedule in
+:mod:`pyspark_tf_gke_tpu.parallel.pipeline`.
+
+Written functionally (pure param pytree + jnp ops) rather than as a linen
+module: the stage body runs inside ``shard_map``, where linen's logical
+sharding constraints are illegal, and the stage-stacking is a property of
+the *parameter layout*, which is clearer built explicitly. The class
+exposes the linen ``init``/``apply`` surface so the generic
+:class:`~pyspark_tf_gke_tpu.train.trainer.Trainer` drives it unchanged
+(task ``bert_classification``).
+
+No counterpart in the reference (it has no attention models — SURVEY §2b);
+parity target is BASELINE.json config 5 scaled past single-chip memory.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax import lax
+from jax.sharding import Mesh
+
+from pyspark_tf_gke_tpu.models.bert import BertConfig
+from pyspark_tf_gke_tpu.parallel.pipeline import (
+    merge_stages,
+    pipeline_apply,
+    split_stages,
+)
+
+NEG_INF = -1e30
+
+
+def _layernorm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+def _layer_apply(p: Dict[str, jnp.ndarray], h: jnp.ndarray, bias: jnp.ndarray,
+                 cfg: BertConfig) -> jnp.ndarray:
+    """One post-LN encoder layer, device-local. ``bias``: [mb, S] additive
+    attention bias (0 = attend, NEG_INF = masked)."""
+    mb, s, H = h.shape
+    nh, d = cfg.num_heads, cfg.head_dim
+    dt = h.dtype
+
+    q = (h @ p["q_kernel"].astype(dt) + p["q_bias"].astype(dt)).reshape(mb, s, nh, d)
+    k = (h @ p["k_kernel"].astype(dt) + p["k_bias"].astype(dt)).reshape(mb, s, nh, d)
+    v = (h @ p["v_kernel"].astype(dt) + p["v_bias"].astype(dt)).reshape(mb, s, nh, d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
+    scores = scores + bias[:, None, None, :]
+    probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(mb, s, H)
+    attn = attn @ p["o_kernel"].astype(dt) + p["o_bias"].astype(dt)
+    h = _layernorm(h + attn, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_eps)
+
+    mlp = h @ p["mlp_in_kernel"].astype(dt) + p["mlp_in_bias"].astype(dt)
+    mlp = nn.gelu(mlp, approximate=True)
+    mlp = mlp @ p["mlp_out_kernel"].astype(dt) + p["mlp_out_bias"].astype(dt)
+    return _layernorm(h + mlp, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_eps)
+
+
+class PipelinedBertClassifier:
+    """Stage-stacked encoder + pooled classifier head.
+
+    ``num_microbatches`` must divide the per-data-shard batch; defaults to
+    ``2 * n_stages`` (bubble fraction ``(P-1)/(3P-1)`` ≈ 1/3 worst case,
+    shrinking with larger M).
+    """
+
+    def __init__(
+        self,
+        cfg: BertConfig,
+        mesh: Mesh,
+        num_labels: int = 2,
+        num_microbatches: Optional[int] = None,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.num_labels = num_labels
+        self.n_stages = mesh.shape.get("pp", 1)
+        if cfg.num_layers % self.n_stages:
+            raise ValueError(
+                f"{cfg.num_layers} layers not divisible into {self.n_stages} pp stages"
+            )
+        self.num_microbatches = num_microbatches or 2 * self.n_stages
+
+    # ---- params -------------------------------------------------------------
+
+    def init(self, rng: jax.Array, input_ids, attention_mask=None,
+             token_type_ids=None) -> Dict[str, Any]:
+        cfg = self.cfg
+        H, I, V = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+        L = cfg.num_layers
+        keys = iter(jax.random.split(rng, 16))
+
+        def normal(key, shape):
+            return jax.random.normal(key, shape, jnp.float32) * 0.02
+
+        def boxed(value, *names):
+            return nn.Partitioned(value, names=names)
+
+        lk = jax.random.split(next(keys), 7)
+        layer_shapes = {
+            "q_kernel": (lk[0], (L, H, H)), "k_kernel": (lk[1], (L, H, H)),
+            "v_kernel": (lk[2], (L, H, H)), "o_kernel": (lk[3], (L, H, H)),
+            "mlp_in_kernel": (lk[4], (L, H, I)), "mlp_out_kernel": (lk[5], (L, I, H)),
+        }
+        layers: Dict[str, Any] = {
+            name: normal(key, shape) for name, (key, shape) in layer_shapes.items()
+        }
+        layers.update(
+            q_bias=jnp.zeros((L, H)), k_bias=jnp.zeros((L, H)),
+            v_bias=jnp.zeros((L, H)), o_bias=jnp.zeros((L, H)),
+            mlp_in_bias=jnp.zeros((L, I)), mlp_out_bias=jnp.zeros((L, H)),
+            ln1_scale=jnp.ones((L, H)), ln1_bias=jnp.zeros((L, H)),
+            ln2_scale=jnp.ones((L, H)), ln2_bias=jnp.zeros((L, H)),
+        )
+        layers = split_stages(layers, self.n_stages)
+        layers = jax.tree.map(
+            lambda a: boxed(a, "stage", "layers", *([None] * (a.ndim - 2))), layers
+        )
+
+        params = {
+            "embed": {
+                "word": boxed(normal(next(keys), (V, H)), "vocab", "embed"),
+                "pos": boxed(
+                    normal(next(keys), (cfg.max_position_embeddings, H)), None, "embed"
+                ),
+                "type": boxed(
+                    normal(next(keys), (cfg.type_vocab_size, H)), None, "embed"
+                ),
+                "ln_scale": boxed(jnp.ones((H,)), "norm"),
+                "ln_bias": boxed(jnp.zeros((H,)), "norm"),
+            },
+            "layers": layers,
+            "head": {
+                "pooler_kernel": boxed(normal(next(keys), (H, H)), "embed", "embed_out"),
+                "pooler_bias": boxed(jnp.zeros((H,)), "embed_out"),
+                "cls_kernel": boxed(normal(next(keys), (H, self.num_labels)), "embed", None),
+                "cls_bias": boxed(jnp.zeros((self.num_labels,)), None),
+            },
+        }
+        return {"params": params}
+
+    # ---- forward ------------------------------------------------------------
+
+    def _embed(self, p, input_ids, token_type_ids=None):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        hidden = (
+            p["embed"]["word"][input_ids]
+            + p["embed"]["pos"][:s][None]
+            + p["embed"]["type"][token_type_ids]
+        )
+        hidden = _layernorm(
+            hidden, p["embed"]["ln_scale"], p["embed"]["ln_bias"], cfg.layer_norm_eps
+        )
+        return hidden.astype(cfg.dtype)
+
+    def _head(self, p, hidden):
+        pooled = jnp.tanh(
+            hidden[:, 0].astype(jnp.float32) @ p["head"]["pooler_kernel"]
+            + p["head"]["pooler_bias"]
+        )
+        logits = pooled @ p["head"]["cls_kernel"] + p["head"]["cls_bias"]
+        return {"cls_logits": logits.astype(jnp.float32)}
+
+    def _bias(self, input_ids, attention_mask):
+        b, s = input_ids.shape
+        if attention_mask is None:
+            return jnp.zeros((b, s), jnp.float32)
+        return jnp.where(attention_mask.astype(bool), 0.0, NEG_INF).astype(jnp.float32)
+
+    def apply(self, variables: Dict[str, Any], input_ids, attention_mask=None,
+              token_type_ids=None) -> Dict[str, jnp.ndarray]:
+        p = nn.meta.unbox(variables["params"])
+        cfg = self.cfg
+        hidden = self._embed(p, input_ids, token_type_ids)
+        bias = self._bias(input_ids, attention_mask)
+
+        def stage_fn(stage_p, h, extras):
+            def one_layer(h, lp):
+                return _layer_apply(lp, h, extras["bias"], cfg), None
+
+            h, _ = lax.scan(one_layer, h, stage_p)
+            return h
+
+        hidden = pipeline_apply(
+            stage_fn, p["layers"], hidden, {"bias": bias}, self.mesh,
+            num_microbatches=self.num_microbatches,
+        )
+        return self._head(p, hidden)
+
+    def apply_sequential(self, variables: Dict[str, Any], input_ids,
+                         attention_mask=None,
+                         token_type_ids=None) -> Dict[str, jnp.ndarray]:
+        """Oracle path: same params, plain layer loop, no mesh/pipeline —
+        the parity reference for tests."""
+        p = nn.meta.unbox(variables["params"])
+        hidden = self._embed(p, input_ids, token_type_ids)
+        bias = self._bias(input_ids, attention_mask)
+        flat = merge_stages(p["layers"])
+        for i in range(self.cfg.num_layers):
+            lp = jax.tree.map(lambda a: a[i], flat)
+            hidden = _layer_apply(lp, hidden, bias, self.cfg)
+        return self._head(p, hidden)
+
+    def parameter_count(self, variables) -> int:
+        return int(sum(np.prod(l.shape) for l in jax.tree.leaves(
+            nn.meta.unbox(variables["params"]))))

@@ -45,6 +45,7 @@ LOGICAL_RULES = (
     ("mlp", "tp"),
     ("vocab", "tp"),
     ("expert", "ep"),
+    ("stage", "pp"),
     ("layers", None),
     ("norm", None),
 )
