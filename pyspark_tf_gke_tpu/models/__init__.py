@@ -3,6 +3,7 @@ from pyspark_tf_gke_tpu.models.cnn import CNNRegressor, PReLU
 from pyspark_tf_gke_tpu.models.resnet import ResNet50
 from pyspark_tf_gke_tpu.models.bert import BertConfig, BertEncoder, BertForPretraining
 from pyspark_tf_gke_tpu.models.pipelined_bert import PipelinedBertClassifier
+from pyspark_tf_gke_tpu.models.moe import MoELayer
 
 __all__ = [
     "MLPClassifier",
@@ -13,6 +14,7 @@ __all__ = [
     "BertEncoder",
     "BertForPretraining",
     "PipelinedBertClassifier",
+    "MoELayer",
     "build_model",
 ]
 

@@ -40,6 +40,12 @@ class BertConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False
     use_flash: bool = False  # Pallas flash-attention kernel (TPU; sp=1 only)
+    # Mixture-of-Experts: num_experts > 0 replaces the dense FFN of every
+    # ``moe_every``-th layer with an expert-parallel MoELayer (models/moe.py).
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 2
+    capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -107,17 +113,32 @@ class BertSelfAttention(nn.Module):
 class BertLayer(nn.Module):
     cfg: BertConfig
     mesh: Optional[Mesh] = None
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, hidden, mask):
         cfg = self.cfg
         attn_out = BertSelfAttention(cfg, self.mesh, name="attention")(hidden, mask)
         hidden = _layernorm(cfg, name="ln_attn")(hidden + attn_out)
-        mlp = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg, name="mlp_in")(hidden)
-        mlp = nn.gelu(mlp, approximate=True)
-        mlp = _dense(cfg.hidden_size, ("mlp", "embed"), cfg, name="mlp_out")(mlp)
+        if self.use_moe:
+            from pyspark_tf_gke_tpu.models.moe import MoELayer
+
+            mlp, aux = MoELayer(
+                num_experts=cfg.num_experts,
+                hidden_size=cfg.hidden_size,
+                intermediate_size=cfg.intermediate_size,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.capacity_factor,
+                dtype=cfg.dtype,
+                name="moe",
+            )(hidden)
+        else:
+            mlp = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg, name="mlp_in")(hidden)
+            mlp = nn.gelu(mlp, approximate=True)
+            mlp = _dense(cfg.hidden_size, ("mlp", "embed"), cfg, name="mlp_out")(mlp)
+            aux = jnp.zeros((), jnp.float32)
         hidden = _layernorm(cfg, name="ln_mlp")(hidden + mlp)
-        return nn.with_logical_constraint(hidden, ("batch", "seq", "embed"))
+        return nn.with_logical_constraint(hidden, ("batch", "seq", "embed")), aux
 
 
 class BertEncoder(nn.Module):
@@ -161,9 +182,14 @@ class BertEncoder(nn.Module):
         layer_cls = BertLayer
         if cfg.remat:
             layer_cls = nn.remat(BertLayer, static_argnums=())
+        aux_total = jnp.zeros((), jnp.float32)
         for i in range(cfg.num_layers):
-            hidden = layer_cls(cfg, self.mesh, name=f"layer_{i}")(hidden, attention_mask)
-        return hidden
+            use_moe = cfg.num_experts > 0 and (i + 1) % cfg.moe_every == 0
+            hidden, aux = layer_cls(cfg, self.mesh, use_moe, name=f"layer_{i}")(
+                hidden, attention_mask
+            )
+            aux_total = aux_total + aux
+        return hidden, aux_total
 
 
 class BertForPretraining(nn.Module):
@@ -177,7 +203,7 @@ class BertForPretraining(nn.Module):
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
         cfg = self.cfg
-        hidden = BertEncoder(cfg, self.mesh, name="encoder")(
+        hidden, aux_loss = BertEncoder(cfg, self.mesh, name="encoder")(
             input_ids, token_type_ids, attention_mask
         )
         mlm = _dense(cfg.hidden_size, ("embed", "embed_out"), cfg, name="mlm_transform")(hidden)
@@ -191,4 +217,5 @@ class BertForPretraining(nn.Module):
         return {
             "mlm_logits": mlm_logits.astype(jnp.float32),
             "cls_logits": cls_logits.astype(jnp.float32),
+            "aux_loss": aux_loss,
         }

@@ -1,0 +1,135 @@
+"""Mixture-of-Experts FFN with expert parallelism over the ``ep`` axis.
+
+Absent from the reference (SURVEY §2b: expert parallelism "absent"), but a
+first-class scale axis here. Designed for the MXU + pjit, GShard/Switch
+style:
+
+* **Dense dispatch, static shapes**: routing is expressed as einsums with
+  a ``[B, S, E, C]`` one-hot dispatch tensor (capacity ``C`` per expert per
+  batch group) — no gathers, no dynamic shapes, so XLA tiles everything
+  onto the MXU and inserts the token all-to-alls implied by the sharding
+  annotations.
+* **Expert parallelism via logical annotation**: expert-stacked weights
+  carry the ``expert`` logical axis (→ ``ep`` mesh axis,
+  ``parallel.sharding.LOGICAL_RULES``); the dispatched activation tensor
+  is constrained to ``("expert", ...)`` so tokens physically travel to
+  their expert's chip over ICI (XLA all-to-all), compute locally, and
+  travel back — composing freely with dp/fsdp/tp.
+* **Top-k routing (k=1 Switch, k=2 GShard)** with softmax gates, capacity
+  dropping (overflow tokens fall through the residual), and the
+  load-balance auxiliary loss ``E * Σ_e f_e · p_e``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoELayer(nn.Module):
+    """Expert-parallel FFN block: ``x -> combine(expert_ffn(dispatch(x)))``.
+
+    Shape-preserving on ``[B, S, H]``; returns ``(out, aux_loss)``.
+    """
+
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        b, s, h = x.shape
+        E, k = self.num_experts, self.top_k
+        # Per-(batch-row) expert capacity; ≥1 so tiny test shapes route.
+        C = max(1, int(self.capacity_factor * k * s / E))
+
+        router = self.param(
+            "router",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("embed", "expert")
+            ),
+            (h, E), jnp.float32,
+        )
+        w_in = self.param(
+            "w_in",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("expert", "embed", "mlp")
+            ),
+            (E, h, self.intermediate_size), jnp.float32,
+        )
+        b_in = self.param(
+            "b_in",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("expert", "mlp")),
+            (E, self.intermediate_size), jnp.float32,
+        )
+        w_out = self.param(
+            "w_out",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("expert", "mlp", "embed")
+            ),
+            (E, self.intermediate_size, h), jnp.float32,
+        )
+        b_out = self.param(
+            "b_out",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("expert", "embed")),
+            (E, h), jnp.float32,
+        )
+
+        # ---- routing (float32 throughout) --------------------------------
+        gates = jax.nn.softmax(
+            x.astype(jnp.float32) @ router, axis=-1
+        )  # [B,S,E]
+
+        dispatch = jnp.zeros((b, s, E, C), jnp.float32)
+        combine = jnp.zeros((b, s, E, C), jnp.float32)
+        remaining = gates
+        # Track how many slots each expert has used per batch row as the
+        # k routing rounds claim positions.
+        used = jnp.zeros((b, E), jnp.float32)
+        top1_mask = None
+        for _ in range(k):
+            idx = jnp.argmax(remaining, axis=-1)  # [B,S]
+            mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [B,S,E]
+            # Queue position of each token at its chosen expert this round.
+            pos = jnp.cumsum(mask, axis=1) * mask - mask + used[:, None, :]  # [B,S,E]
+            keep = mask * (pos < C)  # overflow tokens dropped
+            pos_c = jax.nn.one_hot(
+                jnp.sum(pos * keep, axis=-1).astype(jnp.int32), C, dtype=jnp.float32
+            )  # [B,S,C]
+            slot = keep[..., None] * pos_c[:, :, None, :]  # [B,S,E,C]
+            gate_k = jnp.sum(remaining * keep, axis=-1, keepdims=True)  # [B,S,1]
+            dispatch = dispatch + slot
+            combine = combine + slot * gate_k[..., None]
+            used = used + jnp.sum(keep, axis=1)
+            if top1_mask is None:
+                top1_mask = mask
+            remaining = remaining * (1.0 - mask)
+
+        # Normalize combine weights over the k selected experts.
+        denom = jnp.sum(combine, axis=(2, 3), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+
+        # Switch-style load-balance aux loss: E * Σ_e fraction_e · prob_e.
+        frac = jnp.mean(top1_mask, axis=(0, 1))  # [E]
+        prob = jnp.mean(gates, axis=(0, 1))  # [E]
+        aux_loss = E * jnp.sum(frac * prob)
+
+        # ---- dispatch → expert FFN → combine -----------------------------
+        xe = jnp.einsum("bsec,bsh->ebch", dispatch.astype(self.dtype),
+                        x.astype(self.dtype))
+        xe = nn.with_logical_constraint(xe, ("expert", "batch", None, "embed"))
+        hmid = jnp.einsum("ebch,ehi->ebci", xe, w_in.astype(self.dtype))
+        hmid = nn.gelu(hmid + b_in[:, None, None, :].astype(self.dtype),
+                       approximate=True)
+        hmid = nn.with_logical_constraint(hmid, ("expert", "batch", None, "mlp"))
+        ye = jnp.einsum("ebci,eih->ebch", hmid, w_out.astype(self.dtype))
+        ye = ye + b_out[:, None, None, :].astype(self.dtype)
+        ye = nn.with_logical_constraint(ye, ("expert", "batch", None, "embed"))
+        out = jnp.einsum("bsec,ebch->bsh", combine.astype(self.dtype), ye)
+        return out.astype(x.dtype), aux_loss.astype(jnp.float32)

@@ -94,6 +94,17 @@ class PipelinedBertClassifier:
             raise ValueError(
                 f"{cfg.num_layers} layers not divisible into {self.n_stages} pp stages"
             )
+        unsupported = [
+            name for name, on in
+            (("num_experts", cfg.num_experts), ("use_flash", cfg.use_flash),
+             ("remat", cfg.remat))
+            if on
+        ]
+        if unsupported:
+            raise ValueError(
+                f"PipelinedBertClassifier does not support BertConfig "
+                f"{unsupported}; use BertForPretraining for those, or a pp=1 mesh."
+            )
         self.num_microbatches = num_microbatches or 2 * self.n_stages
 
     # ---- params -------------------------------------------------------------

@@ -47,6 +47,9 @@ from pyspark_tf_gke_tpu.utils.logging import get_logger
 
 logger = get_logger("train.trainer")
 
+# Weight on the MoE load-balance auxiliary loss (Switch Transformer's 1e-2).
+MOE_AUX_WEIGHT = 0.01
+
 
 @dataclasses.dataclass(frozen=True)
 class TrainerTask:
@@ -115,7 +118,13 @@ def bert_classification_task() -> TrainerTask:
     def lam(preds, batch):
         logits = preds["cls_logits"]
         loss = softmax_cross_entropy(logits, batch["labels"])
-        return loss, {"loss": loss, "accuracy": accuracy_metric(logits, batch["labels"])}
+        metrics = {"loss": loss, "accuracy": accuracy_metric(logits, batch["labels"])}
+        aux = preds.get("aux_loss") if isinstance(preds, dict) else None
+        if aux is not None:
+            # MoE load-balance loss (models/moe.py); 0 for dense configs.
+            loss = loss + MOE_AUX_WEIGHT * aux
+            metrics["moe_aux_loss"] = aux
+        return loss, metrics
 
     return TrainerTask("bert_classification", forward, lam)
 
