@@ -9,7 +9,8 @@ designed TPU-first:
   (``parallel.sharding.LOGICAL_RULES``) places the model on any mesh:
   ``tp`` shards heads and MLP width, ``fsdp`` shards the embed dim,
   ``sp`` shards the sequence dimension of activations;
-* attention dispatches to ``ops.ring_attention`` when the mesh has an
+* attention dispatches to ``ops.ring_attention`` (default) or
+  ``ops.ulysses_attention`` (``sp_impl="ulysses"``) when the mesh has an
   ``sp`` axis > 1 — long-context sequence parallelism over ICI — and to
   plain MXU attention otherwise;
 * bfloat16 compute, float32 params and softmax accumulation.
@@ -24,7 +25,11 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from pyspark_tf_gke_tpu.ops.attention import dot_product_attention, ring_attention
+from pyspark_tf_gke_tpu.ops.attention import (
+    dot_product_attention,
+    ring_attention,
+    ulysses_attention,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,12 +45,22 @@ class BertConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False
     use_flash: bool = False  # Pallas flash-attention kernel (TPU; sp=1 only)
+    # Sequence-parallel implementation when the mesh has sp>1:
+    # "ring" (ppermute ring, unbounded S) or "ulysses" (all-to-all,
+    # needs heads divisible by sp; cheaper at moderate S).
+    sp_impl: str = "ring"
     # Mixture-of-Experts: num_experts > 0 replaces the dense FFN of every
     # ``moe_every``-th layer with an expert-parallel MoELayer (models/moe.py).
     num_experts: int = 0
     moe_top_k: int = 2
     moe_every: int = 2
     capacity_factor: float = 1.25
+
+    def __post_init__(self):
+        if self.sp_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sp_impl must be 'ring' or 'ulysses', got {self.sp_impl!r}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -96,9 +111,10 @@ class BertSelfAttention(nn.Module):
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"))
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"))
 
-        use_ring = self.mesh is not None and self.mesh.shape.get("sp", 1) > 1
-        if use_ring:
-            out = ring_attention(q, k, v, self.mesh, kv_mask=mask, axis="sp")
+        use_sp = self.mesh is not None and self.mesh.shape.get("sp", 1) > 1
+        if use_sp:
+            sp_fn = ulysses_attention if cfg.sp_impl == "ulysses" else ring_attention
+            out = sp_fn(q, k, v, self.mesh, kv_mask=mask, axis="sp")
         elif cfg.use_flash:
             from pyspark_tf_gke_tpu.ops.pallas.flash_attention import flash_attention
 

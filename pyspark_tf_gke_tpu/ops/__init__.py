@@ -1,3 +1,7 @@
-from pyspark_tf_gke_tpu.ops.attention import dot_product_attention, ring_attention
+from pyspark_tf_gke_tpu.ops.attention import (
+    dot_product_attention,
+    ring_attention,
+    ulysses_attention,
+)
 
-__all__ = ["dot_product_attention", "ring_attention"]
+__all__ = ["dot_product_attention", "ring_attention", "ulysses_attention"]

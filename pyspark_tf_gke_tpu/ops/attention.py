@@ -13,6 +13,12 @@ framework, so two implementations live here:
   S×S score matrix never materializes and sequence length scales with the
   number of devices. Pattern follows the public ring-attention recipe
   (blockwise attention + ring P2P), re-derived for shard_map.
+* ``ulysses_attention`` — the all-to-all alternative (DeepSpeed-Ulysses
+  pattern): two ``lax.all_to_all``s swap the sequence sharding for a
+  *head* sharding, full attention runs locally on ``H/sp`` heads, and a
+  final all-to-all restores sequence sharding. Cheaper than the ring when
+  ``sp`` ≤ num_heads and the interconnect does fast all-to-all (ICI);
+  the ring wins when S is huge (it never holds the full S per device).
 """
 
 from __future__ import annotations
@@ -105,6 +111,27 @@ def _ring_block(q, k, v, kv_mask, axis_name: str, axis_size: int, causal: bool):
     return out.astype(q.dtype)
 
 
+def _sp_shard_map(body, mesh: Mesh, axis: str, kv_mask):
+    """Shared shard_map scaffolding for the sequence-parallel attention
+    variants: Q/K/V sharded [data, axis, tp, -] with an optional [data,
+    axis] mask (a scalar sentinel stands in when there is none — shard_map
+    needs a concrete operand either way)."""
+    data_spec = ("dp", "fsdp")
+    qkv_spec = P(data_spec, axis, "tp", None)
+    mask_spec = P(data_spec, axis) if kv_mask is not None else P()
+    if kv_mask is None:
+        fn = lambda q, k, v, _: body(q, k, v, None)
+        kv_mask_arg = jnp.zeros((), dtype=bool)
+    else:
+        fn = body
+        kv_mask_arg = kv_mask
+    wrapped = shard_map(
+        fn, mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec, check_vma=False,
+    )
+    return lambda q, k, v: wrapped(q, k, v, kv_mask_arg)
+
+
 def ring_attention(
     q: jnp.ndarray,  # [B, S, H, D] — S sharded over `axis` outside
     k: jnp.ndarray,
@@ -124,17 +151,58 @@ def ring_attention(
         return dot_product_attention(q, k, v,
                                      mask=None if kv_mask is None else kv_mask[:, None, None, :],
                                      causal=causal)
-    data_spec = ("dp", "fsdp")
-    qkv_spec = P(data_spec, axis, "tp", None)
-    mask_spec = P(data_spec, axis)
     fn = functools.partial(_ring_block, axis_name=axis, axis_size=axis_size, causal=causal)
-    in_specs = (qkv_spec, qkv_spec, qkv_spec, mask_spec if kv_mask is not None else P())
-    if kv_mask is None:
-        fn_wrapped = lambda q, k, v, _: fn(q, k, v, None)
-        kv_mask_arg = jnp.zeros((), dtype=bool)
-    else:
-        fn_wrapped = fn
-        kv_mask_arg = kv_mask
-    return shard_map(
-        fn_wrapped, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec, check_vma=False
-    )(q, k, v, kv_mask_arg)
+    return _sp_shard_map(fn, mesh, axis, kv_mask)(q, k, v)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,  # [B, S, H, D] — S sharded over `axis` outside
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    kv_mask: Optional[jnp.ndarray] = None,  # [B, S] bool, S sharded likewise
+    axis: str = "sp",
+    causal: bool = False,
+) -> jnp.ndarray:
+    """All-to-all sequence parallelism over mesh axis ``axis``.
+
+    Each device starts with a sequence block of all heads; one
+    ``all_to_all`` re-shards to all of the sequence for ``H/sp`` heads,
+    attention runs locally (exact, not blockwise), and the inverse
+    ``all_to_all`` restores the sequence sharding. Head count (after any
+    ``tp`` split) must divide by the axis size.
+    """
+    axis_size = mesh.shape[axis]
+    if axis_size == 1:
+        return dot_product_attention(
+            q, k, v,
+            mask=None if kv_mask is None else kv_mask[:, None, None, :],
+            causal=causal,
+        )
+    tp = mesh.shape.get("tp", 1)
+    local_heads = q.shape[2] // tp
+    if local_heads % axis_size:
+        raise ValueError(
+            f"ulysses needs per-device head count {local_heads} divisible by "
+            f"{axis}={axis_size}; use ring_attention instead"
+        )
+
+    def body(q, k, v, mask):
+        # [B, S/sp, h, D] -> [B, S, h/sp, D]: split heads, gather sequence.
+        q, k, v = (
+            lax.all_to_all(t, axis, split_axis=2, concat_axis=1, tiled=True)
+            for t in (q, k, v)
+        )
+        full_mask = (
+            None if mask is None
+            else lax.all_gather(mask, axis, axis=1, tiled=True)
+        )
+        out = dot_product_attention(
+            q, k, v,
+            mask=None if full_mask is None else full_mask[:, None, None, :],
+            causal=causal,
+        )
+        # [B, S, h/sp, D] -> [B, S/sp, h, D]
+        return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    return _sp_shard_map(body, mesh, axis, kv_mask)(q, k, v)
