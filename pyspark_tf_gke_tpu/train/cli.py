@@ -37,6 +37,11 @@ from pyspark_tf_gke_tpu.train.checkpoint import (
     save_history,
     save_label_map,
 )
+from pyspark_tf_gke_tpu.train.resilience import (
+    FaultInjector,
+    Heartbeat,
+    run_with_recovery,
+)
 from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
 from pyspark_tf_gke_tpu.utils.config import Config, parse_args
 from pyspark_tf_gke_tpu.utils.logging import banner, get_logger
@@ -58,7 +63,14 @@ def _local_batch_size(cfg: Config) -> int:
     return cfg.batch_size // n_proc
 
 
-def run_csv_training(cfg: Config) -> dict:
+def _heartbeat(cfg: Config) -> Optional[Heartbeat]:
+    if not cfg.heartbeat_every_steps:
+        return None
+    path = cfg.heartbeat_file or os.path.join(cfg.output_dir, "heartbeat.json")
+    return Heartbeat(path, cfg.heartbeat_every_steps)
+
+
+def run_csv_training(cfg: Config, fault_injector: Optional[FaultInjector] = None) -> dict:
     banner(logger, f"CSV training: {cfg.data_path}")
     X, y, vocab = load_csv(cfg.data_path)
     num_classes = int(np.max(y)) + 1
@@ -102,13 +114,14 @@ def run_csv_training(cfg: Config) -> dict:
     state, history = trainer.fit(
         state, train_iter, cfg.epochs, steps, val_batches=val_batches,
         checkpoint_manager=ckpt, log_every=cfg.log_every_steps,
+        heartbeat=_heartbeat(cfg), fault_injector=fault_injector,
     )
     ckpt.save(state, history)
     save_history(cfg.output_dir, history)
     return history
 
 
-def run_image_training(cfg: Config) -> dict:
+def run_image_training(cfg: Config, fault_injector: Optional[FaultInjector] = None) -> dict:
     banner(logger, f"Image training: {cfg.data_path}")
     from pyspark_tf_gke_tpu.data.images import list_labeled_images
 
@@ -159,6 +172,7 @@ def run_image_training(cfg: Config) -> dict:
     state, history = trainer.fit(
         state, train_iter, cfg.epochs, steps, val_batches=val_batches,
         checkpoint_manager=ckpt, log_every=cfg.log_every_steps,
+        heartbeat=_heartbeat(cfg), fault_injector=fault_injector,
     )
     ckpt.save(state, history)
     save_history(cfg.output_dir, history)
@@ -176,10 +190,20 @@ def main(argv: Optional[list] = None) -> dict:
     if cfg.profile_dir:
         jax.profiler.start_trace(cfg.profile_dir)
     try:
+        # One injector across attempts: each injected step fires once, so
+        # the post-resume replay of the same global step proceeds.
+        fault_injector = FaultInjector.from_spec(cfg.fail_at_steps)
         is_image_mode = cfg.data_is_images or os.path.isdir(cfg.data_path)
-        if is_image_mode:
-            return run_image_training(cfg)
-        return run_csv_training(cfg)
+
+        def attempt_run(attempt: int) -> dict:
+            run_cfg = cfg.replace(resume=cfg.resume or attempt > 0)
+            if attempt > 0:
+                logger.warning("Restart %d: resuming from latest checkpoint", attempt)
+            if is_image_mode:
+                return run_image_training(run_cfg, fault_injector)
+            return run_csv_training(run_cfg, fault_injector)
+
+        return run_with_recovery(attempt_run, max_restarts=cfg.max_restarts)
     finally:
         if cfg.profile_dir:
             jax.profiler.stop_trace()

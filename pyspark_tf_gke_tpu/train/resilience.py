@@ -1,0 +1,146 @@
+"""Failure detection + elastic recovery.
+
+SURVEY §5: the reference has **no trainer-level failure handling** —
+liveness is delegated to infrastructure (MySQL probes
+``mysql-statefulset.yaml:93-105``, StatefulSet ordinal re-clone, GKE
+auto-repair ``main.tf:104-107``), the chief and parameter servers are
+single points of failure, and fault injection exists nowhere. This module
+is the required upgrade, trainer-level and infra-consumable:
+
+* :class:`Heartbeat` — atomic JSON heartbeat file written from the step
+  loop; its *age* is the liveness signal. The k8s manifests consume it as
+  an exec liveness probe (the TPU-native analog of the reference's
+  ``mysqladmin ping`` probe), and :meth:`Heartbeat.is_stalled` gives the
+  same check programmatically for a watchdog.
+* :class:`FaultInjector` — deterministic chaos hook: raise at chosen
+  global steps, so the recovery path is *tested*, not assumed.
+* :func:`run_with_recovery` — restart-with-resume wrapper: on failure,
+  re-enter the training function with ``resume=True`` so it restores the
+  latest orbax checkpoint (train/checkpoint.py) and continues. In-process
+  retry covers single-host faults; multi-host pod failures restart the
+  whole SPMD process via k8s, landing in the same resume path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Iterable, Optional, Sequence, TypeVar
+
+import jax
+
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+logger = get_logger("train.resilience")
+
+T = TypeVar("T")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by :class:`FaultInjector` — distinguishable from real faults."""
+
+
+class Heartbeat:
+    """Step-loop liveness signal: an atomically-replaced JSON file.
+
+    Age-based: consumers (k8s exec probe, watchdog) alarm when the file
+    is older than their stall threshold. **Every process beats** — the
+    canonical deployment writes to a node-local path (``/tmp``), so each
+    pod's probe observes its own process; a stalled host is caught on
+    that host, not inferred from the coordinator. (With a *shared*
+    heartbeat path the age degrades to "most recently alive process" —
+    point it at node-local storage for per-host liveness.)
+    """
+
+    def __init__(self, path: str, every_steps: int = 10):
+        self.path = path
+        self.every_steps = max(1, every_steps)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def beat(self, step: int, force: bool = False) -> None:
+        if not force and step % self.every_steps:
+            return
+        payload = {
+            "step": int(step),
+            "time": time.time(),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+        }
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, self.path)  # atomic: readers never see a torn file
+
+    @staticmethod
+    def read(path: str) -> Optional[dict]:
+        try:
+            with open(path) as fh:
+                return json.load(fh)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    @staticmethod
+    def age(path: str) -> Optional[float]:
+        """Seconds since the last beat, or None if never beaten."""
+        data = Heartbeat.read(path)
+        if data is None:
+            return None
+        return time.time() - float(data["time"])
+
+    @staticmethod
+    def is_stalled(path: str, stall_seconds: float) -> bool:
+        """True when the job wrote a heartbeat once but has gone quiet.
+        A missing file is 'not started', not 'stalled' — k8s probes
+        should use an initialDelay for that phase instead."""
+        a = Heartbeat.age(path)
+        return a is not None and a > stall_seconds
+
+
+class FaultInjector:
+    """Deterministic chaos: raise :class:`InjectedFault` when the step
+    loop reaches any of ``fail_at_steps`` — once per step value, so the
+    post-recovery pass (which replays the same global step after resume)
+    does not immediately re-fail."""
+
+    def __init__(self, fail_at_steps: Iterable[int]):
+        self.pending = set(int(s) for s in fail_at_steps)
+
+    @classmethod
+    def from_spec(cls, spec: str) -> Optional["FaultInjector"]:
+        """Parse a "12,40" CLI/env spec; empty → None (no injection)."""
+        steps = [int(s) for s in spec.split(",") if s.strip()]
+        return cls(steps) if steps else None
+
+    def maybe_fail(self, step: int) -> None:
+        if int(step) in self.pending:
+            self.pending.discard(int(step))
+            raise InjectedFault(f"injected fault at step {step}")
+
+
+def run_with_recovery(
+    train_once: Callable[[int], T],
+    max_restarts: int = 2,
+    retry_delay_s: float = 0.0,
+    fatal: Sequence[type] = (KeyboardInterrupt,),
+) -> T:
+    """Run ``train_once(attempt)`` with restart-on-failure.
+
+    ``train_once`` must itself arrange resume-from-checkpoint when
+    ``attempt > 0`` (the CLI passes ``resume=True``). Exceptions in
+    ``fatal`` propagate immediately; anything else consumes a restart.
+    """
+    attempt = 0
+    while True:
+        try:
+            return train_once(attempt)
+        except BaseException as e:  # noqa: BLE001 — resilience boundary
+            if isinstance(e, tuple(fatal)) or attempt >= max_restarts:
+                raise
+            attempt += 1
+            logger.warning(
+                "Training attempt %d failed (%s: %s); restarting with resume "
+                "(%d/%d)", attempt, type(e).__name__, e, attempt, max_restarts,
+            )
+            if retry_delay_s:
+                time.sleep(retry_delay_s)

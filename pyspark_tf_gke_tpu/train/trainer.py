@@ -322,6 +322,8 @@ class Trainer:
         val_batches: Optional[Callable[[], Any]] = None,  # () -> iterable of batch dicts
         checkpoint_manager=None,
         log_every: int = 0,
+        heartbeat=None,  # train.resilience.Heartbeat
+        fault_injector=None,  # train.resilience.FaultInjector (chaos tests)
     ) -> Tuple[TrainState, Dict[str, list]]:
         """Run the training loop; returns final state and a Keras-style
         history dict (the reference's ``history.history`` analog,
@@ -331,6 +333,9 @@ class Trainer:
 
         data_sharding = batch_sharding(self.mesh)
         history: Dict[str, list] = {}
+        # Host-side mirror of state.step: one sync here, then pure
+        # increments — no per-step device readback for liveness.
+        global_step = int(jax.device_get(state.step))
 
         for epoch in range(epochs):
             # Metrics accumulate as device scalars — no host sync inside the
@@ -349,6 +354,11 @@ class Trainer:
                     jax.block_until_ready(metrics)
                     t_first_step = time.perf_counter() - t0
                 examples += next(iter(host_batch.values())).shape[0] * jax.process_count()
+                global_step += 1
+                if heartbeat is not None:
+                    heartbeat.beat(global_step)
+                if fault_injector is not None:
+                    fault_injector.maybe_fail(global_step)
                 for k, v in metrics.items():
                     sums[k] = sums[k] + v if k in sums else v
                 if log_every and (step_i + 1) % log_every == 0:

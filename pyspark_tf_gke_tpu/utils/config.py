@@ -85,6 +85,15 @@ class Config:
     profile_dir: str = _env("PROFILE_DIR", "")
     log_every_steps: int = _env_int("LOG_EVERY_STEPS", 50)
 
+    # --- resilience (train/resilience.py; the reference delegates all of
+    #     this to infra probes — SURVEY §5) ---
+    max_restarts: int = _env_int("MAX_RESTARTS", 0)  # in-process restarts w/ resume
+    heartbeat_every_steps: int = _env_int("HEARTBEAT_EVERY_STEPS", 10)  # 0 → off
+    # Local path for the liveness heartbeat; "" → <output_dir>/heartbeat.json.
+    # Must be node-local (not gs://) when used as a k8s exec probe.
+    heartbeat_file: str = _env("HEARTBEAT_FILE", "")
+    fail_at_steps: str = _env("FAIL_AT_STEPS", "")  # chaos: "12,40" injects faults
+
     def mesh_axes(self) -> dict:
         """Parse ``mesh_shape`` ("dp=4,fsdp=2,tp=1") into an ordered dict."""
         axes = {}
@@ -138,5 +147,13 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
     p.add_argument("--checkpoint-every-steps", type=int, default=cfg.checkpoint_every_steps)
     p.add_argument("--resume", action="store_true", default=cfg.resume)
     p.add_argument("--profile-dir", default=cfg.profile_dir)
+    p.add_argument("--max-restarts", type=int, default=cfg.max_restarts,
+                   help="in-process restarts with checkpoint resume on failure")
+    p.add_argument("--heartbeat-every-steps", type=int, default=cfg.heartbeat_every_steps,
+                   help="write the liveness heartbeat every N steps (0=off)")
+    p.add_argument("--heartbeat-file", default=cfg.heartbeat_file,
+                   help="heartbeat path; empty = <output-dir>/heartbeat.json")
+    p.add_argument("--fail-at-steps", default=cfg.fail_at_steps,
+                   help='chaos testing: inject faults at these global steps, e.g. "12,40"')
     ns = p.parse_args(argv)
     return cfg.replace(**{k.replace("-", "_"): v for k, v in vars(ns).items()})

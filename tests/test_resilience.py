@@ -1,0 +1,123 @@
+"""Failure detection + elastic recovery (train/resilience.py).
+
+The chaos test drives the REAL CLI end to end: inject a fault mid-run,
+watch the recovery wrapper restore the latest checkpoint and finish —
+the behavior the reference never had (SURVEY §5: no trainer-level
+failure handling, no fault injection anywhere).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pyspark_tf_gke_tpu.train.resilience import (
+    FaultInjector,
+    Heartbeat,
+    InjectedFault,
+    run_with_recovery,
+)
+
+
+def test_heartbeat_write_and_age(tmp_path):
+    path = str(tmp_path / "hb.json")
+    hb = Heartbeat(path, every_steps=5)
+    hb.beat(3)  # not a multiple of 5 → skipped
+    assert Heartbeat.age(path) is None
+    hb.beat(5)
+    data = Heartbeat.read(path)
+    assert data["step"] == 5 and data["process_count"] == 1
+    assert Heartbeat.age(path) < 5.0
+    assert not Heartbeat.is_stalled(path, stall_seconds=60)
+    # Backdate the beat → stalled.
+    data["time"] = time.time() - 120
+    with open(path, "w") as fh:
+        json.dump(data, fh)
+    assert Heartbeat.is_stalled(path, stall_seconds=60)
+
+
+def test_heartbeat_missing_file_not_stalled(tmp_path):
+    path = str(tmp_path / "never.json")
+    assert Heartbeat.age(path) is None
+    assert not Heartbeat.is_stalled(path, stall_seconds=0.001)
+
+
+def test_fault_injector_fires_once():
+    fi = FaultInjector([4])
+    fi.maybe_fail(3)
+    with pytest.raises(InjectedFault):
+        fi.maybe_fail(4)
+    fi.maybe_fail(4)  # replay after resume: no re-fire
+    assert FaultInjector.from_spec("") is None
+    assert FaultInjector.from_spec("2, 7").pending == {2, 7}
+
+
+def test_run_with_recovery_retries_then_succeeds():
+    calls = []
+
+    def train_once(attempt):
+        calls.append(attempt)
+        if attempt < 2:
+            raise RuntimeError("boom")
+        return "done"
+
+    assert run_with_recovery(train_once, max_restarts=2) == "done"
+    assert calls == [0, 1, 2]
+
+
+def test_run_with_recovery_exhausts_restarts():
+    def train_once(attempt):
+        raise RuntimeError("always")
+
+    with pytest.raises(RuntimeError, match="always"):
+        run_with_recovery(train_once, max_restarts=1)
+
+
+def test_run_with_recovery_fatal_propagates():
+    def train_once(attempt):
+        raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        run_with_recovery(train_once, max_restarts=5)
+
+
+def test_cli_chaos_recovery_end_to_end(tmp_path):
+    """Fault at global step 12 with checkpoints every 5 steps: the wrapper
+    must resume from step >= 10 and finish all epochs, producing the full
+    artifact set plus a live heartbeat."""
+    from pyspark_tf_gke_tpu.data.synthetic import make_synthetic_csv
+    from pyspark_tf_gke_tpu.train import cli
+
+    csv = str(tmp_path / "d.csv")
+    make_synthetic_csv(csv, rows=320)
+    out = str(tmp_path / "out")
+    history = cli.main([
+        "--data-path", csv, "--epochs", "4", "--batch-size", "32",
+        "--output-dir", out, "--mesh-shape", "dp=8",
+        "--checkpoint-every-steps", "5", "--max-restarts", "1",
+        "--fail-at-steps", "12", "--heartbeat-every-steps", "2",
+    ])
+    # 4 epochs x 8 steps = 32 steps total; the restart re-runs whole
+    # epochs, so history still records 4 epochs.
+    assert len(history["loss"]) == 4
+    assert all(np.isfinite(v) for v in history["loss"])
+    hb = Heartbeat.read(os.path.join(out, "heartbeat.json"))
+    assert hb is not None and hb["step"] >= 30
+    assert os.path.exists(os.path.join(out, "history.json"))
+
+
+def test_cli_chaos_exhausted_raises(tmp_path):
+    """max_restarts=0 → the injected fault propagates."""
+    from pyspark_tf_gke_tpu.data.synthetic import make_synthetic_csv
+    from pyspark_tf_gke_tpu.train import cli
+
+    csv = str(tmp_path / "d.csv")
+    make_synthetic_csv(csv, rows=320)
+    with pytest.raises(InjectedFault):
+        cli.main([
+            "--data-path", csv, "--epochs", "2", "--batch-size", "32",
+            "--output-dir", str(tmp_path / "out2"), "--mesh-shape", "dp=8",
+            "--fail-at-steps", "3",
+        ])
