@@ -1,0 +1,172 @@
+"""BERT fine-tune entry point fed by TFRecord shards — BASELINE.json
+config 5 ("BERT-base fine-tune fed by PySpark-preprocessed TFRecord
+shards").
+
+The input contract is the ETL bridge schema (``etl.tfrecord_bridge`` on
+the Spark side): one Example per row with ``input_ids`` /
+``attention_mask`` int64 features of length ``seq_len`` and an int64
+``label``. Shards are read with the **native IO plane**
+(``data.native_tfrecord`` → C++ reader, zero tensorflow dependency on
+TPU hosts), distributed over hosts by file; the model is the annotated
+BERT encoder (``models/bert.py``), and all mesh axes work — dp/fsdp/tp
+for the standard fine-tune, sp (ring or Ulysses) for long-sequence
+variants, ep when the config enables MoE.
+
+No counterpart exists in the reference (no attention models, no ETL→DL
+bridge — SURVEY §2b/§7); the run artifacts (history.json, checkpoints)
+follow the same conventions as the CSV/image CLI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pyspark_tf_gke_tpu.data.native_tfrecord import read_tfrecord_batches
+from pyspark_tf_gke_tpu.models import BertConfig, BertForPretraining
+from pyspark_tf_gke_tpu.parallel.distributed import initialize_distributed
+from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
+from pyspark_tf_gke_tpu.train.harness import (
+    finalize_run,
+    init_sample,
+    local_batch_size,
+    make_checkpoint,
+    make_heartbeat,
+)
+from pyspark_tf_gke_tpu.train.resilience import run_with_recovery
+from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
+from pyspark_tf_gke_tpu.utils.config import parse_mesh_shape
+from pyspark_tf_gke_tpu.utils.logging import banner, get_logger
+from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+logger = get_logger("train.bert_finetune")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    e = os.environ.get
+    p = argparse.ArgumentParser(
+        description="Fine-tune BERT on TFRecord shards produced by the Spark ETL bridge"
+    )
+    p.add_argument("--data-pattern", default=e("DATA_PATTERN", ""),
+                   help="glob of TFRecord shards, e.g. 'gs://bucket/shards/train-*.tfrecord'")
+    p.add_argument("--seq-len", type=int, default=int(e("SEQ_LEN", "128")))
+    p.add_argument("--num-labels", type=int, default=int(e("NUM_LABELS", "2")))
+    p.add_argument("--vocab-size", type=int, default=int(e("VOCAB_SIZE", "30522")))
+    p.add_argument("--hidden-size", type=int, default=int(e("HIDDEN_SIZE", "768")))
+    p.add_argument("--num-layers", type=int, default=int(e("NUM_LAYERS", "12")))
+    p.add_argument("--num-heads", type=int, default=int(e("NUM_HEADS", "12")))
+    p.add_argument("--intermediate-size", type=int, default=int(e("INTERMEDIATE_SIZE", "3072")))
+    p.add_argument("--sp-impl", default=e("SP_IMPL", "ring"), choices=["ring", "ulysses"])
+    p.add_argument("--num-experts", type=int, default=int(e("NUM_EXPERTS", "0")),
+                   help=">0 turns every --moe-every'th FFN into an expert-parallel MoE")
+    p.add_argument("--moe-every", type=int, default=int(e("MOE_EVERY", "2")))
+    p.add_argument("--remat", action="store_true", default=e("REMAT", "") == "1")
+    p.add_argument("--epochs", type=int, default=int(e("EPOCHS", "1")))
+    p.add_argument("--steps-per-epoch", type=int, default=int(e("STEPS_PER_EPOCH", "100")))
+    p.add_argument("--batch-size", type=int, default=int(e("BATCH_SIZE", "32")),
+                   help="GLOBAL batch size across all chips")
+    p.add_argument("--learning-rate", type=float, default=float(e("LEARNING_RATE", "2e-5")))
+    p.add_argument("--seed", type=int, default=int(e("SEED", "1337")))
+    p.add_argument("--mesh-shape", default=e("MESH_SHAPE", ""),
+                   help='e.g. "dp=2,fsdp=2" | "dp=2,sp=4" | "" → all chips on dp')
+    p.add_argument("--output-dir", default=e("OUTPUT_DIR", "./bert-finetune"))
+    p.add_argument("--checkpoint-every-steps", type=int,
+                   default=int(e("CHECKPOINT_EVERY_STEPS", "0")))
+    p.add_argument("--resume", action="store_true", default=e("RESUME", "") == "1")
+    p.add_argument("--compute-dtype", default=e("COMPUTE_DTYPE", "bfloat16"),
+                   choices=["bfloat16", "float32"])
+    p.add_argument("--num-processes", type=int, default=int(e("NUM_PROCESSES", "1")))
+    p.add_argument("--process-id", type=int, default=int(e("PROCESS_ID", "-1")))
+    p.add_argument("--coordinator-addr", default=e("COORDINATOR_ADDR", ""))
+    p.add_argument("--coordinator-port", type=int, default=int(e("COORDINATOR_PORT", "8476")))
+    p.add_argument("--max-restarts", type=int, default=int(e("MAX_RESTARTS", "0")))
+    p.add_argument("--heartbeat-every-steps", type=int,
+                   default=int(e("HEARTBEAT_EVERY_STEPS", "10")))
+    return p.parse_args(argv)
+
+
+def shard_schema(seq_len: int) -> dict:
+    """The ETL-bridge contract for sequence-classification shards."""
+    return {
+        "input_ids": ("int", (seq_len,)),
+        "attention_mask": ("int", (seq_len,)),
+        "label": ("int", ()),
+    }
+
+
+def main(argv=None) -> dict:
+    args = parse_args(argv)
+    if not args.data_pattern:
+        raise SystemExit("--data-pattern is required (glob of TFRecord shards)")
+    initialize_distributed(
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+        coordinator_addr=args.coordinator_addr,
+        coordinator_port=args.coordinator_port,
+    )
+    banner(logger, f"BERT fine-tune: {args.data_pattern}")
+
+    cfg = BertConfig(
+        vocab_size=args.vocab_size,
+        hidden_size=args.hidden_size,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        intermediate_size=args.intermediate_size,
+        max_position_embeddings=max(512, args.seq_len),
+        dtype=jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32,
+        remat=args.remat,
+        sp_impl=args.sp_impl,
+        num_experts=args.num_experts,
+        moe_every=args.moe_every,
+    )
+    mesh = make_mesh(parse_mesh_shape(args.mesh_shape) or None)
+    model = BertForPretraining(cfg, mesh=mesh, num_labels=args.num_labels)
+    trainer = Trainer(model, TASKS["bert_classification"](), mesh,
+                      learning_rate=args.learning_rate)
+
+    local_bs = local_batch_size(args.batch_size)
+
+    def batches():
+        for raw in read_tfrecord_batches(
+            args.data_pattern, shard_schema(args.seq_len), local_bs, seed=args.seed
+        ):
+            yield {
+                "input_ids": raw["input_ids"],
+                "attention_mask": raw["attention_mask"],
+                "labels": raw["label"].reshape(-1),
+            }
+
+    it = batches()
+    # First local batch traces init only (tiled up to one row per global
+    # data shard); the iterator continues from the next batch.
+    sample = init_sample(next(it), mesh)
+    state = trainer.init_state(make_rng(args.seed), sample)
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(state.params))
+    logger.info("Model: %d params (%.1fM), mesh=%s", n_params, n_params / 1e6,
+                dict(mesh.shape))
+
+    def attempt_run(attempt: int) -> dict:
+        nonlocal state
+        ckpt, state = make_checkpoint(
+            args.output_dir, args.checkpoint_every_steps, state,
+            args.resume or attempt > 0,
+        )
+        state, history = trainer.fit(
+            state, it, args.epochs, args.steps_per_epoch,
+            checkpoint_manager=ckpt,
+            heartbeat=make_heartbeat(args.output_dir, args.heartbeat_every_steps),
+        )
+        finalize_run(ckpt, state, history, args.output_dir)
+        return history
+
+    return run_with_recovery(attempt_run, max_restarts=args.max_restarts)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -32,16 +32,14 @@ from pyspark_tf_gke_tpu.data.pipeline import (
 from pyspark_tf_gke_tpu.models import build_model
 from pyspark_tf_gke_tpu.parallel.distributed import initialize_distributed
 from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
-from pyspark_tf_gke_tpu.train.checkpoint import (
-    CheckpointManager,
-    save_history,
-    save_label_map,
+from pyspark_tf_gke_tpu.train.checkpoint import save_label_map
+from pyspark_tf_gke_tpu.train.harness import (
+    finalize_run,
+    local_batch_size,
+    make_checkpoint,
+    make_heartbeat,
 )
-from pyspark_tf_gke_tpu.train.resilience import (
-    FaultInjector,
-    Heartbeat,
-    run_with_recovery,
-)
+from pyspark_tf_gke_tpu.train.resilience import FaultInjector, run_with_recovery
 from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
 from pyspark_tf_gke_tpu.utils.config import Config, parse_args
 from pyspark_tf_gke_tpu.utils.logging import banner, get_logger
@@ -56,18 +54,8 @@ def _dtype(name: str):
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "": None}.get(name, None)
 
 
-def _local_batch_size(cfg: Config) -> int:
-    n_proc = jax.process_count()
-    if cfg.batch_size % n_proc:
-        raise ValueError(f"global batch {cfg.batch_size} not divisible by {n_proc} hosts")
-    return cfg.batch_size // n_proc
-
-
-def _heartbeat(cfg: Config) -> Optional[Heartbeat]:
-    if not cfg.heartbeat_every_steps:
-        return None
-    path = cfg.heartbeat_file or os.path.join(cfg.output_dir, "heartbeat.json")
-    return Heartbeat(path, cfg.heartbeat_every_steps)
+def _heartbeat(cfg: Config):
+    return make_heartbeat(cfg.output_dir, cfg.heartbeat_every_steps, cfg.heartbeat_file)
 
 
 def run_csv_training(cfg: Config, fault_injector: Optional[FaultInjector] = None) -> dict:
@@ -86,7 +74,7 @@ def run_csv_training(cfg: Config, fault_injector: Optional[FaultInjector] = None
             "ResNet/BERT workloads have dedicated entry points (see bench.py)."
         )
 
-    local_bs = _local_batch_size(cfg)
+    local_bs = local_batch_size(cfg.batch_size)
     train_iter = BatchIterator({"x": Xt, "y": yt}, local_bs, seed=cfg.seed)
     steps = cfg.steps_per_epoch or train_iter.steps_per_epoch
 
@@ -98,10 +86,9 @@ def run_csv_training(cfg: Config, fault_injector: Optional[FaultInjector] = None
     # the trainer trims to exactly one row per data shard itself.
     state = trainer.init_state(make_rng(cfg.seed), {"x": Xt, "y": yt})
 
-    ckpt = CheckpointManager(os.path.join(cfg.output_dir, "checkpoints"),
-                             every_steps=cfg.checkpoint_every_steps)
-    if cfg.resume and ckpt.latest_step() is not None:
-        state = ckpt.restore(state)
+    ckpt, state = make_checkpoint(
+        cfg.output_dir, cfg.checkpoint_every_steps, state, cfg.resume
+    )
 
     def val_batches():
         if len(Xv) < local_bs:
@@ -116,8 +103,7 @@ def run_csv_training(cfg: Config, fault_injector: Optional[FaultInjector] = None
         checkpoint_manager=ckpt, log_every=cfg.log_every_steps,
         heartbeat=_heartbeat(cfg), fault_injector=fault_injector,
     )
-    ckpt.save(state, history)
-    save_history(cfg.output_dir, history)
+    finalize_run(ckpt, state, history, cfg.output_dir)
     return history
 
 
@@ -137,7 +123,7 @@ def run_image_training(cfg: Config, fault_injector: Optional[FaultInjector] = No
     )
     images_t, targets_t = host_shard(images_t, targets_t)
 
-    local_bs = _local_batch_size(cfg)
+    local_bs = local_batch_size(cfg.batch_size)
     train_iter = BatchIterator(
         {"image": images_t, "target": targets_t}, local_bs, seed=cfg.seed
     )
@@ -156,10 +142,9 @@ def run_image_training(cfg: Config, fault_injector: Optional[FaultInjector] = No
         make_rng(cfg.seed), {"image": images_t, "target": targets_t}
     )
 
-    ckpt = CheckpointManager(os.path.join(cfg.output_dir, "checkpoints"),
-                             every_steps=cfg.checkpoint_every_steps)
-    if cfg.resume and ckpt.latest_step() is not None:
-        state = ckpt.restore(state)
+    ckpt, state = make_checkpoint(
+        cfg.output_dir, cfg.checkpoint_every_steps, state, cfg.resume
+    )
 
     def val_batches():
         if len(images_v) < local_bs:
@@ -174,8 +159,7 @@ def run_image_training(cfg: Config, fault_injector: Optional[FaultInjector] = No
         checkpoint_manager=ckpt, log_every=cfg.log_every_steps,
         heartbeat=_heartbeat(cfg), fault_injector=fault_injector,
     )
-    ckpt.save(state, history)
-    save_history(cfg.output_dir, history)
+    finalize_run(ckpt, state, history, cfg.output_dir)
     return history
 
 

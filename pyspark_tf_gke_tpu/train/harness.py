@@ -1,0 +1,70 @@
+"""Shared run scaffolding for the training entry points (cli.py,
+bert_finetune.py): the pieces every entry repeats — host-local batch
+sizing, init-sample preparation, checkpoint setup/restore/finalize, and
+the heartbeat/recovery plumbing from train/resilience.py."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from pyspark_tf_gke_tpu.train.checkpoint import CheckpointManager, save_history
+from pyspark_tf_gke_tpu.train.resilience import Heartbeat
+
+
+def local_batch_size(global_batch: int) -> int:
+    """Per-host batch from the GLOBAL batch size (reference semantics:
+    batch flags are global; each host feeds its slice)."""
+    n_proc = jax.process_count()
+    if global_batch % n_proc:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {n_proc} hosts"
+        )
+    return global_batch // n_proc
+
+
+def init_sample(batch: Dict[str, np.ndarray], mesh) -> Dict[str, np.ndarray]:
+    """Make a host-local batch usable for shape-only init tracing: the
+    trainer needs >= dp*fsdp GLOBAL rows (one per data shard), so tile the
+    local rows when a small local batch on a many-shard mesh would fall
+    short (multi-host: local batch < global data shards is legitimate)."""
+    need = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    n = len(next(iter(batch.values())))
+    if n >= need:
+        return batch
+    reps = -(-need // n)  # ceil
+    return {k: np.concatenate([v] * reps)[:need] for k, v in batch.items()}
+
+
+def make_checkpoint(
+    output_dir: str,
+    every_steps: int,
+    state,
+    resume: bool,
+):
+    """Build the CheckpointManager under ``output_dir`` and restore the
+    latest step when resuming. Returns (manager, possibly-restored state)."""
+    ckpt = CheckpointManager(
+        os.path.join(output_dir, "checkpoints"), every_steps=every_steps
+    )
+    if resume and ckpt.latest_step() is not None:
+        state = ckpt.restore(state)
+    return ckpt, state
+
+
+def finalize_run(ckpt: CheckpointManager, state, history: Dict, output_dir: str) -> None:
+    """Terminal save: checkpoint + history.json (the reference's
+    model.save + history dump, train_tf_ps.py:674-679)."""
+    ckpt.save(state, history)
+    save_history(output_dir, history)
+
+
+def make_heartbeat(
+    output_dir: str, every_steps: int, path: str = ""
+) -> Optional[Heartbeat]:
+    if not every_steps:
+        return None
+    return Heartbeat(path or os.path.join(output_dir, "heartbeat.json"), every_steps)

@@ -35,6 +35,20 @@ def _env_bool(name: str, default: bool) -> bool:
     return raw.strip().lower() in ("1", "true", "yes", "y")
 
 
+def parse_mesh_shape(spec: str) -> dict:
+    """Parse a mesh-shape spec ("dp=4,fsdp=2,tp=1") into an ordered dict.
+    Empty segments are skipped; an empty spec yields {} (→ all chips on dp)."""
+    axes: dict = {}
+    if spec:
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, size = part.partition("=")
+            axes[name.strip()] = int(size)
+    return axes
+
+
 @dataclasses.dataclass
 class Config:
     """All knobs for a training run.
@@ -95,16 +109,7 @@ class Config:
     fail_at_steps: str = _env("FAIL_AT_STEPS", "")  # chaos: "12,40" injects faults
 
     def mesh_axes(self) -> dict:
-        """Parse ``mesh_shape`` ("dp=4,fsdp=2,tp=1") into an ordered dict."""
-        axes = {}
-        if self.mesh_shape:
-            for part in self.mesh_shape.split(","):
-                part = part.strip()
-                if not part:
-                    continue
-                name, _, size = part.partition("=")
-                axes[name.strip()] = int(size)
-        return axes
+        return parse_mesh_shape(self.mesh_shape)
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
