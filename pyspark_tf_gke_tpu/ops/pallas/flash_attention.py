@@ -10,9 +10,12 @@ is independent). Optional additive bias ``[BH, S]`` implements padding
 masks (0 for keep, -inf/NEG_INF for drop). ``causal=True`` masks with
 block-level skipping (a K block fully in the future is never read).
 
-Backward: ``jax.custom_vjp`` recomputes attention blockwise in plain JAX
-(flash-style memory behavior; XLA fuses it well). Residuals are only
-(q, k, v, bias) — no S×S tensor is saved.
+Backward: ``jax.custom_vjp`` with **Pallas backward kernels** — the
+forward additionally emits the per-row logsumexp ``L = m + log(l)``, and
+two kernels recompute P blockwise from (q, k, bias, L): one walks K
+blocks to produce dQ, the other walks Q blocks to produce dK/dV (the
+standard flash-attention backward split). No S×S tensor ever exists in
+either pass; residuals are (q, k, v, bias, L, D=rowsum(dO·O)).
 
 The public entry ``flash_attention`` takes ``[B, S, H, D]`` like
 ``ops.attention.dot_product_attention`` and reshapes. Falls back to the
@@ -43,8 +46,8 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, block_k: int, causal: bool,
-                scale: float):
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, block_k: int,
+                causal: bool, scale: float):
     # Shapes: q [1, bq, D], k/v [1, S, D], bias [1, S], o [1, bq, D]
     bq = q_ref.shape[1]
     s = k_ref.shape[1]
@@ -91,6 +94,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, block_k: int, causal: b
     l = jnp.where(l == 0.0, 1.0, l)
     out = jnp.where(valid, acc / l, 0.0)
     o_ref[0] = out.astype(o_ref.dtype)
+    # Logsumexp residual for the backward kernels; +inf on fully-masked
+    # rows makes their recomputed P exactly 0.
+    lse_ref[0] = jnp.where(valid, m + jnp.log(l), jnp.inf)[:, 0]
 
 
 def _flash_fwd_bh(q, k, v, bias, *, causal: bool, block_q: int, block_k: int,
@@ -116,47 +122,180 @@ def _flash_fwd_bh(q, k, v, bias, *, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0), **mem),
             pl.BlockSpec((1, s), lambda i, j: (i, 0), **mem),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j), **mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v, bias)
 
 
-def _reference_bh(q, k, v, bias, causal):
-    """Blockwise-free dense reference used for the backward recompute."""
-    scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
-    scores += bias[:, None, :]
-    if causal:
-        s = q.shape[1]
-        cm = jnp.tril(jnp.ones((s, s), dtype=bool))
-        scores = jnp.where(cm[None], scores, NEG_INF)
-    m = scores.max(-1, keepdims=True)
-    p = jnp.exp(scores - m)
-    l = p.sum(-1, keepdims=True)
-    valid = m > NEG_INF / 2
-    out = jnp.where(valid, jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
-                    / jnp.where(l == 0, 1.0, l), 0.0)
-    return out.astype(q.dtype)
+def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref, dq_ref,
+               *, block_k: int, causal: bool, scale: float):
+    # Shapes: q/do/dq [1, bq, D], k/v [1, S, D], bias [1, S],
+    # lse/delta [1, bq]. One Q block per grid step, walking K blocks.
+    bq = q_ref.shape[1]
+    s = k_ref.shape[1]
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]                            # [bq, 1]
+    delta = delta_ref[0][:, None]                        # [bq, 1]
+    acc = jnp.zeros_like(q)
+
+    num_kb = s // block_k
+
+    def body(kb, acc):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        scores += bias_ref[0, pl.ds(kb * block_k, block_k)][None, :]
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        p = jnp.exp(scores - lse)                        # exact probs via saved lse
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        return acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    last_kb = (
+        jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, num_kb)
+        if causal else num_kb
+    )
+    acc = jax.lax.fori_loop(0, last_kb, body, acc)
+    dq_ref[0] = acc.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref,
+                dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float):
+    # Shapes: k/v/dk/dv [1, bk, D], q/do [1, S, D], bias [1, bk],
+    # lse/delta [1, S]. One K block per grid step, walking Q blocks.
+    bk = k_ref.shape[1]
+    s = q_ref.shape[1]
+    ki = pl.program_id(1)
+
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    bias = bias_ref[0][None, :]                          # [1, bk]
+    dk = jnp.zeros_like(k_blk)
+    dv = jnp.zeros_like(v_blk)
+
+    num_qb = s // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * scale
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        scores = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) + bias
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        p = jnp.exp(scores - lse)                        # [bq, bk]
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        # d(scale·q·kᵀ)/dk = scale·q, and q_blk is already pre-scaled.
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    # Causal: Q blocks strictly before this K block never attend to it.
+    first_qb = (ki * bk) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(first_qb, num_qb, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_bh(q, k, v, bias, lse, out, do, *, causal, block_q, block_k,
+                  interpret):
+    bh, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    scale = d ** -0.5
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    mem = {} if _VMEM is None else {"memory_space": _VMEM}
+    full = lambda last: pl.BlockSpec((1, s, last), lambda i, j: (i, 0, 0), **mem)
+    full_row = pl.BlockSpec((1, s), lambda i, j: (i, 0), **mem)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale),
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
+            full(d), full(d), full_row,
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j), **mem),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j), **mem),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, bias, lse, do, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, causal=causal, scale=scale),
+        grid=(bh, s // block_k),
+        in_specs=[
+            full(d),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), **mem),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), **mem),
+            pl.BlockSpec((1, block_k), lambda i, j: (i, j), **mem),
+            full_row, full(d), full_row,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), **mem),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), **mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, bias, lse, do, delta)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash_bh(q, k, v, bias, causal, block_q, block_k, interpret):
-    return _flash_fwd_bh(q, k, v, bias, causal=causal, block_q=block_q,
-                         block_k=block_k, interpret=interpret)
+    out, _ = _flash_fwd_bh(q, k, v, bias, causal=causal, block_q=block_q,
+                           block_k=block_k, interpret=interpret)
+    return out
 
 
 def _flash_bh_fwd(q, k, v, bias, causal, block_q, block_k, interpret):
-    out = _flash_fwd_bh(q, k, v, bias, causal=causal, block_q=block_q,
-                        block_k=block_k, interpret=interpret)
-    return out, (q, k, v, bias)
+    out, lse = _flash_fwd_bh(q, k, v, bias, causal=causal, block_q=block_q,
+                             block_k=block_k, interpret=interpret)
+    return out, (q, k, v, bias, lse, out)
 
 
 def _flash_bh_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v, bias = residuals
-    _, vjp = jax.vjp(lambda q, k, v: _reference_bh(q, k, v, bias, causal), q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, bias, lse, out = residuals
+    dq, dk, dv = _flash_bwd_bh(q, k, v, bias, lse, out, g, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret)
     return dq, dk, dv, None
 
 

@@ -22,7 +22,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +33,6 @@ from pyspark_tf_gke_tpu.parallel.distributed import initialize_distributed
 from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
 from pyspark_tf_gke_tpu.train.harness import (
     finalize_run,
-    init_sample,
     local_batch_size,
     make_checkpoint,
     make_heartbeat,
@@ -143,10 +141,9 @@ def main(argv=None) -> dict:
             }
 
     it = batches()
-    # First local batch traces init only (tiled up to one row per global
-    # data shard); the iterator continues from the next batch.
-    sample = init_sample(next(it), mesh)
-    state = trainer.init_state(make_rng(args.seed), sample)
+    # First local batch traces init only (the trainer tiles it up to one
+    # row per global data shard); the iterator continues from the next.
+    state = trainer.init_state(make_rng(args.seed), next(it))
     n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(state.params))
     logger.info("Model: %d params (%.1fM), mesh=%s", n_params, n_params / 1e6,
                 dict(mesh.shape))

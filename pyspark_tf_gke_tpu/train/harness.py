@@ -26,19 +26,6 @@ def local_batch_size(global_batch: int) -> int:
     return global_batch // n_proc
 
 
-def init_sample(batch: Dict[str, np.ndarray], mesh) -> Dict[str, np.ndarray]:
-    """Make a host-local batch usable for shape-only init tracing: the
-    trainer needs >= dp*fsdp GLOBAL rows (one per data shard), so tile the
-    local rows when a small local batch on a many-shard mesh would fall
-    short (multi-host: local batch < global data shards is legitimate)."""
-    need = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-    n = len(next(iter(batch.values())))
-    if n >= need:
-        return batch
-    reps = -(-need // n)  # ceil
-    return {k: np.concatenate([v] * reps)[:need] for k, v in batch.items()}
-
-
 def make_checkpoint(
     output_dir: str,
     every_steps: int,

@@ -167,14 +167,15 @@ class Trainer:
     def _sample_inputs(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """Minimal batch slice for shape-only init: one row per data-parallel
         shard (shard_map paths, e.g. ring attention, need the global batch
-        divisible by dp*fsdp even at init)."""
+        divisible by dp*fsdp even at init). Batches with fewer rows than
+        shards — legitimate on multi-host, where the local batch can be
+        smaller than the global shard count — are tiled up; this is shape
+        tracing only, values are irrelevant."""
         n = self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1)
-        for k, v in batch.items():
-            if len(v) < n:
-                raise ValueError(
-                    f"sample batch key {k!r} has {len(v)} rows; need >= {n} "
-                    "(one per data-parallel shard) to trace init"
-                )
+        rows = len(next(iter(batch.values())))
+        if rows < n:
+            reps = -(-n // rows)  # ceil
+            batch = {k: np.concatenate([np.asarray(v)] * reps) for k, v in batch.items()}
         return {k: v[:n] for k, v in batch.items()}
 
     def _create_fn(self, sample_batch):
