@@ -16,6 +16,12 @@ extrapolated to the reference baseline cluster's 16 vCPUs
 and its baseline "TF pool" is CPU nodes; see tools/measure_reference_baseline.py).
 
 All diagnostics go to stderr; stdout carries exactly the one JSON line.
+
+Secondary workloads (BASELINE configs 4/5): ``python bench.py resnet50``
+and ``python bench.py bert`` measure examples/sec/chip for ResNet-50
+classification (batch 64, 224²) and BERT-base sequence classification
+(batch 32, S=128); same JSON shape, ``vs_baseline`` null (the reference
+has no such workloads to compare against).
 """
 
 from __future__ import annotations
@@ -32,7 +38,25 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main(batch_size: int = 32, warmup: int = 10, steps: int = 100) -> dict:
+def measure(trainer, state, batch, steps: int):
+    """Shared warmup+measure protocol. All `steps` train steps run inside
+    ONE dispatch (on-device lax.scan): host-side loops on remote-attached
+    chips report ready before the queue drains, understating step time up
+    to ~50x. Full metric readback (np.asarray) forces true completion.
+    Returns (state, per-step losses, elapsed seconds)."""
+    log("compiling + warmup...")
+    state, metrics = trainer.multi_step(state, batch, steps)
+    np.asarray(metrics["loss"])
+
+    log(f"measuring {steps} steps (single-dispatch scan)...")
+    t0 = time.perf_counter()
+    state, metrics = trainer.multi_step(state, batch, steps)
+    losses = np.asarray(metrics["loss"])
+    dt = time.perf_counter() - t0
+    return state, losses, dt
+
+
+def main(batch_size: int = 32, steps: int = 100) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -61,19 +85,7 @@ def main(batch_size: int = 32, warmup: int = 10, steps: int = 100) -> dict:
         "target": jax.device_put(targets, sharding),
     }
 
-    # All `steps` train steps run inside ONE dispatch (on-device lax.scan):
-    # host-side loops on remote-attached chips report ready before the queue
-    # drains, understating step time up to ~50x. Full metric readback
-    # (np.asarray) forces true completion.
-    log("compiling + warmup...")
-    state, metrics = trainer.multi_step(state, batch, steps)
-    np.asarray(metrics["loss"])
-
-    log(f"measuring {steps} steps (single-dispatch scan)...")
-    t0 = time.perf_counter()
-    state, metrics = trainer.multi_step(state, batch, steps)
-    losses = np.asarray(metrics["loss"])
-    dt = time.perf_counter() - t0
+    state, losses, dt = measure(trainer, state, batch, steps)
 
     step_ms = dt / steps * 1000.0
     images_per_sec = batch_size * steps / dt
@@ -105,6 +117,74 @@ def main(batch_size: int = 32, warmup: int = 10, steps: int = 100) -> dict:
     return result
 
 
+def bench_workload(name: str, steps: int = 50, smoke: bool = False) -> dict:
+    """Secondary workloads: resnet50 / bert (BASELINE configs 4 and 5).
+    ``smoke`` shrinks shapes so the plumbing runs on the CPU fake slice."""
+    import jax
+    import jax.numpy as jnp
+
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding, make_mesh
+    from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = make_mesh()
+    rng = np.random.default_rng(0)
+
+    if name == "resnet50":
+        from pyspark_tf_gke_tpu.models import ResNet50
+
+        batch_size, hw = (8, 64) if smoke else (64, 224)
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        batch = {
+            "image": rng.uniform(0, 1, (batch_size, hw, hw, 3)).astype(np.float32),
+            "label": rng.integers(0, 1000, (batch_size,)).astype(np.int32),
+        }
+        trainer = Trainer(model, TASKS["resnet"](), mesh, learning_rate=1e-3)
+    elif name == "bert":
+        from pyspark_tf_gke_tpu.models import BertConfig, BertForPretraining
+
+        batch_size, seq = (8, 32) if smoke else (32, 128)
+        cfg = BertConfig(**(dict(vocab_size=512, hidden_size=64, num_layers=2,
+                                 num_heads=4, intermediate_size=128)
+                            if smoke else {}))
+        model = BertForPretraining(cfg, mesh=mesh)
+        batch = {
+            "input_ids": rng.integers(0, cfg.vocab_size, (batch_size, seq)).astype(np.int32),
+            "attention_mask": np.ones((batch_size, seq), dtype=np.int32),
+            "labels": rng.integers(0, 2, (batch_size,)).astype(np.int32),
+        }
+        trainer = Trainer(model, TASKS["bert_classification"](), mesh,
+                          learning_rate=1e-4)
+    else:
+        raise SystemExit(f"unknown workload {name!r}; use resnet50 | bert")
+
+    state = trainer.init_state(make_rng(1337), batch)
+    sharding = batch_sharding(mesh)
+    global_batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
+
+    state, _, dt = measure(trainer, state, global_batch, steps)
+
+    return {
+        "metric": f"{name}_train_examples_per_sec_per_chip",
+        "value": round(batch_size * steps / dt / n_chips, 2),
+        "unit": "examples/sec/chip",
+        "vs_baseline": None,
+        "step_time_ms": round(dt / steps * 1000.0, 3),
+        "batch_size": batch_size,
+        "n_chips": n_chips,
+    }
+
+
 if __name__ == "__main__":
-    out = main()
+    args = [a for a in sys.argv[1:] if a != "--smoke"]
+    smoke = "--smoke" in sys.argv[1:]
+    workload = args[0] if args else "cnn"
+    if workload == "cnn":
+        # --smoke shrinks the flagship run too (small batch, few steps;
+        # batch stays divisible by the fake slice's 8 devices).
+        out = main(batch_size=8, steps=2) if smoke else main()
+    else:
+        out = bench_workload(workload, steps=2 if smoke else 50, smoke=smoke)
     print(json.dumps(out))

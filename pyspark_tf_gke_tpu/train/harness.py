@@ -1,7 +1,7 @@
 """Shared run scaffolding for the training entry points (cli.py,
 bert_finetune.py): the pieces every entry repeats — host-local batch
-sizing, init-sample preparation, checkpoint setup/restore/finalize, and
-the heartbeat/recovery plumbing from train/resilience.py."""
+sizing, checkpoint setup/restore/finalize, run-notes artifacts, and the
+heartbeat plumbing from train/resilience.py."""
 
 from __future__ import annotations
 
@@ -42,11 +42,43 @@ def make_checkpoint(
     return ckpt, state
 
 
-def finalize_run(ckpt: CheckpointManager, state, history: Dict, output_dir: str) -> None:
+def finalize_run(ckpt: CheckpointManager, state, history: Dict, output_dir: str,
+                 model_name: str = "model") -> None:
     """Terminal save: checkpoint + history.json (the reference's
-    model.save + history dump, train_tf_ps.py:674-679)."""
+    model.save + history dump, train_tf_ps.py:674-679) + run notes."""
     ckpt.save(state, history)
     save_history(output_dir, history)
+    save_run_notes(output_dir, model_name, state, history)
+
+
+def save_run_notes(output_dir: str, model_name: str, state, history: Dict) -> str:
+    """``<model_name>.txt`` run notes — the analog of the reference's
+    ``tf-model/150-320-by-256-B1-model.txt`` artifacts (param count/size,
+    hardware, epochs, final metrics)."""
+    path = os.path.join(output_dir, f"{model_name}.txt")
+    if jax.process_index() != 0:
+        return path
+    leaves = jax.tree.leaves(state.params)
+    n_params = sum(int(np.prod(l.shape)) for l in leaves)
+    n_bytes = sum(int(np.prod(l.shape)) * l.dtype.itemsize for l in leaves)
+    devices = jax.devices()
+    lines = [
+        f"model: {model_name}",
+        f"total params: {n_params:,}",
+        f"size: {n_bytes / (1 << 20):.2f} MB",
+        f"devices: {len(devices)}x {devices[0].platform}"
+        + (f" ({devices[0].device_kind})" if hasattr(devices[0], "device_kind") else ""),
+        f"processes: {jax.process_count()}",
+        f"final step: {int(jax.device_get(state.step))}",
+        f"epochs recorded: {len(history.get('loss', []))}",
+    ]
+    for key, vals in sorted(history.items()):
+        if vals:
+            lines.append(f"final {key}: {vals[-1]:.6g}")
+    os.makedirs(output_dir, exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return path
 
 
 def make_heartbeat(

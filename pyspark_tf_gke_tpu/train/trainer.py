@@ -159,6 +159,7 @@ class Trainer:
         self._train_step = None
         self._raw_train_step = None
         self._eval_step = None
+        self._debug_step = None
         self._scan_steps: Dict[int, Any] = {}
         self.state_shardings = None
 
@@ -274,6 +275,19 @@ class Trainer:
             self._build_steps()
         with self.mesh:
             return self._train_step(state, batch)
+
+    def debug_step(self, state: TrainState, batch: Dict[str, jax.Array]):
+        """Undonated train step for utils.debug determinism checks — the
+        input state stays valid, so the same (state, batch) can be
+        replayed and fingerprinted."""
+        if self._train_step is None:
+            self._build_steps()
+        if self._debug_step is None:
+            self._debug_step = jax.jit(
+                self._raw_train_step, out_shardings=(self.state_shardings, None)
+            )
+        with self.mesh:
+            return self._debug_step(state, batch)
 
     def multi_step(self, state: TrainState, batch: Dict[str, jax.Array], k: int):
         """Run ``k`` train steps on the same batch inside ONE dispatch via an

@@ -1,0 +1,77 @@
+"""Debug / correctness-checking subsystem.
+
+The reference has no race detection or sanitizers; its nearest analog is
+CodeQL static analysis plus strict input validation (SURVEY §5). The SPMD
+equivalents of "race bugs" are **non-determinism** (unstable reductions,
+seed leaks, host-order dependence) and **numeric poisoning** (NaN/Inf
+propagating through collectives), so this module provides the
+corresponding runtime checks:
+
+* :func:`nan_debug` — scoped ``jax_debug_nans``: any op producing NaN
+  raises at the op, not 500 steps later.
+* :func:`find_nonfinite` — walk a pytree, name every leaf containing
+  NaN/Inf (post-mortem for a poisoned TrainState).
+* :func:`tree_fingerprint` / :func:`check_determinism` — bitwise
+  fingerprint of a pytree / assert a function is run-to-run
+  deterministic. ``Trainer.debug_step`` (an undonated step) is the
+  intended target: the same state+batch must produce identical bits on
+  every run and on every mesh layout.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+from typing import Any, Callable, List, Tuple
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def nan_debug(enabled: bool = True):
+    """Enable jax_debug_nans within the scope (NaN → immediate error)."""
+    old = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enabled)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", old)
+
+
+def find_nonfinite(tree: Any, prefix: str = "") -> List[str]:
+    """Paths of leaves containing NaN/Inf, e.g. ``params/layer_0/kernel``."""
+    bad: List[str] = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        arr = np.asarray(jax.device_get(leaf))
+        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+            name = "/".join(
+                str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+            )
+            bad.append(f"{prefix}{name}")
+    return bad
+
+
+def tree_fingerprint(tree: Any) -> str:
+    """Order-stable SHA-256 over the raw bytes of every leaf."""
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(tree):
+        arr = np.asarray(jax.device_get(leaf))
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def check_determinism(
+    fn: Callable[[], Any], runs: int = 2
+) -> Tuple[bool, List[str]]:
+    """Run ``fn`` ``runs`` times; True when every output is bit-identical.
+
+    ``fn`` must be side-effect-free and undonated (donation invalidates
+    inputs after the first run — use ``Trainer.debug_step``, not
+    ``Trainer.step``).
+    """
+    prints = [tree_fingerprint(fn()) for _ in range(runs)]
+    return all(p == prints[0] for p in prints), prints
