@@ -129,3 +129,60 @@ def put_global_batch(batch: Dict[str, np.ndarray], sharding: NamedSharding) -> D
     return {
         k: jax.make_array_from_process_local_data(sharding, v) for k, v in batch.items()
     }
+
+
+def prefetch_to_device(
+    batches: Iterator[Dict[str, np.ndarray]],
+    sharding: NamedSharding,
+    size: int = 2,
+) -> Iterator[Dict[str, jax.Array]]:
+    """Stream ``put_global_batch``-ed batches with a background thread
+    keeping up to ``size`` batches resident on device ahead of the
+    consumer — host→device transfer overlaps the previous step's compute
+    (the tf.data ``prefetch(AUTOTUNE)`` analog, ``train_tf_ps.py:322``,
+    but placing *sharded global* arrays). ``size=0`` degrades to inline
+    transfer. Exceptions in the source iterator re-raise at the consumer.
+    """
+    if size <= 0:
+        for b in batches:
+            yield put_global_batch(b, sharding)
+        return
+
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    done = object()
+    stop = threading.Event()
+
+    def put_or_abort(item) -> bool:
+        """Blocking put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for b in batches:
+                if not put_or_abort(put_global_batch(b, sharding)):
+                    return
+            put_or_abort(done)
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            put_or_abort(e)
+
+    t = threading.Thread(target=worker, daemon=True, name="device-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is done:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()

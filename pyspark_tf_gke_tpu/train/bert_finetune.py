@@ -159,7 +159,8 @@ def main(argv=None) -> dict:
             checkpoint_manager=ckpt,
             heartbeat=make_heartbeat(args.output_dir, args.heartbeat_every_steps),
         )
-        finalize_run(ckpt, state, history, args.output_dir)
+        finalize_run(ckpt, state, history, args.output_dir,
+                     model_name="bert-finetune")
         return history
 
     return run_with_recovery(attempt_run, max_restarts=args.max_restarts)

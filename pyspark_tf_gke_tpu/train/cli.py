@@ -103,7 +103,7 @@ def run_csv_training(cfg: Config, fault_injector: Optional[FaultInjector] = None
         checkpoint_manager=ckpt, log_every=cfg.log_every_steps,
         heartbeat=_heartbeat(cfg), fault_injector=fault_injector,
     )
-    finalize_run(ckpt, state, history, cfg.output_dir)
+    finalize_run(ckpt, state, history, cfg.output_dir, model_name="mlp")
     return history
 
 
@@ -159,7 +159,8 @@ def run_image_training(cfg: Config, fault_injector: Optional[FaultInjector] = No
         checkpoint_manager=ckpt, log_every=cfg.log_every_steps,
         heartbeat=_heartbeat(cfg), fault_injector=fault_injector,
     )
-    finalize_run(ckpt, state, history, cfg.output_dir)
+    finalize_run(ckpt, state, history, cfg.output_dir,
+                 model_name="cnn-b1" if cfg.flat_layer else "cnn-a1")
     return history
 
 

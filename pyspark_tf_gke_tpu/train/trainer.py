@@ -339,18 +339,38 @@ class Trainer:
         log_every: int = 0,
         heartbeat=None,  # train.resilience.Heartbeat
         fault_injector=None,  # train.resilience.FaultInjector (chaos tests)
+        prefetch: int = 2,  # device-resident batches staged ahead (0 = inline)
     ) -> Tuple[TrainState, Dict[str, list]]:
         """Run the training loop; returns final state and a Keras-style
         history dict (the reference's ``history.history`` analog,
         ``train_tf_ps.py:674-679``), extended with the north-star timing
         metrics (step_time_ms, examples_per_sec)."""
-        from pyspark_tf_gke_tpu.data.pipeline import put_global_batch
+        from pyspark_tf_gke_tpu.data.pipeline import prefetch_to_device
 
         data_sharding = batch_sharding(self.mesh)
         history: Dict[str, list] = {}
         # Host-side mirror of state.step: one sync here, then pure
         # increments — no per-step device readback for liveness.
         global_step = int(jax.device_get(state.step))
+        device_batches = prefetch_to_device(batches, data_sharding, size=prefetch)
+        try:
+            return self._fit_epochs(
+                state, device_batches, epochs, steps_per_epoch, val_batches,
+                checkpoint_manager, log_every, heartbeat, fault_injector,
+                history, global_step,
+            )
+        finally:
+            # Stop the prefetch worker: it must not keep draining the
+            # caller's iterator after fit returns or raises (restart
+            # wrappers reuse that iterator).
+            device_batches.close()
+
+    def _fit_epochs(
+        self, state, device_batches, epochs, steps_per_epoch, val_batches,
+        checkpoint_manager, log_every, heartbeat, fault_injector,
+        history, global_step,
+    ):
+        from pyspark_tf_gke_tpu.data.pipeline import put_global_batch
 
         for epoch in range(epochs):
             # Metrics accumulate as device scalars — no host sync inside the
@@ -360,15 +380,15 @@ class Trainer:
             epoch_start = time.perf_counter()
             examples = 0
             for step_i in range(steps_per_epoch):
-                host_batch = next(batches)
-                global_batch = put_global_batch(host_batch, data_sharding)
+                global_batch = next(device_batches)
                 t0 = time.perf_counter()
                 state, metrics = self.step(state, global_batch)
                 if step_i == 0:
                     # first step includes compilation; keep it out of step-time stats
                     jax.block_until_ready(metrics)
                     t_first_step = time.perf_counter() - t0
-                examples += next(iter(host_batch.values())).shape[0] * jax.process_count()
+                # global rows = local rows x processes
+                examples += next(iter(global_batch.values())).shape[0]
                 global_step += 1
                 if heartbeat is not None:
                     heartbeat.beat(global_step)

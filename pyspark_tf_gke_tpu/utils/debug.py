@@ -39,28 +39,44 @@ def nan_debug(enabled: bool = True):
         jax.config.update("jax_debug_nans", old)
 
 
+def _local_arrays(leaf: Any):
+    """Host-examinable numpy views of a leaf: the whole array when fully
+    addressable, otherwise this process's addressable shards (multi-host
+    sharded state cannot be device_get as one array — each host checks
+    and fingerprints its own shards)."""
+    if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+        for shard in leaf.addressable_shards:
+            yield np.asarray(shard.data)
+    else:
+        yield np.asarray(jax.device_get(leaf))
+
+
 def find_nonfinite(tree: Any, prefix: str = "") -> List[str]:
-    """Paths of leaves containing NaN/Inf, e.g. ``params/layer_0/kernel``."""
+    """Paths of leaves containing NaN/Inf, e.g. ``params/layer_0/kernel``.
+    Multi-host: each process inspects its local shards."""
     bad: List[str] = []
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
     for path, leaf in flat:
-        arr = np.asarray(jax.device_get(leaf))
-        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
-            name = "/".join(
-                str(getattr(p, "key", getattr(p, "idx", p))) for p in path
-            )
-            bad.append(f"{prefix}{name}")
+        for arr in _local_arrays(leaf):
+            if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+                name = "/".join(
+                    str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+                )
+                bad.append(f"{prefix}{name}")
+                break
     return bad
 
 
 def tree_fingerprint(tree: Any) -> str:
-    """Order-stable SHA-256 over the raw bytes of every leaf."""
+    """Order-stable SHA-256 over the raw bytes of every leaf. Multi-host:
+    covers this process's addressable shards (a per-host fingerprint —
+    compare across hosts out of band to check cross-host agreement)."""
     h = hashlib.sha256()
     for leaf in jax.tree.leaves(tree):
-        arr = np.asarray(jax.device_get(leaf))
-        h.update(str(arr.shape).encode())
-        h.update(str(arr.dtype).encode())
-        h.update(arr.tobytes())
+        for arr in _local_arrays(leaf):
+            h.update(str(arr.shape).encode())
+            h.update(str(arr.dtype).encode())
+            h.update(arr.tobytes())
     return h.hexdigest()
 
 

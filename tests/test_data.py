@@ -121,3 +121,68 @@ def test_batch_iterator_partial_final_batch():
     assert sorted(np.concatenate(got).tolist()) == x.tolist()
     # next epoch starts from the top again
     assert (next(it)["x"] == x[:4]).all()
+
+
+# ---- device prefetch --------------------------------------------------------
+
+def test_prefetch_to_device_preserves_order_and_values(mesh_dp):
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding
+    from pyspark_tf_gke_tpu.data.pipeline import prefetch_to_device, put_global_batch
+
+    batches = [{"x": np.full((8, 2), i, dtype=np.float32)} for i in range(6)]
+    sharding = batch_sharding(mesh_dp)
+    fetched = list(prefetch_to_device(iter(batches), sharding, size=2))
+    inline = [put_global_batch(b, sharding) for b in batches]
+    assert len(fetched) == 6
+    for got, want in zip(fetched, inline):
+        np.testing.assert_array_equal(np.asarray(got["x"]), np.asarray(want["x"]))
+        assert got["x"].sharding == want["x"].sharding
+
+
+def test_prefetch_to_device_relays_exceptions(mesh_dp):
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding
+    from pyspark_tf_gke_tpu.data.pipeline import prefetch_to_device
+
+    def bad():
+        yield {"x": np.zeros((8, 2), dtype=np.float32)}
+        raise RuntimeError("source died")
+
+    it = prefetch_to_device(bad(), batch_sharding(mesh_dp), size=2)
+    next(it)
+    with pytest.raises(RuntimeError, match="source died"):
+        list(it)
+
+
+def test_prefetch_size_zero_inline(mesh_dp):
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding
+    from pyspark_tf_gke_tpu.data.pipeline import prefetch_to_device
+
+    batches = [{"x": np.ones((8, 2), dtype=np.float32)}]
+    out = list(prefetch_to_device(iter(batches), batch_sharding(mesh_dp), size=0))
+    assert len(out) == 1
+
+
+def test_fit_history_identical_with_and_without_prefetch(mesh_dp):
+    """Prefetch must not change training semantics: same data order, same
+    losses bit-for-bit."""
+    from pyspark_tf_gke_tpu.data.pipeline import BatchIterator
+    from pyspark_tf_gke_tpu.models import MLPClassifier
+    from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    rng = np.random.default_rng(0)
+    data = {
+        "x": rng.normal(size=(64, 3)).astype(np.float32),
+        "y": rng.integers(0, 4, 64).astype(np.int32),
+    }
+
+    def run(prefetch):
+        trainer = Trainer(MLPClassifier(num_classes=4), TASKS["classification"](),
+                          mesh_dp)
+        state = trainer.init_state(make_rng(0), data)
+        it = BatchIterator(data, 16, seed=7)
+        _, history = trainer.fit(state, it, epochs=2, steps_per_epoch=4,
+                                 prefetch=prefetch)
+        return history["loss"]
+
+    assert run(0) == run(2)
