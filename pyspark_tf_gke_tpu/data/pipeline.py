@@ -186,3 +186,7 @@ def prefetch_to_device(
             yield item
     finally:
         stop.set()
+        # Wait for the worker to actually stop: a caller may hand the
+        # same source iterator to a new prefetcher (restart-with-resume),
+        # and two threads on one generator is undefined.
+        t.join()

@@ -140,10 +140,9 @@ def main(argv=None) -> dict:
                 "labels": raw["label"].reshape(-1),
             }
 
-    it = batches()
-    # First local batch traces init only (the trainer tiles it up to one
-    # row per global data shard); the iterator continues from the next.
-    state = trainer.init_state(make_rng(args.seed), next(it))
+    # A throwaway iterator provides the init-tracing batch (the trainer
+    # tiles it up to one row per global data shard).
+    state = trainer.init_state(make_rng(args.seed), next(batches()))
     n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(state.params))
     logger.info("Model: %d params (%.1fM), mesh=%s", n_params, n_params / 1e6,
                 dict(mesh.shape))
@@ -154,8 +153,10 @@ def main(argv=None) -> dict:
             args.output_dir, args.checkpoint_every_steps, state,
             args.resume or attempt > 0,
         )
+        # Fresh stream per attempt: the previous attempt's prefetcher may
+        # have advanced a shared iterator past unseen batches.
         state, history = trainer.fit(
-            state, it, args.epochs, args.steps_per_epoch,
+            state, batches(), args.epochs, args.steps_per_epoch,
             checkpoint_manager=ckpt,
             heartbeat=make_heartbeat(args.output_dir, args.heartbeat_every_steps),
         )
