@@ -38,6 +38,7 @@ from pyspark_tf_gke_tpu.train.harness import (
     local_batch_size,
     make_checkpoint,
     make_heartbeat,
+    make_optimizer,
 )
 from pyspark_tf_gke_tpu.train.resilience import FaultInjector, run_with_recovery
 from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
@@ -77,11 +78,16 @@ def run_csv_training(cfg: Config, fault_injector: Optional[FaultInjector] = None
     local_bs = local_batch_size(cfg.batch_size)
     train_iter = BatchIterator({"x": Xt, "y": yt}, local_bs, seed=cfg.seed)
     steps = cfg.steps_per_epoch or train_iter.steps_per_epoch
+    # With accumulation an optimizer step consumes accum microbatches; keep
+    # one epoch = one dataset pass.
+    steps = -(-steps // cfg.grad_accum_steps)
 
     mesh = make_mesh(cfg.mesh_axes() or None)
     model = build_model("mlp", num_classes=num_classes)
-    trainer = Trainer(model, TASKS["classification"](), mesh,
-                      learning_rate=cfg.learning_rate, fsdp_min_size=cfg.fsdp_min_size)
+    tx = make_optimizer(cfg.learning_rate, cfg.lr_schedule,
+                        total_steps=cfg.epochs * steps, warmup_steps=cfg.warmup_steps)
+    trainer = Trainer(model, TASKS["classification"](), mesh, tx=tx,
+                      fsdp_min_size=cfg.fsdp_min_size)
     # Unsliced host-shard arrays as the init sample: shape-only tracing, and
     # the trainer trims to exactly one row per data shard itself.
     state = trainer.init_state(make_rng(cfg.seed), {"x": Xt, "y": yt})
@@ -102,6 +108,7 @@ def run_csv_training(cfg: Config, fault_injector: Optional[FaultInjector] = None
         state, train_iter, cfg.epochs, steps, val_batches=val_batches,
         checkpoint_manager=ckpt, log_every=cfg.log_every_steps,
         heartbeat=_heartbeat(cfg), fault_injector=fault_injector,
+        grad_accum=cfg.grad_accum_steps,
     )
     finalize_run(ckpt, state, history, cfg.output_dir, model_name="mlp")
     return history
@@ -128,6 +135,7 @@ def run_image_training(cfg: Config, fault_injector: Optional[FaultInjector] = No
         {"image": images_t, "target": targets_t}, local_bs, seed=cfg.seed
     )
     steps = cfg.steps_per_epoch or train_iter.steps_per_epoch
+    steps = -(-steps // cfg.grad_accum_steps)
 
     if cfg.model not in ("", "cnn"):
         raise ValueError(
@@ -136,8 +144,10 @@ def run_image_training(cfg: Config, fault_injector: Optional[FaultInjector] = No
         )
     mesh = make_mesh(cfg.mesh_axes() or None)
     model = build_model("cnn", flat=cfg.flat_layer, dtype=_dtype(cfg.compute_dtype))
-    trainer = Trainer(model, TASKS["regression"](), mesh,
-                      learning_rate=cfg.learning_rate, fsdp_min_size=cfg.fsdp_min_size)
+    tx = make_optimizer(cfg.learning_rate, cfg.lr_schedule,
+                        total_steps=cfg.epochs * steps, warmup_steps=cfg.warmup_steps)
+    trainer = Trainer(model, TASKS["regression"](), mesh, tx=tx,
+                      fsdp_min_size=cfg.fsdp_min_size)
     state = trainer.init_state(
         make_rng(cfg.seed), {"image": images_t, "target": targets_t}
     )
@@ -158,6 +168,7 @@ def run_image_training(cfg: Config, fault_injector: Optional[FaultInjector] = No
         state, train_iter, cfg.epochs, steps, val_batches=val_batches,
         checkpoint_manager=ckpt, log_every=cfg.log_every_steps,
         heartbeat=_heartbeat(cfg), fault_injector=fault_injector,
+        grad_accum=cfg.grad_accum_steps,
     )
     finalize_run(ckpt, state, history, cfg.output_dir,
                  model_name="cnn-b1" if cfg.flat_layer else "cnn-a1")

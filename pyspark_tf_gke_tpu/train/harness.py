@@ -15,6 +15,38 @@ from pyspark_tf_gke_tpu.train.checkpoint import CheckpointManager, save_history
 from pyspark_tf_gke_tpu.train.resilience import Heartbeat
 
 
+def make_optimizer(
+    learning_rate: float,
+    schedule: str = "constant",
+    total_steps: int = 0,
+    warmup_steps: int = 0,
+):
+    """Adam with an optax LR schedule: constant | cosine | warmup_cosine.
+    (The reference uses bare constant-LR Adam, train_tf_ps.py:339,606;
+    schedules are the expected upgrade for the ResNet/BERT configs.)"""
+    import optax
+
+    if schedule not in ("constant", "cosine", "warmup_cosine"):
+        raise ValueError(
+            f"unknown lr schedule {schedule!r}; use constant | cosine | warmup_cosine"
+        )
+    if schedule != "constant" and total_steps <= 0:
+        raise ValueError(
+            f"lr schedule {schedule!r} needs total_steps > 0 (a decay over 0 "
+            "steps would pin the learning rate at ~0 for the whole run)"
+        )
+    if schedule == "constant":
+        lr = learning_rate
+    elif schedule == "cosine":
+        lr = optax.cosine_decay_schedule(learning_rate, total_steps)
+    elif schedule == "warmup_cosine":
+        lr = optax.warmup_cosine_decay_schedule(
+            0.0, learning_rate, max(warmup_steps, 1),
+            max(total_steps, warmup_steps + 1),
+        )
+    return optax.adam(lr)
+
+
 def local_batch_size(global_batch: int) -> int:
     """Per-host batch from the GLOBAL batch size (reference semantics:
     batch flags are global; each host feeds its slice)."""

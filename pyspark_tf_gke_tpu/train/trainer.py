@@ -137,6 +137,23 @@ TASKS = {
 }
 
 
+class _CountingIterator:
+    """Pass-through iterator that tallies consumed global rows (for
+    examples/sec accounting across plain and grad-accum steps)."""
+
+    def __init__(self, it):
+        self._it = it
+        self.rows = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = next(self._it)
+        self.rows += next(iter(batch.values())).shape[0]
+        return batch
+
+
 class Trainer:
     """Builds sharded state, compiles the step, runs the epoch loop."""
 
@@ -160,6 +177,9 @@ class Trainer:
         self._raw_train_step = None
         self._eval_step = None
         self._debug_step = None
+        self._grad_step = None
+        self._accum_add = None
+        self._apply_step = None
         self._scan_steps: Dict[int, Any] = {}
         self.state_shardings = None
 
@@ -276,6 +296,70 @@ class Trainer:
         with self.mesh:
             return self._train_step(state, batch)
 
+    def _build_accum_steps(self):
+        """Two-phase step for gradient accumulation: grads-only compute per
+        microbatch, one optimizer apply per A microbatches. Emulates an
+        A-times-larger global batch with the same device memory."""
+        model, task = self.model, self.task
+
+        def grad_step(state: TrainState, batch):
+            def loss_fn(params):
+                variables = {"params": params}
+                if state.batch_stats is not None:
+                    variables["batch_stats"] = state.batch_stats
+                preds, new_bs = task.forward(model, variables, batch, True, True)
+                loss, metrics = task.loss_and_metrics(preds, batch)
+                return loss, (metrics, new_bs)
+
+            (_, (metrics, new_bs)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
+            return grads, metrics, new_bs
+
+        def apply_step(state: TrainState, grads, new_batch_stats):
+            if task.has_batch_stats and new_batch_stats is not None:
+                return state.apply_gradients(grads, batch_stats=new_batch_stats)
+            return state.apply_gradients(grads)
+
+        def apply_mean(state: TrainState, grads_sum, bs_sum, accum):
+            grads = jax.tree.map(lambda g: g / accum, grads_sum)
+            bs = (
+                None if bs_sum is None
+                else jax.tree.map(lambda b: b / accum, bs_sum)
+            )
+            return apply_step(state, grads, bs)
+
+        param_shardings = (
+            self.state_shardings.params if self.state_shardings is not None else None
+        )
+        self._grad_step = jax.jit(grad_step, out_shardings=(param_shardings, None, None))
+        # One fused add per accumulation round, donating the accumulator —
+        # no per-leaf host dispatches and no extra live gradient buffer.
+        self._accum_add = jax.jit(
+            lambda acc, new: jax.tree.map(jnp.add, acc, new), donate_argnums=0
+        )
+        self._apply_step = jax.jit(
+            apply_mean, donate_argnums=(0, 1), out_shardings=self.state_shardings
+        )
+
+    def accum_step(self, state: TrainState, batches, accum: int):
+        """One optimizer step from ``accum`` consecutive global batches
+        pulled off ``batches`` (an iterator of device-resident batch
+        dicts). Gradients AND batch-norm statistics are averaged over the
+        microbatches. Returns (state, averaged metrics)."""
+        if self._grad_step is None:
+            self._build_accum_steps()
+        with self.mesh:
+            acc = None  # (grads_sum, metrics_sum, bs_sum)
+            for _ in range(accum):
+                grads, metrics, new_bs = self._grad_step(state, next(batches))
+                new = (grads, metrics) if new_bs is None else (grads, metrics, new_bs)
+                acc = new if acc is None else self._accum_add(acc, new)
+            grads_sum, metrics_sum = acc[0], acc[1]
+            bs_sum = acc[2] if len(acc) == 3 else None
+            state = self._apply_step(state, grads_sum, bs_sum, accum)
+        return state, {k: v / accum for k, v in metrics_sum.items()}
+
     def debug_step(self, state: TrainState, batch: Dict[str, jax.Array]):
         """Undonated train step for utils.debug determinism checks — the
         input state stays valid, so the same (state, batch) can be
@@ -340,6 +424,7 @@ class Trainer:
         heartbeat=None,  # train.resilience.Heartbeat
         fault_injector=None,  # train.resilience.FaultInjector (chaos tests)
         prefetch: int = 2,  # device-resident batches staged ahead (0 = inline)
+        grad_accum: int = 1,  # microbatches accumulated per optimizer step
     ) -> Tuple[TrainState, Dict[str, list]]:
         """Run the training loop; returns final state and a Keras-style
         history dict (the reference's ``history.history`` analog,
@@ -352,23 +437,24 @@ class Trainer:
         # Host-side mirror of state.step: one sync here, then pure
         # increments — no per-step device readback for liveness.
         global_step = int(jax.device_get(state.step))
-        device_batches = prefetch_to_device(batches, data_sharding, size=prefetch)
+        prefetched = prefetch_to_device(batches, data_sharding, size=prefetch)
+        device_batches = _CountingIterator(prefetched)
         try:
             return self._fit_epochs(
                 state, device_batches, epochs, steps_per_epoch, val_batches,
                 checkpoint_manager, log_every, heartbeat, fault_injector,
-                history, global_step,
+                history, global_step, grad_accum,
             )
         finally:
             # Stop the prefetch worker: it must not keep draining the
             # caller's iterator after fit returns or raises (restart
             # wrappers reuse that iterator).
-            device_batches.close()
+            prefetched.close()
 
     def _fit_epochs(
         self, state, device_batches, epochs, steps_per_epoch, val_batches,
         checkpoint_manager, log_every, heartbeat, fault_injector,
-        history, global_step,
+        history, global_step, grad_accum,
     ):
         from pyspark_tf_gke_tpu.data.pipeline import put_global_batch
 
@@ -380,15 +466,18 @@ class Trainer:
             epoch_start = time.perf_counter()
             examples = 0
             for step_i in range(steps_per_epoch):
-                global_batch = next(device_batches)
+                rows_before = device_batches.rows
                 t0 = time.perf_counter()
-                state, metrics = self.step(state, global_batch)
+                if grad_accum > 1:
+                    state, metrics = self.accum_step(state, device_batches, grad_accum)
+                else:
+                    state, metrics = self.step(state, next(device_batches))
                 if step_i == 0:
                     # first step includes compilation; keep it out of step-time stats
                     jax.block_until_ready(metrics)
                     t_first_step = time.perf_counter() - t0
-                # global rows = local rows x processes
-                examples += next(iter(global_batch.values())).shape[0]
+                # global rows consumed this optimizer step
+                examples += device_batches.rows - rows_before
                 global_step += 1
                 if heartbeat is not None:
                     heartbeat.beat(global_step)

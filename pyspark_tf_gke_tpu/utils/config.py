@@ -77,6 +77,9 @@ class Config:
     model: str = _env("MODEL", "")  # "" = auto by data mode | mlp | cnn | resnet50 | bert
     flat_layer: bool = _env_bool("FLAT_LAYER", False)  # CNN: Flatten (B1) vs GAP (A1) head
     learning_rate: float = _env_float("LEARNING_RATE", 1e-3)
+    lr_schedule: str = _env("LR_SCHEDULE", "constant")  # constant|cosine|warmup_cosine
+    warmup_steps: int = _env_int("WARMUP_STEPS", 0)
+    grad_accum_steps: int = _env_int("GRAD_ACCUM_STEPS", 1)
     compute_dtype: str = _env("COMPUTE_DTYPE", "bfloat16")
 
     # --- mesh / parallelism (compile-time sharding, replaces the
@@ -143,6 +146,11 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
                    help="empty = auto: mlp for CSV data, cnn for image data")
     p.add_argument("--flat-layer", action="store_true", default=cfg.flat_layer)
     p.add_argument("--learning-rate", type=float, default=cfg.learning_rate)
+    p.add_argument("--lr-schedule", default=cfg.lr_schedule,
+                   choices=["constant", "cosine", "warmup_cosine"])
+    p.add_argument("--warmup-steps", type=int, default=cfg.warmup_steps)
+    p.add_argument("--grad-accum-steps", type=int, default=cfg.grad_accum_steps,
+                   help="microbatches accumulated per optimizer step")
     p.add_argument("--compute-dtype", default=cfg.compute_dtype)
     p.add_argument("--mesh-shape", default=cfg.mesh_shape, help='e.g. "dp=4,fsdp=2"; empty → all devices on dp')
     p.add_argument("--coordinator-addr", default=cfg.coordinator_addr)
