@@ -111,9 +111,14 @@ class MoELayer(nn.Module):
                 top1_mask = mask
             remaining = remaining * (1.0 - mask)
 
-        # Normalize combine weights over the k selected experts.
-        denom = jnp.sum(combine, axis=(2, 3), keepdims=True)
-        combine = combine / jnp.maximum(denom, 1e-9)
+        # Normalize combine weights over the k selected experts. For k == 1
+        # the raw softmax gate must be kept (Switch Transformer): dividing by
+        # itself would make every kept weight exactly 1 and cut the router
+        # out of the differentiable forward path, leaving only the aux loss
+        # to train it.
+        if k > 1:
+            denom = jnp.sum(combine, axis=(2, 3), keepdims=True)
+            combine = combine / jnp.maximum(denom, 1e-9)
 
         # Switch-style load-balance aux loss: E * Σ_e fraction_e · prob_e.
         frac = jnp.mean(top1_mask, axis=(0, 1))  # [E]

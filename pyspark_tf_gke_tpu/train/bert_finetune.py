@@ -86,6 +86,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--max-restarts", type=int, default=int(e("MAX_RESTARTS", "0")))
     p.add_argument("--heartbeat-every-steps", type=int,
                    default=int(e("HEARTBEAT_EVERY_STEPS", "10")))
+    p.add_argument("--heartbeat-file", default=e("HEARTBEAT_FILE", ""),
+                   help="node-local heartbeat path for the k8s exec probe "
+                        "(default: <output-dir>/heartbeat.json)")
     return p.parse_args(argv)
 
 
@@ -158,7 +161,8 @@ def main(argv=None) -> dict:
         state, history = trainer.fit(
             state, batches(), args.epochs, args.steps_per_epoch,
             checkpoint_manager=ckpt,
-            heartbeat=make_heartbeat(args.output_dir, args.heartbeat_every_steps),
+            heartbeat=make_heartbeat(args.output_dir, args.heartbeat_every_steps,
+                                     args.heartbeat_file),
         )
         finalize_run(ckpt, state, history, args.output_dir,
                      model_name="bert-finetune")

@@ -122,7 +122,7 @@ def run_with_recovery(
     train_once: Callable[[int], T],
     max_restarts: int = 2,
     retry_delay_s: float = 0.0,
-    fatal: Sequence[type] = (KeyboardInterrupt,),
+    fatal: Sequence[type] = (KeyboardInterrupt, SystemExit, GeneratorExit),
 ) -> T:
     """Run ``train_once(attempt)`` with restart-on-failure.
 

@@ -60,6 +60,41 @@ def test_moe_capacity_drop_finite():
     assert nonzero_rows.sum(axis=1).max() <= 2
 
 
+def test_moe_top1_router_gets_main_path_gradient():
+    """Switch-style top-1 routing must keep the raw softmax gate as the
+    combine weight: normalizing (gate/gate == 1) would cut the router out
+    of the differentiable forward path, leaving only the aux loss to
+    train it."""
+    layer = MoELayer(num_experts=4, hidden_size=8, intermediate_size=16,
+                     top_k=1, capacity_factor=2.0, dtype=jnp.float32)
+    x = jax.random.normal(make_rng(0), (2, 8, 8), jnp.float32)
+    variables = layer.init(make_rng(1), x)
+
+    def out_only_loss(params):
+        out, _aux = layer.apply({"params": params}, x)
+        return jnp.sum(out ** 2)  # deliberately excludes the aux loss
+
+    grads = jax.grad(out_only_loss)(nn.meta.unbox(variables["params"]))
+    router_grad_norm = float(jnp.linalg.norm(grads["router"]))
+    assert router_grad_norm > 1e-6
+
+    # The combine weight must be the raw gate (< 1 for 4 experts), not a
+    # normalized 1.0: out[token] == gate[e*] * FFN_{e*}(x[token]).
+    out, _ = layer.apply(variables, x)
+    p = nn.meta.unbox(variables["params"])
+    gates = jax.nn.softmax(x @ p["router"], axis=-1)
+    e_star = np.asarray(jnp.argmax(gates, axis=-1))  # [B,S]
+    expected = np.zeros_like(np.asarray(out))
+    for bi in range(x.shape[0]):
+        for si in range(x.shape[1]):
+            e = e_star[bi, si]
+            ffn = nn.gelu(x[bi, si] @ p["w_in"][e] + p["b_in"][e],
+                          approximate=True) @ p["w_out"][e] + p["b_out"][e]
+            expected[bi, si] = float(gates[bi, si, e]) * np.asarray(ffn)
+    assert float(np.max(np.asarray(gates))) < 1.0
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-4)
+
+
 def test_moe_ep_sharding_parity(devices):
     """Same params, ep=4 mesh vs single device: identical outputs."""
     layer = MoELayer(num_experts=4, hidden_size=32, intermediate_size=64,
