@@ -190,12 +190,14 @@ def main(batch_size: int = 32, steps: int = 100) -> dict:
 
 
 def bench_workload(name: str, steps: int = 50, smoke: bool = False,
-                   use_flash=None) -> dict:
+                   use_flash=None, seq_override=None) -> dict:
     """Secondary workloads: resnet50 / bert (BASELINE configs 4 and 5).
     ``smoke`` shrinks shapes so the plumbing runs on the CPU fake slice.
-    ``use_flash`` (bert only): None = model default (flash on TPU),
-    True/False forces the Pallas flash-attention path on/off so the
-    delta is measurable (``--flash`` / ``--no-flash``)."""
+    ``use_flash`` (bert only): None = model default (flash auto on TPU at
+    seq >= FLASH_MIN_SEQ), True/False forces the Pallas path on/off so
+    the delta is measurable (``--flash`` / ``--no-flash``).
+    ``seq_override`` (bert only, ``--seq N``): long-context variant —
+    batch is scaled down to hold tokens/step constant."""
     import jax
     import jax.numpy as jnp
 
@@ -224,12 +226,21 @@ def bench_workload(name: str, steps: int = 50, smoke: bool = False,
         from pyspark_tf_gke_tpu.models import BertConfig, BertForPretraining
 
         batch_size, seq = (8, 32) if smoke else (32, 128)
+        if seq_override:
+            seq = int(seq_override)
+            # ~constant tokens/step, rounded up to a multiple of the data
+            # shards so batch_sharding can split the leading dim.
+            batch_size = max(batch_size * 128 // seq, 1)
+            batch_size = -(-batch_size // n_chips) * n_chips
         cfg_kwargs = (dict(vocab_size=512, hidden_size=64, num_layers=2,
                            num_heads=4, intermediate_size=128)
                       if smoke else {})
+        if seq > 512:
+            cfg_kwargs["max_position_embeddings"] = seq
+        if use_flash is not None:
+            cfg_kwargs["use_flash"] = use_flash
         cfg = BertConfig(**cfg_kwargs)
-        model_kwargs = {} if use_flash is None else {"use_flash": use_flash}
-        model = BertForPretraining(cfg, mesh=mesh, **model_kwargs)
+        model = BertForPretraining(cfg, mesh=mesh)
         batch = {
             "input_ids": rng.integers(0, cfg.vocab_size, (batch_size, seq)).astype(np.int32),
             "attention_mask": np.ones((batch_size, seq), dtype=np.int32),
@@ -237,7 +248,10 @@ def bench_workload(name: str, steps: int = 50, smoke: bool = False,
         }
         trainer = Trainer(model, TASKS["bert_classification"](), mesh,
                           learning_rate=1e-4)
-        extra["flash"] = bool(getattr(model, "use_flash", False))
+        from pyspark_tf_gke_tpu.models.bert import resolve_use_flash
+
+        extra["flash"] = resolve_use_flash(cfg, seq)
+        extra["seq_len"] = seq
     else:
         raise SystemExit(f"unknown workload {name!r}; use cnn | resnet50 | bert | io")
 
@@ -325,6 +339,23 @@ def bench_io(smoke: bool = False) -> dict:
 # ---- orchestrator ----------------------------------------------------------
 
 
+_VALUE_FLAGS = ("--seq",)
+
+
+def _positionals(argv) -> list:
+    """Positional args with flags AND their values stripped (so
+    ``--seq 2048`` never masquerades as the workload name)."""
+    out, skip = [], False
+    for a in argv:
+        if skip:
+            skip = False
+        elif a in _VALUE_FLAGS:
+            skip = True
+        elif not a.startswith("--"):
+            out.append(a)
+    return out
+
+
 def _error_json(workload: str, stage: str, detail: str) -> dict:
     return {
         "metric": f"{workload}_train_images_per_sec_per_chip" if workload == "cnn"
@@ -365,8 +396,11 @@ def probe_backend() -> bool:
 
 
 def orchestrate(argv) -> int:
-    workload = next((a for a in argv if not a.startswith("--")), "cnn")
-    if not probe_backend():
+    positionals = _positionals(argv)
+    workload = positionals[0] if positionals else "cnn"
+    # The io workload is host-only (TFRecord read/write, no devices) —
+    # don't let a down backend block the one bench that doesn't need it.
+    if workload != "io" and not probe_backend():
         print(json.dumps(_error_json(
             workload, "probe",
             f"backend attach failed after {PROBE_ATTEMPTS} attempts "
@@ -400,7 +434,7 @@ def orchestrate(argv) -> int:
 
 
 def run_bench(argv) -> dict:
-    args = [a for a in argv if not a.startswith("--")]
+    args = _positionals(argv)
     smoke = "--smoke" in argv
     workload = args[0] if args else "cnn"
     if workload == "cnn":
@@ -410,8 +444,11 @@ def run_bench(argv) -> dict:
     if workload == "io":
         return bench_io(smoke=smoke)
     use_flash = True if "--flash" in argv else (False if "--no-flash" in argv else None)
+    seq = None
+    if "--seq" in argv:
+        seq = int(argv[argv.index("--seq") + 1])
     return bench_workload(workload, steps=2 if smoke else 50, smoke=smoke,
-                          use_flash=use_flash)
+                          use_flash=use_flash, seq_override=seq)
 
 
 if __name__ == "__main__":

@@ -11,8 +11,14 @@ designed TPU-first:
   ``sp`` shards the sequence dimension of activations;
 * attention dispatches to ``ops.ring_attention`` (default) or
   ``ops.ulysses_attention`` (``sp_impl="ulysses"``) when the mesh has an
-  ``sp`` axis > 1 — long-context sequence parallelism over ICI — and to
-  plain MXU attention otherwise;
+  ``sp`` axis > 1 — long-context sequence parallelism over ICI — on TPU
+  with sp=1 it defaults to the **Pallas flash-attention kernel**
+  (``ops.pallas.flash_attention``), and to plain MXU attention otherwise;
+* LayerNorms default to the **fused Pallas kernel**
+  (``ops.pallas.layernorm``) on TPU, plain XLA-fused math elsewhere;
+* Pallas calls are wrapped in ``jax.shard_map`` whenever the mesh shards
+  the batch/heads axes — the SPMD partitioner cannot split an opaque
+  custom call, so without this a dp>1 mesh would replicate the kernel;
 * bfloat16 compute, float32 params and softmax accumulation.
 """
 
@@ -22,14 +28,37 @@ import dataclasses
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
 from pyspark_tf_gke_tpu.ops.attention import (
     dot_product_attention,
     ring_attention,
     ulysses_attention,
 )
+from pyspark_tf_gke_tpu.parallel.mesh import DATA_AXES
+
+
+def on_tpu() -> bool:
+    """True when the active backend compiles Pallas TPU kernels."""
+    return jax.default_backend() in ("tpu", "axon")
+
+
+# Auto-flash threshold (measured on v5e, fwd+bwd per train step): below
+# this sequence length XLA's fused dense attention wins (kernel dispatch
+# and unfusable reshapes dominate); at/above it the Pallas kernel wins —
+# 1.2x at S=1024, 2.3x at S=4096, 6x at S=8192 (where dense hits the
+# S^2-materialization memory cliff).
+FLASH_MIN_SEQ = 512
+
+
+def resolve_use_flash(cfg: "BertConfig", seq_len: int) -> bool:
+    """The model's flash-vs-dense dispatch, resolved for a sequence
+    length. Single source of truth — bench.py reports this too."""
+    if cfg.use_flash is not None:
+        return cfg.use_flash
+    return on_tpu() and seq_len >= FLASH_MIN_SEQ
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,7 +73,12 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     dtype: Any = jnp.bfloat16
     remat: bool = False
-    use_flash: bool = False  # Pallas flash-attention kernel (TPU; sp=1 only)
+    # Pallas flash-attention kernel (sp=1 only). None = auto: on for TPU
+    # backends when seq >= FLASH_MIN_SEQ, off elsewhere (the dense path;
+    # tests force True with the interpret-mode kernel).
+    use_flash: Optional[bool] = None
+    # Pallas fused LayerNorm. None = auto: on for TPU backends.
+    use_fused_ln: Optional[bool] = None
     # Sequence-parallel implementation when the mesh has sp>1:
     # "ring" (ppermute ring, unbounded S) or "ulysses" (all-to-all,
     # needs heads divisible by sp; cheaper at moderate S).
@@ -81,12 +115,93 @@ def _dense(features, kernel_axes, cfg: BertConfig, name=None):
     )
 
 
-def _layernorm(cfg: BertConfig, name=None):
-    return nn.LayerNorm(
+def _data_shards(mesh: Optional[Mesh], *axes: str) -> int:
+    if mesh is None:
+        return 1
+    out = 1
+    for a in axes:
+        out *= mesh.shape.get(a, 1)
+    return out
+
+
+class FusedLayerNorm(nn.Module):
+    """LayerNorm on the Pallas fused kernel (``ops.pallas.layernorm``) —
+    one VMEM pass instead of several HBM round-trips. Same param names
+    ("scale"/"bias") and init as ``nn.LayerNorm``, so checkpoints are
+    interchangeable. Falls back to plain jnp math (identical closed form,
+    f32 statistics) off-TPU or when ``use_fused=False``."""
+
+    epsilon: float = 1e-12
+    dtype: Any = jnp.float32
+    mesh: Optional[Mesh] = None
+    use_fused: Optional[bool] = None
+
+    @nn.compact
+    def __call__(self, x, residual=None):
+        """``residual`` is summed into ``x`` *inside* the fused kernel
+        (``y = LN(x + residual)``) — the transformer-block pattern; the
+        unfused path adds it in-graph (XLA fuses that itself)."""
+        d = x.shape[-1]
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("norm",)),
+            (d,), jnp.float32,
+        )
+        bias = self.param(
+            "bias",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("norm",)),
+            (d,), jnp.float32,
+        )
+        fused = self.use_fused if self.use_fused is not None else on_tpu()
+        if fused:
+            from pyspark_tf_gke_tpu.ops.pallas.layernorm import fused_layernorm
+
+            n_shards = _data_shards(self.mesh, "dp", "fsdp", "sp")
+            if n_shards > 1:
+                # LN is row-wise: shard rows (batch and, if 3D, seq) and
+                # run the kernel per shard. Scale/bias replicated.
+                row_spec = (
+                    P(DATA_AXES, "sp", None) if x.ndim == 3 else P(DATA_AXES, None)
+                )
+                if residual is None:
+                    fn = jax.shard_map(
+                        lambda xx, ss, bb: fused_layernorm(xx, ss, bb, eps=self.epsilon),
+                        mesh=self.mesh,
+                        in_specs=(row_spec, P(None), P(None)),
+                        out_specs=row_spec,
+                        check_vma=False,
+                    )
+                    y = fn(x, scale, bias)
+                else:
+                    fn = jax.shard_map(
+                        lambda xx, rr, ss, bb: fused_layernorm(
+                            xx, ss, bb, eps=self.epsilon, residual=rr),
+                        mesh=self.mesh,
+                        in_specs=(row_spec, row_spec, P(None), P(None)),
+                        out_specs=row_spec,
+                        check_vma=False,
+                    )
+                    y = fn(x, residual, scale, bias)
+            else:
+                y = fused_layernorm(x, scale, bias, eps=self.epsilon,
+                                    residual=residual)
+            return y.astype(self.dtype)
+        if residual is not None:
+            x = x + residual
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(-1, keepdims=True)
+        xc = xf - mean
+        var = (xc * xc).mean(-1, keepdims=True)
+        y = xc * jax.lax.rsqrt(var + self.epsilon) * scale[None, :] + bias[None, :]
+        return y.astype(self.dtype)
+
+
+def _layernorm(cfg: BertConfig, mesh: Optional[Mesh] = None, name=None):
+    return FusedLayerNorm(
         epsilon=cfg.layer_norm_eps,
         dtype=cfg.dtype,
-        scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), ("norm",)),
-        bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("norm",)),
+        mesh=mesh,
+        use_fused=cfg.use_fused_ln,
         name=name,
     )
 
@@ -112,13 +227,28 @@ class BertSelfAttention(nn.Module):
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"))
 
         use_sp = self.mesh is not None and self.mesh.shape.get("sp", 1) > 1
+        use_flash = resolve_use_flash(cfg, s)
         if use_sp:
             sp_fn = ulysses_attention if cfg.sp_impl == "ulysses" else ring_attention
             out = sp_fn(q, k, v, self.mesh, kv_mask=mask, axis="sp")
-        elif cfg.use_flash:
+        elif use_flash:
             from pyspark_tf_gke_tpu.ops.pallas.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, kv_mask=mask)
+            if _data_shards(self.mesh, "dp", "fsdp", "tp") > 1:
+                # Kernel per shard: batch over the data axes, heads over
+                # tp. Without this the partitioner replicates the opaque
+                # Pallas custom call on every chip.
+                qkv_spec = P(DATA_AXES, None, "tp", None)
+                fn = jax.shard_map(
+                    lambda qq, kk, vv, mm: flash_attention(qq, kk, vv, kv_mask=mm),
+                    mesh=self.mesh,
+                    in_specs=(qkv_spec,) * 3 + (P(DATA_AXES, None),),
+                    out_specs=qkv_spec,
+                    check_vma=False,
+                )
+                out = fn(q, k, v, mask)
+            else:
+                out = flash_attention(q, k, v, kv_mask=mask)
         else:
             out = dot_product_attention(q, k, v, mask=mask[:, None, None, :])
         out = out.reshape(b, s, cfg.hidden_size)
@@ -135,7 +265,7 @@ class BertLayer(nn.Module):
     def __call__(self, hidden, mask):
         cfg = self.cfg
         attn_out = BertSelfAttention(cfg, self.mesh, name="attention")(hidden, mask)
-        hidden = _layernorm(cfg, name="ln_attn")(hidden + attn_out)
+        hidden = _layernorm(cfg, self.mesh, name="ln_attn")(attn_out, residual=hidden)
         if self.use_moe:
             from pyspark_tf_gke_tpu.models.moe import MoELayer
 
@@ -153,7 +283,7 @@ class BertLayer(nn.Module):
             mlp = nn.gelu(mlp, approximate=True)
             mlp = _dense(cfg.hidden_size, ("mlp", "embed"), cfg, name="mlp_out")(mlp)
             aux = jnp.zeros((), jnp.float32)
-        hidden = _layernorm(cfg, name="ln_mlp")(hidden + mlp)
+        hidden = _layernorm(cfg, self.mesh, name="ln_mlp")(mlp, residual=hidden)
         return nn.with_logical_constraint(hidden, ("batch", "seq", "embed")), aux
 
 
@@ -192,7 +322,7 @@ class BertEncoder(nn.Module):
         )
         positions = jnp.arange(s)[None, :]
         hidden = embed(input_ids) + pos_embed(positions) + type_embed(token_type_ids)
-        hidden = _layernorm(cfg, name="ln_embed")(hidden)
+        hidden = _layernorm(cfg, self.mesh, name="ln_embed")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "seq", "embed"))
 
         layer_cls = BertLayer
@@ -224,7 +354,7 @@ class BertForPretraining(nn.Module):
         )
         mlm = _dense(cfg.hidden_size, ("embed", "embed_out"), cfg, name="mlm_transform")(hidden)
         mlm = nn.gelu(mlm, approximate=True)
-        mlm = _layernorm(cfg, name="mlm_ln")(mlm)
+        mlm = _layernorm(cfg, self.mesh, name="mlm_ln")(mlm)
         mlm_logits = _dense(cfg.vocab_size, ("embed", "vocab"), cfg, name="mlm_head")(mlm)
         pooled = jnp.tanh(
             _dense(cfg.hidden_size, ("embed", "embed_out"), cfg, name="pooler")(hidden[:, 0])

@@ -42,19 +42,24 @@ except Exception:  # pragma: no cover
 
 NEG_INF = -1e30
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, block_k: int,
                 causal: bool, scale: float):
-    # Shapes: q [1, bq, D], k/v [1, S, D], bias [1, S], o [1, bq, D]
+    # Shapes: q [1, bq, D], k/v [1, S, D], bias [1, 1, S], o [1, bq, D],
+    # lse [1, 1, bq]. Row-vectors ride a leading singleton so their last
+    # two block dims satisfy Mosaic's (8, 128)-or-full tiling rule.
     bq = q_ref.shape[1]
     s = k_ref.shape[1]
     d = q_ref.shape[2]
     qi = pl.program_id(1)  # Q-block index
 
-    q = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
+    # Matmul operands stay in the input dtype (bf16 hits the MXU at full
+    # rate; f32 would run it 8x slower); accumulation and the softmax
+    # statistics are f32. The scale is folded into the f32 scores.
+    q = q_ref[0]                                         # [bq, D]
 
     m = jnp.full((bq, 1), NEG_INF, dtype=jnp.float32)
     l = jnp.zeros((bq, 1), dtype=jnp.float32)
@@ -64,12 +69,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, block_k: int,
 
     def body(kb, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         scores = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )                                                # [bq, bk]
-        scores += bias_ref[0, pl.ds(kb * block_k, block_k)][None, :]
+        ) * scale                                        # [bq, bk] f32
+        scores += bias_ref[0, 0, pl.ds(kb * block_k, block_k)][None, :]
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
@@ -79,7 +84,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, block_k: int,
         alpha = jnp.exp(m - m_new)
         l = l * alpha + p.sum(axis=1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return m_new, l, acc
 
@@ -96,12 +102,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, block_k: int,
     o_ref[0] = out.astype(o_ref.dtype)
     # Logsumexp residual for the backward kernels; +inf on fully-masked
     # rows makes their recomputed P exactly 0.
-    lse_ref[0] = jnp.where(valid, m + jnp.log(l), jnp.inf)[:, 0]
+    lse_ref[0, 0] = jnp.where(valid, m + jnp.log(l), jnp.inf)[:, 0]
 
 
 def _flash_fwd_bh(q, k, v, bias, *, causal: bool, block_q: int, block_k: int,
                   interpret: bool):
-    """q,k,v: [BH, S, D]; bias: [BH, S] additive (0 / NEG_INF)."""
+    """q,k,v: [BH, S, D]; bias: [BH, 1, S] additive (0 / NEG_INF).
+    Returns (out [BH, S, D], lse [BH, 1, S])."""
     bh, s, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
@@ -120,15 +127,15 @@ def _flash_fwd_bh(q, k, v, bias, *, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
             pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0), **mem),
             pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0), **mem),
-            pl.BlockSpec((1, s), lambda i, j: (i, 0), **mem),
+            pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0), **mem),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j), **mem),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j), **mem),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, bias)
@@ -136,27 +143,27 @@ def _flash_fwd_bh(q, k, v, bias, *, causal: bool, block_q: int, block_k: int,
 
 def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref, dq_ref,
                *, block_k: int, causal: bool, scale: float):
-    # Shapes: q/do/dq [1, bq, D], k/v [1, S, D], bias [1, S],
-    # lse/delta [1, bq]. One Q block per grid step, walking K blocks.
+    # Shapes: q/do/dq [1, bq, D], k/v [1, S, D], bias [1, 1, S],
+    # lse/delta [1, 1, bq]. One Q block per grid step, walking K blocks.
     bq = q_ref.shape[1]
     s = k_ref.shape[1]
     qi = pl.program_id(1)
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]                            # [bq, 1]
-    delta = delta_ref[0][:, None]                        # [bq, 1]
-    acc = jnp.zeros_like(q)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, 0][:, None]                         # [bq, 1]
+    delta = delta_ref[0, 0][:, None]                     # [bq, 1]
+    acc = jnp.zeros((bq, q_ref.shape[2]), dtype=jnp.float32)
 
     num_kb = s // block_k
 
     def body(kb, acc):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         scores = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        scores += bias_ref[0, pl.ds(kb * block_k, block_k)][None, :]
+        ) * scale
+        scores += bias_ref[0, 0, pl.ds(kb * block_k, block_k)][None, :]
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
@@ -165,7 +172,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref, dq_ref
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k_blk.dtype)
         return acc + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -180,42 +187,43 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref, dq_ref
 
 def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref,
                 dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float):
-    # Shapes: k/v/dk/dv [1, bk, D], q/do [1, S, D], bias [1, bk],
-    # lse/delta [1, S]. One K block per grid step, walking Q blocks.
+    # Shapes: k/v/dk/dv [1, bk, D], q/do [1, S, D], bias [1, 1, bk],
+    # lse/delta [1, 1, S]. One K block per grid step, walking Q blocks.
     bk = k_ref.shape[1]
     s = q_ref.shape[1]
     ki = pl.program_id(1)
 
-    k_blk = k_ref[0].astype(jnp.float32)
-    v_blk = v_ref[0].astype(jnp.float32)
-    bias = bias_ref[0][None, :]                          # [1, bk]
-    dk = jnp.zeros_like(k_blk)
-    dv = jnp.zeros_like(v_blk)
+    k_blk = k_ref[0]
+    v_blk = v_ref[0]
+    bias = bias_ref[0, 0][None, :]                       # [1, bk]
+    dk = jnp.zeros(k_blk.shape, dtype=jnp.float32)
+    dv = jnp.zeros(v_blk.shape, dtype=jnp.float32)
 
     num_qb = s // block_q
 
     def body(qb, carry):
         dk, dv = carry
-        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * scale
-        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
         scores = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) + bias
+        ) * scale + bias
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
             scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
-        p = jnp.exp(scores - lse)                        # [bq, bk]
+        p = jnp.exp(scores - lse)                        # [bq, bk] f32
         dv = dv + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta)
-        # d(scale·q·kᵀ)/dk = scale·q, and q_blk is already pre-scaled.
+        # d(scale·q·kᵀ)/dk = scale·q; fold the scale into ds.
+        ds = (p * (dp - delta) * scale).astype(q_blk.dtype)
         dk = dk + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -235,10 +243,11 @@ def _flash_bwd_bh(q, k, v, bias, lse, out, do, *, causal, block_q, block_k,
     block_k = min(block_k, s)
     scale = d ** -0.5
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = delta[:, None, :]                            # [BH, 1, S]
 
     mem = {} if _VMEM is None else {"memory_space": _VMEM}
     full = lambda last: pl.BlockSpec((1, s, last), lambda i, j: (i, 0, 0), **mem)
-    full_row = pl.BlockSpec((1, s), lambda i, j: (i, 0), **mem)
+    full_row = pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0), **mem)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale),
@@ -246,9 +255,9 @@ def _flash_bwd_bh(q, k, v, bias, lse, out, do, *, causal, block_q, block_k,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
             full(d), full(d), full_row,
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j), **mem),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j), **mem),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j), **mem),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j), **mem),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
@@ -262,7 +271,7 @@ def _flash_bwd_bh(q, k, v, bias, lse, out, do, *, causal, block_q, block_k,
             full(d),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), **mem),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), **mem),
-            pl.BlockSpec((1, block_k), lambda i, j: (i, j), **mem),
+            pl.BlockSpec((1, 1, block_k), lambda i, j: (i, 0, j), **mem),
             full_row, full(d), full_row,
         ],
         out_specs=[
@@ -302,20 +311,34 @@ def _flash_bh_bwd(causal, block_q, block_k, interpret, residuals, g):
 _flash_bh.defvjp(_flash_bh_fwd, _flash_bh_bwd)
 
 
+def _pick_seq_block(s: int, desired: int) -> int:
+    """Largest divisor of ``s`` <= ``desired`` that Mosaic accepts as the
+    last block dim of the [.., 1, S] row-vectors (multiple of 128), else
+    the whole sequence as one block."""
+    for blk in range(min(desired, s), 127, -1):
+        if s % blk == 0 and blk % 128 == 0:
+            return blk
+    return s
+
+
 def flash_attention(
     q: jnp.ndarray,  # [B, S, H, D]
     k: jnp.ndarray,
     v: jnp.ndarray,
     kv_mask: Optional[jnp.ndarray] = None,  # [B, S] bool
     causal: bool = False,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Fused attention; drop-in for ``dot_product_attention`` on TPU."""
     b, s, h, d = q.shape
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
+    if block_q is None:
+        block_q = _pick_seq_block(s, DEFAULT_BLOCK_Q)
+    if block_k is None:
+        block_k = _pick_seq_block(s, DEFAULT_BLOCK_K)
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
@@ -324,7 +347,7 @@ def flash_attention(
         bias = jnp.zeros((b, s), dtype=jnp.float32)
     else:
         bias = jnp.where(kv_mask.astype(bool), 0.0, NEG_INF).astype(jnp.float32)
-    bias = jnp.repeat(bias, h, axis=0)  # [BH, S]
+    bias = jnp.repeat(bias, h, axis=0)[:, None, :]  # [BH, 1, S]
 
     out = _flash_bh(to_bh(q), to_bh(k), to_bh(v), bias, causal, block_q, block_k,
                     interpret)
