@@ -446,7 +446,10 @@ def run_bench(argv) -> dict:
     use_flash = True if "--flash" in argv else (False if "--no-flash" in argv else None)
     seq = None
     if "--seq" in argv:
-        seq = int(argv[argv.index("--seq") + 1])
+        try:
+            seq = int(argv[argv.index("--seq") + 1])
+        except (IndexError, ValueError):
+            raise SystemExit("usage: bench.py bert --seq <int>  (e.g. --seq 2048)")
     return bench_workload(workload, steps=2 if smoke else 50, smoke=smoke,
                           use_flash=use_flash, seq_override=seq)
 

@@ -159,29 +159,22 @@ class FusedLayerNorm(nn.Module):
             n_shards = _data_shards(self.mesh, "dp", "fsdp", "sp")
             if n_shards > 1:
                 # LN is row-wise: shard rows (batch and, if 3D, seq) and
-                # run the kernel per shard. Scale/bias replicated.
+                # run the kernel per shard. Scale/bias replicated; the
+                # optional residual shards like x.
                 row_spec = (
                     P(DATA_AXES, "sp", None) if x.ndim == 3 else P(DATA_AXES, None)
                 )
-                if residual is None:
-                    fn = jax.shard_map(
-                        lambda xx, ss, bb: fused_layernorm(xx, ss, bb, eps=self.epsilon),
-                        mesh=self.mesh,
-                        in_specs=(row_spec, P(None), P(None)),
-                        out_specs=row_spec,
-                        check_vma=False,
-                    )
-                    y = fn(x, scale, bias)
-                else:
-                    fn = jax.shard_map(
-                        lambda xx, rr, ss, bb: fused_layernorm(
-                            xx, ss, bb, eps=self.epsilon, residual=rr),
-                        mesh=self.mesh,
-                        in_specs=(row_spec, row_spec, P(None), P(None)),
-                        out_specs=row_spec,
-                        check_vma=False,
-                    )
-                    y = fn(x, residual, scale, bias)
+                has_res = residual is not None
+                args = (x, residual, scale, bias) if has_res else (x, scale, bias)
+                specs = ((row_spec,) * (2 if has_res else 1)) + (P(None), P(None))
+
+                def ln_shard(*a):
+                    xx, rr = (a[0], a[1]) if has_res else (a[0], None)
+                    return fused_layernorm(xx, a[-2], a[-1], eps=self.epsilon,
+                                           residual=rr)
+
+                y = jax.shard_map(ln_shard, mesh=self.mesh, in_specs=specs,
+                                  out_specs=row_spec, check_vma=False)(*args)
             else:
                 y = fused_layernorm(x, scale, bias, eps=self.epsilon,
                                     residual=residual)

@@ -312,13 +312,11 @@ _flash_bh.defvjp(_flash_bh_fwd, _flash_bh_bwd)
 
 
 def _pick_seq_block(s: int, desired: int) -> int:
-    """Largest divisor of ``s`` <= ``desired`` that Mosaic accepts as the
-    last block dim of the [.., 1, S] row-vectors (multiple of 128), else
-    the whole sequence as one block."""
-    for blk in range(min(desired, s), 127, -1):
-        if s % blk == 0 and blk % 128 == 0:
-            return blk
-    return s
+    """Largest Mosaic-valid sequence block: the [.., 1, S] row-vectors
+    make S a lane dim, so blocks must be multiples of 128 (or full S)."""
+    from pyspark_tf_gke_tpu.ops.pallas.layernorm import pick_block
+
+    return pick_block(s, desired, 128)
 
 
 def flash_attention(

@@ -137,14 +137,18 @@ def _ln_res_bwd(eps, block_rows, interpret, residuals, g):
 _ln_res.defvjp(_ln_res_fwd, _ln_res_bwd)
 
 
-def _pick_block(n: int, block_rows: int) -> int:
-    # Largest divisor block that Mosaic accepts: divisible by 8 (sublane
-    # tiling) or equal to the full row count. Falls back to one
-    # whole-array block when no such divisor exists (e.g. odd n).
-    for br in range(min(block_rows, n), 7, -1):
-        if n % br == 0 and br % 8 == 0:
-            return br
+def pick_block(n: int, desired: int, multiple: int) -> int:
+    """Largest divisor of ``n`` <= ``desired`` that is a multiple of
+    ``multiple`` (Mosaic tiling: 8 for sublane/row blocks, 128 for lane
+    blocks), else the whole axis as one block."""
+    for blk in range(min(desired, n), multiple - 1, -1):
+        if n % blk == 0 and blk % multiple == 0:
+            return blk
     return n
+
+
+def _pick_block(n: int, block_rows: int) -> int:
+    return pick_block(n, block_rows, 8)
 
 
 def fused_layernorm(

@@ -338,8 +338,11 @@ class Trainer:
         self._accum_add = jax.jit(
             lambda acc, new: jax.tree.map(jnp.add, acc, new), donate_argnums=0
         )
+        # Donate only the state: its buffers back every output 1:1.
+        # Donating grads too made XLA warn "donated buffers were not
+        # usable" — there is no output left for them to back.
         self._apply_step = jax.jit(
-            apply_mean, donate_argnums=(0, 1), out_shardings=self.state_shardings
+            apply_mean, donate_argnums=0, out_shardings=self.state_shardings
         )
 
     def accum_step(self, state: TrainState, batches, accum: int):
@@ -398,17 +401,25 @@ class Trainer:
             return fn(state, batch)
 
     def evaluate(self, state: TrainState, batches) -> Dict[str, float]:
+        """Metrics accumulate as device scalars — one host sync at the
+        end, not one per batch (a per-batch ``float(v)`` readback
+        serializes dispatch against the device queue)."""
         if self._eval_step is None:
             self._build_steps()
-        sums: Dict[str, float] = {}
+        sums: Optional[Dict[str, jax.Array]] = None
         count = 0
         with self.mesh:
             for batch in batches:
                 metrics = self._eval_step(state, batch)
-                for k, v in metrics.items():
-                    sums[k] = sums.get(k, 0.0) + float(v)
+                sums = (
+                    metrics if sums is None
+                    else jax.tree.map(jnp.add, sums, metrics)
+                )
                 count += 1
-        return {k: v / max(count, 1) for k, v in sums.items()}
+        if sums is None:
+            return {}
+        host = jax.device_get(sums)
+        return {k: float(v) / count for k, v in host.items()}
 
     # ---- epoch loop ---------------------------------------------------------
 
