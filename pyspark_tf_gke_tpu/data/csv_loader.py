@@ -24,10 +24,15 @@ import numpy as np
 
 
 def open_text(path_or_url: str) -> io.TextIOBase:
-    """Open a local file or an HTTP(S) URL as a text stream
-    (reference: ``train_tf_ps.py:53-73``)."""
+    """Open a local file, an HTTP(S) URL (reference:
+    ``train_tf_ps.py:53-73``), or a ``gs://`` object (the reference's
+    cloud data path, ``spark_workload_to_cloud_k8s.py:40-48``) as text."""
+    from pyspark_tf_gke_tpu.utils.fs import fs_open, is_remote
+
     if path_or_url.startswith("http://") or path_or_url.startswith("https://"):
         return io.TextIOWrapper(urlopen(path_or_url), encoding="utf-8")
+    if is_remote(path_or_url):
+        return io.TextIOWrapper(fs_open(path_or_url, "rb"), encoding="utf-8")
     return open(path_or_url, "r", encoding="utf-8")
 
 

@@ -4,8 +4,10 @@ regression targets — semantics matching the reference's image loader
 
 * a jsonl line is used only if the file exists on disk, has a supported
   extension, and has both ``point.x_px`` and ``point.y_px``;
-* images decode to 3 channels, resize bilinearly (the ``tf.image.resize``
-  default) to (height, width), and scale to [0, 1] float32;
+* images decode to 3 channels, resize with **tf.image.resize bilinear
+  semantics** (half-pixel centers, antialias off — implemented first-party
+  in ``resize_bilinear_tf``, golden-tested against tf) to (height, width),
+  and scale to [0, 1] float32;
 * targets are raw pixel coordinates in the *resized* space — no
   normalization (reference keeps original-pixel targets; see the
   commented-out rescale block at ``train_tf_ps.py:259-276``).
@@ -70,13 +72,44 @@ def count_images(data_dir: str) -> int:
     return len(list_labeled_images(data_dir)[0])
 
 
+def resize_bilinear_tf(img: np.ndarray, height: int, width: int) -> np.ndarray:
+    """``tf.image.resize(method='bilinear')`` numerics in numpy:
+    half-pixel centers, **no antialiasing** (the TF default). PIL's
+    BILINEAR applies an antialias filter on downscale, which drifts
+    pixel values vs the reference pipeline (``train_tf_ps.py:301-306``)
+    — hence a first-party kernel instead of PIL. Separable lerp: the
+    fractional weight comes from the unclamped floor; sample indices are
+    clamped into range (matching TF's edge handling)."""
+    img = img.astype(np.float32)
+    in_h, in_w = img.shape[:2]
+
+    def axis(n_in: int, n_out: int):
+        if n_in == n_out:
+            return None
+        scale = n_in / n_out
+        src = (np.arange(n_out, dtype=np.float32) + 0.5) * scale - 0.5
+        lo_f = np.floor(src)
+        frac = (src - lo_f).astype(np.float32)
+        lo = np.clip(lo_f.astype(np.int64), 0, n_in - 1)
+        hi = np.clip(lo_f.astype(np.int64) + 1, 0, n_in - 1)
+        return lo, hi, frac
+
+    rows = axis(in_h, height)
+    if rows is not None:
+        lo, hi, fr = rows
+        img = img[lo] * (1.0 - fr)[:, None, None] + img[hi] * fr[:, None, None]
+    cols = axis(in_w, width)
+    if cols is not None:
+        lo, hi, fr = cols
+        img = img[:, lo] * (1.0 - fr)[None, :, None] + img[:, hi] * fr[None, :, None]
+    return img
+
+
 def load_image(path: str, height: int, width: int) -> np.ndarray:
-    """Decode → RGB → bilinear resize to (height, width) → [0,1] float32."""
+    """Decode → RGB → TF-semantics bilinear resize → [0,1] float32."""
     with Image.open(path) as img:
-        img = img.convert("RGB")
-        # PIL takes (width, height); BILINEAR matches tf.image.resize default.
-        img = img.resize((width, height), resample=Image.BILINEAR)
-        return np.asarray(img, dtype=np.float32) / 255.0
+        arr = np.asarray(img.convert("RGB"), dtype=np.float32)
+    return resize_bilinear_tf(arr, height, width) / 255.0
 
 
 def make_image_arrays(

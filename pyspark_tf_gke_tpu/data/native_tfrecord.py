@@ -15,7 +15,6 @@ shuffle buffer, repeat, drop-remainder batching.
 
 from __future__ import annotations
 
-import glob
 import os
 from typing import Dict, Iterator, Optional, Sequence, Tuple
 
@@ -170,7 +169,9 @@ def read_tfrecord_batches(
     if process_count is None:
         process_count = jax.process_count()
 
-    files = sorted(glob.glob(pattern))
+    from pyspark_tf_gke_tpu.utils.fs import fs_glob, spool_local
+
+    files = fs_glob(pattern)
     if not files:
         raise FileNotFoundError(f"no TFRecord shards match {pattern!r}")
     local_files = files[process_index::process_count]
@@ -178,6 +179,11 @@ def read_tfrecord_batches(
         raise ValueError(
             f"{len(files)} shards < {process_count} processes; write more shards"
         )
+    # The C++ reader (native/src/tfrecord_io.cc) is fopen-based —
+    # gs://-and-friends stage through the local spool once, then every
+    # epoch reads locally. Sharding happens BEFORE spooling: each host
+    # downloads only its own shards.
+    local_files = [spool_local(f) for f in local_files]
 
     def cast(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         out = {}

@@ -20,7 +20,6 @@ the TFRecord path is used.
 
 from __future__ import annotations
 
-import glob
 import os
 from typing import Dict, Iterator, Optional, Sequence, Tuple
 
@@ -114,7 +113,9 @@ def read_tfrecord_batches(
     if process_count is None:
         process_count = jax.process_count()
 
-    files = sorted(glob.glob(pattern))
+    from pyspark_tf_gke_tpu.utils.fs import fs_glob, spool_local
+
+    files = fs_glob(pattern)
     if not files:
         raise FileNotFoundError(f"no TFRecord shards match {pattern!r}")
     local_files = files[process_index::process_count]
@@ -122,6 +123,12 @@ def read_tfrecord_batches(
         raise ValueError(
             f"{len(files)} shards < {process_count} processes; write more shards"
         )
+    # tf.data reads gs:// natively (zero-copy); other remote schemes
+    # (memory:// in tests) stage through the local spool.
+    local_files = [
+        f if f.startswith(("gs://", "gcs://")) else spool_local(f)
+        for f in local_files
+    ]
 
     feature_spec = {}
     for key, (kind, shape) in schema.items():

@@ -1,0 +1,13 @@
+"""Shared helpers for the Pallas TPU kernels."""
+
+from __future__ import annotations
+
+
+def pick_block(n: int, desired: int, multiple: int) -> int:
+    """Largest divisor of ``n`` <= ``desired`` that is a multiple of
+    ``multiple`` (Mosaic tiling: 8 for sublane/row blocks, 128 for lane
+    blocks), else the whole axis as one block."""
+    for blk in range(min(desired, n), multiple - 1, -1):
+        if n % blk == 0 and blk % multiple == 0:
+            return blk
+    return n

@@ -314,7 +314,7 @@ _flash_bh.defvjp(_flash_bh_fwd, _flash_bh_bwd)
 def _pick_seq_block(s: int, desired: int) -> int:
     """Largest Mosaic-valid sequence block: the [.., 1, S] row-vectors
     make S a lane dim, so blocks must be multiples of 128 (or full S)."""
-    from pyspark_tf_gke_tpu.ops.pallas.layernorm import pick_block
+    from pyspark_tf_gke_tpu.ops.pallas.common import pick_block
 
     return pick_block(s, desired, 128)
 

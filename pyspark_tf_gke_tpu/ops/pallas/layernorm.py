@@ -137,17 +137,9 @@ def _ln_res_bwd(eps, block_rows, interpret, residuals, g):
 _ln_res.defvjp(_ln_res_fwd, _ln_res_bwd)
 
 
-def pick_block(n: int, desired: int, multiple: int) -> int:
-    """Largest divisor of ``n`` <= ``desired`` that is a multiple of
-    ``multiple`` (Mosaic tiling: 8 for sublane/row blocks, 128 for lane
-    blocks), else the whole axis as one block."""
-    for blk in range(min(desired, n), multiple - 1, -1):
-        if n % blk == 0 and blk % multiple == 0:
-            return blk
-    return n
-
-
 def _pick_block(n: int, block_rows: int) -> int:
+    from pyspark_tf_gke_tpu.ops.pallas.common import pick_block
+
     return pick_block(n, block_rows, 8)
 
 

@@ -1,0 +1,92 @@
+"""Filesystem access for the TPU-host data plane: local paths plus
+fsspec URLs (``gs://`` in production; ``memory://`` in unit tests).
+
+The reference reads ``gs://<project>-datasets/health.csv`` through the
+Spark GCS connector and tf.data's native GCS filesystem
+(``/root/reference/workloads/raw-spark/spark_checks/python_checks/spark_workload_to_cloud_k8s.py:40-48``);
+this module is the equivalent for our host-side readers:
+
+* ``fs_open``  — streaming reads for the CSV loader;
+* ``fs_glob``  — shard-pattern expansion for the TFRecord readers;
+* ``spool_local`` — stage a remote object into a local spool file for
+  readers that need a real file descriptor (the C++ TFRecord reader,
+  ``native/src/tfrecord_io.cc``, is fopen-based by design — sequential
+  local reads; remote objects stream through the spool once).
+
+HTTP(S) is deliberately not handled here — ``data.csv_loader.open_text``
+keeps the reference's urlopen semantics for those.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import hashlib
+import os
+import shutil
+import tempfile
+from typing import IO, List, Optional
+
+_HTTP = ("http://", "https://")
+
+
+def is_remote(path: str) -> bool:
+    """True for fsspec-routed URLs (gs://, gcs://, memory://, s3://...);
+    False for local paths and http(s), which have their own handling."""
+    return "://" in path and not path.startswith(_HTTP)
+
+
+def fs_open(path: str, mode: str = "rb") -> IO:
+    """Open a local file or an fsspec URL."""
+    if is_remote(path):
+        import fsspec
+
+        return fsspec.open(path, mode).open()
+    return open(path, mode)
+
+
+def fs_glob(pattern: str) -> List[str]:
+    """Sorted glob for local patterns and fsspec URLs (scheme preserved)."""
+    if is_remote(pattern):
+        import fsspec
+
+        fs, _, _ = fsspec.get_fs_token_paths(pattern)
+        return sorted(fs.unstrip_protocol(p) for p in fs.glob(pattern))
+    return sorted(_glob.glob(pattern))
+
+
+def _default_spool_dir() -> str:
+    """Per-user spool dir, created 0700 — a predictable world-shared
+    /tmp path would let another local user pre-plant spool files."""
+    d = os.path.join(tempfile.gettempdir(), f"fs_spool-{os.getuid()}")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    if os.stat(d).st_uid != os.getuid():  # pre-created by someone else
+        d = tempfile.mkdtemp(prefix="fs_spool-")
+    return d
+
+
+def spool_local(path: str, spool_dir: Optional[str] = None) -> str:
+    """Return a local path for ``path``, staging remote objects into a
+    spool file (re-used across calls within the spool dir). The cache
+    key includes the object's version metadata (etag/mtime/size from
+    ``fs.info``), so an overwritten remote object re-downloads instead
+    of serving a stale copy. Local paths pass through untouched."""
+    if not is_remote(path):
+        return path
+    import fsspec
+
+    fs, _, _ = fsspec.get_fs_token_paths(path)
+    try:
+        info = fs.info(path)
+        version = str(info.get("etag") or info.get("mtime") or info.get("size"))
+    except Exception:
+        version = ""
+    spool_dir = spool_dir or _default_spool_dir()
+    os.makedirs(spool_dir, exist_ok=True)
+    digest = hashlib.sha1(f"{path}\0{version}".encode()).hexdigest()[:16]
+    local = os.path.join(spool_dir, f"{digest}-{os.path.basename(path)}")
+    if not os.path.exists(local):
+        tmp = f"{local}.tmp.{os.getpid()}"
+        with fsspec.open(path, "rb") as src, open(tmp, "wb") as dst:
+            shutil.copyfileobj(src, dst)
+        os.replace(tmp, local)  # atomic: concurrent spoolers converge
+    return local

@@ -186,3 +186,22 @@ def test_fit_history_identical_with_and_without_prefetch(mesh_dp):
         return history["loss"]
 
     assert run(0) == run(2)
+
+
+def test_resize_bilinear_matches_tf_golden():
+    """Golden-pixel parity with tf.image.resize (bilinear, antialias
+    off, half-pixel centers) — the reference pipeline's resize
+    (train_tf_ps.py:301-306). Covers downscale, upscale, and the
+    anisotropic 320x256 target; PIL's antialiased BILINEAR would fail
+    the downscale cases."""
+    import pytest
+
+    tf = pytest.importorskip("tensorflow")
+    from pyspark_tf_gke_tpu.data.images import resize_bilinear_tf
+
+    rng = np.random.default_rng(7)
+    img = rng.integers(0, 256, (97, 123, 3)).astype(np.float32)
+    for h, w in [(48, 61), (256, 320), (97, 123), (200, 50)]:
+        ours = resize_bilinear_tf(img, h, w)
+        golden = tf.image.resize(img, (h, w), method="bilinear").numpy()
+        np.testing.assert_allclose(ours, golden, atol=1e-3, rtol=1e-5)

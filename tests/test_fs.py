@@ -1,0 +1,109 @@
+"""gs://-path support on the TPU-host data plane (VERDICT missing #4),
+unit-tested via fsspec's memory:// filesystem — same code path as gs://
+(is_remote → fsspec), no network.
+"""
+
+import numpy as np
+import pytest
+
+fsspec = pytest.importorskip("fsspec")
+
+from pyspark_tf_gke_tpu.utils.fs import fs_glob, fs_open, is_remote, spool_local
+
+
+def _put(url: str, data: bytes):
+    with fsspec.open(url, "wb") as fh:
+        fh.write(data)
+
+
+def test_is_remote_routing():
+    assert is_remote("gs://bucket/x.csv")
+    assert is_remote("memory://bucket/x.csv")
+    assert not is_remote("/tmp/x.csv")
+    assert not is_remote("relative/x.csv")
+    assert not is_remote("https://host/x.csv")  # urlopen path, not fsspec
+
+
+def test_csv_loader_remote(tmp_path):
+    from pyspark_tf_gke_tpu.data.csv_loader import load_csv
+    from pyspark_tf_gke_tpu.data.synthetic import make_synthetic_csv
+
+    local = str(tmp_path / "health.csv")
+    make_synthetic_csv(local, rows=80)
+    _put("memory://bucket/health.csv", open(local, "rb").read())
+
+    x_l, y_l, vocab_l = load_csv(local)
+    x_r, y_r, vocab_r = load_csv("memory://bucket/health.csv")
+    np.testing.assert_array_equal(x_l, x_r)
+    np.testing.assert_array_equal(y_l, y_r)
+    assert vocab_l == vocab_r
+
+
+def test_fs_glob_and_spool(tmp_path):
+    for i in range(3):
+        _put(f"memory://bucket/shards/part-{i:05d}.tfrecord", bytes([i]) * 10)
+    got = fs_glob("memory://bucket/shards/part-*.tfrecord")
+    assert [g.rsplit("/", 1)[1] for g in got] == [
+        f"part-{i:05d}.tfrecord" for i in range(3)
+    ]
+    assert all(g.startswith("memory://") for g in got)
+
+    spool = str(tmp_path / "spool")
+    local = spool_local(got[1], spool_dir=spool)
+    assert open(local, "rb").read() == b"\x01" * 10
+    # second call reuses the spooled copy (content-addressed)
+    assert spool_local(got[1], spool_dir=spool) == local
+    # local paths pass through
+    assert spool_local("/tmp/x") == "/tmp/x"
+
+
+def test_native_tfrecord_reader_remote(tmp_path):
+    """Full shard pipeline over a remote filesystem: write locally,
+    upload, read back through the spool via the native reader."""
+    from pyspark_tf_gke_tpu.data import native_tfrecord as ntr
+    from pyspark_tf_gke_tpu.data.tfrecord import schema_for
+
+    rng = np.random.default_rng(0)
+    arrays = {
+        "input_ids": rng.integers(0, 100, (64, 16)).astype(np.int64),
+        "label": rng.integers(0, 2, (64,)).astype(np.int64),
+    }
+    schema = schema_for(arrays)
+    paths = ntr.write_tfrecord_shards(arrays, str(tmp_path / "p"), num_shards=4)
+    for p in paths:
+        _put(f"memory://bucket/tfr/{p.rsplit('/', 1)[1]}", open(p, "rb").read())
+
+    def read_all(pattern):
+        rows = []
+        for b in ntr.read_tfrecord_batches(
+            pattern, schema, 8, shuffle=False, repeat=False,
+            process_index=0, process_count=1,
+        ):
+            rows.append(b["input_ids"])
+        return np.concatenate(rows)
+
+    local_rows = read_all(str(tmp_path / "p-*.tfrecord"))
+    remote_rows = read_all("memory://bucket/tfr/p-*.tfrecord")
+    np.testing.assert_array_equal(local_rows, remote_rows)
+
+
+def test_tfdata_tfrecord_reader_remote(tmp_path):
+    """The tf.data reader over a non-gs remote scheme stages through the
+    spool (gs:// itself would go to TF's native GCS filesystem)."""
+    pytest.importorskip("tensorflow")
+    from pyspark_tf_gke_tpu.data import tfrecord as tfr
+
+    rng = np.random.default_rng(1)
+    arrays = {"x": rng.normal(size=(32, 4)).astype(np.float32),
+              "label": rng.integers(0, 3, (32,)).astype(np.int64)}
+    schema = tfr.schema_for(arrays)
+    paths = tfr.write_tfrecord_shards(arrays, str(tmp_path / "q"), num_shards=2)
+    for p in paths:
+        _put(f"memory://bucket/tfd/{p.rsplit('/', 1)[1]}", open(p, "rb").read())
+
+    it = tfr.read_tfrecord_batches(
+        "memory://bucket/tfd/q-*.tfrecord", schema, 8, shuffle=False,
+        repeat=False, process_index=0, process_count=1,
+    )
+    n = sum(len(b["label"]) for b in it)
+    assert n == 32

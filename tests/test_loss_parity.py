@@ -1,0 +1,32 @@
+"""Loss-parity oracle (SURVEY §4): the reference TF CNN-B1 and the JAX
+CNN-B1 trained on identical synthetic data must reach the same loss
+floor. Reduced config of ``tools/loss_parity.py``; the checked-in
+``tools/parity_report.json`` holds a full-size run.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+
+@pytest.mark.slow
+def test_tf_vs_jax_cnn_b1_loss_parity(tmp_path):
+    from tools import loss_parity
+
+    images, targets = loss_parity.make_spot_arrays(48, 96, 128)
+    tf_hist = loss_parity.run_tf(images, targets, batch_size=8, epochs=8)
+    jax_hist = loss_parity.run_jax(images, targets, batch_size=8, epochs=8)
+    checks, ok = loss_parity.compare(
+        tf_hist, jax_hist, loss_ratio_tol=1.6, mae_rel_tol=0.35
+    )
+    assert ok, checks
+
+
+def test_make_spot_arrays_deterministic():
+    a1, t1 = __import__("tools.loss_parity", fromlist=["x"]).make_spot_arrays(4, 32, 40)
+    a2, t2 = __import__("tools.loss_parity", fromlist=["x"]).make_spot_arrays(4, 32, 40)
+    np.testing.assert_array_equal(a1, a2)
+    np.testing.assert_array_equal(t1, t2)
+    assert a1.shape == (4, 32, 40, 3) and t1.shape == (4, 2)
+    assert 0.0 <= a1.min() and a1.max() <= 1.0

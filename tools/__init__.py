@@ -1,0 +1,1 @@
+"""Calibration and parity tools (not part of the installed package)."""
