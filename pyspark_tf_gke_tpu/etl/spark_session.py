@@ -72,7 +72,18 @@ class CreateSparkSession:
         except socket.gaierror:
             self.logger.warning("driver host %s does not resolve locally", driver_host)
 
-        spark = (
+        # MySQL JDBC driver for the executors: the reference bakes the jar
+        # into a custom worker image (infra/local/local_spark/Dockerfile:15-17);
+        # spark.jars.packages instead resolves it from Maven at submit time
+        # and ships it to every executor, so stock spark:3.5.x workers can
+        # run the partitioned JDBC ingest (etl/jdbc_ingest.py). Override
+        # with SPARK_JARS_PACKAGES ("" disables, e.g. air-gapped clusters
+        # with the jar pre-baked).
+        packages = os.environ.get(
+            "SPARK_JARS_PACKAGES", "com.mysql:mysql-connector-j:8.4.0"
+        )
+
+        builder = (
             SparkSession.builder.appName(app_name)
             .master(master)
             .config("spark.driver.host", driver_host)
@@ -81,7 +92,9 @@ class CreateSparkSession:
             .config("spark.blockManager.port", bm_port)
             .config("spark.sql.shuffle.partitions",
                     os.environ.get("SPARK_SHUFFLE_PARTITIONS", "16"))
-            .getOrCreate()
         )
+        if packages:
+            builder = builder.config("spark.jars.packages", packages)
+        spark = builder.getOrCreate()
         self.logger.info("Spark session created against %s", master)
         return spark, self.logger, dict(DB_CONFIG)

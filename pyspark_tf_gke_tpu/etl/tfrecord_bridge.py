@@ -110,6 +110,32 @@ def _field_packed_int(value: int) -> bytes:
     return _field_packed_ints([value])
 
 
+def write_partition_rows(
+    idx: int,
+    rows,
+    output_prefix: str,
+    cols: Sequence[str],
+    label_col: str = None,
+    num_shards: int = 16,
+):
+    """The per-partition executor body: frame every row of ``rows`` (any
+    iterable of ``row[col]``-indexable records — Spark ``Row``s or plain
+    dicts) into one TFRecord shard. Module-level so it unit-tests without
+    a Spark session (tests/test_etl.py)."""
+    path = f"{output_prefix}-{idx:05d}-of-{num_shards:05d}.tfrecord"
+    # Executors write locally or via gcs connector-mounted paths.
+    import io
+
+    buf = io.BytesIO()
+    for row in rows:
+        d = {c: row[c] for c in cols}
+        if label_col is not None:
+            d[label_col] = row[label_col]
+        buf.write(tfrecord_frame(example_bytes(d)))
+    _write_bytes(path, buf.getvalue())
+    yield path
+
+
 def write_dataframe_shards(
     df,
     output_prefix: str,
@@ -120,25 +146,16 @@ def write_dataframe_shards(
     """Spark action: repartition to ``num_shards`` and write one TFRecord
     file per partition: ``{output_prefix}-{i:05d}-of-{N:05d}.tfrecord``.
     Works with any Hadoop-visible FS (gs://, file:/)."""
+    import functools
 
-    cols = list(feature_cols)
-    n = num_shards
-
-    def write_partition(idx, rows):
-        path = f"{output_prefix}-{idx:05d}-of-{n:05d}.tfrecord"
-        # Executors write locally or via gcs connector-mounted paths.
-        import io
-
-        buf = io.BytesIO()
-        for row in rows:
-            d = {c: row[c] for c in cols}
-            if label_col is not None:
-                d[label_col] = row[label_col]
-            buf.write(tfrecord_frame(example_bytes(d)))
-        _write_bytes(path, buf.getvalue())
-        yield path
-
-    return df.repartition(n).rdd.mapPartitionsWithIndex(write_partition).collect()
+    write_partition = functools.partial(
+        write_partition_rows,
+        output_prefix=output_prefix,
+        cols=list(feature_cols),
+        label_col=label_col,
+        num_shards=num_shards,
+    )
+    return df.repartition(num_shards).rdd.mapPartitionsWithIndex(write_partition).collect()
 
 
 def _write_bytes(path: str, data: bytes) -> None:

@@ -138,3 +138,85 @@ def test_load_csv_mysql_schema_and_parse(tmp_path):
     rows = list(m.parse_rows(str(p)))
     assert rows[0][2] == "Asthma"
     assert rows[0][5] == 1.5 and rows[0][6] is None and rows[0][7] is None
+
+
+def test_write_partition_rows_without_spark(tmp_path):
+    """The executor body of write_dataframe_shards, driven by a plain
+    iterator of dicts — no Spark needed (VERDICT weak #4). The shard it
+    writes must round-trip through the TPU-side codec parser."""
+    from pyspark_tf_gke_tpu.data.codec import iter_records, parse_example
+    from pyspark_tf_gke_tpu.etl.tfrecord_bridge import write_partition_rows
+
+    prefix = str(tmp_path / "shard")
+    rows = [
+        {"value": 1.5, "lower_ci": 1.0, "upper_ci": 2.0, "label": 3},
+        {"value": 7.25, "lower_ci": 7.0, "upper_ci": 8.0, "label": 1},
+    ]
+    paths = list(write_partition_rows(
+        2, iter(rows), prefix, cols=["value", "lower_ci", "upper_ci"],
+        label_col="label", num_shards=4,
+    ))
+    assert paths == [f"{prefix}-00002-of-00004.tfrecord"]
+
+    schema = {"value": ("float", ()), "lower_ci": ("float", ()),
+              "upper_ci": ("float", ()), "label": ("int", ())}
+    parsed = [parse_example(schema, rec) for rec in iter_records(paths[0])]
+    assert len(parsed) == 2
+    for got, want in zip(parsed, rows):
+        for col in ("value", "lower_ci", "upper_ci"):
+            assert float(got[col]) == pytest.approx(want[col])
+        assert int(got["label"]) == want["label"]
+
+
+def test_write_partition_rows_matches_tf_parse(tmp_path):
+    """The hand-rolled Example proto must parse with real TensorFlow."""
+    tf = pytest.importorskip("tensorflow")
+    from pyspark_tf_gke_tpu.etl.tfrecord_bridge import write_partition_rows
+
+    prefix = str(tmp_path / "tfcheck")
+    rows = [{"value": 2.5, "label": 7}]
+    (path,) = write_partition_rows(0, iter(rows), prefix, cols=["value"],
+                                   label_col="label", num_shards=1)
+    raw = next(iter(tf.data.TFRecordDataset([path])))
+    ex = tf.io.parse_single_example(raw, {
+        "value": tf.io.FixedLenFeature([], tf.float32),
+        "label": tf.io.FixedLenFeature([], tf.int64),
+    })
+    assert float(ex["value"]) == pytest.approx(2.5)
+    assert int(ex["label"]) == 7
+
+
+@pytest.mark.slow
+def test_spark_local2_etl_to_tfrecord_end_to_end(tmp_path):
+    """BASELINE config 3's hand-off, end to end on a local[2] session
+    (the reference's fake-cluster pattern,
+    spark_installation_check.py:12-46): DataFrame -> TFRecord shards ->
+    TPU-side reader."""
+    pyspark = pytest.importorskip("pyspark")
+    from pyspark.sql import SparkSession
+
+    from pyspark_tf_gke_tpu.data import native_tfrecord as ntr
+    from pyspark_tf_gke_tpu.etl.tfrecord_bridge import write_dataframe_shards
+
+    spark = (SparkSession.builder.master("local[2]")
+             .appName("etl-bridge-test").getOrCreate())
+    try:
+        rows = [(float(i), float(i) / 2, i % 3) for i in range(40)]
+        df = spark.createDataFrame(rows, ["value", "lower_ci", "label"])
+        paths = write_dataframe_shards(
+            df, str(tmp_path / "p"), ["value", "lower_ci"],
+            label_col="label", num_shards=4,
+        )
+        assert len(paths) == 4
+
+        schema = {"value": ("float", ()), "lower_ci": ("float", ()),
+                  "label": ("int", ())}
+        got = []
+        for b in ntr.read_tfrecord_batches(
+            str(tmp_path / "p-*.tfrecord"), schema, 8, shuffle=False,
+            repeat=False, process_index=0, process_count=1,
+        ):
+            got.extend(float(v) for v in b["value"])
+        assert sorted(got) == [float(i) for i in range(40)]
+    finally:
+        spark.stop()
