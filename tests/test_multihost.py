@@ -84,3 +84,101 @@ def test_two_process_csv_training(tmp_path):
     assert np.isfinite(float(final[0]))
     assert final[0] == final[1]
     assert os.path.exists(os.path.join(out, "history.json"))
+
+
+@pytest.mark.slow
+def test_two_process_kill_and_resume(tmp_path):
+    """Fault-tolerance across real process boundaries: both workers are
+    SIGKILLed mid-training (the synchronous SPMD failure unit is the
+    whole job — one dead worker stalls collectives, so k8s restarts the
+    set), then relaunched with --resume. The relaunch must restore the
+    mid-run checkpoint and finish with finite, host-identical losses."""
+    import signal
+    import time
+
+    from pyspark_tf_gke_tpu.data.synthetic import make_synthetic_csv
+
+    csv = str(tmp_path / "d.csv")
+    make_synthetic_csv(csv, rows=320)
+    out = str(tmp_path / "out")
+    ckdir = os.path.join(out, "checkpoints")
+
+    env_base = {
+        **os.environ,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "JAX_PLATFORMS": "cpu",
+    }
+
+    def launch(resume: bool):
+        port = _free_port()
+        procs = []
+        for pid in range(2):
+            args = [
+                sys.executable, "-c", RUNNER,
+                "--data-path", csv, "--epochs", "4", "--batch-size", "32",
+                "--output-dir", out, "--mesh-shape", "dp=8",
+                "--num-processes", "2", "--process-id", str(pid),
+                "--coordinator-addr", f"127.0.0.1:{port}",
+                "--checkpoint-every-steps", "3",
+            ]
+            if resume:
+                args.append("--resume")
+            procs.append(subprocess.Popen(
+                args, env=env_base, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            ))
+        return procs
+
+    # Run 1: wait for the first mid-run checkpoint, then kill both
+    # workers hard (no cleanup — the crash path, not shutdown).
+    procs = launch(resume=False)
+    try:
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            steps = [d for d in (os.listdir(ckdir) if os.path.isdir(ckdir) else [])
+                     if d.isdigit()]
+            if steps and all(p.poll() is None for p in procs):
+                break
+            dead = [i for i, p in enumerate(procs) if p.poll() is not None]
+            if dead:
+                # Kill survivors first (a live worker stalled in a
+                # collective would block communicate indefinitely), then
+                # report the DEAD worker's log — that's where the cause is.
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                texts = [p.communicate(timeout=60)[0] for p in procs]
+                raise AssertionError(
+                    f"worker {dead[0]} died early:\n{texts[dead[0]][-2000:]}"
+                )
+            time.sleep(0.5)
+        else:
+            raise AssertionError("no checkpoint appeared before the deadline")
+        for p in procs:
+            p.send_signal(signal.SIGKILL)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.communicate()
+
+    killed_at = max(int(d) for d in os.listdir(ckdir) if d.isdigit())
+
+    # Run 2: relaunch with --resume; must restore and complete.
+    procs = launch(resume=True)
+    try:
+        outputs = [p.communicate(timeout=420)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for i, (p, text) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"resumed worker {i} failed:\n{text[-3000:]}"
+        assert f"WORKER_OK {i}" in text
+    assert any(f"Restored checkpoint step {killed_at}" in t for t in outputs), (
+        f"no restore log; expected step {killed_at}"
+    )
+    final = [t.split(f"WORKER_OK {i} ")[1].splitlines()[0]
+             for i, t in enumerate(outputs)]
+    assert np.isfinite(float(final[0])) and final[0] == final[1]
