@@ -36,6 +36,33 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _launch_workers(csv: str, out: str, epochs: int, extra_args=()):
+    """Start the 2-process fake-slice job (4 virtual CPU devices per
+    process, dp=8 mesh) through the real CLI bootstrap path."""
+    env_base = {
+        **os.environ,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "JAX_PLATFORMS": "cpu",
+    }
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        procs.append(subprocess.Popen(
+            [
+                sys.executable, "-c", RUNNER,
+                "--data-path", csv, "--epochs", str(epochs),
+                "--batch-size", "32",
+                "--output-dir", out, "--mesh-shape", "dp=8",
+                "--num-processes", "2", "--process-id", str(pid),
+                "--coordinator-addr", f"127.0.0.1:{port}",
+                *extra_args,
+            ],
+            env=env_base, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    return procs
+
+
 @pytest.mark.slow
 def test_two_process_csv_training(tmp_path):
     from pyspark_tf_gke_tpu.data.synthetic import make_synthetic_csv
@@ -43,27 +70,9 @@ def test_two_process_csv_training(tmp_path):
     csv = str(tmp_path / "d.csv")
     make_synthetic_csv(csv, rows=320)
     out = str(tmp_path / "out")
-    port = _free_port()
 
-    env_base = {
-        **os.environ,
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-        "JAX_PLATFORMS": "cpu",
-    }
-    procs = []
+    procs = _launch_workers(csv, out, epochs=2)
     try:
-        for pid in range(2):
-            procs.append(subprocess.Popen(
-                [
-                    sys.executable, "-c", RUNNER,
-                    "--data-path", csv, "--epochs", "2", "--batch-size", "32",
-                    "--output-dir", out, "--mesh-shape", "dp=8",
-                    "--num-processes", "2", "--process-id", str(pid),
-                    "--coordinator-addr", f"127.0.0.1:{port}",
-                ],
-                env=env_base, cwd=REPO,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            ))
         outputs = []
         for p in procs:
             out_text, _ = p.communicate(timeout=420)
@@ -103,31 +112,9 @@ def test_two_process_kill_and_resume(tmp_path):
     out = str(tmp_path / "out")
     ckdir = os.path.join(out, "checkpoints")
 
-    env_base = {
-        **os.environ,
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-        "JAX_PLATFORMS": "cpu",
-    }
-
     def launch(resume: bool):
-        port = _free_port()
-        procs = []
-        for pid in range(2):
-            args = [
-                sys.executable, "-c", RUNNER,
-                "--data-path", csv, "--epochs", "4", "--batch-size", "32",
-                "--output-dir", out, "--mesh-shape", "dp=8",
-                "--num-processes", "2", "--process-id", str(pid),
-                "--coordinator-addr", f"127.0.0.1:{port}",
-                "--checkpoint-every-steps", "3",
-            ]
-            if resume:
-                args.append("--resume")
-            procs.append(subprocess.Popen(
-                args, env=env_base, cwd=REPO,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            ))
-        return procs
+        extra = ["--checkpoint-every-steps", "3"] + (["--resume"] if resume else [])
+        return _launch_workers(csv, out, epochs=4, extra_args=extra)
 
     # Run 1: wait for the first mid-run checkpoint, then kill both
     # workers hard (no cleanup — the crash path, not shutdown).
