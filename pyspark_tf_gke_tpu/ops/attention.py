@@ -111,6 +111,52 @@ def _ring_block(q, k, v, kv_mask, axis_name: str, axis_size: int, causal: bool):
     return out.astype(q.dtype)
 
 
+def _merge_partial(o, lse, o_i, lse_i):
+    """Combine two partial attentions (outputs + logsumexps) over
+    disjoint key sets — the flash-style merge. NEG_INF (not -inf) marks
+    empty rows, so the -inf-minus--inf NaN case never arises; merged
+    garbage rows are 0*w + 0*w = 0."""
+    lse_new = jnp.logaddexp(lse, lse_i)
+    w = jnp.exp(lse - lse_new)[..., None]
+    w_i = jnp.exp(lse_i - lse_new)[..., None]
+    return o * w + o_i.astype(jnp.float32) * w_i, lse_new
+
+
+def _ring_block_flash(q, k, v, kv_mask, axis_name: str, axis_size: int):
+    """Ring attention with the Pallas flash kernel as the per-step block
+    engine: each ring step runs one fused blockwise attention on the
+    resident K/V block (returning out + lse), and partial results merge
+    by logsumexp. ``lax.scan`` (not fori_loop) so the ring is
+    reverse-mode differentiable; K/V/mask rotate via ppermute inside the
+    scan, and their cotangents ride the reversed ring on the way back."""
+    from pyspark_tf_gke_tpu.ops.pallas.flash_attention import (
+        flash_attention_block,
+    )
+
+    b, sq, h, d = q.shape
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    o0 = jnp.zeros((b, sq, h, d), dtype=jnp.float32)
+    lse0 = jnp.full((b, sq, h), NEG_INF, dtype=jnp.float32)
+    have_mask = kv_mask is not None
+    mask0 = kv_mask if have_mask else jnp.zeros((), dtype=bool)
+
+    def body(carry, _):
+        o, lse, k, v, mask = carry
+        o_i, lse_i = flash_attention_block(
+            q, k, v, kv_mask=mask if have_mask else None
+        )
+        o, lse = _merge_partial(o, lse, o_i, lse_i)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        if have_mask:
+            mask = lax.ppermute(mask, axis_name, perm)
+        return (o, lse, k, v, mask), None
+
+    (o, lse, *_), _ = lax.scan(body, (o0, lse0, k, v, mask0), None,
+                               length=axis_size)
+    return o.astype(q.dtype)
+
+
 def _sp_shard_map(body, mesh: Mesh, axis: str, kv_mask):
     """Shared shard_map scaffolding for the sequence-parallel attention
     variants: Q/K/V sharded [data, axis, tp, -] with an optional [data,
@@ -140,18 +186,38 @@ def ring_attention(
     kv_mask: Optional[jnp.ndarray] = None,  # [B, S] bool, S sharded likewise
     axis: str = "sp",
     causal: bool = False,
+    use_flash: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Sequence-parallel attention over mesh axis ``axis``.
 
     Inputs carry the *global* sequence dimension; shard_map splits it over
     the ring. Batch stays sharded over the data axes, heads over ``tp``.
+
+    ``use_flash`` selects the per-step block engine: the Pallas flash
+    kernel with lse-merging (None = auto: TPU backend, per-shard sequence
+    >= 512, non-causal — the measured kernel crossover), else the dense
+    online-softmax block. Causal ring flash is unsupported (the kernel's
+    causal mask is block-local); auto falls back to dense for it.
     """
     axis_size = mesh.shape[axis]
     if axis_size == 1:
         return dot_product_attention(q, k, v,
                                      mask=None if kv_mask is None else kv_mask[:, None, None, :],
                                      causal=causal)
-    fn = functools.partial(_ring_block, axis_name=axis, axis_size=axis_size, causal=causal)
+    if use_flash is None:
+        use_flash = (
+            not causal
+            and jax.default_backend() in ("tpu", "axon")
+            and q.shape[1] // axis_size >= 512
+        )
+    if use_flash:
+        if causal:
+            raise ValueError("ring flash attention does not support causal=True")
+        fn = functools.partial(_ring_block_flash, axis_name=axis,
+                               axis_size=axis_size)
+    else:
+        fn = functools.partial(_ring_block, axis_name=axis,
+                               axis_size=axis_size, causal=causal)
     return _sp_shard_map(fn, mesh, axis, kv_mask)(q, k, v)
 
 

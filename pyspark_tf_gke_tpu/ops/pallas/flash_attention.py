@@ -46,11 +46,17 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, block_k: int,
-                causal: bool, scale: float):
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, *rest, block_k: int,
+                causal: bool, scale: float, use_segs: bool):
     # Shapes: q [1, bq, D], k/v [1, S, D], bias [1, 1, S], o [1, bq, D],
-    # lse [1, 1, bq]. Row-vectors ride a leading singleton so their last
-    # two block dims satisfy Mosaic's (8, 128)-or-full tiling rule.
+    # lse [1, 1, bq]; with use_segs also segq [1, 1, bq], segk [1, 1, S]
+    # (int32 packed-sequence ids — tokens attend within their segment).
+    # Row-vectors ride a leading singleton so their last two block dims
+    # satisfy Mosaic's (8, 128)-or-full tiling rule.
+    if use_segs:
+        segq_ref, segk_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     bq = q_ref.shape[1]
     s = k_ref.shape[1]
     d = q_ref.shape[2]
@@ -75,6 +81,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, block_k: int,
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                                        # [bq, bk] f32
         scores += bias_ref[0, 0, pl.ds(kb * block_k, block_k)][None, :]
+        if use_segs:
+            segq = segq_ref[0, 0][:, None]               # [bq, 1]
+            segk = segk_ref[0, 0, pl.ds(kb * block_k, block_k)][None, :]
+            scores = jnp.where(segq == segk, scores, NEG_INF)
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
@@ -105,9 +115,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, block_k: int,
     lse_ref[0, 0] = jnp.where(valid, m + jnp.log(l), jnp.inf)[:, 0]
 
 
-def _flash_fwd_bh(q, k, v, bias, *, causal: bool, block_q: int, block_k: int,
-                  interpret: bool):
-    """q,k,v: [BH, S, D]; bias: [BH, 1, S] additive (0 / NEG_INF).
+def _flash_fwd_bh(q, k, v, bias, segs=None, *, causal: bool, block_q: int,
+                  block_k: int, interpret: bool):
+    """q,k,v: [BH, S, D]; bias: [BH, 1, S] additive (0 / NEG_INF);
+    segs: optional [BH, 1, S] int32 packed-sequence ids.
     Returns (out [BH, S, D], lse [BH, 1, S])."""
     bh, s, d = q.shape
     block_q = min(block_q, s)
@@ -117,34 +128,46 @@ def _flash_fwd_bh(q, k, v, bias, *, causal: bool, block_q: int, block_k: int,
     scale = d ** -0.5
 
     kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
-                               scale=scale)
+                               scale=scale, use_segs=segs is not None)
     mem = {} if _VMEM is None else {"memory_space": _VMEM}
     grid = (bh, s // block_q)
+    qblock = pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j), **mem)
+    full_row = pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0), **mem)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
+        pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0), **mem),
+        pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0), **mem),
+        full_row,
+    ]
+    args = [q, k, v, bias]
+    if segs is not None:
+        in_specs += [qblock, full_row]   # segq view (q rows), segk view (all keys)
+        args += [segs, segs]
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0), **mem),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0), **mem),
-            pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0), **mem),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j), **mem),
+            qblock,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, bias)
+    )(*args)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref, dq_ref,
-               *, block_k: int, causal: bool, scale: float):
+def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref,
+               *rest, block_k: int, causal: bool, scale: float,
+               use_segs: bool):
     # Shapes: q/do/dq [1, bq, D], k/v [1, S, D], bias [1, 1, S],
     # lse/delta [1, 1, bq]. One Q block per grid step, walking K blocks.
+    if use_segs:
+        segq_ref, segk_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
     bq = q_ref.shape[1]
     s = k_ref.shape[1]
     qi = pl.program_id(1)
@@ -164,6 +187,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref, dq_ref
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         scores += bias_ref[0, 0, pl.ds(kb * block_k, block_k)][None, :]
+        if use_segs:
+            segq = segq_ref[0, 0][:, None]
+            segk = segk_ref[0, 0, pl.ds(kb * block_k, block_k)][None, :]
+            scores = jnp.where(segq == segk, scores, NEG_INF)
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
@@ -186,9 +213,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref, dq_ref
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref,
-                dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float):
+                *rest, block_q: int, causal: bool, scale: float,
+                use_segs: bool):
     # Shapes: k/v/dk/dv [1, bk, D], q/do [1, S, D], bias [1, 1, bk],
     # lse/delta [1, 1, S]. One K block per grid step, walking Q blocks.
+    if use_segs:
+        segq_ref, segk_ref, dk_ref, dv_ref = rest
+    else:
+        dk_ref, dv_ref = rest
     bk = k_ref.shape[1]
     s = q_ref.shape[1]
     ki = pl.program_id(1)
@@ -210,6 +242,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref,
         scores = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale + bias
+        if use_segs:
+            segq = segq_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+            segk = segk_ref[0, 0][None, :]               # [1, bk]
+            scores = jnp.where(segq == segk, scores, NEG_INF)
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
@@ -236,44 +272,60 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, lse_ref, do_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd_bh(q, k, v, bias, lse, out, do, *, causal, block_q, block_k,
-                  interpret):
+def _flash_bwd_bh(q, k, v, bias, lse, out, do, segs=None, *, causal, block_q,
+                  block_k, interpret, delta_shift=None):
     bh, s, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     scale = d ** -0.5
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = delta[:, None, :]                            # [BH, 1, S]
+    if delta_shift is not None:
+        # lse cotangent from _flash_bh_lse: ds = p*(dp - delta + g_lse).
+        delta = delta - delta_shift.astype(jnp.float32)
+    use_segs = segs is not None
 
     mem = {} if _VMEM is None else {"memory_space": _VMEM}
     full = lambda last: pl.BlockSpec((1, s, last), lambda i, j: (i, 0, 0), **mem)
     full_row = pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0), **mem)
+    qrow = pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j), **mem)
+    krow = pl.BlockSpec((1, 1, block_k), lambda i, j: (i, 0, j), **mem)
 
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
+        full(d), full(d), full_row, qrow,
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
+        qrow,
+    ]
+    dq_args = [q, k, v, bias, lse, do, delta]
+    if use_segs:
+        dq_specs += [qrow, full_row]
+        dq_args += [segs, segs]
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale),
+        functools.partial(_dq_kernel, block_k=block_k, causal=causal,
+                          scale=scale, use_segs=use_segs),
         grid=(bh, s // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
-            full(d), full(d), full_row,
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j), **mem),
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j), **mem),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **mem),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         interpret=interpret,
-    )(q, k, v, bias, lse, do, delta)
+    )(*dq_args)
 
+    dkv_specs = [
+        full(d),
+        pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), **mem),
+        pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), **mem),
+        krow, full_row, full(d), full_row,
+    ]
+    dkv_args = [q, k, v, bias, lse, do, delta]
+    if use_segs:
+        dkv_specs += [full_row, krow]
+        dkv_args += [segs, segs]
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, causal=causal, scale=scale),
+        functools.partial(_dkv_kernel, block_q=block_q, causal=causal,
+                          scale=scale, use_segs=use_segs),
         grid=(bh, s // block_k),
-        in_specs=[
-            full(d),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), **mem),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), **mem),
-            pl.BlockSpec((1, 1, block_k), lambda i, j: (i, 0, j), **mem),
-            full_row, full(d), full_row,
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), **mem),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), **mem),
@@ -283,32 +335,66 @@ def _flash_bwd_bh(q, k, v, bias, lse, out, do, *, causal, block_q, block_k,
             jax.ShapeDtypeStruct((bh, s, d), v.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, bias, lse, do, delta)
+    )(*dkv_args)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_bh(q, k, v, bias, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd_bh(q, k, v, bias, causal=causal, block_q=block_q,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_bh(q, k, v, bias, segs, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd_bh(q, k, v, bias, segs, causal=causal, block_q=block_q,
                            block_k=block_k, interpret=interpret)
     return out
 
 
-def _flash_bh_fwd(q, k, v, bias, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd_bh(q, k, v, bias, causal=causal, block_q=block_q,
-                             block_k=block_k, interpret=interpret)
-    return out, (q, k, v, bias, lse, out)
+def _flash_bh_fwd(q, k, v, bias, segs, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_bh(q, k, v, bias, segs, causal=causal,
+                             block_q=block_q, block_k=block_k,
+                             interpret=interpret)
+    return out, (q, k, v, bias, segs, lse, out)
 
 
 def _flash_bh_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v, bias, lse, out = residuals
-    dq, dk, dv = _flash_bwd_bh(q, k, v, bias, lse, out, g, causal=causal,
+    q, k, v, bias, segs, lse, out = residuals
+    dq, dk, dv = _flash_bwd_bh(q, k, v, bias, lse, out, g, segs, causal=causal,
                                block_q=block_q, block_k=block_k,
                                interpret=interpret)
-    return dq, dk, dv, None
+    return dq, dk, dv, None, None
 
 
 _flash_bh.defvjp(_flash_bh_fwd, _flash_bh_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_bh_lse(q, k, v, bias, segs, causal, block_q, block_k, interpret):
+    """Flash attention that also returns the per-row logsumexp — the
+    building block for cross-device merging (ring attention combines
+    per-ring-step partial outputs by their lse)."""
+    return _flash_fwd_bh(q, k, v, bias, segs, causal=causal, block_q=block_q,
+                         block_k=block_k, interpret=interpret)
+
+
+def _flash_bh_lse_fwd(q, k, v, bias, segs, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_bh(q, k, v, bias, segs, causal=causal,
+                             block_q=block_q, block_k=block_k,
+                             interpret=interpret)
+    return (out, lse), (q, k, v, bias, segs, lse, out)
+
+
+def _flash_bh_lse_bwd(causal, block_q, block_k, interpret, residuals, gs):
+    """dlse/dscores is exactly the softmax probs, so the lse cotangent
+    folds into the delta term the kernels already subtract:
+    ds = p*(dp - delta + g_lse) — pass (delta - g_lse) and the unchanged
+    backward kernels produce the combined gradient."""
+    g_out, g_lse = gs
+    q, k, v, bias, segs, lse, out = residuals
+    dq, dk, dv = _flash_bwd_bh(q, k, v, bias, lse, out, g_out, segs,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, interpret=interpret,
+                               delta_shift=g_lse)
+    return dq, dk, dv, None, None
+
+
+_flash_bh_lse.defvjp(_flash_bh_lse_fwd, _flash_bh_lse_bwd)
 
 
 def _pick_seq_block(s: int, desired: int) -> int:
@@ -319,17 +405,7 @@ def _pick_seq_block(s: int, desired: int) -> int:
     return pick_block(s, desired, 128)
 
 
-def flash_attention(
-    q: jnp.ndarray,  # [B, S, H, D]
-    k: jnp.ndarray,
-    v: jnp.ndarray,
-    kv_mask: Optional[jnp.ndarray] = None,  # [B, S] bool
-    causal: bool = False,
-    block_q: Optional[int] = None,
-    block_k: Optional[int] = None,
-    interpret: Optional[bool] = None,
-) -> jnp.ndarray:
-    """Fused attention; drop-in for ``dot_product_attention`` on TPU."""
+def _prep_bh(q, k, v, kv_mask, segment_ids, block_q, block_k, interpret):
     b, s, h, d = q.shape
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
@@ -346,7 +422,57 @@ def flash_attention(
     else:
         bias = jnp.where(kv_mask.astype(bool), 0.0, NEG_INF).astype(jnp.float32)
     bias = jnp.repeat(bias, h, axis=0)[:, None, :]  # [BH, 1, S]
+    segs = None
+    if segment_ids is not None:
+        segs = jnp.repeat(segment_ids.astype(jnp.int32), h, axis=0)[:, None, :]
+    return to_bh(q), to_bh(k), to_bh(v), bias, segs, block_q, block_k, interpret
 
-    out = _flash_bh(to_bh(q), to_bh(k), to_bh(v), bias, causal, block_q, block_k,
-                    interpret)
+
+def flash_attention(
+    q: jnp.ndarray,  # [B, S, H, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    kv_mask: Optional[jnp.ndarray] = None,  # [B, S] bool
+    causal: bool = False,
+    segment_ids: Optional[jnp.ndarray] = None,  # [B, S] int — packed sequences
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused attention; drop-in for ``dot_product_attention`` on TPU.
+    ``segment_ids`` confines attention within matching ids (packed
+    sequences / block-diagonal masking), composable with ``kv_mask``
+    and ``causal``."""
+    b, s, h, d = q.shape
+    qb, kb, vb, bias, segs, block_q, block_k, interpret = _prep_bh(
+        q, k, v, kv_mask, segment_ids, block_q, block_k, interpret
+    )
+    out = _flash_bh(qb, kb, vb, bias, segs, causal, block_q, block_k, interpret)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_block(
+    q: jnp.ndarray,  # [B, S, H, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    kv_mask: Optional[jnp.ndarray] = None,  # [B, S] bool
+    segment_ids: Optional[jnp.ndarray] = None,  # [B, S] int
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """One attention *block*: returns ``(out [B,S,H,D], lse [B,S,H])``
+    so a caller can combine partial attentions over K/V blocks held
+    elsewhere (ring attention merges per-ring-step results by lse).
+    Rows with no unmasked key get lse = NEG_INF (no mass) and out = 0 —
+    finite, so the logsumexp merge stays NaN-free."""
+    b, s, h, d = q.shape
+    qb, kb, vb, bias, segs, block_q, block_k, interpret = _prep_bh(
+        q, k, v, kv_mask, segment_ids, block_q, block_k, interpret
+    )
+    out, lse = _flash_bh_lse(qb, kb, vb, bias, segs, False, block_q, block_k,
+                             interpret)
+    out = out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    lse = lse[:, 0, :].reshape(b, h, s).transpose(0, 2, 1)  # [B, S, H]
+    lse = jnp.where(jnp.isposinf(lse), NEG_INF, lse)
+    return out, lse

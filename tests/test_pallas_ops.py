@@ -211,3 +211,77 @@ def test_bert_flash_and_fused_ln_on_dp_mesh(devices):
             losses.append(float(jax.device_get(metrics["loss"])))
         outs[name] = losses
     np.testing.assert_allclose(outs["pallas"], outs["dense"], rtol=2e-3)
+
+
+def test_flash_segment_ids_match_dense():
+    """Packed-sequence masking: segment_ids confine attention within
+    matching ids, composed with a padding mask, fwd and bwd."""
+    q, k, v = _qkv(b=2, s=64, h=2, d=16, seed=5)
+    seg = np.zeros((2, 64), np.int32)
+    seg[:, 20:40] = 1
+    seg[:, 40:] = 2
+    seg = jnp.asarray(seg)
+    mask = np.ones((2, 64), bool)
+    mask[:, 60:] = False
+    mask = jnp.asarray(mask)
+    dense_mask = (seg[:, None, :, None] == seg[:, None, None, :]) & mask[:, None, None, :]
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, kv_mask=mask, segment_ids=seg,
+                                block_q=16, block_k=16, interpret=True) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (dot_product_attention(q, k, v, mask=dense_mask) ** 2).sum()
+
+    out = flash_attention(q, k, v, kv_mask=mask, segment_ids=seg,
+                          block_q=16, block_k=16, interpret=True)
+    ref = dot_product_attention(q, k, v, mask=dense_mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+
+
+def test_flash_attention_block_lse_merge():
+    """flash_attention_block + logsumexp merging must reconstruct full
+    attention from two disjoint K/V halves — the ring-attention
+    contract, including the lse cotangent path."""
+    from pyspark_tf_gke_tpu.ops.attention import _merge_partial
+    from pyspark_tf_gke_tpu.ops.pallas.flash_attention import (
+        flash_attention_block,
+    )
+
+    q, k, v = _qkv(b=2, s=32, h=2, d=16, seed=6)
+    k1, k2 = k[:, :16], k[:, 16:]
+    v1, v2 = v[:, :16], v[:, 16:]
+    mask = np.ones((2, 32), bool)
+    mask[:, 28:] = False
+    m1, m2 = jnp.asarray(mask[:, :16]), jnp.asarray(mask[:, 16:])
+
+    def merged(q, k1, v1, k2, v2):
+        o1, l1 = flash_attention_block(q[:, :16], k1, v1, kv_mask=m1,
+                                       block_q=16, block_k=16, interpret=True)
+        o2, l2 = flash_attention_block(q[:, :16], k2, v2, kv_mask=m2,
+                                       block_q=16, block_k=16, interpret=True)
+        o = jnp.zeros_like(o1, dtype=jnp.float32)
+        lse = jnp.full(o1.shape[:-1], -1e30, dtype=jnp.float32)
+        o, lse = _merge_partial(o, lse, o1, l1)
+        o, lse = _merge_partial(o, lse, o2, l2)
+        return o.astype(q.dtype)
+
+    out = merged(q, k1, v1, k2, v2)
+    ref = dot_product_attention(q[:, :16], k, v,
+                                mask=jnp.asarray(mask)[:, None, None, :])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    g1 = jax.grad(lambda *a: (merged(*a) ** 2).sum(), argnums=(0, 1, 2, 3, 4))(
+        q, k1, v1, k2, v2)
+    gref = jax.grad(lambda q, k, v: (dot_product_attention(
+        q[:, :16], k, v, mask=jnp.asarray(mask)[:, None, None, :]) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(g1[0]), np.asarray(gref[0]), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(jnp.concatenate([g1[1], g1[3]], axis=1)),
+                               np.asarray(gref[1]), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(jnp.concatenate([g1[2], g1[4]], axis=1)),
+                               np.asarray(gref[2]), atol=1e-3)
