@@ -39,7 +39,7 @@ from pyspark_tf_gke_tpu.train.harness import (
 )
 from pyspark_tf_gke_tpu.train.resilience import run_with_recovery
 from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
-from pyspark_tf_gke_tpu.utils.config import parse_mesh_shape
+from pyspark_tf_gke_tpu.utils.config import _env_bool, parse_mesh_shape
 from pyspark_tf_gke_tpu.utils.logging import banner, get_logger
 from pyspark_tf_gke_tpu.utils.seeding import make_rng
 
@@ -76,7 +76,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--output-dir", default=e("OUTPUT_DIR", "./bert-finetune"))
     p.add_argument("--checkpoint-every-steps", type=int,
                    default=int(e("CHECKPOINT_EVERY_STEPS", "0")))
-    p.add_argument("--resume", action="store_true", default=e("RESUME", "") == "1")
+    p.add_argument("--async-checkpoint", action="store_true",
+                   default=_env_bool("ASYNC_CHECKPOINT", False),
+                   help="write checkpoints in the background (orbax async)")
+    p.add_argument("--resume", action="store_true", default=_env_bool("RESUME", False))
     p.add_argument("--compute-dtype", default=e("COMPUTE_DTYPE", "bfloat16"),
                    choices=["bfloat16", "float32"])
     p.add_argument("--num-processes", type=int, default=int(e("NUM_PROCESSES", "1")))
@@ -155,17 +158,23 @@ def main(argv=None) -> dict:
         ckpt, state = make_checkpoint(
             args.output_dir, args.checkpoint_every_steps, state,
             args.resume or attempt > 0,
+            async_save=args.async_checkpoint,
         )
-        # Fresh stream per attempt: the previous attempt's prefetcher may
-        # have advanced a shared iterator past unseen batches.
-        state, history = trainer.fit(
-            state, batches(), args.epochs, args.steps_per_epoch,
-            checkpoint_manager=ckpt,
-            heartbeat=make_heartbeat(args.output_dir, args.heartbeat_every_steps,
-                                     args.heartbeat_file),
-        )
-        finalize_run(ckpt, state, history, args.output_dir,
-                     model_name="bert-finetune")
+        try:
+            # Fresh stream per attempt: the previous attempt's prefetcher
+            # may have advanced a shared iterator past unseen batches.
+            state, history = trainer.fit(
+                state, batches(), args.epochs, args.steps_per_epoch,
+                checkpoint_manager=ckpt,
+                heartbeat=make_heartbeat(args.output_dir, args.heartbeat_every_steps,
+                                         args.heartbeat_file),
+            )
+            finalize_run(ckpt, state, history, args.output_dir,
+                         model_name="bert-finetune")
+        finally:
+            # Join in-flight async saves even on failure: the next attempt
+            # builds a fresh manager on this directory, and two writers race.
+            ckpt.close()
         return history
 
     return run_with_recovery(attempt_run, max_restarts=args.max_restarts)

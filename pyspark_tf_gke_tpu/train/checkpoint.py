@@ -29,25 +29,54 @@ class CheckpointManager:
         directory: str,
         every_steps: int = 0,
         max_to_keep: int = 3,
+        async_save: bool = False,
     ):
+        """``async_save=True`` overlaps checkpoint writes with training:
+        orbax snapshots device arrays to host memory synchronously (so
+        the trainer is free to donate/overwrite the state buffers
+        immediately) and persists in a background thread. ``save`` then
+        returns without blocking; ``wait`` / ``close`` join the writer."""
         self.directory = os.path.abspath(directory)
         self.every_steps = every_steps
+        self.async_save = async_save
+        self._pending_history: Optional[Dict] = None
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True, enable_async_checkpointing=False
+                max_to_keep=max_to_keep, create=True,
+                enable_async_checkpointing=async_save,
             ),
         )
+
+    def _write_history(self, history: Dict) -> None:
+        if jax.process_index() == 0:
+            with open(os.path.join(self.directory, "history.json"), "w") as fh:
+                json.dump(history, fh)
 
     def save(self, state: Any, history: Optional[Dict] = None, force: bool = False) -> None:
         step = int(jax.device_get(state.step))
         self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
+        if self.async_save:
+            # history.json sits next to the checkpoint and would attest
+            # to a save that is not yet durable — defer it to wait().
+            if history is not None:
+                self._pending_history = history
+            logger.info("Scheduled async checkpoint save of step %d to %s",
+                        step, self.directory)
+            return
         self._mgr.wait_until_finished()
-        if history is not None and jax.process_index() == 0:
-            with open(os.path.join(self.directory, "history.json"), "w") as fh:
-                json.dump(history, fh)
+        if history is not None:
+            self._write_history(history)
         logger.info("Saved checkpoint at step %d to %s", step, self.directory)
+
+    def wait(self) -> None:
+        """Block until any in-flight async save is durable (and flush the
+        deferred history.json that attests to it)."""
+        self._mgr.wait_until_finished()
+        if self._pending_history is not None:
+            self._write_history(self._pending_history)
+            self._pending_history = None
 
     def maybe_save(self, state: Any, history: Optional[Dict] = None) -> None:
         """Save when at least ``every_steps`` have elapsed since the last
@@ -66,6 +95,7 @@ class CheckpointManager:
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore into the shardings of ``state_like`` (a concrete or
         abstract TrainState with the target NamedShardings)."""
+        self._mgr.wait_until_finished()  # join any in-flight async save
         if step is None:
             step = self.latest_step()
         if step is None:
@@ -80,6 +110,10 @@ class CheckpointManager:
         return restored
 
     def close(self):
+        """Join any in-flight async save (flushing deferred history) and
+        release the manager — call before building a new manager on the
+        same directory (restart paths), or two writers race."""
+        self.wait()
         self._mgr.close()
 
 

@@ -93,7 +93,8 @@ def run_csv_training(cfg: Config, fault_injector: Optional[FaultInjector] = None
     state = trainer.init_state(make_rng(cfg.seed), {"x": Xt, "y": yt})
 
     ckpt, state = make_checkpoint(
-        cfg.output_dir, cfg.checkpoint_every_steps, state, cfg.resume
+        cfg.output_dir, cfg.checkpoint_every_steps, state, cfg.resume,
+        async_save=cfg.async_checkpoint,
     )
 
     def val_batches():
@@ -104,13 +105,18 @@ def run_csv_training(cfg: Config, fault_injector: Optional[FaultInjector] = None
         for _ in range(it.steps_per_epoch):
             yield next(it)
 
-    state, history = trainer.fit(
-        state, train_iter, cfg.epochs, steps, val_batches=val_batches,
-        checkpoint_manager=ckpt, log_every=cfg.log_every_steps,
-        heartbeat=_heartbeat(cfg), fault_injector=fault_injector,
-        grad_accum=cfg.grad_accum_steps,
-    )
-    finalize_run(ckpt, state, history, cfg.output_dir, model_name="mlp")
+    try:
+        state, history = trainer.fit(
+            state, train_iter, cfg.epochs, steps, val_batches=val_batches,
+            checkpoint_manager=ckpt, log_every=cfg.log_every_steps,
+            heartbeat=_heartbeat(cfg), fault_injector=fault_injector,
+            grad_accum=cfg.grad_accum_steps,
+        )
+        finalize_run(ckpt, state, history, cfg.output_dir, model_name="mlp")
+    finally:
+        # Join in-flight async saves even on failure: the restart wrapper
+        # builds a fresh manager on this directory, and two writers race.
+        ckpt.close()
     return history
 
 
@@ -153,7 +159,8 @@ def run_image_training(cfg: Config, fault_injector: Optional[FaultInjector] = No
     )
 
     ckpt, state = make_checkpoint(
-        cfg.output_dir, cfg.checkpoint_every_steps, state, cfg.resume
+        cfg.output_dir, cfg.checkpoint_every_steps, state, cfg.resume,
+        async_save=cfg.async_checkpoint,
     )
 
     def val_batches():
@@ -164,14 +171,17 @@ def run_image_training(cfg: Config, fault_injector: Optional[FaultInjector] = No
         for _ in range(it.steps_per_epoch):
             yield next(it)
 
-    state, history = trainer.fit(
-        state, train_iter, cfg.epochs, steps, val_batches=val_batches,
-        checkpoint_manager=ckpt, log_every=cfg.log_every_steps,
-        heartbeat=_heartbeat(cfg), fault_injector=fault_injector,
-        grad_accum=cfg.grad_accum_steps,
-    )
-    finalize_run(ckpt, state, history, cfg.output_dir,
-                 model_name="cnn-b1" if cfg.flat_layer else "cnn-a1")
+    try:
+        state, history = trainer.fit(
+            state, train_iter, cfg.epochs, steps, val_batches=val_batches,
+            checkpoint_manager=ckpt, log_every=cfg.log_every_steps,
+            heartbeat=_heartbeat(cfg), fault_injector=fault_injector,
+            grad_accum=cfg.grad_accum_steps,
+        )
+        finalize_run(ckpt, state, history, cfg.output_dir,
+                     model_name="cnn-b1" if cfg.flat_layer else "cnn-a1")
+    finally:
+        ckpt.close()
     return history
 
 

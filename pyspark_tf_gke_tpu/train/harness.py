@@ -63,11 +63,13 @@ def make_checkpoint(
     every_steps: int,
     state,
     resume: bool,
+    async_save: bool = False,
 ):
     """Build the CheckpointManager under ``output_dir`` and restore the
     latest step when resuming. Returns (manager, possibly-restored state)."""
     ckpt = CheckpointManager(
-        os.path.join(output_dir, "checkpoints"), every_steps=every_steps
+        os.path.join(output_dir, "checkpoints"), every_steps=every_steps,
+        async_save=async_save,
     )
     if resume and ckpt.latest_step() is not None:
         state = ckpt.restore(state)
@@ -79,6 +81,7 @@ def finalize_run(ckpt: CheckpointManager, state, history: Dict, output_dir: str,
     """Terminal save: checkpoint + history.json (the reference's
     model.save + history dump, train_tf_ps.py:674-679) + run notes."""
     ckpt.save(state, history)
+    ckpt.wait()  # terminal save must be durable before the process exits
     save_history(output_dir, history)
     save_run_notes(output_dir, model_name, state, history)
 

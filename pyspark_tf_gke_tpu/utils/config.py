@@ -98,6 +98,7 @@ class Config:
 
     # --- checkpoint / aux ---
     checkpoint_every_steps: int = _env_int("CHECKPOINT_EVERY_STEPS", 0)  # 0 → only at end
+    async_checkpoint: bool = _env_bool("ASYNC_CHECKPOINT", False)  # overlap saves with training
     resume: bool = _env_bool("RESUME", False)
     profile_dir: str = _env("PROFILE_DIR", "")
     log_every_steps: int = _env_int("LOG_EVERY_STEPS", 50)
@@ -158,6 +159,8 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
     p.add_argument("--num-processes", type=int, default=cfg.num_processes)
     p.add_argument("--process-id", type=int, default=cfg.process_id)
     p.add_argument("--checkpoint-every-steps", type=int, default=cfg.checkpoint_every_steps)
+    p.add_argument("--async-checkpoint", action="store_true", default=cfg.async_checkpoint,
+                   help="write checkpoints in the background (orbax async)")
     p.add_argument("--resume", action="store_true", default=cfg.resume)
     p.add_argument("--profile-dir", default=cfg.profile_dir)
     p.add_argument("--max-restarts", type=int, default=cfg.max_restarts,

@@ -199,3 +199,31 @@ def test_make_optimizer_schedules():
         assert tx is not None
     with pytest.raises(ValueError, match="unknown lr schedule"):
         make_optimizer(1e-3, "linear")
+
+
+def test_async_checkpoint_with_donated_training(tmp_path, mesh_dp):
+    """Async save must snapshot the state before returning: the trainer
+    keeps stepping (donating/overwriting the very buffers being saved)
+    while the write completes in the background, and the restored
+    checkpoint must equal the state AT save time, not after."""
+    X, y = synthetic_classification_arrays(n=128, num_classes=3)
+    model = MLPClassifier(num_classes=3)
+    trainer = Trainer(model, TASKS["classification"](), mesh_dp, learning_rate=1e-2)
+    it = BatchIterator({"x": X, "y": y}, 32, seed=0)
+    state = trainer.init_state(make_rng(0), next(iter(it)))
+    state, _ = trainer.fit(state, it, epochs=1, steps_per_epoch=2)
+
+    saved_params = jax.device_get(state.params)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=True)
+    mgr.save(state, {"loss": [1.0]})
+    # keep training immediately — donates the in-flight state's buffers
+    state, _ = trainer.fit(state, it, epochs=1, steps_per_epoch=3)
+    mgr.wait()
+    assert mgr.latest_step() == 2
+
+    template = trainer.init_state(make_rng(1), next(iter(it)))
+    restored = mgr.restore(template)
+    assert int(restored.step) == 2
+    for a, b in zip(jax.tree.leaves(saved_params), jax.tree.leaves(restored.params)):
+        np.testing.assert_allclose(np.asarray(a), jax.device_get(b))
+    mgr.close()
