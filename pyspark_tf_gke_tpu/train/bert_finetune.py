@@ -54,6 +54,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--data-pattern", default=e("DATA_PATTERN", ""),
                    help="glob of TFRecord shards, e.g. 'gs://bucket/shards/train-*.tfrecord'")
     p.add_argument("--seq-len", type=int, default=int(e("SEQ_LEN", "128")))
+    p.add_argument("--objective", default=e("OBJECTIVE", "classification"),
+                   choices=["classification", "mlm"],
+                   help="classification = fine-tune on the label column; "
+                        "mlm = masked-LM pretraining on the token stream")
+    p.add_argument("--mlm-prob", type=float, default=float(e("MLM_PROB", "0.15")))
     p.add_argument("--num-labels", type=int, default=int(e("NUM_LABELS", "2")))
     p.add_argument("--vocab-size", type=int, default=int(e("VOCAB_SIZE", "30522")))
     p.add_argument("--hidden-size", type=int, default=int(e("HIDDEN_SIZE", "768")))
@@ -131,15 +136,25 @@ def main(argv=None) -> dict:
     )
     mesh = make_mesh(parse_mesh_shape(args.mesh_shape) or None)
     model = BertForPretraining(cfg, mesh=mesh, num_labels=args.num_labels)
-    trainer = Trainer(model, TASKS["bert_classification"](), mesh,
-                      learning_rate=args.learning_rate)
+    task = TASKS["bert_mlm" if args.objective == "mlm" else "bert_classification"]()
+    trainer = Trainer(model, task, mesh, learning_rate=args.learning_rate)
 
     local_bs = local_batch_size(args.batch_size)
 
     def batches():
-        for raw in read_tfrecord_batches(
-            args.data_pattern, shard_schema(args.seq_len), local_bs, seed=args.seed
-        ):
+        schema = shard_schema(args.seq_len)
+        if args.objective == "mlm":
+            schema.pop("label")  # token-stream pretraining data is unlabeled
+        raw_iter = read_tfrecord_batches(
+            args.data_pattern, schema, local_bs, seed=args.seed
+        )
+        if args.objective == "mlm":
+            from pyspark_tf_gke_tpu.data.mlm import mlm_batches
+
+            yield from mlm_batches(raw_iter, args.vocab_size, seed=args.seed,
+                                   mask_prob=args.mlm_prob)
+            return
+        for raw in raw_iter:
             yield {
                 "input_ids": raw["input_ids"],
                 "attention_mask": raw["attention_mask"],

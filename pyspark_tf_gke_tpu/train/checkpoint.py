@@ -58,10 +58,18 @@ class CheckpointManager:
         step = int(jax.device_get(state.step))
         self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
         if self.async_save:
-            # history.json sits next to the checkpoint and would attest
-            # to a save that is not yet durable — defer it to wait().
-            if history is not None:
-                self._pending_history = history
+            # orbax joins the PRIOR in-flight save before starting this
+            # one, so the previously deferred history is durable now.
+            if self._pending_history is not None:
+                self._write_history(self._pending_history)
+            # Snapshot (the trainer keeps mutating its history dict) and
+            # defer: history.json sits next to the checkpoint and must
+            # never attest to a save that is not yet durable.
+            self._pending_history = (
+                None if history is None
+                else {k: list(v) if isinstance(v, list) else v
+                      for k, v in history.items()}
+            )
             logger.info("Scheduled async checkpoint save of step %d to %s",
                         step, self.directory)
             return

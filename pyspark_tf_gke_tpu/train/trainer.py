@@ -109,12 +109,14 @@ def resnet_task() -> TrainerTask:
     return TrainerTask("resnet", forward, lam, has_batch_stats=True)
 
 
-def bert_classification_task() -> TrainerTask:
-    def forward(model, variables, batch, train, mutable):
-        return model.apply(
-            variables, batch["input_ids"], attention_mask=batch.get("attention_mask")
-        ), None
+def _bert_forward(model, variables, batch, train, mutable):
+    """Shared forward for every BERT objective (classification, MLM)."""
+    return model.apply(
+        variables, batch["input_ids"], attention_mask=batch.get("attention_mask")
+    ), None
 
+
+def bert_classification_task() -> TrainerTask:
     def lam(preds, batch):
         logits = preds["cls_logits"]
         loss = softmax_cross_entropy(logits, batch["labels"])
@@ -126,7 +128,33 @@ def bert_classification_task() -> TrainerTask:
             metrics["moe_aux_loss"] = aux
         return loss, metrics
 
-    return TrainerTask("bert_classification", forward, lam)
+    return TrainerTask("bert_classification", _bert_forward, lam)
+
+
+def bert_mlm_task() -> TrainerTask:
+    """Masked-language-model pretraining: cross-entropy over the masked
+    positions only (labels == IGNORE_INDEX elsewhere — data/mlm.py)."""
+    from pyspark_tf_gke_tpu.data.mlm import IGNORE_INDEX
+
+    def lam(preds, batch):
+        logits = preds["mlm_logits"].astype(jnp.float32)  # [B, S, V]
+        labels = batch["mlm_labels"]
+        mask = (labels != IGNORE_INDEX)
+        safe = jnp.where(mask, labels, 0)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+        denom = jnp.maximum(mask.sum(), 1)
+        loss = jnp.where(mask, per_tok, 0.0).sum() / denom
+        acc = (jnp.where(mask, jnp.argmax(logits, -1) == safe, False).sum()
+               / denom)
+        metrics = {"loss": loss, "mlm_accuracy": acc,
+                   "masked_frac": mask.mean()}
+        aux = preds.get("aux_loss") if isinstance(preds, dict) else None
+        if aux is not None:
+            loss = loss + MOE_AUX_WEIGHT * aux
+            metrics["moe_aux_loss"] = aux
+        return loss, metrics
+
+    return TrainerTask("bert_mlm", _bert_forward, lam)
 
 
 TASKS = {
@@ -134,6 +162,7 @@ TASKS = {
     "regression": regression_task,
     "resnet": resnet_task,
     "bert_classification": bert_classification_task,
+    "bert_mlm": bert_mlm_task,
 }
 
 
