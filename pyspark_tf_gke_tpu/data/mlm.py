@@ -14,7 +14,7 @@ end-to-end, completing the pretrain+finetune story for config 5.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -54,7 +54,7 @@ def apply_mlm_masking(
 
 def mlm_batches(batches, vocab_size: int, seed: int = 1337,
                 mask_prob: float = 0.15,
-                mask_token_id: int = DEFAULT_MASK_ID) -> "Dict":
+                mask_token_id: int = DEFAULT_MASK_ID) -> Iterator[Dict[str, np.ndarray]]:
     """Wrap an iterator of {input_ids, attention_mask, ...} batches into
     MLM training batches {input_ids, attention_mask, mlm_labels}."""
     rng = np.random.default_rng(seed)

@@ -40,17 +40,9 @@ from pyspark_tf_gke_tpu.ops.attention import (
 from pyspark_tf_gke_tpu.parallel.mesh import DATA_AXES
 
 
-def on_tpu() -> bool:
-    """True when the active backend compiles Pallas TPU kernels."""
-    return jax.default_backend() in ("tpu", "axon")
-
-
-# Auto-flash threshold (measured on v5e, fwd+bwd per train step): below
-# this sequence length XLA's fused dense attention wins (kernel dispatch
-# and unfusable reshapes dominate); at/above it the Pallas kernel wins —
-# 1.2x at S=1024, 2.3x at S=4096, 6x at S=8192 (where dense hits the
-# S^2-materialization memory cliff).
-FLASH_MIN_SEQ = 512
+# Shared flash-vs-dense dispatch constants (ops/pallas/common.py) —
+# re-exported here for callers that think in model terms (bench.py).
+from pyspark_tf_gke_tpu.ops.pallas.common import FLASH_MIN_SEQ, on_tpu  # noqa: E402
 
 
 def resolve_use_flash(cfg: "BertConfig", seq_len: int) -> bool:
@@ -223,7 +215,11 @@ class BertSelfAttention(nn.Module):
         use_flash = resolve_use_flash(cfg, s)
         if use_sp:
             sp_fn = ulysses_attention if cfg.sp_impl == "ulysses" else ring_attention
-            out = sp_fn(q, k, v, self.mesh, kv_mask=mask, axis="sp")
+            # Pass the raw tri-state: explicit True/False wins; None lets
+            # each sp impl auto-decide with its own (per-shard vs global)
+            # sequence-length knowledge.
+            out = sp_fn(q, k, v, self.mesh, kv_mask=mask, axis="sp",
+                        use_flash=cfg.use_flash)
         elif use_flash:
             from pyspark_tf_gke_tpu.ops.pallas.flash_attention import flash_attention
 

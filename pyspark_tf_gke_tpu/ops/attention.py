@@ -205,10 +205,11 @@ def ring_attention(
                                      mask=None if kv_mask is None else kv_mask[:, None, None, :],
                                      causal=causal)
     if use_flash is None:
+        from pyspark_tf_gke_tpu.ops.pallas.common import FLASH_MIN_SEQ, on_tpu
+
         use_flash = (
-            not causal
-            and jax.default_backend() in ("tpu", "axon")
-            and q.shape[1] // axis_size >= 512
+            not causal and on_tpu()
+            and q.shape[1] // axis_size >= FLASH_MIN_SEQ
         )
     if use_flash:
         if causal:
@@ -229,6 +230,7 @@ def ulysses_attention(
     kv_mask: Optional[jnp.ndarray] = None,  # [B, S] bool, S sharded likewise
     axis: str = "sp",
     causal: bool = False,
+    use_flash: Optional[bool] = None,
 ) -> jnp.ndarray:
     """All-to-all sequence parallelism over mesh axis ``axis``.
 
@@ -237,9 +239,24 @@ def ulysses_attention(
     attention runs locally (exact, not blockwise), and the inverse
     ``all_to_all`` restores the sequence sharding. Head count (after any
     ``tp`` split) must divide by the axis size.
+
+    ``use_flash`` (None = auto: TPU and global seq >= 512) runs the
+    local attention through the Pallas flash kernel — the device sees
+    the FULL sequence here, so unlike the ring, even ``causal`` works
+    (the kernel's positions are global).
     """
     axis_size = mesh.shape[axis]
+    if use_flash is None:
+        from pyspark_tf_gke_tpu.ops.pallas.common import FLASH_MIN_SEQ, on_tpu
+
+        use_flash = on_tpu() and q.shape[1] >= FLASH_MIN_SEQ
     if axis_size == 1:
+        if use_flash:
+            from pyspark_tf_gke_tpu.ops.pallas.flash_attention import (
+                flash_attention,
+            )
+
+            return flash_attention(q, k, v, kv_mask=kv_mask, causal=causal)
         return dot_product_attention(
             q, k, v,
             mask=None if kv_mask is None else kv_mask[:, None, None, :],
@@ -263,11 +280,18 @@ def ulysses_attention(
             None if mask is None
             else lax.all_gather(mask, axis, axis=1, tiled=True)
         )
-        out = dot_product_attention(
-            q, k, v,
-            mask=None if full_mask is None else full_mask[:, None, None, :],
-            causal=causal,
-        )
+        if use_flash:
+            from pyspark_tf_gke_tpu.ops.pallas.flash_attention import (
+                flash_attention,
+            )
+
+            out = flash_attention(q, k, v, kv_mask=full_mask, causal=causal)
+        else:
+            out = dot_product_attention(
+                q, k, v,
+                mask=None if full_mask is None else full_mask[:, None, None, :],
+                causal=causal,
+            )
         # [B, S, h/sp, D] -> [B, S/sp, h, D]
         return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
 

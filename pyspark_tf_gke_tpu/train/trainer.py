@@ -116,17 +116,21 @@ def _bert_forward(model, variables, batch, train, mutable):
     ), None
 
 
+def _add_moe_aux(loss, metrics, preds):
+    """MoE load-balance loss (models/moe.py); 0 for dense configs."""
+    aux = preds.get("aux_loss")
+    if aux is not None:
+        loss = loss + MOE_AUX_WEIGHT * aux
+        metrics["moe_aux_loss"] = aux
+    return loss, metrics
+
+
 def bert_classification_task() -> TrainerTask:
     def lam(preds, batch):
         logits = preds["cls_logits"]
         loss = softmax_cross_entropy(logits, batch["labels"])
         metrics = {"loss": loss, "accuracy": accuracy_metric(logits, batch["labels"])}
-        aux = preds.get("aux_loss") if isinstance(preds, dict) else None
-        if aux is not None:
-            # MoE load-balance loss (models/moe.py); 0 for dense configs.
-            loss = loss + MOE_AUX_WEIGHT * aux
-            metrics["moe_aux_loss"] = aux
-        return loss, metrics
+        return _add_moe_aux(loss, metrics, preds)
 
     return TrainerTask("bert_classification", _bert_forward, lam)
 
@@ -148,11 +152,7 @@ def bert_mlm_task() -> TrainerTask:
                / denom)
         metrics = {"loss": loss, "mlm_accuracy": acc,
                    "masked_frac": mask.mean()}
-        aux = preds.get("aux_loss") if isinstance(preds, dict) else None
-        if aux is not None:
-            loss = loss + MOE_AUX_WEIGHT * aux
-            metrics["moe_aux_loss"] = aux
-        return loss, metrics
+        return _add_moe_aux(loss, metrics, preds)
 
     return TrainerTask("bert_mlm", _bert_forward, lam)
 
