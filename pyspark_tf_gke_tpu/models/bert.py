@@ -65,9 +65,10 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     dtype: Any = jnp.bfloat16
     remat: bool = False
-    # Pallas flash-attention kernel (sp=1 only). None = auto: on for TPU
-    # backends when seq >= FLASH_MIN_SEQ, off elsewhere (the dense path;
-    # tests force True with the interpret-mode kernel).
+    # Pallas flash attention. None = auto (per path: sp=1 uses the plain
+    # kernel at seq >= FLASH_MIN_SEQ on TPU; sp>1 ring/Ulysses apply their
+    # own thresholds). Explicit True/False forces the kernel on/off on
+    # every path; tests force True with the interpret-mode kernel.
     use_flash: Optional[bool] = None
     # Pallas fused LayerNorm. None = auto: on for TPU backends.
     use_fused_ln: Optional[bool] = None

@@ -408,7 +408,9 @@ def _pick_seq_block(s: int, desired: int) -> int:
 def _prep_bh(q, k, v, kv_mask, segment_ids, block_q, block_k, interpret):
     b, s, h, d = q.shape
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        from pyspark_tf_gke_tpu.ops.pallas.common import on_tpu
+
+        interpret = not on_tpu()
     if block_q is None:
         block_q = _pick_seq_block(s, DEFAULT_BLOCK_Q)
     if block_k is None:

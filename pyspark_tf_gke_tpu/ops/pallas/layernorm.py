@@ -153,7 +153,9 @@ def fused_layernorm(
     residual: Optional[jnp.ndarray] = None,  # same shape as x; y = LN(x+r)
 ) -> jnp.ndarray:
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        from pyspark_tf_gke_tpu.ops.pallas.common import on_tpu
+
+        interpret = not on_tpu()
     shape = x.shape
     x2 = x.reshape(-1, shape[-1])
     br = _pick_block(x2.shape[0], block_rows)

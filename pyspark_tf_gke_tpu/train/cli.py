@@ -85,7 +85,9 @@ def run_csv_training(cfg: Config, fault_injector: Optional[FaultInjector] = None
     mesh = make_mesh(cfg.mesh_axes() or None)
     model = build_model("mlp", num_classes=num_classes)
     tx = make_optimizer(cfg.learning_rate, cfg.lr_schedule,
-                        total_steps=cfg.epochs * steps, warmup_steps=cfg.warmup_steps)
+                        total_steps=cfg.epochs * steps, warmup_steps=cfg.warmup_steps,
+                        optimizer=cfg.optimizer, weight_decay=cfg.weight_decay,
+                        momentum=cfg.momentum, grad_clip_norm=cfg.grad_clip_norm)
     trainer = Trainer(model, TASKS["classification"](), mesh, tx=tx,
                       fsdp_min_size=cfg.fsdp_min_size)
     # Unsliced host-shard arrays as the init sample: shape-only tracing, and
@@ -151,7 +153,9 @@ def run_image_training(cfg: Config, fault_injector: Optional[FaultInjector] = No
     mesh = make_mesh(cfg.mesh_axes() or None)
     model = build_model("cnn", flat=cfg.flat_layer, dtype=_dtype(cfg.compute_dtype))
     tx = make_optimizer(cfg.learning_rate, cfg.lr_schedule,
-                        total_steps=cfg.epochs * steps, warmup_steps=cfg.warmup_steps)
+                        total_steps=cfg.epochs * steps, warmup_steps=cfg.warmup_steps,
+                        optimizer=cfg.optimizer, weight_decay=cfg.weight_decay,
+                        momentum=cfg.momentum, grad_clip_norm=cfg.grad_clip_norm)
     trainer = Trainer(model, TASKS["regression"](), mesh, tx=tx,
                       fsdp_min_size=cfg.fsdp_min_size)
     state = trainer.init_state(

@@ -20,10 +20,16 @@ def make_optimizer(
     schedule: str = "constant",
     total_steps: int = 0,
     warmup_steps: int = 0,
+    optimizer: str = "adam",
+    weight_decay: float = 0.0,
+    momentum: float = 0.9,
+    grad_clip_norm: float = 0.0,
 ):
-    """Adam with an optax LR schedule: constant | cosine | warmup_cosine.
-    (The reference uses bare constant-LR Adam, train_tf_ps.py:339,606;
-    schedules are the expected upgrade for the ResNet/BERT configs.)"""
+    """Optimizer factory: adam | adamw | sgd | momentum | lamb with an
+    optax LR schedule (constant | cosine | warmup_cosine) and optional
+    global-norm gradient clipping. (The reference uses bare constant-LR
+    Adam, train_tf_ps.py:339,606; adamw+warmup_cosine is the standard
+    recipe for the BERT config, lamb for large-batch pretraining.)"""
     import optax
 
     if schedule not in ("constant", "cosine", "warmup_cosine"):
@@ -44,7 +50,32 @@ def make_optimizer(
             0.0, learning_rate, max(warmup_steps, 1),
             max(total_steps, warmup_steps + 1),
         )
-    return optax.adam(lr)
+
+    def decay_mask(params):
+        # Standard BERT/LAMB recipe: decay matrices/embeddings only —
+        # never biases or LayerNorm scales (all 1-D leaves).
+        import jax as _jax
+
+        return _jax.tree.map(lambda p: _jax.numpy.ndim(p) >= 2, params)
+
+    if optimizer == "adam":
+        tx = optax.adam(lr)
+    elif optimizer == "adamw":
+        tx = optax.adamw(lr, weight_decay=weight_decay, mask=decay_mask)
+    elif optimizer == "sgd":
+        tx = optax.sgd(lr)
+    elif optimizer == "momentum":
+        tx = optax.sgd(lr, momentum=momentum, nesterov=True)
+    elif optimizer == "lamb":
+        tx = optax.lamb(lr, weight_decay=weight_decay, mask=decay_mask)
+    else:
+        raise ValueError(
+            f"unknown optimizer {optimizer!r}; use adam | adamw | sgd | "
+            "momentum | lamb"
+        )
+    if grad_clip_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
+    return tx
 
 
 def local_batch_size(global_batch: int) -> int:

@@ -79,6 +79,10 @@ class Config:
     learning_rate: float = _env_float("LEARNING_RATE", 1e-3)
     lr_schedule: str = _env("LR_SCHEDULE", "constant")  # constant|cosine|warmup_cosine
     warmup_steps: int = _env_int("WARMUP_STEPS", 0)
+    optimizer: str = _env("OPTIMIZER", "adam")  # adam|adamw|sgd|momentum|lamb
+    weight_decay: float = _env_float("WEIGHT_DECAY", 0.0)
+    momentum: float = _env_float("MOMENTUM", 0.9)  # --optimizer momentum only
+    grad_clip_norm: float = _env_float("GRAD_CLIP_NORM", 0.0)  # 0 → off
     grad_accum_steps: int = _env_int("GRAD_ACCUM_STEPS", 1)
     compute_dtype: str = _env("COMPUTE_DTYPE", "bfloat16")
 
@@ -150,6 +154,12 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
     p.add_argument("--lr-schedule", default=cfg.lr_schedule,
                    choices=["constant", "cosine", "warmup_cosine"])
     p.add_argument("--warmup-steps", type=int, default=cfg.warmup_steps)
+    p.add_argument("--optimizer", default=cfg.optimizer,
+                   choices=["adam", "adamw", "sgd", "momentum", "lamb"])
+    p.add_argument("--weight-decay", type=float, default=cfg.weight_decay)
+    p.add_argument("--momentum", type=float, default=cfg.momentum)
+    p.add_argument("--grad-clip-norm", type=float, default=cfg.grad_clip_norm,
+                   help="clip gradients by global norm (0 = off)")
     p.add_argument("--grad-accum-steps", type=int, default=cfg.grad_accum_steps,
                    help="microbatches accumulated per optimizer step")
     p.add_argument("--compute-dtype", default=cfg.compute_dtype)

@@ -227,3 +227,25 @@ def test_async_checkpoint_with_donated_training(tmp_path, mesh_dp):
     for a, b in zip(jax.tree.leaves(saved_params), jax.tree.leaves(restored.params)):
         np.testing.assert_allclose(np.asarray(a), jax.device_get(b))
     mgr.close()
+
+
+def test_make_optimizer_families(mesh_dp):
+    """Every optimizer family must build and train the MLP a step."""
+    from pyspark_tf_gke_tpu.data.pipeline import put_global_batch
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding
+    from pyspark_tf_gke_tpu.train.harness import make_optimizer
+
+    X, y = synthetic_classification_arrays(n=64, num_classes=3)
+    batch = {"x": X[:32], "y": y[:32]}
+    gb = put_global_batch(batch, batch_sharding(mesh_dp))
+    for name in ("adam", "adamw", "sgd", "momentum", "lamb"):
+        tx = make_optimizer(1e-2, optimizer=name, weight_decay=0.01,
+                            grad_clip_norm=1.0)
+        model = MLPClassifier(num_classes=3)
+        trainer = Trainer(model, TASKS["classification"](), mesh_dp, tx=tx)
+        state = trainer.init_state(make_rng(0), batch)
+        state, metrics = trainer.step(state, gb)
+        assert np.isfinite(float(jax.device_get(metrics["loss"]))), name
+
+    with pytest.raises(ValueError):
+        make_optimizer(1e-2, optimizer="adagrad")
