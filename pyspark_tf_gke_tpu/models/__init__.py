@@ -4,6 +4,7 @@ from pyspark_tf_gke_tpu.models.resnet import ResNet50
 from pyspark_tf_gke_tpu.models.bert import BertConfig, BertEncoder, BertForPretraining
 from pyspark_tf_gke_tpu.models.pipelined_bert import PipelinedBertClassifier
 from pyspark_tf_gke_tpu.models.moe import MoELayer
+from pyspark_tf_gke_tpu.models.causal_lm import CausalLM, CausalLMConfig, generate
 
 __all__ = [
     "MLPClassifier",
@@ -15,6 +16,9 @@ __all__ = [
     "BertForPretraining",
     "PipelinedBertClassifier",
     "MoELayer",
+    "CausalLM",
+    "CausalLMConfig",
+    "generate",
     "build_model",
 ]
 
@@ -33,4 +37,7 @@ def build_model(name: str, **kw):
     if name == "bert":
         cfg = kw.get("config") or BertConfig()
         return BertForPretraining(cfg)
+    if name == "causal_lm":
+        cfg = kw.get("config") or CausalLMConfig()
+        return CausalLM(cfg)
     raise ValueError(f"Unknown model {name!r}")

@@ -1,0 +1,317 @@
+"""Decoder-only causal language model + KV-cache autoregressive decoding.
+
+No counterpart in the reference (its only models are an MLP and CNNs —
+SURVEY §2b); this completes the transformer family with the *serving*
+path a framework needs: train with next-token loss, then generate with
+a static-shape KV cache under ``lax.scan`` — no retracing per token, no
+dynamic shapes, XLA-friendly throughout.
+
+TPU-first design notes:
+
+* pre-LN blocks sharing the encoder's building blocks
+  (``_dense`` / ``_layernorm`` / logical axis annotations from
+  ``models/bert.py``) so the same LOGICAL_RULES place it on any mesh;
+* training attention goes through the same dispatch as BERT: Pallas
+  flash (``causal=True`` with block-level skipping) on TPU at
+  seq >= FLASH_MIN_SEQ, dense otherwise, shard_map-wrapped on sharded
+  meshes;
+* decoding keeps a ``[B, S_max, H, D]`` K/V cache per layer as flax
+  "cache" variables; each step attends over the cache prefix with a
+  position mask (static shapes — the mask, not the shapes, moves);
+* ``generate`` = one jitted prefill + one jitted ``lax.scan`` over
+  decode steps (greedy or temperature sampling).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from pyspark_tf_gke_tpu.models.bert import _data_shards, _dense
+from pyspark_tf_gke_tpu.ops.attention import dot_product_attention
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class CausalLMConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_seq_len: int = 1024
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    use_flash: Optional[bool] = None  # None = auto (TPU, seq >= FLASH_MIN_SEQ)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def _ln(cfg: CausalLMConfig, mesh: Optional[Mesh] = None, name=None):
+    from pyspark_tf_gke_tpu.models.bert import FusedLayerNorm
+
+    return FusedLayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                          mesh=mesh, name=name)
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: CausalLMConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, hidden, *, decode: bool = False, prefill: bool = False):
+        cfg = self.cfg
+        b, s, _ = hidden.shape
+        h, d = cfg.num_heads, cfg.head_dim
+
+        q = _dense(cfg.hidden_size, ("embed", "mlp"), cfg, name="query")(hidden)
+        k = _dense(cfg.hidden_size, ("embed", "mlp"), cfg, name="key")(hidden)
+        v = _dense(cfg.hidden_size, ("embed", "mlp"), cfg, name="value")(hidden)
+        q = q.reshape(b, s, h, d)
+        k = k.reshape(b, s, h, d)
+        v = v.reshape(b, s, h, d)
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"))
+        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"))
+
+        if decode:
+            out = self._decode_attend(q, k, v)
+        else:
+            if prefill:
+                # One full forward fills the whole cache prefix — no
+                # per-token replay; attention below is the normal causal
+                # pass over the prompt.
+                self._write_cache_prefix(k, v)
+            out = self._causal_attend(q, k, v)
+        out = out.reshape(b, s, cfg.hidden_size)
+        return _dense(cfg.hidden_size, ("mlp", "embed"), cfg, name="out")(out)
+
+    def _causal_attend(self, q, k, v):
+        from pyspark_tf_gke_tpu.models.bert import resolve_use_flash
+
+        cfg = self.cfg
+        s = q.shape[1]
+        if resolve_use_flash(cfg, s):
+            from pyspark_tf_gke_tpu.ops.pallas.flash_attention import (
+                flash_attention,
+            )
+
+            if _data_shards(self.mesh, "dp", "fsdp", "tp") > 1:
+                # Same rationale as BertSelfAttention: the partitioner
+                # can't split an opaque Pallas call — run it per shard.
+                from jax.sharding import PartitionSpec as P
+
+                from pyspark_tf_gke_tpu.parallel.mesh import DATA_AXES
+
+                qkv_spec = P(DATA_AXES, None, "tp", None)
+                fn = jax.shard_map(
+                    lambda qq, kk, vv: flash_attention(qq, kk, vv, causal=True),
+                    mesh=self.mesh,
+                    in_specs=(qkv_spec,) * 3,
+                    out_specs=qkv_spec,
+                    check_vma=False,
+                )
+                return fn(q, k, v)
+            return flash_attention(q, k, v, causal=True)
+        return dot_product_attention(q, k, v, causal=True)
+
+    def _cache_vars(self, b, h, d, dtype):
+        cfg = self.cfg
+        ck = self.variable("cache", "k", jnp.zeros,
+                           (b, cfg.max_seq_len, h, d), dtype)
+        cv = self.variable("cache", "v", jnp.zeros,
+                           (b, cfg.max_seq_len, h, d), dtype)
+        idx = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
+        return ck, cv, idx
+
+    def _write_cache_prefix(self, k, v):
+        b, s, h, d = k.shape
+        ck, cv, idx = self._cache_vars(b, h, d, k.dtype)
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, 0, 0))
+        idx.value = jnp.asarray(s, jnp.int32)
+
+    def _decode_attend(self, q, k, v):
+        """One-token step against the static-shape KV cache. The cache
+        is a flax "cache" variable [B, S_max, H, D]; ``cache_index``
+        tracks the fill level, and a position mask (not a dynamic slice
+        shape) hides the unwritten suffix."""
+        cfg = self.cfg
+        b, s, h, d = q.shape
+        if s != 1:
+            raise ValueError(f"decode step expects one token, got seq {s}")
+        ck, cv, idx = self._cache_vars(b, h, d, k.dtype)
+
+        pos = idx.value
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, pos, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, pos, 0, 0))
+        idx.value = pos + 1
+
+        # [B,1,H,D] x [B,S_max,H,D] -> [B,H,1,S_max], masked past the fill.
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value,
+                            preferred_element_type=jnp.float32) * (d ** -0.5)
+        valid = (jnp.arange(cfg.max_seq_len) <= pos)[None, None, None, :]
+        scores = jnp.where(valid, scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
+
+
+class CausalLMBlock(nn.Module):
+    cfg: CausalLMConfig
+    mesh: Optional[Mesh] = None
+    # Static mode flags live on the MODULE (not call kwargs): nn.remat
+    # forwards call kwargs as traced values, and `if decode:` on a
+    # tracer crashes. Module attributes stay Python bools under remat.
+    decode: bool = False
+    prefill: bool = False
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.cfg
+        attn_in = _ln(cfg, self.mesh, name="ln_attn")(hidden)
+        hidden = hidden + CausalSelfAttention(cfg, self.mesh, name="attention")(
+            attn_in, decode=self.decode, prefill=self.prefill
+        )
+        mlp_in = _ln(cfg, self.mesh, name="ln_mlp")(hidden)
+        mlp = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg, name="mlp_in")(mlp_in)
+        mlp = nn.gelu(mlp, approximate=True)
+        mlp = _dense(cfg.hidden_size, ("mlp", "embed"), cfg, name="mlp_out")(mlp)
+        return hidden + mlp
+
+
+class CausalLM(nn.Module):
+    """Pre-LN decoder stack with tied-untied LM head (untied: its own
+    ("embed", "vocab") projection, tensor-parallel over tp)."""
+
+    cfg: CausalLMConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, input_ids, *, decode: bool = False,
+                 prefill: bool = False,
+                 positions: Optional[jnp.ndarray] = None):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")),
+            name="wte",
+        )
+        pos_embed = nn.Embed(
+            cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), (None, "embed")),
+            name="wpe",
+        )
+        if positions is None:
+            positions = jnp.arange(s)[None, :]
+        hidden = embed(input_ids) + pos_embed(positions)
+
+        block_cls = CausalLMBlock
+        if cfg.remat and not (decode or prefill):
+            block_cls = nn.remat(CausalLMBlock, static_argnums=())
+        for i in range(cfg.num_layers):
+            hidden = block_cls(cfg, self.mesh, decode=decode, prefill=prefill,
+                               name=f"layer_{i}")(hidden)
+        hidden = _ln(cfg, self.mesh, name="ln_final")(hidden)
+        logits = _dense(cfg.vocab_size, ("embed", "vocab"), cfg,
+                        name="lm_head")(hidden)
+        return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# generation
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _prefill(model: CausalLM, params, prompt_ids):
+    """ONE full causal forward over the prompt: computes the last-token
+    logits AND writes every layer's K/V into the cache prefix
+    (prefill=True) — no per-token replay."""
+    logits, mutated = model.apply(
+        {"params": params}, prompt_ids, prefill=True, mutable=["cache"]
+    )
+    return mutated["cache"], logits[:, -1]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "temperature", "eos_token_id",
+                     "s_prompt"),
+)
+def _decode(model: CausalLM, params, cache, last_logits, rng, *,
+            max_new_tokens: int, temperature: float,
+            eos_token_id: Optional[int], s_prompt: int):
+    b = last_logits.shape[0]
+
+    def sample(logits, rng):
+        if temperature > 0:
+            return jax.random.categorical(rng, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def step(carry, t):
+        cache, logits, rng, done = carry
+        rng, sub = jax.random.split(rng)
+        tok = sample(logits, sub).astype(jnp.int32)          # [B]
+        if eos_token_id is not None:
+            tok = jnp.where(done, eos_token_id, tok)
+            done = done | (tok == eos_token_id)
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, tok[:, None], decode=True,
+            positions=jnp.full((b, 1), t, jnp.int32),
+            mutable=["cache"],
+        )
+        return (mutated["cache"], logits[:, 0], rng, done), tok
+
+    done0 = jnp.zeros((b,), bool)
+    (_, _, _, _), tokens = jax.lax.scan(
+        step, (cache, last_logits, rng, done0),
+        s_prompt + jnp.arange(max_new_tokens),
+    )
+    return tokens.T  # [B, max_new_tokens]
+
+
+def generate(
+    model: CausalLM,
+    params,
+    prompt_ids: jnp.ndarray,       # [B, S_prompt] int32
+    max_new_tokens: int,
+    temperature: float = 0.0,      # 0 → greedy
+    rng: Optional[jax.Array] = None,
+    eos_token_id: Optional[int] = None,
+) -> jnp.ndarray:
+    """Autoregressive decoding: one jitted prefill forward (fills the KV
+    cache in a single pass) + one jitted ``lax.scan`` over single-token
+    cache steps. The jits are module-level with the model/config static,
+    so repeat serving calls with the same shapes hit the compile cache.
+    Returns ``[B, S_prompt + max_new_tokens]``; after ``eos_token_id``
+    (if given) positions are padded with eos."""
+    cfg = model.cfg
+    _, s_prompt = prompt_ids.shape
+    if s_prompt + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {s_prompt} + {max_new_tokens} new tokens exceeds "
+            f"max_seq_len {cfg.max_seq_len}"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    cache, last_logits = _prefill(model, params, prompt_ids)
+    new_tokens = _decode(
+        model, params, cache, last_logits, rng,
+        max_new_tokens=max_new_tokens, temperature=temperature,
+        eos_token_id=eos_token_id, s_prompt=s_prompt,
+    )
+    return jnp.concatenate([prompt_ids, new_tokens], axis=1)

@@ -157,12 +157,39 @@ def bert_mlm_task() -> TrainerTask:
     return TrainerTask("bert_mlm", _bert_forward, lam)
 
 
+def causal_lm_task() -> TrainerTask:
+    """Next-token prediction: shift-by-one cross entropy over every
+    position that has a successor (optionally masked by attention_mask)."""
+
+    def forward(model, variables, batch, train, mutable):
+        return model.apply(variables, batch["input_ids"]), None
+
+    def lam(logits, batch):
+        ids = batch["input_ids"]
+        targets = ids[:, 1:]
+        lg = logits[:, :-1].astype(jnp.float32)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(lg, targets)
+        mask = batch.get("attention_mask")
+        if mask is not None:
+            m = mask[:, 1:].astype(jnp.float32)
+            denom = jnp.maximum(m.sum(), 1.0)
+            loss = (per_tok * m).sum() / denom
+            acc = ((jnp.argmax(lg, -1) == targets) * m).sum() / denom
+        else:
+            loss = per_tok.mean()
+            acc = (jnp.argmax(lg, -1) == targets).mean()
+        return loss, {"loss": loss, "next_token_accuracy": acc}
+
+    return TrainerTask("causal_lm", forward, lam)
+
+
 TASKS = {
     "classification": classification_task,
     "regression": regression_task,
     "resnet": resnet_task,
     "bert_classification": bert_classification_task,
     "bert_mlm": bert_mlm_task,
+    "causal_lm": causal_lm_task,
 }
 
 
@@ -240,6 +267,8 @@ class Trainer:
                     sample_batch["input_ids"],
                     attention_mask=sample_batch.get("attention_mask"),
                 )
+            elif task.name == "causal_lm":
+                variables = model.init(rng, sample_batch["input_ids"])
             elif task.name == "regression":
                 variables = model.init(rng, sample_batch["image"])
             else:
