@@ -23,7 +23,9 @@ and ``python bench.py bert`` measure examples/sec/chip for ResNet-50
 classification (batch 64, 224²) and BERT-base sequence classification
 (batch 32, S=128); same JSON shape, ``vs_baseline`` null (the reference
 has no such workloads to compare against). ``python bench.py io``
-measures the native input pipeline (TFRecord shards → host batches).
+measures the native input pipeline (TFRecord shards → host batches);
+``python bench.py generate [--kv-heads N]`` measures KV-cache decode
+tokens/sec on the serving path.
 
 Resilience: the TPU backend attach through the tunnel is known-flaky
 (round 1 lost its entire perf evidence to one failed attach). The
@@ -253,7 +255,8 @@ def bench_workload(name: str, steps: int = 50, smoke: bool = False,
         extra["flash"] = resolve_use_flash(cfg, seq)
         extra["seq_len"] = seq
     else:
-        raise SystemExit(f"unknown workload {name!r}; use cnn | resnet50 | bert | io")
+        raise SystemExit(
+            f"unknown workload {name!r}; use cnn | resnet50 | bert | generate | io")
 
     state = trainer.init_state(make_rng(1337), batch)
     sharding = batch_sharding(mesh)
@@ -275,6 +278,81 @@ def bench_workload(name: str, steps: int = 50, smoke: bool = False,
         "n_chips": n_chips,
         "device_kind": device_kind,
         **extra,
+    }
+
+
+def bench_decode(smoke: bool = False, kv_heads=None) -> dict:
+    """Serving-path throughput (BASELINE has no analog — this benches the
+    framework's own KV-cache generation): one jitted prefill + scan
+    decode on a GPT-small-shaped causal LM. Reports decode tokens/sec
+    per chip and the prefill latency. ``--kv-heads N`` measures the GQA
+    variant (smaller cache → less HBM traffic per decode step)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig, generate
+    from pyspark_tf_gke_tpu.models.causal_lm import _prefill
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+    from flax import linen as nn
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    device_kind = devices[0].device_kind
+
+    if smoke:
+        cfg = CausalLMConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                             num_heads=4, intermediate_size=128,
+                             max_seq_len=64, dtype=jnp.float32,
+                             num_kv_heads=int(kv_heads) if kv_heads else None)
+        batch, s_prompt, n_new = 2, 16, 8
+    else:
+        cfg = CausalLMConfig(
+            num_kv_heads=int(kv_heads) if kv_heads else None)  # GPT-small shape
+        batch, s_prompt, n_new = 8, 128, 512
+
+    model = CausalLM(cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, s_prompt)).astype(np.int32))
+    variables = jax.jit(model.init)(make_rng(1337), prompt[:, :8])
+    params = nn.meta.unbox(variables["params"])
+
+    # On the remote-attached chip block_until_ready can report before the
+    # queue drains (same gotcha as measure()); a host readback of an
+    # output is the only reliable completion barrier, so all timings
+    # force np.asarray on a (small) result.
+    log("compiling prefill + decode...")
+    np.asarray(generate(model, params, prompt, max_new_tokens=n_new))
+    np.asarray(_prefill(model, params, prompt)[1][:, :8])  # warm the timed slice path
+
+    t0 = time.perf_counter()
+    _, last_logits = _prefill(model, params, prompt)
+    np.asarray(last_logits[:, :8])  # tiny slice: completion barrier, not a 1MB transfer
+    prefill_dt = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = generate(model, params, prompt, max_new_tokens=n_new)
+    np.asarray(out)
+    dt = time.perf_counter() - t0
+
+    decode_dt = dt - prefill_dt
+    tokens = batch * n_new
+    return {
+        "metric": "causal_lm_decode_tokens_per_sec_per_chip",
+        "value": round(tokens / decode_dt / n_chips, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,
+        "prefill_ms": round(prefill_dt * 1000.0, 2),
+        "decode_step_ms": round(decode_dt / n_new * 1000.0, 3),
+        "batch_size": batch,
+        "prompt_len": s_prompt,
+        "new_tokens": n_new,
+        "kv_heads": cfg.kv_heads,
+        "num_heads": cfg.num_heads,
+        "n_chips": n_chips,
+        "device_kind": device_kind,
+        "workload": (f"CausalLM {cfg.num_layers}L h{cfg.hidden_size} "
+                     f"vocab {cfg.vocab_size}, greedy KV-cache decode"),
     }
 
 
@@ -339,7 +417,7 @@ def bench_io(smoke: bool = False) -> dict:
 # ---- orchestrator ----------------------------------------------------------
 
 
-_VALUE_FLAGS = ("--seq",)
+_VALUE_FLAGS = ("--seq", "--kv-heads")
 
 
 def _positionals(argv) -> list:
@@ -443,6 +521,17 @@ def run_bench(argv) -> dict:
         return main(batch_size=8, steps=2) if smoke else main()
     if workload == "io":
         return bench_io(smoke=smoke)
+    if workload == "generate":
+        kv = None
+        if "--kv-heads" in argv:
+            try:
+                kv = int(argv[argv.index("--kv-heads") + 1])
+                if kv <= 0:
+                    raise ValueError
+            except (IndexError, ValueError):
+                raise SystemExit(
+                    "usage: bench.py generate --kv-heads <positive int>")
+        return bench_decode(smoke=smoke, kv_heads=kv)
     use_flash = True if "--flash" in argv else (False if "--no-flash" in argv else None)
     seq = None
     if "--seq" in argv:

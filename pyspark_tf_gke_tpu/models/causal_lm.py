@@ -15,9 +15,11 @@ TPU-first design notes:
   flash (``causal=True`` with block-level skipping) on TPU at
   seq >= FLASH_MIN_SEQ, dense otherwise, shard_map-wrapped on sharded
   meshes;
-* decoding keeps a ``[B, S_max, H, D]`` K/V cache per layer as flax
-  "cache" variables; each step attends over the cache prefix with a
-  position mask (static shapes — the mask, not the shapes, moves);
+* decoding keeps a ``[B, S_max, H_kv, D]`` K/V cache per layer as flax
+  "cache" variables (``H_kv < H`` under grouped-query attention — the
+  cache, and with it per-step HBM traffic, shrinks by ``H/H_kv``); each
+  step attends over the cache prefix with a position mask (static
+  shapes — the mask, not the shapes, moves);
 * ``generate`` = one jitted prefill + one jitted ``lax.scan`` over
   decode steps (greedy or temperature sampling).
 """
@@ -51,10 +53,23 @@ class CausalLMConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False
     use_flash: Optional[bool] = None  # None = auto (TPU, seq >= FLASH_MIN_SEQ)
+    # Grouped-query attention: K/V get this many heads (must divide
+    # num_heads); None = num_heads (standard MHA), 1 = MQA. The KV cache
+    # shrinks by num_heads/num_kv_heads — the decode path is HBM-bound on
+    # cache reads, so this is a direct serving-throughput lever.
+    num_kv_heads: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.num_kv_heads if self.num_kv_heads is not None else self.num_heads
+        if self.num_heads % kv:
+            raise ValueError(
+                f"num_kv_heads {kv} must divide num_heads {self.num_heads}")
+        return kv
 
 
 def _ln(cfg: CausalLMConfig, mesh: Optional[Mesh] = None, name=None):
@@ -72,14 +87,14 @@ class CausalSelfAttention(nn.Module):
     def __call__(self, hidden, *, decode: bool = False, prefill: bool = False):
         cfg = self.cfg
         b, s, _ = hidden.shape
-        h, d = cfg.num_heads, cfg.head_dim
+        h, hkv, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
 
         q = _dense(cfg.hidden_size, ("embed", "mlp"), cfg, name="query")(hidden)
-        k = _dense(cfg.hidden_size, ("embed", "mlp"), cfg, name="key")(hidden)
-        v = _dense(cfg.hidden_size, ("embed", "mlp"), cfg, name="value")(hidden)
+        k = _dense(hkv * d, ("embed", "mlp"), cfg, name="key")(hidden)
+        v = _dense(hkv * d, ("embed", "mlp"), cfg, name="value")(hidden)
         q = q.reshape(b, s, h, d)
-        k = k.reshape(b, s, h, d)
-        v = v.reshape(b, s, h, d)
+        k = k.reshape(b, s, hkv, d)
+        v = v.reshape(b, s, hkv, d)
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"))
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"))
@@ -90,8 +105,14 @@ class CausalSelfAttention(nn.Module):
             if prefill:
                 # One full forward fills the whole cache prefix — no
                 # per-token replay; attention below is the normal causal
-                # pass over the prompt.
+                # pass over the prompt. The cache stores kv_heads only.
                 self._write_cache_prefix(k, v)
+            if hkv != h:
+                # Training/prefill compute path: broadcast K/V to the full
+                # head count so the shared flash/dense engines apply. The
+                # GQA memory win is in the cache, not the training pass.
+                k = jnp.repeat(k, h // hkv, axis=2)
+                v = jnp.repeat(v, h // hkv, axis=2)
             out = self._causal_attend(q, k, v)
         out = out.reshape(b, s, cfg.hidden_size)
         return _dense(cfg.hidden_size, ("mlp", "embed"), cfg, name="out")(out)
@@ -143,27 +164,34 @@ class CausalSelfAttention(nn.Module):
 
     def _decode_attend(self, q, k, v):
         """One-token step against the static-shape KV cache. The cache
-        is a flax "cache" variable [B, S_max, H, D]; ``cache_index``
+        is a flax "cache" variable [B, S_max, H_kv, D]; ``cache_index``
         tracks the fill level, and a position mask (not a dynamic slice
-        shape) hides the unwritten suffix."""
+        shape) hides the unwritten suffix. With GQA the grouped einsum
+        reads each cached KV head once for its whole query group — the
+        HBM traffic drops by num_heads/kv_heads."""
         cfg = self.cfg
         b, s, h, d = q.shape
+        hkv = k.shape[2]
         if s != 1:
             raise ValueError(f"decode step expects one token, got seq {s}")
-        ck, cv, idx = self._cache_vars(b, h, d, k.dtype)
+        ck, cv, idx = self._cache_vars(b, hkv, d, k.dtype)
 
         pos = idx.value
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, pos, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, pos, 0, 0))
         idx.value = pos + 1
 
-        # [B,1,H,D] x [B,S_max,H,D] -> [B,H,1,S_max], masked past the fill.
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value,
+        # [B,1,Hkv,G,D] x [B,S_max,Hkv,D] -> [B,Hkv,G,1,S_max], masked
+        # past the fill (G = query heads per KV head; G=1 is plain MHA).
+        g = h // hkv
+        q5 = q.reshape(b, s, hkv, g, d)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", q5, ck.value,
                             preferred_element_type=jnp.float32) * (d ** -0.5)
-        valid = (jnp.arange(cfg.max_seq_len) <= pos)[None, None, None, :]
+        valid = (jnp.arange(cfg.max_seq_len) <= pos)[None, None, None, None, :]
         scores = jnp.where(valid, scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, cv.value)
+        return out.reshape(b, s, h, d)
 
 
 class CausalLMBlock(nn.Module):
@@ -199,7 +227,8 @@ class CausalLM(nn.Module):
     @nn.compact
     def __call__(self, input_ids, *, decode: bool = False,
                  prefill: bool = False,
-                 positions: Optional[jnp.ndarray] = None):
+                 positions: Optional[jnp.ndarray] = None,
+                 return_hidden: bool = False):
         cfg = self.cfg
         b, s = input_ids.shape
         embed = nn.Embed(
@@ -225,9 +254,15 @@ class CausalLM(nn.Module):
             hidden = block_cls(cfg, self.mesh, decode=decode, prefill=prefill,
                                name=f"layer_{i}")(hidden)
         hidden = _ln(cfg, self.mesh, name="ln_final")(hidden)
-        logits = _dense(cfg.vocab_size, ("embed", "vocab"), cfg,
-                        name="lm_head")(hidden)
-        return logits.astype(jnp.float32)
+        head = _dense(cfg.vocab_size, ("embed", "vocab"), cfg, name="lm_head")
+        if return_hidden:
+            # Chunked-CE training path (ops/chunked_ce.py): the caller
+            # applies the head weight chunk-by-chunk inside the loss, so
+            # full [B,S,V] logits never materialize. Touch the head on a
+            # single position so its params exist under init.
+            head(hidden[:, :1])
+            return hidden
+        return head(hidden).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -246,28 +281,58 @@ def _prefill(model: CausalLM, params, prompt_ids):
     return mutated["cache"], logits[:, -1]
 
 
+def _filter_logits(logits, top_k: Optional[int], top_p):
+    """Mask logits outside the top-k set and/or the top-p (nucleus) mass
+    to NEG_INF. Static-shape friendly: thresholds, not gathers.
+    ``top_k`` is static (lax.top_k needs a static k); ``top_p`` may be a
+    traced scalar — only its presence is a trace key, so per-request
+    sampling settings don't recompile the decode program."""
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep tokens whose *exclusive* cumulative mass is < top_p — the
+        # top token always survives (and top_p >= 1 keeps everything).
+        # Threshold = smallest kept logit.
+        keep = (cum - probs) < top_p
+        thresh = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < thresh, NEG_INF, logits)
+    return logits
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "max_new_tokens", "temperature", "eos_token_id",
-                     "s_prompt"),
+    static_argnames=("model", "max_new_tokens", "greedy", "eos_token_id",
+                     "s_prompt", "top_k"),
 )
-def _decode(model: CausalLM, params, cache, last_logits, rng, *,
-            max_new_tokens: int, temperature: float,
-            eos_token_id: Optional[int], s_prompt: int):
+def _decode(model: CausalLM, params, cache, last_logits, rng, temperature,
+            top_p, *, max_new_tokens: int, greedy: bool,
+            eos_token_id: Optional[int], s_prompt: int,
+            top_k: Optional[int] = None):
     b = last_logits.shape[0]
 
     def sample(logits, rng):
-        if temperature > 0:
-            return jax.random.categorical(rng, logits / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
+        if greedy:
+            return jnp.argmax(logits, axis=-1)
+        logits = _filter_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(rng, logits, axis=-1)
+
+    def emit(logits, rng, done):
+        """Sample one token and fold in the eos latch."""
+        tok = sample(logits, rng).astype(jnp.int32)          # [B]
+        if eos_token_id is not None:
+            tok = jnp.where(done, eos_token_id, tok)
+            done = done | (tok == eos_token_id)
+        return tok, done
 
     def step(carry, t):
         cache, logits, rng, done = carry
         rng, sub = jax.random.split(rng)
-        tok = sample(logits, sub).astype(jnp.int32)          # [B]
-        if eos_token_id is not None:
-            tok = jnp.where(done, eos_token_id, tok)
-            done = done | (tok == eos_token_id)
+        tok, done = emit(logits, sub, done)
         logits, mutated = model.apply(
             {"params": params, "cache": cache}, tok[:, None], decode=True,
             positions=jnp.full((b, 1), t, jnp.int32),
@@ -275,11 +340,17 @@ def _decode(model: CausalLM, params, cache, last_logits, rng, *,
         )
         return (mutated["cache"], logits[:, 0], rng, done), tok
 
+    # Scan max_new_tokens - 1 steps; the final token is sampled from the
+    # carried logits directly — the last model forward (whose logits
+    # nobody reads) never runs.
     done0 = jnp.zeros((b,), bool)
-    (_, _, _, _), tokens = jax.lax.scan(
+    (_, last, rng, done), tokens = jax.lax.scan(
         step, (cache, last_logits, rng, done0),
-        s_prompt + jnp.arange(max_new_tokens),
+        s_prompt + jnp.arange(max_new_tokens - 1),
     )
+    rng, sub = jax.random.split(rng)
+    final, _ = emit(last, sub, done)
+    tokens = jnp.concatenate([tokens, final[None]], axis=0)
     return tokens.T  # [B, max_new_tokens]
 
 
@@ -291,13 +362,16 @@ def generate(
     temperature: float = 0.0,      # 0 → greedy
     rng: Optional[jax.Array] = None,
     eos_token_id: Optional[int] = None,
+    top_k: Optional[int] = None,   # sample from the k highest logits
+    top_p: Optional[float] = None,  # nucleus sampling mass (0, 1]
 ) -> jnp.ndarray:
     """Autoregressive decoding: one jitted prefill forward (fills the KV
     cache in a single pass) + one jitted ``lax.scan`` over single-token
     cache steps. The jits are module-level with the model/config static,
     so repeat serving calls with the same shapes hit the compile cache.
-    Returns ``[B, S_prompt + max_new_tokens]``; after ``eos_token_id``
-    (if given) positions are padded with eos."""
+    ``top_k``/``top_p`` filter the sampling distribution (ignored when
+    greedy). Returns ``[B, S_prompt + max_new_tokens]``; after
+    ``eos_token_id`` (if given) positions are padded with eos."""
     cfg = model.cfg
     _, s_prompt = prompt_ids.shape
     if s_prompt + max_new_tokens > cfg.max_seq_len:
@@ -309,9 +383,13 @@ def generate(
         rng = jax.random.PRNGKey(0)
 
     cache, last_logits = _prefill(model, params, prompt_ids)
+    # temperature / top_p ride as traced scalars: changing them per call
+    # (per request, on a server) reuses the compiled decode program.
     new_tokens = _decode(
         model, params, cache, last_logits, rng,
-        max_new_tokens=max_new_tokens, temperature=temperature,
-        eos_token_id=eos_token_id, s_prompt=s_prompt,
+        jnp.float32(temperature if temperature > 0 else 1.0),
+        jnp.float32(top_p) if top_p is not None else None,
+        max_new_tokens=max_new_tokens, greedy=temperature <= 0,
+        eos_token_id=eos_token_id, s_prompt=s_prompt, top_k=top_k,
     )
     return jnp.concatenate([prompt_ids, new_tokens], axis=1)

@@ -56,6 +56,97 @@ def test_kv_cache_decode_matches_full_forward():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_gqa_cache_decode_matches_full_forward():
+    """MQA (num_kv_heads=1): the grouped-einsum cache path must agree
+    exactly with full-recompute greedy decoding, and the cache must
+    actually hold only kv_heads heads."""
+    cfg = CausalLMConfig(**{**TINY, "num_kv_heads": 1})
+    model = CausalLM(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    variables = jax.jit(model.init)(make_rng(3), ids)
+    from flax import linen as nn
+
+    params = nn.meta.unbox(variables["params"])
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(0, 97, (2, 5)).astype(np.int32))
+    n_new = 6
+
+    out = generate(model, params, prompt, max_new_tokens=n_new)
+
+    ref = prompt
+    for _ in range(n_new):
+        logits = model.apply({"params": params}, ref)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        ref = jnp.concatenate([ref, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    # cache shape carries kv_heads=1, not num_heads=2
+    _, mutated = model.apply({"params": params}, prompt, prefill=True,
+                             mutable=["cache"])
+    ck = mutated["cache"]["layer_0"]["attention"]["k"]
+    assert ck.shape == (2, cfg.max_seq_len, 1, cfg.head_dim)
+
+
+def test_gqa_param_savings():
+    """K/V projection params shrink by num_heads/num_kv_heads."""
+    mha = CausalLMConfig(**TINY)
+    mqa = CausalLMConfig(**{**TINY, "num_kv_heads": 1})
+    from flax import linen as nn
+
+    ids = jnp.zeros((1, 4), jnp.int32)
+    p_mha = nn.meta.unbox(jax.jit(CausalLM(mha).init)(make_rng(0), ids)["params"])
+    p_mqa = nn.meta.unbox(jax.jit(CausalLM(mqa).init)(make_rng(0), ids)["params"])
+    k_mha = p_mha["layer_0"]["attention"]["key"]["kernel"]
+    k_mqa = p_mqa["layer_0"]["attention"]["key"]["kernel"]
+    assert k_mha.shape[-1] == 2 * k_mqa.shape[-1]
+
+
+def test_topk_topp_sampling():
+    model, params = _model_and_params(seed=4)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    # top_k=1 at any temperature is greedy
+    greedy = generate(model, params, prompt, max_new_tokens=5)
+    k1 = generate(model, params, prompt, max_new_tokens=5,
+                  temperature=1.0, top_k=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+    # nucleus sampling stays in-vocab and respects shapes
+    out = generate(model, params, prompt, max_new_tokens=5,
+                   temperature=1.3, top_k=20, top_p=0.9,
+                   rng=jax.random.PRNGKey(7))
+    toks = np.asarray(out)
+    assert toks.shape == (2, 9)
+    assert ((toks >= 0) & (toks < 97)).all()
+
+
+def test_sampling_params_do_not_recompile():
+    """temperature/top_p are traced operands: per-request sampling
+    settings must reuse the compiled decode program."""
+    from pyspark_tf_gke_tpu.models.causal_lm import _decode
+
+    model, params = _model_and_params(seed=5)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    generate(model, params, prompt, max_new_tokens=3, temperature=0.7,
+             top_p=0.9, rng=jax.random.PRNGKey(0))
+    n = _decode._cache_size()
+    generate(model, params, prompt, max_new_tokens=3, temperature=1.1,
+             top_p=0.8, rng=jax.random.PRNGKey(1))
+    assert _decode._cache_size() == n
+
+
+def test_filter_logits_topp_keeps_top_token():
+    from pyspark_tf_gke_tpu.models.causal_lm import _filter_logits
+
+    logits = jnp.asarray([[10.0, 1.0, 0.5, -2.0]])
+    # tiny top_p: only the argmax survives
+    out = _filter_logits(logits, None, 1e-6)
+    assert np.isfinite(np.asarray(out[0, 0]))
+    assert (np.asarray(out[0, 1:]) < -1e29).all()
+    # top_k=2 keeps exactly the two largest
+    out = _filter_logits(logits, 2, None)
+    assert np.isfinite(np.asarray(out[0, :2])).all()
+    assert (np.asarray(out[0, 2:]) < -1e29).all()
+
+
 def test_generate_eos_padding():
     model, params = _model_and_params(seed=2)
     prompt = jnp.zeros((1, 3), jnp.int32)
