@@ -3,5 +3,11 @@ from pyspark_tf_gke_tpu.ops.attention import (
     ring_attention,
     ulysses_attention,
 )
+from pyspark_tf_gke_tpu.ops.chunked_ce import chunked_cross_entropy
 
-__all__ = ["dot_product_attention", "ring_attention", "ulysses_attention"]
+__all__ = [
+    "dot_product_attention",
+    "ring_attention",
+    "ulysses_attention",
+    "chunked_cross_entropy",
+]

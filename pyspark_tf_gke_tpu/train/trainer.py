@@ -157,9 +157,50 @@ def bert_mlm_task() -> TrainerTask:
     return TrainerTask("bert_mlm", _bert_forward, lam)
 
 
-def causal_lm_task() -> TrainerTask:
+def causal_lm_task(vocab_chunks: Optional[int] = None) -> TrainerTask:
     """Next-token prediction: shift-by-one cross entropy over every
-    position that has a successor (optionally masked by attention_mask)."""
+    position that has a successor (optionally masked by attention_mask).
+
+    ``vocab_chunks=N`` switches to the chunked large-vocab loss
+    (``ops/chunked_ce.py``): the model returns final hidden states and
+    the LM-head weight is applied chunk-by-chunk inside the loss, so the
+    fp32 ``[B, S, V]`` logits — the memory hog of LM training — never
+    materialize. Numerics match the dense path to fp32 tolerance."""
+
+    def _reduce(per_tok, pred_ids, targets, mask):
+        if mask is not None:
+            m = mask[:, 1:].astype(jnp.float32)
+            denom = jnp.maximum(m.sum(), 1.0)
+            loss = (per_tok * m).sum() / denom
+            acc = ((pred_ids == targets) * m).sum() / denom
+        else:
+            loss = per_tok.mean()
+            acc = (pred_ids == targets).astype(jnp.float32).mean()
+        return loss, {"loss": loss, "next_token_accuracy": acc}
+
+    if vocab_chunks:
+        from pyspark_tf_gke_tpu.ops.chunked_ce import chunked_cross_entropy
+
+        def forward(model, variables, batch, train, mutable):
+            hidden = model.apply(variables, batch["input_ids"],
+                                 return_hidden=True)
+            head = variables["params"]["lm_head"]
+            return {"hidden": hidden, "kernel": head["kernel"],
+                    "bias": head.get("bias")}, None
+
+        def lam(preds, batch):
+            ids = batch["input_ids"]
+            targets = ids[:, 1:]
+            h = preds["hidden"][:, :-1]
+            b, s1, e = h.shape
+            per_tok, amax = chunked_cross_entropy(
+                h.reshape(b * s1, e), preds["kernel"], preds["bias"],
+                targets.reshape(-1), num_chunks=vocab_chunks)
+            return _reduce(per_tok.reshape(b, s1),
+                           amax.reshape(b, s1), targets,
+                           batch.get("attention_mask"))
+
+        return TrainerTask("causal_lm", forward, lam)
 
     def forward(model, variables, batch, train, mutable):
         return model.apply(variables, batch["input_ids"]), None
@@ -169,16 +210,8 @@ def causal_lm_task() -> TrainerTask:
         targets = ids[:, 1:]
         lg = logits[:, :-1].astype(jnp.float32)
         per_tok = optax.softmax_cross_entropy_with_integer_labels(lg, targets)
-        mask = batch.get("attention_mask")
-        if mask is not None:
-            m = mask[:, 1:].astype(jnp.float32)
-            denom = jnp.maximum(m.sum(), 1.0)
-            loss = (per_tok * m).sum() / denom
-            acc = ((jnp.argmax(lg, -1) == targets) * m).sum() / denom
-        else:
-            loss = per_tok.mean()
-            acc = (jnp.argmax(lg, -1) == targets).mean()
-        return loss, {"loss": loss, "next_token_accuracy": acc}
+        return _reduce(per_tok, jnp.argmax(lg, -1), targets,
+                       batch.get("attention_mask"))
 
     return TrainerTask("causal_lm", forward, lam)
 
