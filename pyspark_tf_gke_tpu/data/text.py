@@ -1,0 +1,191 @@
+"""Text → token-id pipeline for causal-LM pretraining.
+
+No counterpart in the reference (its data plane is CSV rows and PNG
+images — SURVEY §2a); this closes the loop for the decoder-only model
+family (``models/causal_lm.py``): raw text files (local or ``gs://`` via
+``utils.fs``) become packed fixed-length ``input_ids`` batches.
+
+Two tokenizers:
+
+* ``ByteTokenizer`` — always available, dependency-free: UTF-8 bytes
+  0..255 plus ``<pad>``/``<bos>``/``<eos>`` specials (vocab 259).
+  Deterministic and reversible; the right default for tests and smoke
+  runs.
+* ``load_hf_tokenizer`` — gated adapter over ``transformers``
+  ``AutoTokenizer`` (baked into the image) for real vocabularies
+  (e.g. ``gpt2``, ``bert-base-uncased``). Import-gated so the data
+  plane never hard-depends on it.
+
+Packing follows the standard LM recipe: documents are concatenated with
+``eos`` separators into one token stream, then cut into ``seq_len``
+rows — no padding waste, every position trains. Static shapes
+throughout (XLA-friendly batches).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from pyspark_tf_gke_tpu.utils.fs import fs_glob, fs_open
+
+
+@dataclasses.dataclass(frozen=True)
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer: ids 0..255 = bytes, then specials."""
+
+    pad_id: int = 256
+    bos_id: int = 257
+    eos_id: int = 258
+
+    @property
+    def vocab_size(self) -> int:
+        return 259
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode(
+            "utf-8", errors="replace")
+
+
+class HFTokenizerAdapter:
+    """Uniform facade (encode/decode/vocab_size/eos_id) over a
+    ``transformers`` tokenizer."""
+
+    def __init__(self, tok):
+        self._tok = tok
+        self.eos_id = (tok.eos_token_id if tok.eos_token_id is not None
+                       else tok.sep_token_id or 0)
+        self.pad_id = tok.pad_token_id if tok.pad_token_id is not None else 0
+        self.vocab_size = int(len(tok))
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids))
+
+
+def load_hf_tokenizer(name_or_path: str) -> HFTokenizerAdapter:
+    try:
+        from transformers import AutoTokenizer
+    except ImportError as exc:  # pragma: no cover - baked into the image
+        raise ImportError(
+            "transformers is required for --tokenizer other than 'byte'"
+        ) from exc
+    return HFTokenizerAdapter(AutoTokenizer.from_pretrained(name_or_path))
+
+
+def get_tokenizer(spec: str = "byte"):
+    """``byte`` → ByteTokenizer; anything else → HF AutoTokenizer name."""
+    if spec in ("", "byte"):
+        return ByteTokenizer()
+    return load_hf_tokenizer(spec)
+
+
+def iter_documents(pattern: str, *, process_index: int = 0,
+                   process_count: int = 1) -> Iterator[str]:
+    """Yield documents from text files matching ``pattern`` (local glob
+    or fsspec URL — gs:// in production). A document is a
+    blank-line-separated block; files are striped across hosts
+    round-robin (file i → host i % process_count), the same
+    by-file contract as the TFRecord shard readers."""
+    paths = fs_glob(pattern)
+    if not paths:
+        raise FileNotFoundError(f"no text files match {pattern!r}")
+    for i, path in enumerate(paths):
+        if i % process_count != process_index:
+            continue
+        with fs_open(path, "rb") as fh:
+            buf: List[str] = []
+            for raw in fh:
+                line = raw.decode("utf-8", errors="replace").rstrip("\n")
+                if line.strip():
+                    buf.append(line)
+                elif buf:
+                    yield "\n".join(buf)
+                    buf = []
+            if buf:
+                yield "\n".join(buf)
+
+
+def pack_tokens(
+    docs: Iterable[str],
+    tokenizer,
+    seq_len: int,
+) -> Iterator[np.ndarray]:
+    """Concatenate tokenized docs with ``eos`` separators; emit
+    fixed-length ``[seq_len]`` int32 rows. The trailing partial row is
+    dropped (static shapes beat a padded straggler)."""
+    stream: List[int] = []
+    eos = tokenizer.eos_id
+    for doc in docs:
+        stream.extend(tokenizer.encode(doc))
+        stream.append(eos)
+        while len(stream) >= seq_len:
+            yield np.asarray(stream[:seq_len], np.int32)
+            del stream[:seq_len]
+
+
+def lm_batches(
+    pattern: str,
+    tokenizer,
+    seq_len: int,
+    batch_size: int,
+    *,
+    seed: int = 0,
+    repeat: bool = True,
+    shuffle_buffer: int = 256,
+    process_index: int = 0,
+    process_count: int = 1,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Packed LM batches ``{"input_ids": [B, S] int32}``.
+
+    Rows pass through a reservoir-style shuffle buffer (seeded — the
+    same determinism contract as the TFRecord readers); ``repeat``
+    restarts the file pass with a reseeded buffer each epoch."""
+    rng = np.random.default_rng(seed)
+    epoch = 0
+    batch: List[np.ndarray] = []  # partial batches carry across epochs
+    while True:
+        buf: List[np.ndarray] = []
+        produced = 0
+        rows = pack_tokens(
+            iter_documents(pattern, process_index=process_index,
+                           process_count=process_count),
+            tokenizer, seq_len)
+        for row in rows:
+            produced += 1
+            if shuffle_buffer > 1:
+                buf.append(row)
+                if len(buf) < shuffle_buffer:
+                    continue
+                idx = rng.integers(0, len(buf))
+                buf[idx], buf[-1] = buf[-1], buf[idx]
+                row = buf.pop()
+            batch.append(row)
+            if len(batch) == batch_size:
+                yield {"input_ids": np.stack(batch)}
+                batch = []
+        rng.shuffle(buf)
+        for row in buf:
+            batch.append(row)
+            if len(batch) == batch_size:
+                yield {"input_ids": np.stack(batch)}
+                batch = []
+        if produced == 0:
+            # Empty pass: corpus too small for a single seq_len row, or
+            # multi-host striping gave this process no files. Repeating
+            # would busy-hang the trainer — fail loudly instead.
+            raise ValueError(
+                f"{pattern!r} produced no length-{seq_len} rows for "
+                f"process {process_index}/{process_count}; corpus too "
+                "small or too few files for the host count")
+        if not repeat:
+            return
+        epoch += 1
+        rng = np.random.default_rng(seed + epoch)

@@ -1,0 +1,166 @@
+"""Causal-LM pretraining entry point: raw text → packed tokens → decoder.
+
+Completes the model-family matrix the same way ``bert_finetune`` does
+for the encoder: text files (local or ``gs://``) stream through
+``data.text`` (tokenize → eos-pack → shuffle → batch), the model is the
+decoder-only ``models/causal_lm.py`` (flash attention on TPU, GQA
+optional), and the loss is either the dense next-token cross-entropy or
+the chunked large-vocab loss (``ops/chunked_ce.py``, ``--vocab-chunks``)
+that never materializes ``[B, S, V]`` logits.
+
+No counterpart in the reference (no language models — SURVEY §2b); run
+artifacts (history.json, orbax checkpoints, heartbeat) follow the same
+conventions as the other entry points, so the k8s manifests and
+resilience machinery apply unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pyspark_tf_gke_tpu.data.text import get_tokenizer, lm_batches
+from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
+from pyspark_tf_gke_tpu.parallel.distributed import initialize_distributed
+from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
+from pyspark_tf_gke_tpu.train.harness import (
+    finalize_run,
+    local_batch_size,
+    make_checkpoint,
+    make_heartbeat,
+)
+from pyspark_tf_gke_tpu.train.resilience import run_with_recovery
+from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
+from pyspark_tf_gke_tpu.utils.config import _env_bool, parse_mesh_shape
+from pyspark_tf_gke_tpu.utils.logging import banner, get_logger
+from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+logger = get_logger("train.lm_pretrain")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    e = os.environ.get
+    p = argparse.ArgumentParser(
+        description="Pretrain a decoder-only causal LM on raw text files"
+    )
+    p.add_argument("--data-pattern", default=e("DATA_PATTERN", ""),
+                   help="glob of text files, e.g. 'gs://bucket/corpus/*.txt'")
+    p.add_argument("--tokenizer", default=e("TOKENIZER", "byte"),
+                   help="'byte' (built-in, vocab 259) or an HF tokenizer "
+                        "name/path (e.g. 'gpt2')")
+    p.add_argument("--seq-len", type=int, default=int(e("SEQ_LEN", "512")))
+    p.add_argument("--hidden-size", type=int, default=int(e("HIDDEN_SIZE", "768")))
+    p.add_argument("--num-layers", type=int, default=int(e("NUM_LAYERS", "12")))
+    p.add_argument("--num-heads", type=int, default=int(e("NUM_HEADS", "12")))
+    p.add_argument("--num-kv-heads", type=int, default=int(e("NUM_KV_HEADS", "0")),
+                   help=">0 enables grouped-query attention (1 = MQA)")
+    p.add_argument("--intermediate-size", type=int,
+                   default=int(e("INTERMEDIATE_SIZE", "3072")))
+    p.add_argument("--vocab-chunks", type=int, default=int(e("VOCAB_CHUNKS", "0")),
+                   help=">0 uses the chunked large-vocab cross-entropy "
+                        "(ops/chunked_ce.py) with this many vocab chunks")
+    p.add_argument("--remat", action="store_true", default=e("REMAT", "") == "1")
+    p.add_argument("--epochs", type=int, default=int(e("EPOCHS", "1")))
+    p.add_argument("--steps-per-epoch", type=int, default=int(e("STEPS_PER_EPOCH", "100")))
+    p.add_argument("--batch-size", type=int, default=int(e("BATCH_SIZE", "16")),
+                   help="GLOBAL batch size across all chips")
+    p.add_argument("--learning-rate", type=float, default=float(e("LEARNING_RATE", "3e-4")))
+    p.add_argument("--seed", type=int, default=int(e("SEED", "1337")))
+    p.add_argument("--mesh-shape", default=e("MESH_SHAPE", ""),
+                   help='e.g. "dp=2,fsdp=2" | "" → all chips on dp')
+    p.add_argument("--output-dir", default=e("OUTPUT_DIR", "./lm-pretrain"))
+    p.add_argument("--checkpoint-every-steps", type=int,
+                   default=int(e("CHECKPOINT_EVERY_STEPS", "0")))
+    p.add_argument("--async-checkpoint", action="store_true",
+                   default=_env_bool("ASYNC_CHECKPOINT", False))
+    p.add_argument("--resume", action="store_true", default=_env_bool("RESUME", False))
+    p.add_argument("--compute-dtype", default=e("COMPUTE_DTYPE", "bfloat16"),
+                   choices=["bfloat16", "float32"])
+    p.add_argument("--num-processes", type=int, default=int(e("NUM_PROCESSES", "1")))
+    p.add_argument("--process-id", type=int, default=int(e("PROCESS_ID", "-1")))
+    p.add_argument("--coordinator-addr", default=e("COORDINATOR_ADDR", ""))
+    p.add_argument("--coordinator-port", type=int, default=int(e("COORDINATOR_PORT", "8476")))
+    p.add_argument("--max-restarts", type=int, default=int(e("MAX_RESTARTS", "0")))
+    p.add_argument("--heartbeat-every-steps", type=int,
+                   default=int(e("HEARTBEAT_EVERY_STEPS", "10")))
+    p.add_argument("--heartbeat-file", default=e("HEARTBEAT_FILE", ""),
+                   help="node-local heartbeat path for the k8s exec probe "
+                        "(default: <output-dir>/heartbeat.json)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> dict:
+    args = parse_args(argv)
+    if not args.data_pattern:
+        raise SystemExit("--data-pattern is required (glob of text files)")
+    initialize_distributed(
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+        coordinator_addr=args.coordinator_addr,
+        coordinator_port=args.coordinator_port,
+    )
+    banner(logger, f"Causal-LM pretraining: {args.data_pattern}")
+
+    tokenizer = get_tokenizer(args.tokenizer)
+    cfg = CausalLMConfig(
+        vocab_size=tokenizer.vocab_size,
+        hidden_size=args.hidden_size,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        num_kv_heads=args.num_kv_heads or None,
+        intermediate_size=args.intermediate_size,
+        max_seq_len=args.seq_len,
+        dtype=jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32,
+        remat=args.remat,
+    )
+    mesh = make_mesh(parse_mesh_shape(args.mesh_shape) or None)
+    model = CausalLM(cfg, mesh=mesh)
+    task = TASKS["causal_lm"](vocab_chunks=args.vocab_chunks or None)
+    trainer = Trainer(model, task, mesh, learning_rate=args.learning_rate)
+
+    local_bs = local_batch_size(args.batch_size)
+
+    def batches():
+        yield from lm_batches(
+            args.data_pattern, tokenizer, args.seq_len, local_bs,
+            seed=args.seed,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+        )
+
+    state = trainer.init_state(make_rng(args.seed), next(batches()))
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(state.params))
+    logger.info("Model: %d params (%.1fM), vocab=%d, mesh=%s", n_params,
+                n_params / 1e6, cfg.vocab_size, dict(mesh.shape))
+
+    def attempt_run(attempt: int) -> dict:
+        nonlocal state
+        ckpt, state = make_checkpoint(
+            args.output_dir, args.checkpoint_every_steps, state,
+            args.resume or attempt > 0,
+            async_save=args.async_checkpoint,
+        )
+        try:
+            state, history = trainer.fit(
+                state, batches(), args.epochs, args.steps_per_epoch,
+                checkpoint_manager=ckpt,
+                heartbeat=make_heartbeat(args.output_dir,
+                                         args.heartbeat_every_steps,
+                                         args.heartbeat_file),
+            )
+            finalize_run(ckpt, state, history, args.output_dir,
+                         model_name="causal-lm")
+        finally:
+            ckpt.close()
+        return history
+
+    return run_with_recovery(attempt_run, max_restarts=args.max_restarts)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
