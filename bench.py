@@ -281,16 +281,18 @@ def bench_workload(name: str, steps: int = 50, smoke: bool = False,
     }
 
 
-def bench_decode(smoke: bool = False, kv_heads=None) -> dict:
+def bench_decode(smoke: bool = False, kv_heads=None, int8: bool = False) -> dict:
     """Serving-path throughput (BASELINE has no analog — this benches the
     framework's own KV-cache generation): one jitted prefill + scan
     decode on a GPT-small-shaped causal LM. Reports decode tokens/sec
     per chip and the prefill latency. ``--kv-heads N`` measures the GQA
-    variant (smaller cache → less HBM traffic per decode step)."""
+    variant (smaller cache → less HBM traffic per decode step);
+    ``--int8`` measures weight-only int8 quantized serving
+    (ops/quant.py — halves the weight-streaming traffic)."""
     import jax
     import jax.numpy as jnp
 
-    from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig, generate
+    from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
     from pyspark_tf_gke_tpu.models.causal_lm import _prefill
     from pyspark_tf_gke_tpu.utils.seeding import make_rng
     from flax import linen as nn
@@ -316,26 +318,43 @@ def bench_decode(smoke: bool = False, kv_heads=None) -> dict:
         rng.integers(0, cfg.vocab_size, (batch, s_prompt)).astype(np.int32))
     variables = jax.jit(model.init)(make_rng(1337), prompt[:, :8])
     params = nn.meta.unbox(variables["params"])
+    from pyspark_tf_gke_tpu.ops.quant import quantize_tree, tree_bytes
+
+    dense_mb = tree_bytes(params) / 1e6
+    if int8:
+        params = jax.jit(quantize_tree)(params)
+    params_mb = tree_bytes(params) / 1e6 if int8 else dense_mb
 
     # On the remote-attached chip block_until_ready can report before the
     # queue drains (same gotcha as measure()); a host readback of an
     # output is the only reliable completion barrier, so all timings
-    # force np.asarray on a (small) result.
+    # force np.asarray on a (small) result. Prefill and decode are timed
+    # as separate dispatches (subtraction timing drowns in jitter at
+    # small shapes).
+    from pyspark_tf_gke_tpu.models.causal_lm import _decode
+
+    rng_key = jax.random.PRNGKey(0)
+
+    def run_decode(cache, last):
+        return _decode(
+            model, params, cache, last, rng_key, jnp.float32(1.0), None,
+            max_new_tokens=n_new, greedy=True, eos_token_id=None,
+            s_prompt=s_prompt, top_k=None)
+
     log("compiling prefill + decode...")
-    np.asarray(generate(model, params, prompt, max_new_tokens=n_new))
-    np.asarray(_prefill(model, params, prompt)[1][:, :8])  # warm the timed slice path
+    cache, last = _prefill(model, params, prompt)
+    np.asarray(last[:, :8])
+    np.asarray(run_decode(cache, last))
 
     t0 = time.perf_counter()
-    _, last_logits = _prefill(model, params, prompt)
-    np.asarray(last_logits[:, :8])  # tiny slice: completion barrier, not a 1MB transfer
+    cache, last = _prefill(model, params, prompt)
+    np.asarray(last[:, :8])  # tiny slice: completion barrier, not a 1MB transfer
     prefill_dt = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    out = generate(model, params, prompt, max_new_tokens=n_new)
+    out = run_decode(cache, last)
     np.asarray(out)
-    dt = time.perf_counter() - t0
-
-    decode_dt = dt - prefill_dt
+    decode_dt = time.perf_counter() - t0
     tokens = batch * n_new
     return {
         "metric": "causal_lm_decode_tokens_per_sec_per_chip",
@@ -349,6 +368,9 @@ def bench_decode(smoke: bool = False, kv_heads=None) -> dict:
         "new_tokens": n_new,
         "kv_heads": cfg.kv_heads,
         "num_heads": cfg.num_heads,
+        "int8_weights": int8,
+        "params_mb": round(params_mb, 1),
+        "dense_params_mb": round(dense_mb, 1),
         "n_chips": n_chips,
         "device_kind": device_kind,
         "workload": (f"CausalLM {cfg.num_layers}L h{cfg.hidden_size} "
@@ -531,7 +553,7 @@ def run_bench(argv) -> dict:
             except (IndexError, ValueError):
                 raise SystemExit(
                     "usage: bench.py generate --kv-heads <positive int>")
-        return bench_decode(smoke=smoke, kv_heads=kv)
+        return bench_decode(smoke=smoke, kv_heads=kv, int8="--int8" in argv)
     use_flash = True if "--flash" in argv else (False if "--no-flash" in argv else None)
     seq = None
     if "--seq" in argv:

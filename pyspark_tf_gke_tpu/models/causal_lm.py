@@ -274,9 +274,14 @@ class CausalLM(nn.Module):
 def _prefill(model: CausalLM, params, prompt_ids):
     """ONE full causal forward over the prompt: computes the last-token
     logits AND writes every layer's K/V into the cache prefix
-    (prefill=True) — no per-token replay."""
+    (prefill=True) — no per-token replay. ``params`` may be an int8
+    weight-only quantized tree (``ops/quant.py``); dequant happens here,
+    inside the jit, so XLA fuses it into the matmuls."""
+    from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
+
     logits, mutated = model.apply(
-        {"params": params}, prompt_ids, prefill=True, mutable=["cache"]
+        {"params": dequantize_tree(params)}, prompt_ids, prefill=True,
+        mutable=["cache"]
     )
     return mutated["cache"], logits[:, -1]
 
@@ -313,7 +318,19 @@ def _decode(model: CausalLM, params, cache, last_logits, rng, temperature,
             top_p, *, max_new_tokens: int, greedy: bool,
             eos_token_id: Optional[int], s_prompt: int,
             top_k: Optional[int] = None):
+    from pyspark_tf_gke_tpu.ops.quant import dequantize_tree, is_quantized
+
+    quantized = is_quantized(params)
     b = last_logits.shape[0]
+
+    def step_params(p):
+        """Weight-only int8: dequant INSIDE the scan body, behind an
+        optimization barrier so XLA cannot hoist the bf16 weights out of
+        the loop — each step streams int8 from HBM and the convert+scale
+        fuses into the matmuls. Dense trees pass through untouched."""
+        if not quantized:
+            return p
+        return dequantize_tree(jax.lax.optimization_barrier(p))
 
     def sample(logits, rng):
         if greedy:
@@ -334,7 +351,8 @@ def _decode(model: CausalLM, params, cache, last_logits, rng, temperature,
         rng, sub = jax.random.split(rng)
         tok, done = emit(logits, sub, done)
         logits, mutated = model.apply(
-            {"params": params, "cache": cache}, tok[:, None], decode=True,
+            {"params": step_params(params), "cache": cache}, tok[:, None],
+            decode=True,
             positions=jnp.full((b, 1), t, jnp.int32),
             mutable=["cache"],
         )
