@@ -124,7 +124,8 @@ def _mfu(flops_per_step, step_seconds: float, device_kind: str):
     return flops_per_step / (step_seconds * peak)
 
 
-def main(batch_size: int = 32, steps: int = 100) -> dict:
+def main(batch_size: int = 32, steps: int = 100, throughput_batch: int = 128,
+         throughput_steps: int = 40) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -162,6 +163,32 @@ def main(batch_size: int = 32, steps: int = 100) -> dict:
     images_per_sec_per_chip = images_per_sec / n_chips
     mfu = _mfu(flops, dt / steps, device_kind)
 
+    # Secondary: throughput-optimal batch. The B1 architecture is
+    # latency-bound at batch 32 on a v5e (channel widths 3..64 against a
+    # 128-wide MXU leave the chip idle between small kernels; measured
+    # step time is nearly flat in batch), so a larger per-chip batch
+    # raises images/sec ~linearly at the same step time. Reported
+    # separately — the headline stays the reference's batch-32 config.
+    tp = {}
+    if throughput_batch and throughput_batch != batch_size:
+        try:
+            timages = rng.uniform(0, 1, (throughput_batch, 256, 320, 3)).astype(np.float32)
+            ttargets = rng.uniform(0, 256, (throughput_batch, 2)).astype(np.float32)
+            tbatch = {
+                "image": jax.device_put(timages, sharding),
+                "target": jax.device_put(ttargets, sharding),
+            }
+            _, _, tdt = measure(trainer, state, tbatch, throughput_steps)
+            tp = {
+                "max_throughput_images_per_sec_per_chip": round(
+                    throughput_batch * throughput_steps / tdt / n_chips, 2),
+                "max_throughput_batch_size": throughput_batch,
+                "max_throughput_step_time_ms": round(
+                    tdt / throughput_steps * 1000.0, 3),
+            }
+        except Exception as exc:  # pragma: no cover - OOM safety on small chips
+            log(f"throughput-batch measurement skipped: {exc!r}")
+
     baseline_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tools", "reference_baseline.json"
     )
@@ -186,6 +213,7 @@ def main(batch_size: int = 32, steps: int = 100) -> dict:
         "device_kind": device_kind,
         "workload": "CNN-B1 43.4M params, 256x320x3, Adam+MSE, bf16 compute",
         "baseline": "reference TF CNN-B1 on 16 vCPU (extrapolated; tools/reference_baseline.json)",
+        **tp,
     }
     log(f"loss trajectory: {losses[0]:.3f} -> {losses[-1]:.3f}")
     return result
@@ -288,7 +316,7 @@ def bench_decode(smoke: bool = False, kv_heads=None, int8: bool = False) -> dict
     per chip and the prefill latency. ``--kv-heads N`` measures the GQA
     variant (smaller cache → less HBM traffic per decode step);
     ``--int8`` measures weight-only int8 quantized serving
-    (ops/quant.py — halves the weight-streaming traffic)."""
+    (ops/quant.py — 4× less weight-streaming traffic vs f32 params)."""
     import jax
     import jax.numpy as jnp
 
@@ -323,7 +351,7 @@ def bench_decode(smoke: bool = False, kv_heads=None, int8: bool = False) -> dict
     dense_mb = tree_bytes(params) / 1e6
     if int8:
         params = jax.jit(quantize_tree)(params)
-    params_mb = tree_bytes(params) / 1e6 if int8 else dense_mb
+    params_mb = tree_bytes(params) / 1e6
 
     # On the remote-attached chip block_until_ready can report before the
     # queue drains (same gotcha as measure()); a host readback of an
@@ -538,9 +566,10 @@ def run_bench(argv) -> dict:
     smoke = "--smoke" in argv
     workload = args[0] if args else "cnn"
     if workload == "cnn":
-        # --smoke shrinks the flagship run too (small batch, few steps;
-        # batch stays divisible by the fake slice's 8 devices).
-        return main(batch_size=8, steps=2) if smoke else main()
+        # --smoke shrinks the flagship run too (small batch, few steps,
+        # no secondary throughput-batch pass; batch stays divisible by
+        # the fake slice's 8 devices).
+        return main(batch_size=8, steps=2, throughput_batch=0) if smoke else main()
     if workload == "io":
         return bench_io(smoke=smoke)
     if workload == "generate":

@@ -318,19 +318,33 @@ def _decode(model: CausalLM, params, cache, last_logits, rng, temperature,
             top_p, *, max_new_tokens: int, greedy: bool,
             eos_token_id: Optional[int], s_prompt: int,
             top_k: Optional[int] = None):
-    from pyspark_tf_gke_tpu.ops.quant import dequantize_tree, is_quantized
+    from pyspark_tf_gke_tpu.ops.quant import dequantize_embeddings, is_quantized
 
     quantized = is_quantized(params)
+    if quantized:
+        # Embedding tables dequant ONCE here (hoisted out of the scan):
+        # decode gathers single rows from them, so an in-loop barrier
+        # would stream the whole table every step for nothing.
+        params = dequantize_embeddings(params)
     b = last_logits.shape[0]
 
     def step_params(p):
         """Weight-only int8: dequant INSIDE the scan body, behind an
-        optimization barrier so XLA cannot hoist the bf16 weights out of
+        optimization barrier so XLA cannot hoist the wide weights out of
         the loop — each step streams int8 from HBM and the convert+scale
-        fuses into the matmuls. Dense trees pass through untouched."""
+        fuses into the matmuls. Dense leaves (incl. the pre-dequantized
+        embeddings) pass through un-barriered."""
         if not quantized:
             return p
-        return dequantize_tree(jax.lax.optimization_barrier(p))
+        from pyspark_tf_gke_tpu.ops.quant import QTensor
+
+        def deq(leaf):
+            if isinstance(leaf, QTensor):
+                q, s = jax.lax.optimization_barrier((leaf.q, leaf.scale))
+                return QTensor(q, s, leaf.dtype).dequantize()
+            return leaf
+
+        return jax.tree.map(deq, p, is_leaf=lambda l: isinstance(l, QTensor))
 
     def sample(logits, rng):
         if greedy:

@@ -1,0 +1,84 @@
+"""Multi-chip serving utilities: place a causal-LM param tree into its
+tensor-parallel shardings and generate under a mesh.
+
+The reference serves nothing (its endpoint is a saved ``.keras`` file,
+SURVEY §5); serving here is a first-class SPMD surface: the same logical
+axis annotations that shard the model for training
+(``parallel/sharding.py`` LOGICAL_RULES) shard it for inference, so a
+checkpoint trained on any mesh serves on any other mesh — XLA inserts
+the collectives for the tp-sharded matmuls and the decode scan runs
+unchanged.
+
+Composes with the serving optimizations in this package: GQA caches,
+weight-only int8 (``ops/quant.py`` — quantize first, then
+``shard_params_for_serving`` places QTensor leaves with their scales
+aligned to the kernel shards), top-k/top-p sampling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh
+
+from pyspark_tf_gke_tpu.parallel.sharding import LOGICAL_RULES
+
+
+def serving_shardings(model, params, mesh: Mesh, rules=LOGICAL_RULES):
+    """NamedShardings for ``params`` from the model's logical axis
+    annotations (tp over heads/mlp/vocab, replicated elsewhere). Works
+    from a plain (unboxed) param tree: annotations are recovered by
+    re-tracing ``model.init`` at abstract level.
+
+    Quantized trees (``ops/quant.py``) are supported: a QTensor leaf
+    gets its kernel's spec on ``q`` and the spec's last axis on the
+    per-output-channel ``scale`` (so a tp-sharded kernel keeps its
+    scales aligned with its shards)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pyspark_tf_gke_tpu.ops.quant import QTensor
+
+    sample = jnp.zeros((1, 8), jnp.int32)
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), sample))["params"]
+    boxed = any(isinstance(l, nn.Partitioned) for l in jax.tree.leaves(
+        abstract, is_leaf=lambda x: isinstance(x, nn.Partitioned)))
+    if boxed:
+        specs = nn.get_partition_spec(abstract)
+        shardings = nn.logical_to_mesh_sharding(specs, mesh, rules)
+    else:
+        shardings = jax.tree.map(lambda _: NamedSharding(mesh, P()), abstract)
+
+    def align(leaf, sh):
+        if isinstance(leaf, QTensor):
+            spec = sh.spec
+            scale_spec = P(spec[-1]) if len(spec) else P()
+            # aux (dtype) must match the param leaf's so the sharding
+            # tree's treedef lines up for device_put
+            return QTensor(sh, NamedSharding(mesh, scale_spec), leaf.dtype)
+        return sh
+
+    return jax.tree.map(align, params, shardings,
+                        is_leaf=lambda l: isinstance(l, QTensor))
+
+
+def shard_params_for_serving(model, params, mesh: Mesh, rules=LOGICAL_RULES):
+    """device_put ``params`` into their serving shardings."""
+    return jax.device_put(params, serving_shardings(model, params, mesh, rules))
+
+
+def serve_generate(model, params, prompt_ids, mesh: Optional[Mesh] = None,
+                   **kwargs):
+    """``generate`` under a mesh context (no-op mesh → single chip).
+    ``params`` should already be placed (``shard_params_for_serving``);
+    the prompt is replicated — decode is latency-bound, and batch
+    sharding over dp composes at the caller level if wanted."""
+    from pyspark_tf_gke_tpu.models.causal_lm import generate
+
+    if mesh is None:
+        return generate(model, params, prompt_ids, **kwargs)
+    with mesh:
+        return generate(model, params, prompt_ids, **kwargs)
