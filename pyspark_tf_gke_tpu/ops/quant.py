@@ -100,17 +100,16 @@ def dequantize_embeddings(params):
     invariant) rather than stream through the in-loop barrier with the
     matmul weights."""
 
-    def walk(node):
-        if isinstance(node, dict):
-            return {
-                k: (v.dequantize()
-                    if k == "embedding" and isinstance(v, QTensor)
-                    else walk(v))
-                for k, v in node.items()
-            }
-        return node
+    def fix(path, leaf):
+        if isinstance(leaf, QTensor) and any(
+                getattr(k, "key", None) == "embedding" for k in path):
+            return leaf.dequantize()
+        return leaf
 
-    return walk(params)
+    # tree_map_with_path (not a dict walk) so FrozenDict and any other
+    # mapping container get the same treatment.
+    return jax.tree_util.tree_map_with_path(
+        fix, params, is_leaf=lambda l: isinstance(l, QTensor))
 
 
 def is_quantized(params) -> bool:

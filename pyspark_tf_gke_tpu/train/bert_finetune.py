@@ -75,6 +75,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--batch-size", type=int, default=int(e("BATCH_SIZE", "32")),
                    help="GLOBAL batch size across all chips")
     p.add_argument("--learning-rate", type=float, default=float(e("LEARNING_RATE", "2e-5")))
+    p.add_argument("--ema-decay", type=float, default=float(e("EMA_DECAY", "0")),
+                   help=">0 maintains an EMA of params alongside training")
     p.add_argument("--seed", type=int, default=int(e("SEED", "1337")))
     p.add_argument("--mesh-shape", default=e("MESH_SHAPE", ""),
                    help='e.g. "dp=2,fsdp=2" | "dp=2,sp=4" | "" → all chips on dp')
@@ -137,7 +139,8 @@ def main(argv=None) -> dict:
     mesh = make_mesh(parse_mesh_shape(args.mesh_shape) or None)
     model = BertForPretraining(cfg, mesh=mesh, num_labels=args.num_labels)
     task = TASKS["bert_mlm" if args.objective == "mlm" else "bert_classification"]()
-    trainer = Trainer(model, task, mesh, learning_rate=args.learning_rate)
+    trainer = Trainer(model, task, mesh, learning_rate=args.learning_rate,
+                      ema_decay=args.ema_decay)
 
     local_bs = local_batch_size(args.batch_size)
 

@@ -23,17 +23,25 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
     batch_stats: Any = None
+    # Exponential moving average of params (None = disabled). The decay
+    # is a static hyperparameter; ema_params shard exactly like params.
+    ema_params: Any = None
+    ema_decay: float = struct.field(pytree_node=False, default=0.0)
 
     def apply_gradients(self, grads: Any, **updates) -> "TrainState":
         updates_tx, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
         new_params = optax.apply_updates(self.params, updates_tx)
+        if self.ema_params is not None:
+            d = self.ema_decay
+            updates.setdefault("ema_params", jax.tree.map(
+                lambda e, p: d * e + (1.0 - d) * p, self.ema_params, new_params))
         return self.replace(
             step=self.step + 1, params=new_params, opt_state=new_opt_state, **updates
         )
 
     @classmethod
     def create(cls, params: Any, tx: optax.GradientTransformation,
-               batch_stats: Any = None) -> "TrainState":
+               batch_stats: Any = None, ema_decay: float = 0.0) -> "TrainState":
         import jax.numpy as jnp
 
         return cls(
@@ -41,5 +49,7 @@ class TrainState(struct.PyTreeNode):
             params=params,
             opt_state=tx.init(params),
             batch_stats=batch_stats,
+            ema_params=jax.tree.map(jnp.copy, params) if ema_decay else None,
+            ema_decay=ema_decay,
             tx=tx,
         )

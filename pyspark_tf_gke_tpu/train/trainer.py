@@ -255,6 +255,7 @@ class Trainer:
         tx: Optional[optax.GradientTransformation] = None,
         fsdp_min_size: int = DEFAULT_MIN_SIZE,
         logical_rules=LOGICAL_RULES,
+        ema_decay: float = 0.0,  # >0 maintains an EMA of params (eval/serving)
     ):
         self.model = model
         self.task = task
@@ -262,6 +263,7 @@ class Trainer:
         self.tx = tx if tx is not None else optax.adam(learning_rate)
         self.fsdp_min_size = fsdp_min_size
         self.logical_rules = logical_rules
+        self.ema_decay = ema_decay
         self._train_step = None
         self._raw_train_step = None
         self._eval_step = None
@@ -308,7 +310,8 @@ class Trainer:
                 variables = model.init(rng, sample_batch["x"])
             params = variables["params"]
             batch_stats = variables.get("batch_stats")
-            return TrainState.create(params, tx, batch_stats)
+            return TrainState.create(params, tx, batch_stats,
+                                     ema_decay=self.ema_decay)
 
         return create
 
@@ -491,10 +494,18 @@ class Trainer:
         with self.mesh:
             return fn(state, batch)
 
-    def evaluate(self, state: TrainState, batches) -> Dict[str, float]:
+    def evaluate(self, state: TrainState, batches,
+                 use_ema: bool = False) -> Dict[str, float]:
         """Metrics accumulate as device scalars — one host sync at the
         end, not one per batch (a per-batch ``float(v)`` readback
-        serializes dispatch against the device queue)."""
+        serializes dispatch against the device queue). ``use_ema``
+        evaluates the EMA weights (same jit trace — only the leaves
+        swap)."""
+        if use_ema:
+            if state.ema_params is None:
+                raise ValueError("use_ema=True but the trainer was built "
+                                 "with ema_decay=0")
+            state = state.replace(params=state.ema_params)
         if self._eval_step is None:
             self._build_steps()
         sums: Optional[Dict[str, jax.Array]] = None

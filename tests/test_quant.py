@@ -92,6 +92,22 @@ def test_quantized_generate_matches_shapes_and_quality():
     assert ((np.asarray(out) >= 0) & (np.asarray(out) < 97)).all()
 
 
+def test_dequantize_embeddings_handles_frozendict():
+    """The embedding-hoist must work for plain dicts AND FrozenDict."""
+    import flax.core
+
+    from pyspark_tf_gke_tpu.ops.quant import dequantize_embeddings
+
+    tree = {
+        "wte": {"embedding": quantize_tensor(jnp.ones((64, 32), jnp.float32))},
+        "l0": {"kernel": quantize_tensor(jnp.ones((64, 32), jnp.float32))},
+    }
+    for t in (tree, flax.core.freeze(tree)):
+        out = dequantize_embeddings(t)
+        assert not isinstance(out["wte"]["embedding"], QTensor)
+        assert isinstance(out["l0"]["kernel"], QTensor)
+
+
 def test_bench_decode_int8_smoke():
     from bench import bench_decode
 
