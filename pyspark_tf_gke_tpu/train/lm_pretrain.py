@@ -72,6 +72,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--learning-rate", type=float, default=float(e("LEARNING_RATE", "3e-4")))
     p.add_argument("--ema-decay", type=float, default=float(e("EMA_DECAY", "0")),
                    help=">0 maintains an EMA of params alongside training")
+    p.add_argument("--export-bundle", default=e("EXPORT_BUNDLE", ""),
+                   help="directory to export a serving bundle into after "
+                        "training (EMA weights if enabled; int8 by default)")
+    p.add_argument("--export-dense", action="store_true",
+                   default=_env_bool("EXPORT_DENSE", False),
+                   help="skip int8 quantization in the exported bundle")
     p.add_argument("--seed", type=int, default=int(e("SEED", "1337")))
     p.add_argument("--mesh-shape", default=e("MESH_SHAPE", ""),
                    help='e.g. "dp=2,fsdp=2" | "" → all chips on dp')
@@ -162,7 +168,19 @@ def main(argv=None) -> dict:
             ckpt.close()
         return history
 
-    return run_with_recovery(attempt_run, max_restarts=args.max_restarts)
+    history = run_with_recovery(attempt_run, max_restarts=args.max_restarts)
+    if args.export_bundle:
+        # ALL processes participate: quantize is a collective jit over
+        # sharded params and the orbax save is a collective write (the
+        # bundle gates its config.json to process 0 internally).
+        from pyspark_tf_gke_tpu.train.export import export_serving_bundle
+
+        weights = state.ema_params if state.ema_params is not None else state.params
+        export_serving_bundle(cfg, weights, args.export_bundle,
+                              quantize=not args.export_dense,
+                              tokenizer_spec=args.tokenizer)
+        logger.info("Exported serving bundle to %s", args.export_bundle)
+    return history
 
 
 if __name__ == "__main__":

@@ -44,6 +44,10 @@ class TrainState(struct.PyTreeNode):
                batch_stats: Any = None, ema_decay: float = 0.0) -> "TrainState":
         import jax.numpy as jnp
 
+        if not 0.0 <= ema_decay < 1.0:
+            # decay == 1 would freeze the EMA at init forever (and the
+            # export path prefers EMA weights) — reject it loudly.
+            raise ValueError(f"ema_decay must be in [0, 1), got {ema_decay}")
         return cls(
             step=jnp.zeros((), dtype=jnp.int32),
             params=params,

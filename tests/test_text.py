@@ -132,8 +132,20 @@ def test_lm_pretrain_entry_e2e(tmp_path, devices):
         "--steps-per-epoch", "3",
         "--batch-size", "8",
         "--compute-dtype", "float32",
+        "--ema-decay", "0.9",
+        "--export-bundle", str(tmp_path / "bundle"),
         "--output-dir", str(out),
     ])
     assert len(history["loss"]) == 2
     assert all(np.isfinite(l) for l in history["loss"])
     assert (out / "history.json").exists()
+
+    # exported serving bundle loads and generates
+    from pyspark_tf_gke_tpu.models import generate
+    from pyspark_tf_gke_tpu.train.export import load_serving_bundle
+
+    model, params, meta = load_serving_bundle(str(tmp_path / "bundle"))
+    assert meta["tokenizer"] == "byte"
+    out_ids = generate(model, params, np.zeros((1, 4), np.int32),
+                       max_new_tokens=4)
+    assert out_ids.shape == (1, 8)

@@ -305,3 +305,11 @@ def test_evaluate_use_ema_without_ema_raises(mesh_dp):
     state = trainer.init_state(make_rng(0), next(iter(it)))
     with pytest.raises(ValueError, match="ema_decay=0"):
         trainer.evaluate(state, [], use_ema=True)
+
+
+def test_ema_decay_validated(mesh_dp):
+    from pyspark_tf_gke_tpu.train.state import TrainState
+    import optax
+
+    with pytest.raises(ValueError, match="ema_decay"):
+        TrainState.create({"w": jnp.ones((2,))}, optax.sgd(0.1), ema_decay=1.0)
