@@ -34,6 +34,16 @@ _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
            "float16": jnp.float16}
 
 
+def _qtensor_paths(params) -> list:
+    """Sorted keystr paths of every QTensor leaf."""
+    from pyspark_tf_gke_tpu.ops.quant import QTensor
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda l: isinstance(l, QTensor))
+    return sorted(jax.tree_util.keystr(path) for path, leaf in flat
+                  if isinstance(leaf, QTensor))
+
+
 def export_serving_bundle(
     cfg: CausalLMConfig,
     params: Any,
@@ -54,8 +64,10 @@ def export_serving_bundle(
         "format": "pyspark_tf_gke_tpu.serving_bundle.v1",
         "model": "causal_lm",
         "quantized": bool(is_quantized(params)),
-        # recorded so the loader rebuilds the exact same pytree structure
-        "quantize_min_size": quantize_min_size,
+        # The exact QTensor leaf paths, recorded so the loader rebuilds
+        # the same pytree no matter how the tree was quantized (caller-
+        # quantized trees included — a min_size alone couldn't say).
+        "quantized_paths": _qtensor_paths(params),
         "tokenizer": tokenizer_spec,
         "config": cfg_dict,
     }
@@ -87,17 +99,23 @@ def load_serving_bundle(bundle_dir: str) -> Tuple[CausalLM, Any, dict]:
     model = CausalLM(cfg)
 
     # Abstract target with the same pytree (incl. QTensor nodes) so
-    # orbax restores structure-exactly: re-init abstractly, quantize the
-    # abstract tree if the bundle is quantized.
+    # orbax restores structure-exactly: re-init abstractly, then
+    # quantize exactly the leaves the bundle recorded as QTensors.
     from flax import linen as nn
+
+    from pyspark_tf_gke_tpu.ops.quant import quantize_tensor
 
     sample = jnp.zeros((1, 8), jnp.int32)
     abstract = jax.eval_shape(
         lambda: nn.meta.unbox(model.init(jax.random.PRNGKey(0), sample)["params"]))
-    if meta["quantized"]:
-        min_size = int(meta.get("quantize_min_size", 4096))
-        abstract = jax.eval_shape(
-            lambda p: quantize_tree(p, min_size=min_size), abstract)
+    qpaths = set(meta.get("quantized_paths", []))
+    if qpaths:
+        def requantize(path, leaf):
+            if jax.tree_util.keystr(path) in qpaths:
+                return jax.eval_shape(quantize_tensor, leaf)
+            return leaf
+
+        abstract = jax.tree_util.tree_map_with_path(requantize, abstract)
 
     ckptr = ocp.StandardCheckpointer()
     params = ckptr.restore(os.path.join(os.path.abspath(bundle_dir), "params"),

@@ -50,6 +50,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument("--data-pattern", default=e("DATA_PATTERN", ""),
                    help="glob of text files, e.g. 'gs://bucket/corpus/*.txt'")
+    p.add_argument("--eval-pattern", default=e("EVAL_PATTERN", ""),
+                   help="optional glob of held-out text files; per-epoch "
+                        "val_loss and val_perplexity land in history")
+    p.add_argument("--eval-batches", type=int, default=int(e("EVAL_BATCHES", "16")),
+                   help="number of validation batches per epoch")
     p.add_argument("--tokenizer", default=e("TOKENIZER", "byte"),
                    help="'byte' (built-in, vocab 259) or an HF tokenizer "
                         "name/path (e.g. 'gpt2')")
@@ -142,6 +147,32 @@ def main(argv=None) -> dict:
             process_count=jax.process_count(),
         )
 
+    val_batches = None
+    if args.eval_pattern:
+        import itertools
+
+        def val_batches():
+            # Fresh deterministic pass each epoch, capped at --eval-batches
+            # (unshuffled: a fixed eval set makes val_loss comparable
+            # across epochs). An empty pass — e.g. striping gave this
+            # host no eval files — skips validation instead of killing a
+            # healthy training run. (Multi-host note: give every host
+            # the same number of eval files; SPMD eval steps are
+            # collective, so uneven batch counts would desynchronize.)
+            def gen():
+                try:
+                    yield from itertools.islice(
+                        lm_batches(args.eval_pattern, tokenizer,
+                                   args.seq_len, local_bs, seed=args.seed,
+                                   repeat=False, shuffle_buffer=1,
+                                   process_index=jax.process_index(),
+                                   process_count=jax.process_count()),
+                        args.eval_batches)
+                except ValueError as exc:
+                    logger.warning("validation skipped: %s", exc)
+
+            return gen()
+
     state = trainer.init_state(make_rng(args.seed), next(batches()))
     n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(state.params))
     logger.info("Model: %d params (%.1fM), vocab=%d, mesh=%s", n_params,
@@ -157,11 +188,17 @@ def main(argv=None) -> dict:
         try:
             state, history = trainer.fit(
                 state, batches(), args.epochs, args.steps_per_epoch,
+                val_batches=val_batches,
+                # validate the weights the bundle will ship: EMA if enabled
+                val_use_ema=args.ema_decay > 0,
                 checkpoint_manager=ckpt,
                 heartbeat=make_heartbeat(args.output_dir,
                                          args.heartbeat_every_steps,
                                          args.heartbeat_file),
             )
+            if "val_loss" in history:
+                history["val_perplexity"] = [
+                    float(np.exp(min(l, 30.0))) for l in history["val_loss"]]
             finalize_run(ckpt, state, history, args.output_dir,
                          model_name="causal-lm")
         finally:

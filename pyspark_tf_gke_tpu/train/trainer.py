@@ -538,6 +538,7 @@ class Trainer:
         fault_injector=None,  # train.resilience.FaultInjector (chaos tests)
         prefetch: int = 2,  # device-resident batches staged ahead (0 = inline)
         grad_accum: int = 1,  # microbatches accumulated per optimizer step
+        val_use_ema: bool = False,  # validate the EMA weights (the ones exported)
     ) -> Tuple[TrainState, Dict[str, list]]:
         """Run the training loop; returns final state and a Keras-style
         history dict (the reference's ``history.history`` analog,
@@ -556,7 +557,7 @@ class Trainer:
             return self._fit_epochs(
                 state, device_batches, epochs, steps_per_epoch, val_batches,
                 checkpoint_manager, log_every, heartbeat, fault_injector,
-                history, global_step, grad_accum,
+                history, global_step, grad_accum, val_use_ema,
             )
         finally:
             # Stop the prefetch worker: it must not keep draining the
@@ -567,7 +568,7 @@ class Trainer:
     def _fit_epochs(
         self, state, device_batches, epochs, steps_per_epoch, val_batches,
         checkpoint_manager, log_every, heartbeat, fault_injector,
-        history, global_step, grad_accum,
+        history, global_step, grad_accum, val_use_ema=False,
     ):
         from pyspark_tf_gke_tpu.data.pipeline import put_global_batch
 
@@ -627,7 +628,8 @@ class Trainer:
                 val_iter = (
                     put_global_batch(b, val_sharding) for b in val_batches()
                 )
-                val_metrics = self.evaluate(state, val_iter)
+                val_metrics = self.evaluate(state, val_iter,
+                                            use_ema=val_use_ema)
                 for k, v in val_metrics.items():
                     history.setdefault(f"val_{k}", []).append(v)
                 logger.info(

@@ -6,6 +6,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from flax import linen as nn
 
 from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig, generate
@@ -87,3 +88,73 @@ def test_bundle_config_json_is_plain_data(tmp_path):
     assert meta["tokenizer"] == "gpt2"
     assert meta["config"]["dtype"] == "float32"
     assert meta["config"]["num_kv_heads"] == 1
+
+
+def test_lm_eval_on_bundle(tmp_path, capsys):
+    """evaluate/lm_eval: perplexity + sample generation from a bundle.
+    The model vocab must cover the byte tokenizer (259) — lm_eval
+    rejects a narrower model loudly (tested below)."""
+    cfg = CausalLMConfig(**{**CFG, "vocab_size": 259})
+    model = CausalLM(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = nn.meta.unbox(jax.jit(model.init)(make_rng(2), ids)["params"])
+    bundle = str(tmp_path / "bundle")
+    export_serving_bundle(cfg, params, bundle, quantize=True,
+                          quantize_min_size=64)
+
+    corpus = tmp_path / "heldout"
+    corpus.mkdir()
+    rng = np.random.default_rng(0)
+    (corpus / "h.txt").write_text(
+        " ".join("".join(chr(rng.integers(97, 123)) for _ in range(6))
+                 for _ in range(400)))
+
+    from pyspark_tf_gke_tpu.evaluate.lm_eval import main
+
+    res = main([
+        "--bundle", bundle,
+        "--data-pattern", str(corpus / "*.txt"),
+        "--batches", "2", "--batch-size", "4", "--seq-len", "24",
+        "--prompt", "ab", "--max-new-tokens", "5",
+    ])
+    assert res["perplexity"] > 1.0
+    assert res["tokens"] > 0
+    assert res["quantized"] is True
+    assert len(res["samples"]) == 1
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["perplexity"] == res["perplexity"]
+
+
+def test_lm_eval_rejects_vocab_mismatch(tmp_path):
+    """A bundle whose model vocab is narrower than its recorded
+    tokenizer must fail loudly, not NaN silently."""
+    cfg, model, params = _model_and_params()  # vocab 97 < byte's 259
+    bundle = str(tmp_path / "bad")
+    export_serving_bundle(cfg, params, bundle, quantize=False)
+
+    from pyspark_tf_gke_tpu.evaluate.lm_eval import main
+
+    with pytest.raises(ValueError, match="out of range"):
+        main(["--bundle", bundle, "--data-pattern", "x*.txt"])
+
+
+def test_caller_prequantized_bundle_roundtrip(tmp_path):
+    """Exporting an already-quantized tree (custom min_size) must load
+    back structure-exactly — the bundle records quantized leaf paths,
+    not a threshold."""
+    from pyspark_tf_gke_tpu.ops.quant import quantize_tree
+
+    cfg, model, params = _model_and_params(seed=3)
+    q = quantize_tree(params, min_size=512)  # unusual threshold
+    bundle = str(tmp_path / "pq")
+    export_serving_bundle(cfg, q, bundle)  # quantize step skipped
+
+    model2, params2, meta = load_serving_bundle(bundle)
+    assert meta["quantized"] is True
+    ref = [(p, type(l).__name__) for p, l in
+           jax.tree_util.tree_flatten_with_path(
+               q, is_leaf=lambda l: isinstance(l, QTensor))[0]]
+    got = [(p, type(l).__name__) for p, l in
+           jax.tree_util.tree_flatten_with_path(
+               params2, is_leaf=lambda l: isinstance(l, QTensor))[0]]
+    assert [t for _, t in ref] == [t for _, t in got]

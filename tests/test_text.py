@@ -134,10 +134,15 @@ def test_lm_pretrain_entry_e2e(tmp_path, devices):
         "--compute-dtype", "float32",
         "--ema-decay", "0.9",
         "--export-bundle", str(tmp_path / "bundle"),
+        "--eval-pattern", str(corpus / "0.txt"),
+        "--eval-batches", "2",
         "--output-dir", str(out),
     ])
     assert len(history["loss"]) == 2
     assert all(np.isfinite(l) for l in history["loss"])
+    assert len(history["val_loss"]) == 2
+    assert history["val_perplexity"][-1] == pytest.approx(
+        np.exp(history["val_loss"][-1]))
     assert (out / "history.json").exists()
 
     # exported serving bundle loads and generates
