@@ -1,0 +1,146 @@
+"""Spark → packed-token TFRecord shards: the ETL plane for LM pretraining.
+
+The reference's ETL plane ends at MySQL/GCS tables (SURVEY §2a); the
+framework's decoder family needs token streams. This bridge lets the
+Spark pool do the corpus work — clean, tokenize, eos-pack — and hand the
+TPU hosts ready-to-train shards, exactly like ``tfrecord_bridge`` does
+for the BERT fine-tune schema (BASELINE configs 3/5 pattern):
+
+* executor body is pure Python (``data.text`` tokenizers +
+  ``tfrecord_bridge`` framing) — no tensorflow, no connector jars;
+* output schema is ``{"input_ids": int64[seq_len]}`` per Example, the
+  contract of ``train/lm_pretrain.py --data-format tokens`` (read with
+  the native C++ TFRecord plane on the TPU side);
+* shards land on any executor-visible FS (gs:// in production).
+
+The per-partition body is module-level and iterator-driven so it
+unit-tests without a Spark session (tests/test_etl.py pattern).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Iterable, Iterator, List, Sequence
+
+from pyspark_tf_gke_tpu.etl.tfrecord_bridge import (
+    _write_bytes,
+    example_bytes,
+    tfrecord_frame,
+)
+
+
+def tokenize_partition_docs(
+    idx: int,
+    docs: Iterable,
+    output_prefix: str,
+    seq_len: int,
+    tokenizer_spec: str = "byte",
+    num_shards: int = 16,
+    text_field: str = None,
+) -> Iterator[str]:
+    """Executor body: tokenize + eos-pack this partition's documents and
+    frame the packed rows into one TFRecord shard. ``docs`` is any
+    iterable of strings (or ``row[text_field]``-indexable records)."""
+    from pyspark_tf_gke_tpu.data.text import get_tokenizer, pack_tokens
+
+    tokenizer = get_tokenizer(tokenizer_spec)
+    texts = (d if text_field is None else d[text_field] for d in docs)
+
+    buf = io.BytesIO()
+    rows = 0
+    for packed in pack_tokens(texts, tokenizer, seq_len):
+        payload = example_bytes({"input_ids": [int(t) for t in packed]})
+        buf.write(tfrecord_frame(payload))
+        rows += 1
+    path = f"{output_prefix}-{idx:05d}-of-{num_shards:05d}.tfrecord"
+    _write_bytes(path, buf.getvalue())
+    yield path
+
+
+def write_shard_metadata(output_prefix: str, seq_len: int,
+                         tokenizer_spec: str = "byte") -> str:
+    """Sidecar ``{output_prefix}.meta.json`` recording the tokenizer and
+    seq_len the shards were packed with — the consumer contract check
+    (a byte-packed corpus read as gpt2 ids, or vice versa, trains on
+    silently-clamped garbage otherwise)."""
+    import json
+
+    from pyspark_tf_gke_tpu.data.text import get_tokenizer
+
+    path = f"{output_prefix}.meta.json"
+    meta = {
+        "format": "pyspark_tf_gke_tpu.token_shards.v1",
+        "tokenizer": tokenizer_spec,
+        "vocab_size": get_tokenizer(tokenizer_spec).vocab_size,
+        "seq_len": seq_len,
+    }
+    _write_bytes(path, json.dumps(meta, indent=2).encode())
+    return path
+
+
+def validate_shard_meta(pattern: str, tokenizer_spec: str, seq_len: int,
+                        vocab_size: int) -> None:
+    """Check a consumer's tokenizer/seq_len against the shards' sidecar
+    (located next to the first matching shard). Missing sidecar → warn
+    (pre-metadata shards); mismatch → raise."""
+    import json
+    import logging
+    import os
+
+    from pyspark_tf_gke_tpu.utils.fs import fs_glob, fs_open
+
+    logger = logging.getLogger("etl.text_bridge")
+    matches = fs_glob(pattern)
+    if not matches:
+        return  # the reader will fail loudly on its own
+    # shards are {prefix}-NNNNN-of-NNNNN.tfrecord; sidecar is {prefix}.meta.json
+    base = matches[0].rsplit("-", 3)[0]
+    sidecar = f"{base}.meta.json"
+    try:
+        with fs_open(sidecar, "rb") as fh:
+            meta = json.loads(fh.read().decode())
+    except (FileNotFoundError, OSError):
+        logger.warning("no token-shard sidecar at %s; cannot verify the "
+                       "tokenizer contract", sidecar)
+        return
+    problems = []
+    if meta.get("tokenizer") != tokenizer_spec:
+        problems.append(f"shards packed with tokenizer "
+                        f"{meta.get('tokenizer')!r}, consumer uses "
+                        f"{tokenizer_spec!r}")
+    if int(meta.get("seq_len", seq_len)) != seq_len:
+        problems.append(f"shards packed at seq_len {meta.get('seq_len')}, "
+                        f"consumer expects {seq_len}")
+    if int(meta.get("vocab_size", 0)) > vocab_size:
+        problems.append(f"shard vocab {meta.get('vocab_size')} exceeds the "
+                        f"model vocab {vocab_size}")
+    if problems:
+        raise ValueError("token-shard contract mismatch: " +
+                         "; ".join(problems))
+
+
+def write_token_shards(
+    df,
+    output_prefix: str,
+    seq_len: int,
+    text_col: str = "text",
+    tokenizer_spec: str = "byte",
+    num_shards: int = 16,
+) -> List[str]:
+    """Spark action: repartition the corpus DataFrame and write one
+    packed-token TFRecord shard per partition (plus the metadata
+    sidecar)."""
+    import functools
+
+    body = functools.partial(
+        tokenize_partition_docs,
+        output_prefix=output_prefix,
+        seq_len=seq_len,
+        tokenizer_spec=tokenizer_spec,
+        num_shards=num_shards,
+        text_field=text_col,
+    )
+    paths = (df.select(text_col).repartition(num_shards)
+               .rdd.mapPartitionsWithIndex(body).collect())
+    write_shard_metadata(output_prefix, seq_len, tokenizer_spec)
+    return paths

@@ -75,7 +75,7 @@ def bundle_perplexity(model, params, tokenizer, pattern: str, seq_len: int,
         import optax
 
         per_tok = optax.softmax_cross_entropy_with_integer_labels(lg, targets)
-        return per_tok.sum(), per_tok.size
+        return per_tok.sum()
 
     # NLLs accumulate as device scalars — one host sync after the loop,
     # not one per batch (a per-batch readback serializes dispatch
@@ -86,9 +86,9 @@ def bundle_perplexity(model, params, tokenizer, pattern: str, seq_len: int,
                    repeat=False, shuffle_buffer=1),
         max_batches)
     for batch in rows:
-        nll, n = batch_nll(params, jnp.asarray(batch["input_ids"]))
-        nlls.append(nll)
-        total_tok += int(n)
+        ids = batch["input_ids"]
+        nlls.append(batch_nll(params, jnp.asarray(ids)))
+        total_tok += ids.shape[0] * (ids.shape[1] - 1)  # host-known, no sync
     if total_tok == 0:
         raise ValueError(f"no evaluation rows from {pattern!r}")
     mean_nll = float(jax.device_get(sum(nlls))) / total_tok
@@ -109,6 +109,12 @@ def main(argv=None) -> dict:
             f"{tokenizer.vocab_size}, larger than the model's "
             f"{model.cfg.vocab_size} — token ids would index out of range")
     seq_len = args.seq_len or model.cfg.max_seq_len
+    if seq_len > model.cfg.max_seq_len:
+        raise ValueError(
+            f"--seq-len {seq_len} exceeds the bundle's max_seq_len "
+            f"{model.cfg.max_seq_len}: positions past it would clamp to "
+            "the last position embedding and the perplexity would be "
+            "silently wrong")
 
     result = {"bundle": args.bundle, "quantized": meta.get("quantized"),
               "model": meta.get("model")}

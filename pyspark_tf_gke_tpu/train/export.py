@@ -116,6 +116,12 @@ def load_serving_bundle(bundle_dir: str) -> Tuple[CausalLM, Any, dict]:
             return leaf
 
         abstract = jax.tree_util.tree_map_with_path(requantize, abstract)
+    elif meta.get("quantized"):
+        # Back-compat: bundles written before quantized_paths recorded
+        # only the export-side min_size threshold.
+        min_size = int(meta.get("quantize_min_size", 4096))
+        abstract = jax.eval_shape(
+            lambda p: quantize_tree(p, min_size=min_size), abstract)
 
     ckptr = ocp.StandardCheckpointer()
     params = ckptr.restore(os.path.join(os.path.abspath(bundle_dir), "params"),

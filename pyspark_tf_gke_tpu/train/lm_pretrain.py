@@ -49,7 +49,14 @@ def parse_args(argv=None) -> argparse.Namespace:
         description="Pretrain a decoder-only causal LM on raw text files"
     )
     p.add_argument("--data-pattern", default=e("DATA_PATTERN", ""),
-                   help="glob of text files, e.g. 'gs://bucket/corpus/*.txt'")
+                   help="glob of text files, e.g. 'gs://bucket/corpus/*.txt' "
+                        "(or token shards with --data-format tokens)")
+    p.add_argument("--data-format", default=e("DATA_FORMAT", "text"),
+                   choices=["text", "tokens"],
+                   help="text = raw files tokenized host-side; tokens = "
+                        "packed-token TFRecord shards from the Spark ETL "
+                        "bridge (etl/text_bridge.py), read with the native "
+                        "IO plane")
     p.add_argument("--eval-pattern", default=e("EVAL_PATTERN", ""),
                    help="optional glob of held-out text files; per-epoch "
                         "val_loss and val_perplexity land in history")
@@ -140,6 +147,19 @@ def main(argv=None) -> dict:
     local_bs = local_batch_size(args.batch_size)
 
     def batches():
+        if args.data_format == "tokens":
+            from pyspark_tf_gke_tpu.data.native_tfrecord import (
+                read_tfrecord_batches,
+            )
+            from pyspark_tf_gke_tpu.etl.text_bridge import validate_shard_meta
+
+            validate_shard_meta(args.data_pattern, args.tokenizer,
+                                args.seq_len, tokenizer.vocab_size)
+            # reader already yields int32 (int_dtype default)
+            yield from read_tfrecord_batches(
+                args.data_pattern, {"input_ids": ("int", (args.seq_len,))},
+                local_bs, seed=args.seed)
+            return
         yield from lm_batches(
             args.data_pattern, tokenizer, args.seq_len, local_bs,
             seed=args.seed,
@@ -150,6 +170,14 @@ def main(argv=None) -> dict:
     val_batches = None
     if args.eval_pattern:
         import itertools
+
+        from pyspark_tf_gke_tpu.utils.fs import fs_glob
+
+        if not fs_glob(args.eval_pattern):
+            # Fail a typo'd eval path at startup, not at the end of
+            # epoch 1 (where run_with_recovery would retry it).
+            raise SystemExit(f"--eval-pattern matches no files: "
+                             f"{args.eval_pattern!r}")
 
         def val_batches():
             # Fresh deterministic pass each epoch, capped at --eval-batches

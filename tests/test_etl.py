@@ -1,3 +1,4 @@
+import os
 import numpy as np
 import pytest
 
@@ -220,3 +221,96 @@ def test_spark_local2_etl_to_tfrecord_end_to_end(tmp_path):
         assert sorted(got) == [float(i) for i in range(40)]
     finally:
         spark.stop()
+
+
+def test_text_bridge_executor_body_without_spark(tmp_path):
+    """etl/text_bridge: the per-partition tokenize+pack body runs on a
+    plain iterator and its shards parse back through the native IO
+    plane with the lm_pretrain schema contract."""
+    from pyspark_tf_gke_tpu.data.native_tfrecord import read_tfrecord_batches
+    from pyspark_tf_gke_tpu.data.text import ByteTokenizer, pack_tokens
+    from pyspark_tf_gke_tpu.etl.text_bridge import tokenize_partition_docs
+
+    docs = ["hello tpu world", "spark executors pack tokens", "short"]
+    prefix = str(tmp_path / "tok")
+    (path,) = tokenize_partition_docs(0, iter(docs), prefix, seq_len=8,
+                                      num_shards=1)
+    assert path.endswith("-00000-of-00001.tfrecord")
+
+    expect = list(pack_tokens(docs, ByteTokenizer(), 8))
+    got = []
+    for batch in read_tfrecord_batches(
+            f"{prefix}-*.tfrecord", {"input_ids": ("int", (8,))}, 2,
+            shuffle=False, repeat=False):
+        got.extend(batch["input_ids"])
+    assert len(got) == len(expect)
+    for g, e in zip(got, expect):
+        np.testing.assert_array_equal(np.asarray(g), e)
+
+
+def test_text_bridge_row_records(tmp_path):
+    """Spark Row-like records via text_field indexing."""
+    from pyspark_tf_gke_tpu.etl.text_bridge import tokenize_partition_docs
+
+    rows = [{"text": "abcdef" * 4}, {"text": "ghijkl" * 4}]
+    prefix = str(tmp_path / "r")
+    (path,) = tokenize_partition_docs(0, iter(rows), prefix, seq_len=16,
+                                      num_shards=1, text_field="text")
+    assert os.path.getsize(path) > 0
+
+
+def test_lm_pretrain_tokens_format(tmp_path):
+    """lm_pretrain --data-format tokens trains from bridge shards."""
+    from pyspark_tf_gke_tpu.etl.text_bridge import tokenize_partition_docs
+    from pyspark_tf_gke_tpu.train.lm_pretrain import main
+
+    rng = np.random.default_rng(0)
+    docs = ["".join(chr(rng.integers(97, 123)) for _ in range(300))
+            for _ in range(20)]
+    prefix = str(tmp_path / "shards" / "train")
+    os.makedirs(tmp_path / "shards")
+    for i in range(2):
+        list(tokenize_partition_docs(i, iter(docs[i::2]), prefix,
+                                     seq_len=32, num_shards=2))
+    from pyspark_tf_gke_tpu.etl.text_bridge import write_shard_metadata
+    write_shard_metadata(prefix, seq_len=32)
+
+    out = tmp_path / "run"
+    history = main([
+        "--data-pattern", f"{prefix}-*.tfrecord",
+        "--data-format", "tokens",
+        "--seq-len", "32",
+        "--hidden-size", "32", "--num-layers", "2", "--num-heads", "2",
+        "--intermediate-size", "64",
+        "--epochs", "1", "--steps-per-epoch", "3", "--batch-size", "8",
+        "--compute-dtype", "float32",
+        "--output-dir", str(out),
+    ])
+    assert np.isfinite(history["loss"][0])
+
+
+def test_token_shard_contract_mismatch_raises(tmp_path):
+    """A consumer whose seq_len/tokenizer disagrees with the shard
+    sidecar must fail loudly, not train on clamped garbage."""
+    import json
+
+    from pyspark_tf_gke_tpu.etl.text_bridge import (
+        tokenize_partition_docs,
+        validate_shard_meta,
+    )
+
+    prefix = str(tmp_path / "t")
+    list(tokenize_partition_docs(0, iter(["hello world " * 10]), prefix,
+                                 seq_len=16, num_shards=1))
+    json.dump({"format": "pyspark_tf_gke_tpu.token_shards.v1",
+               "tokenizer": "byte", "vocab_size": 259, "seq_len": 16},
+              open(f"{prefix}.meta.json", "w"))
+
+    pattern = f"{prefix}-*.tfrecord"
+    validate_shard_meta(pattern, "byte", 16, 259)  # matching: ok
+    with pytest.raises(ValueError, match="seq_len"):
+        validate_shard_meta(pattern, "byte", 32, 259)
+    with pytest.raises(ValueError, match="tokenizer"):
+        validate_shard_meta(pattern, "gpt2", 16, 50257)
+    with pytest.raises(ValueError, match="vocab"):
+        validate_shard_meta(pattern, "byte", 16, 97)
