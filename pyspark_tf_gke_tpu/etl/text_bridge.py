@@ -19,7 +19,6 @@ unit-tests without a Spark session (tests/test_etl.py pattern).
 
 from __future__ import annotations
 
-import io
 from typing import Iterable, Iterator, List, Sequence
 
 from pyspark_tf_gke_tpu.etl.tfrecord_bridge import (
@@ -44,17 +43,30 @@ def tokenize_partition_docs(
     from pyspark_tf_gke_tpu.data.text import get_tokenizer, pack_tokens
 
     tokenizer = get_tokenizer(tokenizer_spec)
-    texts = (d if text_field is None else d[text_field] for d in docs)
+    raw = (d if text_field is None else d[text_field] for d in docs)
+    # Nulls survive df.select() after outer joins / JDBC ingest; skip
+    # them instead of AttributeError-ing the whole Spark action.
+    texts = (t for t in raw if t)
 
-    buf = io.BytesIO()
-    rows = 0
-    for packed in pack_tokens(texts, tokenizer, seq_len):
-        payload = example_bytes({"input_ids": [int(t) for t in packed]})
-        buf.write(tfrecord_frame(payload))
-        rows += 1
     path = f"{output_prefix}-{idx:05d}-of-{num_shards:05d}.tfrecord"
-    _write_bytes(path, buf.getvalue())
+    # Stream frames straight to the output: buffering the shard in
+    # memory would double a multi-GB partition on the executor.
+    with _open_out(path) as out:
+        for packed in pack_tokens(texts, tokenizer, seq_len):
+            payload = example_bytes({"input_ids": [int(t) for t in packed]})
+            out.write(tfrecord_frame(payload))
     yield path
+
+
+def _open_out(path: str):
+    if path.startswith("gs://"):
+        try:
+            import gcsfs
+
+            return gcsfs.GCSFileSystem().open(path, "wb")
+        except ImportError as e:
+            raise RuntimeError("gs:// output needs gcsfs on executors") from e
+    return open(path, "wb")
 
 
 def write_shard_metadata(output_prefix: str, seq_len: int,

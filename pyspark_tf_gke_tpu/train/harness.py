@@ -36,6 +36,16 @@ def make_optimizer(
         raise ValueError(
             f"unknown lr schedule {schedule!r}; use constant | cosine | warmup_cosine"
         )
+    if weight_decay and optimizer not in ("adamw", "lamb"):
+        raise ValueError(
+            f"weight_decay={weight_decay} is ignored by optimizer "
+            f"{optimizer!r} — use adamw or lamb (or set weight_decay=0)"
+        )
+    if warmup_steps and schedule != "warmup_cosine":
+        raise ValueError(
+            f"warmup_steps={warmup_steps} is ignored by schedule "
+            f"{schedule!r} — use warmup_cosine (or set warmup_steps=0)"
+        )
     if schedule != "constant" and total_steps <= 0:
         raise ValueError(
             f"lr schedule {schedule!r} needs total_steps > 0 (a decay over 0 "

@@ -33,6 +33,7 @@ from pyspark_tf_gke_tpu.train.harness import (
     local_batch_size,
     make_checkpoint,
     make_heartbeat,
+    make_optimizer,
 )
 from pyspark_tf_gke_tpu.train.resilience import run_with_recovery
 from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
@@ -84,6 +85,18 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--learning-rate", type=float, default=float(e("LEARNING_RATE", "3e-4")))
     p.add_argument("--ema-decay", type=float, default=float(e("EMA_DECAY", "0")),
                    help=">0 maintains an EMA of params alongside training")
+    p.add_argument("--optimizer", default=e("OPTIMIZER", "adam"),
+                   choices=["adam", "adamw", "sgd", "momentum", "lamb"],
+                   help="adamw + warmup_cosine is the standard transformer "
+                        "recipe; adam (the prior default) stays default "
+                        "for backward-compatible loss curves")
+    p.add_argument("--weight-decay", type=float,
+                   default=float(e("WEIGHT_DECAY", "0.0")))
+    p.add_argument("--lr-schedule", default=e("LR_SCHEDULE", "constant"),
+                   choices=["constant", "cosine", "warmup_cosine"])
+    p.add_argument("--warmup-steps", type=int, default=int(e("WARMUP_STEPS", "0")))
+    p.add_argument("--grad-clip-norm", type=float,
+                   default=float(e("GRAD_CLIP_NORM", "0.0")))
     p.add_argument("--export-bundle", default=e("EXPORT_BUNDLE", ""),
                    help="directory to export a serving bundle into after "
                         "training (EMA weights if enabled; int8 by default)")
@@ -141,8 +154,12 @@ def main(argv=None) -> dict:
     mesh = make_mesh(parse_mesh_shape(args.mesh_shape) or None)
     model = CausalLM(cfg, mesh=mesh)
     task = TASKS["causal_lm"](vocab_chunks=args.vocab_chunks or None)
-    trainer = Trainer(model, task, mesh, learning_rate=args.learning_rate,
-                      ema_decay=args.ema_decay)
+    tx = make_optimizer(
+        args.learning_rate, schedule=args.lr_schedule,
+        total_steps=args.epochs * args.steps_per_epoch,
+        warmup_steps=args.warmup_steps, optimizer=args.optimizer,
+        weight_decay=args.weight_decay, grad_clip_norm=args.grad_clip_norm)
+    trainer = Trainer(model, task, mesh, tx=tx, ema_decay=args.ema_decay)
 
     local_bs = local_batch_size(args.batch_size)
 

@@ -314,3 +314,15 @@ def test_token_shard_contract_mismatch_raises(tmp_path):
         validate_shard_meta(pattern, "gpt2", 16, 50257)
     with pytest.raises(ValueError, match="vocab"):
         validate_shard_meta(pattern, "byte", 16, 97)
+
+
+def test_text_bridge_skips_null_docs(tmp_path):
+    """NULL text rows (outer joins, JDBC) are skipped, not crashed on."""
+    from pyspark_tf_gke_tpu.etl.text_bridge import tokenize_partition_docs
+
+    rows = [{"text": "hello world " * 5}, {"text": None}, {"text": ""},
+            {"text": "more text here " * 5}]
+    prefix = str(tmp_path / "n")
+    (path,) = tokenize_partition_docs(0, iter(rows), prefix, seq_len=16,
+                                      num_shards=1, text_field="text")
+    assert os.path.getsize(path) > 0

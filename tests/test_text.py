@@ -154,3 +154,30 @@ def test_lm_pretrain_entry_e2e(tmp_path, devices):
     out_ids = generate(model, params, np.zeros((1, 4), np.int32),
                        max_new_tokens=4)
     assert out_ids.shape == (1, 8)
+
+
+def test_lm_pretrain_optimizer_flags(tmp_path, devices):
+    """adamw + warmup_cosine + grad clipping wire through the harness
+    optimizer factory."""
+    corpus = tmp_path / "c"
+    corpus.mkdir()
+    rng = np.random.default_rng(2)
+    (corpus / "t.txt").write_text(
+        "\n\n".join("".join(chr(rng.integers(97, 123)) for _ in range(300))
+                    for _ in range(6)))
+
+    from pyspark_tf_gke_tpu.train.lm_pretrain import main
+
+    history = main([
+        "--data-pattern", str(corpus / "*.txt"),
+        "--seq-len", "32", "--hidden-size", "32", "--num-layers", "1",
+        "--num-heads", "2", "--intermediate-size", "64",
+        "--optimizer", "adamw", "--weight-decay", "0.01",
+        "--lr-schedule", "warmup_cosine", "--warmup-steps", "2",
+        "--grad-clip-norm", "1.0",
+        "--epochs", "2", "--steps-per-epoch", "3", "--batch-size", "8",
+        "--compute-dtype", "float32",
+        "--output-dir", str(tmp_path / "o"),
+    ])
+    assert len(history["loss"]) == 2
+    assert all(np.isfinite(l) for l in history["loss"])

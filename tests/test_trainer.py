@@ -195,7 +195,8 @@ def test_make_optimizer_schedules():
     from pyspark_tf_gke_tpu.train.harness import make_optimizer
 
     for sched in ("constant", "cosine", "warmup_cosine"):
-        tx = make_optimizer(1e-3, sched, total_steps=100, warmup_steps=10)
+        warmup = 10 if sched == "warmup_cosine" else 0
+        tx = make_optimizer(1e-3, sched, total_steps=100, warmup_steps=warmup)
         assert tx is not None
     with pytest.raises(ValueError, match="unknown lr schedule"):
         make_optimizer(1e-3, "linear")
@@ -239,7 +240,8 @@ def test_make_optimizer_families(mesh_dp):
     batch = {"x": X[:32], "y": y[:32]}
     gb = put_global_batch(batch, batch_sharding(mesh_dp))
     for name in ("adam", "adamw", "sgd", "momentum", "lamb"):
-        tx = make_optimizer(1e-2, optimizer=name, weight_decay=0.01,
+        wd = 0.01 if name in ("adamw", "lamb") else 0.0
+        tx = make_optimizer(1e-2, optimizer=name, weight_decay=wd,
                             grad_clip_norm=1.0)
         model = MLPClassifier(num_classes=3)
         trainer = Trainer(model, TASKS["classification"](), mesh_dp, tx=tx)
@@ -313,3 +315,15 @@ def test_ema_decay_validated(mesh_dp):
 
     with pytest.raises(ValueError, match="ema_decay"):
         TrainState.create({"w": jnp.ones((2,))}, optax.sgd(0.1), ema_decay=1.0)
+
+
+def test_make_optimizer_rejects_ignored_knobs():
+    from pyspark_tf_gke_tpu.train.harness import make_optimizer
+
+    with pytest.raises(ValueError, match="weight_decay"):
+        make_optimizer(1e-3, optimizer="adam", weight_decay=0.01)
+    with pytest.raises(ValueError, match="warmup_steps"):
+        make_optimizer(1e-3, schedule="cosine", total_steps=10, warmup_steps=5)
+    # valid combos still build
+    make_optimizer(1e-3, optimizer="adamw", weight_decay=0.01,
+                   schedule="warmup_cosine", total_steps=10, warmup_steps=2)
