@@ -57,6 +57,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--num-beams", type=int, default=1,
+                   help=">1 decodes samples with beam search instead of "
+                        "greedy/sampling")
     return p.parse_args(argv)
 
 
@@ -124,14 +127,32 @@ def main(argv=None) -> dict:
             args.batch_size, args.batches))
 
     samples = []
+    eos_id = getattr(tokenizer, "eos_id", None)
+    if args.num_beams > 1 and (args.temperature > 0 or args.top_p):
+        logger.warning("--temperature/--top-p are ignored with "
+                       "--num-beams > 1 (beam search is deterministic)")
     for prompt in args.prompt:
         ids = jnp.asarray([tokenizer.encode(prompt)], jnp.int32)
-        out = generate(model, params, ids,
-                       max_new_tokens=args.max_new_tokens,
-                       temperature=args.temperature, top_p=args.top_p)
-        text = tokenizer.decode(np.asarray(out[0]).tolist())
-        samples.append({"prompt": prompt, "completion": text})
-        logger.info("sample: %r -> %r", prompt, text)
+        if args.num_beams > 1:
+            from pyspark_tf_gke_tpu.models import beam_search
+
+            out, score = beam_search(model, params, ids,
+                                     max_new_tokens=args.max_new_tokens,
+                                     num_beams=args.num_beams,
+                                     eos_token_id=eos_id)
+            entry = {"prompt": prompt, "beam_score": float(score[0])}
+        else:
+            out = generate(model, params, ids,
+                           max_new_tokens=args.max_new_tokens,
+                           temperature=args.temperature, top_p=args.top_p,
+                           eos_token_id=eos_id)
+            entry = {"prompt": prompt}
+        toks = np.asarray(out[0, ids.shape[1]:]).tolist()
+        if eos_id is not None and eos_id in toks:
+            toks = toks[:toks.index(eos_id)]  # strip eos padding
+        entry["completion"] = prompt + tokenizer.decode(toks)
+        samples.append(entry)
+        logger.info("sample: %r -> %r", prompt, entry["completion"])
     if samples:
         result["samples"] = samples
 

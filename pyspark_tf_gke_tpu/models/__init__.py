@@ -4,6 +4,7 @@ from pyspark_tf_gke_tpu.models.resnet import ResNet50
 from pyspark_tf_gke_tpu.models.bert import BertConfig, BertEncoder, BertForPretraining
 from pyspark_tf_gke_tpu.models.pipelined_bert import PipelinedBertClassifier
 from pyspark_tf_gke_tpu.models.moe import MoELayer
+from pyspark_tf_gke_tpu.models.beam_search import beam_search
 from pyspark_tf_gke_tpu.models.causal_lm import CausalLM, CausalLMConfig, generate
 
 __all__ = [
@@ -19,6 +20,7 @@ __all__ = [
     "CausalLM",
     "CausalLMConfig",
     "generate",
+    "beam_search",
     "build_model",
 ]
 

@@ -1,0 +1,212 @@
+"""Beam-search decoding over the KV cache.
+
+No counterpart in the reference (it has no generative models); this
+completes the decoding API of ``models/causal_lm.py`` (greedy /
+sampling in ``generate``; beams here) with the same XLA discipline:
+one jitted prefill + one jitted ``lax.scan``, static shapes throughout.
+
+Mechanics (standard batched beam search, TPU-shaped):
+
+* the prompt is prefix-filled ONCE at batch ``B``; the per-layer cache
+  is then tiled to ``B*K`` (tile beats re-prefilling K× — prefill is
+  the expensive pass);
+* each step scores ``[B*K, V]`` continuations, flattens per batch row
+  to ``[B, K*V]``, takes the top-K, and reorders every cache leaf and
+  the token history with one ``take_along_axis`` gather over the beam
+  axis (no dynamic shapes — beams move by index, not by slicing);
+* hypotheses that emit eos move into a FINISHED pool of K
+  length-penalized entries (GNMT-style); active beams never carry eos,
+  so a short finished hypothesis can never be evicted by longer
+  unfinished beams, and pruning uses the same penalized score
+  ``score / ((5+len)/6)**alpha`` as final selection (which also lets
+  still-active beams compete at full length).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _tile_beams(tree, k: int):
+    """[B, ...] -> [B*K, ...] with each row repeated K times. Scalar
+    leaves (the cache fill index) pass through untouched."""
+    return jax.tree.map(
+        lambda l: l if l.ndim == 0 else jnp.repeat(l, k, axis=0), tree)
+
+
+def _reorder_beams(tree, beam_idx):
+    """Gather beams: tree leaves [B*K, ...], beam_idx [B, K] of source
+    beam indices within each batch row. Scalar leaves pass through."""
+    b, k = beam_idx.shape
+
+    def gather(leaf):
+        if leaf.ndim == 0:
+            return leaf
+        grouped = leaf.reshape(b, k, *leaf.shape[1:])
+        idx = beam_idx.reshape(b, k, *([1] * (leaf.ndim - 1)))
+        return jnp.take_along_axis(grouped, idx, axis=1).reshape(leaf.shape)
+
+    return jax.tree.map(gather, tree)
+
+
+def _penalty(length, alpha: float):
+    return ((5.0 + length.astype(jnp.float32)) / 6.0) ** alpha
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "num_beams", "eos_token_id",
+                     "s_prompt"),
+)
+def _beam_decode(model, params, cache, last_logits, *, max_new_tokens: int,
+                 num_beams: int, eos_token_id: Optional[int], s_prompt: int,
+                 length_penalty: float):
+    from pyspark_tf_gke_tpu.ops.quant import (
+        dequantize_embeddings,
+        inloop_dequantize,
+        is_quantized,
+    )
+
+    quantized = is_quantized(params)
+    if quantized:
+        params = dequantize_embeddings(params)
+    b, v = last_logits.shape
+    k = num_beams
+    t_max = max_new_tokens
+
+    cache = _tile_beams(cache, k)                       # [B*K, ...]
+    logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32))   # [B, V]
+
+    # GNMT-style search: ACTIVE beams never carry eos (the eos column is
+    # masked out of their continuations); a hypothesis that would end
+    # moves into a FINISHED pool of K length-penalized entries instead.
+    # This way a short finished hypothesis can never be evicted by
+    # longer unfinished beams, and pruning/selection use the same
+    # penalized score.
+    fin_scores = jnp.full((b, k), NEG_INF, jnp.float32)
+    fin_tokens = jnp.zeros((b, k, t_max), jnp.int32)
+
+    if eos_token_id is not None:
+        # seed the pool with the "ends immediately" hypothesis
+        fin_scores = fin_scores.at[:, 0].set(
+            logp0[:, eos_token_id] / _penalty(jnp.asarray(1), length_penalty))
+        fin_tokens = fin_tokens.at[:, 0, 0].set(eos_token_id)
+        logp0 = logp0.at[:, eos_token_id].set(NEG_INF)
+
+    scores, tok0 = jax.lax.top_k(logp0, k)              # [B, K] active seeds
+    tokens0 = jnp.zeros((b * k, t_max), jnp.int32)
+    tokens0 = tokens0.at[:, 0].set(tok0.reshape(-1))
+
+    def model_step(cache, tok, t):
+        p = inloop_dequantize(params) if quantized else params
+        logits, mutated = model.apply(
+            {"params": p, "cache": cache}, tok[:, None], decode=True,
+            positions=jnp.full((b * k, 1), t, jnp.int32),
+            mutable=["cache"],
+        )
+        return mutated["cache"], logits[:, 0]
+
+    def merge_finished(fin_scores, fin_tokens, new_scores, new_tokens):
+        """Keep the K best of pool ∪ new candidates (both penalized)."""
+        all_scores = jnp.concatenate([fin_scores, new_scores], axis=1)
+        all_tokens = jnp.concatenate([fin_tokens, new_tokens], axis=1)
+        fin_scores, idx = jax.lax.top_k(all_scores, k)
+        fin_tokens = jnp.take_along_axis(all_tokens, idx[:, :, None], axis=1)
+        return fin_scores, fin_tokens
+
+    def step(carry, t):
+        cache, tokens, scores, fin_scores, fin_tokens = carry
+        # the last emitted token per beam lives at history position
+        # pos = t - s_prompt; it is fed at sequence position t
+        pos = t - s_prompt
+        tok = jax.lax.dynamic_index_in_dim(tokens, pos, axis=1,
+                                           keepdims=False)
+        cache, logits = model_step(cache, tok, t)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))     # [B*K, V]
+        logp = logp.reshape(b, k, v)
+
+        if eos_token_id is not None:
+            # hypotheses finishing NOW: length = pos + 2 (incl. eos)
+            end_scores = (scores + logp[:, :, eos_token_id]) / _penalty(
+                pos + 2, length_penalty)                           # [B, K]
+            end_tokens = tokens.reshape(b, k, t_max)
+            end_tokens = jax.lax.dynamic_update_index_in_dim(
+                end_tokens, jnp.full((b, k), eos_token_id, jnp.int32),
+                pos + 1, axis=2)
+            fin_scores, fin_tokens = merge_finished(
+                fin_scores, fin_tokens, end_scores, end_tokens)
+            logp = logp.at[:, :, eos_token_id].set(NEG_INF)
+
+        cand = scores.reshape(b, k, 1) + logp                      # [B, K, V]
+        scores, flat_idx = jax.lax.top_k(cand.reshape(b, k * v), k)
+        beam_idx = flat_idx // v                                   # [B, K]
+        new_tok = (flat_idx % v).astype(jnp.int32)                 # [B, K]
+
+        cache = _reorder_beams(cache, beam_idx)
+        tokens = _reorder_beams(tokens, beam_idx)
+        tokens = tokens.at[:, pos + 1].set(new_tok.reshape(-1))
+        return (cache, tokens, scores, fin_scores, fin_tokens), None
+
+    (cache, tokens, scores, fin_scores, fin_tokens), _ = jax.lax.scan(
+        step, (cache, tokens0, scores, fin_scores, fin_tokens),
+        s_prompt + jnp.arange(t_max - 1),
+    )
+
+    # Final selection: still-active beams compete at full length against
+    # the finished pool, all under the same penalty.
+    active_final = scores / _penalty(jnp.asarray(t_max), length_penalty)
+    fin_scores, fin_tokens = merge_finished(
+        fin_scores, fin_tokens, active_final, tokens.reshape(b, k, t_max))
+
+    best_tokens = fin_tokens[:, 0]                                 # [B, T]
+    best_scores = fin_scores[:, 0]
+    if eos_token_id is not None:
+        # pad everything after the first eos with eos
+        seen = jnp.cumsum(best_tokens == eos_token_id, axis=1) > 0
+        shifted = jnp.concatenate(
+            [jnp.zeros((b, 1), bool), seen[:, :-1]], axis=1)
+        best_tokens = jnp.where(shifted, eos_token_id, best_tokens)
+    return best_tokens, best_scores
+
+
+def beam_search(
+    model,
+    params,
+    prompt_ids: jnp.ndarray,         # [B, S_prompt] int32
+    max_new_tokens: int,
+    num_beams: int = 4,
+    eos_token_id: Optional[int] = None,
+    length_penalty: float = 1.0,
+):
+    """Returns ``(sequences [B, S_prompt+max_new_tokens], scores [B])``
+    — the best beam per row with its length-normalized log-probability.
+    ``num_beams=1`` reduces exactly to greedy ``generate``."""
+    from pyspark_tf_gke_tpu.models.causal_lm import _prefill
+
+    cfg = model.cfg
+    _, s_prompt = prompt_ids.shape
+    if s_prompt + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {s_prompt} + {max_new_tokens} new tokens exceeds "
+            f"max_seq_len {cfg.max_seq_len}")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if not 1 <= num_beams < cfg.vocab_size:
+        raise ValueError(
+            f"num_beams must be in [1, vocab_size); got {num_beams} "
+            f"(vocab {cfg.vocab_size})")
+
+    cache, last_logits = _prefill(model, params, prompt_ids)
+    best_tokens, scores = _beam_decode(
+        model, params, cache, last_logits,
+        max_new_tokens=max_new_tokens, num_beams=num_beams,
+        eos_token_id=eos_token_id, s_prompt=s_prompt,
+        length_penalty=length_penalty)
+    seqs = jnp.concatenate([prompt_ids, best_tokens], axis=1)
+    return seqs, scores

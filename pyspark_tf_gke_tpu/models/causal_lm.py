@@ -329,22 +329,12 @@ def _decode(model: CausalLM, params, cache, last_logits, rng, temperature,
     b = last_logits.shape[0]
 
     def step_params(p):
-        """Weight-only int8: dequant INSIDE the scan body, behind an
-        optimization barrier so XLA cannot hoist the wide weights out of
-        the loop — each step streams int8 from HBM and the convert+scale
-        fuses into the matmuls. Dense leaves (incl. the pre-dequantized
-        embeddings) pass through un-barriered."""
+        """Weight-only int8: in-loop barriered dequant (ops/quant.py)."""
         if not quantized:
             return p
-        from pyspark_tf_gke_tpu.ops.quant import QTensor
+        from pyspark_tf_gke_tpu.ops.quant import inloop_dequantize
 
-        def deq(leaf):
-            if isinstance(leaf, QTensor):
-                q, s = jax.lax.optimization_barrier((leaf.q, leaf.scale))
-                return QTensor(q, s, leaf.dtype).dequantize()
-            return leaf
-
-        return jax.tree.map(deq, p, is_leaf=lambda l: isinstance(l, QTensor))
+        return inloop_dequantize(p)
 
     def sample(logits, rng):
         if greedy:

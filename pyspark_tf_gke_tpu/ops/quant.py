@@ -112,6 +112,23 @@ def dequantize_embeddings(params):
         fix, params, is_leaf=lambda l: isinstance(l, QTensor))
 
 
+def inloop_dequantize(params):
+    """Dequantize QTensor leaves INSIDE a decode loop body, each behind
+    an ``optimization_barrier`` so XLA cannot hoist the wide weights out
+    of the loop — every step streams int8 from HBM and the convert+scale
+    fuses into the matmuls. Dense leaves (incl. pre-dequantized
+    embeddings) pass through un-barriered. Shared by ``generate`` and
+    ``beam_search``."""
+
+    def deq(leaf):
+        if isinstance(leaf, QTensor):
+            q, s = jax.lax.optimization_barrier((leaf.q, leaf.scale))
+            return QTensor(q, s, leaf.dtype).dequantize()
+        return leaf
+
+    return jax.tree.map(deq, params, is_leaf=lambda l: isinstance(l, QTensor))
+
+
 def is_quantized(params) -> bool:
     return any(isinstance(l, QTensor) for l in jax.tree.leaves(
         params, is_leaf=lambda l: isinstance(l, QTensor)))
