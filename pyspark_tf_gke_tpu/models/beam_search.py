@@ -186,7 +186,10 @@ def beam_search(
 ):
     """Returns ``(sequences [B, S_prompt+max_new_tokens], scores [B])``
     — the best beam per row with its length-normalized log-probability.
-    ``num_beams=1`` reduces exactly to greedy ``generate``."""
+    With ``eos_token_id=None``, ``num_beams=1`` reduces exactly to
+    greedy ``generate`` (with eos set the semantics differ by design:
+    the single active beam explores the best non-eos continuation while
+    the ends-here hypothesis waits in the finished pool)."""
     from pyspark_tf_gke_tpu.models.causal_lm import _prefill
 
     cfg = model.cfg
@@ -201,6 +204,11 @@ def beam_search(
         raise ValueError(
             f"num_beams must be in [1, vocab_size); got {num_beams} "
             f"(vocab {cfg.vocab_size})")
+    if eos_token_id is not None and not 0 <= eos_token_id < cfg.vocab_size:
+        # under jit an OOB scatter is silently dropped and an OOB gather
+        # clamps — the search would return plausible garbage, not error
+        raise ValueError(
+            f"eos_token_id {eos_token_id} outside vocab [0, {cfg.vocab_size})")
 
     cache, last_logits = _prefill(model, params, prompt_ids)
     best_tokens, scores = _beam_decode(

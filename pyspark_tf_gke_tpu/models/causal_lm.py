@@ -58,6 +58,11 @@ class CausalLMConfig:
     # shrinks by num_heads/num_kv_heads — the decode path is HBM-bound on
     # cache reads, so this is a direct serving-throughput lever.
     num_kv_heads: Optional[int] = None
+    # "learned" = absolute wpe table (GPT-2 style); "rope" = rotary
+    # embeddings applied to q/k (no position table, better length
+    # extrapolation, the modern default for long-context decoders).
+    pos_embedding: str = "learned"
+    rope_theta: float = 10000.0
 
     @property
     def head_dim(self) -> int:
@@ -72,6 +77,24 @@ class CausalLMConfig:
         return kv
 
 
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
+               theta: float = 10000.0) -> jnp.ndarray:
+    """Rotary position embedding on ``x [B, S, H, D]`` at integer
+    ``positions [B, S]`` (rotate-half formulation, fp32 angles). The
+    same code serves training (positions = arange) and decode
+    (positions = the single cache index), because rotation is purely
+    per-position — nothing is cached or retrained for new lengths."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]                       # [B,S,1,half]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
 def _ln(cfg: CausalLMConfig, mesh: Optional[Mesh] = None, name=None):
     from pyspark_tf_gke_tpu.models.bert import FusedLayerNorm
 
@@ -84,7 +107,8 @@ class CausalSelfAttention(nn.Module):
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, hidden, *, decode: bool = False, prefill: bool = False):
+    def __call__(self, hidden, *, decode: bool = False, prefill: bool = False,
+                 positions: Optional[jnp.ndarray] = None):
         cfg = self.cfg
         b, s, _ = hidden.shape
         h, hkv, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
@@ -95,6 +119,15 @@ class CausalSelfAttention(nn.Module):
         q = q.reshape(b, s, h, d)
         k = k.reshape(b, s, hkv, d)
         v = v.reshape(b, s, hkv, d)
+        if cfg.pos_embedding == "rope":
+            if d % 2:
+                raise ValueError(f"rope needs an even head_dim, got {d}")
+            if positions is None:
+                positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+            # rotate q and k (the cache then holds rotated keys, so the
+            # decode einsum needs no further position handling)
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k = apply_rope(k, positions, cfg.rope_theta)
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
         k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"))
         v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"))
@@ -204,11 +237,12 @@ class CausalLMBlock(nn.Module):
     prefill: bool = False
 
     @nn.compact
-    def __call__(self, hidden):
+    def __call__(self, hidden, positions=None):
         cfg = self.cfg
         attn_in = _ln(cfg, self.mesh, name="ln_attn")(hidden)
         hidden = hidden + CausalSelfAttention(cfg, self.mesh, name="attention")(
-            attn_in, decode=self.decode, prefill=self.prefill
+            attn_in, decode=self.decode, prefill=self.prefill,
+            positions=positions,
         )
         mlp_in = _ln(cfg, self.mesh, name="ln_mlp")(hidden)
         mlp = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg, name="mlp_in")(mlp_in)
@@ -230,6 +264,9 @@ class CausalLM(nn.Module):
                  positions: Optional[jnp.ndarray] = None,
                  return_hidden: bool = False):
         cfg = self.cfg
+        if cfg.pos_embedding not in ("learned", "rope"):
+            raise ValueError(f"pos_embedding must be 'learned' or 'rope', "
+                             f"got {cfg.pos_embedding!r}")
         b, s = input_ids.shape
         embed = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
@@ -237,22 +274,26 @@ class CausalLM(nn.Module):
                 nn.initializers.normal(stddev=0.02), ("vocab", "embed")),
             name="wte",
         )
-        pos_embed = nn.Embed(
-            cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
-            embedding_init=nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=0.02), (None, "embed")),
-            name="wpe",
-        )
         if positions is None:
-            positions = jnp.arange(s)[None, :]
-        hidden = embed(input_ids) + pos_embed(positions)
+            positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+        if cfg.pos_embedding == "rope":
+            hidden = embed(input_ids)
+        else:
+            pos_embed = nn.Embed(
+                cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
+                embedding_init=nn.with_logical_partitioning(
+                    nn.initializers.normal(stddev=0.02), (None, "embed")),
+                name="wpe",
+            )
+            hidden = embed(input_ids) + pos_embed(positions)
 
         block_cls = CausalLMBlock
         if cfg.remat and not (decode or prefill):
             block_cls = nn.remat(CausalLMBlock, static_argnums=())
+        rope_pos = positions if cfg.pos_embedding == "rope" else None
         for i in range(cfg.num_layers):
             hidden = block_cls(cfg, self.mesh, decode=decode, prefill=prefill,
-                               name=f"layer_{i}")(hidden)
+                               name=f"layer_{i}")(hidden, rope_pos)
         hidden = _ln(cfg, self.mesh, name="ln_final")(hidden)
         head = _dense(cfg.vocab_size, ("embed", "vocab"), cfg, name="lm_head")
         if return_hidden:

@@ -72,6 +72,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--num-heads", type=int, default=int(e("NUM_HEADS", "12")))
     p.add_argument("--num-kv-heads", type=int, default=int(e("NUM_KV_HEADS", "0")),
                    help=">0 enables grouped-query attention (1 = MQA)")
+    p.add_argument("--pos-embedding", default=e("POS_EMBEDDING", "learned"),
+                   choices=["learned", "rope"],
+                   help="rope = rotary q/k embeddings (no position table, "
+                        "better length extrapolation)")
     p.add_argument("--intermediate-size", type=int,
                    default=int(e("INTERMEDIATE_SIZE", "3072")))
     p.add_argument("--vocab-chunks", type=int, default=int(e("VOCAB_CHUNKS", "0")),
@@ -146,6 +150,7 @@ def main(argv=None) -> dict:
         num_layers=args.num_layers,
         num_heads=args.num_heads,
         num_kv_heads=args.num_kv_heads or None,
+        pos_embedding=args.pos_embedding,
         intermediate_size=args.intermediate_size,
         max_seq_len=args.seq_len,
         dtype=jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32,

@@ -140,3 +140,10 @@ def test_beam_bounds_checked():
     with pytest.raises(ValueError, match="max_seq_len"):
         beam_search(model, params, jnp.zeros((1, 30), jnp.int32),
                     max_new_tokens=10, num_beams=2)
+
+
+def test_beam_eos_id_validated():
+    model, params = _setup()
+    with pytest.raises(ValueError, match="eos_token_id"):
+        beam_search(model, params, jnp.zeros((1, 3), jnp.int32),
+                    max_new_tokens=2, num_beams=2, eos_token_id=999)
