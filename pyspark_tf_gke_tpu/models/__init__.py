@@ -5,7 +5,7 @@ from pyspark_tf_gke_tpu.models.bert import BertConfig, BertEncoder, BertForPretr
 from pyspark_tf_gke_tpu.models.pipelined_bert import PipelinedBertClassifier
 from pyspark_tf_gke_tpu.models.moe import MoELayer
 from pyspark_tf_gke_tpu.models.beam_search import beam_search
-from pyspark_tf_gke_tpu.models.causal_lm import CausalLM, CausalLMConfig, generate
+from pyspark_tf_gke_tpu.models.causal_lm import CausalLM, CausalLMConfig, generate, llama_like
 
 __all__ = [
     "MLPClassifier",
@@ -21,6 +21,7 @@ __all__ = [
     "CausalLMConfig",
     "generate",
     "beam_search",
+    "llama_like",
     "build_model",
 ]
 

@@ -63,6 +63,13 @@ class CausalLMConfig:
     # extrapolation, the modern default for long-context decoders).
     pos_embedding: str = "learned"
     rope_theta: float = 10000.0
+    # "layernorm" (GPT-2 style, the Pallas-fused LN) or "rmsnorm"
+    # (Llama style: no mean subtraction, no bias — one less HBM pass).
+    norm: str = "layernorm"
+    # "gelu" (hidden = W2 gelu(W1 x)) or "swiglu" (Llama style:
+    # hidden = W2 (silu(Wg x) * W1 x); intermediate_size is the gated
+    # width as given — no 2/3 rescaling is applied implicitly).
+    ffn: str = "gelu"
 
     @property
     def head_dim(self) -> int:
@@ -95,7 +102,38 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
     return out.astype(x.dtype)
 
 
+def llama_like(**overrides) -> "CausalLMConfig":
+    """Llama-architecture preset: RoPE + RMSNorm + SwiGLU. Combine with
+    ``num_kv_heads`` for GQA. Any field can be overridden."""
+    defaults = dict(pos_embedding="rope", norm="rmsnorm", ffn="swiglu")
+    return CausalLMConfig(**{**defaults, **overrides})
+
+
+class RMSNorm(nn.Module):
+    """Llama-style norm: ``x * scale / rms(x)`` — no mean subtraction,
+    no bias. fp32 statistics regardless of compute dtype."""
+
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(),
+                                         ("embed",)),
+            (x.shape[-1],), jnp.float32)
+        xf = x.astype(jnp.float32)
+        rms = jnp.sqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + self.epsilon)
+        return (xf / rms * scale).astype(self.dtype)
+
+
 def _ln(cfg: CausalLMConfig, mesh: Optional[Mesh] = None, name=None):
+    if cfg.norm == "rmsnorm":
+        return RMSNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, name=name)
+    if cfg.norm != "layernorm":
+        raise ValueError(f"norm must be 'layernorm' or 'rmsnorm', "
+                         f"got {cfg.norm!r}")
     from pyspark_tf_gke_tpu.models.bert import FusedLayerNorm
 
     return FusedLayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
@@ -245,8 +283,18 @@ class CausalLMBlock(nn.Module):
             positions=positions,
         )
         mlp_in = _ln(cfg, self.mesh, name="ln_mlp")(hidden)
-        mlp = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg, name="mlp_in")(mlp_in)
-        mlp = nn.gelu(mlp, approximate=True)
+        if cfg.ffn == "swiglu":
+            gate = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg,
+                          name="mlp_gate")(mlp_in)
+            up = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg,
+                        name="mlp_in")(mlp_in)
+            mlp = nn.silu(gate) * up
+        elif cfg.ffn == "gelu":
+            mlp = _dense(cfg.intermediate_size, ("embed", "mlp"), cfg,
+                         name="mlp_in")(mlp_in)
+            mlp = nn.gelu(mlp, approximate=True)
+        else:
+            raise ValueError(f"ffn must be 'gelu' or 'swiglu', got {cfg.ffn!r}")
         mlp = _dense(cfg.hidden_size, ("mlp", "embed"), cfg, name="mlp_out")(mlp)
         return hidden + mlp
 

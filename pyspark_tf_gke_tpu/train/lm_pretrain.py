@@ -72,10 +72,18 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--num-heads", type=int, default=int(e("NUM_HEADS", "12")))
     p.add_argument("--num-kv-heads", type=int, default=int(e("NUM_KV_HEADS", "0")),
                    help=">0 enables grouped-query attention (1 = MQA)")
-    p.add_argument("--pos-embedding", default=e("POS_EMBEDDING", "learned"),
+    p.add_argument("--pos-embedding", default=e("POS_EMBEDDING") or None,
                    choices=["learned", "rope"],
                    help="rope = rotary q/k embeddings (no position table, "
-                        "better length extrapolation)")
+                        "better length extrapolation); default learned")
+    p.add_argument("--norm", default=e("NORM") or None,
+                   choices=["layernorm", "rmsnorm"])
+    p.add_argument("--ffn", default=e("FFN") or None,
+                   choices=["gelu", "swiglu"])
+    p.add_argument("--arch", default=e("ARCH", ""),
+                   choices=["", "gpt2", "llama"],
+                   help="architecture preset: gpt2 = learned+layernorm+gelu "
+                        "(the defaults); llama = rope+rmsnorm+swiglu")
     p.add_argument("--intermediate-size", type=int,
                    default=int(e("INTERMEDIATE_SIZE", "3072")))
     p.add_argument("--vocab-chunks", type=int, default=int(e("VOCAB_CHUNKS", "0")),
@@ -135,6 +143,27 @@ def main(argv=None) -> dict:
     args = parse_args(argv)
     if not args.data_pattern:
         raise SystemExit("--data-pattern is required (glob of text files)")
+    # Architecture resolution: explicit flags (None = unset) vs the
+    # --arch preset. A flag that disagrees with the preset is an error
+    # (silently discarding either side trains the wrong architecture for
+    # a whole job); checked before any backend init so it fails fast.
+    presets = {"llama": {"pos_embedding": "rope", "norm": "rmsnorm",
+                         "ffn": "swiglu"},
+               "gpt2": {"pos_embedding": "learned", "norm": "layernorm",
+                        "ffn": "gelu"},
+               "": {}}
+    builtin = {"pos_embedding": "learned", "norm": "layernorm", "ffn": "gelu"}
+    preset = presets[args.arch]
+    for name, default in builtin.items():
+        explicit = getattr(args, name)
+        if explicit is None:
+            setattr(args, name, preset.get(name, default))
+        elif name in preset and explicit != preset[name]:
+            raise SystemExit(
+                f"--arch {args.arch} sets --{name.replace('_', '-')} "
+                f"{preset[name]}, conflicting with the explicit "
+                f"--{name.replace('_', '-')} {explicit}; drop --arch and "
+                "set the architecture flags individually")
     initialize_distributed(
         num_processes=args.num_processes,
         process_id=args.process_id,
@@ -151,6 +180,8 @@ def main(argv=None) -> dict:
         num_heads=args.num_heads,
         num_kv_heads=args.num_kv_heads or None,
         pos_embedding=args.pos_embedding,
+        norm=args.norm,
+        ffn=args.ffn,
         intermediate_size=args.intermediate_size,
         max_seq_len=args.seq_len,
         dtype=jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32,

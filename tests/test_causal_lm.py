@@ -221,10 +221,11 @@ def test_rope_no_position_table():
 
     params = nn.meta.unbox(variables["params"])
     assert "wpe" not in params
-    # rope is position-sensitive: permuting the prompt changes the
-    # last-token logits (it wouldn't with no positional signal at all)
+    # rope is position-sensitive: permuting only the NON-final prompt
+    # tokens changes the last-token logits — with no positional signal
+    # the attention over a permuted set would be identical
     ids = jnp.asarray([[5, 9, 2, 7]], jnp.int32)
-    perm = jnp.asarray([[7, 2, 9, 5]], jnp.int32)
+    perm = jnp.asarray([[9, 5, 2, 7]], jnp.int32)
     la = model.apply({"params": params}, ids)
     lb = model.apply({"params": params}, perm)
     assert not np.allclose(np.asarray(la[:, -1]), np.asarray(lb[:, -1]),
@@ -282,4 +283,60 @@ def test_rope_rejects_odd_head_dim():
     cfg = CausalLMConfig(**{**ROPE, "hidden_size": 30, "num_heads": 2})
     model = CausalLM(cfg)
     with pytest.raises(ValueError, match="even head_dim"):
+        jax.jit(model.init)(make_rng(0), jnp.zeros((1, 4), jnp.int32))
+
+
+def test_llama_architecture_trains_and_decodes(devices):
+    """The full Llama-shaped stack (RoPE + RMSNorm + SwiGLU + GQA):
+    trains, has no wpe/bias-free norms, gated FFN params, and KV-cache
+    decoding matches full recompute."""
+    from flax import linen as nn
+
+    from pyspark_tf_gke_tpu.models import llama_like
+
+    cfg = llama_like(vocab_size=97, hidden_size=32, num_layers=2,
+                     num_heads=2, num_kv_heads=1, intermediate_size=48,
+                     max_seq_len=48, dtype=jnp.float32)
+    assert (cfg.pos_embedding, cfg.norm, cfg.ffn) == ("rope", "rmsnorm", "swiglu")
+    model = CausalLM(cfg)
+    params = nn.meta.unbox(
+        jax.jit(model.init)(make_rng(0), jnp.zeros((1, 8), jnp.int32))["params"])
+    assert "wpe" not in params
+    assert "scale" in params["layer_0"]["ln_attn"]
+    assert "bias" not in params["layer_0"]["ln_attn"]
+    assert "mlp_gate" in params["layer_0"]
+
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, 97, (2, 5)).astype(np.int32))
+    out = generate(model, params, prompt, max_new_tokens=5)
+    ref = prompt
+    for _ in range(5):
+        lg = model.apply({"params": params}, ref)
+        ref = jnp.concatenate(
+            [ref, jnp.argmax(lg[:, -1], -1).astype(jnp.int32)[:, None]], 1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    from pyspark_tf_gke_tpu.data.pipeline import put_global_batch
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding, make_mesh
+    from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
+
+    mesh = make_mesh({"dp": 2}, devices[:2])
+    model_m = CausalLM(cfg, mesh=mesh)
+    batch = {"input_ids": rng.integers(0, 97, (8, 24)).astype(np.int32)}
+    trainer = Trainer(model_m, TASKS["causal_lm"](), mesh, learning_rate=1e-2)
+    state = trainer.init_state(make_rng(0), batch)
+    gb = put_global_batch(batch, batch_sharding(mesh))
+    losses = []
+    for _ in range(5):
+        state, m = trainer.step(state, gb)
+        losses.append(float(jax.device_get(m["loss"])))
+    assert losses[-1] < losses[0]
+
+
+def test_invalid_norm_and_ffn_rejected():
+    model = CausalLM(CausalLMConfig(**{**TINY, "norm": "batchnorm"}))
+    with pytest.raises(ValueError, match="norm"):
+        jax.jit(model.init)(make_rng(0), jnp.zeros((1, 4), jnp.int32))
+    model = CausalLM(CausalLMConfig(**{**TINY, "ffn": "relu"}))
+    with pytest.raises(ValueError, match="ffn"):
         jax.jit(model.init)(make_rng(0), jnp.zeros((1, 4), jnp.int32))

@@ -181,3 +181,30 @@ def test_lm_pretrain_optimizer_flags(tmp_path, devices):
     ])
     assert len(history["loss"]) == 2
     assert all(np.isfinite(l) for l in history["loss"])
+
+
+def test_lm_pretrain_arch_preset(tmp_path, devices):
+    """--arch llama sets the trio; conflicts with explicit flags raise
+    before any backend init."""
+    from pyspark_tf_gke_tpu.train.lm_pretrain import main
+
+    with pytest.raises(SystemExit, match="conflicting"):
+        main(["--data-pattern", "x*.txt", "--arch", "llama", "--ffn", "gelu"])
+
+    corpus = tmp_path / "c"
+    corpus.mkdir()
+    rng = np.random.default_rng(3)
+    (corpus / "t.txt").write_text(
+        "\n\n".join("".join(chr(rng.integers(97, 123)) for _ in range(300))
+                    for _ in range(6)))
+    history = main([
+        "--data-pattern", str(corpus / "*.txt"),
+        "--arch", "llama",
+        "--seq-len", "32", "--hidden-size", "32", "--num-layers", "1",
+        "--num-heads", "2", "--num-kv-heads", "1",
+        "--intermediate-size", "48",
+        "--epochs", "1", "--steps-per-epoch", "3", "--batch-size", "8",
+        "--compute-dtype", "float32",
+        "--output-dir", str(tmp_path / "o"),
+    ])
+    assert np.isfinite(history["loss"][0])
