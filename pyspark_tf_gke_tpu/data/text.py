@@ -117,18 +117,38 @@ def pack_tokens(
     docs: Iterable[str],
     tokenizer,
     seq_len: int,
-) -> Iterator[np.ndarray]:
+    with_segments: bool = False,
+) -> Iterator:
     """Concatenate tokenized docs with ``eos`` separators; emit
     fixed-length ``[seq_len]`` int32 rows. The trailing partial row is
-    dropped (static shapes beat a padded straggler)."""
+    dropped (static shapes beat a padded straggler).
+
+    ``with_segments=True`` yields ``(tokens, segment_ids)`` pairs where
+    the segment id increments per document (an eos separator belongs to
+    the document it ends) — attention can then be confined within
+    documents (block-diagonal masking) instead of leaking across packed
+    boundaries."""
     stream: List[int] = []
+    seg_stream: List[int] = []
     eos = tokenizer.eos_id
+    doc_id = 0
     for doc in docs:
-        stream.extend(tokenizer.encode(doc))
+        ids = tokenizer.encode(doc)
+        stream.extend(ids)
         stream.append(eos)
+        if with_segments:
+            seg_stream.extend([doc_id] * (len(ids) + 1))
+            doc_id += 1
         while len(stream) >= seq_len:
-            yield np.asarray(stream[:seq_len], np.int32)
+            row = np.asarray(stream[:seq_len], np.int32)
             del stream[:seq_len]
+            if with_segments:
+                segs = np.asarray(seg_stream[:seq_len], np.int32)
+                del seg_stream[:seq_len]
+                # per-row local ids (attention only compares equality)
+                yield row, segs - segs[0]
+            else:
+                yield row
 
 
 def lm_batches(
@@ -142,22 +162,32 @@ def lm_batches(
     shuffle_buffer: int = 256,
     process_index: int = 0,
     process_count: int = 1,
+    with_segments: bool = False,
 ) -> Iterator[Dict[str, np.ndarray]]:
-    """Packed LM batches ``{"input_ids": [B, S] int32}``.
+    """Packed LM batches ``{"input_ids": [B, S] int32}`` (plus
+    ``"segment_ids"`` when ``with_segments`` — document-boundary
+    attention masking).
 
     Rows pass through a reservoir-style shuffle buffer (seeded — the
     same determinism contract as the TFRecord readers); ``repeat``
     restarts the file pass with a reseeded buffer each epoch."""
     rng = np.random.default_rng(seed)
     epoch = 0
-    batch: List[np.ndarray] = []  # partial batches carry across epochs
+    batch: List = []  # partial batches carry across epochs
+
+    def emit(batch):
+        if with_segments:
+            return {"input_ids": np.stack([t for t, _ in batch]),
+                    "segment_ids": np.stack([s for _, s in batch])}
+        return {"input_ids": np.stack(batch)}
+
     while True:
-        buf: List[np.ndarray] = []
+        buf: List = []
         produced = 0
         rows = pack_tokens(
             iter_documents(pattern, process_index=process_index,
                            process_count=process_count),
-            tokenizer, seq_len)
+            tokenizer, seq_len, with_segments=with_segments)
         for row in rows:
             produced += 1
             if shuffle_buffer > 1:
@@ -169,13 +199,14 @@ def lm_batches(
                 row = buf.pop()
             batch.append(row)
             if len(batch) == batch_size:
-                yield {"input_ids": np.stack(batch)}
+                yield emit(batch)
                 batch = []
-        rng.shuffle(buf)
+        # index permutation, not rng.shuffle: buf rows may be tuples
+        buf = [buf[i] for i in rng.permutation(len(buf))]
         for row in buf:
             batch.append(row)
             if len(batch) == batch_size:
-                yield {"input_ids": np.stack(batch)}
+                yield emit(batch)
                 batch = []
         if produced == 0:
             # Empty pass: corpus too small for a single seq_len row, or

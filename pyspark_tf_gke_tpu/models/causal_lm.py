@@ -146,7 +146,8 @@ class CausalSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, *, decode: bool = False, prefill: bool = False,
-                 positions: Optional[jnp.ndarray] = None):
+                 positions: Optional[jnp.ndarray] = None,
+                 segment_ids: Optional[jnp.ndarray] = None):
         cfg = self.cfg
         b, s, _ = hidden.shape
         h, hkv, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
@@ -184,11 +185,11 @@ class CausalSelfAttention(nn.Module):
                 # GQA memory win is in the cache, not the training pass.
                 k = jnp.repeat(k, h // hkv, axis=2)
                 v = jnp.repeat(v, h // hkv, axis=2)
-            out = self._causal_attend(q, k, v)
+            out = self._causal_attend(q, k, v, segment_ids=segment_ids)
         out = out.reshape(b, s, cfg.hidden_size)
         return _dense(cfg.hidden_size, ("mlp", "embed"), cfg, name="out")(out)
 
-    def _causal_attend(self, q, k, v):
+    def _causal_attend(self, q, k, v, segment_ids=None):
         from pyspark_tf_gke_tpu.models.bert import resolve_use_flash
 
         cfg = self.cfg
@@ -206,16 +207,32 @@ class CausalSelfAttention(nn.Module):
                 from pyspark_tf_gke_tpu.parallel.mesh import DATA_AXES
 
                 qkv_spec = P(DATA_AXES, None, "tp", None)
+                # one shard_map either way: the optional segment operand
+                # rides as *rest so the dispatch can't diverge between
+                # the masked and unmasked paths
+                operands = (q, k, v)
+                in_specs = (qkv_spec,) * 3
+                if segment_ids is not None:
+                    operands += (segment_ids,)
+                    in_specs += (P(DATA_AXES, None),)
                 fn = jax.shard_map(
-                    lambda qq, kk, vv: flash_attention(qq, kk, vv, causal=True),
+                    lambda qq, kk, vv, *rest: flash_attention(
+                        qq, kk, vv, causal=True,
+                        segment_ids=rest[0] if rest else None),
                     mesh=self.mesh,
-                    in_specs=(qkv_spec,) * 3,
+                    in_specs=in_specs,
                     out_specs=qkv_spec,
                     check_vma=False,
                 )
-                return fn(q, k, v)
-            return flash_attention(q, k, v, causal=True)
-        return dot_product_attention(q, k, v, causal=True)
+                return fn(*operands)
+            return flash_attention(q, k, v, causal=True,
+                                   segment_ids=segment_ids)
+        mask = None
+        if segment_ids is not None:
+            # block-diagonal: query attends only within its document
+            mask = (segment_ids[:, None, :, None] ==
+                    segment_ids[:, None, None, :])
+        return dot_product_attention(q, k, v, mask=mask, causal=True)
 
     def _cache_vars(self, b, h, d, dtype):
         cfg = self.cfg
@@ -275,12 +292,12 @@ class CausalLMBlock(nn.Module):
     prefill: bool = False
 
     @nn.compact
-    def __call__(self, hidden, positions=None):
+    def __call__(self, hidden, positions=None, segment_ids=None):
         cfg = self.cfg
         attn_in = _ln(cfg, self.mesh, name="ln_attn")(hidden)
         hidden = hidden + CausalSelfAttention(cfg, self.mesh, name="attention")(
             attn_in, decode=self.decode, prefill=self.prefill,
-            positions=positions,
+            positions=positions, segment_ids=segment_ids,
         )
         mlp_in = _ln(cfg, self.mesh, name="ln_mlp")(hidden)
         if cfg.ffn == "swiglu":
@@ -310,6 +327,7 @@ class CausalLM(nn.Module):
     def __call__(self, input_ids, *, decode: bool = False,
                  prefill: bool = False,
                  positions: Optional[jnp.ndarray] = None,
+                 segment_ids: Optional[jnp.ndarray] = None,
                  return_hidden: bool = False):
         cfg = self.cfg
         if cfg.pos_embedding not in ("learned", "rope"):
@@ -341,7 +359,8 @@ class CausalLM(nn.Module):
         rope_pos = positions if cfg.pos_embedding == "rope" else None
         for i in range(cfg.num_layers):
             hidden = block_cls(cfg, self.mesh, decode=decode, prefill=prefill,
-                               name=f"layer_{i}")(hidden, rope_pos)
+                               name=f"layer_{i}")(hidden, rope_pos,
+                                                  segment_ids)
         hidden = _ln(cfg, self.mesh, name="ln_final")(hidden)
         head = _dense(cfg.vocab_size, ("embed", "vocab"), cfg, name="lm_head")
         if return_hidden:

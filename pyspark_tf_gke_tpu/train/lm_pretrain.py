@@ -84,6 +84,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                    choices=["", "gpt2", "llama"],
                    help="architecture preset: gpt2 = learned+layernorm+gelu "
                         "(the defaults); llama = rope+rmsnorm+swiglu")
+    p.add_argument("--doc-masking", action="store_true",
+                   default=_env_bool("DOC_MASKING", False),
+                   help="confine attention within document boundaries in "
+                        "packed rows (segment ids from the packer; text "
+                        "format only)")
     p.add_argument("--intermediate-size", type=int,
                    default=int(e("INTERMEDIATE_SIZE", "3072")))
     p.add_argument("--vocab-chunks", type=int, default=int(e("VOCAB_CHUNKS", "0")),
@@ -143,6 +148,9 @@ def main(argv=None) -> dict:
     args = parse_args(argv)
     if not args.data_pattern:
         raise SystemExit("--data-pattern is required (glob of text files)")
+    if args.doc_masking and args.data_format == "tokens":
+        raise SystemExit("--doc-masking needs the text data format "
+                         "(token shards carry no segment ids)")
     # Architecture resolution: explicit flags (None = unset) vs the
     # --arch preset. A flag that disagrees with the preset is an error
     # (silently discarding either side trains the wrong architecture for
@@ -218,6 +226,7 @@ def main(argv=None) -> dict:
             seed=args.seed,
             process_index=jax.process_index(),
             process_count=jax.process_count(),
+            with_segments=args.doc_masking,
         )
 
     val_batches = None
@@ -247,7 +256,10 @@ def main(argv=None) -> dict:
                                    args.seq_len, local_bs, seed=args.seed,
                                    repeat=False, shuffle_buffer=1,
                                    process_index=jax.process_index(),
-                                   process_count=jax.process_count()),
+                                   process_count=jax.process_count(),
+                                   # validate the objective being
+                                   # trained: same masking as training
+                                   with_segments=args.doc_masking),
                         args.eval_batches)
                 except ValueError as exc:
                     logger.warning("validation skipped: %s", exc)

@@ -183,6 +183,7 @@ def causal_lm_task(vocab_chunks: Optional[int] = None) -> TrainerTask:
 
         def forward(model, variables, batch, train, mutable):
             hidden = model.apply(variables, batch["input_ids"],
+                                 segment_ids=batch.get("segment_ids"),
                                  return_hidden=True)
             head = variables["params"]["lm_head"]
             return {"hidden": hidden, "kernel": head["kernel"],
@@ -203,7 +204,8 @@ def causal_lm_task(vocab_chunks: Optional[int] = None) -> TrainerTask:
         return TrainerTask("causal_lm", forward, lam)
 
     def forward(model, variables, batch, train, mutable):
-        return model.apply(variables, batch["input_ids"]), None
+        return model.apply(variables, batch["input_ids"],
+                           segment_ids=batch.get("segment_ids")), None
 
     def lam(logits, batch):
         ids = batch["input_ids"]
