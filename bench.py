@@ -309,14 +309,17 @@ def bench_workload(name: str, steps: int = 50, smoke: bool = False,
     }
 
 
-def bench_decode(smoke: bool = False, kv_heads=None, int8: bool = False) -> dict:
+def bench_decode(smoke: bool = False, kv_heads=None, int8: bool = False,
+                 num_beams: int = 0) -> dict:
     """Serving-path throughput (BASELINE has no analog — this benches the
     framework's own KV-cache generation): one jitted prefill + scan
     decode on a GPT-small-shaped causal LM. Reports decode tokens/sec
     per chip and the prefill latency. ``--kv-heads N`` measures the GQA
     variant (smaller cache → less HBM traffic per decode step);
     ``--int8`` measures weight-only int8 quantized serving
-    (ops/quant.py — 4× less weight-streaming traffic vs f32 params)."""
+    (ops/quant.py — 4× less weight-streaming traffic vs f32 params);
+    ``--beams K`` measures beam-search decode (tokens/sec counts the
+    selected sequence's tokens — compute is K× wider)."""
     import jax
     import jax.numpy as jnp
 
@@ -363,11 +366,25 @@ def bench_decode(smoke: bool = False, kv_heads=None, int8: bool = False) -> dict
 
     rng_key = jax.random.PRNGKey(0)
 
-    def run_decode(cache, last):
-        return _decode(
-            model, params, cache, last, rng_key, jnp.float32(1.0), None,
-            max_new_tokens=n_new, greedy=True, eos_token_id=None,
-            s_prompt=s_prompt, top_k=None)
+    if num_beams:
+        from pyspark_tf_gke_tpu.models.beam_search import _beam_decode
+
+        if num_beams >= cfg.vocab_size:
+            raise SystemExit(f"--beams {num_beams} must be < the model "
+                             f"vocab ({cfg.vocab_size})")
+
+        def run_decode(cache, last):
+            toks, _ = _beam_decode(
+                model, params, cache, last, max_new_tokens=n_new,
+                num_beams=num_beams, eos_token_id=None,
+                s_prompt=s_prompt, length_penalty=1.0)
+            return toks
+    else:
+        def run_decode(cache, last):
+            return _decode(
+                model, params, cache, last, rng_key, jnp.float32(1.0), None,
+                max_new_tokens=n_new, greedy=True, eos_token_id=None,
+                s_prompt=s_prompt, top_k=None)
 
     log("compiling prefill + decode...")
     cache, last = _prefill(model, params, prompt)
@@ -397,12 +414,15 @@ def bench_decode(smoke: bool = False, kv_heads=None, int8: bool = False) -> dict
         "kv_heads": cfg.kv_heads,
         "num_heads": cfg.num_heads,
         "int8_weights": int8,
+        "num_beams": num_beams or None,
         "params_mb": round(params_mb, 1),
         "dense_params_mb": round(dense_mb, 1),
         "n_chips": n_chips,
         "device_kind": device_kind,
         "workload": (f"CausalLM {cfg.num_layers}L h{cfg.hidden_size} "
-                     f"vocab {cfg.vocab_size}, greedy KV-cache decode"),
+                     f"vocab {cfg.vocab_size}, "
+                     + (f"beam-{num_beams} KV-cache decode" if num_beams
+                        else "greedy KV-cache decode")),
     }
 
 
@@ -467,7 +487,7 @@ def bench_io(smoke: bool = False) -> dict:
 # ---- orchestrator ----------------------------------------------------------
 
 
-_VALUE_FLAGS = ("--seq", "--kv-heads")
+_VALUE_FLAGS = ("--seq", "--kv-heads", "--beams")
 
 
 def _positionals(argv) -> list:
@@ -582,7 +602,16 @@ def run_bench(argv) -> dict:
             except (IndexError, ValueError):
                 raise SystemExit(
                     "usage: bench.py generate --kv-heads <positive int>")
-        return bench_decode(smoke=smoke, kv_heads=kv, int8="--int8" in argv)
+        beams = 0
+        if "--beams" in argv:
+            try:
+                beams = int(argv[argv.index("--beams") + 1])
+                if beams < 1:
+                    raise ValueError
+            except (IndexError, ValueError):
+                raise SystemExit("usage: bench.py generate --beams <positive int>")
+        return bench_decode(smoke=smoke, kv_heads=kv, int8="--int8" in argv,
+                            num_beams=beams)
     use_flash = True if "--flash" in argv else (False if "--no-flash" in argv else None)
     seq = None
     if "--seq" in argv:

@@ -147,3 +147,11 @@ def test_beam_eos_id_validated():
     with pytest.raises(ValueError, match="eos_token_id"):
         beam_search(model, params, jnp.zeros((1, 3), jnp.int32),
                     max_new_tokens=2, num_beams=2, eos_token_id=999)
+
+
+def test_bench_decode_beams_smoke():
+    from bench import bench_decode
+
+    res = bench_decode(smoke=True, num_beams=2)
+    assert res["num_beams"] == 2
+    assert res["value"] > 0
