@@ -24,8 +24,9 @@ classification (batch 64, 224²) and BERT-base sequence classification
 (batch 32, S=128); same JSON shape, ``vs_baseline`` null (the reference
 has no such workloads to compare against). ``python bench.py io``
 measures the native input pipeline (TFRecord shards → host batches);
-``python bench.py generate [--kv-heads N]`` measures KV-cache decode
-tokens/sec on the serving path.
+``python bench.py generate [--kv-heads N] [--int8] [--beams K]``
+measures KV-cache decode tokens/sec on the serving path (GQA, weight-
+only int8, beam search).
 
 Resilience: the TPU backend attach through the tunnel is known-flaky
 (round 1 lost its entire perf evidence to one failed attach). The
