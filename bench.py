@@ -384,6 +384,7 @@ def bench_decode(smoke: bool = False, kv_heads=None, int8: bool = False,
         def run_decode(cache, last):
             return _decode(
                 model, params, cache, last, rng_key, jnp.float32(1.0), None,
+                None, jnp.zeros((batch, 1), bool),
                 max_new_tokens=n_new, greedy=True, eos_token_id=None,
                 s_prompt=s_prompt, top_k=None)
 

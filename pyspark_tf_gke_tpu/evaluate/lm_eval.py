@@ -60,6 +60,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--num-beams", type=int, default=1,
                    help=">1 decodes samples with beam search instead of "
                         "greedy/sampling")
+    p.add_argument("--repetition-penalty", type=float, default=None,
+                   help=">1 discourages repeating seen tokens "
+                        "(greedy/sampling path only)")
     return p.parse_args(argv)
 
 
@@ -128,9 +131,11 @@ def main(argv=None) -> dict:
 
     samples = []
     eos_id = getattr(tokenizer, "eos_id", None)
-    if args.num_beams > 1 and (args.temperature > 0 or args.top_p):
-        logger.warning("--temperature/--top-p are ignored with "
-                       "--num-beams > 1 (beam search is deterministic)")
+    if args.num_beams > 1 and (args.temperature > 0 or args.top_p
+                               or args.repetition_penalty):
+        logger.warning("--temperature/--top-p/--repetition-penalty are "
+                       "ignored with --num-beams > 1 (beam search is "
+                       "deterministic and unpenalized)")
     for prompt in args.prompt:
         ids = jnp.asarray([tokenizer.encode(prompt)], jnp.int32)
         if args.num_beams > 1:
@@ -145,7 +150,8 @@ def main(argv=None) -> dict:
             out = generate(model, params, ids,
                            max_new_tokens=args.max_new_tokens,
                            temperature=args.temperature, top_p=args.top_p,
-                           eos_token_id=eos_id)
+                           eos_token_id=eos_id,
+                           repetition_penalty=args.repetition_penalty)
             entry = {"prompt": prompt}
         toks = np.asarray(out[0, ids.shape[1]:]).tolist()
         if eos_id is not None and eos_id in toks:

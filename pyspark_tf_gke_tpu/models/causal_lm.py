@@ -423,8 +423,8 @@ def _filter_logits(logits, top_k: Optional[int], top_p):
                      "s_prompt", "top_k"),
 )
 def _decode(model: CausalLM, params, cache, last_logits, rng, temperature,
-            top_p, *, max_new_tokens: int, greedy: bool,
-            eos_token_id: Optional[int], s_prompt: int,
+            top_p, repetition_penalty, seen0, *, max_new_tokens: int,
+            greedy: bool, eos_token_id: Optional[int], s_prompt: int,
             top_k: Optional[int] = None):
     from pyspark_tf_gke_tpu.ops.quant import dequantize_embeddings, is_quantized
 
@@ -436,6 +436,19 @@ def _decode(model: CausalLM, params, cache, last_logits, rng, temperature,
         params = dequantize_embeddings(params)
     b = last_logits.shape[0]
 
+    def penalize(logits, seen):
+        """CTRL-style repetition penalty: already-seen tokens become
+        less likely — positive logits divide by the penalty, negative
+        ones multiply by it (both directions REDUCE the logit; this is
+        the CTRL/HF formulation). seen is a [B, V] presence bitmap
+        carried through the scan; repetition_penalty rides as a traced
+        scalar (1.0 = off) or None (compiled out)."""
+        if repetition_penalty is None:
+            return logits
+        adj = jnp.where(logits > 0, logits / repetition_penalty,
+                        logits * repetition_penalty)
+        return jnp.where(seen, adj, logits)
+
     def step_params(p):
         """Weight-only int8: in-loop barriered dequant (ops/quant.py)."""
         if not quantized:
@@ -444,42 +457,45 @@ def _decode(model: CausalLM, params, cache, last_logits, rng, temperature,
 
         return inloop_dequantize(p)
 
-    def sample(logits, rng):
+    def sample(logits, rng, seen):
+        logits = penalize(logits, seen)
         if greedy:
             return jnp.argmax(logits, axis=-1)
         logits = _filter_logits(logits / temperature, top_k, top_p)
         return jax.random.categorical(rng, logits, axis=-1)
 
-    def emit(logits, rng, done):
-        """Sample one token and fold in the eos latch."""
-        tok = sample(logits, rng).astype(jnp.int32)          # [B]
+    def emit(logits, rng, done, seen):
+        """Sample one token, fold in the eos latch, mark it seen."""
+        tok = sample(logits, rng, seen).astype(jnp.int32)    # [B]
         if eos_token_id is not None:
             tok = jnp.where(done, eos_token_id, tok)
             done = done | (tok == eos_token_id)
-        return tok, done
+        if repetition_penalty is not None:
+            seen = seen.at[jnp.arange(b), tok].set(True)
+        return tok, done, seen
 
     def step(carry, t):
-        cache, logits, rng, done = carry
+        cache, logits, rng, done, seen = carry
         rng, sub = jax.random.split(rng)
-        tok, done = emit(logits, sub, done)
+        tok, done, seen = emit(logits, sub, done, seen)
         logits, mutated = model.apply(
             {"params": step_params(params), "cache": cache}, tok[:, None],
             decode=True,
             positions=jnp.full((b, 1), t, jnp.int32),
             mutable=["cache"],
         )
-        return (mutated["cache"], logits[:, 0], rng, done), tok
+        return (mutated["cache"], logits[:, 0], rng, done, seen), tok
 
     # Scan max_new_tokens - 1 steps; the final token is sampled from the
     # carried logits directly — the last model forward (whose logits
     # nobody reads) never runs.
     done0 = jnp.zeros((b,), bool)
-    (_, last, rng, done), tokens = jax.lax.scan(
-        step, (cache, last_logits, rng, done0),
+    (_, last, rng, done, seen), tokens = jax.lax.scan(
+        step, (cache, last_logits, rng, done0, seen0),
         s_prompt + jnp.arange(max_new_tokens - 1),
     )
     rng, sub = jax.random.split(rng)
-    final, _ = emit(last, sub, done)
+    final, _, _ = emit(last, sub, done, seen)
     tokens = jnp.concatenate([tokens, final[None]], axis=0)
     return tokens.T  # [B, max_new_tokens]
 
@@ -494,6 +510,7 @@ def generate(
     eos_token_id: Optional[int] = None,
     top_k: Optional[int] = None,   # sample from the k highest logits
     top_p: Optional[float] = None,  # nucleus sampling mass (0, 1]
+    repetition_penalty: Optional[float] = None,  # >1 discourages repeats
 ) -> jnp.ndarray:
     """Autoregressive decoding: one jitted prefill forward (fills the KV
     cache in a single pass) + one jitted ``lax.scan`` over single-token
@@ -513,12 +530,24 @@ def generate(
         rng = jax.random.PRNGKey(0)
 
     cache, last_logits = _prefill(model, params, prompt_ids)
-    # temperature / top_p ride as traced scalars: changing them per call
-    # (per request, on a server) reuses the compiled decode program.
+    # temperature / top_p / repetition_penalty ride as traced scalars:
+    # changing them per call (per request, on a server) reuses the
+    # compiled decode program. The repetition presence bitmap [B, V] is
+    # seeded from the prompt; a [B, 1] dummy keeps the scan carry
+    # structure when the penalty is off.
+    b = prompt_ids.shape[0]
+    if repetition_penalty is not None:
+        seen0 = jnp.zeros((b, cfg.vocab_size), bool)
+        seen0 = seen0.at[jnp.arange(b)[:, None], prompt_ids].set(True)
+        rp = jnp.float32(repetition_penalty)
+    else:
+        seen0 = jnp.zeros((b, 1), bool)
+        rp = None
     new_tokens = _decode(
         model, params, cache, last_logits, rng,
         jnp.float32(temperature if temperature > 0 else 1.0),
         jnp.float32(top_p) if top_p is not None else None,
+        rp, seen0,
         max_new_tokens=max_new_tokens, greedy=temperature <= 0,
         eos_token_id=eos_token_id, s_prompt=s_prompt, top_k=top_k,
     )

@@ -340,3 +340,20 @@ def test_invalid_norm_and_ffn_rejected():
     model = CausalLM(CausalLMConfig(**{**TINY, "ffn": "relu"}))
     with pytest.raises(ValueError, match="ffn"):
         jax.jit(model.init)(make_rng(0), jnp.zeros((1, 4), jnp.int32))
+
+
+def test_repetition_penalty_blocks_repeats():
+    """A huge penalty makes greedy decoding avoid every already-seen
+    token: prompt + generated tokens are all distinct."""
+    model, params = _model_and_params(seed=8)
+    prompt = jnp.asarray([[11, 22, 33]], jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=6,
+                   repetition_penalty=1e9)
+    toks = np.asarray(out[0]).tolist()
+    assert len(set(toks)) == len(toks), f"repeats in {toks}"
+    # penalty=1.0 exercises the bitmap path as a no-op: must equal the
+    # penalty-free greedy decode exactly
+    a = generate(model, params, prompt, max_new_tokens=6)
+    b = generate(model, params, prompt, max_new_tokens=6,
+                 repetition_penalty=1.0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
