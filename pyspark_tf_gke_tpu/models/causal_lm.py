@@ -526,6 +526,11 @@ def generate(
             f"prompt {s_prompt} + {max_new_tokens} new tokens exceeds "
             f"max_seq_len {cfg.max_seq_len}"
         )
+    if repetition_penalty is not None and repetition_penalty <= 0:
+        # 0 would map seen logits to +inf/0 (deterministic repeat loop),
+        # negative sign-flips them — both silently corrupt decoding
+        raise ValueError(
+            f"repetition_penalty must be > 0, got {repetition_penalty}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
 

@@ -357,3 +357,10 @@ def test_repetition_penalty_blocks_repeats():
     b = generate(model, params, prompt, max_new_tokens=6,
                  repetition_penalty=1.0)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_repetition_penalty_validated():
+    model, params = _model_and_params()
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        generate(model, params, jnp.zeros((1, 3), jnp.int32),
+                 max_new_tokens=2, repetition_penalty=0.0)

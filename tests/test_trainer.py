@@ -327,3 +327,45 @@ def test_make_optimizer_rejects_ignored_knobs():
     # valid combos still build
     make_optimizer(1e-3, optimizer="adamw", weight_decay=0.01,
                    schedule="warmup_cosine", total_steps=10, warmup_steps=2)
+
+
+def test_average_checkpoints_tool(tmp_path, mesh_dp):
+    """tools/average_checkpoints: mean of the last K checkpoints' params,
+    restorable into a TrainState by the normal manager."""
+    from tools.average_checkpoints import average_checkpoints
+
+    X, y = synthetic_classification_arrays(n=96, num_classes=3)
+    model = MLPClassifier(num_classes=3)
+    trainer = Trainer(model, TASKS["classification"](), mesh_dp,
+                      learning_rate=1e-2)
+    it = BatchIterator({"x": X, "y": y}, 32, seed=0)
+    state = trainer.init_state(make_rng(0), next(iter(it)))
+
+    ckdir = str(tmp_path / "ck")
+    mgr = CheckpointManager(ckdir, max_to_keep=10)
+    snapshots = []
+    for _ in range(3):
+        state, _ = trainer.fit(state, it, epochs=1, steps_per_epoch=2)
+        mgr.save(state, force=True)
+        snapshots.append(jax.device_get(jax.tree.leaves(state.params)[0]))
+    mgr.close()
+
+    outdir = str(tmp_path / "avg")
+    step = average_checkpoints(ckdir, outdir, last=3)
+    assert step == int(jax.device_get(state.step))
+
+    restored = CheckpointManager(outdir).restore(state)
+    leaf = jax.device_get(jax.tree.leaves(restored.params)[0])
+    np.testing.assert_allclose(leaf, np.mean(snapshots, axis=0), rtol=1e-6)
+    # step/opt_state come from the newest checkpoint
+    assert int(jax.device_get(restored.step)) == step
+
+    with pytest.raises(ValueError, match="at least 2"):
+        onedir = str(tmp_path / "one")
+        m2 = CheckpointManager(onedir)
+        m2.save(state, force=True)
+        m2.close()
+        average_checkpoints(onedir, str(tmp_path / "avg2"), last=5)
+
+    with pytest.raises(ValueError, match="last"):
+        average_checkpoints(ckdir, str(tmp_path / "avg3"), last=0)
