@@ -39,6 +39,7 @@ a traceback.
 
 from __future__ import annotations
 
+import datetime
 import json
 import os
 import subprocess
@@ -46,6 +47,9 @@ import sys
 import time
 
 import numpy as np
+
+HISTORY_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "tools", "bench_history.jsonl")
 
 PROBE_ATTEMPTS = 4
 PROBE_TIMEOUT_S = 240
@@ -179,13 +183,16 @@ def main(batch_size: int = 32, steps: int = 100, throughput_batch: int = 128,
                 "image": jax.device_put(timages, sharding),
                 "target": jax.device_put(ttargets, sharding),
             }
+            tflops = step_flops(trainer, state, tbatch)
             _, _, tdt = measure(trainer, state, tbatch, throughput_steps)
+            tmfu = _mfu(tflops, tdt / throughput_steps, device_kind)
             tp = {
                 "max_throughput_images_per_sec_per_chip": round(
                     throughput_batch * throughput_steps / tdt / n_chips, 2),
                 "max_throughput_batch_size": throughput_batch,
                 "max_throughput_step_time_ms": round(
                     tdt / throughput_steps * 1000.0, 3),
+                "max_throughput_mfu": round(tmfu, 4) if tmfu is not None else None,
             }
         except Exception as exc:  # pragma: no cover - OOM safety on small chips
             log(f"throughput-batch measurement skipped: {exc!r}")
@@ -517,6 +524,34 @@ def _error_json(workload: str, stage: str, detail: str) -> dict:
     }
 
 
+def append_history(argv, result: dict) -> None:
+    """Append a successful measurement to the committed evidence trail.
+
+    Round 1 and round 2 both lost their perf evidence to tunnel outages
+    at capture time: numbers measured mid-round existed only as markdown
+    claims. Every successful run is therefore recorded verbatim — full
+    result JSON + UTC timestamp + argv — the moment it completes, into
+    ``tools/bench_history.jsonl`` (committed), so a later outage cannot
+    erase the fact that a measurement happened. README/PARITY cite these
+    entries by timestamp. ``--smoke`` runs (tiny-shape plumbing checks)
+    and explicit ``--no-history`` runs are not measurements and are not
+    recorded."""
+    if result.get("value") is None or "--smoke" in argv or "--no-history" in argv:
+        return
+    entry = {
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "argv": list(argv),
+        "result": result,
+    }
+    try:
+        with open(HISTORY_PATH, "a") as fh:
+            fh.write(json.dumps(entry) + "\n")
+        log(f"history: appended to {HISTORY_PATH}")
+    except OSError as exc:  # pragma: no cover - read-only checkouts
+        log(f"history append failed: {exc!r}")
+
+
 def probe_backend() -> bool:
     """Attach the backend in a throwaway subprocess (a failed/hung attach
     can't poison or wedge the orchestrator) with timeout + backoff."""
@@ -574,6 +609,10 @@ def orchestrate(argv) -> int:
              if ln.startswith("{")), None)
         if proc.returncode == 0 and line:
             print(line)
+            try:
+                append_history(argv, json.loads(line))
+            except ValueError as exc:
+                log(f"history: stdout line was not JSON, not recorded: {exc!r}")
             return 0
         last = f"rc={proc.returncode}: {proc.stderr.strip()[-800:]}"
         log(f"[run {attempt + 1}/{RUN_ATTEMPTS}] failed: {last}")
