@@ -168,8 +168,18 @@ class CausalSelfAttention(nn.Module):
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
-        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"))
-        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"))
+        # K/V carry only kv_heads here; with tp > kv_heads (e.g. MQA on a
+        # tp=2 mesh) a 'heads' constraint on that axis is non-divisible
+        # and the trace fails. Keep the constraint whenever the mesh's tp
+        # extent divides kv_heads (so divisible GQA, e.g. kv=4/tp=2,
+        # stays explicitly sharded through the cache write) and only
+        # drop it — re-constraining after the repeat below — when it
+        # cannot divide.
+        tp = self.mesh.shape.get("tp", 1) if self.mesh is not None else 1
+        kv_axes = ("batch", "seq", "heads" if hkv % tp == 0 else None,
+                   "head_dim")
+        k = nn.with_logical_constraint(k, kv_axes)
+        v = nn.with_logical_constraint(v, kv_axes)
 
         if decode:
             out = self._decode_attend(q, k, v)
@@ -185,6 +195,10 @@ class CausalSelfAttention(nn.Module):
                 # GQA memory win is in the cache, not the training pass.
                 k = jnp.repeat(k, h // hkv, axis=2)
                 v = jnp.repeat(v, h // hkv, axis=2)
+                k = nn.with_logical_constraint(
+                    k, ("batch", "seq", "heads", "head_dim"))
+                v = nn.with_logical_constraint(
+                    v, ("batch", "seq", "heads", "head_dim"))
             out = self._causal_attend(q, k, v, segment_ids=segment_ids)
         out = out.reshape(b, s, cfg.hidden_size)
         return _dense(cfg.hidden_size, ("mlp", "embed"), cfg, name="out")(out)
@@ -521,6 +535,11 @@ def generate(
     ``eos_token_id`` (if given) positions are padded with eos."""
     cfg = model.cfg
     _, s_prompt = prompt_ids.shape
+    if max_new_tokens < 1:
+        # the decode scan runs max_new_tokens - 1 steps and then emits one
+        # final token from the carried logits, so 0 would silently return
+        # 1 generated token (beam_search already validates this)
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if s_prompt + max_new_tokens > cfg.max_seq_len:
         raise ValueError(
             f"prompt {s_prompt} + {max_new_tokens} new tokens exceeds "

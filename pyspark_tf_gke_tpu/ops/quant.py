@@ -40,7 +40,8 @@ class QTensor:
     """int8 weight + per-output-channel float32 scale."""
 
     q: jnp.ndarray      # int8, same shape as the original kernel
-    scale: jnp.ndarray  # float32, shape = (kernel.shape[-1],)
+    scale: jnp.ndarray  # float32; (shape[-1],) for per-column kernels,
+    #                     (rows, 1) for per-row embedding tables
     dtype: Any          # original dtype, restored on dequantize
 
     def tree_flatten(self):
@@ -58,31 +59,52 @@ class QTensor:
         return (self.q.astype(jnp.float32) * self.scale).astype(self.dtype)
 
 
-def quantize_tensor(w: jnp.ndarray) -> QTensor:
-    """Symmetric per-last-axis-channel int8 quantization."""
+def is_embedding_path(path) -> bool:
+    """True when a pytree key path addresses an ``nn.Embed`` table
+    (param name ``embedding``) — the single definition shared by
+    quantize-time granularity choice, decode-time hoisting, and bundle
+    restore, so the three can't silently diverge."""
+    return any(getattr(k, "key", None) == "embedding" for k in path)
+
+
+def quantize_tensor(w: jnp.ndarray, axis: int = -1) -> QTensor:
+    """Symmetric per-channel int8 quantization. ``axis`` is the channel
+    axis that keeps one scale per slice (reduced over all others):
+    ``-1`` = per-output-column (dense kernels), ``0`` = per-row
+    (embedding tables — each gathered row quantized independently, so a
+    single outlier row cannot coarsen every other token's embedding)."""
     wf = jnp.asarray(w, jnp.float32)
-    amax = jnp.max(jnp.abs(wf), axis=tuple(range(wf.ndim - 1)), keepdims=True)
+    axis = axis % wf.ndim
+    reduce_axes = tuple(a for a in range(wf.ndim) if a != axis)
+    amax = jnp.max(jnp.abs(wf), axis=reduce_axes, keepdims=True)
     scale = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
-    return QTensor(q, scale.reshape(-1), jnp.asarray(w).dtype)
+    # keep the historical flat (C,) shape for the last-axis case; per-row
+    # scales stay keepdims-shaped so dequantize broadcasts over columns
+    if axis == wf.ndim - 1:
+        scale = scale.reshape(-1)
+    return QTensor(q, scale, jnp.asarray(w).dtype)
 
 
 def quantize_tree(params, min_size: int = 4096):
-    """Quantize every 2-D kernel with >= min_size elements; leave
-    embeddings out is the caller's choice of min_size/structure — here
-    any 2-D leaf qualifies, which for the transformer stack means the
-    dense kernels AND the embedding tables; embedding rows are gathered,
-    not streamed, so quantizing them costs nothing at decode and saves
-    checkpoint/HBM bytes too."""
+    """Quantize every 2-D kernel with >= min_size elements, which for
+    the transformer stack means the dense kernels AND the embedding
+    tables; embedding rows are gathered, not streamed, so quantizing
+    them costs nothing at decode and saves checkpoint/HBM bytes too.
+    Dense kernels get per-output-column scales (the matmul-operand
+    granularity); ``nn.Embed`` tables (param name ``embedding``) get
+    per-row scales — a per-column scale there would be computed over the
+    entire vocabulary, letting one outlier row coarsen every token."""
 
-    def maybe_q(leaf):
+    def maybe_q(path, leaf):
         arr = jnp.asarray(leaf)
         if arr.ndim == 2 and arr.size >= min_size and jnp.issubdtype(
                 arr.dtype, jnp.floating):
-            return quantize_tensor(arr)
+            return quantize_tensor(
+                arr, axis=0 if is_embedding_path(path) else -1)
         return leaf
 
-    return jax.tree.map(maybe_q, params)
+    return jax.tree_util.tree_map_with_path(maybe_q, params)
 
 
 def dequantize_tree(params):
@@ -101,8 +123,7 @@ def dequantize_embeddings(params):
     matmul weights."""
 
     def fix(path, leaf):
-        if isinstance(leaf, QTensor) and any(
-                getattr(k, "key", None) == "embedding" for k in path):
+        if isinstance(leaf, QTensor) and is_embedding_path(path):
             return leaf.dequantize()
         return leaf
 

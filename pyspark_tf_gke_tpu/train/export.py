@@ -25,6 +25,7 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import orbax.checkpoint as ocp
 
 from pyspark_tf_gke_tpu.models.causal_lm import CausalLM, CausalLMConfig
@@ -103,7 +104,10 @@ def load_serving_bundle(bundle_dir: str) -> Tuple[CausalLM, Any, dict]:
     # quantize exactly the leaves the bundle recorded as QTensors.
     from flax import linen as nn
 
-    from pyspark_tf_gke_tpu.ops.quant import quantize_tensor
+    from pyspark_tf_gke_tpu.ops.quant import (
+        is_embedding_path,
+        quantize_tensor,
+    )
 
     sample = jnp.zeros((1, 8), jnp.int32)
     abstract = jax.eval_shape(
@@ -112,16 +116,32 @@ def load_serving_bundle(bundle_dir: str) -> Tuple[CausalLM, Any, dict]:
     if qpaths:
         def requantize(path, leaf):
             if jax.tree_util.keystr(path) in qpaths:
-                return jax.eval_shape(quantize_tensor, leaf)
+                # mirror quantize_tree's granularity choice so the
+                # abstract scale SHAPES match the checkpoint ((rows, 1)
+                # for embedding tables, (cols,) for kernels) — orbax
+                # versions that validate the abstract would otherwise
+                # reject the restore
+                axis = 0 if is_embedding_path(path) else -1
+                return jax.eval_shape(
+                    lambda l: quantize_tensor(l, axis=axis), leaf)
             return leaf
 
         abstract = jax.tree_util.tree_map_with_path(requantize, abstract)
     elif meta.get("quantized"):
-        # Back-compat: bundles written before quantized_paths recorded
-        # only the export-side min_size threshold.
+        # Back-compat: bundles written before quantized_paths were
+        # recorded carry only the export-side min_size threshold — and
+        # predate per-row embedding scales, so every recorded scale is
+        # the legacy per-column (cols,) shape.
         min_size = int(meta.get("quantize_min_size", 4096))
-        abstract = jax.eval_shape(
-            lambda p: quantize_tree(p, min_size=min_size), abstract)
+
+        def legacy_q(leaf):
+            if (len(leaf.shape) == 2
+                    and int(np.prod(leaf.shape)) >= min_size
+                    and jnp.issubdtype(leaf.dtype, jnp.floating)):
+                return jax.eval_shape(quantize_tensor, leaf)
+            return leaf
+
+        abstract = jax.tree.map(legacy_q, abstract)
 
     ckptr = ocp.StandardCheckpointer()
     params = ckptr.restore(os.path.join(os.path.abspath(bundle_dir), "params"),

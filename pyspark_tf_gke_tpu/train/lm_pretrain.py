@@ -235,11 +235,24 @@ def main(argv=None) -> dict:
 
         from pyspark_tf_gke_tpu.utils.fs import fs_glob
 
-        if not fs_glob(args.eval_pattern):
+        eval_files = fs_glob(args.eval_pattern)
+        if not eval_files:
             # Fail a typo'd eval path at startup, not at the end of
             # epoch 1 (where run_with_recovery would retry it).
             raise SystemExit(f"--eval-pattern matches no files: "
                              f"{args.eval_pattern!r}")
+        if jax.process_count() > 1 and len(eval_files) % jax.process_count():
+            # SPMD eval steps are collective: a host whose round-robin
+            # stripe holds fewer eval files than its peers would skip
+            # collective steps the others run — a silent desync/hang.
+            # Every host sees the same glob, so this check fires (and
+            # exits) consistently everywhere.
+            raise SystemExit(
+                f"--eval-pattern matched {len(eval_files)} files, which "
+                f"does not divide evenly across {jax.process_count()} "
+                f"hosts; uneven per-host eval file counts desynchronize "
+                f"collective eval steps. Repack the eval set so every "
+                f"host gets the same number of files.")
 
         def val_batches():
             # Fresh deterministic pass each epoch, capped at --eval-batches

@@ -55,7 +55,12 @@ def serving_shardings(model, params, mesh: Mesh, rules=LOGICAL_RULES):
     def align(leaf, sh):
         if isinstance(leaf, QTensor):
             spec = sh.spec
-            scale_spec = P(spec[-1]) if len(spec) else P()
+            if jnp.asarray(leaf.scale).ndim == 2:
+                # per-row embedding scale, shape (rows, 1): follow the
+                # kernel's row axis, replicate the singleton column
+                scale_spec = P(spec[0], None) if len(spec) else P()
+            else:
+                scale_spec = P(spec[-1]) if len(spec) else P()
             # aux (dtype) must match the param leaf's so the sharding
             # tree's treedef lines up for device_put
             return QTensor(sh, NamedSharding(mesh, scale_spec), leaf.dtype)

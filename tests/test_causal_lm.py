@@ -364,3 +364,14 @@ def test_repetition_penalty_validated():
     with pytest.raises(ValueError, match="repetition_penalty"):
         generate(model, params, jnp.zeros((1, 3), jnp.int32),
                  max_new_tokens=2, repetition_penalty=0.0)
+
+
+def test_generate_rejects_nonpositive_max_new_tokens():
+    """The decode scan runs max_new_tokens-1 steps then emits one final
+    token, so 0 would silently return 1 token — reject it instead
+    (beam_search already does)."""
+    model, params = _model_and_params()
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    for bad in (0, -3):
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            generate(model, params, prompt, max_new_tokens=bad)

@@ -55,17 +55,16 @@ def test_quantized_bundle_smaller_and_serves(tmp_path):
     export_serving_bundle(cfg, params, quant_dir, quantize=True,
                           quantize_min_size=64)
 
-    def tree_size(d):
-        total = 0
-        for root, _, files in os.walk(d):
-            total += sum(os.path.getsize(os.path.join(root, f)) for f in files)
-        return total
-
-    # tiny test model: small 1-D leaves + orbax metadata dilute the 4x
-    # kernel shrink; on real models the kernels dominate
-    assert tree_size(quant_dir) < 0.75 * tree_size(dense_dir)
-
     model2, params2, meta = load_serving_bundle(quant_dir)
+    # Compare the parameter payloads, not os.walk byte totals of the
+    # orbax directories — ocdbt file sizes vary run to run (metadata,
+    # chunk packing), which made the directory-size assertion flaky.
+    # Tiny test model: small 1-D leaves dilute the 4x kernel shrink;
+    # on real models the kernels dominate.
+    from pyspark_tf_gke_tpu.ops.quant import tree_bytes
+
+    _, dense_params, _ = load_serving_bundle(dense_dir)
+    assert tree_bytes(params2) < 0.75 * tree_bytes(dense_params)
     assert meta["quantized"] is True
     assert is_quantized(params2)
     head = params2["lm_head"]["kernel"]

@@ -115,3 +115,31 @@ def test_bench_decode_int8_smoke():
     assert res["int8_weights"] is True
     assert res["value"] > 0
     assert res["params_mb"] > 0
+
+
+def test_embedding_tables_quantized_per_row():
+    """Embedding tables get one scale per ROW (gathered unit): a single
+    outlier row must not coarsen every other token's embedding, which is
+    exactly what per-column scales (computed over the whole vocabulary)
+    would do."""
+    rng = np.random.default_rng(0)
+    table = rng.normal(scale=0.02, size=(64, 32)).astype(np.float32)
+    table[7] *= 1000.0  # one outlier token
+    params = {"wte": {"embedding": jnp.asarray(table)},
+              "dense": {"kernel": jnp.asarray(
+                  rng.normal(size=(64, 32)).astype(np.float32))}}
+    q = quantize_tree(params, min_size=64)
+
+    emb = q["wte"]["embedding"]
+    assert isinstance(emb, QTensor)
+    assert emb.scale.shape == (64, 1)               # per-row
+    assert q["dense"]["kernel"].scale.shape == (32,)  # per-column (unchanged)
+
+    deq = np.asarray(emb.dequantize())
+    normal_rows = np.delete(np.arange(64), 7)
+    err = np.abs(deq[normal_rows] - table[normal_rows]).max()
+    # per-row: normal rows keep their own tiny scale (~0.02*k/127).
+    # Per-column scales would be ~20/127 ≈ 0.16 — orders worse.
+    assert err < 5e-3
+    # the outlier row itself roundtrips within its own scale
+    assert np.abs(deq[7] - table[7]).max() <= float(emb.scale[7, 0]) / 2 + 1e-6

@@ -79,3 +79,28 @@ def test_sharded_generate_with_int8(devices):
     toks = np.asarray(out)
     assert toks.shape == (2, 10)
     assert ((toks >= 0) & (toks < 96)).all()
+
+
+def test_mqa_serving_on_tp_wider_than_kv_heads(devices):
+    """MQA (num_kv_heads=1) on a tp=2 mesh: the K/V activations carry
+    fewer heads than tp, so a 'heads' sharding constraint on that axis
+    would be non-divisible and fail the trace. The model constrains K/V
+    only after the repeat to full heads; both the training forward and
+    the serving path must trace and run."""
+    cfg = CausalLMConfig(**{**CFG, "num_kv_heads": 1})
+    mesh = make_mesh({"tp": 2}, devices[:2])
+    model = CausalLM(cfg, mesh=mesh)
+    ids = jnp.zeros((2, 8), jnp.int32)
+    params = nn.meta.unbox(jax.jit(model.init)(make_rng(3), ids)["params"])
+
+    placed = shard_params_for_serving(model, params, mesh)
+    with mesh:
+        logits = jax.jit(lambda p, i: model.apply({"params": p}, i))(
+            placed, ids)
+    assert np.asarray(logits).shape == (2, 8, CFG["vocab_size"])
+
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(
+        rng.integers(0, CFG["vocab_size"], (2, 5)).astype(np.int32))
+    out = serve_generate(model, placed, prompt, mesh=mesh, max_new_tokens=4)
+    assert np.asarray(out).shape == (2, 9)
