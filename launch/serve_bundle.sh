@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Serving launcher: deploy an exported bundle behind the tpu-serve
+# Service and smoke-check it over the wire. Closes the loop the
+# reference left manual (its terminal artifact was consumed by a human
+# running workloads/raw-tf/test-model.py); here the artifact deploys and
+# a remote eval drives it (evaluate/lm_eval.py --endpoint).
+#
+# Usage (from the bastion):
+#   PROJECT_ID=my-proj BUNDLE_DIR=gs://my-proj-datasets/runs/lm/serving-bundle \
+#     ./serve_bundle.sh
+set -euo pipefail
+
+BUNDLE_DIR="${BUNDLE_DIR:-gs://${PROJECT_ID:?set PROJECT_ID}-datasets/runs/lm/serving-bundle}"
+SERVE_TP="${SERVE_TP:-4}"
+MANIFEST="$(dirname "$0")/../infra/k8s/tpu/tpu-serve.yaml"
+
+echo "Deploying serving bundle ${BUNDLE_DIR} (tp=${SERVE_TP})"
+
+sed -e "s|\${PROJECT_ID}|${PROJECT_ID}|g" \
+    -e "s|\${REGISTRY}|${REGISTRY:-gcr.io/${PROJECT_ID}}|g" \
+    "${MANIFEST}" | kubectl apply -f -
+
+kubectl set env deployment/tpu-serve \
+  BUNDLE_DIR="${BUNDLE_DIR}" SERVE_TP="${SERVE_TP}"
+
+echo "Waiting for rollout (startup probe covers the bundle pull)..."
+kubectl rollout status deployment/tpu-serve --timeout=900s
+
+echo "Health:"
+kubectl run tpu-serve-check --rm -i --restart=Never \
+  --image=curlimages/curl:8.7.1 -- \
+  curl -sS http://tpu-serve:8000/healthz
+
+cat <<'EON'
+
+Endpoint is up. From any pod in the cluster:
+  curl -s http://tpu-serve:8000/v1/generate \
+    -d '{"prompts": ["the tpu"], "max_new_tokens": 32}'
+Remote eval (perplexity + samples) from the bastion:
+  python -m pyspark_tf_gke_tpu.evaluate.lm_eval \
+    --endpoint http://tpu-serve:8000 \
+    --data-pattern 'gs://<project>-datasets/corpus/heldout/*.txt' \
+    --prompt "the tpu"
+EON

@@ -44,8 +44,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     e = os.environ.get
     p = argparse.ArgumentParser(
         description="Evaluate an exported causal-LM serving bundle")
-    p.add_argument("--bundle", required=True,
+    p.add_argument("--bundle",
                    help="directory written by train/export.py")
+    p.add_argument("--endpoint", default=e("SERVE_ENDPOINT", ""),
+                   help="URL of a running train/serve.py deployment "
+                        "(e.g. http://tpu-serve:8000) — evaluates over "
+                        "the wire instead of loading the bundle locally")
     p.add_argument("--data-pattern", default=e("DATA_PATTERN", ""),
                    help="glob of held-out text files for perplexity")
     p.add_argument("--batches", type=int, default=int(e("EVAL_BATCHES", "16")))
@@ -105,8 +109,74 @@ def bundle_perplexity(model, params, tokenizer, pattern: str, seq_len: int,
     }
 
 
+def endpoint_eval(args) -> dict:
+    """Remote evaluation against a deployed ``train/serve.py`` endpoint:
+    perplexity from ``/v1/score`` over whole documents (the server
+    tokenizes and truncates at its max_seq_len — unlike local mode's
+    eos-packed fixed-length rows, so the two modes agree in trend, not
+    digit-for-digit), samples from ``/v1/generate``."""
+    import urllib.request
+
+    from pyspark_tf_gke_tpu.data.text import iter_documents
+
+    def post(path: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            args.endpoint.rstrip("/") + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read())
+
+    result = {"endpoint": args.endpoint}
+    if args.data_pattern:
+        total_nll, total_tok = 0.0, 0
+        batch: list = []
+
+        def flush(batch):
+            nonlocal total_nll, total_tok
+            for s in post("/v1/score", {"texts": batch})["scores"]:
+                total_nll += s["nll"]
+                total_tok += s["tokens"]
+
+        n_batches = 0
+        for doc in iter_documents(args.data_pattern):
+            batch.append(doc)
+            if len(batch) == args.batch_size:
+                flush(batch)
+                batch = []
+                n_batches += 1
+                if n_batches >= args.batches:
+                    break
+        if batch and n_batches < args.batches:
+            flush(batch)
+        if total_tok == 0:
+            raise ValueError(f"no scoreable documents from "
+                             f"{args.data_pattern!r}")
+        mean_nll = total_nll / total_tok
+        result.update({"perplexity": float(np.exp(min(mean_nll, 30.0))),
+                       "mean_nll": mean_nll, "tokens": total_tok})
+    if args.prompt:
+        out = post("/v1/generate", {
+            "prompts": args.prompt,
+            "max_new_tokens": args.max_new_tokens,
+            "temperature": args.temperature,
+            "top_p": args.top_p,
+            "num_beams": args.num_beams if args.num_beams > 1 else 0,
+            "repetition_penalty": args.repetition_penalty,
+        })["completions"]
+        result["samples"] = out
+        for s in out:
+            logger.info("sample: %r -> %r", s["prompt"], s["completion"])
+    print(json.dumps(result))
+    return result
+
+
 def main(argv=None) -> dict:
     args = parse_args(argv)
+    if bool(args.bundle) == bool(args.endpoint):
+        raise SystemExit("exactly one of --bundle or --endpoint is required")
+    if args.endpoint:
+        return endpoint_eval(args)
     model, params, meta = load_serving_bundle(args.bundle)
     tokenizer = get_tokenizer(meta.get("tokenizer", "byte"))
     if tokenizer.vocab_size > model.cfg.vocab_size:

@@ -45,6 +45,20 @@ def _qtensor_paths(params) -> list:
                   if isinstance(leaf, QTensor))
 
 
+def _qtensor_scale_shapes(params) -> dict:
+    """keystr path → scale shape for every QTensor leaf. Recorded in the
+    bundle so the loader rebuilds the exact abstract (per-column kernels
+    carry ``(cols,)`` scales, per-row embedding tables ``(rows, 1)``,
+    caller-quantized trees whatever the caller chose) without guessing
+    from the path."""
+    from pyspark_tf_gke_tpu.ops.quant import QTensor
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda l: isinstance(l, QTensor))
+    return {jax.tree_util.keystr(path): list(leaf.scale.shape)
+            for path, leaf in flat if isinstance(leaf, QTensor)}
+
+
 def export_serving_bundle(
     cfg: CausalLMConfig,
     params: Any,
@@ -69,6 +83,7 @@ def export_serving_bundle(
         # the same pytree no matter how the tree was quantized (caller-
         # quantized trees included — a min_size alone couldn't say).
         "quantized_paths": _qtensor_paths(params),
+        "quantized_scale_shapes": _qtensor_scale_shapes(params),
         "tokenizer": tokenizer_spec,
         "config": cfg_dict,
     }
@@ -104,26 +119,32 @@ def load_serving_bundle(bundle_dir: str) -> Tuple[CausalLM, Any, dict]:
     # quantize exactly the leaves the bundle recorded as QTensors.
     from flax import linen as nn
 
-    from pyspark_tf_gke_tpu.ops.quant import (
-        is_embedding_path,
-        quantize_tensor,
-    )
+    from pyspark_tf_gke_tpu.ops.quant import quantize_tensor
 
     sample = jnp.zeros((1, 8), jnp.int32)
     abstract = jax.eval_shape(
         lambda: nn.meta.unbox(model.init(jax.random.PRNGKey(0), sample)["params"]))
     qpaths = set(meta.get("quantized_paths", []))
     if qpaths:
+        from pyspark_tf_gke_tpu.ops.quant import QTensor
+
+        scale_shapes = meta.get("quantized_scale_shapes", {})
+
         def requantize(path, leaf):
-            if jax.tree_util.keystr(path) in qpaths:
-                # mirror quantize_tree's granularity choice so the
-                # abstract scale SHAPES match the checkpoint ((rows, 1)
-                # for embedding tables, (cols,) for kernels) — orbax
-                # versions that validate the abstract would otherwise
-                # reject the restore
-                axis = 0 if is_embedding_path(path) else -1
-                return jax.eval_shape(
-                    lambda l: quantize_tensor(l, axis=axis), leaf)
+            key = jax.tree_util.keystr(path)
+            if key in qpaths:
+                if key in scale_shapes:
+                    # the bundle records each scale's exact shape —
+                    # rebuild the abstract from it so orbax validation
+                    # matches whatever granularity the export used
+                    return QTensor(
+                        jax.ShapeDtypeStruct(leaf.shape, jnp.int8),
+                        jax.ShapeDtypeStruct(
+                            tuple(scale_shapes[key]), jnp.float32),
+                        leaf.dtype)
+                # bundles from before scale shapes were recorded are
+                # uniformly per-column (quantize_tensor's legacy default)
+                return jax.eval_shape(quantize_tensor, leaf)
             return leaf
 
         abstract = jax.tree_util.tree_map_with_path(requantize, abstract)

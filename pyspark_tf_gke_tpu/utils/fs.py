@@ -64,6 +64,24 @@ def _default_spool_dir() -> str:
     return d
 
 
+def fs_copy_tree(url: str, local_dir: str) -> str:
+    """Recursively copy a remote directory tree (e.g. a ``gs://``
+    serving bundle) into ``local_dir``. orbax restores from a directory
+    tree, so serving pulls the whole bundle once rather than streaming
+    per-file."""
+    if not is_remote(url):
+        raise ValueError(f"fs_copy_tree expects a remote URL, got {url!r}")
+    import fsspec
+
+    fs, _, (root,) = fsspec.get_fs_token_paths(url.rstrip("/"))
+    os.makedirs(local_dir, exist_ok=True)
+    # trailing separators make get() copy root's CONTENTS into local_dir
+    # (async-batched on gcsfs) rather than nesting a basename dir
+    fs.get(root.rstrip("/") + "/", local_dir.rstrip("/") + "/",
+           recursive=True)
+    return local_dir
+
+
 def spool_local(path: str, spool_dir: Optional[str] = None) -> str:
     """Return a local path for ``path``, staging remote objects into a
     spool file (re-used across calls within the spool dir). The cache

@@ -157,3 +157,32 @@ def test_caller_prequantized_bundle_roundtrip(tmp_path):
            jax.tree_util.tree_flatten_with_path(
                params2, is_leaf=lambda l: isinstance(l, QTensor))[0]]
     assert [t for _, t in ref] == [t for _, t in got]
+
+
+def test_legacy_bundle_without_scale_shapes_restores(tmp_path):
+    """Bundles written before quantized_scale_shapes was recorded carry
+    uniformly per-column scales; the loader's fallback abstract must
+    match them exactly."""
+    from pyspark_tf_gke_tpu.ops.quant import quantize_tensor
+
+    cfg, model, params = _model_and_params(seed=4)
+    # per-column everywhere = what old exports stored
+    legacy = jax.tree_util.tree_map(
+        lambda l: quantize_tensor(l) if l.ndim == 2 and l.size >= 64 else l,
+        params)
+    bundle = str(tmp_path / "legacy")
+    export_serving_bundle(cfg, legacy, bundle)
+
+    meta_path = os.path.join(bundle, "config.json")
+    meta = json.load(open(meta_path))
+    assert meta.pop("quantized_scale_shapes")  # simulate the old format
+    json.dump(meta, open(meta_path, "w"))
+
+    model2, params2, meta2 = load_serving_bundle(bundle)
+    assert meta2["quantized"] is True
+    head = params2["lm_head"]["kernel"]
+    assert isinstance(head, QTensor)
+    assert head.scale.shape == (97,)  # per-column, as stored
+    out = generate(model2, params2, jnp.zeros((1, 4), jnp.int32),
+                   max_new_tokens=3)
+    assert np.asarray(out).shape == (1, 7)

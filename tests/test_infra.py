@@ -80,3 +80,22 @@ def test_launch_scripts_are_valid_bash():
     for script in glob.glob(os.path.join(ROOT, "launch/*.sh")):
         subprocess.run(["bash", "-n", script], check=True)
         assert os.stat(script).st_mode & stat.S_IXUSR or True  # syntax is the gate
+
+
+def test_tpu_serve_manifest_conventions():
+    """The serving Deployment must run the serve CLI, probe /healthz on
+    the served port, and claim the slice's TPU resources."""
+    docs = _load("infra/k8s/tpu/tpu-serve.yaml")
+    svc = next(d for d in docs if d["kind"] == "Service")
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    port = svc["spec"]["ports"][0]["port"]
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"][-1] == "pyspark_tf_gke_tpu.train.serve"
+    assert c["ports"][0]["containerPort"] == port
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["SERVE_PORT"] == str(port)
+    assert env["BUNDLE_DIR"].startswith("gs://")
+    for probe in ("startupProbe", "readinessProbe", "livenessProbe"):
+        assert c[probe]["httpGet"]["path"] == "/healthz"
+        assert c[probe]["httpGet"]["port"] == port
+    assert c["resources"]["requests"]["google.com/tpu"] == "4"

@@ -1,0 +1,145 @@
+"""Serving deployment surface e2e: export bundle → HTTP server →
+generate/score over the wire (train/serve.py), incl. the remote
+lm_eval mode (evaluate/lm_eval.py --endpoint)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
+from pyspark_tf_gke_tpu.train.export import export_serving_bundle
+from pyspark_tf_gke_tpu.train.serve import BundleServer, start_http_server
+from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+# vocab must cover the byte tokenizer (259) the bundle records by default
+CFG = dict(vocab_size=259, hidden_size=32, num_layers=2, num_heads=2,
+           intermediate_size=64, max_seq_len=64, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def endpoint(tmp_path_factory):
+    cfg = CausalLMConfig(**CFG)
+    model = CausalLM(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = nn.meta.unbox(jax.jit(model.init)(make_rng(0), ids)["params"])
+    bundle = str(tmp_path_factory.mktemp("serve") / "bundle")
+    export_serving_bundle(cfg, params, bundle, quantize=True,
+                          quantize_min_size=64)
+
+    server = BundleServer(bundle)
+    httpd = start_http_server(server, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield url
+    httpd.shutdown()
+
+
+def _post(url, path, payload):
+    req = urllib.request.Request(url + path, data=json.dumps(payload).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def test_healthz(endpoint):
+    with urllib.request.urlopen(endpoint + "/healthz") as resp:
+        health = json.loads(resp.read())
+    assert health["status"] == "ok"
+    assert health["quantized"] is True
+    assert health["vocab_size"] == 259
+    assert health["max_seq_len"] == 64
+
+
+def test_generate_over_the_wire_batches_mixed_lengths(endpoint):
+    """Prompts of different token lengths group into separate decode
+    batches but return in request order, each extended by new tokens."""
+    prompts = ["hello", "ab", "world", "xy"]  # lengths 5, 2, 5, 2
+    out = _post(endpoint, "/v1/generate",
+                {"prompts": prompts, "max_new_tokens": 6})["completions"]
+    assert [o["prompt"] for o in out] == prompts
+    for o in out:
+        assert o["completion"].startswith(o["prompt"])
+        assert 0 < o["new_tokens"] <= 6
+        assert o["latency_ms"] > 0
+
+
+def test_generate_single_prompt_and_beams(endpoint):
+    out = _post(endpoint, "/v1/generate",
+                {"prompt": "abc", "max_new_tokens": 4,
+                 "num_beams": 2})["completions"]
+    assert len(out) == 1
+    assert "beam_score" in out[0]
+
+
+def test_score_over_the_wire(endpoint):
+    # "z" is a 1-token text: no next-token NLL exists — it must come
+    # back skipped without failing the rest of the batch (remote
+    # perplexity eval feeds arbitrary documents)
+    texts = ["hello world", "z", "zq"]
+    scores = _post(endpoint, "/v1/score", {"texts": texts})["scores"]
+    assert len(scores) == 3
+    assert scores[1] == {"nll": 0.0, "tokens": 0, "truncated": False,
+                         "skipped": True}
+    for s, t in ((scores[0], texts[0]), (scores[2], texts[2])):
+        assert s["tokens"] == len(t.encode()) - 1
+        assert s["nll"] > 0 and np.isfinite(s["nll"])
+        assert s["truncated"] is False
+
+
+def test_http_errors(endpoint):
+    # malformed body → 400
+    req = urllib.request.Request(endpoint + "/v1/generate", data=b"{nope",
+                                 headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req)
+    assert e.value.code == 400
+    # over-long prompt → 400 with the explanation
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(endpoint, "/v1/generate",
+              {"prompts": ["x" * 100], "max_new_tokens": 10})
+    assert e.value.code == 400
+    assert "max_seq_len" in json.loads(e.value.read())["error"]
+    # unknown route → 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(endpoint, "/v1/nope", {})
+    assert e.value.code == 404
+
+
+def test_lm_eval_endpoint_mode(endpoint, tmp_path, capsys):
+    """The full loop the k8s deployment enables: a client evaluates a
+    DEPLOYED model over the wire — no jax/bundle on the client path."""
+    corpus = tmp_path / "heldout"
+    corpus.mkdir()
+    rng = np.random.default_rng(0)
+    (corpus / "h.txt").write_text(
+        "\n\n".join("".join(chr(rng.integers(97, 123)) for _ in range(20))
+                    for _ in range(8)))
+
+    from pyspark_tf_gke_tpu.evaluate.lm_eval import main
+
+    res = main([
+        "--endpoint", endpoint,
+        "--data-pattern", str(corpus / "*.txt"),
+        "--batches", "2", "--batch-size", "4",
+        "--prompt", "ab", "--max-new-tokens", "4",
+    ])
+    assert res["perplexity"] > 1.0
+    assert res["tokens"] > 0
+    assert len(res["samples"]) == 1
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["perplexity"] == res["perplexity"]
+
+
+def test_lm_eval_requires_exactly_one_source():
+    from pyspark_tf_gke_tpu.evaluate.lm_eval import main
+
+    with pytest.raises(SystemExit):
+        main(["--data-pattern", "x*.txt"])  # neither bundle nor endpoint
