@@ -64,7 +64,14 @@ class KMeansSparkWorkload:
 
         pipeline_model = Pipeline(stages=stages).fit(input_df)
         dataset = pipeline_model.transform(input_df).select("features")
-        model = KMeans().setK(25).setSeed(1).setMaxIter(1000).fit(dataset)
+        # k=25/seed=1/maxIter=1000 are the reference's constants
+        # (k_means.py:83); KMEANS_K is env-overridable the same way
+        # MEASURE_NAME_WEIGHT is so small fixtures can cluster too
+        try:
+            k = int(os.environ.get("KMEANS_K", "25"))
+        except ValueError:
+            k = 25
+        model = KMeans().setK(max(2, k)).setSeed(1).setMaxIter(1000).fit(dataset)
         type(self).pipeline_model = pipeline_model
         type(self).kmeans_model = model
         return pipeline_model, model

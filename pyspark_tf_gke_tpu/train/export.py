@@ -126,28 +126,37 @@ def load_serving_bundle(bundle_dir: str) -> Tuple[CausalLM, Any, dict]:
         lambda: nn.meta.unbox(model.init(jax.random.PRNGKey(0), sample)["params"]))
     qpaths = set(meta.get("quantized_paths", []))
     if qpaths:
-        from pyspark_tf_gke_tpu.ops.quant import QTensor
+        from pyspark_tf_gke_tpu.ops.quant import QTensor, is_embedding_path
 
         scale_shapes = meta.get("quantized_scale_shapes", {})
 
-        def requantize(path, leaf):
+        def requantize_with(path, leaf, embed_axis0: bool):
             key = jax.tree_util.keystr(path)
-            if key in qpaths:
-                if key in scale_shapes:
-                    # the bundle records each scale's exact shape —
-                    # rebuild the abstract from it so orbax validation
-                    # matches whatever granularity the export used
-                    return QTensor(
-                        jax.ShapeDtypeStruct(leaf.shape, jnp.int8),
-                        jax.ShapeDtypeStruct(
-                            tuple(scale_shapes[key]), jnp.float32),
-                        leaf.dtype)
-                # bundles from before scale shapes were recorded are
-                # uniformly per-column (quantize_tensor's legacy default)
-                return jax.eval_shape(quantize_tensor, leaf)
-            return leaf
+            if key not in qpaths:
+                return leaf
+            if key in scale_shapes:
+                # the bundle records each scale's exact shape — rebuild
+                # the abstract from it so orbax validation matches
+                # whatever granularity the export used
+                return QTensor(
+                    jax.ShapeDtypeStruct(leaf.shape, jnp.int8),
+                    jax.ShapeDtypeStruct(
+                        tuple(scale_shapes[key]), jnp.float32),
+                    leaf.dtype)
+            # Bundles from before scale shapes were recorded: most are
+            # uniformly per-column, but a brief window quantized
+            # embedding tables per-row — build_abstract covers both and
+            # the loader below retries with the other interpretation.
+            axis = 0 if (embed_axis0 and is_embedding_path(path)) else -1
+            return jax.eval_shape(lambda l: quantize_tensor(l, axis=axis),
+                                  leaf)
 
-        abstract = jax.tree_util.tree_map_with_path(requantize, abstract)
+        def build_abstract(embed_axis0: bool):
+            return jax.tree_util.tree_map_with_path(
+                lambda p, l: requantize_with(p, l, embed_axis0), abstract)
+
+        abstract_candidates = ([build_abstract(False)] if scale_shapes else
+                               [build_abstract(False), build_abstract(True)])
     elif meta.get("quantized"):
         # Back-compat: bundles written before quantized_paths were
         # recorded carry only the export-side min_size threshold — and
@@ -162,10 +171,20 @@ def load_serving_bundle(bundle_dir: str) -> Tuple[CausalLM, Any, dict]:
                 return jax.eval_shape(quantize_tensor, leaf)
             return leaf
 
-        abstract = jax.tree.map(legacy_q, abstract)
+        abstract_candidates = [jax.tree.map(legacy_q, abstract)]
+    else:
+        abstract_candidates = [abstract]
 
     ckptr = ocp.StandardCheckpointer()
-    params = ckptr.restore(os.path.join(os.path.abspath(bundle_dir), "params"),
-                           abstract)
-    ckptr.close()
+    try:
+        params_path = os.path.join(os.path.abspath(bundle_dir), "params")
+        for i, candidate in enumerate(abstract_candidates):
+            try:
+                params = ckptr.restore(params_path, candidate)
+                break
+            except Exception:  # orbax shape-validation mismatch
+                if i == len(abstract_candidates) - 1:
+                    raise
+    finally:
+        ckptr.close()
     return model, params, meta

@@ -113,8 +113,12 @@ class BundleServer:
                  temperature: float = 0.0, top_k=None, top_p=None,
                  num_beams: int = 0, repetition_penalty=None) -> list:
         """Batch completion. Prompts are grouped by token length so each
-        group decodes as one batched call; results return in input
-        order."""
+        group decodes as one batched call; the batch dimension pads up
+        to power-of-2 buckets (repeating the first row) so mixed traffic
+        reuses a handful of compiled shapes instead of recompiling per
+        group size; results return in input order. Sampling requests get
+        a fresh per-request PRNG key — a fixed seed would hand every
+        client the same 'random' completion."""
         from pyspark_tf_gke_tpu.models.causal_lm import generate
         from pyspark_tf_gke_tpu.train.serving import serve_generate
 
@@ -123,6 +127,9 @@ class BundleServer:
         if len(prompts) > MAX_BATCH:
             raise ValueError(f"batch of {len(prompts)} exceeds "
                              f"max batch {MAX_BATCH}")
+        rng = (jax.random.PRNGKey(
+            int.from_bytes(os.urandom(4), "little"))
+            if temperature and temperature > 0 else None)
         cfg = self.model.cfg
         eos_id = getattr(self.tokenizer, "eos_id", None)
         encoded = []
@@ -143,7 +150,11 @@ class BundleServer:
         results = [None] * len(prompts)
         with self._lock:
             for length, members in sorted(groups.items()):
-                batch = jnp.asarray([ids for _, ids in members], jnp.int32)
+                rows = [ids for _, ids in members]
+                n_real = len(rows)
+                bucket = 1 << (n_real - 1).bit_length()  # next power of 2
+                rows = rows + [rows[0]] * (bucket - n_real)
+                batch = jnp.asarray(rows, jnp.int32)
                 t0 = time.perf_counter()
                 if num_beams and num_beams > 1:
                     from pyspark_tf_gke_tpu.models import beam_search
@@ -155,22 +166,16 @@ class BundleServer:
                             num_beams=num_beams, eos_token_id=eos_id)
                     scores = np.asarray(scores)
                 else:
-                    if self.mesh is not None:
-                        out = serve_generate(
-                            self.model, self.params, batch, mesh=self.mesh,
-                            max_new_tokens=max_new_tokens,
-                            temperature=temperature, top_k=top_k,
-                            top_p=top_p, eos_token_id=eos_id,
-                            repetition_penalty=repetition_penalty)
-                    else:
-                        out = generate(
-                            self.model, self.params, batch,
-                            max_new_tokens=max_new_tokens,
-                            temperature=temperature, top_k=top_k,
-                            top_p=top_p, eos_token_id=eos_id,
-                            repetition_penalty=repetition_penalty)
+                    gen_fn = generate if self.mesh is None else serve_generate
+                    kwargs = {} if self.mesh is None else {"mesh": self.mesh}
+                    out = gen_fn(
+                        self.model, self.params, batch,
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature, rng=rng, top_k=top_k,
+                        top_p=top_p, eos_token_id=eos_id,
+                        repetition_penalty=repetition_penalty, **kwargs)
                     scores = None
-                toks = np.asarray(out[:, length:])
+                toks = np.asarray(out[:n_real, length:])
                 dt = (time.perf_counter() - t0) * 1000.0
                 for row, (i, _) in enumerate(members):
                     new = toks[row].tolist()
@@ -237,9 +242,14 @@ class BundleServer:
         if rows:
             lengths = [len(ids) for _, ids, _ in rows]
             seq_len = _bucket(max(lengths), cap)
-            padded = np.zeros((len(rows), seq_len), np.int32)
+            # batch dim pads to a power-of-2 bucket too (dummy rows get
+            # length 0 → fully masked), bounding compiled shapes
+            n_real = len(rows)
+            n_bucket = 1 << (n_real - 1).bit_length()
+            padded = np.zeros((n_bucket, seq_len), np.int32)
             for r, (_, ids, _) in enumerate(rows):
                 padded[r, :len(ids)] = ids
+            lengths = lengths + [0] * (n_bucket - n_real)
             with self._lock:
                 fn = self._score_fn()
                 with self.mesh or contextlib.nullcontext():

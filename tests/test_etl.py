@@ -326,3 +326,80 @@ def test_text_bridge_skips_null_docs(tmp_path):
     (path,) = tokenize_partition_docs(0, iter(rows), prefix, seq_len=16,
                                       num_shards=1, text_field="text")
     assert os.path.getsize(path) > 0
+
+
+@pytest.fixture(scope="module")
+def spark_local():
+    """Shared local[2] session — the reference's fake-cluster pattern
+    (spark_checks/python_checks/spark_installation_check.py:12-46)."""
+    pytest.importorskip("pyspark")
+    from pyspark.sql import SparkSession
+
+    spark = (SparkSession.builder.master("local[2]")
+             .appName("etl-e2e").getOrCreate())
+    yield spark
+    spark.stop()
+
+
+@pytest.mark.slow
+def test_spark_local2_kmeans_flagship_workload(spark_local, monkeypatch):
+    """The flagship ETL job (reference k_means.py:164-208) executing for
+    real: feature pipeline (null filter -> StringIndexer -> OneHot ->
+    mean imputation -> weighting -> assemble) + KMeans fit + single-row
+    inference, on a local[2] cluster with synthetic health rows."""
+    from pyspark_tf_gke_tpu.etl.kmeans_spark import KMeansSparkWorkload
+
+    monkeypatch.setenv("KMEANS_K", "3")
+    monkeypatch.setenv("MEASURE_NAME_WEIGHT", "2")
+    rng = np.random.default_rng(0)
+    measures = ["Able-Bodied", "Asthma", "Cancer"]
+    rows = []
+    for i in range(60):
+        m = measures[i % 3]
+        base = 10.0 * (i % 3)
+        v = float(base + rng.normal(0, 0.5))
+        # a few nulls/NaNs exercise the imputation stage
+        rows.append((m,
+                     None if i == 5 else v,
+                     float("nan") if i == 7 else v - 1.0,
+                     v + 1.0))
+    df = spark_local.createDataFrame(
+        rows, ["measure_name", "value", "lower_ci", "upper_ci"])
+
+    wl = KMeansSparkWorkload()
+    pipeline_model, model = wl.k_means(df)
+    assert len(model.clusterCenters()) == 3
+
+    for label, num in zip(measures, [0, 10, 30]):
+        pred, preds_df = wl.infer_single_row(spark_local, label, num)
+        assert pred in (0, 1, 2)
+        assert preds_df.count() == 1
+
+
+@pytest.mark.slow
+def test_spark_local2_text_bridge_packed_tokens(spark_local, tmp_path):
+    """The LM corpus ETL (etl/text_bridge.py) executing on Spark for
+    real: DataFrame of documents -> executor-side tokenize+pack ->
+    TFRecord shards + metadata sidecar -> TPU-side reader."""
+    from pyspark_tf_gke_tpu.data.native_tfrecord import read_tfrecord_batches
+    from pyspark_tf_gke_tpu.etl.text_bridge import (
+        validate_shard_meta,
+        write_token_shards,
+    )
+
+    docs = [(f"document {i} about tpus and sparks " * 3,) for i in range(12)]
+    df = spark_local.createDataFrame(docs, ["text"])
+    prefix = str(tmp_path / "corpus")
+    paths = write_token_shards(df, prefix, seq_len=16, num_shards=2)
+    assert len(paths) == 2
+    validate_shard_meta(f"{prefix}-*.tfrecord", "byte", 16)
+
+    rows = 0
+    for batch in read_tfrecord_batches(
+            f"{prefix}-*.tfrecord", {"input_ids": ("int", (16,))}, 4,
+            shuffle=False, repeat=False):
+        arr = np.asarray(batch["input_ids"])
+        assert arr.shape[1] == 16
+        assert (arr >= 0).all() and (arr < 259).all()
+        rows += arr.shape[0]
+    assert rows > 0

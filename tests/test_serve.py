@@ -143,3 +143,12 @@ def test_lm_eval_requires_exactly_one_source():
 
     with pytest.raises(SystemExit):
         main(["--data-pattern", "x*.txt"])  # neither bundle nor endpoint
+
+
+def test_sampling_varies_across_requests(endpoint):
+    """temperature>0 must not hand every request the same 'random'
+    completion (a fixed PRNG seed would)."""
+    body = {"prompts": ["abcd"], "max_new_tokens": 10, "temperature": 1.0}
+    outs = {_post(endpoint, "/v1/generate", body)["completions"][0]["completion"]
+            for _ in range(4)}
+    assert len(outs) > 1

@@ -56,6 +56,37 @@ def make_spot_arrays(n: int, height: int, width: int, seed: int = 1337):
     return images, targets
 
 
+FRAMING = (
+    "The reference's recorded 150-epoch history "
+    "(tf-model/150-320-by-256-B1-model.json) was trained on a private "
+    "laser-spot image set that is NOT checked into the reference repo, "
+    "so trajectory parity against that exact run is impossible. This "
+    "report is therefore an IMPLEMENTATION-vs-IMPLEMENTATION oracle: "
+    "the reference's own TF/Keras model code and this repo's JAX model "
+    "train on the SAME seeded synthetic dataset, same batch order, same "
+    "optimizer; parity = the JAX side reaches a final metric no worse "
+    "than the TF side's best epoch. Both reference trainers are "
+    "covered: the flagship CNN-B1 image regressor "
+    "(train_tf_ps.py:346-378) and the MLP/CSV classifier "
+    "(train_tf_ps.py:328-343)."
+)
+
+
+def make_health_arrays(n: int, num_classes: int = 6, seed: int = 1337):
+    """In-memory analog of the CSV task (load_csv semantics,
+    ``train_tf_ps.py:75-149``): 3 float features (value, lower_ci,
+    upper_ci) whose joint distribution clusters by label — a learnable
+    stand-in for the health_disparities subpopulation classes."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, n).astype(np.int32)
+    centers = rng.uniform(-3, 3, (num_classes, 3)).astype(np.float32)
+    feats = centers[labels] + rng.normal(0, 0.6, (n, 3)).astype(np.float32)
+    # lower_ci/upper_ci bracket value the way the real rows do
+    feats[:, 1] = feats[:, 0] - np.abs(feats[:, 1]) * 0.1
+    feats[:, 2] = feats[:, 0] + np.abs(feats[:, 2]) * 0.1
+    return feats.astype(np.float32), labels
+
+
 def run_tf(images, targets, batch_size: int, epochs: int, lr: float = 1e-3):
     """The reference implementation: Keras Sequential B1, model.fit with
     shuffle=False so the batch order matches the JAX run exactly."""
@@ -118,6 +149,88 @@ def run_jax(images, targets, batch_size: int, epochs: int, lr: float = 1e-3):
     return history
 
 
+def run_tf_mlp(feats, labels, batch_size: int, epochs: int, lr: float = 1e-3):
+    """The reference's OTHER trainer: build_deep_model
+    (``train_tf_ps.py:328-343``) — Dense 16/32/64 relu + softmax head,
+    Adam lr=1e-3, sparse categorical CE."""
+    import tensorflow as tf
+
+    num_classes = int(labels.max()) + 1
+    tf.keras.utils.set_random_seed(1337)
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(feats.shape[1],)),
+        tf.keras.layers.Dense(16, activation="relu"),
+        tf.keras.layers.Dense(32, activation="relu"),
+        tf.keras.layers.Dense(64, activation="relu"),
+        tf.keras.layers.Dense(num_classes, activation="softmax"),
+    ])
+    model.compile(
+        optimizer=tf.keras.optimizers.Adam(lr, epsilon=KERAS_ADAM_EPS),
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(),
+        metrics=["accuracy"],
+    )
+    hist = model.fit(feats, labels, batch_size=batch_size, epochs=epochs,
+                     shuffle=False, verbose=0)
+    return {k: [float(v) for v in vs] for k, vs in hist.history.items()}
+
+
+def run_jax_mlp(feats, labels, batch_size: int, epochs: int, lr: float = 1e-3):
+    """This repo's MLPClassifier (models/mlp.py — the param-count parity
+    twin) + Trainer, same batch order."""
+    import jax
+    import optax
+
+    from pyspark_tf_gke_tpu.data.pipeline import put_global_batch
+    from pyspark_tf_gke_tpu.models import MLPClassifier
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding, make_mesh
+    from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    model = MLPClassifier(num_classes=int(labels.max()) + 1)
+    trainer = Trainer(model, TASKS["classification"](), mesh,
+                      tx=optax.adam(lr, eps=KERAS_ADAM_EPS))
+    state = trainer.init_state(make_rng(1337), {"x": feats[:1], "y": labels[:1]})
+    sharding = batch_sharding(mesh)
+    steps = len(feats) // batch_size
+    history = {"loss": [], "accuracy": []}
+    for _ in range(epochs):
+        sums = {"loss": 0.0, "accuracy": 0.0}
+        for i in range(steps):
+            sl = slice(i * batch_size, (i + 1) * batch_size)
+            gb = put_global_batch({"x": feats[sl], "y": labels[sl]}, sharding)
+            state, metrics = trainer.step(state, gb)
+            m = jax.device_get(metrics)
+            sums["loss"] += float(m["loss"])
+            sums["accuracy"] += float(m["accuracy"])
+        for k in history:
+            history[k].append(sums[k] / steps)
+    return history
+
+
+def compare_cls(tf_hist, jax_hist, loss_ratio_tol: float, acc_abs_tol: float):
+    """Classification parity-or-better: final CE loss no worse than the
+    TF run's best epoch (× tol) and final accuracy within ``acc_abs_tol``
+    of the TF run's best."""
+    checks = {}
+    tl, jl = min(tf_hist["loss"]), jax_hist["loss"][-1]
+    ta, ja = max(tf_hist["accuracy"]), jax_hist["accuracy"][-1]
+    checks["final_loss_not_worse_than_tf_best"] = {
+        "tf_best": tl, "tf_final": tf_hist["loss"][-1], "jax_final": jl,
+        "tol": loss_ratio_tol, "ok": jl <= tl * loss_ratio_tol,
+    }
+    checks["final_accuracy_not_worse_than_tf_best"] = {
+        "tf_best": ta, "tf_final": tf_hist["accuracy"][-1], "jax_final": ja,
+        "tol": acc_abs_tol, "ok": ja >= ta - acc_abs_tol,
+    }
+    for name, hist in (("tf", tf_hist), ("jax", jax_hist)):
+        checks[f"{name}_descended"] = {
+            "first": hist["loss"][0], "last": hist["loss"][-1],
+            "ok": hist["loss"][-1] < hist["loss"][0],
+        }
+    return checks, all(c["ok"] for c in checks.values())
+
+
 def compare(tf_hist, jax_hist, loss_ratio_tol: float, mae_rel_tol: float):
     """Parity-or-better checks: the JAX trajectory must reach a final
     loss/MAE no worse than the reference's (within tolerance) — beating
@@ -161,37 +274,92 @@ def main(argv=None) -> int:
                          "loss: jax_final must be <= tf_best * tol "
                          "(inits are framework-seeded, not identical)")
     ap.add_argument("--mae-rel-tol", type=float, default=0.35)
+    ap.add_argument("--mlp-rows", type=int, default=4096)
+    ap.add_argument("--mlp-epochs", type=int, default=20)
+    ap.add_argument("--acc-abs-tol", type=float, default=0.05)
+    ap.add_argument("--skip-cnn", action="store_true",
+                    help="reuse the existing report's cnn_b1 section "
+                         "(recorded run) and refresh only the MLP half — "
+                         "the CNN pair is expensive off-TPU")
     ap.add_argument("--report", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "parity_report.json"))
     args = ap.parse_args(argv)
 
-    images, targets = make_spot_arrays(args.images, args.height, args.width)
-    print(f"dataset: {args.images} images {args.height}x{args.width}, "
-          f"batch {args.batch_size}, {args.epochs} epochs", file=sys.stderr)
+    cnn_section = None
+    if args.skip_cnn:
+        with open(args.report) as fh:
+            prev = json.load(fh)
+        cnn_section = prev.get("cnn_b1") or {
+            # migrate a pre-restructure report (flat layout)
+            "reference_workload": "train_tf_ps.py:346-378 (flagship)",
+            "config": prev["config"],
+            "optimizer": prev["optimizer"],
+            "tf_history": prev["tf_history"],
+            "jax_history": prev["jax_history"],
+            "checks": prev["checks"],
+            "parity": prev["parity"],
+        }
+        tf_hist, jax_hist = cnn_section["tf_history"], cnn_section["jax_history"]
+        checks, ok = cnn_section["checks"], cnn_section["parity"]
+        print("cnn: reusing recorded histories from the existing report",
+              file=sys.stderr)
+    else:
+        images, targets = make_spot_arrays(args.images, args.height, args.width)
+        print(f"cnn dataset: {args.images} images {args.height}x{args.width}, "
+              f"batch {args.batch_size}, {args.epochs} epochs", file=sys.stderr)
 
-    tf_hist = run_tf(images, targets, args.batch_size, args.epochs)
-    print(f"tf   loss: {tf_hist['loss'][0]:.1f} -> {tf_hist['loss'][-1]:.2f}",
-          file=sys.stderr)
-    jax_hist = run_jax(images, targets, args.batch_size, args.epochs)
-    print(f"jax  loss: {jax_hist['loss'][0]:.1f} -> {jax_hist['loss'][-1]:.2f}",
-          file=sys.stderr)
+        tf_hist = run_tf(images, targets, args.batch_size, args.epochs)
+        print(f"tf   loss: {tf_hist['loss'][0]:.1f} -> "
+              f"{tf_hist['loss'][-1]:.2f}", file=sys.stderr)
+        jax_hist = run_jax(images, targets, args.batch_size, args.epochs)
+        print(f"jax  loss: {jax_hist['loss'][0]:.1f} -> "
+              f"{jax_hist['loss'][-1]:.2f}", file=sys.stderr)
+        checks, ok = compare(tf_hist, jax_hist, args.loss_ratio_tol,
+                             args.mae_rel_tol)
 
-    checks, ok = compare(tf_hist, jax_hist, args.loss_ratio_tol, args.mae_rel_tol)
+    feats, labels = make_health_arrays(args.mlp_rows)
+    print(f"mlp dataset: {args.mlp_rows} rows, batch {args.batch_size}, "
+          f"{args.mlp_epochs} epochs", file=sys.stderr)
+    tf_mlp = run_tf_mlp(feats, labels, args.batch_size, args.mlp_epochs)
+    jax_mlp = run_jax_mlp(feats, labels, args.batch_size, args.mlp_epochs)
+    print(f"tf   mlp acc: {tf_mlp['accuracy'][-1]:.3f}  "
+          f"jax mlp acc: {jax_mlp['accuracy'][-1]:.3f}", file=sys.stderr)
+    mlp_checks, mlp_ok = compare_cls(tf_mlp, jax_mlp, args.loss_ratio_tol,
+                                     args.acc_abs_tol)
+
     report = {
-        "config": {k: getattr(args, k) for k in
-                   ("images", "height", "width", "batch_size", "epochs")},
-        "optimizer": {"name": "adam", "lr": 1e-3, "eps": KERAS_ADAM_EPS},
-        "tf_history": tf_hist,
-        "jax_history": jax_hist,
-        "checks": checks,
-        "parity": ok,
+        "framing": FRAMING,
+        "reference_dataset_available": False,
+        "cnn_b1": cnn_section or {
+            "reference_workload": "train_tf_ps.py:346-378 (flagship)",
+            "config": {k: getattr(args, k) for k in
+                       ("images", "height", "width", "batch_size", "epochs")},
+            "optimizer": {"name": "adam", "lr": 1e-3, "eps": KERAS_ADAM_EPS},
+            "tf_history": tf_hist,
+            "jax_history": jax_hist,
+            "checks": checks,
+            "parity": ok,
+        },
+        "mlp_csv": {
+            "reference_workload": "train_tf_ps.py:328-343 (CSV classifier)",
+            "config": {"rows": args.mlp_rows, "batch_size": args.batch_size,
+                       "epochs": args.mlp_epochs},
+            "optimizer": {"name": "adam", "lr": 1e-3, "eps": KERAS_ADAM_EPS},
+            "tf_history": tf_mlp,
+            "jax_history": jax_mlp,
+            "checks": mlp_checks,
+            "parity": mlp_ok,
+        },
+        "parity": ok and mlp_ok,
     }
     with open(args.report, "w") as fh:
         json.dump(report, fh, indent=2)
-    print(json.dumps({"parity": ok, "report": args.report,
-                      "final_loss": {"tf": tf_hist["loss"][-1],
-                                     "jax": jax_hist["loss"][-1]}}))
-    return 0 if ok else 1
+    print(json.dumps({"parity": ok and mlp_ok, "report": args.report,
+                      "cnn_final_loss": {"tf": tf_hist["loss"][-1],
+                                         "jax": jax_hist["loss"][-1]},
+                      "mlp_final_acc": {"tf": tf_mlp["accuracy"][-1],
+                                        "jax": jax_mlp["accuracy"][-1]}}))
+    return 0 if (ok and mlp_ok) else 1
 
 
 if __name__ == "__main__":
