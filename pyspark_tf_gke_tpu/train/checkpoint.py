@@ -18,6 +18,7 @@ from typing import Any, Dict, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from pyspark_tf_gke_tpu.utils.fs import fs_makedirs, fs_write_text, is_remote
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
 logger = get_logger("train.checkpoint")
@@ -36,11 +37,15 @@ class CheckpointManager:
         the trainer is free to donate/overwrite the state buffers
         immediately) and persists in a background thread. ``save`` then
         returns without blocking; ``wait`` / ``close`` join the writer."""
-        self.directory = os.path.abspath(directory)
+        # gs:// paths pass through untouched — orbax/tensorstore speaks
+        # GCS natively, and abspath would mangle the scheme into a local
+        # ./gs:/ directory (the k8s manifests set OUTPUT_DIR=gs://...)
+        self.directory = (directory if is_remote(directory)
+                          else os.path.abspath(directory))
         self.every_steps = every_steps
         self.async_save = async_save
         self._pending_history: Optional[Dict] = None
-        os.makedirs(self.directory, exist_ok=True)
+        fs_makedirs(self.directory)
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -51,8 +56,8 @@ class CheckpointManager:
 
     def _write_history(self, history: Dict) -> None:
         if jax.process_index() == 0:
-            with open(os.path.join(self.directory, "history.json"), "w") as fh:
-                json.dump(history, fh)
+            fs_write_text(os.path.join(self.directory, "history.json"),
+                          json.dumps(history))
 
     def save(self, state: Any, history: Optional[Dict] = None, force: bool = False) -> None:
         step = int(jax.device_get(state.step))
@@ -127,20 +132,19 @@ class CheckpointManager:
 
 def save_label_map(output_dir: str, vocab) -> str:
     """``label_map.json`` with the reference's exact format
-    (``train_tf_ps.py:582-583``): {index: label}."""
-    os.makedirs(output_dir, exist_ok=True)
+    (``train_tf_ps.py:582-583``): {index: label}. gs:// output dirs
+    write through fsspec (single whole-object write)."""
     path = os.path.join(output_dir, "label_map.json")
     if jax.process_index() == 0:
-        with open(path, "w", encoding="utf-8") as fh:
-            json.dump({int(i): s for i, s in enumerate(vocab)}, fh, ensure_ascii=False, indent=2)
+        fs_write_text(path, json.dumps(
+            {int(i): s for i, s in enumerate(vocab)},
+            ensure_ascii=False, indent=2))
     return path
 
 
 def save_history(output_dir: str, history: Dict) -> str:
     """``history.json`` — Keras-History-compatible (``train_tf_ps.py:678-679``)."""
-    os.makedirs(output_dir, exist_ok=True)
     path = os.path.join(output_dir, "history.json")
     if jax.process_index() == 0:
-        with open(path, "w", encoding="utf-8") as fh:
-            json.dump(history, fh)
+        fs_write_text(path, json.dumps(history))
     return path

@@ -13,6 +13,7 @@ import numpy as np
 
 from pyspark_tf_gke_tpu.train.checkpoint import CheckpointManager, save_history
 from pyspark_tf_gke_tpu.train.resilience import Heartbeat
+from pyspark_tf_gke_tpu.utils.fs import fs_write_text, is_remote
 
 
 def make_optimizer(
@@ -151,9 +152,7 @@ def save_run_notes(output_dir: str, model_name: str, state, history: Dict) -> st
     for key, vals in sorted(history.items()):
         if vals:
             lines.append(f"final {key}: {vals[-1]:.6g}")
-    os.makedirs(output_dir, exist_ok=True)
-    with open(path, "w") as fh:
-        fh.write("\n".join(lines) + "\n")
+    fs_write_text(path, "\n".join(lines) + "\n")
     return path
 
 
@@ -162,4 +161,12 @@ def make_heartbeat(
 ) -> Optional[Heartbeat]:
     if not every_steps:
         return None
-    return Heartbeat(path or os.path.join(output_dir, "heartbeat.json"), every_steps)
+    if not path:
+        # heartbeats must be node-local (age probes need local mtime;
+        # a per-step gs:// write would be absurd) — when the artifact
+        # dir is remote, default to /tmp like the k8s manifests do,
+        # per-process so a hung process can't hide behind a live peer
+        path = ("/tmp/tpu-heartbeat-{process_index}.json"
+                if is_remote(output_dir)
+                else os.path.join(output_dir, "heartbeat.json"))
+    return Heartbeat(path, every_steps)

@@ -30,6 +30,7 @@ from typing import Callable, Iterable, Optional, Sequence, TypeVar
 
 import jax
 
+from pyspark_tf_gke_tpu.utils.fs import is_remote
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
 logger = get_logger("train.resilience")
@@ -54,6 +55,17 @@ class Heartbeat:
     """
 
     def __init__(self, path: str, every_steps: int = 10):
+        if is_remote(path):
+            # age-based probes need local mtime semantics, and a gs://
+            # beat would turn every step into a network write
+            raise ValueError(
+                f"heartbeat path must be node-local, got {path!r} — "
+                f"point HEARTBEAT_FILE at /tmp (the k8s manifests do)")
+        # per-process files for multi-process-per-node runs (tests,
+        # local fake slices); single-process-per-pod deployments don't
+        # need the placeholder. replace(), not format(): other literal
+        # braces in the path must pass through untouched.
+        path = path.replace("{process_index}", str(jax.process_index()))
         self.path = path
         self.every_steps = max(1, every_steps)
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -95,6 +107,29 @@ class Heartbeat:
         should use an initialDelay for that phase instead."""
         a = Heartbeat.age(path)
         return a is not None and a > stall_seconds
+
+
+def detect_stall(paths: Sequence[str], stall_seconds: float,
+                 timeout_s: float, poll_s: float = 0.5) -> Optional[str]:
+    """Watchdog primitive: poll the heartbeat files until one goes
+    stale (written once, then quiet for ``stall_seconds``) or
+    ``timeout_s`` elapses. Returns the first stalled path, or None.
+
+    This is the job-level detection the k8s liveness probe performs per
+    pod (``tpu-worker.yaml``); a watchdog process uses it directly when
+    supervising a local multi-process fake slice. A HUNG worker — alive
+    but stopped, the real TPU-pod failure shape (stuck collective,
+    wedged host) — produces exactly this signature: the process exists,
+    the heartbeat ages. Response is job-level restart: synchronous SPMD
+    means one stalled worker blocks every peer's collectives, so the
+    whole set restarts and resumes from the latest checkpoint."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        for p in paths:
+            if Heartbeat.is_stalled(p, stall_seconds):
+                return p
+        time.sleep(poll_s)
+    return None
 
 
 class FaultInjector:

@@ -64,6 +64,33 @@ def _default_spool_dir() -> str:
     return d
 
 
+def fs_makedirs(path: str) -> None:
+    """mkdir -p for local paths; no-op for object stores (GCS has no
+    directories — objects simply exist under a prefix)."""
+    if not is_remote(path):
+        os.makedirs(path, exist_ok=True)
+
+
+def fs_write_text(path: str, text: str) -> str:
+    """Write a small text artifact (history.json, run notes, label map)
+    GCS-compatibly: one whole-object write per call — no append, no
+    seek, which object stores don't support. Local writes go through a
+    same-directory temp file + atomic rename so concurrent readers
+    never observe a torn artifact."""
+    if is_remote(path):
+        import fsspec
+
+        with fsspec.open(path, "w") as fh:
+            fh.write(text)
+        return path
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+    return path
+
+
 def fs_copy_tree(url: str, local_dir: str) -> str:
     """Recursively copy a remote directory tree (e.g. a ``gs://``
     serving bundle) into ``local_dir``. orbax restores from a directory

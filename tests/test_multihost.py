@@ -63,6 +63,31 @@ def _launch_workers(csv: str, out: str, epochs: int, extra_args=()):
     return procs
 
 
+def _wait_for_checkpoint(procs, ckdir, extra_ready=None, timeout_s=300):
+    """Poll until a numbered checkpoint exists (and ``extra_ready()``,
+    if given, holds) with every worker alive. A worker dying first is
+    reported from ITS log (survivors are killed first — a live worker
+    stalled in a collective would block communicate indefinitely)."""
+    import time
+
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        steps = [d for d in (os.listdir(ckdir) if os.path.isdir(ckdir) else [])
+                 if d.isdigit()]
+        if steps and (extra_ready is None or extra_ready()):
+            return
+        dead = [i for i, p in enumerate(procs) if p.poll() is not None]
+        if dead:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            texts = [p.communicate(timeout=60)[0] for p in procs]
+            raise AssertionError(
+                f"worker {dead[0]} died early:\n{texts[dead[0]][-2000:]}")
+        time.sleep(0.5)
+    raise AssertionError("no checkpoint appeared before the deadline")
+
+
 @pytest.mark.slow
 def test_two_process_csv_training(tmp_path):
     from pyspark_tf_gke_tpu.data.synthetic import make_synthetic_csv
@@ -120,27 +145,7 @@ def test_two_process_kill_and_resume(tmp_path):
     # workers hard (no cleanup — the crash path, not shutdown).
     procs = launch(resume=False)
     try:
-        deadline = time.time() + 300
-        while time.time() < deadline:
-            steps = [d for d in (os.listdir(ckdir) if os.path.isdir(ckdir) else [])
-                     if d.isdigit()]
-            if steps and all(p.poll() is None for p in procs):
-                break
-            dead = [i for i, p in enumerate(procs) if p.poll() is not None]
-            if dead:
-                # Kill survivors first (a live worker stalled in a
-                # collective would block communicate indefinitely), then
-                # report the DEAD worker's log — that's where the cause is.
-                for p in procs:
-                    if p.poll() is None:
-                        p.kill()
-                texts = [p.communicate(timeout=60)[0] for p in procs]
-                raise AssertionError(
-                    f"worker {dead[0]} died early:\n{texts[dead[0]][-2000:]}"
-                )
-            time.sleep(0.5)
-        else:
-            raise AssertionError("no checkpoint appeared before the deadline")
+        _wait_for_checkpoint(procs, ckdir)
         for p in procs:
             p.send_signal(signal.SIGKILL)
     finally:
@@ -169,3 +174,85 @@ def test_two_process_kill_and_resume(tmp_path):
     final = [t.split(f"WORKER_OK {i} ")[1].splitlines()[0]
              for i, t in enumerate(outputs)]
     assert np.isfinite(float(final[0])) and final[0] == final[1]
+
+
+@pytest.mark.slow
+def test_two_process_sigstop_stall_detection_and_restart(tmp_path):
+    """The REAL TPU-pod failure shape: a worker that is alive but hung
+    (SIGSTOP — the process exists, collectives never complete). End to
+    end: per-process heartbeats -> watchdog detects the stalled worker
+    by heartbeat age (train/resilience.detect_stall, the k8s liveness
+    probe's logic) -> job-level restart (sync SPMD: one hung worker
+    stalls every peer, so the whole set restarts) -> resume from the
+    mid-run checkpoint -> completion."""
+    import signal
+    import time
+
+    from pyspark_tf_gke_tpu.data.synthetic import make_synthetic_csv
+    from pyspark_tf_gke_tpu.train.resilience import detect_stall
+
+    csv = str(tmp_path / "d.csv")
+    make_synthetic_csv(csv, rows=320)
+    out = str(tmp_path / "out")
+    ckdir = os.path.join(out, "checkpoints")
+    hb = [str(tmp_path / f"hb-{i}.json") for i in range(2)]
+
+    def launch(resume: bool, epochs: int):
+        extra = [
+            "--checkpoint-every-steps", "3",
+            "--heartbeat-every-steps", "1",
+            "--heartbeat-file", str(tmp_path / "hb-{process_index}.json"),
+        ] + (["--resume"] if resume else [])
+        return _launch_workers(csv, out, epochs=epochs, extra_args=extra)
+
+    # Run 1: plenty of epochs — it is not meant to finish; the stopped
+    # worker wedges the job and the watchdog ends it.
+    procs = launch(resume=False, epochs=200)
+    try:
+        # wait until a checkpoint exists and both workers are beating
+        _wait_for_checkpoint(
+            procs, ckdir,
+            extra_ready=lambda: all(os.path.exists(p) for p in hb))
+
+        # Hang worker 1 (alive, not dead — SIGKILL is the easy case;
+        # this is the hard one the heartbeat exists for).
+        procs[1].send_signal(signal.SIGSTOP)
+
+        stalled = detect_stall(hb, stall_seconds=6.0, timeout_s=120.0)
+        assert stalled is not None, "watchdog never saw the stall"
+        # worker 1 must be among the stalled (worker 0 may stall too —
+        # it is blocked in a collective with a hung peer; that is the
+        # sync-SPMD point). Ensure specifically that hb-1 goes stale.
+        deadline = time.time() + 60
+        from pyspark_tf_gke_tpu.train.resilience import Heartbeat
+
+        while time.time() < deadline and not Heartbeat.is_stalled(hb[1], 6.0):
+            time.sleep(0.5)
+        assert Heartbeat.is_stalled(hb[1], 6.0)
+        assert procs[1].poll() is None, "worker must be hung, not dead"
+
+        # Job-level restart: kill the whole set (SIGKILL terminates a
+        # stopped process too).
+        for p in procs:
+            p.send_signal(signal.SIGKILL)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.communicate()
+
+    killed_at = max(int(d) for d in os.listdir(ckdir) if d.isdigit())
+
+    # Run 2: short, resumable, must restore the mid-run checkpoint.
+    procs = launch(resume=True, epochs=4)
+    try:
+        outputs = [p.communicate(timeout=420)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for i, (p, text) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"restarted worker {i} failed:\n{text[-3000:]}"
+        assert f"WORKER_OK {i}" in text
+    assert any(f"Restored checkpoint step {killed_at}" in t for t in outputs)
