@@ -72,11 +72,13 @@ def _wait_for_checkpoint(procs, ckdir, extra_ready=None, timeout_s=300):
 
     deadline = time.time() + timeout_s
     while time.time() < deadline:
+        # dead-worker check FIRST: an early crash must fail the wait
+        # even when a checkpoint already landed
+        dead = [i for i, p in enumerate(procs) if p.poll() is not None]
         steps = [d for d in (os.listdir(ckdir) if os.path.isdir(ckdir) else [])
                  if d.isdigit()]
-        if steps and (extra_ready is None or extra_ready()):
+        if not dead and steps and (extra_ready is None or extra_ready()):
             return
-        dead = [i for i, p in enumerate(procs) if p.poll() is not None]
         if dead:
             for p in procs:
                 if p.poll() is None:
