@@ -120,12 +120,15 @@ def measure(trainer, state, batch, steps: int):
     return state, losses, dt
 
 
-def _throughput_pass(trainer, state, tbatch, tsteps: int, n_chips: int,
+def _throughput_pass(trainer, state, make_tbatch, tsteps: int, n_chips: int,
                      device_kind: str, actual_batch: int, unit: str) -> dict:
     """Shared disclosed-secondary measurement at a larger per-chip batch
-    (the headline stays the BASELINE config's batch). Returns the
-    max_throughput_* fields; {} on failure (OOM safety on small chips)."""
+    (the headline stays the BASELINE config's batch). ``make_tbatch`` is
+    a thunk so the big-batch ALLOCATION is inside the guard too. Returns
+    the max_throughput_* fields; {} on failure (OOM safety on small
+    chips — the already-measured headline must survive)."""
     try:
+        tbatch = make_tbatch()
         tflops = step_flops(trainer, state, tbatch)
         _, _, tdt = measure(trainer, state, tbatch, tsteps)
         tmfu = _mfu(tflops, tdt / tsteps, device_kind)
@@ -150,14 +153,89 @@ def _mfu(flops_per_step, step_seconds: float, device_kind: str):
     return flops_per_step / (step_seconds * peak)
 
 
-def main(batch_size: int = 32, steps: int = 100, throughput_batch: int = 128,
-         throughput_steps: int = 40) -> dict:
+def build_workload(name: str, smoke: bool = False, batch_override: int = 0,
+                   use_flash=None, seq_override=None):
+    """(trainer, batch, batch_size, extra) for a named workload — the
+    single construction point shared by the bench passes below and by
+    ``tools/roofline.py``, so the analysis tool always explains exactly
+    the program the bench measures."""
     import jax
     import jax.numpy as jnp
 
-    from pyspark_tf_gke_tpu.models import CNNRegressor
-    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding, make_mesh
+    from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
     from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
+
+    mesh = make_mesh()
+    n_chips = len(jax.devices())
+    rng = np.random.default_rng(0)
+    extra = {}
+    if name == "cnn":
+        from pyspark_tf_gke_tpu.models import CNNRegressor
+
+        batch_size = batch_override or (8 if smoke else 32)
+        model = CNNRegressor(num_outputs=2, flat=True, dtype=jnp.bfloat16)
+        batch = {
+            "image": rng.uniform(
+                0, 1, (batch_size, 256, 320, 3)).astype(np.float32),
+            "target": rng.uniform(
+                0, 256, (batch_size, 2)).astype(np.float32),
+        }
+        trainer = Trainer(model, TASKS["regression"](), mesh,
+                          learning_rate=1e-3)
+    elif name == "resnet50":
+        from pyspark_tf_gke_tpu.models import ResNet50
+
+        batch_size, hw = (8, 64) if smoke else (64, 224)
+        batch_size = batch_override or batch_size
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        batch = {
+            "image": rng.uniform(0, 1, (batch_size, hw, hw, 3)).astype(np.float32),
+            "label": rng.integers(0, 1000, (batch_size,)).astype(np.int32),
+        }
+        trainer = Trainer(model, TASKS["resnet"](), mesh, learning_rate=1e-3)
+    elif name == "bert":
+        from pyspark_tf_gke_tpu.models import BertConfig, BertForPretraining
+
+        batch_size, seq = (8, 32) if smoke else (32, 128)
+        batch_size = batch_override or batch_size
+        if seq_override:
+            seq = int(seq_override)
+            # ~constant tokens/step, rounded up to a multiple of the data
+            # shards so batch_sharding can split the leading dim.
+            batch_size = max(batch_size * 128 // seq, 1)
+            batch_size = -(-batch_size // n_chips) * n_chips
+        cfg_kwargs = (dict(vocab_size=512, hidden_size=64, num_layers=2,
+                           num_heads=4, intermediate_size=128)
+                      if smoke else {})
+        if seq > 512:
+            cfg_kwargs["max_position_embeddings"] = seq
+        if use_flash is not None:
+            cfg_kwargs["use_flash"] = use_flash
+        cfg = BertConfig(**cfg_kwargs)
+        model = BertForPretraining(cfg, mesh=mesh)
+        batch = {
+            "input_ids": rng.integers(
+                0, cfg.vocab_size, (batch_size, seq)).astype(np.int32),
+            "attention_mask": np.ones((batch_size, seq), dtype=np.int32),
+            "labels": rng.integers(0, 2, (batch_size,)).astype(np.int32),
+        }
+        trainer = Trainer(model, TASKS["bert_classification"](), mesh,
+                          learning_rate=1e-4)
+        from pyspark_tf_gke_tpu.models.bert import resolve_use_flash
+
+        extra["flash"] = resolve_use_flash(cfg, seq)
+        extra["seq_len"] = seq
+    else:
+        raise SystemExit(
+            f"unknown workload {name!r}; use cnn | resnet50 | bert | generate | io")
+    return trainer, batch, batch_size, extra
+
+
+def main(batch_size: int = 32, steps: int = 100, throughput_batch: int = 128,
+         throughput_steps: int = 40) -> dict:
+    import jax
+
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding
     from pyspark_tf_gke_tpu.utils.seeding import make_rng
 
     devices = jax.devices()
@@ -165,13 +243,11 @@ def main(batch_size: int = 32, steps: int = 100, throughput_batch: int = 128,
     n_chips = len(devices)
     device_kind = devices[0].device_kind
 
-    mesh = make_mesh()  # all chips on dp
-    model = CNNRegressor(num_outputs=2, flat=True, dtype=jnp.bfloat16)
-    trainer = Trainer(model, TASKS["regression"](), mesh, learning_rate=1e-3)
-
+    trainer, hbatch, batch_size, _ = build_workload("cnn",
+                                                    batch_override=batch_size)
+    mesh = trainer.mesh
     rng = np.random.default_rng(0)
-    images = rng.uniform(0, 1, (batch_size, 256, 320, 3)).astype(np.float32)
-    targets = rng.uniform(0, 256, (batch_size, 2)).astype(np.float32)
+    images, targets = hbatch["image"], hbatch["target"]
 
     state = trainer.init_state(make_rng(1337), {"image": images[:1], "target": targets[:1]})
 
@@ -197,13 +273,17 @@ def main(batch_size: int = 32, steps: int = 100, throughput_batch: int = 128,
     # separately — the headline stays the reference's batch-32 config.
     tp = {}
     if throughput_batch and throughput_batch != batch_size:
-        timages = rng.uniform(0, 1, (throughput_batch, 256, 320, 3)).astype(np.float32)
-        ttargets = rng.uniform(0, 256, (throughput_batch, 2)).astype(np.float32)
-        tbatch = {
-            "image": jax.device_put(timages, sharding),
-            "target": jax.device_put(ttargets, sharding),
-        }
-        tp = _throughput_pass(trainer, state, tbatch, throughput_steps,
+        def make_tbatch():
+            timages = rng.uniform(
+                0, 1, (throughput_batch, 256, 320, 3)).astype(np.float32)
+            ttargets = rng.uniform(
+                0, 256, (throughput_batch, 2)).astype(np.float32)
+            return {
+                "image": jax.device_put(timages, sharding),
+                "target": jax.device_put(ttargets, sharding),
+            }
+
+        tp = _throughput_pass(trainer, state, make_tbatch, throughput_steps,
                               n_chips, device_kind, throughput_batch,
                               unit="images")
 
@@ -252,65 +332,18 @@ def bench_workload(name: str, steps: int = 50, smoke: bool = False,
     with batch until the MXU tiles fill; the headline batch stays the
     BASELINE config's)."""
     import jax
-    import jax.numpy as jnp
 
-    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding, make_mesh
-    from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding
     from pyspark_tf_gke_tpu.utils.seeding import make_rng
 
     devices = jax.devices()
     n_chips = len(devices)
     device_kind = devices[0].device_kind
-    mesh = make_mesh()
-    rng = np.random.default_rng(0)
-    extra = {}
 
-    if name == "resnet50":
-        from pyspark_tf_gke_tpu.models import ResNet50
-
-        batch_size, hw = (8, 64) if smoke else (64, 224)
-        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
-        batch = {
-            "image": rng.uniform(0, 1, (batch_size, hw, hw, 3)).astype(np.float32),
-            "label": rng.integers(0, 1000, (batch_size,)).astype(np.int32),
-        }
-        trainer = Trainer(model, TASKS["resnet"](), mesh, learning_rate=1e-3)
-    elif name == "bert":
-        from pyspark_tf_gke_tpu.models import BertConfig, BertForPretraining
-
-        batch_size, seq = (8, 32) if smoke else (32, 128)
-        if seq_override:
-            seq = int(seq_override)
-            # ~constant tokens/step, rounded up to a multiple of the data
-            # shards so batch_sharding can split the leading dim.
-            batch_size = max(batch_size * 128 // seq, 1)
-            batch_size = -(-batch_size // n_chips) * n_chips
-        cfg_kwargs = (dict(vocab_size=512, hidden_size=64, num_layers=2,
-                           num_heads=4, intermediate_size=128)
-                      if smoke else {})
-        if seq > 512:
-            cfg_kwargs["max_position_embeddings"] = seq
-        if use_flash is not None:
-            cfg_kwargs["use_flash"] = use_flash
-        cfg = BertConfig(**cfg_kwargs)
-        model = BertForPretraining(cfg, mesh=mesh)
-        batch = {
-            "input_ids": rng.integers(0, cfg.vocab_size, (batch_size, seq)).astype(np.int32),
-            "attention_mask": np.ones((batch_size, seq), dtype=np.int32),
-            "labels": rng.integers(0, 2, (batch_size,)).astype(np.int32),
-        }
-        trainer = Trainer(model, TASKS["bert_classification"](), mesh,
-                          learning_rate=1e-4)
-        from pyspark_tf_gke_tpu.models.bert import resolve_use_flash
-
-        extra["flash"] = resolve_use_flash(cfg, seq)
-        extra["seq_len"] = seq
-    else:
-        raise SystemExit(
-            f"unknown workload {name!r}; use cnn | resnet50 | bert | generate | io")
-
+    trainer, batch, batch_size, extra = build_workload(
+        name, smoke=smoke, use_flash=use_flash, seq_override=seq_override)
     state = trainer.init_state(make_rng(1337), batch)
-    sharding = batch_sharding(mesh)
+    sharding = batch_sharding(trainer.mesh)
     global_batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
 
     flops = step_flops(trainer, state, global_batch)
@@ -323,11 +356,12 @@ def bench_workload(name: str, steps: int = 50, smoke: bool = False,
         # the requested number (a non-multiple request must not inflate
         # the recorded metric)
         actual = batch_size * scale
-        tbatch = {k: jax.device_put(np.repeat(v, scale, axis=0), sharding)
-                  for k, v in batch.items()}
         extra.update(_throughput_pass(
-            trainer, state, tbatch, max(steps // 4, 2), n_chips,
-            device_kind, actual, unit="examples"))
+            trainer, state,
+            lambda: {k: jax.device_put(np.repeat(v, scale, axis=0), sharding)
+                     for k, v in batch.items()},
+            max(steps // 4, 2), n_chips, device_kind, actual,
+            unit="examples"))
     elif throughput_batch:
         log(f"throughput batch {throughput_batch} < 2x the headline batch "
             f"{batch_size}; secondary pass skipped")

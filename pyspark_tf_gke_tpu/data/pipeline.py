@@ -88,6 +88,7 @@ class BatchIterator:
             raise ValueError(f"batch_size {batch_size} > dataset size {self.n}")
         self.shuffle = shuffle
         self.drop_remainder = drop_remainder
+        self._seed = seed
         self._rng = np_rng(seed)
         self._order = np.arange(self.n)
         self._pos = self.n  # trigger reshuffle on first batch
@@ -97,6 +98,31 @@ class BatchIterator:
         if self.drop_remainder:
             return max(1, self.n // self.batch_size)
         return -(-self.n // self.batch_size)  # ceil: remainder yields a partial batch
+
+    def fast_forward(self, consumed_batches: int) -> "BatchIterator":
+        """Rewind-and-replay to the state after ``consumed_batches``
+        draws: resume-from-checkpoint continues the EXACT deterministic
+        batch order mid-epoch instead of restarting a fresh epoch pass
+        (which silently repeats some examples and starves others). Only
+        the seeded shuffles are replayed — O(epochs), no data touched.
+        Every host calls this with the same count, so host-sharded
+        iterators stay in lockstep."""
+        if consumed_batches < 0:
+            raise ValueError(f"consumed_batches must be >= 0, "
+                             f"got {consumed_batches}")
+        spe = self.steps_per_epoch
+        epochs_done, within = divmod(consumed_batches, spe)
+        self._rng = np_rng(self._seed)
+        self._order = np.arange(self.n)
+        if self.shuffle:
+            # one shuffle per STARTED epoch (the lazy reshuffle in
+            # __next__ fires at each epoch's first draw)
+            for _ in range(epochs_done + (1 if within else 0)):
+                self._rng.shuffle(self._order)
+        # within==0 → the next draw begins a new epoch (triggers its
+        # shuffle); otherwise resume mid-epoch at the exact row offset
+        self._pos = self.n if within == 0 else within * self.batch_size
+        return self
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         return self

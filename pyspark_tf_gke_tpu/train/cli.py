@@ -98,6 +98,12 @@ def run_csv_training(cfg: Config, fault_injector: Optional[FaultInjector] = None
         cfg.output_dir, cfg.checkpoint_every_steps, state, cfg.resume,
         async_save=cfg.async_checkpoint,
     )
+    restored_step = int(jax.device_get(state.step))
+    if restored_step:
+        # continue the exact deterministic batch order from where the
+        # restored optimizer step left off (each step consumed
+        # grad_accum microbatches)
+        train_iter.fast_forward(restored_step * cfg.grad_accum_steps)
 
     def val_batches():
         if len(Xv) < local_bs:
@@ -166,6 +172,9 @@ def run_image_training(cfg: Config, fault_injector: Optional[FaultInjector] = No
         cfg.output_dir, cfg.checkpoint_every_steps, state, cfg.resume,
         async_save=cfg.async_checkpoint,
     )
+    restored_step = int(jax.device_get(state.step))
+    if restored_step:
+        train_iter.fast_forward(restored_step * cfg.grad_accum_steps)
 
     def val_batches():
         if len(images_v) < local_bs:

@@ -205,3 +205,25 @@ def test_resize_bilinear_matches_tf_golden():
         ours = resize_bilinear_tf(img, h, w)
         golden = tf.image.resize(img, (h, w), method="bilinear").numpy()
         np.testing.assert_allclose(ours, golden, atol=1e-3, rtol=1e-5)
+
+
+def test_batch_iterator_fast_forward_exact_order():
+    """Resume continuation: a fresh iterator fast-forwarded by k draws
+    must produce the identical remaining sequence — mid-epoch, at epoch
+    boundaries, and across reshuffles."""
+    x = np.arange(23)
+    for k in (0, 1, 3, 4, 5, 8, 11, 12):  # spe = 23//5 = 4
+        ref = BatchIterator({"x": x}, batch_size=5, seed=7)
+        for _ in range(k):
+            next(ref)
+        ffwd = BatchIterator({"x": x}, batch_size=5, seed=7).fast_forward(k)
+        for _ in range(9):
+            np.testing.assert_array_equal(next(ref)["x"], next(ffwd)["x"])
+
+
+def test_batch_iterator_fast_forward_rejects_negative():
+    import pytest as _pytest
+
+    it = BatchIterator({"x": np.arange(10)}, batch_size=5)
+    with _pytest.raises(ValueError):
+        it.fast_forward(-1)

@@ -72,9 +72,12 @@ def _wait_for_checkpoint(procs, ckdir, extra_ready=None, timeout_s=300):
 
     deadline = time.time() + timeout_s
     while time.time() < deadline:
-        # dead-worker check FIRST: an early crash must fail the wait
-        # even when a checkpoint already landed
-        dead = [i for i, p in enumerate(procs) if p.poll() is not None]
+        # crash check FIRST: an early nonzero exit must fail the wait
+        # even when a checkpoint already landed. A clean rc=0 exit is
+        # not a crash — the run simply finished fast; let the
+        # checkpoint condition decide.
+        dead = [i for i, p in enumerate(procs)
+                if p.poll() is not None and p.returncode != 0]
         steps = [d for d in (os.listdir(ckdir) if os.path.isdir(ckdir) else [])
                  if d.isdigit()]
         if not dead and steps and (extra_ready is None or extra_ready()):

@@ -1,0 +1,142 @@
+"""Analytic roofline report for the bench workloads.
+
+Task: explain measured MFU (e.g. ResNet-50's 29% at batch 64 in round 2)
+from the compiled program itself, not vibes. XLA's cost model exposes,
+per compiled executable, the total FLOPs and the bytes it moves; the
+ratio (arithmetic intensity) against the chip's compute/bandwidth ridge
+point says whether a workload CAN reach high MFU at all:
+
+    attainable FLOP/s = min(peak_flops, AI * hbm_bandwidth)
+    AI                = flops / bytes_accessed
+
+For a v5e (197 bf16 TFLOP/s, ~819 GB/s HBM) the ridge is ~240 FLOP/B;
+programs below it are bandwidth-bound and their MFU ceiling is AI/ridge
+regardless of kernel quality. The report prints, per workload: FLOPs,
+bytes, AI, the roofline MFU ceiling, and (when run on the real chip)
+measured step time + achieved MFU vs that ceiling — separating "kernel
+is slow" (measured far below the analytic ceiling) from "workload is
+bandwidth-bound" (ceiling itself is low, so raise the per-chip batch or
+fuse more).
+
+Workload construction, FLOPs counting, and chip peaks are IMPORTED from
+``bench.py`` (``build_workload`` / ``step_flops`` / ``peak_flops_for``)
+— this tool always analyzes exactly the program the bench measures.
+
+Usage::
+
+    python tools/roofline.py resnet50 [--batch 64] [--measure]
+    python tools/roofline.py cnn bert resnet50 --batch 64 --measure
+
+Without ``--measure`` it only compiles (safe on the CPU fake slice —
+pass ``--cpu``); with it, it also times steps on the attached backend.
+Appends nothing to the bench history — this is a diagnosis tool; the
+bench owns the evidence trail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+# HBM bytes/s per chip — the bandwidth half of the roofline; the compute
+# half comes from bench.PEAK_BF16_FLOPS via peak_flops_for.
+HBM_BYTES_PER_S = {
+    "v5 lite": 8.19e11,
+    "v5e": 8.19e11,
+    "v5p": 2.765e12,
+    "v4": 1.2e12,
+    "v6": 1.64e12,
+}
+
+
+def hbm_bw_for(device_kind: str):
+    kind = device_kind.lower()
+    for key, bw in HBM_BYTES_PER_S.items():
+        if key in kind:
+            return bw
+    return None
+
+
+def analyze(name: str, batch: int, measure: bool, steps: int = 30) -> dict:
+    import jax
+
+    from bench import build_workload, measure as timed, peak_flops_for
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    trainer, batch_dict, batch_size, _ = build_workload(
+        name, batch_override=batch)
+    state = trainer.init_state(make_rng(1337), batch_dict)
+    sharding = batch_sharding(trainer.mesh)
+    gb = {k: jax.device_put(v, sharding) for k, v in batch_dict.items()}
+
+    device_kind = getattr(jax.devices()[0], "device_kind", "cpu")
+    peak_flops = peak_flops_for(device_kind)
+    hbm_bw = hbm_bw_for(device_kind)
+
+    if trainer._train_step is None:
+        trainer._build_steps()
+    with trainer.mesh:
+        compiled = trainer._train_step.lower(state, gb).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    flops = float(cost.get("flops", 0.0))
+    bytes_accessed = float(cost.get("bytes accessed", 0.0))
+    ai = flops / bytes_accessed if bytes_accessed else None
+
+    out = {
+        "workload": f"{name} b{batch_size}",
+        "device_kind": device_kind,
+        "flops_per_step": flops,
+        "bytes_accessed_per_step": bytes_accessed,
+        "arithmetic_intensity": round(ai, 2) if ai else None,
+    }
+    if peak_flops and hbm_bw and ai:
+        ridge = peak_flops / hbm_bw
+        attainable = min(peak_flops, ai * hbm_bw)
+        out.update({
+            "ridge_flops_per_byte": round(ridge, 1),
+            "bound": "compute" if ai >= ridge else "bandwidth",
+            "mfu_ceiling": round(attainable / peak_flops, 4),
+            "ideal_step_ms": round(flops / attainable * 1000.0, 3),
+        })
+    if measure:
+        _, _, dt = timed(trainer, state, gb, steps)
+        step_s = dt / steps
+        out["measured_step_ms"] = round(step_s * 1000.0, 3)
+        if peak_flops:
+            out["measured_mfu"] = round(flops / (step_s * peak_flops), 4)
+            if "mfu_ceiling" in out:
+                out["ceiling_fraction_achieved"] = round(
+                    out["measured_mfu"] / out["mfu_ceiling"], 3)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("workloads", nargs="+", help="cnn | resnet50 | bert")
+    ap.add_argument("--batch", type=int, default=0, help="override batch size")
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--measure", action="store_true",
+                    help="also time steps on the attached backend")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU fake slice (compile-only analysis)")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    for name in args.workloads:
+        print(json.dumps(analyze(name, args.batch, args.measure, args.steps)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
