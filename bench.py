@@ -24,7 +24,7 @@ classification (batch 64, 224²) and BERT-base sequence classification
 (batch 32, S=128); same JSON shape, ``vs_baseline`` null (the reference
 has no such workloads to compare against). ``python bench.py io``
 measures the native input pipeline (TFRecord shards → host batches);
-``python bench.py generate [--kv-heads N] [--int8] [--beams K]``
+``python bench.py generate [--kv-heads N] [--int8] [--int8-kv] [--beams K]``
 measures KV-cache decode tokens/sec on the serving path (GQA, weight-
 only int8, beam search).
 
@@ -382,13 +382,16 @@ def bench_workload(name: str, steps: int = 50, smoke: bool = False,
 
 
 def bench_decode(smoke: bool = False, kv_heads=None, int8: bool = False,
-                 num_beams: int = 0) -> dict:
+                 num_beams: int = 0, int8_kv: bool = False) -> dict:
     """Serving-path throughput (BASELINE has no analog — this benches the
     framework's own KV-cache generation): one jitted prefill + scan
     decode on a GPT-small-shaped causal LM. Reports decode tokens/sec
     per chip and the prefill latency. ``--kv-heads N`` measures the GQA
     variant (smaller cache → less HBM traffic per decode step);
-    ``--int8`` measures weight-only int8 quantized serving
+    ``--int8-kv`` stores the KV cache itself as int8 with per-(position,
+    head) scales (models/causal_lm.py kv_cache_quant — the cache stream
+    is the other decode bottleneck); ``--int8`` measures weight-only
+    int8 quantized serving
     (ops/quant.py — 4× less weight-streaming traffic vs f32 params);
     ``--beams K`` measures beam-search decode (tokens/sec counts the
     selected sequence's tokens — compute is K× wider)."""
@@ -408,11 +411,13 @@ def bench_decode(smoke: bool = False, kv_heads=None, int8: bool = False,
         cfg = CausalLMConfig(vocab_size=512, hidden_size=64, num_layers=2,
                              num_heads=4, intermediate_size=128,
                              max_seq_len=64, dtype=jnp.float32,
-                             num_kv_heads=int(kv_heads) if kv_heads else None)
+                             num_kv_heads=int(kv_heads) if kv_heads else None,
+                             kv_cache_quant=int8_kv)
         batch, s_prompt, n_new = 2, 16, 8
     else:
         cfg = CausalLMConfig(
-            num_kv_heads=int(kv_heads) if kv_heads else None)  # GPT-small shape
+            num_kv_heads=int(kv_heads) if kv_heads else None,  # GPT-small shape
+            kv_cache_quant=int8_kv)
         batch, s_prompt, n_new = 8, 128, 512
 
     model = CausalLM(cfg)
@@ -487,6 +492,7 @@ def bench_decode(smoke: bool = False, kv_heads=None, int8: bool = False,
         "kv_heads": cfg.kv_heads,
         "num_heads": cfg.num_heads,
         "int8_weights": int8,
+        "int8_kv_cache": int8_kv,
         "num_beams": num_beams or None,
         "params_mb": round(params_mb, 1),
         "dense_params_mb": round(dense_mb, 1),
@@ -653,6 +659,7 @@ ALL_WORKLOADS = (
     ["generate"],
     ["generate", "--kv-heads", "2"],
     ["generate", "--kv-heads", "2", "--int8"],
+    ["generate", "--kv-heads", "2", "--int8", "--int8-kv"],
     ["generate", "--beams", "4"],
     ["io"],
 )
@@ -765,7 +772,7 @@ def run_bench(argv) -> dict:
             except (IndexError, ValueError):
                 raise SystemExit("usage: bench.py generate --beams <positive int>")
         return bench_decode(smoke=smoke, kv_heads=kv, int8="--int8" in argv,
-                            num_beams=beams)
+                            num_beams=beams, int8_kv="--int8-kv" in argv)
     use_flash = True if "--flash" in argv else (False if "--no-flash" in argv else None)
     seq = None
     if "--seq" in argv:

@@ -25,22 +25,17 @@ class KMeansSparkWorkload:
     def __init__(self, logger=None):
         self.logger = logger
 
-    def k_means(self, input_df):
-        _require_pyspark()
-        from pyspark.ml import Pipeline
-        from pyspark.ml.clustering import KMeans
-        from pyspark.ml.feature import OneHotEncoder, StringIndexer, VectorAssembler
+    @staticmethod
+    def _clean(input_df):
+        """The eager prep the reference applies OUTSIDE its pipeline
+        (``k_means.py:27-51``): drop null measure_name rows, mean-impute
+        NaN/null numerics. Shared by fit and evaluation — anything that
+        transforms through the fitted pipeline must see the same prep,
+        or NaNs ride through VectorAssembler(handleInvalid='keep')."""
         from pyspark.sql.functions import col, isnan, when
 
         input_df = input_df.filter(col("measure_name").isNotNull())
-
-        stages = [
-            StringIndexer(inputCol="measure_name", outputCol="measure_name_index",
-                          handleInvalid="keep"),
-            OneHotEncoder(inputCol="measure_name_index", outputCol="measure_name_vec"),
-        ]
-        numeric_cols = ["value", "lower_ci", "upper_ci"]
-        for name in numeric_cols:
+        for name in ("value", "lower_ci", "upper_ci"):
             if name in input_df.columns:
                 mean_val = (
                     input_df.select(name)
@@ -52,6 +47,22 @@ class KMeansSparkWorkload:
                     name,
                     when(col(name).isNull() | isnan(col(name)), mean_val).otherwise(col(name)),
                 )
+        return input_df
+
+    def k_means(self, input_df):
+        _require_pyspark()
+        from pyspark.ml import Pipeline
+        from pyspark.ml.clustering import KMeans
+        from pyspark.ml.feature import OneHotEncoder, StringIndexer, VectorAssembler
+
+        input_df = self._clean(input_df)
+
+        stages = [
+            StringIndexer(inputCol="measure_name", outputCol="measure_name_index",
+                          handleInvalid="keep"),
+            OneHotEncoder(inputCol="measure_name_index", outputCol="measure_name_vec"),
+        ]
+        numeric_cols = ["value", "lower_ci", "upper_ci"]
 
         try:
             repeats = int(os.environ.get("MEASURE_NAME_WEIGHT", "5"))
@@ -75,6 +86,27 @@ class KMeansSparkWorkload:
         type(self).pipeline_model = pipeline_model
         type(self).kmeans_model = model
         return pipeline_model, model
+
+    def silhouette(self, input_df=None) -> float:
+        """Silhouette score (squared euclidean) of the fitted clustering —
+        the reference's cloud integration check computes exactly this
+        (``spark_checks/python_checks/spark_workload_to_cloud_k8s.py:141-144``).
+        Pass the training DataFrame (or any frame with the same columns)."""
+        _require_pyspark()
+        from pyspark.ml.evaluation import ClusteringEvaluator
+
+        cls = type(self)
+        if cls.pipeline_model is None or cls.kmeans_model is None:
+            raise RuntimeError("Run k_means() before evaluation.")
+        if input_df is None:
+            raise ValueError("silhouette needs the DataFrame to score")
+        dataset = cls.pipeline_model.transform(
+            self._clean(input_df)).select("features")
+        preds = cls.kmeans_model.transform(dataset)
+        return float(ClusteringEvaluator(
+            featuresCol="features", predictionCol="prediction",
+            metricName="silhouette",
+            distanceMeasure="squaredEuclidean").evaluate(preds))
 
     def infer_single_row(self, spark, entry_str: str = "Able-Bodied", entry_num: int = 0):
         cls = type(self)

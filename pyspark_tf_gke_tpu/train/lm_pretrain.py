@@ -72,6 +72,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--num-heads", type=int, default=int(e("NUM_HEADS", "12")))
     p.add_argument("--num-kv-heads", type=int, default=int(e("NUM_KV_HEADS", "0")),
                    help=">0 enables grouped-query attention (1 = MQA)")
+    p.add_argument("--kv-cache-quant", action="store_true",
+                   default=e("KV_CACHE_QUANT", "") == "1",
+                   help="exported bundle serves with an int8 KV cache "
+                        "(per-row scales; 4x less decode cache traffic "
+                        "vs f32, stacks with GQA)")
     p.add_argument("--pos-embedding", default=e("POS_EMBEDDING") or None,
                    choices=["learned", "rope"],
                    help="rope = rotary q/k embeddings (no position table, "
@@ -194,6 +199,7 @@ def main(argv=None) -> dict:
         max_seq_len=args.seq_len,
         dtype=jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32,
         remat=args.remat,
+        kv_cache_quant=args.kv_cache_quant,
     )
     mesh = make_mesh(parse_mesh_shape(args.mesh_shape) or None)
     model = CausalLM(cfg, mesh=mesh)

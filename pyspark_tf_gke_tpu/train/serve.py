@@ -70,11 +70,20 @@ class BundleServer:
     ``shard_params_for_serving`` and every call runs under the mesh
     context (XLA inserts the collectives)."""
 
-    def __init__(self, bundle_dir: str, mesh=None):
+    def __init__(self, bundle_dir: str, mesh=None, int8_kv: bool = False):
         from pyspark_tf_gke_tpu.data.text import get_tokenizer
         from pyspark_tf_gke_tpu.train.export import load_serving_bundle
 
         self.model, params, self.meta = load_serving_bundle(bundle_dir)
+        if int8_kv and not self.model.cfg.kv_cache_quant:
+            # cache layout is a serving-time choice (params unchanged) —
+            # allow turning it on for bundles exported without the flag
+            import dataclasses
+
+            from pyspark_tf_gke_tpu.models import CausalLM
+
+            self.model = CausalLM(
+                dataclasses.replace(self.model.cfg, kv_cache_quant=True))
         self.tokenizer = get_tokenizer(self.meta.get("tokenizer", "byte"))
         if self.tokenizer.vocab_size > self.model.cfg.vocab_size:
             raise ValueError(
@@ -352,6 +361,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--port", type=int, default=int(e("SERVE_PORT", "8000")))
     p.add_argument("--tp", type=int, default=int(e("SERVE_TP", "0")),
                    help="tensor-parallel ways (0/1 = single device)")
+    p.add_argument("--int8-kv", action="store_true",
+                   default=e("SERVE_INT8_KV", "") == "1",
+                   help="serve with an int8 KV cache even if the bundle "
+                        "wasn't exported with one")
     p.add_argument("--stdin", action="store_true",
                    help="serve stdin lines instead of HTTP: each input "
                         "line is a prompt, each output line a JSON result")
@@ -385,7 +398,8 @@ def main(argv=None) -> int:
         from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh({"tp": args.tp}, jax.devices()[:args.tp])
-    server = BundleServer(_resolve_bundle(args.bundle), mesh=mesh)
+    server = BundleServer(_resolve_bundle(args.bundle), mesh=mesh,
+                          int8_kv=args.int8_kv)
     logger.info("bundle loaded: %s", server.health())
 
     if args.stdin:

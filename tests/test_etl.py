@@ -375,6 +375,11 @@ def test_spark_local2_kmeans_flagship_workload(spark_local, monkeypatch):
         assert pred in (0, 1, 2)
         assert preds_df.count() == 1
 
+    # the reference cloud check's quality gate: well-separated synthetic
+    # clusters must score a clearly positive silhouette
+    score = wl.silhouette(df)
+    assert 0.0 < score <= 1.0
+
 
 @pytest.mark.slow
 def test_spark_local2_text_bridge_packed_tokens(spark_local, tmp_path):
