@@ -227,7 +227,7 @@ def build_workload(name: str, smoke: bool = False, batch_override: int = 0,
         extra["seq_len"] = seq
     else:
         raise SystemExit(
-            f"unknown workload {name!r}; use cnn | resnet50 | bert | generate | io")
+            f"unknown workload {name!r}; use cnn | resnet50 | bert | generate | spec | io")
     return trainer, batch, batch_size, extra
 
 
@@ -378,6 +378,83 @@ def bench_workload(name: str, steps: int = 50, smoke: bool = False,
         "n_chips": n_chips,
         "device_kind": device_kind,
         **extra,
+    }
+
+
+def bench_spec_decode(smoke: bool = False, gamma: int = 4) -> dict:
+    """Speculative decoding (models/speculative.py): GPT-small target +
+    a 2-layer draft at half hidden. Random weights mean near-zero
+    acceptance — the realistic LOWER bound (a trained draft/target pair
+    sits between this and the perfect-draft upper bound, which is also
+    reported via a self-draft pass). What this measures on hardware is
+    the real cost of the chunk-verify forward vs per-token decode."""
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
+    from pyspark_tf_gke_tpu.models.speculative import speculative_generate
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    devices = jax.devices()
+    device_kind = devices[0].device_kind
+    if smoke:
+        tcfg = CausalLMConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                              num_heads=4, intermediate_size=128,
+                              max_seq_len=64, dtype=jnp.float32)
+        dcfg = CausalLMConfig(vocab_size=512, hidden_size=32, num_layers=1,
+                              num_heads=2, intermediate_size=64,
+                              max_seq_len=64, dtype=jnp.float32)
+        s_prompt, n_new = 16, 8
+    else:
+        tcfg = CausalLMConfig()  # GPT-small shape
+        dcfg = CausalLMConfig(hidden_size=384, num_layers=2, num_heads=6,
+                              intermediate_size=1536)
+        s_prompt, n_new = 128, 256
+    target, draft = CausalLM(tcfg), CausalLM(dcfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, tcfg.vocab_size, (1, s_prompt)).astype(np.int32))
+    tparams = nn.meta.unbox(
+        jax.jit(target.init)(make_rng(1337), prompt[:, :8])["params"])
+    dparams = nn.meta.unbox(
+        jax.jit(draft.init)(make_rng(7), prompt[:, :8])["params"])
+
+    def run(dm, dp):
+        out, stats = speculative_generate(
+            target, tparams, dm, dp, prompt, max_new_tokens=n_new,
+            gamma=gamma, return_stats=True)
+        np.asarray(out)  # completion barrier
+        return stats
+
+    run(draft, dparams)  # compile both round shapes
+    t0 = time.perf_counter()
+    stats = run(draft, dparams)
+    dt = time.perf_counter() - t0
+
+    run(target, tparams)  # perfect-draft upper bound (self-draft)
+    t0 = time.perf_counter()
+    stats_ub = run(target, tparams)
+    dt_ub = time.perf_counter() - t0
+
+    return {
+        "metric": "causal_lm_speculative_tokens_per_sec",
+        "value": round(n_new / dt, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "gamma": gamma,
+        "acceptance_rate": round(stats["accepted"] / max(stats["proposed"], 1), 3),
+        "tokens_per_round": round(stats["tokens_per_round"], 2),
+        "upper_bound_tokens_per_sec": round(n_new / dt_ub, 1),
+        "upper_bound_acceptance": round(
+            stats_ub["accepted"] / max(stats_ub["proposed"], 1), 3),
+        "new_tokens": n_new,
+        "prompt_len": s_prompt,
+        "device_kind": device_kind,
+        "workload": (f"speculative decode: target {tcfg.num_layers}L "
+                     f"h{tcfg.hidden_size} + draft {dcfg.num_layers}L "
+                     f"h{dcfg.hidden_size} (random weights: lower bound; "
+                     f"self-draft: upper bound)"),
     }
 
 
@@ -566,7 +643,7 @@ def bench_io(smoke: bool = False) -> dict:
 # ---- orchestrator ----------------------------------------------------------
 
 
-_VALUE_FLAGS = ("--seq", "--kv-heads", "--beams")
+_VALUE_FLAGS = ("--seq", "--kv-heads", "--beams", "--gamma")
 
 
 def _positionals(argv) -> list:
@@ -661,6 +738,7 @@ ALL_WORKLOADS = (
     ["generate", "--kv-heads", "2", "--int8"],
     ["generate", "--kv-heads", "2", "--int8", "--int8-kv"],
     ["generate", "--beams", "4"],
+    ["spec"],
     ["io"],
 )
 
@@ -753,6 +831,16 @@ def run_bench(argv) -> dict:
         return main(batch_size=8, steps=2, throughput_batch=0) if smoke else main()
     if workload == "io":
         return bench_io(smoke=smoke)
+    if workload == "spec":
+        gamma = 4
+        if "--gamma" in argv:
+            try:
+                gamma = int(argv[argv.index("--gamma") + 1])
+                if gamma < 1:
+                    raise ValueError
+            except (IndexError, ValueError):
+                raise SystemExit("usage: bench.py spec --gamma <positive int>")
+        return bench_spec_decode(smoke=smoke, gamma=gamma)
     if workload == "generate":
         kv = None
         if "--kv-heads" in argv:

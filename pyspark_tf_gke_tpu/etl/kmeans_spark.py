@@ -25,29 +25,39 @@ class KMeansSparkWorkload:
     def __init__(self, logger=None):
         self.logger = logger
 
-    @staticmethod
-    def _clean(input_df):
+    impute_means = None  # numeric-column means captured at fit time
+
+    @classmethod
+    def _clean(cls, input_df, means=None):
         """The eager prep the reference applies OUTSIDE its pipeline
         (``k_means.py:27-51``): drop null measure_name rows, mean-impute
         NaN/null numerics. Shared by fit and evaluation — anything that
         transforms through the fitted pipeline must see the same prep,
-        or NaNs ride through VectorAssembler(handleInvalid='keep')."""
+        or NaNs ride through VectorAssembler(handleInvalid='keep').
+        ``means`` (fit-time values) keeps evaluation imputing with the
+        SAME constants the model was trained with; None computes and
+        returns fresh ones (the fit path)."""
         from pyspark.sql.functions import col, isnan, when
 
         input_df = input_df.filter(col("measure_name").isNotNull())
+        used = {}
         for name in ("value", "lower_ci", "upper_ci"):
             if name in input_df.columns:
-                mean_val = (
-                    input_df.select(name)
-                    .filter(~isnan(col(name)) & col(name).isNotNull())
-                    .agg({name: "avg"})
-                    .collect()[0][0]
-                )
+                if means is not None and name in means:
+                    mean_val = means[name]
+                else:
+                    mean_val = (
+                        input_df.select(name)
+                        .filter(~isnan(col(name)) & col(name).isNotNull())
+                        .agg({name: "avg"})
+                        .collect()[0][0]
+                    )
+                used[name] = mean_val
                 input_df = input_df.withColumn(
                     name,
                     when(col(name).isNull() | isnan(col(name)), mean_val).otherwise(col(name)),
                 )
-        return input_df
+        return input_df, used
 
     def k_means(self, input_df):
         _require_pyspark()
@@ -55,7 +65,8 @@ class KMeansSparkWorkload:
         from pyspark.ml.clustering import KMeans
         from pyspark.ml.feature import OneHotEncoder, StringIndexer, VectorAssembler
 
-        input_df = self._clean(input_df)
+        input_df, means = self._clean(input_df)
+        type(self).impute_means = means
 
         stages = [
             StringIndexer(inputCol="measure_name", outputCol="measure_name_index",
@@ -100,8 +111,8 @@ class KMeansSparkWorkload:
             raise RuntimeError("Run k_means() before evaluation.")
         if input_df is None:
             raise ValueError("silhouette needs the DataFrame to score")
-        dataset = cls.pipeline_model.transform(
-            self._clean(input_df)).select("features")
+        cleaned, _ = self._clean(input_df, means=cls.impute_means)
+        dataset = cls.pipeline_model.transform(cleaned).select("features")
         preds = cls.kmeans_model.transform(dataset)
         return float(ClusteringEvaluator(
             featuresCol="features", predictionCol="prediction",

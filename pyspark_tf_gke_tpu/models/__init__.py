@@ -5,6 +5,7 @@ from pyspark_tf_gke_tpu.models.bert import BertConfig, BertEncoder, BertForPretr
 from pyspark_tf_gke_tpu.models.pipelined_bert import PipelinedBertClassifier
 from pyspark_tf_gke_tpu.models.moe import MoELayer
 from pyspark_tf_gke_tpu.models.beam_search import beam_search
+from pyspark_tf_gke_tpu.models.speculative import speculative_generate
 from pyspark_tf_gke_tpu.models.causal_lm import CausalLM, CausalLMConfig, generate, llama_like
 
 __all__ = [
@@ -21,6 +22,7 @@ __all__ = [
     "CausalLMConfig",
     "generate",
     "beam_search",
+    "speculative_generate",
     "llama_like",
     "build_model",
 ]

@@ -303,22 +303,23 @@ class CausalSelfAttention(nn.Module):
         cache[-1].value = jnp.asarray(s, jnp.int32)
 
     def _decode_attend(self, q, k, v):
-        """One-token step against the static-shape KV cache. The cache
-        is a flax "cache" variable [B, S_max, H_kv, D]; ``cache_index``
-        tracks the fill level, and a position mask (not a dynamic slice
-        shape) hides the unwritten suffix. With GQA the grouped einsum
-        reads each cached KV head once for its whole query group — the
-        HBM traffic drops by num_heads/kv_heads."""
+        """A decode step against the static-shape KV cache: one token,
+        or a CHUNK of s tokens (speculative decoding scores a whole
+        draft proposal in one forward). The cache is a flax "cache"
+        variable [B, S_max, H_kv, D]; ``cache_index`` tracks the fill
+        level, and a position mask (not a dynamic slice shape) hides the
+        unwritten suffix — chunk queries get the causal offset mask
+        ``k_pos <= pos + q_idx``. With GQA the grouped einsum reads each
+        cached KV head once for its whole query group — the HBM traffic
+        drops by num_heads/kv_heads."""
         cfg = self.cfg
         b, s, h, d = q.shape
         hkv = k.shape[2]
-        if s != 1:
-            raise ValueError(f"decode step expects one token, got seq {s}")
         cache = self._cache_vars(b, hkv, d, k.dtype)
         ck, cv, ks, vs, idx = cache
         pos = idx.value
         self._cache_write(cache, pos, k, v)
-        idx.value = pos + 1
+        idx.value = pos + s
 
         # int8 cache: dequantize in-einsum — XLA streams int8 + the tiny
         # [B,S,H] scales from HBM and fuses convert*scale into the
@@ -331,14 +332,16 @@ class CausalSelfAttention(nn.Module):
         else:
             kf, vf = ck.value, cv.value
 
-        # [B,1,Hkv,G,D] x [B,S_max,Hkv,D] -> [B,Hkv,G,1,S_max], masked
-        # past the fill (G = query heads per KV head; G=1 is plain MHA).
+        # [B,s,Hkv,G,D] x [B,S_max,Hkv,D] -> [B,Hkv,G,s,S_max], masked
+        # causally past each query's own position (G = query heads per
+        # KV head; G=1 is plain MHA).
         g = h // hkv
         q5 = q.reshape(b, s, hkv, g, d)
         scores = jnp.einsum("bqhgd,bkhd->bhgqk", q5, kf,
                             preferred_element_type=jnp.float32) * (d ** -0.5)
-        valid = (jnp.arange(cfg.max_seq_len) <= pos)[None, None, None, None, :]
-        scores = jnp.where(valid, scores, NEG_INF)
+        valid = (jnp.arange(cfg.max_seq_len)[None, :]
+                 <= pos + jnp.arange(s)[:, None])  # [s, S_max]
+        scores = jnp.where(valid[None, None, None, :, :], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
         return out.reshape(b, s, h, d)

@@ -1,0 +1,221 @@
+"""Speculative decoding: a small draft model proposes, the target model
+verifies — greedy-exact.
+
+No counterpart in the reference (it has no serving at all; SURVEY §5).
+This is the latency lever for single-stream serving: autoregressive
+decode runs one HBM-bound step per token, but a TARGET-model forward
+over a CHUNK of gamma+1 tokens costs barely more than one step (same
+weight streaming, gamma+1 columns of compute). So a cheap draft model
+autoregresses gamma candidate tokens, and the target scores the whole
+proposal in ONE chunk forward against its KV cache
+(``CausalSelfAttention._decode_attend`` handles s>1 with the causal
+offset mask). Accepted prefix + one correction token emit per round:
+between 1 and gamma+1 tokens per target forward.
+
+Greedy acceptance (``d_i == argmax(target logits at i-1)``) makes the
+output PROVABLY identical to plain greedy decoding of the target model
+— ``tests/test_speculative.py`` asserts token-for-token equality, and
+the draft model only affects speed, never content.
+
+Cache bookkeeping: both models' caches are flax "cache" pytrees whose
+scalar ``index`` leaf is the fill level and whose suffix past it is
+masked, so ROLLBACK after a rejected proposal is just resetting
+``index`` — the stale K/V rows beyond it are invisible and will be
+overwritten. Batch is restricted to 1: acceptance length varies per
+row, and the scalar fill index (deliberately scalar — it keeps decode
+masks cheap) cannot roll rows back independently. Speculation is a
+latency tool; batch throughput is better served by plain batched decode.
+
+The round loop runs on the host (each round needs the accepted count —
+the classic speculative-decoding sync); the per-round pieces (draft
+scan, target chunk forward) are module-level jits keyed by static
+shapes, so steady-state rounds compile nothing.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pyspark_tf_gke_tpu.models.causal_lm import CausalLM, _prefill
+
+
+def _set_cache_index(cache, value):
+    """Return a cache pytree with every scalar ``index`` leaf set to
+    ``value`` (rollback / sync). Structure-generic: works per layer."""
+    val = jnp.asarray(value, jnp.int32)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: val
+        if any(getattr(k, "key", None) == "index" for k in path) else leaf,
+        cache)
+
+
+@partial(jax.jit, static_argnames=("model",))
+def _extend(model: CausalLM, params, cache, chunk, pos):
+    """Feed ``chunk [B, c]`` against the cache at fill ``pos``: returns
+    logits ``[B, c, V]`` for every chunk position and the updated cache
+    (fill = pos + c). One forward — this is the verify step."""
+    from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
+
+    b, c = chunk.shape
+    positions = pos + jnp.arange(c, dtype=jnp.int32)[None, :]
+    logits, mutated = model.apply(
+        {"params": dequantize_tree(params), "cache": cache}, chunk,
+        decode=True, positions=jnp.broadcast_to(positions, (b, c)),
+        mutable=["cache"])
+    return logits, mutated["cache"]
+
+
+@partial(jax.jit, static_argnames=("model", "gamma"))
+def _draft_propose(model: CausalLM, params, cache, last_tok, pos, gamma: int):
+    """Greedy-autoregress ``gamma`` draft tokens starting from
+    ``last_tok`` at fill ``pos``. Returns proposals ``[B, gamma]`` and
+    the updated draft cache (which now holds last_tok .. d_{gamma-1})."""
+    from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
+
+    p = dequantize_tree(params)
+    b = last_tok.shape[0]
+
+    def step(carry, t):
+        cache, tok = carry
+        logits, mutated = model.apply(
+            {"params": p, "cache": cache}, tok[:, None], decode=True,
+            positions=jnp.broadcast_to(pos + t, (b, 1)).astype(jnp.int32),
+            mutable=["cache"])
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        return (mutated["cache"], nxt), nxt
+
+    (cache, _), toks = jax.lax.scan(
+        step, (cache, last_tok), jnp.arange(gamma, dtype=jnp.int32))
+    return toks.T, cache  # [B, gamma]
+
+
+def speculative_generate(
+    target_model: CausalLM,
+    target_params,
+    draft_model: CausalLM,
+    draft_params,
+    prompt_ids,                      # [1, S_prompt] int32
+    max_new_tokens: int,
+    gamma: int = 4,
+    eos_token_id: Optional[int] = None,
+    return_stats: bool = False,
+) -> jnp.ndarray:
+    """Greedy generation from the TARGET model, accelerated by a draft.
+
+    Returns ``[1, S_prompt + max_new_tokens]`` — identical tokens to
+    ``generate(target_model, target_params, prompt_ids, ...)`` greedy
+    (after eos, positions pad with eos). With ``return_stats`` also
+    returns ``{"rounds": r, "proposed": p, "accepted": a}``.
+    """
+    if prompt_ids.shape[0] != 1:
+        raise ValueError(
+            f"speculative decoding is batch-1 (latency tool; the scalar "
+            f"cache fill index cannot roll rows back independently), "
+            f"got batch {prompt_ids.shape[0]}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if target_model.cfg.vocab_size != draft_model.cfg.vocab_size:
+        raise ValueError(
+            f"draft vocab {draft_model.cfg.vocab_size} != target vocab "
+            f"{target_model.cfg.vocab_size}: the models must share a "
+            f"tokenizer")
+    s_prompt = prompt_ids.shape[1]
+    if s_prompt + max_new_tokens > target_model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {s_prompt} + {max_new_tokens} new tokens exceeds the "
+            f"target's max_seq_len {target_model.cfg.max_seq_len}")
+    if s_prompt + max_new_tokens > draft_model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {s_prompt} + {max_new_tokens} new tokens exceeds the "
+            f"DRAFT's max_seq_len {draft_model.cfg.max_seq_len}")
+
+    # Prefill both models on the prompt. The target's last-token logits
+    # give the first emitted token for free.
+    t_cache, t_last = _prefill(target_model, target_params, prompt_ids)
+    d_cache, _ = _prefill(draft_model, draft_params, prompt_ids)
+
+    first = int(jnp.argmax(t_last, axis=-1)[0])
+    emitted = [first]
+    # fill levels: cache rows written so far (prompt only; the freshly
+    # emitted token is fed next round)
+    t_fill = d_fill = s_prompt
+    rounds = proposed = accepted_total = 0
+
+    while len(emitted) < max_new_tokens and (
+            eos_token_id is None or eos_token_id not in emitted):
+        rounds += 1
+        budget = max_new_tokens - len(emitted)
+        g = min(gamma, budget)
+
+        # 1. draft syncs on any emitted tokens it hasn't cached yet
+        #    (everything but the newest, which _draft_propose feeds):
+        #    the draft cache holds the first d_fill tokens of
+        #    prompt+emitted, so the gap is emitted[d_fill - s_prompt
+        #    : -1].
+        pending = emitted[d_fill - s_prompt:len(emitted) - 1]
+        if pending:
+            chunk = jnp.asarray([pending], jnp.int32)
+            _, d_cache = _extend(draft_model, draft_params, d_cache, chunk,
+                                 jnp.asarray(d_fill, jnp.int32))
+            d_fill += len(pending)
+        last_tok = jnp.asarray([emitted[-1]], jnp.int32)
+        drafts, d_cache = _draft_propose(
+            draft_model, draft_params, d_cache, last_tok,
+            jnp.asarray(d_fill, jnp.int32), g)
+        d_fill += g  # holds last_tok .. d_{g-1}
+        drafts_host = np.asarray(drafts)[0]  # [g]
+        proposed += g
+
+        # 2. target verifies the whole proposal in ONE chunk forward:
+        #    feed [last_tok, d_0..d_{g-1}] → logits for each position.
+        chunk = jnp.asarray(
+            [[emitted[-1], *drafts_host.tolist()]], jnp.int32)  # [1, g+1]
+        logits, t_cache = _extend(target_model, target_params, t_cache,
+                                  chunk, jnp.asarray(t_fill, jnp.int32))
+        t_fill += g + 1
+        preds = np.asarray(jnp.argmax(logits, axis=-1))[0]  # [g+1]
+
+        # 3. greedy acceptance: d_i is kept iff it equals the target's
+        #    own argmax at the position before it.
+        a = 0
+        while a < g and drafts_host[a] == preds[a]:
+            a += 1
+        accepted_total += a
+        # emit accepted drafts + the target's correction/extension token
+        emitted.extend(int(t) for t in drafts_host[:a])
+        if len(emitted) < max_new_tokens:
+            emitted.append(int(preds[a]))
+
+        # 4. rollback both caches to the verified prefix: prompt +
+        #    emitted tokens that have been FED (everything but the
+        #    newest). Index reset is the whole rollback — the masked
+        #    suffix is invisible and gets overwritten.
+        t_fill = s_prompt + len(emitted) - 1
+        d_fill = min(d_fill, t_fill)
+        t_cache = _set_cache_index(t_cache, t_fill)
+        d_cache = _set_cache_index(d_cache, d_fill)
+
+    # eos padding to the fixed output length (generate()'s contract)
+    out = emitted[:max_new_tokens]
+    if eos_token_id is not None and eos_token_id in out:
+        stop = out.index(eos_token_id)
+        out = out[:stop + 1] + [eos_token_id] * (max_new_tokens - stop - 1)
+    else:
+        out = out + [out[-1]] * (max_new_tokens - len(out))
+    result = jnp.concatenate(
+        [prompt_ids, jnp.asarray([out], jnp.int32)], axis=1)
+    if return_stats:
+        # the first token came free from the prefill, not from a round —
+        # excluding it keeps the stat within its gamma+1 ceiling
+        return result, {"rounds": rounds, "proposed": proposed,
+                        "accepted": accepted_total,
+                        "tokens_per_round": (len(emitted) - 1)
+                        / max(rounds, 1)}
+    return result

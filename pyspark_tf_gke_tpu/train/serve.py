@@ -57,6 +57,7 @@ logger = get_logger("train.serve")
 
 SCORE_BUCKET = 64
 MAX_BATCH = 64
+SPEC_GAMMA = 4  # speculative draft chunk width (echoed in responses)
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -70,11 +71,25 @@ class BundleServer:
     ``shard_params_for_serving`` and every call runs under the mesh
     context (XLA inserts the collectives)."""
 
-    def __init__(self, bundle_dir: str, mesh=None, int8_kv: bool = False):
+    def __init__(self, bundle_dir: str, mesh=None, int8_kv: bool = False,
+                 draft_bundle_dir: str = ""):
         from pyspark_tf_gke_tpu.data.text import get_tokenizer
         from pyspark_tf_gke_tpu.train.export import load_serving_bundle
 
         self.model, params, self.meta = load_serving_bundle(bundle_dir)
+        self.draft_model = self.draft_params = None
+        self.draft_bundle_dir = draft_bundle_dir
+        if draft_bundle_dir:
+            # speculative decoding: single-prompt greedy requests verify
+            # a cheap draft's proposals in chunk forwards — same tokens,
+            # fewer target steps (models/speculative.py)
+            self.draft_model, self.draft_params, _ = load_serving_bundle(
+                draft_bundle_dir)
+            if (self.draft_model.cfg.vocab_size
+                    != self.model.cfg.vocab_size):
+                raise ValueError(
+                    f"draft bundle vocab {self.draft_model.cfg.vocab_size} "
+                    f"!= target vocab {self.model.cfg.vocab_size}")
         if int8_kv and not self.model.cfg.kv_cache_quant:
             # cache layout is a serving-time choice (params unchanged) —
             # allow turning it on for bundles exported without the flag
@@ -96,6 +111,12 @@ class BundleServer:
             )
 
             params = shard_params_for_serving(self.model, params, mesh)
+            if self.draft_model is not None:
+                # the draft rides the same mesh — unsharded draft arrays
+                # would forfeit its tp memory/latency win and break on
+                # multi-host meshes
+                self.draft_params = shard_params_for_serving(
+                    self.draft_model, self.draft_params, mesh)
         self.params = params
         self.bundle_dir = bundle_dir
         self._lock = threading.Lock()  # one model, one device queue
@@ -114,6 +135,7 @@ class BundleServer:
             "tokenizer": self.meta.get("tokenizer", "byte"),
             "n_devices": len(jax.devices()),
             "tp": dict(self.mesh.shape).get("tp", 1) if self.mesh else 1,
+            "speculative_draft": self.draft_bundle_dir or None,
         }
 
     # -- generation ------------------------------------------------------
@@ -152,6 +174,39 @@ class BundleServer:
                     f"exceeds max_seq_len {cfg.max_seq_len}")
             encoded.append((i, ids))
 
+        use_spec = (self.draft_model is not None and len(prompts) == 1
+                    and not (temperature and temperature > 0)
+                    and not num_beams and repetition_penalty is None
+                    and top_k is None and top_p is None
+                    # a shorter draft context falls back to plain decode
+                    # rather than erroring a request the target can serve
+                    and len(encoded[0][1]) + max_new_tokens
+                    <= self.draft_model.cfg.max_seq_len)
+        if use_spec:
+            from pyspark_tf_gke_tpu.models.speculative import (
+                speculative_generate,
+            )
+
+            _, ids = encoded[0]
+            with self._lock:
+                t0 = time.perf_counter()
+                with self.mesh or contextlib.nullcontext():
+                    out, stats = speculative_generate(
+                        self.model, self.params, self.draft_model,
+                        self.draft_params, jnp.asarray([ids], jnp.int32),
+                        max_new_tokens=max_new_tokens, gamma=SPEC_GAMMA,
+                        eos_token_id=eos_id, return_stats=True)
+                dt = (time.perf_counter() - t0) * 1000.0
+            return [self._entry(
+                prompts[0], np.asarray(out[0, len(ids):]).tolist(), dt,
+                eos_id,
+                speculative={
+                    "gamma": SPEC_GAMMA,
+                    "acceptance_rate": round(
+                        stats["accepted"] / max(stats["proposed"], 1), 3),
+                    "tokens_per_round": round(stats["tokens_per_round"], 2),
+                })]
+
         groups = {}
         for i, ids in encoded:
             groups.setdefault(len(ids), []).append((i, ids))
@@ -187,19 +242,24 @@ class BundleServer:
                 toks = np.asarray(out[:n_real, length:])
                 dt = (time.perf_counter() - t0) * 1000.0
                 for row, (i, _) in enumerate(members):
-                    new = toks[row].tolist()
-                    if eos_id is not None and eos_id in new:
-                        new = new[:new.index(eos_id)]
-                    entry = {
-                        "prompt": prompts[i],
-                        "completion": prompts[i] + self.tokenizer.decode(new),
-                        "new_tokens": len(new),
-                        "latency_ms": round(dt, 2),
-                    }
-                    if scores is not None:
-                        entry["beam_score"] = float(scores[row])
-                    results[i] = entry
+                    extra = ({"beam_score": float(scores[row])}
+                             if scores is not None else {})
+                    results[i] = self._entry(prompts[i], toks[row].tolist(),
+                                             dt, eos_id, **extra)
         return results
+
+    def _entry(self, prompt, new_tokens, dt_ms, eos_id, **extra) -> dict:
+        """Shared response assembly: eos truncation + decode back to
+        text (one definition for the batched and speculative paths)."""
+        if eos_id is not None and eos_id in new_tokens:
+            new_tokens = new_tokens[:new_tokens.index(eos_id)]
+        return {
+            "prompt": prompt,
+            "completion": prompt + self.tokenizer.decode(new_tokens),
+            "new_tokens": len(new_tokens),
+            "latency_ms": round(dt_ms, 2),
+            **extra,
+        }
 
     # -- scoring ---------------------------------------------------------
 
@@ -365,6 +425,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default=e("SERVE_INT8_KV", "") == "1",
                    help="serve with an int8 KV cache even if the bundle "
                         "wasn't exported with one")
+    p.add_argument("--draft-bundle", default=e("DRAFT_BUNDLE_DIR", ""),
+                   help="a smaller bundle (same tokenizer/vocab) used as "
+                        "the speculative-decoding draft for single-prompt "
+                        "greedy requests — identical tokens, lower latency")
     p.add_argument("--stdin", action="store_true",
                    help="serve stdin lines instead of HTTP: each input "
                         "line is a prompt, each output line a JSON result")
@@ -398,8 +462,10 @@ def main(argv=None) -> int:
         from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh({"tp": args.tp}, jax.devices()[:args.tp])
-    server = BundleServer(_resolve_bundle(args.bundle), mesh=mesh,
-                          int8_kv=args.int8_kv)
+    server = BundleServer(
+        _resolve_bundle(args.bundle), mesh=mesh, int8_kv=args.int8_kv,
+        draft_bundle_dir=(_resolve_bundle(args.draft_bundle)
+                          if args.draft_bundle else ""))
     logger.info("bundle loaded: %s", server.health())
 
     if args.stdin:

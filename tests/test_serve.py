@@ -152,3 +152,33 @@ def test_sampling_varies_across_requests(endpoint):
     outs = {_post(endpoint, "/v1/generate", body)["completions"][0]["completion"]
             for _ in range(4)}
     assert len(outs) > 1
+
+
+def test_speculative_serving_same_tokens(tmp_path):
+    """A server with a draft bundle serves single-prompt greedy requests
+    through speculative decoding — identical completion to the plain
+    server, plus acceptance stats in the response."""
+    cfg = CausalLMConfig(**CFG)
+    model = CausalLM(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = nn.meta.unbox(jax.jit(model.init)(make_rng(5), ids)["params"])
+    target_dir = str(tmp_path / "target")
+    export_serving_bundle(cfg, params, target_dir, quantize=False)
+
+    dcfg = CausalLMConfig(**{**CFG, "hidden_size": 16, "num_layers": 1})
+    draft = CausalLM(dcfg)
+    dparams = nn.meta.unbox(jax.jit(draft.init)(make_rng(6), ids)["params"])
+    draft_dir = str(tmp_path / "draft")
+    export_serving_bundle(dcfg, dparams, draft_dir, quantize=False)
+
+    plain = BundleServer(target_dir)
+    spec = BundleServer(target_dir, draft_bundle_dir=draft_dir)
+    assert spec.health()["speculative_draft"] == draft_dir
+
+    ref = plain.generate(["hello tpu"], max_new_tokens=10)[0]
+    out = spec.generate(["hello tpu"], max_new_tokens=10)[0]
+    assert out["completion"] == ref["completion"]
+    assert "speculative" in out and "acceptance_rate" in out["speculative"]
+    # multi-prompt and sampling requests fall back to the batched path
+    multi = spec.generate(["ab", "cd"], max_new_tokens=4)
+    assert len(multi) == 2 and "speculative" not in multi[0]
