@@ -399,6 +399,14 @@ class CausalLM(nn.Module):
             raise ValueError(f"pos_embedding must be 'learned' or 'rope', "
                              f"got {cfg.pos_embedding!r}")
         b, s = input_ids.shape
+        if decode and s > 1 and positions is None:
+            # a decode CHUNK (speculative verify) embeds at absolute
+            # positions cache_fill..cache_fill+s-1, which only the
+            # caller knows — defaulting to arange(s) would silently
+            # misplace wpe/RoPE while the attention mask stays right
+            raise ValueError(
+                "multi-token decode requires explicit positions "
+                "(cache_fill + arange(s)); see models/speculative._extend")
         embed = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(

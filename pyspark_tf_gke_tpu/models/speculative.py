@@ -70,11 +70,29 @@ def _extend(model: CausalLM, params, cache, chunk, pos):
     return logits, mutated["cache"]
 
 
+@partial(jax.jit, static_argnames=("model",))
+def _extend_cache_only(model: CausalLM, params, cache, chunk, pos):
+    """Cache-side-effect-only extend for the draft resync: skips the
+    lm_head projection (``return_hidden=True``) — nobody reads these
+    logits, and the [c, vocab] matmul is the chunk's dominant cost."""
+    from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
+
+    b, c = chunk.shape
+    positions = pos + jnp.arange(c, dtype=jnp.int32)[None, :]
+    _, mutated = model.apply(
+        {"params": dequantize_tree(params), "cache": cache}, chunk,
+        decode=True, positions=jnp.broadcast_to(positions, (b, c)),
+        return_hidden=True, mutable=["cache"])
+    return mutated["cache"]
+
+
 @partial(jax.jit, static_argnames=("model", "gamma"))
 def _draft_propose(model: CausalLM, params, cache, last_tok, pos, gamma: int):
     """Greedy-autoregress ``gamma`` draft tokens starting from
     ``last_tok`` at fill ``pos``. Returns proposals ``[B, gamma]`` and
-    the updated draft cache (which now holds last_tok .. d_{gamma-1})."""
+    the updated draft cache, which now holds last_tok .. d_{gamma-2}
+    (the final proposal d_{gamma-1} is sampled but never fed, so it is
+    not cached — fill grows by exactly gamma rows)."""
     from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
 
     p = dequantize_tree(params)
@@ -162,14 +180,15 @@ def speculative_generate(
         pending = emitted[d_fill - s_prompt:len(emitted) - 1]
         if pending:
             chunk = jnp.asarray([pending], jnp.int32)
-            _, d_cache = _extend(draft_model, draft_params, d_cache, chunk,
-                                 jnp.asarray(d_fill, jnp.int32))
+            d_cache = _extend_cache_only(
+                draft_model, draft_params, d_cache, chunk,
+                jnp.asarray(d_fill, jnp.int32))
             d_fill += len(pending)
         last_tok = jnp.asarray([emitted[-1]], jnp.int32)
         drafts, d_cache = _draft_propose(
             draft_model, draft_params, d_cache, last_tok,
             jnp.asarray(d_fill, jnp.int32), g)
-        d_fill += g  # holds last_tok .. d_{g-1}
+        d_fill += g  # holds last_tok .. d_{g-2} (d_{g-1} never fed)
         drafts_host = np.asarray(drafts)[0]  # [g]
         proposed += g
 

@@ -153,6 +153,36 @@ class FaultInjector:
             raise InjectedFault(f"injected fault at step {step}")
 
 
+def _watch_main(argv=None) -> int:
+    """Standalone watchdog: ``python -m pyspark_tf_gke_tpu.train.resilience
+    --paths hb0.json,hb1.json --stall 60 [--timeout 3600]`` — exits 1
+    the moment any heartbeat goes stale (printing which), 0 if the
+    timeout passes without a stall. Compose with the shell/k8s for the
+    restart action: ``watch ... || kubectl rollout restart ...``. The
+    per-pod k8s probes embed the same logic; this entry supervises
+    local fake slices and bastion-side runs."""
+    import argparse
+    import json as _json
+
+    ap = argparse.ArgumentParser(description="heartbeat stall watchdog")
+    ap.add_argument("--paths", required=True,
+                    help="comma-separated heartbeat files")
+    ap.add_argument("--stall", type=float, default=60.0,
+                    help="seconds of heartbeat silence that count as hung")
+    ap.add_argument("--timeout", type=float, default=3600.0,
+                    help="give up (exit 0) after this many seconds")
+    ap.add_argument("--poll", type=float, default=1.0)
+    args = ap.parse_args(argv)
+    paths = [p for p in args.paths.split(",") if p]
+    stalled = detect_stall(paths, args.stall, args.timeout, args.poll)
+    if stalled:
+        print(_json.dumps({"stalled": stalled,
+                           "age_s": Heartbeat.age(stalled),
+                           "last": Heartbeat.read(stalled)}))
+        return 1
+    return 0
+
+
 def run_with_recovery(
     train_once: Callable[[int], T],
     max_restarts: int = 2,
@@ -179,3 +209,9 @@ def run_with_recovery(
             )
             if retry_delay_s:
                 time.sleep(retry_delay_s)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_watch_main())

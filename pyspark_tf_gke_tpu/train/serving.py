@@ -21,6 +21,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
 from jax.sharding import Mesh
 
@@ -52,7 +53,26 @@ def serving_shardings(model, params, mesh: Mesh, rules=LOGICAL_RULES):
     else:
         shardings = jax.tree.map(lambda _: NamedSharding(mesh, P()), abstract)
 
+    def fit_spec(spec, shape):
+        """Drop sharding on any dim the mesh extent doesn't divide
+        (e.g. a vocab-259 byte-tokenizer head over tp=2) — replicating
+        that one leaf beats failing the whole placement."""
+        out = []
+        for i, axes in enumerate(tuple(spec) + (None,) * (len(shape) - len(spec))):
+            if axes is None:
+                out.append(None)
+                continue
+            names = axes if isinstance(axes, tuple) else (axes,)
+            ways = int(np.prod([mesh.shape[a] for a in names]))
+            out.append(axes if shape[i] % ways == 0 else None)
+        return P(*out)
+
     def align(leaf, sh):
+        # np.shape: reading a host-numpy leaf's shape must not device-put
+        # the whole array (a tp-sized model can OOM one chip)
+        arr_shape = (leaf.q.shape if isinstance(leaf, QTensor)
+                     else np.shape(leaf))
+        sh = NamedSharding(mesh, fit_spec(sh.spec, arr_shape))
         if isinstance(leaf, QTensor):
             spec = sh.spec
             if jnp.asarray(leaf.scale).ndim == 2:

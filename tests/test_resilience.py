@@ -121,3 +121,25 @@ def test_cli_chaos_exhausted_raises(tmp_path):
             "--output-dir", str(tmp_path / "out2"), "--mesh-shape", "dp=8",
             "--fail-at-steps", "3",
         ])
+
+
+def test_watchdog_cli_detects_stale_and_clean(tmp_path, capsys):
+    import json
+    import time as _time
+
+    from pyspark_tf_gke_tpu.train.resilience import _watch_main
+
+    stale = tmp_path / "hb.json"
+    stale.write_text(json.dumps({"step": 3, "time": 1.0,
+                                 "process_index": 0, "process_count": 1}))
+    rc = _watch_main(["--paths", str(stale), "--stall", "5",
+                      "--timeout", "3", "--poll", "0.1"])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["stalled"] == str(stale) and out["last"]["step"] == 3
+
+    fresh = tmp_path / "hb2.json"
+    fresh.write_text(json.dumps({"step": 9, "time": _time.time() + 3600,
+                                 "process_index": 0, "process_count": 1}))
+    assert _watch_main(["--paths", str(fresh), "--stall", "60",
+                        "--timeout", "1", "--poll", "0.2"]) == 0

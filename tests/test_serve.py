@@ -182,3 +182,56 @@ def test_speculative_serving_same_tokens(tmp_path):
     # multi-prompt and sampling requests fall back to the batched path
     multi = spec.generate(["ab", "cd"], max_new_tokens=4)
     assert len(multi) == 2 and "speculative" not in multi[0]
+
+
+def test_speculative_serving_on_tp_mesh(tmp_path, devices):
+    """Draft params shard onto the same tp mesh as the target; the
+    speculative path must produce the plain tp server's tokens."""
+    from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
+
+    cfg = CausalLMConfig(**CFG)
+    model = CausalLM(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = nn.meta.unbox(jax.jit(model.init)(make_rng(7), ids)["params"])
+    target_dir = str(tmp_path / "t")
+    export_serving_bundle(cfg, params, target_dir, quantize=False)
+
+    dcfg = CausalLMConfig(**{**CFG, "hidden_size": 16, "num_layers": 1})
+    draft = CausalLM(dcfg)
+    dparams = nn.meta.unbox(jax.jit(draft.init)(make_rng(8), ids)["params"])
+    draft_dir = str(tmp_path / "d")
+    export_serving_bundle(dcfg, dparams, draft_dir, quantize=False)
+
+    mesh = make_mesh({"tp": 2}, devices[:2])
+    plain = BundleServer(target_dir, mesh=mesh)
+    spec = BundleServer(target_dir, mesh=mesh, draft_bundle_dir=draft_dir)
+    # the draft's divisible kernels actually shard onto the mesh (its
+    # vocab-259 head replicates — 259 % 2 != 0 falls back per leaf)
+    assert any(not l.sharding.is_fully_replicated
+               for l in jax.tree.leaves(spec.draft_params))
+    ref = plain.generate(["sharded tpu"], max_new_tokens=8)[0]
+    out = spec.generate(["sharded tpu"], max_new_tokens=8)[0]
+    assert out["completion"] == ref["completion"]
+    assert "speculative" in out
+
+
+def test_speculative_falls_back_beyond_draft_context(tmp_path):
+    """A request longer than the DRAFT's max_seq_len must serve through
+    the plain path (the target can handle it), not error."""
+    cfg = CausalLMConfig(**CFG)  # max_seq_len 64
+    model = CausalLM(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = nn.meta.unbox(jax.jit(model.init)(make_rng(9), ids)["params"])
+    target_dir = str(tmp_path / "t")
+    export_serving_bundle(cfg, params, target_dir, quantize=False)
+    dcfg = CausalLMConfig(**{**CFG, "max_seq_len": 16, "num_layers": 1})
+    draft = CausalLM(dcfg)
+    dparams = nn.meta.unbox(jax.jit(draft.init)(make_rng(10), ids)["params"])
+    draft_dir = str(tmp_path / "d")
+    export_serving_bundle(dcfg, dparams, draft_dir, quantize=False)
+
+    spec = BundleServer(target_dir, draft_bundle_dir=draft_dir)
+    out = spec.generate(["a prompt well past sixteen"],
+                        max_new_tokens=8)[0]  # 26 tokens > draft's 16
+    assert "speculative" not in out
+    assert out["new_tokens"] > 0
