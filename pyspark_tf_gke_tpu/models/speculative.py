@@ -35,7 +35,7 @@ shapes, so steady-state rounds compile nothing.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -54,36 +54,24 @@ def _set_cache_index(cache, value):
         cache)
 
 
-@partial(jax.jit, static_argnames=("model",))
-def _extend(model: CausalLM, params, cache, chunk, pos):
+@partial(jax.jit, static_argnames=("model", "cache_only"))
+def _extend(model: CausalLM, params, cache, chunk, pos,
+            cache_only: bool = False):
     """Feed ``chunk [B, c]`` against the cache at fill ``pos``: returns
-    logits ``[B, c, V]`` for every chunk position and the updated cache
-    (fill = pos + c). One forward — this is the verify step."""
+    ``(logits [B, c, V], cache)`` with fill = pos + c. One forward —
+    this is the verify step. ``cache_only`` (the draft resync) skips the
+    lm_head projection via ``return_hidden=True`` and returns
+    ``(None, cache)`` — nobody reads those logits, and the [c, vocab]
+    matmul is the chunk's dominant cost."""
     from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
 
     b, c = chunk.shape
     positions = pos + jnp.arange(c, dtype=jnp.int32)[None, :]
-    logits, mutated = model.apply(
+    out, mutated = model.apply(
         {"params": dequantize_tree(params), "cache": cache}, chunk,
         decode=True, positions=jnp.broadcast_to(positions, (b, c)),
-        mutable=["cache"])
-    return logits, mutated["cache"]
-
-
-@partial(jax.jit, static_argnames=("model",))
-def _extend_cache_only(model: CausalLM, params, cache, chunk, pos):
-    """Cache-side-effect-only extend for the draft resync: skips the
-    lm_head projection (``return_hidden=True``) — nobody reads these
-    logits, and the [c, vocab] matmul is the chunk's dominant cost."""
-    from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
-
-    b, c = chunk.shape
-    positions = pos + jnp.arange(c, dtype=jnp.int32)[None, :]
-    _, mutated = model.apply(
-        {"params": dequantize_tree(params), "cache": cache}, chunk,
-        decode=True, positions=jnp.broadcast_to(positions, (b, c)),
-        return_hidden=True, mutable=["cache"])
-    return mutated["cache"]
+        return_hidden=cache_only, mutable=["cache"])
+    return (None if cache_only else out), mutated["cache"]
 
 
 @partial(jax.jit, static_argnames=("model", "gamma"))
@@ -180,9 +168,9 @@ def speculative_generate(
         pending = emitted[d_fill - s_prompt:len(emitted) - 1]
         if pending:
             chunk = jnp.asarray([pending], jnp.int32)
-            d_cache = _extend_cache_only(
+            _, d_cache = _extend(
                 draft_model, draft_params, d_cache, chunk,
-                jnp.asarray(d_fill, jnp.int32))
+                jnp.asarray(d_fill, jnp.int32), cache_only=True)
             d_fill += len(pending)
         last_tok = jnp.asarray([emitted[-1]], jnp.int32)
         drafts, d_cache = _draft_propose(

@@ -22,8 +22,8 @@ CFG = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
            dtype=jnp.float32)
 
 
-def _model_and_params(seed=0):
-    cfg = CausalLMConfig(**CFG)
+def _model_and_params(seed=0, **overrides):
+    cfg = CausalLMConfig(**{**CFG, **overrides})
     model = CausalLM(cfg)
     ids = jnp.zeros((1, 8), jnp.int32)
     params = nn.meta.unbox(jax.jit(model.init)(make_rng(seed), ids)["params"])
@@ -183,6 +183,20 @@ def test_legacy_bundle_without_scale_shapes_restores(tmp_path):
     head = params2["lm_head"]["kernel"]
     assert isinstance(head, QTensor)
     assert head.scale.shape == (97,)  # per-column, as stored
+    out = generate(model2, params2, jnp.zeros((1, 4), jnp.int32),
+                   max_new_tokens=3)
+    assert np.asarray(out).shape == (1, 7)
+
+
+def test_bundle_roundtrips_kv_cache_quant_flag(tmp_path):
+    """A bundle exported from a kv_cache_quant config must serve with
+    the int8 cache after reload (the flag rides config.json)."""
+    cfg, model, params = _model_and_params(seed=5, kv_cache_quant=True)
+    bundle = str(tmp_path / "kvq")
+    export_serving_bundle(cfg, params, bundle, quantize=False)
+
+    model2, params2, _ = load_serving_bundle(bundle)
+    assert model2.cfg.kv_cache_quant is True
     out = generate(model2, params2, jnp.zeros((1, 4), jnp.int32),
                    max_new_tokens=3)
     assert np.asarray(out).shape == (1, 7)
