@@ -193,6 +193,21 @@ def build_workload(name: str, smoke: bool = False, batch_override: int = 0,
             "label": rng.integers(0, 1000, (batch_size,)).astype(np.int32),
         }
         trainer = Trainer(model, TASKS["resnet"](), mesh, learning_rate=1e-3)
+    elif name == "vit":
+        from pyspark_tf_gke_tpu.models import BertConfig, ViTClassifier
+
+        batch_size, hw = (8, 32) if smoke else (64, 224)
+        batch_size = batch_override or batch_size
+        cfg_kwargs = (dict(hidden_size=64, num_layers=2, num_heads=4,
+                           intermediate_size=128) if smoke else {})
+        # ViT-Base = BERT-base encoder over 16x16 patches
+        model = ViTClassifier(BertConfig(**cfg_kwargs), num_classes=1000,
+                              patch_size=16, mesh=mesh)
+        batch = {
+            "image": rng.uniform(0, 1, (batch_size, hw, hw, 3)).astype(np.float32),
+            "label": rng.integers(0, 1000, (batch_size,)).astype(np.int32),
+        }
+        trainer = Trainer(model, TASKS["vit"](), mesh, learning_rate=1e-3)
     elif name == "bert":
         from pyspark_tf_gke_tpu.models import BertConfig, BertForPretraining
 
@@ -227,7 +242,7 @@ def build_workload(name: str, smoke: bool = False, batch_override: int = 0,
         extra["seq_len"] = seq
     else:
         raise SystemExit(
-            f"unknown workload {name!r}; use cnn | resnet50 | bert | generate | spec | io")
+            f"unknown workload {name!r}; use cnn | resnet50 | vit | bert | generate | spec | io")
     return trainer, batch, batch_size, extra
 
 
@@ -734,6 +749,7 @@ def probe_backend() -> bool:
 ALL_WORKLOADS = (
     ["cnn"],
     ["resnet50"],
+    ["vit"],
     ["bert"],
     ["bert", "--seq", "2048"],
     ["bert", "--no-flash", "--seq", "2048"],

@@ -1,6 +1,7 @@
 from pyspark_tf_gke_tpu.models.mlp import MLPClassifier
 from pyspark_tf_gke_tpu.models.cnn import CNNRegressor, PReLU
 from pyspark_tf_gke_tpu.models.resnet import ResNet50
+from pyspark_tf_gke_tpu.models.vit import ViTClassifier
 from pyspark_tf_gke_tpu.models.bert import BertConfig, BertEncoder, BertForPretraining
 from pyspark_tf_gke_tpu.models.pipelined_bert import PipelinedBertClassifier
 from pyspark_tf_gke_tpu.models.moe import MoELayer
@@ -13,6 +14,7 @@ __all__ = [
     "CNNRegressor",
     "PReLU",
     "ResNet50",
+    "ViTClassifier",
     "BertConfig",
     "BertEncoder",
     "BertForPretraining",

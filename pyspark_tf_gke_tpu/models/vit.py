@@ -1,0 +1,92 @@
+"""ViT image classifier on the shared transformer stack.
+
+The reference's vision models are CNNs (``train_tf_ps.py:346-378``) and
+it has no transformer anywhere; this model bridges the two planes the
+TPU-first way: images patchify into a token sequence with ONE stride-p
+convolution (a single MXU matmul over p*p*3-dim patches — no
+per-patch Python), and the tokens then ride the SAME ``BertLayer``
+blocks as the text models. Everything the encoder stack already has
+applies unchanged and for free: logical-axis sharding (fsdp/tp/sp),
+Pallas flash attention and fused LayerNorm, remat, and even MoE FFNs
+(``num_experts`` in the config).
+
+Classification reads a learned [CLS] token (position 0), matching the
+text encoder's pooling convention.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from pyspark_tf_gke_tpu.models.bert import (
+    BertConfig,
+    BertLayer,
+    _dense,
+    _layernorm,
+)
+
+
+class ViTClassifier(nn.Module):
+    """``cfg`` reuses BertConfig for the encoder knobs (hidden size,
+    heads, layers, flash/fused-LN switches, MoE, remat); ``vocab_size``
+    / ``max_position_embeddings`` are ignored — positions come from the
+    patch grid."""
+
+    cfg: BertConfig
+    num_classes: int
+    patch_size: int = 16
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray) -> jnp.ndarray:  # [B, H, W, C]
+        cfg = self.cfg
+        p = self.patch_size
+        b, h, w, _ = images.shape
+        if h % p or w % p:
+            raise ValueError(
+                f"image {h}x{w} not divisible by patch size {p}")
+
+        x = nn.Conv(cfg.hidden_size, (p, p), strides=(p, p), use_bias=True,
+                    dtype=cfg.dtype, name="patch_embed")(
+            images.astype(cfg.dtype))
+        x = x.reshape(b, -1, cfg.hidden_size)  # [B, (H/p)(W/p), hidden]
+        s = x.shape[1] + 1
+
+        cls = self.param(
+            "cls_token",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), (None, None, "embed")),
+            (1, 1, cfg.hidden_size))
+        pos = self.param(
+            "pos_embedding",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), (None, None, "embed")),
+            (1, s, cfg.hidden_size))
+        hidden = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, cfg.hidden_size)).astype(cfg.dtype),
+             x], axis=1) + pos.astype(cfg.dtype)
+        hidden = _layernorm(cfg, self.mesh, name="ln_embed")(hidden)
+        hidden = nn.with_logical_constraint(hidden, ("batch", "seq", "embed"))
+
+        mask = jnp.ones((b, s), dtype=bool)
+        layer_cls = BertLayer
+        if cfg.remat:
+            layer_cls = nn.remat(BertLayer, static_argnums=())
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(cfg.num_layers):
+            use_moe = cfg.num_experts > 0 and (i + 1) % cfg.moe_every == 0
+            hidden, aux = layer_cls(cfg, self.mesh, use_moe,
+                                    name=f"layer_{i}")(hidden, mask)
+            aux_total = aux_total + aux
+
+        cls_out = _layernorm(cfg, self.mesh, name="ln_final")(hidden[:, :1])
+        logits = _dense(self.num_classes, ("embed", None), cfg,
+                        name="head")(cls_out[:, 0])
+        # dict preds like BertForPretraining: the MoE router's
+        # load-balance aux loss must reach the task's _add_moe_aux or
+        # expert routing silently collapses
+        return {"logits": logits.astype(jnp.float32), "aux_loss": aux_total}

@@ -93,6 +93,11 @@ def regression_task() -> TrainerTask:
     return TrainerTask("regression", forward, lam)
 
 
+def _image_cls_lam(preds, batch):
+    loss = softmax_cross_entropy(preds, batch["label"])
+    return loss, {"loss": loss, "accuracy": accuracy_metric(preds, batch["label"])}
+
+
 def resnet_task() -> TrainerTask:
     def forward(model, variables, batch, train, mutable):
         if train:
@@ -102,11 +107,22 @@ def resnet_task() -> TrainerTask:
             return preds, new_state["batch_stats"]
         return model.apply(variables, batch["image"], train=False), None
 
-    def lam(preds, batch):
-        loss = softmax_cross_entropy(preds, batch["label"])
-        return loss, {"loss": loss, "accuracy": accuracy_metric(preds, batch["label"])}
+    return TrainerTask("resnet", forward, _image_cls_lam, has_batch_stats=True)
 
-    return TrainerTask("resnet", forward, lam, has_batch_stats=True)
+
+def vit_task() -> TrainerTask:
+    """Image classification for stateless transformer classifiers
+    (models/vit.py — no batch-norm statistics to thread; dict preds
+    carry the MoE aux loss when experts are enabled)."""
+
+    def forward(model, variables, batch, train, mutable):
+        return model.apply(variables, batch["image"]), None
+
+    def lam(preds, batch):
+        loss, metrics = _image_cls_lam(preds["logits"], batch)
+        return _add_moe_aux(loss, metrics, preds)
+
+    return TrainerTask("vit", forward, lam)
 
 
 def _bert_forward(model, variables, batch, train, mutable):
@@ -222,6 +238,7 @@ TASKS = {
     "classification": classification_task,
     "regression": regression_task,
     "resnet": resnet_task,
+    "vit": vit_task,
     "bert_classification": bert_classification_task,
     "bert_mlm": bert_mlm_task,
     "causal_lm": causal_lm_task,
@@ -298,6 +315,8 @@ class Trainer:
         def create(rng):
             if task.name == "resnet":
                 variables = model.init(rng, sample_batch["image"], train=False)
+            elif task.name == "vit":
+                variables = model.init(rng, sample_batch["image"])
             elif task.name.startswith("bert"):
                 variables = model.init(
                     rng,
