@@ -22,11 +22,16 @@ Secondary workloads (BASELINE configs 4/5): ``python bench.py resnet50``
 and ``python bench.py bert`` measure examples/sec/chip for ResNet-50
 classification (batch 64, 224²) and BERT-base sequence classification
 (batch 32, S=128); same JSON shape, ``vs_baseline`` null (the reference
-has no such workloads to compare against). ``python bench.py io``
-measures the native input pipeline (TFRecord shards → host batches);
+has no such workloads to compare against). ``python bench.py vit`` is
+ViT-Base over 16x16 patches (same batch as resnet50). ``python bench.py
+io`` measures the native input pipeline (TFRecord shards → host
+batches);
 ``python bench.py generate [--kv-heads N] [--int8] [--int8-kv] [--beams K]``
 measures KV-cache decode tokens/sec on the serving path (GQA, weight-
-only int8, beam search).
+only int8, int8 KV cache, beam search); ``python bench.py spec
+[--gamma N]`` measures speculative decoding (lower + upper bounds).
+``python bench.py all`` runs the full 13-workload matrix with ONE
+backend probe, appending every success to tools/bench_history.jsonl.
 
 Resilience: the TPU backend attach through the tunnel is known-flaky
 (round 1 lost its entire perf evidence to one failed attach). The
