@@ -268,3 +268,19 @@ def test_heartbeat_rejects_remote_path():
         Heartbeat("gs://bucket/hb.json")
     hb = make_heartbeat("gs://bucket/out", every_steps=5)
     assert hb.path.startswith("/tmp")
+
+
+def test_fs_copy_tree_pulls_bundle_layout(tmp_path):
+    """Remote bundle pull (train/serve.py startup): the whole tree lands
+    under local_dir with relative paths preserved."""
+    from pyspark_tf_gke_tpu.utils.fs import fs_copy_tree
+
+    _put("memory://bucket/bundle/config.json", b'{"a": 1}')
+    _put("memory://bucket/bundle/params/data/chunk0", b"\x00" * 16)
+    local = str(tmp_path / "pulled")
+    out = fs_copy_tree("memory://bucket/bundle", local)
+    assert out == local
+    assert open(f"{local}/config.json", "rb").read() == b'{"a": 1}'
+    assert open(f"{local}/params/data/chunk0", "rb").read() == b"\x00" * 16
+    with pytest.raises(ValueError, match="remote"):
+        fs_copy_tree("/local/path", local)
