@@ -1,0 +1,176 @@
+"""Chip-watcher + bare-invocation chain logic (tools/bench_watch.py,
+bench.py orchestrate_bare).
+
+Round-3 verdict items: Missing #2 (the capture loop must be a committed,
+restartable artifact) and Weak #4 (the driver's fixed bare command must
+chain into the full matrix after a successful flagship run). These tests
+never touch the tunnel: subprocess/orchestrate layers are monkeypatched.
+"""
+
+import json
+import subprocess
+
+import bench
+from tools import bench_watch
+
+
+class _Proc:
+    def __init__(self, rc=0, out="", err=""):
+        self.returncode = rc
+        self.stdout = out
+        self.stderr = err
+
+
+def test_probe_once_rejects_cpu_fallback(monkeypatch):
+    # A latched JAX_PLATFORMS=cpu answering the probe is NOT a chip
+    # window; the watcher must keep waiting.
+    monkeypatch.setattr(
+        bench_watch.subprocess, "run",
+        lambda *a, **k: _Proc(0, "8x cpu (cpu)\n"))
+    assert bench_watch.probe_once(5) is None
+
+
+def test_probe_once_accepts_tpu(monkeypatch):
+    monkeypatch.setattr(
+        bench_watch.subprocess, "run",
+        lambda *a, **k: _Proc(0, "1x TPU v5 lite (tpu)\n"))
+    assert bench_watch.probe_once(5) == "1x TPU v5 lite (tpu)"
+
+
+def test_probe_once_timeout_and_rc(monkeypatch):
+    def boom(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="x", timeout=5)
+
+    monkeypatch.setattr(bench_watch.subprocess, "run", boom)
+    assert bench_watch.probe_once(5) is None
+    monkeypatch.setattr(
+        bench_watch.subprocess, "run", lambda *a, **k: _Proc(1, "", "boom"))
+    assert bench_watch.probe_once(5) is None
+
+
+def test_watch_once_waits_when_down(monkeypatch, tmp_path):
+    monkeypatch.setattr(bench_watch, "probe_once", lambda t: None)
+    monkeypatch.setattr(bench_watch, "STATE_PATH",
+                        str(tmp_path / "state.json"))
+    monkeypatch.setattr(bench_watch, "LOG_PATH", str(tmp_path / "w.log"))
+    rc = bench_watch.main(["--once"])
+    assert rc == 1
+    state = json.loads((tmp_path / "state.json").read_text())
+    assert state["status"] == "waiting" and state["probes"] == 1
+
+
+def test_watch_captures_on_first_success(monkeypatch, tmp_path):
+    monkeypatch.setattr(bench_watch, "probe_once",
+                        lambda t: "1x TPU v5 lite (tpu)")
+    calls = []
+    monkeypatch.setattr(bench_watch, "run_capture",
+                        lambda t: calls.append(t) or 0)
+    monkeypatch.setattr(bench_watch, "STATE_PATH",
+                        str(tmp_path / "state.json"))
+    monkeypatch.setattr(bench_watch, "LOG_PATH", str(tmp_path / "w.log"))
+    rc = bench_watch.main(["--interval", "0.01"])
+    assert rc == 0 and len(calls) == 1
+    state = json.loads((tmp_path / "state.json").read_text())
+    assert state["status"] == "captured" and state["captures"] == 1
+
+
+TPU_DESC = "probe ok: 1x TPU v5 lite (tpu)"
+
+
+def test_bare_invocation_chains_full_matrix(monkeypatch, capsys):
+    # The flagship JSON must be the ONLY stdout line; every other matrix
+    # workload runs with skip_probe (one probe for the whole window).
+    calls = []
+
+    def fake_orchestrate(argv, skip_probe=False):
+        calls.append((list(argv), skip_probe))
+        if list(argv) == ["cnn"]:
+            print('{"metric": "flagship", "value": 1.0}')
+        return 0
+
+    monkeypatch.setattr(bench, "probe_backend", lambda: TPU_DESC)
+    monkeypatch.setattr(bench, "orchestrate", fake_orchestrate)
+    rc = bench.orchestrate_bare()
+    assert rc == 0
+    assert calls[0] == (["cnn"], True)  # probe already done by _bare
+    chained = [c[0] for c in calls[1:]]
+    expected = [list(w) for w in bench.ALL_WORKLOADS if w != ["cnn"]]
+    assert chained == expected
+    assert all(c[1] for c in calls[1:])  # skip_probe on every chained run
+    out_lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+    assert out_lines == ['{"metric": "flagship", "value": 1.0}']
+
+
+def test_bare_invocation_no_chain_on_failure(monkeypatch):
+    calls = []
+    monkeypatch.setattr(bench, "probe_backend", lambda: TPU_DESC)
+    monkeypatch.setattr(
+        bench, "orchestrate",
+        lambda argv, skip_probe=False: calls.append(list(argv)) or 1)
+    rc = bench.orchestrate_bare()
+    assert rc == 1 and calls == [["cnn"]]
+
+
+def test_bare_invocation_error_json_when_probe_fails(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "probe_backend", lambda: "")
+    monkeypatch.setattr(
+        bench, "orchestrate",
+        lambda argv, skip_probe=False: pytest_fail_if_called())
+    rc = bench.orchestrate_bare()
+    assert rc == 1
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    err = json.loads(out[0])
+    assert err["value"] is None and err["error"]["stage"] == "probe"
+
+
+def pytest_fail_if_called():
+    raise AssertionError("orchestrate must not run when the probe fails")
+
+
+def test_bare_invocation_cpu_fallback_skips_chain(monkeypatch, capsys):
+    # A latched CPU fake slice answering the probe must not pollute the
+    # TPU evidence trail with 12 chained CPU measurements.
+    calls = []
+
+    def fake_orchestrate(argv, skip_probe=False):
+        calls.append(list(argv))
+        print('{"metric": "flagship", "value": 0.1}')
+        return 0
+
+    monkeypatch.setattr(bench, "probe_backend",
+                        lambda: "probe ok: 8x cpu (cpu)")
+    monkeypatch.setattr(bench, "orchestrate", fake_orchestrate)
+    rc = bench.orchestrate_bare()
+    assert rc == 0 and calls == [["cnn"]]  # flagship only, no chain
+    out_lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+    assert len(out_lines) == 1
+
+
+def test_chained_json_goes_to_stderr_not_stdout(monkeypatch, capsys):
+    # Chained workloads print their JSON via print() inside orchestrate;
+    # orchestrate_bare must redirect that to stderr to preserve the
+    # driver's one-stdout-line contract.
+    def fake_orchestrate(argv, skip_probe=False):
+        print(json.dumps({"metric": argv[0], "value": 2.0}))
+        return 0
+
+    monkeypatch.setattr(bench, "probe_backend", lambda: TPU_DESC)
+    monkeypatch.setattr(bench, "orchestrate", fake_orchestrate)
+    rc = bench.orchestrate_bare()
+    assert rc == 0
+    cap = capsys.readouterr()
+    out_lines = [ln for ln in cap.out.splitlines() if ln.startswith("{")]
+    assert len(out_lines) == 1  # flagship only
+    # every chained workload's JSON landed on stderr instead
+    err_json = [ln for ln in cap.err.splitlines() if ln.startswith("{")]
+    assert len(err_json) == len(bench.ALL_WORKLOADS) - 1
+
+
+def test_run_matrix_shared_by_all_and_bare():
+    # Regression guard for the extracted helper: orchestrate_all and
+    # orchestrate_bare must both route through _run_matrix.
+    import inspect
+
+    assert "_run_matrix" in inspect.getsource(bench.orchestrate_all)
+    assert "_run_matrix" in inspect.getsource(bench.orchestrate_bare)

@@ -1,0 +1,215 @@
+"""Chip-watcher: seize the next TPU tunnel window automatically.
+
+Three consecutive rounds lost their hardware evidence because the axon
+tunnel was down whenever someone was looking, and the capture loop that
+would have caught the re-attach lived only in an interactive session
+(round-3 VERDICT, "What's missing" #2). This file makes the watcher a
+committed, restartable artifact:
+
+    python tools/bench_watch.py            # watch -> capture once -> exit
+    python tools/bench_watch.py --forever  # re-arm after each capture
+
+Each tick runs ONE cheap probe (throwaway subprocess, hard timeout — a
+hung ``jax.devices()`` attach cannot wedge the loop; see
+tools/bench_history.jsonl for why the probe is a subprocess). On the
+first successful probe it fires the full capture sequence:
+
+  1. ``python bench.py all``  — the 13-workload matrix; every success is
+     appended to the committed evidence trail ``tools/bench_history.jsonl``
+     by bench.py itself.
+  2. ``python tools/roofline.py cnn resnet50 bert --measure`` — the
+     hardware roofline the round-3 verdict asked for (Weak #2), written
+     to ``tools/roofline_hw.json``.
+
+Everything is also streamed to ``tools/bench_watch.log`` and a one-line
+state file ``tools/bench_watch_state.json`` is rewritten every tick so a
+later session (or a human) can see at a glance whether the watcher is
+alive, how many probes it has burned, and when the last capture ran.
+
+Reference counterpart: the informal "run it when the cluster is up"
+verification loop of /root/reference/workloads/raw-spark/spark_checks/
+python_checks/spark_installation_check.py:12-46 — here made unattended
+because the hardware window, not the operator, is the scarce resource.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+ROOFLINE = os.path.join(REPO, "tools", "roofline.py")
+LOG_PATH = os.path.join(REPO, "tools", "bench_watch.log")
+STATE_PATH = os.path.join(REPO, "tools", "bench_watch_state.json")
+ROOFLINE_OUT = os.path.join(REPO, "tools", "roofline_hw.json")
+
+PROBE_CODE = (
+    "import jax; ds = jax.devices(); "
+    "print(f'{len(ds)}x {ds[0].device_kind} ({ds[0].platform})')"
+)
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+
+
+def log(msg: str) -> None:
+    # LOG_PATH resolved at call time, not def time, so tests can
+    # monkeypatch it away from the committed tree.
+    line = f"[bench_watch {_now()}] {msg}"
+    print(line, file=sys.stderr, flush=True)
+    try:
+        with open(LOG_PATH, "a") as fh:
+            fh.write(line + "\n")
+    except OSError:
+        pass
+
+
+def write_state(**kw) -> None:
+    """Rewrite the one-line observability file. Best-effort: the watcher
+    must keep probing even on a read-only checkout."""
+    kw.setdefault("ts", _now())
+    kw.setdefault("pid", os.getpid())
+    try:
+        with open(STATE_PATH, "w") as fh:
+            fh.write(json.dumps(kw) + "\n")
+    except OSError:
+        pass
+
+
+def probe_once(timeout_s: float) -> str | None:
+    """One cheap backend probe in a throwaway subprocess. Returns the
+    device description on success, None on failure/timeout. A single
+    attempt per tick (no internal retries) — the watcher IS the retry
+    loop, and burning bench.py's 4x240s backoff per tick would make the
+    tick interval meaningless."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    desc = proc.stdout.strip()
+    # The CPU fallback answering is NOT a chip window — require a
+    # non-cpu platform so a latched JAX_PLATFORMS=cpu (or a image-level
+    # fallback) can't trigger a meaningless "capture".
+    return desc if desc and "(cpu)" not in desc else None
+
+
+def run_capture(timeout_s: float) -> int:
+    """The full capture sequence. Streams child output into the log.
+    Returns bench.py all's rc (roofline failure is logged, not fatal —
+    the matrix is the evidence that matters)."""
+    log("chip answered - running bench.py all (full matrix)")
+    t0 = time.time()
+    try:
+        fh = open(LOG_PATH, "a")
+    except OSError:
+        # Same best-effort stance as log()/write_state(): an unwritable
+        # checkout must not kill the capture the watcher waited hours for.
+        fh = None
+    try:
+        rc = subprocess.call(
+            [sys.executable, BENCH, "all"],
+            stdout=fh or sys.stderr, stderr=fh or sys.stderr,
+            cwd=REPO, timeout=None,
+        )
+    finally:
+        if fh is not None:
+            fh.close()
+    log(f"bench.py all done rc={rc} in {time.time() - t0:.0f}s")
+
+    log("capturing hardware roofline (cnn resnet50 bert --measure)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, ROOFLINE, "cnn", "resnet50", "bert",
+             "--measure"],
+            capture_output=True, text=True, cwd=REPO, timeout=timeout_s,
+        )
+        # The result write comes FIRST: an unwritable log must not drop
+        # a roofline the watcher waited hours for.
+        if proc.returncode == 0 and proc.stdout.strip():
+            with open(ROOFLINE_OUT, "w") as out:
+                out.write(proc.stdout)
+            log(f"roofline written to {ROOFLINE_OUT}")
+        else:
+            log(f"roofline failed rc={proc.returncode} "
+                f"(non-fatal): {proc.stderr.strip()[-300:]}")
+        try:
+            with open(LOG_PATH, "a") as fh:
+                fh.write(proc.stderr)
+        except OSError:
+            pass
+    except (subprocess.TimeoutExpired, OSError) as exc:
+        log(f"roofline capture skipped (non-fatal): {exc!r}")
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--interval", type=float, default=240.0,
+                    help="seconds between probes (default 240)")
+    ap.add_argument("--probe-timeout", type=float, default=90.0,
+                    help="per-probe subprocess timeout (default 90s; the "
+                    "down tunnel hangs attaches forever)")
+    ap.add_argument("--capture-timeout", type=float, default=1800.0,
+                    help="timeout for the roofline capture step")
+    ap.add_argument("--forever", action="store_true",
+                    help="re-arm after each capture instead of exiting")
+    ap.add_argument("--rearm-delay", type=float, default=3600.0,
+                    help="--forever: seconds to sleep after a capture")
+    ap.add_argument("--max-hours", type=float, default=0.0,
+                    help="give up after this many hours (0 = never)")
+    ap.add_argument("--once", action="store_true",
+                    help="single probe, no loop (for tests/cron)")
+    args = ap.parse_args(argv)
+
+    t_start = time.time()
+    probes = 0
+    captures = 0
+    log(f"armed: interval={args.interval:.0f}s probe_timeout="
+        f"{args.probe_timeout:.0f}s forever={args.forever}")
+    while True:
+        probes += 1
+        desc = probe_once(args.probe_timeout)
+        if desc is None:
+            write_state(status="waiting", probes=probes, captures=captures,
+                        last_probe="down")
+            if probes == 1 or probes % 10 == 0:
+                log(f"probe #{probes}: tunnel down")
+        else:
+            log(f"probe #{probes}: {desc}")
+            write_state(status="capturing", probes=probes,
+                        captures=captures, device=desc)
+            rc = run_capture(args.capture_timeout)
+            captures += 1
+            write_state(status="captured", probes=probes, captures=captures,
+                        device=desc, bench_all_rc=rc)
+            if not args.forever:
+                log("capture complete - exiting (use --forever to re-arm)")
+                return rc
+            if not args.once:
+                log(f"re-arming in {args.rearm_delay:.0f}s (--forever)")
+                time.sleep(args.rearm_delay)
+                continue
+        if args.once:
+            return 0 if desc else 1
+        if args.max_hours and (time.time() - t_start) > args.max_hours * 3600:
+            log(f"giving up after {args.max_hours}h / {probes} probes")
+            write_state(status="expired", probes=probes, captures=captures)
+            return 1
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
