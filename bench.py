@@ -756,17 +756,30 @@ def append_history(argv, result: dict) -> None:
         log(f"history append failed: {exc!r}")
 
 
+# ONE probe snippet and ONE CPU-fallback test, shared with
+# tools/bench_watch.py — the guards parse this exact format, so a format
+# edit in one place must not silently disable the other file's check.
+PROBE_CODE = (
+    "import jax; ds = jax.devices(); "
+    "print(f'probe ok: {len(ds)}x {ds[0].device_kind} "
+    "({ds[0].platform})')"
+)
+
+
+def is_cpu_probe(desc: str) -> bool:
+    """True when a successful probe answered with the CPU fallback — a
+    latched JAX_PLATFORMS=cpu is NOT a chip window, and the evidence
+    trail records TPU measurements only."""
+    return "(cpu)" in desc
+
+
 def probe_backend() -> str:
     """Attach the backend in a throwaway subprocess (a failed/hung attach
     can't poison or wedge the orchestrator) with timeout + backoff.
     Returns the device description (truthy) on success — including the
     platform, so callers can tell a real TPU from the CPU fallback — or
     "" on persistent failure."""
-    code = (
-        "import jax; ds = jax.devices(); "
-        "print(f'probe ok: {len(ds)}x {ds[0].device_kind} "
-        "({ds[0].platform})')"
-    )
+    code = PROBE_CODE
     for attempt in range(PROBE_ATTEMPTS):
         try:
             proc = subprocess.run(
@@ -837,7 +850,14 @@ def orchestrate_all(extra) -> int:
     window to one-at-a-time runs. Emits one JSON line per workload on
     stdout and a final summary line; rc=0 if every workload measured."""
     smoke = "--smoke" in extra
-    backend_ok = smoke or bool(probe_backend())
+    if smoke:
+        backend_ok = True
+    else:
+        desc = probe_backend()
+        backend_ok = bool(desc) and not is_cpu_probe(desc)
+        if desc and not backend_ok:
+            log("backend is the CPU fallback - device workloads fast-fail "
+                "(the trail records TPU evidence only)")
     failures = _run_matrix(extra, backend_ok)
     print(json.dumps({"metric": "bench_all", "value": len(ALL_WORKLOADS) - failures,
                       "unit": "workloads_measured", "vs_baseline": None,
@@ -862,13 +882,14 @@ def orchestrate_bare() -> int:
             f"backend attach failed after {PROBE_ATTEMPTS} attempts "
             f"({PROBE_TIMEOUT_S}s timeout each)")))
         return 1
+    if is_cpu_probe(desc):
+        # The CPU fallback answering the probe is not a chip window. The
+        # driver still gets its flagship JSON line, but nothing is
+        # recorded (the trail is TPU evidence) and nothing is chained.
+        log("backend is the CPU fallback - flagship runs unrecorded, "
+            "matrix chain skipped")
+        return orchestrate(["cnn", "--no-history"], skip_probe=True)
     rc = orchestrate(["cnn"], skip_probe=True)
-    if rc == 0 and "(cpu)" in desc:
-        # The CPU fallback answering the probe is not a chip window;
-        # the trail is TPU evidence (same guard as tools/bench_watch.py).
-        log("backend is the CPU fallback - flagship recorded, matrix "
-            "chain skipped")
-        return rc
     if rc == 0:
         import contextlib
 

@@ -37,6 +37,7 @@ from pyspark_tf_gke_tpu.ops.attention import (
     ring_attention,
     ulysses_attention,
 )
+from pyspark_tf_gke_tpu.models.embedding import TokenEmbed
 from pyspark_tf_gke_tpu.parallel.mesh import DATA_AXES
 
 
@@ -292,19 +293,21 @@ class BertEncoder(nn.Module):
         if token_type_ids is None:
             token_type_ids = jnp.zeros((b, s), dtype=jnp.int32)
 
-        embed = nn.Embed(
+        # One-hot matmul embeds (models/embedding.py): nn.Embed's gather
+        # backward forces an involuntary full remat on dp×fsdp×tp meshes.
+        embed = TokenEmbed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), ("vocab", "embed")),
             name="word_embeddings",
         )
-        pos_embed = nn.Embed(
+        pos_embed = TokenEmbed(
             cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), (None, "embed")),
             name="position_embeddings",
         )
-        type_embed = nn.Embed(
+        type_embed = TokenEmbed(
             cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), (None, "embed")),

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from pyspark_tf_gke_tpu.models.bert import _data_shards, _dense
+from pyspark_tf_gke_tpu.models.embedding import TokenEmbed
 from pyspark_tf_gke_tpu.ops.attention import dot_product_attention
 
 NEG_INF = -1e30
@@ -407,7 +408,12 @@ class CausalLM(nn.Module):
             raise ValueError(
                 "multi-token decode requires explicit positions "
                 "(cache_fill + arange(s)); see models/speculative._extend")
-        embed = nn.Embed(
+        # One-hot matmul embed on the training path (models/embedding.py:
+        # nn.Embed's gather backward triggers involuntary full remat on
+        # dp×fsdp×tp meshes); decode/prefill have no backward, so they
+        # keep the cheap gather.
+        one_hot = not (decode or prefill)
+        embed = TokenEmbed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), ("vocab", "embed")),
@@ -416,15 +422,16 @@ class CausalLM(nn.Module):
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
         if cfg.pos_embedding == "rope":
-            hidden = embed(input_ids)
+            hidden = embed(input_ids, one_hot=one_hot)
         else:
-            pos_embed = nn.Embed(
+            pos_embed = TokenEmbed(
                 cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
                 embedding_init=nn.with_logical_partitioning(
                     nn.initializers.normal(stddev=0.02), (None, "embed")),
                 name="wpe",
             )
-            hidden = embed(input_ids) + pos_embed(positions)
+            hidden = (embed(input_ids, one_hot=one_hot)
+                      + pos_embed(positions, one_hot=one_hot))
 
         block_cls = CausalLMBlock
         if cfg.remat and not (decode or prefill):

@@ -1,0 +1,62 @@
+"""Token embedding as a one-hot matmul (the TPU "iota embed" trick).
+
+``nn.Embed`` lowers the lookup to a gather whose backward is a
+scatter-add of the batch-sharded cotangent into the ``(vocab, embed)``-
+sharded table. On a dp×fsdp×tp mesh GSPMD cannot express that reshard
+(batch axes → embed axis with a transposed device order) and falls back
+to **involuntary full rematerialization** — replicating the activation
+gradient on every chip, every step. Observed on the MLM dryrun config
+(``MULTICHIP_r03.json``: ``cannot go from {devices=[4,1,1,2]} to
+{devices=[1,1,2,4]T(1,0,2)}`` at ``encoder/ln_embed``).
+
+Written as ``one_hot(ids) @ table``, both the forward and the backward
+are dot-generals, which the SPMD partitioner handles with ordinary
+collectives — and the forward rides the MXU instead of issuing a gather.
+The extra B·S·V·H MACs are the same order as the (untied) LM-head matmul
+that every config already pays; for inference paths with no backward
+(KV-cache decode/prefill) callers pass ``one_hot=False`` to keep the
+cheap gather.
+
+Parity: parameter name ("embedding"), shape ``[num_embeddings,
+features]``, fp32 storage and init match ``nn.Embed``, so checkpoints
+are interchangeable; a 0/1 one-hot contraction reproduces the gather
+bit-exactly (each output element is one product against 1.0 plus exact
+zeros).
+
+Reference counterpart: none (the reference has no embedding layers at
+all — SURVEY §2b); the design follows the public maxtext/t5x
+"use_iota_embed" pattern for GSPMD-efficient embeddings.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class TokenEmbed(nn.Module):
+    """Drop-in ``nn.Embed`` replacement with a matmul-based lookup.
+
+    ``one_hot=True`` (training) contracts a one-hot matrix against the
+    table — clean SPMD partitioning of the backward; ``one_hot=False``
+    (decode/prefill, no backward) gathers like ``nn.Embed``.
+    """
+
+    num_embeddings: int
+    features: int
+    dtype: Any = jnp.float32
+    embedding_init: Any = nn.initializers.normal(stddev=0.02)
+
+    @nn.compact
+    def __call__(self, ids: jax.Array, one_hot: bool = True) -> jax.Array:
+        table = self.param(
+            "embedding", self.embedding_init,
+            (self.num_embeddings, self.features), jnp.float32,
+        )
+        if one_hot:
+            oh = jax.nn.one_hot(ids, self.num_embeddings, dtype=self.dtype)
+            return jnp.matmul(oh, table.astype(self.dtype))
+        return jnp.take(table, ids, axis=0).astype(self.dtype)

@@ -130,7 +130,8 @@ def pytest_fail_if_called():
 
 def test_bare_invocation_cpu_fallback_skips_chain(monkeypatch, capsys):
     # A latched CPU fake slice answering the probe must not pollute the
-    # TPU evidence trail with 12 chained CPU measurements.
+    # TPU evidence trail: the flagship still runs (the driver gets its
+    # JSON line) but UNRECORDED, and nothing is chained.
     calls = []
 
     def fake_orchestrate(argv, skip_probe=False):
@@ -142,9 +143,34 @@ def test_bare_invocation_cpu_fallback_skips_chain(monkeypatch, capsys):
                         lambda: "probe ok: 8x cpu (cpu)")
     monkeypatch.setattr(bench, "orchestrate", fake_orchestrate)
     rc = bench.orchestrate_bare()
-    assert rc == 0 and calls == [["cnn"]]  # flagship only, no chain
+    assert rc == 0 and calls == [["cnn", "--no-history"]]
     out_lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
     assert len(out_lines) == 1
+
+
+def test_orchestrate_all_rejects_cpu_fallback(monkeypatch, capsys):
+    # `bench.py all` must fast-fail device workloads when only the CPU
+    # fallback answers — error JSON per workload, io still runs.
+    ran = []
+    monkeypatch.setattr(bench, "probe_backend",
+                        lambda: "probe ok: 8x cpu (cpu)")
+    monkeypatch.setattr(
+        bench, "orchestrate",
+        lambda argv, skip_probe=False: ran.append(list(argv)) or 0)
+    rc = bench.orchestrate_all([])
+    assert rc == 1  # device workloads all failed the gate
+    assert ran == [["io"]]  # only the host-only workload executed
+    out = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+           if ln.startswith("{")]
+    errors = [o for o in out if o.get("error")]
+    assert len(errors) == len(bench.ALL_WORKLOADS) - 1
+
+
+def test_probe_code_shared_between_bench_and_watcher():
+    assert bench_watch.PROBE_CODE is bench.PROBE_CODE
+    assert bench_watch.is_cpu_probe is bench.is_cpu_probe
+    assert bench.is_cpu_probe("probe ok: 8x cpu (cpu)")
+    assert not bench.is_cpu_probe("probe ok: 1x TPU v5 lite (tpu)")
 
 
 def test_chained_json_goes_to_stderr_not_stdout(monkeypatch, capsys):

@@ -43,16 +43,18 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from bench import PROBE_CODE, is_cpu_probe  # noqa: E402  (shared probe
+#   snippet + CPU-fallback test: the guards parse the probe's output
+#   format, so both files must agree on it — single source of truth)
+
 BENCH = os.path.join(REPO, "bench.py")
 ROOFLINE = os.path.join(REPO, "tools", "roofline.py")
 LOG_PATH = os.path.join(REPO, "tools", "bench_watch.log")
 STATE_PATH = os.path.join(REPO, "tools", "bench_watch_state.json")
 ROOFLINE_OUT = os.path.join(REPO, "tools", "roofline_hw.json")
-
-PROBE_CODE = (
-    "import jax; ds = jax.devices(); "
-    "print(f'{len(ds)}x {ds[0].device_kind} ({ds[0].platform})')"
-)
 
 
 def _now() -> str:
@@ -103,7 +105,7 @@ def probe_once(timeout_s: float) -> str | None:
     # The CPU fallback answering is NOT a chip window — require a
     # non-cpu platform so a latched JAX_PLATFORMS=cpu (or a image-level
     # fallback) can't trigger a meaningless "capture".
-    return desc if desc and "(cpu)" not in desc else None
+    return desc if desc and not is_cpu_probe(desc) else None
 
 
 def run_capture(timeout_s: float) -> int:
