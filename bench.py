@@ -819,22 +819,22 @@ ALL_WORKLOADS = (
 )
 
 
-def _run_matrix(extra, backend_ok: bool, skip=()) -> int:
+def _run_matrix(extra, backend_ok: bool, skip=(),
+                gate_reason: str = "backend attach failed (probed once "
+                                   "for the whole matrix)") -> int:
     """Run the matrix workloads back to back with ONE shared probe
     verdict, appending each success to the history trail. Returns the
     failure count. With the tunnel down, per-workload probing would burn
     PROBE_ATTEMPTS x 240s per device workload (hours) — so device
-    workloads fast-fail on ``backend_ok=False`` while the host-only io
-    bench still runs."""
+    workloads fast-fail on ``backend_ok=False`` (with ``gate_reason`` in
+    their error JSON) while the host-only io bench still runs."""
     failures = 0
     for argv in ALL_WORKLOADS:
         if list(argv) in [list(s) for s in skip]:
             continue
         log(f"=== bench matrix: {' '.join(argv)} ===")
         if argv[0] != "io" and not backend_ok:
-            print(json.dumps(_error_json(
-                argv[0], "probe", "backend attach failed (probed once "
-                "for the whole matrix)")))
+            print(json.dumps(_error_json(argv[0], "probe", gate_reason)))
             failures += 1
             continue
         rc = orchestrate([*argv, *extra], skip_probe=True)
@@ -850,15 +850,23 @@ def orchestrate_all(extra) -> int:
     window to one-at-a-time runs. Emits one JSON line per workload on
     stdout and a final summary line; rc=0 if every workload measured."""
     smoke = "--smoke" in extra
+    gate_reason = ("backend attach failed (probed once for the whole "
+                   "matrix)")
     if smoke:
         backend_ok = True
     else:
         desc = probe_backend()
         backend_ok = bool(desc) and not is_cpu_probe(desc)
         if desc and not backend_ok:
+            # Attach SUCCEEDED but on the CPU fallback — a different
+            # operator action (clear the latched platform) than a down
+            # tunnel (wait/retry); the error JSON must say which.
+            gate_reason = (f"backend attached but is the CPU fallback "
+                           f"({desc}) - clear the latched platform; the "
+                           f"trail records TPU evidence only")
             log("backend is the CPU fallback - device workloads fast-fail "
                 "(the trail records TPU evidence only)")
-    failures = _run_matrix(extra, backend_ok)
+    failures = _run_matrix(extra, backend_ok, gate_reason=gate_reason)
     print(json.dumps({"metric": "bench_all", "value": len(ALL_WORKLOADS) - failures,
                       "unit": "workloads_measured", "vs_baseline": None,
                       "total": len(ALL_WORKLOADS), "failures": failures}))

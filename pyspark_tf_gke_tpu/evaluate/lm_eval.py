@@ -79,7 +79,8 @@ def bundle_perplexity(model, params, tokenizer, pattern: str, seq_len: int,
     def batch_nll(p, ids):
         from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
 
-        logits = model.apply({"params": dequantize_tree(p)}, ids)
+        logits = model.apply({"params": dequantize_tree(p)}, ids,
+                             train=False)
         lg = logits[:, :-1].astype(jnp.float32)
         targets = ids[:, 1:]
         import optax

@@ -283,7 +283,8 @@ class BertEncoder(nn.Module):
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 train: bool = True):
         cfg = self.cfg
         b, s = input_ids.shape
         if attention_mask is None:
@@ -314,7 +315,11 @@ class BertEncoder(nn.Module):
             name="token_type_embeddings",
         )
         positions = jnp.arange(s)[None, :]
-        hidden = embed(input_ids) + pos_embed(positions) + type_embed(token_type_ids)
+        # one_hot only when a gradient will flow (see models/embedding.py);
+        # eval-only forwards keep the cheap gather.
+        hidden = (embed(input_ids, one_hot=train)
+                  + pos_embed(positions, one_hot=train)
+                  + type_embed(token_type_ids, one_hot=train))
         hidden = _layernorm(cfg, self.mesh, name="ln_embed")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "seq", "embed"))
 
@@ -340,10 +345,11 @@ class BertForPretraining(nn.Module):
     num_labels: int = 2
 
     @nn.compact
-    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 train: bool = True):
         cfg = self.cfg
         hidden, aux_loss = BertEncoder(cfg, self.mesh, name="encoder")(
-            input_ids, token_type_ids, attention_mask
+            input_ids, token_type_ids, attention_mask, train=train
         )
         mlm = _dense(cfg.hidden_size, ("embed", "embed_out"), cfg, name="mlm_transform")(hidden)
         mlm = nn.gelu(mlm, approximate=True)

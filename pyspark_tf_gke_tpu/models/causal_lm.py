@@ -37,6 +37,7 @@ from jax.sharding import Mesh
 
 from pyspark_tf_gke_tpu.models.bert import _data_shards, _dense
 from pyspark_tf_gke_tpu.models.embedding import TokenEmbed
+from pyspark_tf_gke_tpu.parallel.sharding import mesh_extent_for
 from pyspark_tf_gke_tpu.ops.attention import dot_product_attention
 
 NEG_INF = -1e30
@@ -176,14 +177,16 @@ class CausalSelfAttention(nn.Module):
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
-        # K/V carry only kv_heads here; with tp > kv_heads (e.g. MQA on a
-        # tp=2 mesh) a 'heads' constraint on that axis is non-divisible
-        # and the trace fails. Keep the constraint whenever the mesh's tp
-        # extent divides kv_heads (so divisible GQA, e.g. kv=4/tp=2,
-        # stays explicitly sharded through the cache write) and only
-        # drop it — re-constraining after the repeat below — when it
-        # cannot divide.
-        tp = self.mesh.shape.get("tp", 1) if self.mesh is not None else 1
+        # K/V carry only kv_heads here; with more head-shards than
+        # kv_heads (e.g. MQA on a tp=2 mesh) a 'heads' constraint on
+        # that axis is non-divisible and the trace fails. Keep the
+        # constraint whenever the head-shard extent divides kv_heads
+        # (so divisible GQA, e.g. kv=4/tp=2, stays explicitly sharded
+        # through the cache write) and only drop it — re-constraining
+        # after the repeat below — when it cannot divide. The extent is
+        # derived from LOGICAL_RULES ("heads" → whatever axis the rules
+        # map), not a hardcoded "tp" (round-3 ADVICE).
+        tp = mesh_extent_for("heads", self.mesh)
         kv_axes = ("batch", "seq", "heads" if hkv % tp == 0 else None,
                    "head_dim")
         k = nn.with_logical_constraint(k, kv_axes)
@@ -394,7 +397,8 @@ class CausalLM(nn.Module):
                  prefill: bool = False,
                  positions: Optional[jnp.ndarray] = None,
                  segment_ids: Optional[jnp.ndarray] = None,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False,
+                 train: bool = True):
         cfg = self.cfg
         if cfg.pos_embedding not in ("learned", "rope"):
             raise ValueError(f"pos_embedding must be 'learned' or 'rope', "
@@ -410,9 +414,11 @@ class CausalLM(nn.Module):
                 "(cache_fill + arange(s)); see models/speculative._extend")
         # One-hot matmul embed on the training path (models/embedding.py:
         # nn.Embed's gather backward triggers involuntary full remat on
-        # dp×fsdp×tp meshes); decode/prefill have no backward, so they
-        # keep the cheap gather.
-        one_hot = not (decode or prefill)
+        # dp×fsdp×tp meshes). The matmul only pays for itself when a
+        # gradient will flow — decode/prefill have no backward, and
+        # pure-inference full forwards (scoring/eval) pass train=False
+        # to keep the cheap gather too.
+        one_hot = train and not (decode or prefill)
         embed = TokenEmbed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(

@@ -13,8 +13,9 @@ Written as ``one_hot(ids) @ table``, both the forward and the backward
 are dot-generals, which the SPMD partitioner handles with ordinary
 collectives — and the forward rides the MXU instead of issuing a gather.
 The extra B·S·V·H MACs are the same order as the (untied) LM-head matmul
-that every config already pays; for inference paths with no backward
-(KV-cache decode/prefill) callers pass ``one_hot=False`` to keep the
+that every config already pays; paths with no backward — KV-cache
+decode/prefill, and pure-inference full forwards (scoring/eval, routed
+via the models' ``train=False``) — pass ``one_hot=False`` to keep the
 cheap gather.
 
 Parity: parameter name ("embedding"), shape ``[num_embeddings,

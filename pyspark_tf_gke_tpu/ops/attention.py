@@ -262,7 +262,9 @@ def ulysses_attention(
             mask=None if kv_mask is None else kv_mask[:, None, None, :],
             causal=causal,
         )
-    tp = mesh.shape.get("tp", 1)
+    from pyspark_tf_gke_tpu.parallel.sharding import mesh_extent_for
+
+    tp = mesh_extent_for("heads", mesh)  # rule-derived, not literal "tp"
     local_heads = q.shape[2] // tp
     if local_heads % axis_size:
         raise ValueError(

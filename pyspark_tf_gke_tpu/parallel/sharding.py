@@ -51,6 +51,27 @@ LOGICAL_RULES = (
 )
 
 
+def mesh_extent_for(logical_axis: str, mesh: Optional[Mesh],
+                    rules=LOGICAL_RULES) -> int:
+    """Number of shards the rule set assigns to ``logical_axis`` on this
+    mesh (1 when unmapped/absent). Divisibility guards must use THIS —
+    not a hardcoded mesh-axis name — so they stay true to whatever axis
+    the rules actually map (e.g. "heads" → "tp" today; remapping the
+    rules can never silently detach a guard from the constraint it
+    protects)."""
+    if mesh is None:
+        return 1
+    target = dict(rules).get(logical_axis)
+    if target is None:
+        return 1
+    axes = target if isinstance(target, (tuple, list)) else (target,)
+    out = 1
+    for a in axes:
+        if a is not None:
+            out *= mesh.shape.get(a, 1)
+    return out
+
+
 def fsdp_spec(shape: tuple, mesh: Mesh, min_size: int = DEFAULT_MIN_SIZE) -> P:
     """PartitionSpec sharding the largest fsdp-divisible dim of ``shape``.
 

@@ -178,13 +178,20 @@ def load_serving_bundle(bundle_dir: str) -> Tuple[CausalLM, Any, dict]:
     ckptr = ocp.StandardCheckpointer()
     try:
         params_path = os.path.join(os.path.abspath(bundle_dir), "params")
+        first_exc = None
         for i, candidate in enumerate(abstract_candidates):
             try:
                 params = ckptr.restore(params_path, candidate)
                 break
-            except Exception:  # orbax shape-validation mismatch
+            except Exception as exc:  # orbax shape-validation mismatch
+                # The FIRST candidate is the expected layout; if every
+                # candidate fails, its error is the real cause (a
+                # missing/corrupt checkpoint would otherwise surface as
+                # the ALTERNATE candidate's confusing shape mismatch).
+                if first_exc is None:
+                    first_exc = exc
                 if i == len(abstract_candidates) - 1:
-                    raise
+                    raise first_exc
     finally:
         ckptr.close()
     return model, params, meta

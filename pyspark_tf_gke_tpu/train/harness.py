@@ -164,9 +164,12 @@ def make_heartbeat(
     if not path:
         # heartbeats must be node-local (age probes need local mtime;
         # a per-step gs:// write would be absurd) — when the artifact
-        # dir is remote, default to /tmp like the k8s manifests do,
-        # per-process so a hung process can't hide behind a live peer
+        # dir is remote, default to /tmp like the k8s manifests do.
+        # Per-process in BOTH defaults: with a shared file a hung
+        # process hides behind any live peer's beats (local
+        # multi-process runs are exactly the fake-slice test shape).
         path = ("/tmp/tpu-heartbeat-{process_index}.json"
                 if is_remote(output_dir)
-                else os.path.join(output_dir, "heartbeat.json"))
+                else os.path.join(output_dir,
+                                  "heartbeat-{process_index}.json"))
     return Heartbeat(path, every_steps)

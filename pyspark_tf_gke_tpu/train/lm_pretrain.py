@@ -145,7 +145,7 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default=int(e("HEARTBEAT_EVERY_STEPS", "10")))
     p.add_argument("--heartbeat-file", default=e("HEARTBEAT_FILE", ""),
                    help="node-local heartbeat path for the k8s exec probe "
-                        "(default: <output-dir>/heartbeat.json)")
+                        "(default: <output-dir>/heartbeat-{process_index}.json)")
     return p.parse_args(argv)
 
 

@@ -110,10 +110,24 @@ class Heartbeat:
 
 
 def detect_stall(paths: Sequence[str], stall_seconds: float,
-                 timeout_s: float, poll_s: float = 0.5) -> Optional[str]:
+                 timeout_s: float, poll_s: float = 0.5,
+                 startup_grace_s: Optional[float] = None) -> Optional[str]:
     """Watchdog primitive: poll the heartbeat files until one goes
     stale (written once, then quiet for ``stall_seconds``) or
     ``timeout_s`` elapses. Returns the first stalled path, or None.
+
+    A path that NEVER appears is also a stall: a worker hung before its
+    first beat (wedged import, stuck device attach) writes no file at
+    all, so after ``startup_grace_s`` from watchdog start a still-missing
+    file is reported stalled too — otherwise that worker would pass as
+    healthy for the whole timeout. The grace defaults to
+    ``3 * stall_seconds``, NOT ``stall_seconds``: the first beat lands
+    only after init + XLA compile, which legitimately dwarfs the
+    steady-state stall window (a grace equal to it would restart-loop a
+    healthy job straight through its compile). Size it above your
+    worst-case cold start — the k8s analog is the probe initialDelay.
+    (With ``timeout_s < startup_grace_s`` the grace never elapses and
+    missing files stay 'not started'.)
 
     This is the job-level detection the k8s liveness probe performs per
     pod (``tpu-worker.yaml``); a watchdog process uses it directly when
@@ -123,11 +137,16 @@ def detect_stall(paths: Sequence[str], stall_seconds: float,
     the heartbeat ages. Response is job-level restart: synchronous SPMD
     means one stalled worker blocks every peer's collectives, so the
     whole set restarts and resumes from the latest checkpoint."""
-    deadline = time.time() + timeout_s
+    grace = (3 * stall_seconds if startup_grace_s is None
+             else startup_grace_s)
+    start = time.time()
+    deadline = start + timeout_s
     while time.time() < deadline:
         for p in paths:
             if Heartbeat.is_stalled(p, stall_seconds):
                 return p
+            if Heartbeat.age(p) is None and time.time() - start > grace:
+                return p  # never appeared within the startup grace
         time.sleep(poll_s)
     return None
 
@@ -172,9 +191,15 @@ def _watch_main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=3600.0,
                     help="give up (exit 0) after this many seconds")
     ap.add_argument("--poll", type=float, default=1.0)
+    ap.add_argument("--startup-grace", type=float, default=None,
+                    help="seconds a heartbeat file may remain absent "
+                         "before 'never started' counts as stalled "
+                         "(default 3x --stall; size above worst-case "
+                         "init + XLA compile)")
     args = ap.parse_args(argv)
     paths = [p for p in args.paths.split(",") if p]
-    stalled = detect_stall(paths, args.stall, args.timeout, args.poll)
+    stalled = detect_stall(paths, args.stall, args.timeout, args.poll,
+                           startup_grace_s=args.startup_grace)
     if stalled:
         print(_json.dumps({"stalled": stalled,
                            "age_s": Heartbeat.age(stalled),

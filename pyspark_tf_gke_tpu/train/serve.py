@@ -55,6 +55,10 @@ from pyspark_tf_gke_tpu.utils.logging import get_logger
 
 logger = get_logger("train.serve")
 
+# Reject request bodies above this size with 413 before reading them —
+# the handler otherwise trusts Content-Length and buffers the whole body.
+MAX_BODY_BYTES = 8 << 20
+
 SCORE_BUCKET = 64
 MAX_BATCH = 64
 SPEC_GAMMA = 4  # speculative draft chunk width (echoed in responses)
@@ -275,7 +279,8 @@ class BundleServer:
 
             @jax.jit
             def nll(params, ids, lengths):
-                logits = model.apply({"params": dequantize_tree(params)}, ids)
+                logits = model.apply({"params": dequantize_tree(params)},
+                                     ids, train=False)
                 lg = logits[:, :-1].astype(jnp.float32)
                 per_tok = optax.softmax_cross_entropy_with_integer_labels(
                     lg, ids[:, 1:])
@@ -358,6 +363,14 @@ def _make_handler(server: BundleServer):
         def do_POST(self):
             try:
                 n = int(self.headers.get("Content-Length", 0))
+                if n > MAX_BODY_BYTES:
+                    # Replying without reading the body desyncs an
+                    # HTTP/1.1 keep-alive stream (the unread bytes would
+                    # parse as the next request) — drop the connection.
+                    self.close_connection = True
+                    return self._reply(413, {
+                        "error": f"body too large ({n} bytes > "
+                                 f"{MAX_BODY_BYTES})"})
                 req = json.loads(self.rfile.read(n) or b"{}")
             except (ValueError, json.JSONDecodeError) as exc:
                 return self._reply(400, {"error": f"bad JSON body: {exc}"})
@@ -390,7 +403,9 @@ def _make_handler(server: BundleServer):
                     self._reply(200, {"scores": server.score(texts)})
                 else:
                     self._reply(404, {"error": f"unknown path {self.path}"})
-            except ValueError as exc:
+            except (TypeError, ValueError) as exc:
+                # TypeError too: int(None)/float([]) from JSON null/list
+                # field values is caller error, not a server fault
                 self._reply(400, {"error": str(exc)})
             except Exception as exc:  # noqa: BLE001 — keep the server up
                 logger.exception("request failed")

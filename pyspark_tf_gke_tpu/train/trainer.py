@@ -126,9 +126,12 @@ def vit_task() -> TrainerTask:
 
 
 def _bert_forward(model, variables, batch, train, mutable):
-    """Shared forward for every BERT objective (classification, MLM)."""
+    """Shared forward for every BERT objective (classification, MLM).
+    ``train`` routes the embedding lookup: one-hot matmul when a
+    gradient will flow, plain gather for eval (models/embedding.py)."""
     return model.apply(
-        variables, batch["input_ids"], attention_mask=batch.get("attention_mask")
+        variables, batch["input_ids"],
+        attention_mask=batch.get("attention_mask"), train=train
     ), None
 
 
@@ -200,7 +203,7 @@ def causal_lm_task(vocab_chunks: Optional[int] = None) -> TrainerTask:
         def forward(model, variables, batch, train, mutable):
             hidden = model.apply(variables, batch["input_ids"],
                                  segment_ids=batch.get("segment_ids"),
-                                 return_hidden=True)
+                                 return_hidden=True, train=train)
             head = variables["params"]["lm_head"]
             return {"hidden": hidden, "kernel": head["kernel"],
                     "bias": head.get("bias")}, None
@@ -221,7 +224,8 @@ def causal_lm_task(vocab_chunks: Optional[int] = None) -> TrainerTask:
 
     def forward(model, variables, batch, train, mutable):
         return model.apply(variables, batch["input_ids"],
-                           segment_ids=batch.get("segment_ids")), None
+                           segment_ids=batch.get("segment_ids"),
+                           train=train), None
 
     def lam(logits, batch):
         ids = batch["input_ids"]

@@ -111,7 +111,7 @@ class Config:
     #     this to infra probes — SURVEY §5) ---
     max_restarts: int = _env_int("MAX_RESTARTS", 0)  # in-process restarts w/ resume
     heartbeat_every_steps: int = _env_int("HEARTBEAT_EVERY_STEPS", 10)  # 0 → off
-    # Local path for the liveness heartbeat; "" → <output_dir>/heartbeat.json.
+    # Local path for the liveness heartbeat; "" → <output_dir>/heartbeat-{process_index}.json.
     # Must be node-local (not gs://) when used as a k8s exec probe.
     heartbeat_file: str = _env("HEARTBEAT_FILE", "")
     fail_at_steps: str = _env("FAIL_AT_STEPS", "")  # chaos: "12,40" injects faults
@@ -178,7 +178,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
     p.add_argument("--heartbeat-every-steps", type=int, default=cfg.heartbeat_every_steps,
                    help="write the liveness heartbeat every N steps (0=off)")
     p.add_argument("--heartbeat-file", default=cfg.heartbeat_file,
-                   help="heartbeat path; empty = <output-dir>/heartbeat.json")
+                   help="heartbeat path; empty = <output-dir>/heartbeat-{process_index}.json")
     p.add_argument("--fail-at-steps", default=cfg.fail_at_steps,
                    help='chaos testing: inject faults at these global steps, e.g. "12,40"')
     ns = p.parse_args(argv)

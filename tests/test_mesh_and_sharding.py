@@ -69,3 +69,19 @@ def test_validate_ipv4_rejects_bad():
         validate_ipv4("300.1.1.1")
     validate_ipv4("192.168.1.10")  # ok
     validate_ipv4("my-host.example:8476")  # DNS names ok
+
+
+def test_mesh_extent_for_follows_rules(devices):
+    # Divisibility guards derive shard extents from LOGICAL_RULES, not
+    # hardcoded mesh-axis names (round-3 ADVICE): remapping a rule must
+    # move every guard with it.
+    from pyspark_tf_gke_tpu.parallel.sharding import mesh_extent_for
+
+    mesh = make_mesh({"dp": 2, "tp": 4}, devices)
+    assert mesh_extent_for("heads", mesh) == 4      # ("heads","tp")
+    assert mesh_extent_for("batch", mesh) == 2      # ("dp","fsdp"), fsdp=1
+    assert mesh_extent_for("head_dim", mesh) == 1   # mapped to None
+    assert mesh_extent_for("nonexistent", mesh) == 1
+    assert mesh_extent_for("heads", None) == 1
+    remapped = (("heads", "dp"),)
+    assert mesh_extent_for("heads", mesh, rules=remapped) == 2

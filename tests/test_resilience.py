@@ -103,7 +103,9 @@ def test_cli_chaos_recovery_end_to_end(tmp_path):
     # epochs, so history still records 4 epochs.
     assert len(history["loss"]) == 4
     assert all(np.isfinite(v) for v in history["loss"])
-    hb = Heartbeat.read(os.path.join(out, "heartbeat.json"))
+    # default heartbeat path is per-process (round-3 ADVICE): a hung
+    # process must not hide behind a live peer's shared-file beats
+    hb = Heartbeat.read(os.path.join(out, "heartbeat-0.json"))
     assert hb is not None and hb["step"] >= 30
     assert os.path.exists(os.path.join(out, "history.json"))
 
@@ -143,3 +145,19 @@ def test_watchdog_cli_detects_stale_and_clean(tmp_path, capsys):
                                  "process_index": 0, "process_count": 1}))
     assert _watch_main(["--paths", str(fresh), "--stall", "60",
                         "--timeout", "1", "--poll", "0.2"]) == 0
+
+
+def test_detect_stall_never_appearing_file(tmp_path):
+    # A worker hung before its FIRST beat writes no file at all — after
+    # stall_seconds of watchdog runtime a still-missing path is stalled
+    # (round-3 ADVICE: it previously passed as healthy forever).
+    from pyspark_tf_gke_tpu.train.resilience import detect_stall
+
+    missing = str(tmp_path / "never-appears.json")
+    hit = detect_stall([missing], stall_seconds=0.2, timeout_s=2.0,
+                       poll_s=0.05)
+    assert hit == missing
+    # ... but with timeout < stall window the grace never elapses: the
+    # "not started yet" (k8s initialDelay) phase stays healthy.
+    assert detect_stall([missing], stall_seconds=60, timeout_s=0.3,
+                        poll_s=0.05) is None

@@ -111,6 +111,23 @@ def test_http_errors(endpoint):
     with pytest.raises(urllib.error.HTTPError) as e:
         _post(endpoint, "/v1/nope", {})
     assert e.value.code == 404
+    # JSON null for a numeric field → 400, not 500 (int(None) raises
+    # TypeError; round-3 ADVICE)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(endpoint, "/v1/generate",
+              {"prompts": ["ab"], "max_new_tokens": None})
+    assert e.value.code == 400
+    # oversize Content-Length → 413 before the body is read
+    from pyspark_tf_gke_tpu.train.serve import MAX_BODY_BYTES
+
+    req = urllib.request.Request(
+        endpoint + "/v1/generate", data=b"{}",
+        headers={"Content-Type": "application/json",
+                 "Content-Length": str(MAX_BODY_BYTES + 1)})
+    req.method = "POST"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req)
+    assert e.value.code == 413
 
 
 def test_lm_eval_endpoint_mode(endpoint, tmp_path, capsys):
