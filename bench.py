@@ -819,9 +819,12 @@ ALL_WORKLOADS = (
 )
 
 
+GATE_ATTACH_FAILED = ("backend attach failed (probed once for the "
+                      "whole matrix)")
+
+
 def _run_matrix(extra, backend_ok: bool, skip=(),
-                gate_reason: str = "backend attach failed (probed once "
-                                   "for the whole matrix)") -> int:
+                gate_reason: str = GATE_ATTACH_FAILED) -> int:
     """Run the matrix workloads back to back with ONE shared probe
     verdict, appending each success to the history trail. Returns the
     failure count. With the tunnel down, per-workload probing would burn
@@ -850,8 +853,7 @@ def orchestrate_all(extra) -> int:
     window to one-at-a-time runs. Emits one JSON line per workload on
     stdout and a final summary line; rc=0 if every workload measured."""
     smoke = "--smoke" in extra
-    gate_reason = ("backend attach failed (probed once for the whole "
-                   "matrix)")
+    gate_reason = GATE_ATTACH_FAILED
     if smoke:
         backend_ok = True
     else:

@@ -58,6 +58,11 @@ class TokenEmbed(nn.Module):
             (self.num_embeddings, self.features), jnp.float32,
         )
         if one_hot:
+            # HIGHEST precision: on TPU the default f32 matmul runs in
+            # bf16 passes, which would round the table values and break
+            # bit-parity with the gather; the one-hot contraction is
+            # cheap enough that exactness wins.
             oh = jax.nn.one_hot(ids, self.num_embeddings, dtype=self.dtype)
-            return jnp.matmul(oh, table.astype(self.dtype))
+            return jnp.matmul(oh, table.astype(self.dtype),
+                              precision=jax.lax.Precision.HIGHEST)
         return jnp.take(table, ids, axis=0).astype(self.dtype)

@@ -167,16 +167,32 @@ class PipelinedBertClassifier:
 
     # ---- forward ------------------------------------------------------------
 
-    def _embed(self, p, input_ids, token_type_ids=None):
+    def _embed(self, p, input_ids, token_type_ids=None, train=True):
         cfg = self.cfg
         s = input_ids.shape[1]
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
-        hidden = (
-            p["embed"]["word"][input_ids]
-            + p["embed"]["pos"][:s][None]
-            + p["embed"]["type"][token_type_ids]
-        )
+        if train:
+            # one-hot matmul lookup when a gradient will flow — the
+            # gather backward's scatter-add reshards badly under GSPMD
+            # (models/embedding.py); HIGHEST precision keeps it
+            # bit-equal to the gather.
+            hp = jax.lax.Precision.HIGHEST
+            word = jnp.matmul(
+                jax.nn.one_hot(input_ids, cfg.vocab_size,
+                               dtype=p["embed"]["word"].dtype),
+                p["embed"]["word"], precision=hp)
+            typ = jnp.matmul(
+                jax.nn.one_hot(token_type_ids, cfg.type_vocab_size,
+                               dtype=p["embed"]["type"].dtype),
+                p["embed"]["type"], precision=hp)
+            hidden = word + p["embed"]["pos"][:s][None] + typ
+        else:
+            hidden = (
+                p["embed"]["word"][input_ids]
+                + p["embed"]["pos"][:s][None]
+                + p["embed"]["type"][token_type_ids]
+            )
         hidden = _layernorm(
             hidden, p["embed"]["ln_scale"], p["embed"]["ln_bias"], cfg.layer_norm_eps
         )
@@ -197,10 +213,10 @@ class PipelinedBertClassifier:
         return jnp.where(attention_mask.astype(bool), 0.0, NEG_INF).astype(jnp.float32)
 
     def apply(self, variables: Dict[str, Any], input_ids, attention_mask=None,
-              token_type_ids=None) -> Dict[str, jnp.ndarray]:
+              token_type_ids=None, train: bool = True) -> Dict[str, jnp.ndarray]:
         p = nn.meta.unbox(variables["params"])
         cfg = self.cfg
-        hidden = self._embed(p, input_ids, token_type_ids)
+        hidden = self._embed(p, input_ids, token_type_ids, train=train)
         bias = self._bias(input_ids, attention_mask)
 
         def stage_fn(stage_p, h, extras):
@@ -217,12 +233,12 @@ class PipelinedBertClassifier:
         return self._head(p, hidden)
 
     def apply_sequential(self, variables: Dict[str, Any], input_ids,
-                         attention_mask=None,
-                         token_type_ids=None) -> Dict[str, jnp.ndarray]:
+                         attention_mask=None, token_type_ids=None,
+                         train: bool = True) -> Dict[str, jnp.ndarray]:
         """Oracle path: same params, plain layer loop, no mesh/pipeline —
         the parity reference for tests."""
         p = nn.meta.unbox(variables["params"])
-        hidden = self._embed(p, input_ids, token_type_ids)
+        hidden = self._embed(p, input_ids, token_type_ids, train=train)
         bias = self._bias(input_ids, attention_mask)
         flat = merge_stages(p["layers"])
         for i in range(self.cfg.num_layers):

@@ -351,6 +351,10 @@ def _make_handler(server: BundleServer):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if self.close_connection:
+                # advertise the close (http.server's send_error does the
+                # same) so pooling clients don't reuse a dying socket
+                self.send_header("Connection", "close")
             self.end_headers()
             self.wfile.write(body)
 
