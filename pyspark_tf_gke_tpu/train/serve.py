@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pyspark_tf_gke_tpu.train.serving import as_host_array
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
 logger = get_logger("train.serve")
@@ -202,7 +203,7 @@ class BundleServer:
                         eos_token_id=eos_id, return_stats=True)
                 dt = (time.perf_counter() - t0) * 1000.0
             return [self._entry(
-                prompts[0], np.asarray(out[0, len(ids):]).tolist(), dt,
+                prompts[0], np.asarray(as_host_array(out)[0, len(ids):]).tolist(), dt,
                 eos_id,
                 speculative={
                     "gamma": SPEC_GAMMA,
@@ -232,7 +233,7 @@ class BundleServer:
                             self.model, self.params, batch,
                             max_new_tokens=max_new_tokens,
                             num_beams=num_beams, eos_token_id=eos_id)
-                    scores = np.asarray(scores)
+                    scores = np.asarray(as_host_array(scores))
                 else:
                     gen_fn = generate if self.mesh is None else serve_generate
                     kwargs = {} if self.mesh is None else {"mesh": self.mesh}
@@ -243,7 +244,7 @@ class BundleServer:
                         top_p=top_p, eos_token_id=eos_id,
                         repetition_penalty=repetition_penalty, **kwargs)
                     scores = None
-                toks = np.asarray(out[:n_real, length:])
+                toks = np.asarray(as_host_array(out))[:n_real, length:]
                 dt = (time.perf_counter() - t0) * 1000.0
                 for row, (i, _) in enumerate(members):
                     extra = ({"beam_score": float(scores[row])}
@@ -327,9 +328,9 @@ class BundleServer:
             with self._lock:
                 fn = self._score_fn()
                 with self.mesh or contextlib.nullcontext():
-                    nlls = np.asarray(
+                    nlls = np.asarray(as_host_array(
                         fn(self.params, jnp.asarray(padded),
-                           jnp.asarray(lengths, jnp.int32)))
+                           jnp.asarray(lengths, jnp.int32))))
             for r, (i, ids, trunc) in enumerate(rows):
                 results[i] = {"nll": float(nlls[r]), "tokens": len(ids) - 1,
                               "truncated": trunc}

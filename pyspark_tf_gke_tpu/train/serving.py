@@ -100,10 +100,33 @@ def serve_generate(model, params, prompt_ids, mesh: Optional[Mesh] = None,
     """``generate`` under a mesh context (no-op mesh → single chip).
     ``params`` should already be placed (``shard_params_for_serving``);
     the prompt is replicated — decode is latency-bound, and batch
-    sharding over dp composes at the caller level if wanted."""
+    sharding over dp composes at the caller level if wanted.
+
+    On a multi-process mesh the generated tokens can come back sharded
+    across hosts (not fully addressable) — a server process must be able
+    to READ what it is about to send to the client, so the output is
+    all-gathered to every host (a [B, S] int32 array; negligible next to
+    the decode itself). Every process participates in the gather, which
+    is the natural SPMD serving shape: all processes run the same
+    request."""
     from pyspark_tf_gke_tpu.models.causal_lm import generate
 
     if mesh is None:
         return generate(model, params, prompt_ids, **kwargs)
     with mesh:
-        return generate(model, params, prompt_ids, **kwargs)
+        out = generate(model, params, prompt_ids, **kwargs)
+    return as_host_array(out)
+
+
+def as_host_array(x):
+    """Make a device array host-readable on EVERY process: on a
+    multi-process mesh outputs can come back sharded across hosts (not
+    fully addressable), and a server about to serialize tokens/scores
+    must hold the whole thing. No-op for single-process arrays; an SPMD
+    all-gather otherwise (all processes run the same request, so all
+    reach this collective)."""
+    if getattr(x, "is_fully_addressable", True):
+        return x
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x, tiled=True)
