@@ -1,0 +1,140 @@
+"""Trained target/draft fixture for speculative decoding.
+
+Random weights give near-zero acceptance (the lower bound) and a
+self-draft gives exactly 1.0 (the upper bound); neither resembles a
+deployed draft/target pair, so the spec bench and tests said almost
+nothing about real speculative behavior (round-3 VERDICT, Weak #5).
+
+This module trains a tiny byte-level target and a smaller draft on the
+SAME low-entropy synthetic text for a few hundred Adam steps — enough
+for both to lock onto the distribution, so the draft's greedy proposals
+agree with the target's often but not always. The whole training loop
+is one ``lax.scan`` under one jit per model (seconds on CPU, trivial on
+a chip), deterministic by seed.
+
+Text source: sentences drawn from a tiny first-order Markov chain over
+a dozen words (seeded). The entropy is low enough that two different
+model sizes both learn it quickly, and high enough (branching successors)
+that a half-size draft keeps disagreeing with the target sometimes —
+which is exactly the regime speculative decoding is for.
+
+Reference counterpart: none (the reference has no generation at all);
+the fixture pattern follows the standard practice of evaluating
+speculative decoding with a distilled/smaller draft of the same data.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+# word -> possible successors; deterministic-ish chain with branching so
+# a smaller model stays imperfect on it
+_CHAIN = {
+    "the": ["tpu", "mesh", "ring", "chip"],
+    "tpu": ["shards", "runs", "compiles"],
+    "mesh": ["shards", "holds"],
+    "ring": ["passes", "runs"],
+    "chip": ["runs", "holds"],
+    "shards": ["the"],
+    "runs": ["the", "fast", "."],
+    "holds": ["the"],
+    "passes": ["the"],
+    "compiles": ["the", "fast", "."],
+    "fast": ["."],
+    ".": ["the"],
+}
+
+
+def synthetic_text(n_chars: int, seed: int = 0) -> str:
+    rng = np.random.default_rng(seed)
+    words, word = [], "the"
+    total = 0
+    while total < n_chars:
+        words.append(word)
+        total += len(word) + 1
+        succ = _CHAIN[word]
+        word = succ[int(rng.integers(len(succ)))]
+    return " ".join(words)
+
+
+def _pack_rows(seq_len: int, n_rows: int, seed: int = 0) -> np.ndarray:
+    """[n_rows, seq_len] int32 byte tokens cut from one generated stream."""
+    from pyspark_tf_gke_tpu.data.text import ByteTokenizer
+
+    tok = ByteTokenizer()
+    stream = np.asarray(
+        tok.encode(synthetic_text(seq_len * (n_rows + 1), seed=seed)),
+        dtype=np.int32)
+    need = seq_len * n_rows
+    assert stream.size >= need, "generator under-produced"
+    return stream[:need].reshape(n_rows, seq_len)
+
+
+def _train_lm(model, rows: np.ndarray, steps: int, lr: float,
+              seed: int):
+    """A few hundred Adam steps over the fixed row set, the whole loop
+    inside one jitted ``lax.scan`` (no per-step dispatch overhead —
+    matters through the remote-TPU tunnel)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from flax import linen as nn
+
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    params = nn.meta.unbox(
+        jax.jit(model.init)(make_rng(seed), jnp.asarray(rows[:1]))["params"])
+    tx = optax.adam(lr)
+    data = jnp.asarray(rows)
+    n_rows = rows.shape[0]
+
+    def one_step(carry, i):
+        params, opt = carry
+        ids = jax.lax.dynamic_index_in_dim(data, i % n_rows, axis=0,
+                                           keepdims=True)
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, ids, train=True)
+            lg = logits[:, :-1].astype(jnp.float32)
+            per = optax.softmax_cross_entropy_with_integer_labels(
+                lg, ids[:, 1:])
+            return per.mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt = tx.update(grads, opt, params)
+        return (optax.apply_updates(params, updates), opt), loss
+
+    @jax.jit
+    def train(params):
+        opt = tx.init(params)
+        (params, _), losses = jax.lax.scan(
+            one_step, (params, opt), jnp.arange(steps))
+        return params, losses[-1]
+
+    params, _ = train(params)
+    return params
+
+
+def make_spec_fixture(steps: int = 400, seq_len: int = 64,
+                      seed: int = 0) -> Tuple:
+    """Returns ``(target, tparams, draft, dparams, prompt)``: a trained
+    2-layer h64 byte target, a trained 1-layer h32 draft (same data),
+    and an in-distribution prompt row. Deterministic by seed."""
+    import jax.numpy as jnp
+
+    from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
+
+    common = dict(vocab_size=259, intermediate_size=128, max_seq_len=256,
+                  dtype=jnp.float32)
+    tcfg = CausalLMConfig(hidden_size=64, num_layers=2, num_heads=4,
+                          **common)
+    dcfg = CausalLMConfig(hidden_size=32, num_layers=1, num_heads=2,
+                          **{**common, "intermediate_size": 64})
+    rows = _pack_rows(seq_len, n_rows=32, seed=seed)
+    target, draft = CausalLM(tcfg), CausalLM(dcfg)
+    tparams = _train_lm(target, rows, steps, lr=3e-3, seed=seed)
+    dparams = _train_lm(draft, rows, steps, lr=3e-3, seed=seed + 1)
+    prompt = jnp.asarray(_pack_rows(16, n_rows=1, seed=seed + 2))
+    return target, tparams, draft, dparams, prompt
