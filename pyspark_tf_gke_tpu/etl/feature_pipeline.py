@@ -21,11 +21,12 @@ bit-comparable to what Spark's VectorAssembler would produce.
 
 from __future__ import annotations
 
-import os
 from collections import Counter
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
+
+from pyspark_tf_gke_tpu.etl.knobs import NUMERIC_COLS
 
 
 def string_index(values: Sequence[str]) -> Dict[str, int]:
@@ -40,15 +41,14 @@ class FeaturePipeline:
     def __init__(
         self,
         category_col: str = "measure_name",
-        numeric_cols: Sequence[str] = ("value", "lower_ci", "upper_ci"),
+        numeric_cols: Sequence[str] = NUMERIC_COLS,
         repeats: Optional[int] = None,
         drop_last: bool = True,
     ):
         if repeats is None:
-            try:
-                repeats = int(os.environ.get("MEASURE_NAME_WEIGHT", "5"))
-            except Exception:
-                repeats = 5
+            from pyspark_tf_gke_tpu.etl.knobs import measure_weight
+
+            repeats = measure_weight()
         self.repeats = max(1, int(repeats))
         self.category_col = category_col
         self.numeric_cols = list(numeric_cols)

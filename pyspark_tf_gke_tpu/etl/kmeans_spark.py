@@ -12,8 +12,6 @@ The TPU-native twin of this job is ``etl.kmeans`` + ``etl.feature_pipeline``.
 
 from __future__ import annotations
 
-import os
-
 from pyspark_tf_gke_tpu.etl.spark_session import CreateSparkSession, _require_pyspark
 from pyspark_tf_gke_tpu.etl.jdbc_ingest import RetrieveDataFromMySQL
 
@@ -39,9 +37,11 @@ class KMeansSparkWorkload:
         returns fresh ones (the fit path)."""
         from pyspark.sql.functions import col, isnan, when
 
+        from pyspark_tf_gke_tpu.etl.knobs import NUMERIC_COLS
+
         input_df = input_df.filter(col("measure_name").isNotNull())
         used = {}
-        for name in ("value", "lower_ci", "upper_ci"):
+        for name in NUMERIC_COLS:
             if name in input_df.columns:
                 if means is not None and name in means:
                     mean_val = means[name]
@@ -68,32 +68,27 @@ class KMeansSparkWorkload:
         input_df, means = self._clean(input_df)
         type(self).impute_means = means
 
+        from pyspark_tf_gke_tpu.etl.knobs import (
+            KMEANS_MAX_ITER,
+            KMEANS_SEED,
+            assemble_feature_cols,
+            kmeans_k,
+            measure_weight,
+        )
+
         stages = [
             StringIndexer(inputCol="measure_name", outputCol="measure_name_index",
                           handleInvalid="keep"),
             OneHotEncoder(inputCol="measure_name_index", outputCol="measure_name_vec"),
         ]
-        numeric_cols = ["value", "lower_ci", "upper_ci"]
-
-        try:
-            repeats = int(os.environ.get("MEASURE_NAME_WEIGHT", "5"))
-        except Exception:
-            repeats = 5
-        repeats = max(1, repeats)
-        feature_cols = ["measure_name_vec"] * repeats + numeric_cols
-        stages.append(VectorAssembler(inputCols=feature_cols, outputCol="features",
-                                      handleInvalid="keep"))
+        stages.append(VectorAssembler(
+            inputCols=assemble_feature_cols(measure_weight()),
+            outputCol="features", handleInvalid="keep"))
 
         pipeline_model = Pipeline(stages=stages).fit(input_df)
         dataset = pipeline_model.transform(input_df).select("features")
-        # k=25/seed=1/maxIter=1000 are the reference's constants
-        # (k_means.py:83); KMEANS_K is env-overridable the same way
-        # MEASURE_NAME_WEIGHT is so small fixtures can cluster too
-        try:
-            k = int(os.environ.get("KMEANS_K", "25"))
-        except ValueError:
-            k = 25
-        model = KMeans().setK(max(2, k)).setSeed(1).setMaxIter(1000).fit(dataset)
+        model = (KMeans().setK(kmeans_k()).setSeed(KMEANS_SEED)
+                 .setMaxIter(KMEANS_MAX_ITER).fit(dataset))
         type(self).pipeline_model = pipeline_model
         type(self).kmeans_model = model
         return pipeline_model, model

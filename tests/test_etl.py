@@ -408,3 +408,32 @@ def test_spark_local2_text_bridge_packed_tokens(spark_local, tmp_path):
         assert (arr >= 0).all() and (arr < 259).all()
         rows += arr.shape[0]
     assert rows > 0
+
+
+def test_knobs_pure_no_pyspark(monkeypatch):
+    """etl/knobs.py: the env knobs and feature-column assembly shared by
+    the Spark job and the host pipeline are importable and correct with
+    NO pyspark (round-3 VERDICT #8 — JVM-gated code is session glue
+    only)."""
+    from pyspark_tf_gke_tpu.etl import knobs
+
+    monkeypatch.delenv("MEASURE_NAME_WEIGHT", raising=False)
+    monkeypatch.delenv("KMEANS_K", raising=False)
+    assert knobs.measure_weight() == 5
+    assert knobs.kmeans_k() == 25
+    monkeypatch.setenv("MEASURE_NAME_WEIGHT", "3")
+    monkeypatch.setenv("KMEANS_K", "4")
+    assert knobs.measure_weight() == 3
+    assert knobs.kmeans_k() == 4
+    monkeypatch.setenv("MEASURE_NAME_WEIGHT", "-2")  # clamped
+    monkeypatch.setenv("KMEANS_K", "junk")           # default on parse error
+    assert knobs.measure_weight() == 1
+    assert knobs.kmeans_k() == 25
+    cols = knobs.assemble_feature_cols(3)
+    assert cols == ["measure_name_vec"] * 3 + ["value", "lower_ci",
+                                               "upper_ci"]
+    # FeaturePipeline's default weighting routes through the same knob
+    from pyspark_tf_gke_tpu.etl.feature_pipeline import FeaturePipeline
+
+    monkeypatch.setenv("MEASURE_NAME_WEIGHT", "2")
+    assert FeaturePipeline().repeats == 2
