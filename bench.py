@@ -30,7 +30,7 @@ batches);
 measures KV-cache decode tokens/sec on the serving path (GQA, weight-
 only int8, int8 KV cache, beam search); ``python bench.py spec
 [--gamma N]`` measures speculative decoding (lower + upper bounds).
-``python bench.py all`` runs the full 13-workload matrix with ONE
+``python bench.py all`` runs the full 14-workload matrix with ONE
 backend probe, appending every success to tools/bench_history.jsonl.
 
 Resilience: the TPU backend attach through the tunnel is known-flaky
@@ -159,7 +159,7 @@ def _mfu(flops_per_step, step_seconds: float, device_kind: str):
 
 
 def build_workload(name: str, smoke: bool = False, batch_override: int = 0,
-                   use_flash=None, seq_override=None):
+                   use_flash=None, seq_override=None, mu_dtype=None):
     """(trainer, batch, batch_size, extra) for a named workload — the
     single construction point shared by the bench passes below and by
     ``tools/roofline.py``, so the analysis tool always explains exactly
@@ -185,8 +185,12 @@ def build_workload(name: str, smoke: bool = False, batch_override: int = 0,
             "target": rng.uniform(
                 0, 256, (batch_size, 2)).astype(np.float32),
         }
+        # mu_dtype: the flagship is param/optimizer-traffic-bound at
+        # batch 32 (tools/roofline.py analytic model); bf16 Adam
+        # first moments halve that slice of the HBM stream. Disclosed
+        # as a separate matrix entry — the headline keeps f32 parity.
         trainer = Trainer(model, TASKS["regression"](), mesh,
-                          learning_rate=1e-3)
+                          learning_rate=1e-3, mu_dtype=mu_dtype)
     elif name == "resnet50":
         from pyspark_tf_gke_tpu.models import ResNet50
 
@@ -252,7 +256,7 @@ def build_workload(name: str, smoke: bool = False, batch_override: int = 0,
 
 
 def main(batch_size: int = 32, steps: int = 100, throughput_batch: int = 128,
-         throughput_steps: int = 40) -> dict:
+         throughput_steps: int = 40, mu_dtype=None) -> dict:
     import jax
 
     from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding
@@ -264,7 +268,8 @@ def main(batch_size: int = 32, steps: int = 100, throughput_batch: int = 128,
     device_kind = devices[0].device_kind
 
     trainer, hbatch, batch_size, _ = build_workload("cnn",
-                                                    batch_override=batch_size)
+                                                    batch_override=batch_size,
+                                                    mu_dtype=mu_dtype)
     mesh = trainer.mesh
     rng = np.random.default_rng(0)
     images, targets = hbatch["image"], hbatch["target"]
@@ -329,8 +334,11 @@ def main(batch_size: int = 32, steps: int = 100, throughput_batch: int = 128,
         "batch_size": batch_size,
         "n_chips": n_chips,
         "device_kind": device_kind,
-        "workload": "CNN-B1 43.4M params, 256x320x3, Adam+MSE, bf16 compute",
+        "workload": "CNN-B1 43.4M params, 256x320x3, Adam+MSE, bf16 compute"
+                    + (" + bf16 Adam moments" if mu_dtype is not None else ""),
         "baseline": "reference TF CNN-B1 on 16 vCPU (extrapolated; tools/reference_baseline.json)",
+        **({"adam_mu_dtype": str(np.dtype(mu_dtype))}
+           if mu_dtype is not None else {}),
         **tp,
     }
     log(f"loss trajectory: {losses[0]:.3f} -> {losses[-1]:.3f}")
@@ -716,12 +724,23 @@ def _positionals(argv) -> list:
     return out
 
 
-def _latest_history(workload: str):
-    """Most recent committed evidence-trail entry whose argv starts with
-    this workload (None if the trail has none). Attached to error JSON
-    so a tunnel outage at capture time still points the reader at the
-    last REAL measurement — explicitly marked stale, never substituted
-    for the live value."""
+def _normalize_argv(argv) -> list:
+    """Canonical identity of a bench invocation: drop the flags that
+    don't change WHAT is measured, and name the bare flagship
+    explicitly. Two cnn variants (e.g. ``--bf16-moments``) normalize
+    differently — they are different measurements."""
+    out = [a for a in argv if a not in ("--smoke", "--no-history")]
+    return out or ["cnn"]
+
+
+def _latest_history(argv):
+    """Most recent committed evidence-trail entry for EXACTLY this
+    invocation (normalized argv match — a ``cnn --bf16-moments`` entry
+    must never stand in for the f32 parity flagship). None if the trail
+    has none. Attached to error JSON so a tunnel outage at capture time
+    still points the reader at the last REAL measurement — explicitly
+    marked stale, never substituted for the live value."""
+    want = _normalize_argv(argv)
     entries = []
     try:
         with open(HISTORY_PATH) as fh:
@@ -738,22 +757,26 @@ def _latest_history(workload: str):
     except OSError:
         return None
     for entry in reversed(entries):
-        pos = _positionals(entry.get("argv", []) or [])
-        if (pos and pos[0] == workload) or (not pos and workload == "cnn"):
+        if _normalize_argv(entry.get("argv", []) or []) == want:
             return entry
     return None
 
 
-def _error_json(workload: str, stage: str, detail: str) -> dict:
+def _error_json(argv, stage: str, detail: str) -> dict:
+    norm = _normalize_argv(argv)
+    workload = norm[0]
     out = {
         "metric": f"{workload}_train_images_per_sec_per_chip" if workload == "cnn"
         else f"{workload}_bench",
         "value": None,
         "unit": "images/sec/chip" if workload == "cnn" else "examples/sec/chip",
         "vs_baseline": None,
+        # full normalized argv so two variants of one workload (e.g.
+        # cnn vs cnn --bf16-moments) stay distinguishable in error lines
+        "argv": norm,
         "error": {"stage": stage, "detail": detail[-2000:]},
     }
-    last = _latest_history(workload)
+    last = _latest_history(argv)
     if last is not None:
         out["last_recorded"] = {"ts": last["ts"], "stale": True,
                                 "result": last["result"]}
@@ -836,6 +859,7 @@ def probe_backend() -> str:
 
 ALL_WORKLOADS = (
     ["cnn"],
+    ["cnn", "--bf16-moments"],  # disclosed optimizer-traffic lever
     ["resnet50"],
     ["vit"],
     ["bert"],
@@ -869,7 +893,7 @@ def _run_matrix(extra, backend_ok: bool, skip=(),
             continue
         log(f"=== bench matrix: {' '.join(argv)} ===")
         if argv[0] != "io" and not backend_ok:
-            print(json.dumps(_error_json(argv[0], "probe", gate_reason)))
+            print(json.dumps(_error_json(list(argv), "probe", gate_reason)))
             failures += 1
             continue
         rc = orchestrate([*argv, *extra], skip_probe=True)
@@ -910,7 +934,7 @@ def orchestrate_all(extra) -> int:
 def orchestrate_bare() -> int:
     """``python bench.py`` with NO arguments — the driver's fixed capture
     command. It can only ever record the flagship, so when the tunnel
-    finally answers during a driver capture, 12 of 13 matrix
+    finally answers during a driver capture, 13 of 14 matrix
     measurements would still be missing (round-3 verdict, Weak #4). The
     bare invocation therefore chains opportunistically into the rest of
     the matrix after a successful flagship run: the flagship JSON stays
@@ -920,7 +944,7 @@ def orchestrate_bare() -> int:
     desc = probe_backend()
     if not desc:
         print(json.dumps(_error_json(
-            "cnn", "probe",
+            ["cnn"], "probe",
             f"backend attach failed after {PROBE_ATTEMPTS} attempts "
             f"({PROBE_TIMEOUT_S}s timeout each)")))
         return 1
@@ -956,7 +980,7 @@ def orchestrate(argv, skip_probe: bool = False) -> int:
     if (workload != "io" and "--smoke" not in argv and not skip_probe
             and not probe_backend()):
         print(json.dumps(_error_json(
-            workload, "probe",
+            list(argv), "probe",
             f"backend attach failed after {PROBE_ATTEMPTS} attempts "
             f"({PROBE_TIMEOUT_S}s timeout each)")))
         return 1
@@ -987,7 +1011,7 @@ def orchestrate(argv, skip_probe: bool = False) -> int:
         log(f"[run {attempt + 1}/{RUN_ATTEMPTS}] failed: {last}")
         if attempt < RUN_ATTEMPTS - 1:
             time.sleep(BACKOFF_S[0])
-    print(json.dumps(_error_json(workload, "run", last)))
+    print(json.dumps(_error_json(list(argv), "run", last)))
     return 1
 
 
@@ -996,10 +1020,16 @@ def run_bench(argv) -> dict:
     smoke = "--smoke" in argv
     workload = args[0] if args else "cnn"
     if workload == "cnn":
+        mu = None
+        if "--bf16-moments" in argv:
+            import jax.numpy as jnp
+
+            mu = jnp.bfloat16
         # --smoke shrinks the flagship run too (small batch, few steps,
         # no secondary throughput-batch pass; batch stays divisible by
         # the fake slice's 8 devices).
-        return main(batch_size=8, steps=2, throughput_batch=0) if smoke else main()
+        return (main(batch_size=8, steps=2, throughput_batch=0, mu_dtype=mu)
+                if smoke else main(mu_dtype=mu))
     if workload == "io":
         return bench_io(smoke=smoke)
     if workload == "spec":

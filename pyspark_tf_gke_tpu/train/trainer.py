@@ -279,11 +279,17 @@ class Trainer:
         fsdp_min_size: int = DEFAULT_MIN_SIZE,
         logical_rules=LOGICAL_RULES,
         ema_decay: float = 0.0,  # >0 maintains an EMA of params (eval/serving)
+        mu_dtype: Optional[Any] = None,  # Adam first-moment dtype; bf16
+        # halves that slice of the per-step param/optimizer HBM traffic
+        # — the flagship (43M params, batch 32) is bound on exactly that
+        # stream (tools/roofline.py analytic model). Default f32 keeps
+        # reference-parity optimizer numerics; ignored when tx is given.
     ):
         self.model = model
         self.task = task
         self.mesh = mesh
-        self.tx = tx if tx is not None else optax.adam(learning_rate)
+        self.tx = tx if tx is not None else optax.adam(
+            learning_rate, mu_dtype=mu_dtype)
         self.fsdp_min_size = fsdp_min_size
         self.logical_rules = logical_rules
         self.ema_decay = ema_decay

@@ -200,3 +200,21 @@ def test_run_matrix_shared_by_all_and_bare():
 
     assert "_run_matrix" in inspect.getsource(bench.orchestrate_all)
     assert "_run_matrix" in inspect.getsource(bench.orchestrate_bare)
+
+
+def test_latest_history_distinguishes_cnn_variants(monkeypatch, tmp_path):
+    # A cnn --bf16-moments entry must never stand in for the f32 parity
+    # flagship in stale-fallback error JSON (and vice versa).
+    hist = tmp_path / "hist.jsonl"
+    hist.write_text(
+        json.dumps({"ts": "t1", "argv": ["cnn"],
+                    "result": {"value": 1.0}}) + "\n" +
+        json.dumps({"ts": "t2", "argv": ["cnn", "--bf16-moments"],
+                    "result": {"value": 2.0}}) + "\n")
+    monkeypatch.setattr(bench, "HISTORY_PATH", str(hist))
+    assert bench._latest_history(["cnn"])["ts"] == "t1"
+    assert bench._latest_history(["cnn", "--bf16-moments"])["ts"] == "t2"
+    assert bench._latest_history([])["ts"] == "t1"  # bare == flagship
+    err = bench._error_json(["cnn", "--bf16-moments"], "probe", "down")
+    assert err["argv"] == ["cnn", "--bf16-moments"]
+    assert err["last_recorded"]["result"]["value"] == 2.0
