@@ -218,3 +218,20 @@ def test_latest_history_distinguishes_cnn_variants(monkeypatch, tmp_path):
     err = bench._error_json(["cnn", "--bf16-moments"], "probe", "down")
     assert err["argv"] == ["cnn", "--bf16-moments"]
     assert err["last_recorded"]["result"]["value"] == 2.0
+
+
+def test_normalize_argv_order_insensitive():
+    a = bench._normalize_argv(["bert", "--seq", "2048", "--no-flash"])
+    b = bench._normalize_argv(["bert", "--no-flash", "--seq", "2048"])
+    assert a == b
+    assert bench._normalize_argv(["cnn", "--smoke"]) == ["cnn"]
+    assert bench._normalize_argv([]) == ["cnn"]
+    assert (bench._normalize_argv(["cnn", "--bf16-moments"])
+            != bench._normalize_argv(["cnn"]))
+
+
+def test_bf16_moments_rejected_off_flagship():
+    import pytest
+
+    with pytest.raises(SystemExit, match="cnn workload only"):
+        bench.run_bench(["resnet50", "--bf16-moments"])
