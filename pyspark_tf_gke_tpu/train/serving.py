@@ -130,3 +130,102 @@ def as_host_array(x):
     from jax.experimental import multihost_utils
 
     return multihost_utils.process_allgather(x, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# multi-host serving driver
+# ---------------------------------------------------------------------------
+#
+# SPMD serving means every process must run the SAME program for every
+# request — but only process 0 has the HTTP socket. The driver below is
+# the missing control plane: process 0 ANNOUNCES each request (a
+# fixed-shape header broadcast, then the prompt payload), the other
+# processes sit in `serve_worker_loop` replaying the same
+# `serve_generate` call, and the collective-backed decode + the
+# `as_host_array` gather line up across hosts. Greedy decode only (the
+# header carries no sampling state — temperature-bearing requests
+# belong on a single-host tp mesh or need a richer header).
+#
+# The reference has no analog (it serves a saved .keras file to a
+# human, test-model.py); the pattern here is the standard
+# "coordinator announces, workers replay" SPMD-serving shape.
+
+OP_SHUTDOWN = 0
+OP_GENERATE = 1
+_HEADER_LEN = 4  # [op, batch, prompt_len, max_new_tokens]
+
+
+def _bcast(x):
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(x)
+
+
+def announce_generate(prompt_ids, max_new_tokens: int) -> None:
+    """Process 0: publish a generate request to every worker process.
+    Two broadcasts: the fixed-shape header first (workers learn the
+    payload shape), then the prompt tokens."""
+    b, s = prompt_ids.shape
+    _bcast(np.array([OP_GENERATE, b, s, max_new_tokens], np.int32))
+    _bcast(np.asarray(prompt_ids, np.int32))
+
+
+def announce_shutdown() -> None:
+    """Process 0: release every worker from ``serve_worker_loop``."""
+    _bcast(np.array([OP_SHUTDOWN, 0, 0, 0], np.int32))
+
+
+import threading as _threading
+
+# One announce+decode at a time: HTTP handlers run concurrently, and
+# interleaved broadcast pairs would hand workers request A's header
+# with request B's payload (a desynced stream where a stray zero word
+# reads as OP_SHUTDOWN).
+_MH_LOCK = _threading.Lock()
+
+
+def mh_generate(model, params, prompt_ids, mesh: Mesh,
+                max_new_tokens: int = 64):
+    """Process 0's request path on a multi-process mesh: announce, then
+    run the same ``serve_generate`` the workers replay. On a
+    single-process mesh this degrades to plain ``serve_generate`` (no
+    broadcasts). Thread-safe: the announce+decode pair is serialized —
+    concurrent HTTP handlers cannot interleave broadcasts."""
+    # the SAME int32 array is announced and decoded — a dtype mismatch
+    # would compile a different program on process 0 than the workers'
+    # replay, desynchronizing the SPMD collectives
+    prompt = np.asarray(prompt_ids, np.int32)
+    with _MH_LOCK:
+        if jax.process_count() > 1:
+            announce_generate(prompt, max_new_tokens)
+        return serve_generate(model, params, jnp.asarray(prompt),
+                              mesh=mesh, max_new_tokens=max_new_tokens)
+
+
+def serve_worker_loop(model, params, mesh: Mesh) -> int:
+    """Processes 1..N-1: replay every announced request until shutdown.
+    Returns the number of requests served. ``params`` must already be
+    placed with ``shard_params_for_serving`` on the SAME mesh as
+    process 0's.
+
+    A request that raises (e.g. prompt+max_new over max_seq_len) is
+    logged and the loop continues: process 0 hits the same error on its
+    own copy, answers the client with it, and keeps serving — a worker
+    that exited instead would leave the next broadcast with no peer and
+    hang the whole job silently."""
+    import logging
+
+    logger = logging.getLogger("train.serving")
+    served = 0
+    while True:
+        header = np.asarray(_bcast(np.zeros(_HEADER_LEN, np.int32)))
+        op, b, s, max_new = (int(v) for v in header)
+        if op == OP_SHUTDOWN:
+            return served
+        prompt = np.asarray(_bcast(np.zeros((b, s), np.int32)))
+        try:
+            serve_generate(model, params, jnp.asarray(prompt), mesh=mesh,
+                           max_new_tokens=max_new)
+        except Exception:  # noqa: BLE001 — keep the control plane alive
+            logger.exception("replayed request failed (continuing)")
+        served += 1

@@ -185,7 +185,31 @@ def test_two_process_kill_and_resume(tmp_path):
     assert np.isfinite(float(final[0])) and final[0] == final[1]
 
 
-SERVE_RUNNER = r"""
+# ONE serving fixture (model config / seed / mesh shape / placement),
+# shared verbatim by both runner scripts and — via _tp_serve_fixture —
+# by both in-test reference paths: the token-identity asserts compare
+# the SAME model by construction.
+TP_SERVE_SETUP = r"""
+import jax.numpy as jnp
+from flax import linen as nn
+from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
+from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
+from pyspark_tf_gke_tpu.train.serving import (
+    announce_shutdown, mh_generate, serve_generate, serve_worker_loop,
+    shard_params_for_serving)
+from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+cfg = CausalLMConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_heads=4, num_kv_heads=2, intermediate_size=64,
+                     max_seq_len=32, dtype=jnp.float32)
+mesh = make_mesh({"dp": 4, "tp": 2}, jax.devices()[:8])
+model = CausalLM(cfg, mesh=mesh)
+params = jax.device_get(nn.meta.unbox(
+    jax.jit(model.init)(make_rng(7), jnp.zeros((1, 8), jnp.int32))["params"]))
+placed = shard_params_for_serving(model, params, mesh)
+"""
+
+_RUNNER_PREAMBLE = r"""
 import sys
 import numpy as np
 import jax
@@ -195,29 +219,24 @@ from pyspark_tf_gke_tpu.parallel.distributed import initialize_distributed
 num, pid, addr = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 initialize_distributed(num_processes=num, process_id=pid,
                        coordinator_addr=addr)
-import jax.numpy as jnp
-from flax import linen as nn
-from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
-from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
-from pyspark_tf_gke_tpu.train.serving import (
-    serve_generate, shard_params_for_serving)
-from pyspark_tf_gke_tpu.utils.seeding import make_rng
+"""
 
+SERVE_RUNNER = _RUNNER_PREAMBLE + TP_SERVE_SETUP + r"""
 assert len(jax.devices()) == 2 * jax.local_device_count()
-cfg = CausalLMConfig(vocab_size=64, hidden_size=32, num_layers=2,
-                     num_heads=4, num_kv_heads=2, intermediate_size=64,
-                     max_seq_len=32, dtype=jnp.float32)
-mesh = make_mesh({"dp": 4, "tp": 2}, jax.devices())
-model = CausalLM(cfg, mesh=mesh)
-params = jax.device_get(nn.meta.unbox(
-    jax.jit(model.init)(make_rng(7), jnp.zeros((1, 8), jnp.int32))["params"]))
-placed = shard_params_for_serving(model, params, mesh)
 prompt = jnp.asarray(np.tile(np.arange(4, 12, dtype=np.int32)[None], (2, 1)))
 out = serve_generate(model, placed, prompt, mesh=mesh, max_new_tokens=6)
 assert getattr(out, "is_fully_addressable", True), (
     "serve output must be host-readable")
 print("SERVE_TOKENS", pid, np.asarray(out)[:, 8:].tolist())
 """
+
+
+def _tp_serve_fixture():
+    """In-process twin of TP_SERVE_SETUP: exec the SAME source so the
+    single-process reference can never drift from the runners."""
+    ns = {"__builtins__": __builtins__}
+    exec("import jax\n" + TP_SERVE_SETUP, ns)
+    return ns["model"], ns["placed"], ns["mesh"]
 
 
 @pytest.mark.slow
@@ -228,28 +247,12 @@ def test_two_process_tp_serving_matches_single_process(tmp_path):
     produce the SAME tokens as the identical model served on the
     in-process 8-device mesh — param-placement and collective bugs on
     the serving path hide exactly here."""
-    import jax
     import jax.numpy as jnp
-    from flax import linen as nn
 
-    from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
-    from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
-    from pyspark_tf_gke_tpu.train.serving import (
-        serve_generate,
-        shard_params_for_serving,
-    )
-    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+    from pyspark_tf_gke_tpu.train.serving import serve_generate
 
     # Single-process reference on the same mesh shape / seed / prompt.
-    cfg = CausalLMConfig(vocab_size=64, hidden_size=32, num_layers=2,
-                         num_heads=4, num_kv_heads=2, intermediate_size=64,
-                         max_seq_len=32, dtype=jnp.float32)
-    mesh = make_mesh({"dp": 4, "tp": 2}, jax.devices()[:8])
-    model = CausalLM(cfg, mesh=mesh)
-    params = jax.device_get(nn.meta.unbox(
-        jax.jit(model.init)(make_rng(7),
-                            jnp.zeros((1, 8), jnp.int32))["params"]))
-    placed = shard_params_for_serving(model, params, mesh)
+    model, placed, mesh = _tp_serve_fixture()
     prompt = jnp.asarray(
         np.tile(np.arange(4, 12, dtype=np.int32)[None], (2, 1)))
     ref = np.asarray(serve_generate(model, placed, prompt, mesh=mesh,
@@ -266,6 +269,52 @@ def test_two_process_tp_serving_matches_single_process(tmp_path):
     # identical across hosts, and identical to the single-process mesh
     assert toks[0] == toks[1]
     assert toks[0] == str(ref)
+
+
+MH_SERVE_RUNNER = _RUNNER_PREAMBLE + TP_SERVE_SETUP + r"""
+if pid == 0:
+    # two requests with DIFFERENT shapes: the worker loop must learn
+    # each payload shape from the header broadcast
+    p1 = np.tile(np.arange(4, 12, dtype=np.int32)[None], (2, 1))
+    p2 = np.arange(10, 16, dtype=np.int32)[None]
+    o1 = np.asarray(mh_generate(model, placed, p1, mesh, max_new_tokens=5))
+    o2 = np.asarray(mh_generate(model, placed, p2, mesh, max_new_tokens=3))
+    announce_shutdown()
+    print("MH_TOKENS", o1[:, 8:].tolist(), o2[:, 6:].tolist())
+else:
+    served = serve_worker_loop(model, placed, mesh)
+    assert served == 2, f"worker replayed {served} != 2 requests"
+    print("MH_WORKER_OK", served)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_serving_driver_worker_loop(tmp_path):
+    """The multi-host serving CONTROL plane (train/serving.py): process
+    0 announces each request (header + payload broadcast), process 1
+    replays it in serve_worker_loop, and the collective-backed decode
+    stays in lockstep across request shapes — tokens must equal the
+    single-process reference."""
+    import jax
+    import jax.numpy as jnp
+    from pyspark_tf_gke_tpu.train.serving import serve_generate
+
+    model, placed, mesh = _tp_serve_fixture()
+    p1 = jnp.asarray(np.tile(np.arange(4, 12, dtype=np.int32)[None], (2, 1)))
+    p2 = jnp.asarray(np.arange(10, 16, dtype=np.int32)[None])
+    r1 = np.asarray(serve_generate(model, placed, p1, mesh=mesh,
+                                   max_new_tokens=5))[:, 8:].tolist()
+    r2 = np.asarray(serve_generate(model, placed, p2, mesh=mesh,
+                                   max_new_tokens=3))[:, 6:].tolist()
+
+    procs = _spawn_pair(lambda pid, port: [
+        "-c", MH_SERVE_RUNNER, "2", str(pid), f"127.0.0.1:{port}"])
+    outputs = _communicate_pair(procs)
+    for i, (p, text) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"mh worker {i} failed:\n{text[-3000:]}"
+    assert "MH_WORKER_OK 2" in outputs[1]
+    toks = outputs[0].split("MH_TOKENS ")[1].splitlines()[0]
+    assert toks == f"{r1} {r2}"
 
 
 @pytest.mark.slow
