@@ -175,6 +175,28 @@ def load_serving_bundle(bundle_dir: str) -> Tuple[CausalLM, Any, dict]:
     else:
         abstract_candidates = [abstract]
 
+    if jax.process_count() > 1:
+        # Multi-process restore: orbax refuses sharding-less abstract
+        # arrays here ("sharding ... should be specified [and] concrete").
+        # Every process restores the FULL array onto its own CPU backend
+        # device — host RAM, NOT an accelerator: a model that needs tp
+        # to fit would OOM a single chip's HBM before
+        # shard_params_for_serving ever placed its shards.
+        try:
+            host_dev = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:  # pragma: no cover - cpu backend always exists
+            host_dev = jax.local_devices()[0]
+        local = jax.sharding.SingleDeviceSharding(host_dev)
+
+        def pin(leaf):
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                            sharding=local)
+            return leaf
+
+        abstract_candidates = [jax.tree.map(pin, c)
+                               for c in abstract_candidates]
+
     ckptr = ocp.StandardCheckpointer()
     try:
         params_path = os.path.join(os.path.abspath(bundle_dir), "params")
@@ -194,4 +216,9 @@ def load_serving_bundle(bundle_dir: str) -> Tuple[CausalLM, Any, dict]:
                     raise first_exc
     finally:
         ckptr.close()
+    if jax.process_count() > 1:
+        # hand callers host numpy: device_put from a committed
+        # single-device array to a global multi-process sharding is the
+        # one transfer shape jax does not support
+        params = jax.device_get(params)
     return model, params, meta

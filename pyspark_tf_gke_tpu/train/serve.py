@@ -124,6 +124,14 @@ class BundleServer:
                     self.draft_model, self.draft_params, mesh)
         self.params = params
         self.bundle_dir = bundle_dir
+        self.multi_host = jax.process_count() > 1
+        if self.multi_host and mesh is None:
+            raise ValueError("multi-host serving needs a mesh spanning "
+                             "all processes (set --tp / SERVE_TP)")
+        if self.multi_host and self.draft_model is not None:
+            raise ValueError("speculative decoding is not supported on "
+                             "multi-host serving (the announce/replay "
+                             "header carries greedy decode only)")
         self._lock = threading.Lock()  # one model, one device queue
         self._nll_fn = None
 
@@ -139,6 +147,7 @@ class BundleServer:
             "max_seq_len": self.model.cfg.max_seq_len,
             "tokenizer": self.meta.get("tokenizer", "byte"),
             "n_devices": len(jax.devices()),
+            "processes": jax.process_count(),
             "tp": dict(self.mesh.shape).get("tp", 1) if self.mesh else 1,
             "speculative_draft": self.draft_bundle_dir or None,
         }
@@ -163,6 +172,14 @@ class BundleServer:
         if len(prompts) > MAX_BATCH:
             raise ValueError(f"batch of {len(prompts)} exceeds "
                              f"max batch {MAX_BATCH}")
+        if self.multi_host and (num_beams or (temperature and temperature > 0)
+                                or top_k is not None or top_p is not None
+                                or repetition_penalty is not None):
+            # the announce/replay header (train/serving.py) carries only
+            # what greedy decode needs; anything else would run a
+            # different program on process 0 than on the workers
+            raise ValueError("multi-host serving supports greedy decode "
+                             "only (no sampling, beams, or penalties)")
         rng = (jax.random.PRNGKey(
             int.from_bytes(os.urandom(4), "little"))
             if temperature and temperature > 0 else None)
@@ -234,6 +251,14 @@ class BundleServer:
                             max_new_tokens=max_new_tokens,
                             num_beams=num_beams, eos_token_id=eos_id)
                     scores = np.asarray(as_host_array(scores))
+                elif self.multi_host:
+                    from pyspark_tf_gke_tpu.train.serving import mh_generate
+
+                    out = mh_generate(self.model, self.params, batch,
+                                      self.mesh,
+                                      max_new_tokens=max_new_tokens,
+                                      eos_token_id=eos_id)
+                    scores = None
                 else:
                     gen_fn = generate if self.mesh is None else serve_generate
                     kwargs = {} if self.mesh is None else {"mesh": self.mesh}
@@ -304,6 +329,12 @@ class BundleServer:
         if len(texts) > MAX_BATCH:
             raise ValueError(f"batch of {len(texts)} exceeds "
                              f"max batch {MAX_BATCH}")
+        if self.multi_host:
+            # scoring runs its own jitted collective program; the
+            # announce/replay protocol does not carry it (yet)
+            raise ValueError("score is not supported on multi-host "
+                             "serving; run lm_eval against a single-host "
+                             "tp endpoint instead")
         cap = self.model.cfg.max_seq_len
         results = [None] * len(texts)
         rows = []  # (result index, ids, truncated)
@@ -456,6 +487,16 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default=int(e("MAX_NEW_TOKENS", "64")))
     p.add_argument("--temperature", type=float,
                    default=float(e("TEMPERATURE", "0.0")))
+    # multi-host: same bootstrap flags as the trainers. Process 0 runs
+    # the HTTP server; the rest replay announced requests
+    # (train/serving.py serve_worker_loop). Greedy decode only.
+    p.add_argument("--num-processes", type=int,
+                   default=int(e("NUM_PROCESSES", "1")))
+    p.add_argument("--process-id", type=int,
+                   default=int(e("PROCESS_ID", "-1")))
+    p.add_argument("--coordinator-addr", default=e("COORDINATOR_ADDR", ""))
+    p.add_argument("--coordinator-port", type=int,
+                   default=int(e("COORDINATOR_PORT", "8476")))
     return p.parse_args(argv)
 
 
@@ -477,8 +518,24 @@ def _resolve_bundle(path: str) -> str:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.num_processes > 1:
+        from pyspark_tf_gke_tpu.parallel.distributed import (
+            initialize_distributed,
+        )
+
+        initialize_distributed(
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+            coordinator_addr=args.coordinator_addr,
+            coordinator_port=args.coordinator_port)
     mesh = None
-    if args.tp and args.tp > 1:
+    if jax.process_count() > 1:
+        # one mesh over ALL global devices: tp as asked, dp on the rest
+        # (the -1 wildcard gives a clear divisibility error for bad --tp)
+        from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"dp": -1, "tp": max(args.tp, 1)}, jax.devices())
+    elif args.tp and args.tp > 1:
         from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh({"tp": args.tp}, jax.devices()[:args.tp])
@@ -488,31 +545,52 @@ def main(argv=None) -> int:
                           if args.draft_bundle else ""))
     logger.info("bundle loaded: %s", server.health())
 
-    if args.stdin:
-        for line in sys.stdin:
-            prompt = line.rstrip("\n")
-            if not prompt:
-                continue
-            try:
-                out = server.generate([prompt],
-                                      max_new_tokens=args.max_new_tokens,
-                                      temperature=args.temperature)[0]
-            except ValueError as exc:
-                # a bad line (over-long, zero tokens) must not take the
-                # loaded model down with it — mirror the HTTP 400 path
-                out = {"prompt": prompt, "error": str(exc)}
-            print(json.dumps(out), flush=True)
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        # workers: no HTTP socket — replay every announced request until
+        # process 0 shuts the job down
+        from pyspark_tf_gke_tpu.train.serving import serve_worker_loop
+
+        served = serve_worker_loop(server.model, server.params, server.mesh)
+        logger.info("worker loop done after %d requests", served)
         return 0
 
-    httpd = start_http_server(server, args.host, args.port)
-    logger.info("serving on http://%s:%d (healthz, /v1/generate, /v1/score)",
-                *httpd.server_address[:2])
     try:
-        httpd.serve_forever()
-    except KeyboardInterrupt:
-        logger.info("shutting down")
-        httpd.shutdown()
-    return 0
+        # ONE finally covers everything process 0 does from here: a
+        # failure anywhere (port already bound, broken stdin pipe, ...)
+        # must still release the worker loops, or a local error becomes
+        # a pod-wide jax.distributed fatal cascade.
+        if args.stdin:
+            for line in sys.stdin:
+                prompt = line.rstrip("\n")
+                if not prompt:
+                    continue
+                try:
+                    out = server.generate(
+                        [prompt], max_new_tokens=args.max_new_tokens,
+                        temperature=args.temperature)[0]
+                except ValueError as exc:
+                    # a bad line (over-long, zero tokens) must not take
+                    # the loaded model down with it — mirror the HTTP
+                    # 400 path
+                    out = {"prompt": prompt, "error": str(exc)}
+                print(json.dumps(out), flush=True)
+            return 0
+
+        httpd = start_http_server(server, args.host, args.port)
+        logger.info(
+            "serving on http://%s:%d (healthz, /v1/generate, /v1/score)",
+            *httpd.server_address[:2])
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            logger.info("shutting down")
+            httpd.shutdown()
+        return 0
+    finally:
+        if jax.process_count() > 1:
+            from pyspark_tf_gke_tpu.train.serving import announce_shutdown
+
+            announce_shutdown()  # release the worker loops
 
 
 if __name__ == "__main__":

@@ -152,7 +152,7 @@ def as_host_array(x):
 
 OP_SHUTDOWN = 0
 OP_GENERATE = 1
-_HEADER_LEN = 4  # [op, batch, prompt_len, max_new_tokens]
+_HEADER_LEN = 5  # [op, batch, prompt_len, max_new_tokens, eos (-1=none)]
 
 
 def _bcast(x):
@@ -161,18 +161,26 @@ def _bcast(x):
     return multihost_utils.broadcast_one_to_all(x)
 
 
-def announce_generate(prompt_ids, max_new_tokens: int) -> None:
+def announce_generate(prompt_ids, max_new_tokens: int,
+                      eos_token_id=None) -> None:
     """Process 0: publish a generate request to every worker process.
     Two broadcasts: the fixed-shape header first (workers learn the
-    payload shape), then the prompt tokens."""
+    payload shape), then the prompt tokens. The header carries every
+    argument that shapes the compiled program (eos included) — a worker
+    replaying a DIFFERENT program than process 0 desyncs the SPMD
+    collectives."""
     b, s = prompt_ids.shape
-    _bcast(np.array([OP_GENERATE, b, s, max_new_tokens], np.int32))
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+    _bcast(np.array([OP_GENERATE, b, s, max_new_tokens, eos], np.int32))
     _bcast(np.asarray(prompt_ids, np.int32))
 
 
 def announce_shutdown() -> None:
-    """Process 0: release every worker from ``serve_worker_loop``."""
-    _bcast(np.array([OP_SHUTDOWN, 0, 0, 0], np.int32))
+    """Process 0: release every worker from ``serve_worker_loop``.
+    Takes the announce lock: a shutdown racing an in-flight handler's
+    announce+decode would interleave into the workers' ordered stream."""
+    with _MH_LOCK:
+        _bcast(np.array([OP_SHUTDOWN, 0, 0, 0, 0], np.int32))
 
 
 import threading as _threading
@@ -185,7 +193,7 @@ _MH_LOCK = _threading.Lock()
 
 
 def mh_generate(model, params, prompt_ids, mesh: Mesh,
-                max_new_tokens: int = 64):
+                max_new_tokens: int = 64, eos_token_id=None):
     """Process 0's request path on a multi-process mesh: announce, then
     run the same ``serve_generate`` the workers replay. On a
     single-process mesh this degrades to plain ``serve_generate`` (no
@@ -197,9 +205,10 @@ def mh_generate(model, params, prompt_ids, mesh: Mesh,
     prompt = np.asarray(prompt_ids, np.int32)
     with _MH_LOCK:
         if jax.process_count() > 1:
-            announce_generate(prompt, max_new_tokens)
+            announce_generate(prompt, max_new_tokens, eos_token_id)
         return serve_generate(model, params, jnp.asarray(prompt),
-                              mesh=mesh, max_new_tokens=max_new_tokens)
+                              mesh=mesh, max_new_tokens=max_new_tokens,
+                              eos_token_id=eos_token_id)
 
 
 def serve_worker_loop(model, params, mesh: Mesh) -> int:
@@ -219,13 +228,14 @@ def serve_worker_loop(model, params, mesh: Mesh) -> int:
     served = 0
     while True:
         header = np.asarray(_bcast(np.zeros(_HEADER_LEN, np.int32)))
-        op, b, s, max_new = (int(v) for v in header)
+        op, b, s, max_new, eos = (int(v) for v in header)
         if op == OP_SHUTDOWN:
             return served
         prompt = np.asarray(_bcast(np.zeros((b, s), np.int32)))
         try:
             serve_generate(model, params, jnp.asarray(prompt), mesh=mesh,
-                           max_new_tokens=max_new)
+                           max_new_tokens=max_new,
+                           eos_token_id=None if eos < 0 else eos)
         except Exception:  # noqa: BLE001 — keep the control plane alive
             logger.exception("replayed request failed (continuing)")
         served += 1

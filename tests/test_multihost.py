@@ -317,6 +317,116 @@ def test_two_process_serving_driver_worker_loop(tmp_path):
     assert toks == f"{r1} {r2}"
 
 
+SERVE_MAIN_RUNNER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from pyspark_tf_gke_tpu.train import serve
+
+sys.exit(serve.main(sys.argv[1:]))
+"""
+
+
+@pytest.mark.slow
+def test_two_process_serve_cli_http_end_to_end(tmp_path):
+    """The DEPLOYMENT surface on a multi-host mesh: two processes run
+    the real `train.serve` CLI (process 0 = HTTP server, process 1 =
+    worker loop), the parent speaks HTTP to process 0, and greedy
+    completions match a single-process BundleServer on the same mesh
+    shape; sampling requests are rejected with 400."""
+    import json as _json
+    import time
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
+    from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
+    from pyspark_tf_gke_tpu.train.export import export_serving_bundle
+    from pyspark_tf_gke_tpu.train.serve import BundleServer
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    # vocab 259 covers the byte tokenizer the bundle records by default
+    cfg = CausalLMConfig(vocab_size=259, hidden_size=32, num_layers=2,
+                         num_heads=4, num_kv_heads=2, intermediate_size=64,
+                         max_seq_len=64, dtype=jnp.float32)
+    model = CausalLM(cfg)
+    params = nn.meta.unbox(jax.jit(model.init)(
+        make_rng(11), jnp.zeros((1, 8), jnp.int32))["params"])
+    bundle = str(tmp_path / "bundle")
+    export_serving_bundle(cfg, params, bundle, quantize=False)
+
+    # single-process reference on the same dp x tp mesh shape
+    ref_server = BundleServer(
+        bundle, mesh=make_mesh({"dp": 4, "tp": 2}, jax.devices()[:8]))
+    ref = ref_server.generate(["ab"], max_new_tokens=6)[0]["completion"]
+
+    http_port = _free_port()
+    procs = _spawn_pair(lambda pid, port: [
+        "-c", SERVE_MAIN_RUNNER,
+        "--bundle", bundle, "--host", "127.0.0.1",
+        "--port", str(http_port), "--tp", "2",
+        "--num-processes", "2", "--process-id", str(pid),
+        "--coordinator-addr", f"127.0.0.1:{port}",
+    ])
+    try:
+        base = f"http://127.0.0.1:{http_port}"
+        deadline = time.time() + 240
+        health = None
+        while time.time() < deadline:
+            if any(p.poll() is not None for p in procs):
+                break  # a worker died — fall through to the asserts
+            try:
+                with urllib.request.urlopen(base + "/healthz",
+                                            timeout=5) as r:
+                    health = _json.loads(r.read())
+                break
+            except (urllib.error.URLError, ConnectionError, OSError):
+                time.sleep(1.0)
+        assert health is not None, "server never became healthy"
+        assert health["processes"] == 2 and health["tp"] == 2
+
+        def post(payload, path="/v1/generate"):
+            req = urllib.request.Request(
+                base + path, data=_json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return _json.loads(r.read())
+
+        out = post({"prompts": ["ab"], "max_new_tokens": 6})
+        assert out["completions"][0]["completion"] == ref
+
+        # sampling is rejected on multi-host (greedy-only wire header)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post({"prompts": ["ab"], "max_new_tokens": 4,
+                  "temperature": 1.0})
+        assert e.value.code == 400
+        assert "greedy" in _json.loads(e.value.read())["error"]
+
+        # graceful shutdown: SIGINT on process 0 -> KeyboardInterrupt ->
+        # announce_shutdown releases the worker loop -> both exit 0.
+        # (A SIGKILL teardown instead makes the worker die rc=1 in the
+        # jax.distributed fatal-error handler — the coordinator's death
+        # cascade, not a crash, but indistinguishable from one.)
+        import signal
+
+        procs[0].send_signal(signal.SIGINT)
+        outputs = _communicate_pair(procs, timeout_s=120)
+        for i, (p, text) in enumerate(zip(procs, outputs)):
+            assert p.returncode == 0, (
+                f"serve process {i} did not shut down cleanly:"
+                f"\n{text[-3000:]}")
+        assert "worker loop done after 1 requests" in outputs[1]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+
 @pytest.mark.slow
 def test_two_process_sigstop_stall_detection_and_restart(tmp_path):
     """The REAL TPU-pod failure shape: a worker that is alive but hung
