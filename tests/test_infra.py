@@ -99,3 +99,43 @@ def test_tpu_serve_manifest_conventions():
         assert c[probe]["httpGet"]["path"] == "/healthz"
         assert c[probe]["httpGet"]["port"] == port
     assert c["resources"]["requests"]["google.com/tpu"] == "4"
+
+
+def test_tpu_serve_multihost_manifest_conventions():
+    """The multi-host serving StatefulSet must agree with the CLI's
+    addressing contract: hostname-ordinal process ids, pod-0 headless
+    DNS as coordinator (the trainer convention), HTTP Service pinned to
+    pod 0 via the per-pod-name selector, and parallel pod start (the
+    jax.distributed barrier needs every process up)."""
+    docs = _load("infra/k8s/tpu/tpu-serve-multihost.yaml")
+    headless = next(d for d in docs if d["kind"] == "Service"
+                    and d["spec"].get("clusterIP") == "None")
+    http = next(d for d in docs if d["kind"] == "Service"
+                and d["spec"].get("clusterIP") != "None")
+    sts = next(d for d in docs if d["kind"] == "StatefulSet")
+
+    assert sts["spec"]["serviceName"] == headless["metadata"]["name"]
+    assert sts["spec"]["podManagementPolicy"] == "Parallel"
+    # HTTP routes to pod 0 only
+    sel = http["spec"]["selector"]
+    assert sel["statefulset.kubernetes.io/pod-name"] == (
+        sts["metadata"]["name"] + "-0")
+    env = {e["name"]: e.get("value") for e in
+           sts["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["COORDINATOR_ADDR"] == (
+        f"{sts['metadata']['name']}-0.{headless['metadata']['name']}")
+    assert int(env["NUM_PROCESSES"]) == sts["spec"]["replicas"]
+    assert "PROCESS_ID" not in env  # derived from the hostname ordinal
+    # coordinator port consistent between env and the headless Service
+    assert int(env["COORDINATOR_PORT"]) == (
+        headless["spec"]["ports"][0]["port"])
+    # DNS-before-readiness: without this the set deadlocks on bootstrap
+    assert headless["spec"]["publishNotReadyAddresses"] is True
+    # probes: ONE anchored stdlib-python exec block (no wget/pgrep in
+    # the slim image), identical across startup/readiness/liveness
+    c = sts["spec"]["template"]["spec"]["containers"][0]
+    execs = [c[k]["exec"] for k in
+             ("startupProbe", "readinessProbe", "livenessProbe")]
+    assert execs[0] == execs[1] == execs[2]
+    assert execs[0]["command"][0] == "python"
+    assert "urllib.request" in execs[0]["command"][2]
