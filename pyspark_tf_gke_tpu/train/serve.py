@@ -133,7 +133,6 @@ class BundleServer:
                              "multi-host serving (the announce/replay "
                              "header carries greedy decode only)")
         self._lock = threading.Lock()  # one model, one device queue
-        self._nll_fn = None
 
     # -- health ----------------------------------------------------------
 
@@ -293,31 +292,6 @@ class BundleServer:
 
     # -- scoring ---------------------------------------------------------
 
-    def _score_fn(self):
-        # one jitted closure; jax.jit retraces per padded (batch, seq)
-        # bucket shape on its own
-        if self._nll_fn is None:
-            import optax
-
-            from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
-
-            model = self.model
-
-            @jax.jit
-            def nll(params, ids, lengths):
-                logits = model.apply({"params": dequantize_tree(params)},
-                                     ids, train=False)
-                lg = logits[:, :-1].astype(jnp.float32)
-                per_tok = optax.softmax_cross_entropy_with_integer_labels(
-                    lg, ids[:, 1:])
-                # position j scores token j+1; valid while j+1 < length
-                mask = (jnp.arange(ids.shape[1] - 1)[None, :]
-                        < (lengths - 1)[:, None])
-                return (per_tok * mask).sum(axis=1)
-
-            self._nll_fn = nll
-        return self._nll_fn
-
     def score(self, texts) -> list:
         """Per-text total NLL in nats + scored token count. Texts longer
         than max_seq_len are truncated (reported via ``truncated``);
@@ -329,12 +303,6 @@ class BundleServer:
         if len(texts) > MAX_BATCH:
             raise ValueError(f"batch of {len(texts)} exceeds "
                              f"max batch {MAX_BATCH}")
-        if self.multi_host:
-            # scoring runs its own jitted collective program; the
-            # announce/replay protocol does not carry it (yet)
-            raise ValueError("score is not supported on multi-host "
-                             "serving; run lm_eval against a single-host "
-                             "tp endpoint instead")
         cap = self.model.cfg.max_seq_len
         results = [None] * len(texts)
         rows = []  # (result index, ids, truncated)
@@ -356,12 +324,14 @@ class BundleServer:
             for r, (_, ids, _) in enumerate(rows):
                 padded[r, :len(ids)] = ids
             lengths = lengths + [0] * (n_bucket - n_real)
+            from pyspark_tf_gke_tpu.train.serving import mh_score
+
             with self._lock:
-                fn = self._score_fn()
-                with self.mesh or contextlib.nullcontext():
-                    nlls = np.asarray(as_host_array(
-                        fn(self.params, jnp.asarray(padded),
-                           jnp.asarray(lengths, jnp.int32))))
+                # mh_score owns the single-vs-multi-host dispatch: it
+                # announces for workers to replay when processes > 1 and
+                # degrades to plain serve_score otherwise
+                nlls = np.asarray(mh_score(
+                    self.model, self.params, padded, lengths, self.mesh))
             for r, (i, ids, trunc) in enumerate(rows):
                 results[i] = {"nll": float(nlls[r]), "tokens": len(ids) - 1,
                               "truncated": trunc}

@@ -118,6 +118,41 @@ def serve_generate(model, params, prompt_ids, mesh: Optional[Mesh] = None,
     return as_host_array(out)
 
 
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnames=("model",))
+def _nll_kernel(model, params, ids, lengths):
+    """Masked per-row total next-token NLL — the /v1/score kernel. Lives
+    HERE (not in the HTTP server) so process 0 and the multi-host worker
+    loop jit the identical program; jax.jit retraces per padded
+    (batch, seq) bucket shape on its own."""
+    import optax
+
+    from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
+
+    logits = model.apply({"params": dequantize_tree(params)}, ids,
+                         train=False)
+    lg = logits[:, :-1].astype(jnp.float32)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        lg, ids[:, 1:])
+    # position j scores token j+1; valid while j+1 < length
+    mask = (jnp.arange(ids.shape[1] - 1)[None, :] < (lengths - 1)[:, None])
+    return (per_tok * mask).sum(axis=1)
+
+
+def serve_score(model, params, ids, lengths,
+                mesh: Optional[Mesh] = None):
+    """Per-row NLL under a mesh context, host-readable on every process
+    (the serving twin of ``serve_generate``)."""
+    import contextlib
+
+    with mesh or contextlib.nullcontext():
+        out = _nll_kernel(model, params, jnp.asarray(ids),
+                          jnp.asarray(lengths, jnp.int32))
+    return as_host_array(out)
+
+
 def as_host_array(x):
     """Make a device array host-readable on EVERY process: on a
     multi-process mesh outputs can come back sharded across hosts (not
@@ -152,7 +187,9 @@ def as_host_array(x):
 
 OP_SHUTDOWN = 0
 OP_GENERATE = 1
+OP_SCORE = 2
 _HEADER_LEN = 5  # [op, batch, prompt_len, max_new_tokens, eos (-1=none)]
+#                  (OP_SCORE reuses batch/prompt_len; the other two are 0)
 
 
 def _bcast(x):
@@ -190,6 +227,21 @@ import threading as _threading
 # with request B's payload (a desynced stream where a stray zero word
 # reads as OP_SHUTDOWN).
 _MH_LOCK = _threading.Lock()
+
+
+def mh_score(model, params, ids, lengths, mesh: Mesh):
+    """Process 0's scoring path on a multi-process mesh: announce
+    (header + token payload + lengths payload), then run the same
+    ``serve_score`` the workers replay."""
+    ids = np.asarray(ids, np.int32)
+    lengths = np.asarray(lengths, np.int32)
+    b, s = ids.shape
+    with _MH_LOCK:
+        if jax.process_count() > 1:
+            _bcast(np.array([OP_SCORE, b, s, 0, 0], np.int32))
+            _bcast(ids)
+            _bcast(lengths)
+        return serve_score(model, params, ids, lengths, mesh=mesh)
 
 
 def mh_generate(model, params, prompt_ids, mesh: Mesh,
@@ -232,10 +284,15 @@ def serve_worker_loop(model, params, mesh: Mesh) -> int:
         if op == OP_SHUTDOWN:
             return served
         prompt = np.asarray(_bcast(np.zeros((b, s), np.int32)))
+        lengths = (np.asarray(_bcast(np.zeros(b, np.int32)))
+                   if op == OP_SCORE else None)
         try:
-            serve_generate(model, params, jnp.asarray(prompt), mesh=mesh,
-                           max_new_tokens=max_new,
-                           eos_token_id=None if eos < 0 else eos)
+            if op == OP_SCORE:
+                serve_score(model, params, prompt, lengths, mesh=mesh)
+            else:
+                serve_generate(model, params, jnp.asarray(prompt),
+                               mesh=mesh, max_new_tokens=max_new,
+                               eos_token_id=None if eos < 0 else eos)
         except Exception:  # noqa: BLE001 — keep the control plane alive
             logger.exception("replayed request failed (continuing)")
         served += 1

@@ -272,18 +272,24 @@ def test_two_process_tp_serving_matches_single_process(tmp_path):
 
 
 MH_SERVE_RUNNER = _RUNNER_PREAMBLE + TP_SERVE_SETUP + r"""
+from pyspark_tf_gke_tpu.train.serving import mh_score
+
 if pid == 0:
-    # two requests with DIFFERENT shapes: the worker loop must learn
-    # each payload shape from the header broadcast
+    # three requests with DIFFERENT shapes and ops: the worker loop must
+    # learn each payload shape from the header broadcast, and replay
+    # score as well as generate
     p1 = np.tile(np.arange(4, 12, dtype=np.int32)[None], (2, 1))
     p2 = np.arange(10, 16, dtype=np.int32)[None]
     o1 = np.asarray(mh_generate(model, placed, p1, mesh, max_new_tokens=5))
     o2 = np.asarray(mh_generate(model, placed, p2, mesh, max_new_tokens=3))
+    nll = np.asarray(mh_score(model, placed, p1,
+                              np.array([8, 5], np.int32), mesh))
     announce_shutdown()
-    print("MH_TOKENS", o1[:, 8:].tolist(), o2[:, 6:].tolist())
+    print("MH_TOKENS", o1[:, 8:].tolist(), o2[:, 6:].tolist(),
+          [round(float(v), 4) for v in nll])
 else:
     served = serve_worker_loop(model, placed, mesh)
-    assert served == 2, f"worker replayed {served} != 2 requests"
+    assert served == 3, f"worker replayed {served} != 3 requests"
     print("MH_WORKER_OK", served)
 """
 
@@ -297,7 +303,7 @@ def test_two_process_serving_driver_worker_loop(tmp_path):
     single-process reference."""
     import jax
     import jax.numpy as jnp
-    from pyspark_tf_gke_tpu.train.serving import serve_generate
+    from pyspark_tf_gke_tpu.train.serving import serve_generate, serve_score
 
     model, placed, mesh = _tp_serve_fixture()
     p1 = jnp.asarray(np.tile(np.arange(4, 12, dtype=np.int32)[None], (2, 1)))
@@ -306,15 +312,18 @@ def test_two_process_serving_driver_worker_loop(tmp_path):
                                    max_new_tokens=5))[:, 8:].tolist()
     r2 = np.asarray(serve_generate(model, placed, p2, mesh=mesh,
                                    max_new_tokens=3))[:, 6:].tolist()
+    rn = [round(float(v), 4) for v in np.asarray(serve_score(
+        model, placed, np.asarray(p1), np.array([8, 5], np.int32),
+        mesh=mesh))]
 
     procs = _spawn_pair(lambda pid, port: [
         "-c", MH_SERVE_RUNNER, "2", str(pid), f"127.0.0.1:{port}"])
     outputs = _communicate_pair(procs)
     for i, (p, text) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"mh worker {i} failed:\n{text[-3000:]}"
-    assert "MH_WORKER_OK 2" in outputs[1]
+    assert "MH_WORKER_OK 3" in outputs[1]
     toks = outputs[0].split("MH_TOKENS ")[1].splitlines()[0]
-    assert toks == f"{r1} {r2}"
+    assert toks == f"{r1} {r2} {rn}"
 
 
 SERVE_MAIN_RUNNER = r"""
@@ -399,6 +408,12 @@ def test_two_process_serve_cli_http_end_to_end(tmp_path):
         out = post({"prompts": ["ab"], "max_new_tokens": 6})
         assert out["completions"][0]["completion"] == ref
 
+        # scoring rides the wire protocol too (OP_SCORE replay)
+        sc = post({"texts": ["hello world"]}, path="/v1/score")
+        ref_sc = ref_server.score(["hello world"])
+        assert sc["scores"][0]["tokens"] == ref_sc[0]["tokens"]
+        assert abs(sc["scores"][0]["nll"] - ref_sc[0]["nll"]) < 1e-3
+
         # sampling is rejected on multi-host (greedy-only wire header)
         with pytest.raises(urllib.error.HTTPError) as e:
             post({"prompts": ["ab"], "max_new_tokens": 4,
@@ -419,7 +434,7 @@ def test_two_process_serve_cli_http_end_to_end(tmp_path):
             assert p.returncode == 0, (
                 f"serve process {i} did not shut down cleanly:"
                 f"\n{text[-3000:]}")
-        assert "worker loop done after 1 requests" in outputs[1]
+        assert "worker loop done after 2 requests" in outputs[1]
     finally:
         for p in procs:
             if p.poll() is None:
