@@ -227,3 +227,23 @@ def test_batch_iterator_fast_forward_rejects_negative():
     it = BatchIterator({"x": np.arange(10)}, batch_size=5)
     with _pytest.raises(ValueError):
         it.fast_forward(-1)
+
+
+def test_parallel_decode_bit_identical_to_serial(tmp_path):
+    """make_image_arrays decodes on a thread pool (the tf.data
+    num_parallel_calls analog); ex.map preserves order, so the
+    materialized array must be BIT-identical to a serial loop — the
+    seeded split/shuffle semantics depend on it."""
+    import numpy as np
+
+    from pyspark_tf_gke_tpu.data.images import load_image
+    from pyspark_tf_gke_tpu.data.synthetic import (
+        make_synthetic_image_dataset,
+    )
+
+    d = str(tmp_path / "imgs")
+    make_synthetic_image_dataset(d, num_images=12, height=24, width=30)
+    fp, _ = list_labeled_images(d)
+    serial = np.stack([load_image(p, 16, 20) for p in fp])
+    parallel, _ = make_image_arrays(d, (16, 20))
+    np.testing.assert_array_equal(serial, parallel)
