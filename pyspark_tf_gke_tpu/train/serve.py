@@ -171,14 +171,16 @@ class BundleServer:
         if len(prompts) > MAX_BATCH:
             raise ValueError(f"batch of {len(prompts)} exceeds "
                              f"max batch {MAX_BATCH}")
-        if self.multi_host and (num_beams or (temperature and temperature > 0)
+        if self.multi_host and ((temperature and temperature > 0)
                                 or top_k is not None or top_p is not None
                                 or repetition_penalty is not None):
             # the announce/replay header (train/serving.py) carries only
-            # what greedy decode needs; anything else would run a
-            # different program on process 0 than on the workers
-            raise ValueError("multi-host serving supports greedy decode "
-                             "only (no sampling, beams, or penalties)")
+            # DETERMINISTIC request parameters (greedy + beam width);
+            # sampling state would run a different program on process 0
+            # than on the workers
+            raise ValueError("multi-host serving supports deterministic "
+                             "decode only (greedy or beams - no "
+                             "sampling or penalties)")
         rng = (jax.random.PRNGKey(
             int.from_bytes(os.urandom(4), "little"))
             if temperature and temperature > 0 else None)
@@ -242,14 +244,15 @@ class BundleServer:
                 batch = jnp.asarray(rows, jnp.int32)
                 t0 = time.perf_counter()
                 if num_beams and num_beams > 1:
-                    from pyspark_tf_gke_tpu.models import beam_search
+                    from pyspark_tf_gke_tpu.train.serving import mh_generate
 
-                    with self.mesh or contextlib.nullcontext():
-                        out, scores = beam_search(
-                            self.model, self.params, batch,
-                            max_new_tokens=max_new_tokens,
-                            num_beams=num_beams, eos_token_id=eos_id)
-                    scores = np.asarray(as_host_array(scores))
+                    # mh_generate owns single-vs-multi-host dispatch and
+                    # the shared serve_beam gather sequence
+                    out, scores = mh_generate(
+                        self.model, self.params, batch, self.mesh,
+                        max_new_tokens=max_new_tokens, eos_token_id=eos_id,
+                        num_beams=num_beams)
+                    scores = np.asarray(scores)
                 elif self.multi_host:
                     from pyspark_tf_gke_tpu.train.serving import mh_generate
 

@@ -176,10 +176,11 @@ def as_host_array(x):
 # the missing control plane: process 0 ANNOUNCES each request (a
 # fixed-shape header broadcast, then the prompt payload), the other
 # processes sit in `serve_worker_loop` replaying the same
-# `serve_generate` call, and the collective-backed decode + the
-# `as_host_array` gather line up across hosts. Greedy decode only (the
-# header carries no sampling state — temperature-bearing requests
-# belong on a single-host tp mesh or need a richer header).
+# serve_generate/serve_beam/serve_score call, and the collective-backed
+# compute + the `as_host_array` gathers line up across hosts.
+# DETERMINISTIC requests only: the header carries everything that
+# shapes the compiled program (greedy decode, beam width, scoring) but
+# no per-request rng — sampling belongs on a single-host tp mesh.
 #
 # The reference has no analog (it serves a saved .keras file to a
 # human, test-model.py); the pattern here is the standard
@@ -188,8 +189,10 @@ def as_host_array(x):
 OP_SHUTDOWN = 0
 OP_GENERATE = 1
 OP_SCORE = 2
-_HEADER_LEN = 5  # [op, batch, prompt_len, max_new_tokens, eos (-1=none)]
-#                  (OP_SCORE reuses batch/prompt_len; the other two are 0)
+# [op, batch, prompt_len, max_new_tokens, eos (-1=none), num_beams]
+# (num_beams>1 -> the deterministic beam path; OP_SCORE reuses
+#  batch/prompt_len and zeros the rest)
+_HEADER_LEN = 6
 
 
 def _bcast(x):
@@ -199,16 +202,17 @@ def _bcast(x):
 
 
 def announce_generate(prompt_ids, max_new_tokens: int,
-                      eos_token_id=None) -> None:
+                      eos_token_id=None, num_beams: int = 0) -> None:
     """Process 0: publish a generate request to every worker process.
     Two broadcasts: the fixed-shape header first (workers learn the
     payload shape), then the prompt tokens. The header carries every
-    argument that shapes the compiled program (eos included) — a worker
-    replaying a DIFFERENT program than process 0 desyncs the SPMD
-    collectives."""
+    argument that shapes the compiled program (eos and beam width
+    included) — a worker replaying a DIFFERENT program than process 0
+    desyncs the SPMD collectives."""
     b, s = prompt_ids.shape
     eos = -1 if eos_token_id is None else int(eos_token_id)
-    _bcast(np.array([OP_GENERATE, b, s, max_new_tokens, eos], np.int32))
+    _bcast(np.array([OP_GENERATE, b, s, max_new_tokens, eos,
+                     num_beams], np.int32))
     _bcast(np.asarray(prompt_ids, np.int32))
 
 
@@ -217,7 +221,7 @@ def announce_shutdown() -> None:
     Takes the announce lock: a shutdown racing an in-flight handler's
     announce+decode would interleave into the workers' ordered stream."""
     with _MH_LOCK:
-        _bcast(np.array([OP_SHUTDOWN, 0, 0, 0, 0], np.int32))
+        _bcast(np.zeros(_HEADER_LEN, np.int32))  # OP_SHUTDOWN
 
 
 import threading as _threading
@@ -229,6 +233,25 @@ import threading as _threading
 _MH_LOCK = _threading.Lock()
 
 
+def serve_beam(model, params, prompt_ids, mesh: Optional[Mesh] = None,
+               max_new_tokens: int = 64, num_beams: int = 4,
+               eos_token_id=None):
+    """Deterministic beam search under a mesh context, both outputs
+    host-readable on every process. One shared entry so process 0 and
+    the worker replay run the identical program AND the identical
+    gather sequence (tokens first, then scores)."""
+    import contextlib
+
+    from pyspark_tf_gke_tpu.models import beam_search
+
+    with mesh or contextlib.nullcontext():
+        out, scores = beam_search(model, params, jnp.asarray(prompt_ids),
+                                  max_new_tokens=max_new_tokens,
+                                  num_beams=num_beams,
+                                  eos_token_id=eos_token_id)
+    return as_host_array(out), as_host_array(scores)
+
+
 def mh_score(model, params, ids, lengths, mesh: Mesh):
     """Process 0's scoring path on a multi-process mesh: announce
     (header + token payload + lengths payload), then run the same
@@ -238,26 +261,35 @@ def mh_score(model, params, ids, lengths, mesh: Mesh):
     b, s = ids.shape
     with _MH_LOCK:
         if jax.process_count() > 1:
-            _bcast(np.array([OP_SCORE, b, s, 0, 0], np.int32))
+            _bcast(np.array([OP_SCORE, b, s, 0, 0, 0], np.int32))
             _bcast(ids)
             _bcast(lengths)
         return serve_score(model, params, ids, lengths, mesh=mesh)
 
 
 def mh_generate(model, params, prompt_ids, mesh: Mesh,
-                max_new_tokens: int = 64, eos_token_id=None):
+                max_new_tokens: int = 64, eos_token_id=None,
+                num_beams: int = 0):
     """Process 0's request path on a multi-process mesh: announce, then
-    run the same ``serve_generate`` the workers replay. On a
-    single-process mesh this degrades to plain ``serve_generate`` (no
-    broadcasts). Thread-safe: the announce+decode pair is serialized —
-    concurrent HTTP handlers cannot interleave broadcasts."""
+    run the same ``serve_generate`` (or ``serve_beam`` for
+    ``num_beams>1`` — deterministic, so it rides the wire) the workers
+    replay. On a single-process mesh this degrades to the plain call
+    (no broadcasts). Thread-safe: the announce+decode pair is
+    serialized — concurrent HTTP handlers cannot interleave broadcasts.
+    Returns tokens, or ``(tokens, scores)`` on the beam path."""
     # the SAME int32 array is announced and decoded — a dtype mismatch
     # would compile a different program on process 0 than the workers'
     # replay, desynchronizing the SPMD collectives
     prompt = np.asarray(prompt_ids, np.int32)
     with _MH_LOCK:
         if jax.process_count() > 1:
-            announce_generate(prompt, max_new_tokens, eos_token_id)
+            announce_generate(prompt, max_new_tokens, eos_token_id,
+                              num_beams=num_beams)
+        if num_beams and num_beams > 1:
+            return serve_beam(model, params, prompt, mesh=mesh,
+                              max_new_tokens=max_new_tokens,
+                              num_beams=num_beams,
+                              eos_token_id=eos_token_id)
         return serve_generate(model, params, jnp.asarray(prompt),
                               mesh=mesh, max_new_tokens=max_new_tokens,
                               eos_token_id=eos_token_id)
@@ -280,7 +312,7 @@ def serve_worker_loop(model, params, mesh: Mesh) -> int:
     served = 0
     while True:
         header = np.asarray(_bcast(np.zeros(_HEADER_LEN, np.int32)))
-        op, b, s, max_new, eos = (int(v) for v in header)
+        op, b, s, max_new, eos, beams = (int(v) for v in header)
         if op == OP_SHUTDOWN:
             return served
         prompt = np.asarray(_bcast(np.zeros((b, s), np.int32)))
@@ -289,6 +321,10 @@ def serve_worker_loop(model, params, mesh: Mesh) -> int:
         try:
             if op == OP_SCORE:
                 serve_score(model, params, prompt, lengths, mesh=mesh)
+            elif beams > 1:
+                serve_beam(model, params, prompt, mesh=mesh,
+                           max_new_tokens=max_new, num_beams=beams,
+                           eos_token_id=None if eos < 0 else eos)
             else:
                 serve_generate(model, params, jnp.asarray(prompt),
                                mesh=mesh, max_new_tokens=max_new,

@@ -275,21 +275,25 @@ MH_SERVE_RUNNER = _RUNNER_PREAMBLE + TP_SERVE_SETUP + r"""
 from pyspark_tf_gke_tpu.train.serving import mh_score
 
 if pid == 0:
-    # three requests with DIFFERENT shapes and ops: the worker loop must
+    # four requests with DIFFERENT shapes and ops: the worker loop must
     # learn each payload shape from the header broadcast, and replay
-    # score as well as generate
+    # score and beams as well as greedy generate
     p1 = np.tile(np.arange(4, 12, dtype=np.int32)[None], (2, 1))
     p2 = np.arange(10, 16, dtype=np.int32)[None]
     o1 = np.asarray(mh_generate(model, placed, p1, mesh, max_new_tokens=5))
     o2 = np.asarray(mh_generate(model, placed, p2, mesh, max_new_tokens=3))
     nll = np.asarray(mh_score(model, placed, p1,
                               np.array([8, 5], np.int32), mesh))
+    ob, sc = mh_generate(model, placed, p2, mesh, max_new_tokens=3,
+                         num_beams=2)
     announce_shutdown()
     print("MH_TOKENS", o1[:, 8:].tolist(), o2[:, 6:].tolist(),
-          [round(float(v), 4) for v in nll])
+          [round(float(v), 4) for v in nll],
+          np.asarray(ob)[:, 6:].tolist(),
+          [round(float(v), 4) for v in np.asarray(sc)])
 else:
     served = serve_worker_loop(model, placed, mesh)
-    assert served == 3, f"worker replayed {served} != 3 requests"
+    assert served == 4, f"worker replayed {served} != 4 requests"
     print("MH_WORKER_OK", served)
 """
 
@@ -315,15 +319,21 @@ def test_two_process_serving_driver_worker_loop(tmp_path):
     rn = [round(float(v), 4) for v in np.asarray(serve_score(
         model, placed, np.asarray(p1), np.array([8, 5], np.int32),
         mesh=mesh))]
+    from pyspark_tf_gke_tpu.train.serving import serve_beam
+
+    rb, rs = serve_beam(model, placed, np.asarray(p2), mesh=mesh,
+                        max_new_tokens=3, num_beams=2)
+    rb = np.asarray(rb)[:, 6:].tolist()
+    rs = [round(float(v), 4) for v in np.asarray(rs)]
 
     procs = _spawn_pair(lambda pid, port: [
         "-c", MH_SERVE_RUNNER, "2", str(pid), f"127.0.0.1:{port}"])
     outputs = _communicate_pair(procs)
     for i, (p, text) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"mh worker {i} failed:\n{text[-3000:]}"
-    assert "MH_WORKER_OK 3" in outputs[1]
+    assert "MH_WORKER_OK 4" in outputs[1]
     toks = outputs[0].split("MH_TOKENS ")[1].splitlines()[0]
-    assert toks == f"{r1} {r2} {rn}"
+    assert toks == f"{r1} {r2} {rn} {rb} {rs}"
 
 
 SERVE_MAIN_RUNNER = r"""
@@ -414,7 +424,16 @@ def test_two_process_serve_cli_http_end_to_end(tmp_path):
         assert sc["scores"][0]["tokens"] == ref_sc[0]["tokens"]
         assert abs(sc["scores"][0]["nll"] - ref_sc[0]["nll"]) < 1e-3
 
-        # sampling is rejected on multi-host (greedy-only wire header)
+        # deterministic beams ride it as well (header num_beams)
+        bm = post({"prompts": ["ab"], "max_new_tokens": 4, "num_beams": 2})
+        ref_bm = ref_server.generate(["ab"], max_new_tokens=4, num_beams=2)
+        assert (bm["completions"][0]["completion"]
+                == ref_bm[0]["completion"])
+        assert abs(bm["completions"][0]["beam_score"]
+                   - ref_bm[0]["beam_score"]) < 1e-4
+
+        # sampling is rejected on multi-host (per-request rng state is
+        # not on the wire; deterministic requests only)
         with pytest.raises(urllib.error.HTTPError) as e:
             post({"prompts": ["ab"], "max_new_tokens": 4,
                   "temperature": 1.0})
@@ -434,7 +453,7 @@ def test_two_process_serve_cli_http_end_to_end(tmp_path):
             assert p.returncode == 0, (
                 f"serve process {i} did not shut down cleanly:"
                 f"\n{text[-3000:]}")
-        assert "worker loop done after 2 requests" in outputs[1]
+        assert "worker loop done after 3 requests" in outputs[1]
     finally:
         for p in procs:
             if p.poll() is None:
