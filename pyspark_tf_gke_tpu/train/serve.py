@@ -171,16 +171,6 @@ class BundleServer:
         if len(prompts) > MAX_BATCH:
             raise ValueError(f"batch of {len(prompts)} exceeds "
                              f"max batch {MAX_BATCH}")
-        if self.multi_host and ((temperature and temperature > 0)
-                                or top_k is not None or top_p is not None
-                                or repetition_penalty is not None):
-            # the announce/replay header (train/serving.py) carries only
-            # DETERMINISTIC request parameters (greedy + beam width);
-            # sampling state would run a different program on process 0
-            # than on the workers
-            raise ValueError("multi-host serving supports deterministic "
-                             "decode only (greedy or beams - no "
-                             "sampling or penalties)")
         rng = (jax.random.PRNGKey(
             int.from_bytes(os.urandom(4), "little"))
             if temperature and temperature > 0 else None)
@@ -256,10 +246,16 @@ class BundleServer:
                 elif self.multi_host:
                     from pyspark_tf_gke_tpu.train.serving import mh_generate
 
+                    # everything (incl. the rng key for sampling) rides
+                    # the announce/replay wire — see train/serving.py
                     out = mh_generate(self.model, self.params, batch,
                                       self.mesh,
                                       max_new_tokens=max_new_tokens,
-                                      eos_token_id=eos_id)
+                                      eos_token_id=eos_id,
+                                      temperature=temperature,
+                                      top_k=top_k, top_p=top_p,
+                                      repetition_penalty=repetition_penalty,
+                                      rng=rng)
                     scores = None
                 else:
                     gen_fn = generate if self.mesh is None else serve_generate

@@ -189,10 +189,14 @@ def as_host_array(x):
 OP_SHUTDOWN = 0
 OP_GENERATE = 1
 OP_SCORE = 2
-# [op, batch, prompt_len, max_new_tokens, eos (-1=none), num_beams]
-# (num_beams>1 -> the deterministic beam path; OP_SCORE reuses
-#  batch/prompt_len and zeros the rest)
-_HEADER_LEN = 6
+# [op, batch, prompt_len, max_new_tokens, eos (-1=none), num_beams,
+#  top_k (-1=none), extras (0/1/2)]
+# num_beams>1 -> the deterministic beam path. extras=1 -> one float
+# payload follows the prompt (temperature/top_p/penalty; greedy with a
+# repetition penalty); extras=2 -> the float payload AND the rng key
+# (sampling), so every process draws the SAME tokens. OP_SCORE reuses
+# batch/prompt_len and zeros the rest.
+_HEADER_LEN = 8
 
 
 def _bcast(x):
@@ -202,18 +206,29 @@ def _bcast(x):
 
 
 def announce_generate(prompt_ids, max_new_tokens: int,
-                      eos_token_id=None, num_beams: int = 0) -> None:
+                      eos_token_id=None, num_beams: int = 0,
+                      top_k=None, sampling=None) -> None:
     """Process 0: publish a generate request to every worker process.
-    Two broadcasts: the fixed-shape header first (workers learn the
-    payload shape), then the prompt tokens. The header carries every
-    argument that shapes the compiled program (eos and beam width
-    included) — a worker replaying a DIFFERENT program than process 0
-    desyncs the SPMD collectives."""
+    Broadcasts: the fixed-shape header first (workers learn the payload
+    shapes), the prompt tokens, and — for sampling requests — the float
+    params + the rng key, so every process draws identical tokens. The
+    header carries every argument that shapes the compiled program —
+    a worker replaying a DIFFERENT program than process 0 desyncs the
+    SPMD collectives."""
     b, s = prompt_ids.shape
     eos = -1 if eos_token_id is None else int(eos_token_id)
-    _bcast(np.array([OP_GENERATE, b, s, max_new_tokens, eos,
-                     num_beams], np.int32))
+    tk = -1 if top_k is None else int(top_k)
+    extras = (0 if sampling is None
+              else (2 if sampling["key"] is not None else 1))
+    header = np.zeros(_HEADER_LEN, np.int32)
+    header[:8] = [OP_GENERATE, b, s, max_new_tokens, eos, num_beams,
+                  tk, extras]
+    _bcast(header)
     _bcast(np.asarray(prompt_ids, np.int32))
+    if sampling is not None:
+        _bcast(np.asarray(sampling["floats"], np.float32))
+        if sampling["key"] is not None:
+            _bcast(np.asarray(sampling["key"], np.uint32))
 
 
 def announce_shutdown() -> None:
@@ -261,38 +276,92 @@ def mh_score(model, params, ids, lengths, mesh: Mesh):
     b, s = ids.shape
     with _MH_LOCK:
         if jax.process_count() > 1:
-            _bcast(np.array([OP_SCORE, b, s, 0, 0, 0], np.int32))
+            header = np.zeros(_HEADER_LEN, np.int32)
+            header[:3] = [OP_SCORE, b, s]
+            _bcast(header)
             _bcast(ids)
             _bcast(lengths)
         return serve_score(model, params, ids, lengths, mesh=mesh)
 
 
+def _pack_sampling(temperature, top_p, repetition_penalty, rng):
+    """Wire form of the TRACED decode operands: three floats (NaN =
+    None) + — when actually sampling — the raw rng key words. A greedy
+    request with a repetition penalty packs floats only (the penalty is
+    applied before argmax too). The invariant is argument equality —
+    both sides hand ``generate`` identical values, so they trace and
+    draw identically."""
+    sampling = bool(temperature and temperature > 0)
+    if not sampling and repetition_penalty is None:
+        return None
+    if sampling and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng key")
+    floats = np.array([temperature if sampling else 0.0,
+                       np.nan if top_p is None else top_p,
+                       np.nan if repetition_penalty is None
+                       else repetition_penalty], np.float32)
+    key = None
+    if sampling:
+        try:
+            key = np.asarray(jax.random.key_data(rng), np.uint32)
+        except TypeError:  # raw uint32 key (legacy PRNGKey form)
+            key = np.asarray(rng, np.uint32)
+    # NOTE: process 0 must ALSO decode through _unpack_sampling (see
+    # mh_generate) so both sides hand generate() the same typed-key
+    # form — a raw-vs-typed key operand would trace different programs.
+    return {"floats": floats, "key": key}
+
+
+def _unpack_sampling(floats, key):
+    t, tp, rp = (float(v) for v in floats)
+    out = dict(
+        temperature=t,
+        top_p=None if np.isnan(tp) else tp,
+        repetition_penalty=None if np.isnan(rp) else rp,
+    )
+    if key is not None:
+        out["rng"] = jax.random.wrap_key_data(jnp.asarray(key, jnp.uint32))
+    return out
+
+
 def mh_generate(model, params, prompt_ids, mesh: Mesh,
                 max_new_tokens: int = 64, eos_token_id=None,
-                num_beams: int = 0):
+                num_beams: int = 0, temperature: float = 0.0,
+                top_k=None, top_p=None, repetition_penalty=None,
+                rng=None):
     """Process 0's request path on a multi-process mesh: announce, then
     run the same ``serve_generate`` (or ``serve_beam`` for
-    ``num_beams>1`` — deterministic, so it rides the wire) the workers
-    replay. On a single-process mesh this degrades to the plain call
-    (no broadcasts). Thread-safe: the announce+decode pair is
-    serialized — concurrent HTTP handlers cannot interleave broadcasts.
-    Returns tokens, or ``(tokens, scores)`` on the beam path."""
-    # the SAME int32 array is announced and decoded — a dtype mismatch
-    # would compile a different program on process 0 than the workers'
-    # replay, desynchronizing the SPMD collectives
+    ``num_beams>1``) the workers replay. Sampling rides the wire too —
+    the rng key and float params are broadcast so every process draws
+    the same tokens. On a single-process mesh this degrades to the
+    plain call (no broadcasts). Thread-safe: the announce+decode pair
+    is serialized — concurrent HTTP handlers cannot interleave
+    broadcasts. Returns tokens, or ``(tokens, scores)`` on the beam
+    path."""
+    # the SAME values are announced and decoded — any mismatch (array
+    # dtype, float top_k, raw-vs-typed key) would compile a different
+    # program on process 0 than the workers' replay, desynchronizing
+    # the SPMD collectives. Hence: int32 prompt, int-or-None top_k, and
+    # process 0 decoding its own kwargs through _unpack_sampling.
     prompt = np.asarray(prompt_ids, np.int32)
+    top_k = None if top_k is None else int(top_k)
+    sampling = _pack_sampling(temperature, top_p, repetition_penalty, rng)
     with _MH_LOCK:
         if jax.process_count() > 1:
             announce_generate(prompt, max_new_tokens, eos_token_id,
-                              num_beams=num_beams)
+                              num_beams=num_beams, top_k=top_k,
+                              sampling=sampling)
         if num_beams and num_beams > 1:
             return serve_beam(model, params, prompt, mesh=mesh,
                               max_new_tokens=max_new_tokens,
                               num_beams=num_beams,
                               eos_token_id=eos_token_id)
+        kwargs = ({} if sampling is None else
+                  _unpack_sampling(sampling["floats"], sampling["key"]))
         return serve_generate(model, params, jnp.asarray(prompt),
                               mesh=mesh, max_new_tokens=max_new_tokens,
-                              eos_token_id=eos_token_id)
+                              eos_token_id=eos_token_id, top_k=top_k,
+                              **kwargs)
 
 
 def serve_worker_loop(model, params, mesh: Mesh) -> int:
@@ -312,12 +381,19 @@ def serve_worker_loop(model, params, mesh: Mesh) -> int:
     served = 0
     while True:
         header = np.asarray(_bcast(np.zeros(_HEADER_LEN, np.int32)))
-        op, b, s, max_new, eos, beams = (int(v) for v in header)
+        op, b, s, max_new, eos, beams, tk, sampling = (
+            int(v) for v in header)
         if op == OP_SHUTDOWN:
             return served
         prompt = np.asarray(_bcast(np.zeros((b, s), np.int32)))
         lengths = (np.asarray(_bcast(np.zeros(b, np.int32)))
                    if op == OP_SCORE else None)
+        skwargs = {}
+        if sampling:  # extras: 1 = floats only, 2 = floats + rng key
+            floats = np.asarray(_bcast(np.zeros(3, np.float32)))
+            key = (np.asarray(_bcast(np.zeros(2, np.uint32)))
+                   if sampling == 2 else None)
+            skwargs = _unpack_sampling(floats, key)
         try:
             if op == OP_SCORE:
                 serve_score(model, params, prompt, lengths, mesh=mesh)
@@ -328,7 +404,8 @@ def serve_worker_loop(model, params, mesh: Mesh) -> int:
             else:
                 serve_generate(model, params, jnp.asarray(prompt),
                                mesh=mesh, max_new_tokens=max_new,
-                               eos_token_id=None if eos < 0 else eos)
+                               eos_token_id=None if eos < 0 else eos,
+                               top_k=None if tk < 0 else tk, **skwargs)
         except Exception:  # noqa: BLE001 — keep the control plane alive
             logger.exception("replayed request failed (continuing)")
         served += 1
