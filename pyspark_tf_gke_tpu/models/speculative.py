@@ -147,7 +147,13 @@ def speculative_generate(
     t_cache, t_last = _prefill(target_model, target_params, prompt_ids)
     d_cache, _ = _prefill(draft_model, draft_params, prompt_ids)
 
-    first = int(jnp.argmax(t_last, axis=-1)[0])
+    # host readbacks route through as_host_array: on a multi-process
+    # mesh these drive the (deterministic) control flow, so every
+    # process must read the same values — a bare np.asarray would raise
+    # on non-addressable shards instead
+    from pyspark_tf_gke_tpu.parallel.distributed import as_host_array
+
+    first = int(np.asarray(as_host_array(jnp.argmax(t_last, axis=-1)))[0])
     emitted = [first]
     # fill levels: cache rows written so far (prompt only; the freshly
     # emitted token is fed next round)
@@ -177,7 +183,7 @@ def speculative_generate(
             draft_model, draft_params, d_cache, last_tok,
             jnp.asarray(d_fill, jnp.int32), g)
         d_fill += g  # holds last_tok .. d_{g-2} (d_{g-1} never fed)
-        drafts_host = np.asarray(drafts)[0]  # [g]
+        drafts_host = np.asarray(as_host_array(drafts))[0]  # [g]
         proposed += g
 
         # 2. target verifies the whole proposal in ONE chunk forward:
@@ -187,7 +193,8 @@ def speculative_generate(
         logits, t_cache = _extend(target_model, target_params, t_cache,
                                   chunk, jnp.asarray(t_fill, jnp.int32))
         t_fill += g + 1
-        preds = np.asarray(jnp.argmax(logits, axis=-1))[0]  # [g+1]
+        preds = np.asarray(as_host_array(
+            jnp.argmax(logits, axis=-1)))[0]  # [g+1]
 
         # 3. greedy acceptance: d_i is kept iff it equals the target's
         #    own argmax at the position before it.

@@ -116,3 +116,18 @@ def initialize_distributed(
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+def as_host_array(x):
+    """Make a device array host-readable on EVERY process: on a
+    multi-process mesh results can come back sharded across hosts (not
+    fully addressable), and host-side consumers (a server serializing
+    tokens, control flow reading accept counts) must hold the whole
+    thing. No-op for single-process arrays; an SPMD all-gather
+    otherwise — all processes run the same program, so all reach this
+    collective."""
+    if getattr(x, "is_fully_addressable", True):
+        return x
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x, tiled=True)

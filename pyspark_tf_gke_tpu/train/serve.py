@@ -39,7 +39,6 @@ Implementation notes (TPU-shaped, not an afterthought):
 from __future__ import annotations
 
 import argparse
-import contextlib
 import json
 import os
 import sys
@@ -128,10 +127,6 @@ class BundleServer:
         if self.multi_host and mesh is None:
             raise ValueError("multi-host serving needs a mesh spanning "
                              "all processes (set --tp / SERVE_TP)")
-        if self.multi_host and self.draft_model is not None:
-            raise ValueError("speculative decoding is not supported on "
-                             "multi-host serving (the announce/replay "
-                             "header carries greedy decode only)")
         self._lock = threading.Lock()  # one model, one device queue
 
     # -- health ----------------------------------------------------------
@@ -196,19 +191,19 @@ class BundleServer:
                     and len(encoded[0][1]) + max_new_tokens
                     <= self.draft_model.cfg.max_seq_len)
         if use_spec:
-            from pyspark_tf_gke_tpu.models.speculative import (
-                speculative_generate,
-            )
-
             _, ids = encoded[0]
+            from pyspark_tf_gke_tpu.train.serving import mh_speculative
+
             with self._lock:
                 t0 = time.perf_counter()
-                with self.mesh or contextlib.nullcontext():
-                    out, stats = speculative_generate(
-                        self.model, self.params, self.draft_model,
-                        self.draft_params, jnp.asarray([ids], jnp.int32),
-                        max_new_tokens=max_new_tokens, gamma=SPEC_GAMMA,
-                        eos_token_id=eos_id, return_stats=True)
+                # mh_speculative owns single-vs-multi-host dispatch (the
+                # announce header rides OP_SPECULATIVE; workers replay
+                # the same accept/rollback loop in lockstep)
+                out, stats = mh_speculative(
+                    self.model, self.params, self.draft_model,
+                    self.draft_params, jnp.asarray([ids], jnp.int32),
+                    self.mesh, max_new_tokens=max_new_tokens,
+                    gamma=SPEC_GAMMA, eos_token_id=eos_id)
                 dt = (time.perf_counter() - t0) * 1000.0
             return [self._entry(
                 prompts[0], np.asarray(as_host_array(out)[0, len(ids):]).tolist(), dt,
@@ -513,13 +508,21 @@ def main(argv=None) -> int:
         draft_bundle_dir=(_resolve_bundle(args.draft_bundle)
                           if args.draft_bundle else ""))
     logger.info("bundle loaded: %s", server.health())
+    if jax.process_count() > 1:
+        # fail a misdeploy (draft bundle on some processes only) at
+        # startup, not mid-collective on the first speculative request
+        from pyspark_tf_gke_tpu.train.serving import sync_serving_config
+
+        sync_serving_config(server.draft_model is not None)
 
     if jax.process_count() > 1 and jax.process_index() != 0:
         # workers: no HTTP socket — replay every announced request until
         # process 0 shuts the job down
         from pyspark_tf_gke_tpu.train.serving import serve_worker_loop
 
-        served = serve_worker_loop(server.model, server.params, server.mesh)
+        served = serve_worker_loop(server.model, server.params, server.mesh,
+                                   draft_model=server.draft_model,
+                                   draft_params=server.draft_params)
         logger.info("worker loop done after %d requests", served)
         return 0
 

@@ -25,6 +25,7 @@ import numpy as np
 from flax import linen as nn
 from jax.sharding import Mesh
 
+from pyspark_tf_gke_tpu.parallel.distributed import as_host_array
 from pyspark_tf_gke_tpu.parallel.sharding import LOGICAL_RULES
 
 
@@ -153,18 +154,6 @@ def serve_score(model, params, ids, lengths,
     return as_host_array(out)
 
 
-def as_host_array(x):
-    """Make a device array host-readable on EVERY process: on a
-    multi-process mesh outputs can come back sharded across hosts (not
-    fully addressable), and a server about to serialize tokens/scores
-    must hold the whole thing. No-op for single-process arrays; an SPMD
-    all-gather otherwise (all processes run the same request, so all
-    reach this collective)."""
-    if getattr(x, "is_fully_addressable", True):
-        return x
-    from jax.experimental import multihost_utils
-
-    return multihost_utils.process_allgather(x, tiled=True)
 
 
 # ---------------------------------------------------------------------------
@@ -189,10 +178,12 @@ def as_host_array(x):
 OP_SHUTDOWN = 0
 OP_GENERATE = 1
 OP_SCORE = 2
-# [op, batch, prompt_len, max_new_tokens, eos (-1=none), num_beams,
+OP_SPECULATIVE = 3
+# [op, batch, prompt_len, max_new_tokens, eos (-1=none), aux,
 #  top_k (-1=none), extras (0/1/2)]
-# num_beams>1 -> the deterministic beam path. extras=1 -> one float
-# payload follows the prompt (temperature/top_p/penalty; greedy with a
+# aux = num_beams for OP_GENERATE (beams>1 -> the deterministic beam
+# path), gamma for OP_SPECULATIVE. extras=1 -> one float payload
+# follows the prompt (temperature/top_p/penalty; greedy with a
 # repetition penalty); extras=2 -> the float payload AND the rng key
 # (sampling), so every process draws the SAME tokens. OP_SCORE reuses
 # batch/prompt_len and zeros the rest.
@@ -265,6 +256,57 @@ def serve_beam(model, params, prompt_ids, mesh: Optional[Mesh] = None,
                                   num_beams=num_beams,
                                   eos_token_id=eos_token_id)
     return as_host_array(out), as_host_array(scores)
+
+
+def sync_serving_config(has_draft: bool) -> None:
+    """Called ONCE at startup by every process of a multi-process
+    serving deployment: process 0's draft-bundle presence broadcasts
+    and each process compares it with its own. A mismatch (the classic
+    misdeploy: --draft-bundle on some pods only) raises AT STARTUP on
+    the divergent process — a clean nonzero exit the coordinator
+    cascade turns into a visible set failure — instead of deadlocking
+    the first speculative request mid-collective, where process 0
+    would enter the prefill collectives with no peer."""
+    if jax.process_count() <= 1:
+        return
+    p0 = int(np.asarray(_bcast(np.int32(bool(has_draft)))))
+    if bool(p0) != bool(has_draft):
+        mine = "has one" if has_draft else "has none"
+        theirs = "has a draft bundle" if p0 else "has no draft bundle"
+        raise RuntimeError(
+            f"serving config mismatch: process 0 {theirs}, process "
+            f"{jax.process_index()} {mine} - deploy identical CLI args "
+            f"on every process")
+
+
+def mh_speculative(model, params, draft_model, draft_params, prompt_ids,
+                   mesh: Mesh, max_new_tokens: int, gamma: int = 4,
+                   eos_token_id=None):
+    """Process 0's speculative path on a multi-process mesh. The
+    accept/rollback control flow is deterministic greedy driven by
+    device readbacks that ``speculative_generate`` routes through
+    ``as_host_array`` — every process reads the same values and stays
+    in lockstep through the same sequence of prefill/extend/propose
+    dispatches. Returns ``(tokens, stats)``."""
+    import contextlib
+
+    from pyspark_tf_gke_tpu.models.speculative import speculative_generate
+
+    prompt = np.asarray(prompt_ids, np.int32)
+    b, s = prompt.shape
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+    with _MH_LOCK:
+        if jax.process_count() > 1:
+            header = np.zeros(_HEADER_LEN, np.int32)
+            header[:6] = [OP_SPECULATIVE, b, s, max_new_tokens, eos, gamma]
+            _bcast(header)
+            _bcast(prompt)
+        with mesh or contextlib.nullcontext():
+            return speculative_generate(
+                model, params, draft_model, draft_params,
+                jnp.asarray(prompt), max_new_tokens=max_new_tokens,
+                gamma=gamma, eos_token_id=eos_token_id,
+                return_stats=True)
 
 
 def mh_score(model, params, ids, lengths, mesh: Mesh):
@@ -364,11 +406,12 @@ def mh_generate(model, params, prompt_ids, mesh: Mesh,
                               **kwargs)
 
 
-def serve_worker_loop(model, params, mesh: Mesh) -> int:
+def serve_worker_loop(model, params, mesh: Mesh,
+                      draft_model=None, draft_params=None) -> int:
     """Processes 1..N-1: replay every announced request until shutdown.
-    Returns the number of requests served. ``params`` must already be
-    placed with ``shard_params_for_serving`` on the SAME mesh as
-    process 0's.
+    Returns the number of requests served. ``params`` (and the draft
+    pair, when speculative serving is deployed) must already be placed
+    with ``shard_params_for_serving`` on the SAME mesh as process 0's.
 
     A request that raises (e.g. prompt+max_new over max_seq_len) is
     logged and the loop continues: process 0 hits the same error on its
@@ -381,8 +424,8 @@ def serve_worker_loop(model, params, mesh: Mesh) -> int:
     served = 0
     while True:
         header = np.asarray(_bcast(np.zeros(_HEADER_LEN, np.int32)))
-        op, b, s, max_new, eos, beams, tk, sampling = (
-            int(v) for v in header)
+        op, b, s, max_new, eos, aux, tk, sampling = (
+            int(v) for v in header)  # aux = beams (generate) / gamma (spec)
         if op == OP_SHUTDOWN:
             return served
         prompt = np.asarray(_bcast(np.zeros((b, s), np.int32)))
@@ -395,11 +438,28 @@ def serve_worker_loop(model, params, mesh: Mesh) -> int:
                    if sampling == 2 else None)
             skwargs = _unpack_sampling(floats, key)
         try:
-            if op == OP_SCORE:
+            if op == OP_SPECULATIVE:
+                import contextlib
+
+                from pyspark_tf_gke_tpu.models.speculative import (
+                    speculative_generate,
+                )
+
+                if draft_model is None:
+                    raise RuntimeError(
+                        "speculative request announced but this worker "
+                        "has no draft bundle — deploy identical CLI "
+                        "args on every process")
+                with mesh or contextlib.nullcontext():
+                    speculative_generate(
+                        model, params, draft_model, draft_params,
+                        jnp.asarray(prompt), max_new_tokens=max_new,
+                        gamma=aux, eos_token_id=None if eos < 0 else eos)
+            elif op == OP_SCORE:
                 serve_score(model, params, prompt, lengths, mesh=mesh)
-            elif beams > 1:
+            elif aux > 1:
                 serve_beam(model, params, prompt, mesh=mesh,
-                           max_new_tokens=max_new, num_beams=beams,
+                           max_new_tokens=max_new, num_beams=aux,
                            eos_token_id=None if eos < 0 else eos)
             else:
                 serve_generate(model, params, jnp.asarray(prompt),

@@ -387,8 +387,19 @@ def test_two_process_serve_cli_http_end_to_end(tmp_path):
         make_rng(11), jnp.zeros((1, 8), jnp.int32))["params"])
     bundle = str(tmp_path / "bundle")
     export_serving_bundle(cfg, params, bundle, quantize=False)
+    # a smaller draft (same vocab): single-prompt greedy requests route
+    # through speculative decoding — over the wire, on multi-host
+    dcfg = CausalLMConfig(vocab_size=259, hidden_size=16, num_layers=1,
+                          num_heads=2, num_kv_heads=1, intermediate_size=32,
+                          max_seq_len=64, dtype=jnp.float32)
+    dmodel = CausalLM(dcfg)
+    dparams = nn.meta.unbox(jax.jit(dmodel.init)(
+        make_rng(12), jnp.zeros((1, 8), jnp.int32))["params"])
+    draft = str(tmp_path / "draft")
+    export_serving_bundle(dcfg, dparams, draft, quantize=False)
 
-    # single-process reference on the same dp x tp mesh shape
+    # single-process reference on the same dp x tp mesh shape (no draft
+    # needed: speculative decoding is greedy-exact by construction)
     ref_server = BundleServer(
         bundle, mesh=make_mesh({"dp": 4, "tp": 2}, jax.devices()[:8]))
     ref = ref_server.generate(["ab"], max_new_tokens=6)[0]["completion"]
@@ -396,7 +407,8 @@ def test_two_process_serve_cli_http_end_to_end(tmp_path):
     http_port = _free_port()
     procs = _spawn_pair(lambda pid, port: [
         "-c", SERVE_MAIN_RUNNER,
-        "--bundle", bundle, "--host", "127.0.0.1",
+        "--bundle", bundle, "--draft-bundle", draft,
+        "--host", "127.0.0.1",
         "--port", str(http_port), "--tp", "2",
         "--num-processes", "2", "--process-id", str(pid),
         "--coordinator-addr", f"127.0.0.1:{port}",
@@ -425,8 +437,12 @@ def test_two_process_serve_cli_http_end_to_end(tmp_path):
             with urllib.request.urlopen(req, timeout=120) as r:
                 return _json.loads(r.read())
 
+        # single-prompt greedy routes SPECULATIVE (draft bundle loaded)
+        # over the wire; greedy-exact, so it matches the plain reference
         out = post({"prompts": ["ab"], "max_new_tokens": 6})
         assert out["completions"][0]["completion"] == ref
+        assert "speculative" in out["completions"][0]
+        assert out["completions"][0]["speculative"]["gamma"] == 4
 
         # scoring rides the wire protocol too (OP_SCORE replay)
         sc = post({"texts": ["hello world"]}, path="/v1/score")
