@@ -50,7 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pyspark_tf_gke_tpu.train.serving import as_host_array
+from pyspark_tf_gke_tpu.parallel.distributed import as_host_array
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
 logger = get_logger("train.serve")

@@ -446,10 +446,19 @@ def serve_worker_loop(model, params, mesh: Mesh,
                 )
 
                 if draft_model is None:
-                    raise RuntimeError(
+                    # NOT raised into the loop's catch-all: process 0 is
+                    # already inside speculative_generate's collectives,
+                    # so "log and wait for the next announce" would park
+                    # this process at the next _bcast while process 0
+                    # blocks in a collective forever — the exact hang
+                    # the startup sync_serving_config check exists to
+                    # prevent. A misdeployed worker must die loudly.
+                    logger.error(
                         "speculative request announced but this worker "
                         "has no draft bundle — deploy identical CLI "
-                        "args on every process")
+                        "args on every process; exiting so the hang is "
+                        "visible as a dead process, not a stuck job")
+                    raise SystemExit(13)
                 with mesh or contextlib.nullcontext():
                     speculative_generate(
                         model, params, draft_model, draft_params,

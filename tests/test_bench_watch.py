@@ -235,3 +235,84 @@ def test_bf16_moments_rejected_off_flagship():
 
     with pytest.raises(SystemExit, match="cnn workload only"):
         bench.run_bench(["resnet50", "--bf16-moments"])
+
+
+def test_matrix_fast_fails_when_tunnel_dies_mid_matrix(monkeypatch, capsys):
+    # Round-4 live failure mode: cnn/resnet50 measured fine, then the
+    # tunnel died and vit hung to its RUN_TIMEOUT_S. Every remaining
+    # device workload must fast-fail after ONE cheap re-probe (not burn
+    # RUN_ATTEMPTS x RUN_TIMEOUT_S each); the host-only io bench still
+    # runs.
+    ran = []
+
+    def fake_orchestrate(argv, skip_probe=False):
+        ran.append(list(argv))
+        return 1 if argv[0] == "vit" else 0  # vit "hangs", rest fine
+
+    monkeypatch.setattr(bench, "orchestrate", fake_orchestrate)
+    monkeypatch.setattr(bench, "probe_backend_once", lambda *a: "")
+    failures = bench._run_matrix([], backend_ok=True)
+    # Everything before vit ran; vit failed; after vit only io ran.
+    names = [a[0] for a in ran]
+    assert "vit" in names and "io" in names
+    assert names.index("vit") < names.index("io")
+    assert "bert" not in names and "generate" not in names
+    out = capsys.readouterr().out
+    assert "mid-matrix" in out  # fast-fail error JSON names the cause
+    dead_device = [w for w in bench.ALL_WORKLOADS
+                   if w[0] not in ("io",) and list(w) not in ran
+                   and w[0] != "cnn"]
+    assert failures == 1 + len(dead_device)
+
+
+def test_matrix_keeps_going_when_probe_still_answers(monkeypatch):
+    # A workload's OWN failure (tunnel fine) must not kill the matrix.
+    ran = []
+
+    def fake_orchestrate(argv, skip_probe=False):
+        ran.append(list(argv))
+        return 1 if argv[0] == "vit" else 0
+
+    probes = []
+    monkeypatch.setattr(bench, "orchestrate", fake_orchestrate)
+    monkeypatch.setattr(
+        bench, "probe_backend_once",
+        lambda *a: probes.append(1) or "probe ok: 1x TPU v5 lite (tpu)")
+    failures = bench._run_matrix([], backend_ok=True)
+    assert failures == 1
+    assert [a[0] for a in ran].count("generate") == 5  # full matrix ran
+    assert len(probes) == 1  # exactly one re-probe, after the failure
+
+
+def test_run_retry_skipped_when_backend_gone(monkeypatch, capsys):
+    # orchestrate must not retry a timed-out workload into a dead
+    # backend (each retry costs RUN_TIMEOUT_S).
+    attempts = []
+
+    def fake_run(cmd, **kw):
+        attempts.append(cmd)
+        raise subprocess.TimeoutExpired(cmd=cmd, timeout=1)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "probe_backend_once", lambda *a: "")
+    rc = bench.orchestrate(["vit"], skip_probe=True)
+    assert rc == 1
+    assert len(attempts) == 1  # no second RUN_TIMEOUT_S burned
+    err = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "retry skipped" in err["error"]["detail"]
+
+
+def test_run_retry_proceeds_when_backend_alive(monkeypatch, capsys):
+    attempts = []
+
+    def fake_run(cmd, **kw):
+        attempts.append(cmd)
+        raise subprocess.TimeoutExpired(cmd=cmd, timeout=1)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "probe_backend_once",
+                        lambda *a: "probe ok: 1x TPU v5 lite (tpu)")
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    rc = bench.orchestrate(["vit"], skip_probe=True)
+    assert rc == 1
+    assert len(attempts) == bench.RUN_ATTEMPTS
