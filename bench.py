@@ -30,7 +30,7 @@ batches);
 measures KV-cache decode tokens/sec on the serving path (GQA, weight-
 only int8, int8 KV cache, beam search); ``python bench.py spec
 [--gamma N]`` measures speculative decoding (lower + upper bounds).
-``python bench.py all`` runs the full 14-workload matrix with ONE
+``python bench.py all`` runs the full 15-workload matrix with ONE
 backend probe, appending every success to tools/bench_history.jsonl.
 
 Resilience: the TPU backend attach through the tunnel is known-flaky
@@ -159,7 +159,8 @@ def _mfu(flops_per_step, step_seconds: float, device_kind: str):
 
 
 def build_workload(name: str, smoke: bool = False, batch_override: int = 0,
-                   use_flash=None, seq_override=None, mu_dtype=None):
+                   use_flash=None, seq_override=None, mu_dtype=None,
+                   s2d: bool = False):
     """(trainer, batch, batch_size, extra) for a named workload — the
     single construction point shared by the bench passes below and by
     ``tools/roofline.py``, so the analysis tool always explains exactly
@@ -196,12 +197,19 @@ def build_workload(name: str, smoke: bool = False, batch_override: int = 0,
 
         batch_size, hw = (8, 64) if smoke else (64, 224)
         batch_size = batch_override or batch_size
-        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        # --s2d: the disclosed stem lever (see models/resnet.py
+        # space_to_depth) — same output shapes and FLOP class, stem
+        # contraction dim 4*4*12=192 instead of 7*7*3=147-with-3-wide
+        # lanes; the next chip window A/Bs it against the plain headline.
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
+                         s2d_stem=s2d)
         batch = {
             "image": rng.uniform(0, 1, (batch_size, hw, hw, 3)).astype(np.float32),
             "label": rng.integers(0, 1000, (batch_size,)).astype(np.int32),
         }
         trainer = Trainer(model, TASKS["resnet"](), mesh, learning_rate=1e-3)
+        if s2d:
+            extra["stem"] = "space_to_depth_2x_4x4"
     elif name == "vit":
         from pyspark_tf_gke_tpu.models import BertConfig, ViTClassifier
 
@@ -347,7 +355,7 @@ def main(batch_size: int = 32, steps: int = 100, throughput_batch: int = 128,
 
 def bench_workload(name: str, steps: int = 50, smoke: bool = False,
                    use_flash=None, seq_override=None,
-                   throughput_batch: int = 0) -> dict:
+                   throughput_batch: int = 0, s2d: bool = False) -> dict:
     """Secondary workloads: resnet50 / bert (BASELINE configs 4 and 5).
     ``smoke`` shrinks shapes so the plumbing runs on the CPU fake slice.
     ``use_flash`` (bert only): None = model default (flash auto on TPU at
@@ -369,7 +377,8 @@ def bench_workload(name: str, steps: int = 50, smoke: bool = False,
     device_kind = devices[0].device_kind
 
     trainer, batch, batch_size, extra = build_workload(
-        name, smoke=smoke, use_flash=use_flash, seq_override=seq_override)
+        name, smoke=smoke, use_flash=use_flash, seq_override=seq_override,
+        s2d=s2d)
     state = trainer.init_state(make_rng(1337), batch)
     sharding = batch_sharding(trainer.mesh)
     global_batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
@@ -896,6 +905,7 @@ ALL_WORKLOADS = (
     ["cnn"],
     ["cnn", "--bf16-moments"],  # disclosed optimizer-traffic lever
     ["resnet50"],
+    ["resnet50", "--s2d"],  # disclosed stem-layout lever
     ["vit"],
     ["bert"],
     ["bert", "--seq", "2048"],
@@ -990,7 +1000,7 @@ def orchestrate_all(extra) -> int:
 def orchestrate_bare() -> int:
     """``python bench.py`` with NO arguments — the driver's fixed capture
     command. It can only ever record the flagship, so when the tunnel
-    finally answers during a driver capture, 13 of 14 matrix
+    finally answers during a driver capture, 14 of 15 matrix
     measurements would still be missing (round-3 verdict, Weak #4). The
     bare invocation therefore chains opportunistically into the rest of
     the matrix after a successful flagship run: the flagship JSON stays
@@ -1092,6 +1102,8 @@ def run_bench(argv) -> dict:
         # a silently-ignored flag would record a mislabeled identity
         # into the evidence trail (argv IS the measurement identity)
         raise SystemExit("--bf16-moments applies to the cnn workload only")
+    if "--s2d" in argv and workload != "resnet50":
+        raise SystemExit("--s2d applies to the resnet50 workload only")
     if workload == "cnn":
         mu = None
         if "--bf16-moments" in argv:
@@ -1147,7 +1159,7 @@ def run_bench(argv) -> dict:
     tb = 256 if (workload in ("resnet50", "vit") and not smoke) else 0
     return bench_workload(workload, steps=2 if smoke else 50, smoke=smoke,
                           use_flash=use_flash, seq_override=seq,
-                          throughput_batch=tb)
+                          throughput_batch=tb, s2d="--s2d" in argv)
 
 
 if __name__ == "__main__":

@@ -19,6 +19,25 @@ import jax.numpy as jnp
 ModuleDef = Any
 
 
+def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """(B, H, W, C) -> (B, H/block, W/block, C*block*block).
+
+    Pure layout rearrangement (no FLOPs): each output "pixel" stacks a
+    block x block patch of input pixels along channels. Used by the s2d
+    stem so the first conv contracts over C*block^2 channels instead of
+    3 — the stem's MXU contraction dim grows from KH*KW*3 toward the
+    128-lane tile the systolic array actually loads, which is the
+    standard TPU ResNet stem optimization (cf. MLPerf ResNet and the
+    roofline analysis in docs/PARITY.md)."""
+    b, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(
+            f"space_to_depth needs H,W divisible by {block}, got {h}x{w}")
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // block, w // block, c * block * block)
+
+
 class BottleneckBlock(nn.Module):
     features: int
     conv: ModuleDef
@@ -47,6 +66,17 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Optional[Any] = jnp.bfloat16
+    # s2d stem: rearrange the input 2x space-to-depth and replace the
+    # 7x7/stride-2 conv (contraction dim 7*7*3 = 147, of which only 3
+    # channels feed each MXU lane group) with an equivalent-receptive-
+    # field 4x4/stride-1 conv over 12 channels (covers 8x8 input pixels
+    # at stride 2, i.e. the 7x7 window padded by one). Same output
+    # shape; ~31% more raw stem MACs (192 vs 147 per output element —
+    # the stem is <1% of total model FLOPs) traded for a contraction
+    # dim the MXU can actually fill. A disclosed bench variant
+    # (``bench.py resnet50 --s2d``), not a drop-in weight-compatible
+    # swap.
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -56,7 +86,13 @@ class ResNet(nn.Module):
             epsilon=1e-5, dtype=self.dtype,
         )
         x = x.astype(self.dtype) if self.dtype else x
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        if self.s2d_stem:
+            x = space_to_depth(x, 2)
+            x = conv(self.num_filters, (4, 4), (1, 1), padding="SAME",
+                     name="conv_init_s2d")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -316,3 +316,10 @@ def test_run_retry_proceeds_when_backend_alive(monkeypatch, capsys):
     rc = bench.orchestrate(["vit"], skip_probe=True)
     assert rc == 1
     assert len(attempts) == bench.RUN_ATTEMPTS
+
+
+def test_s2d_rejected_off_resnet50():
+    import pytest
+
+    with pytest.raises(SystemExit, match="resnet50 workload only"):
+        bench.run_bench(["cnn", "--s2d"])

@@ -115,3 +115,83 @@ def test_build_model_factory():
     assert isinstance(build_model("cnn", flat=True), CNNRegressor)
     with pytest.raises(ValueError):
         build_model("nope")
+
+
+def test_space_to_depth_layout():
+    from pyspark_tf_gke_tpu.models.resnet import space_to_depth
+
+    # Each output pixel must stack its 2x2 input patch along channels in
+    # (row-major patch, then original channel) order.
+    x = jnp.arange(2 * 4 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 4, 3)
+    y = space_to_depth(x, 2)
+    assert y.shape == (2, 2, 2, 12)
+    expected = jnp.concatenate(
+        [x[:, 0:1, 0:1, :], x[:, 0:1, 1:2, :],
+         x[:, 1:2, 0:1, :], x[:, 1:2, 1:2, :]], axis=-1)
+    assert jnp.array_equal(y[:, 0:1, 0:1, :], expected)
+    import pytest
+
+    with pytest.raises(ValueError, match="divisible"):
+        space_to_depth(jnp.ones((1, 5, 4, 3)), 2)
+
+
+def test_resnet50_s2d_stem_shapes_match_plain():
+    # The s2d variant must be output-shape-identical to the plain stem
+    # (the bench A/B compares like against like), differing only in the
+    # stem parameterization: 4x4x12 kernel instead of 7x7x3.
+    plain = ResNet50(num_classes=10, dtype=None)
+    s2d = ResNet50(num_classes=10, dtype=None, s2d_stem=True)
+    x = jnp.ones((2, 64, 64, 3))
+    vp = jax.eval_shape(lambda: plain.init(jax.random.key(0), x, train=False))
+    vs = jax.eval_shape(lambda: s2d.init(jax.random.key(0), x, train=False))
+    op = jax.eval_shape(
+        lambda: plain.apply(
+            plain.init(jax.random.key(0), x, train=False), x, train=False))
+    os_ = jax.eval_shape(
+        lambda: s2d.apply(
+            s2d.init(jax.random.key(0), x, train=False), x, train=False))
+    assert op.shape == os_.shape == (2, 10)
+    kp = vp["params"]["conv_init"]["kernel"]
+    ks = vs["params"]["conv_init_s2d"]["kernel"]
+    assert kp.shape == (7, 7, 3, 64)
+    assert ks.shape == (4, 4, 12, 64)
+    # Everything downstream of the stem is structurally identical.
+    downstream_p = {k for k in vp["params"] if not k.startswith("conv_init")}
+    downstream_s = {k for k in vs["params"] if not k.startswith("conv_init")}
+    assert downstream_p == downstream_s
+
+
+def test_resnet50_s2d_trains():
+    import numpy as np
+    import optax
+
+    model = ResNet50(num_classes=4, num_filters=8, stage_sizes=(1, 1),
+                     dtype=None, s2d_stem=True)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (8, 32, 32, 3)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 4, (8,)).astype(np.int32))
+    variables = model.init(jax.random.key(0), x, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, batch_stats, opt_state):
+        def loss_fn(p):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            return loss, updates["batch_stats"]
+
+        (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        upd, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, upd), bs, opt_state, loss
+
+    first = None
+    for _ in range(10):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first  # the reparameterized stem learns
