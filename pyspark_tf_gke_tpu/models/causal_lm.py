@@ -156,7 +156,8 @@ class CausalSelfAttention(nn.Module):
     @nn.compact
     def __call__(self, hidden, *, decode: bool = False, prefill: bool = False,
                  positions: Optional[jnp.ndarray] = None,
-                 segment_ids: Optional[jnp.ndarray] = None):
+                 segment_ids: Optional[jnp.ndarray] = None,
+                 slot_decode: bool = False):
         cfg = self.cfg
         b, s, _ = hidden.shape
         h, hkv, d = cfg.num_heads, cfg.kv_heads, cfg.head_dim
@@ -193,7 +194,9 @@ class CausalSelfAttention(nn.Module):
         v = nn.with_logical_constraint(v, kv_axes)
 
         if decode:
-            out = self._decode_attend(q, k, v)
+            out = self._decode_attend(
+                q, k, v,
+                row_positions=(positions if slot_decode else None))
         else:
             if prefill:
                 # One full forward fills the whole cache prefix — no
@@ -300,13 +303,36 @@ class CausalSelfAttention(nn.Module):
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, pos, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, pos, 0, 0))
 
+    @staticmethod
+    def _cache_write_rows(cache, pos_b, k, v):
+        """Slot-mode write: k/v [B,s,H,D] land at a DIFFERENT position
+        per row (``pos_b`` [B] int32) — each batch row is an independent
+        request at its own fill level (train/continuous.py). A vmapped
+        per-row dynamic_update_slice costs a scatter instead of the
+        uniform path's one contiguous slice write, which is why the
+        whole-batch path above stays separate."""
+        ck, cv, ks, vs, _ = cache
+        row3 = jax.vmap(
+            lambda buf, val, p: jax.lax.dynamic_update_slice(
+                buf, val, (p, 0, 0)))
+        if ks is not None:
+            k, k_scale = CausalSelfAttention._quantize_kv(k)
+            v, v_scale = CausalSelfAttention._quantize_kv(v)
+            row2 = jax.vmap(
+                lambda buf, val, p: jax.lax.dynamic_update_slice(
+                    buf, val, (p, 0)))
+            ks.value = row2(ks.value, k_scale, pos_b)
+            vs.value = row2(vs.value, v_scale, pos_b)
+        ck.value = row3(ck.value, k, pos_b)
+        cv.value = row3(cv.value, v, pos_b)
+
     def _write_cache_prefix(self, k, v):
         b, s, h, d = k.shape
         cache = self._cache_vars(b, h, d, k.dtype)
         self._cache_write(cache, 0, k, v)
         cache[-1].value = jnp.asarray(s, jnp.int32)
 
-    def _decode_attend(self, q, k, v):
+    def _decode_attend(self, q, k, v, row_positions=None):
         """A decode step against the static-shape KV cache: one token,
         or a CHUNK of s tokens (speculative decoding scores a whole
         draft proposal in one forward). The cache is a flax "cache"
@@ -315,15 +341,25 @@ class CausalSelfAttention(nn.Module):
         unwritten suffix — chunk queries get the causal offset mask
         ``k_pos <= pos + q_idx``. With GQA the grouped einsum reads each
         cached KV head once for its whole query group — the HBM traffic
-        drops by num_heads/kv_heads."""
+        drops by num_heads/kv_heads.
+
+        ``row_positions`` [B, s] switches to slot mode (continuous
+        batching): each row writes at ITS OWN fill level and masks
+        against it; the shared ``cache_index`` advances to the max fill
+        so non-slot readers of the var stay conservative."""
         cfg = self.cfg
         b, s, h, d = q.shape
         hkv = k.shape[2]
         cache = self._cache_vars(b, hkv, d, k.dtype)
         ck, cv, ks, vs, idx = cache
-        pos = idx.value
-        self._cache_write(cache, pos, k, v)
-        idx.value = pos + s
+        if row_positions is not None:
+            pos_b = row_positions[:, 0]                       # [B]
+            self._cache_write_rows(cache, pos_b, k, v)
+            idx.value = jnp.maximum(idx.value, jnp.max(pos_b) + s)
+        else:
+            pos = idx.value
+            self._cache_write(cache, pos, k, v)
+            idx.value = pos + s
 
         # int8 cache: dequantize in-einsum — XLA streams int8 + the tiny
         # [B,S,H] scales from HBM and fuses convert*scale into the
@@ -343,9 +379,15 @@ class CausalSelfAttention(nn.Module):
         q5 = q.reshape(b, s, hkv, g, d)
         scores = jnp.einsum("bqhgd,bkhd->bhgqk", q5, kf,
                             preferred_element_type=jnp.float32) * (d ** -0.5)
-        valid = (jnp.arange(cfg.max_seq_len)[None, :]
-                 <= pos + jnp.arange(s)[:, None])  # [s, S_max]
-        scores = jnp.where(valid[None, None, None, :, :], scores, NEG_INF)
+        k_pos = jnp.arange(cfg.max_seq_len)
+        if row_positions is not None:
+            q_abs = pos_b[:, None] + jnp.arange(s)[None, :]   # [B, s]
+            valid = k_pos[None, None, :] <= q_abs[..., None]  # [B, s, S_max]
+            vmask = valid[:, None, None, :, :]
+        else:
+            valid = k_pos[None, :] <= pos + jnp.arange(s)[:, None]
+            vmask = valid[None, None, None, :, :]             # [s, S_max]
+        scores = jnp.where(vmask, scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
         return out.reshape(b, s, h, d)
@@ -359,6 +401,7 @@ class CausalLMBlock(nn.Module):
     # tracer crashes. Module attributes stay Python bools under remat.
     decode: bool = False
     prefill: bool = False
+    slot_decode: bool = False
 
     @nn.compact
     def __call__(self, hidden, positions=None, segment_ids=None):
@@ -367,6 +410,7 @@ class CausalLMBlock(nn.Module):
         hidden = hidden + CausalSelfAttention(cfg, self.mesh, name="attention")(
             attn_in, decode=self.decode, prefill=self.prefill,
             positions=positions, segment_ids=segment_ids,
+            slot_decode=self.slot_decode,
         )
         mlp_in = _ln(cfg, self.mesh, name="ln_mlp")(hidden)
         if cfg.ffn == "swiglu":
@@ -398,12 +442,23 @@ class CausalLM(nn.Module):
                  positions: Optional[jnp.ndarray] = None,
                  segment_ids: Optional[jnp.ndarray] = None,
                  return_hidden: bool = False,
-                 train: bool = True):
+                 train: bool = True,
+                 slot_decode: bool = False):
         cfg = self.cfg
         if cfg.pos_embedding not in ("learned", "rope"):
             raise ValueError(f"pos_embedding must be 'learned' or 'rope', "
                              f"got {cfg.pos_embedding!r}")
         b, s = input_ids.shape
+        if slot_decode and (not decode or positions is None
+                            or positions.ndim != 2):
+            # slot mode (continuous batching, train/continuous.py): each
+            # batch row is an independent request at its own cache fill
+            # level; positions [B, s] are the per-row authority for the
+            # cache write offset, the attention validity mask AND
+            # wpe/RoPE — an implicit default would desync them.
+            raise ValueError(
+                "slot_decode requires decode=True and explicit "
+                "positions of shape [batch, s]")
         if decode and s > 1 and positions is None:
             # a decode CHUNK (speculative verify) embeds at absolute
             # positions cache_fill..cache_fill+s-1, which only the
@@ -442,9 +497,14 @@ class CausalLM(nn.Module):
         block_cls = CausalLMBlock
         if cfg.remat and not (decode or prefill):
             block_cls = nn.remat(CausalLMBlock, static_argnums=())
-        rope_pos = positions if cfg.pos_embedding == "rope" else None
+        # slot mode needs positions in the attention layer even for
+        # learned-pos models: they are the per-row cache write offset,
+        # not just a RoPE input.
+        rope_pos = (positions if cfg.pos_embedding == "rope" or slot_decode
+                    else None)
         for i in range(cfg.num_layers):
             hidden = block_cls(cfg, self.mesh, decode=decode, prefill=prefill,
+                               slot_decode=slot_decode,
                                name=f"layer_{i}")(hidden, rope_pos,
                                                   segment_ids)
         hidden = _ln(cfg, self.mesh, name="ln_final")(hidden)

@@ -1,0 +1,318 @@
+"""Slot-based continuous batching: the serving plane's request engine.
+
+The reference's serving story is one-at-a-time prediction over a saved
+model (``/root/reference/workloads/raw-tf/test-model.py:13-56``). A real
+serving plane cannot afford that: decode is HBM-bound, so throughput
+comes from keeping every KV-cache slot busy — and requests arrive and
+finish at different times, so a whole-batch ``generate`` (everyone
+enters and exits together, the batch lives as long as its longest
+member) leaves slots idle exactly when load is highest.
+
+This engine is the TPU-idiomatic version of vLLM/TGI-style continuous
+batching, built for XLA's compilation model instead of CUDA kernels:
+
+- **Static shapes everywhere.** A fixed pool of ``num_slots`` KV-cache
+  rows; prompts prefill through a small set of length buckets; decode is
+  ONE compiled program per (model, chunk) regardless of which requests
+  occupy which slots. No recompiles at serve time after warmup.
+- **Per-row cache positions** (``models/causal_lm.py`` ``slot_decode``):
+  each batch row writes K/V at its own fill level and masks attention
+  against it, so row b can be 900 tokens into its answer while row b+1
+  is on token 3 of a fresh request.
+- **Admission at chunk boundaries.** The host loop runs a jitted
+  ``lax.scan`` of ``chunk`` decode steps, then admits queued requests
+  into freed slots (prefill writes the slot's cache rows directly).
+  Through a remote-dispatch link the chunk amortizes per-dispatch
+  latency; on a local TPU host it amortizes Python.
+- **Right-padded bucketed prefill is exact**: causal attention means a
+  real token's K/V and logits never see the padding AFTER it, and pad
+  rows in the cache beyond a slot's fill level are masked by the
+  per-row validity test (``k_pos <= fill``) until real decode tokens
+  overwrite them one by one.
+
+Greedy decoding (the deterministic serving path — parity-tested
+token-for-token against ``models.causal_lm.generate``). Weight-only
+int8 params and int8 KV cache both ride along: prefill dequantizes
+inside its jit, the decode chunk uses the same in-loop barriered
+dequant as ``_decode``, and the per-row cache write quantizes per row.
+
+Single-process engine (one host driving one chip or a tp-sharded mesh
+via module-level jits); the multi-host announce/replay serving wire
+(``train/serving.py``) stays the cross-process surface.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pyspark_tf_gke_tpu.models.causal_lm import CausalLM
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+logger = get_logger("train.continuous")
+
+PAD_BUCKETS = (32, 64, 128, 256, 512, 1024)
+
+
+def bucket_length(n: int, buckets: Sequence[int] = PAD_BUCKETS) -> int:
+    """Smallest bucket >= n (compile-count control: one prefill program
+    per bucket, not per prompt length)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds largest bucket {buckets[-1]}")
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray            # [S_true] int32
+    max_new_tokens: int
+    tokens: List[int] = field(default_factory=list)
+    done: bool = False
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _prefill_padded(model: CausalLM, params, padded_ids, true_len):
+    """Prefill on a right-padded [1, S_bucket] prompt. Returns the full
+    cache and the logits at the LAST REAL token (index true_len-1 —
+    ``_prefill``'s logits[:, -1] would read a pad position). Causality
+    makes the padding invisible to every real position."""
+    from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
+
+    logits, mutated = model.apply(
+        {"params": dequantize_tree(params)}, padded_ids, prefill=True,
+        mutable=["cache"])
+    last = jnp.take_along_axis(
+        logits, (true_len - 1)[None, None, None], axis=1)[:, 0]
+    return mutated["cache"], last
+
+
+@jax.jit
+def _insert_slot(cache, positions, last_logits, live, cache1, logits1,
+                 slot, fill):
+    """Drop a prefilled request into slot ``slot`` (traced scalar — one
+    compiled program serves every slot): cache rows, fill level, carried
+    logits, live flag."""
+    # Scalar leaves are the per-layer `index` fill counters — unused by
+    # slot mode (per-row positions are the authority) but kept
+    # conservative (max) so any non-slot reader of the cache var sees a
+    # safe fill level.
+    cache = jax.tree.map(
+        lambda big, row: (jnp.maximum(big, row) if row.ndim == 0
+                          else big.at[slot].set(row[0])),
+        cache, cache1)
+    return (cache,
+            positions.at[slot].set(fill),
+            last_logits.at[slot].set(logits1[0]),
+            live.at[slot].set(True))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "chunk", "eos_token_id", "pad_id"))
+def _decode_chunk(model: CausalLM, params, cache, positions, last_logits,
+                  live, *, chunk: int, eos_token_id: Optional[int],
+                  pad_id: int):
+    """``chunk`` greedy decode steps for ALL slots in one dispatch.
+
+    Mirrors ``causal_lm._decode``'s emit-then-step order exactly (the
+    parity oracle): emit token t from the carried logits, then run the
+    model at each row's own position to produce logits t+1. Rows that
+    are dead (free slot) or that hit eos keep computing — static shapes
+    — but their positions freeze (no cache growth past the fill level)
+    and their emitted tokens are ``pad_id``."""
+    from pyspark_tf_gke_tpu.ops.quant import (dequantize_embeddings,
+                                              inloop_dequantize,
+                                              is_quantized)
+
+    quantized = is_quantized(params)
+    p = dequantize_embeddings(params) if quantized else params
+
+    def step(carry, _):
+        cache, positions, logits, live = carry
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B]
+        # Emit BEFORE the eos latch drops `live`: the eos token itself
+        # belongs to the output (generate pads WITH eos after it; the
+        # host loop truncates inclusively on it).
+        emitted = jnp.where(live, tok, pad_id)
+        if eos_token_id is not None:
+            live = live & (tok != eos_token_id)
+        # Dead rows replay position 0 with a pad token: static shape,
+        # no position growth, slot cache row 0 is overwritten on the
+        # next admit's prefill anyway.
+        step_tok = jnp.where(live, tok, pad_id)
+        step_pos = jnp.where(live, positions, 0)
+        logits, mutated = model.apply(
+            {"params": inloop_dequantize(p) if quantized else p,
+             "cache": cache},
+            step_tok[:, None], decode=True, slot_decode=True,
+            positions=step_pos[:, None], mutable=["cache"])
+        positions = jnp.where(live, positions + 1, positions)
+        return (mutated["cache"], positions, logits[:, 0], live), emitted
+
+    (cache, positions, last_logits, live), toks = jax.lax.scan(
+        step, (cache, positions, last_logits, live), None, length=chunk)
+    return cache, positions, last_logits, live, toks.T  # [B, chunk]
+
+
+class ContinuousEngine:
+    """Admit requests any time; every free KV slot is refilled at the
+    next chunk boundary. ``submit`` queues, ``run_until_drained`` (or
+    repeated ``step``) decodes; finished requests come back as
+    ``(rid, token_list)``."""
+
+    def __init__(self, model: CausalLM, params, num_slots: int = 8,
+                 chunk: int = 8, eos_token_id: Optional[int] = None,
+                 pad_id: int = 0,
+                 buckets: Sequence[int] = PAD_BUCKETS):
+        if num_slots < 1 or chunk < 1:
+            raise ValueError("num_slots and chunk must be >= 1")
+        self.model, self.params = model, params
+        self.num_slots, self.chunk = num_slots, chunk
+        self.eos_token_id, self.pad_id = eos_token_id, pad_id
+        # Default ladder adapts to the model: every standard bucket that
+        # fits, plus max_seq_len itself as the top bucket — so any
+        # prompt the model can serve (prompt + >=1 new token fits) has a
+        # bucket, and a tiny-context model still gets one. An explicit
+        # ``buckets`` argument is honored as given.
+        s_max = model.cfg.max_seq_len
+        if buckets is PAD_BUCKETS:
+            buckets = tuple(b for b in PAD_BUCKETS if b < s_max) + (s_max,)
+        self.buckets = tuple(b for b in buckets if b <= s_max)
+        if not self.buckets:
+            raise ValueError(
+                f"no prompt bucket fits max_seq_len {s_max}")
+        self._rid = itertools.count()
+        self._queue: List[_Request] = []
+        self._slots: Dict[int, _Request] = {}
+        self._finished: List[_Request] = []
+        self._state = None  # (cache, positions, last_logits, live)
+
+    # -- submission ------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int,
+               ) -> int:
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + max_new_tokens > self.model.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt {prompt.size} + {max_new_tokens} new tokens "
+                f"exceeds max_seq_len {self.model.cfg.max_seq_len}")
+        bucket_length(prompt.size, self.buckets)  # raises if oversized
+        req = _Request(next(self._rid), prompt, max_new_tokens)
+        self._queue.append(req)
+        return req.rid
+
+    def cancel(self, rid: int) -> bool:
+        """Drop a request (abandoned client / front-side timeout): a
+        queued request is removed; an active one frees its KV slot
+        immediately so it stops burning decode steps. Returns True if
+        the request was found."""
+        for i, req in enumerate(self._queue):
+            if req.rid == rid:
+                del self._queue[i]
+                return True
+        for slot, req in list(self._slots.items()):
+            if req.rid == rid:
+                del self._slots[slot]
+                if self._state is not None:
+                    cache, positions, last_logits, live = self._state
+                    self._state = (cache, positions, last_logits,
+                                   live.at[slot].set(False))
+                return True
+        return False
+
+    # -- internals -------------------------------------------------------
+    def _init_state(self, cache1):
+        b, v = self.num_slots, self.model.cfg.vocab_size
+        cache = jax.tree.map(
+            lambda row: (jnp.zeros_like(row) if row.ndim == 0
+                         else jnp.zeros((b,) + row.shape[1:], row.dtype)),
+            cache1)
+        return (cache,
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b, v), jnp.float32),
+                jnp.zeros((b,), bool))
+
+    def _admit(self, slot: int, req: _Request) -> None:
+        sb = bucket_length(req.prompt.size, self.buckets)
+        padded = np.full((1, sb), self.pad_id, np.int32)
+        padded[0, :req.prompt.size] = req.prompt
+        cache1, logits1 = _prefill_padded(
+            self.model, self.params, jnp.asarray(padded),
+            jnp.asarray(req.prompt.size, jnp.int32))
+        if self._state is None:
+            self._state = self._init_state(cache1)
+        cache, positions, last_logits, live = self._state
+        self._state = _insert_slot(
+            cache, positions, last_logits, live, cache1, logits1,
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(req.prompt.size, jnp.int32))
+        self._slots[slot] = req
+
+    def _admit_waiting(self) -> None:
+        free = [s for s in range(self.num_slots) if s not in self._slots]
+        while free and self._queue:
+            self._admit(free.pop(0), self._queue.pop(0))
+
+    # -- the loop --------------------------------------------------------
+    def step(self) -> List[_Request]:
+        """Admit into free slots, run one decode chunk, collect tokens.
+        Returns requests finished during this chunk."""
+        self._admit_waiting()
+        if not self._slots:
+            return []
+        cache, positions, last_logits, live = self._state
+        cache, positions, last_logits, live, toks = _decode_chunk(
+            self.model, self.params, cache, positions, last_logits, live,
+            chunk=self.chunk, eos_token_id=self.eos_token_id,
+            pad_id=self.pad_id)
+        self._state = (cache, positions, last_logits, live)
+        toks = np.asarray(toks)
+        live_host = np.asarray(live)
+        newly_done = []
+        for slot, req in list(self._slots.items()):
+            budget = req.max_new_tokens - len(req.tokens)
+            take = toks[slot, :budget]
+            if self.eos_token_id is not None:
+                hit = np.nonzero(take == self.eos_token_id)[0]
+                if hit.size:
+                    take = take[:hit[0] + 1]
+            req.tokens.extend(int(t) for t in take)
+            eos_done = (self.eos_token_id is not None
+                        and not live_host[slot])
+            if eos_done or len(req.tokens) >= req.max_new_tokens:
+                req.done = True
+                newly_done.append(req)
+                del self._slots[slot]
+                # slot's live flag must drop so its rows stop advancing
+                _, _, _, live_arr = self._state
+                self._state = self._state[:3] + (
+                    live_arr.at[slot].set(False),)
+        self._finished.extend(newly_done)
+        return newly_done
+
+    def run_until_drained(self):
+        """Drive steps until queue + slots are empty; yields finished
+        requests in completion order."""
+        while self._queue or self._slots:
+            for req in self.step():
+                yield req.rid, req.tokens
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "queued": len(self._queue),
+            "active": len(self._slots),
+            "finished": len(self._finished),
+            "num_slots": self.num_slots,
+            "chunk": self.chunk,
+        }

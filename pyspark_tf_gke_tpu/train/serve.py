@@ -68,6 +68,96 @@ def _bucket(n: int, cap: int) -> int:
     return min(-(-n // SCORE_BUCKET) * SCORE_BUCKET, cap)
 
 
+class _ContinuousFront:
+    """Thread front for the slot engine (train/continuous.py): ONE
+    driver thread owns the device loop; HTTP handler threads submit
+    token prompts and block on a per-request event. Requests admitted
+    into KV slots as they free up — a long completion no longer stalls
+    the short ones behind it (the whole-batch path's failure mode)."""
+
+    def __init__(self, model, params, eos_id, num_slots: int,
+                 chunk: int):
+        self._engine_args = (model, params, eos_id, num_slots, chunk)
+        self.engine = self._new_engine()
+        self.lock = threading.Lock()
+        self.new_work = threading.Event()
+        self.stop = threading.Event()
+        self._results = {}  # rid -> [threading.Event, tokens|None]
+        self.thread = threading.Thread(
+            target=self._loop, name="continuous-engine", daemon=True)
+        self.thread.start()
+
+    def _new_engine(self):
+        from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
+
+        model, params, eos_id, num_slots, chunk = self._engine_args
+        return ContinuousEngine(model, params, num_slots=num_slots,
+                                chunk=chunk, eos_token_id=eos_id)
+
+    def submit_and_wait(self, prompt_ids, max_new_tokens: int,
+                        timeout_s: float = 600.0):
+        done = threading.Event()
+        with self.lock:
+            rid = self.engine.submit(prompt_ids, max_new_tokens)
+            self._results[rid] = [done, None]
+        self.new_work.set()
+        if not done.wait(timeout_s):
+            with self.lock:
+                # free the KV slot too — an abandoned request must not
+                # keep decoding tokens nobody will read (overload would
+                # otherwise starve the very queue that caused the
+                # timeout)
+                self.engine.cancel(rid)
+                self._results.pop(rid, None)
+            raise RuntimeError(
+                f"continuous decode timed out after {timeout_s}s")
+        with self.lock:
+            result = self._results.pop(rid)[1]
+        if isinstance(result, Exception):
+            raise RuntimeError(
+                f"continuous engine failed this request: {result}")
+        return result
+
+    def _loop(self):
+        while not self.stop.is_set():
+            busy = False
+            with self.lock:
+                try:
+                    stats = self.engine.stats
+                    busy = bool(stats["active"] or stats["queued"])
+                    finished = self.engine.step() if busy else []
+                    for req in finished:
+                        slot = self._results.get(req.rid)
+                        if slot is not None:
+                            slot[1] = req.tokens
+                            slot[0].set()
+                except Exception as exc:  # noqa: BLE001 — driver thread
+                    # One failed step must not brick serving: the engine
+                    # state may be mid-chunk garbage, so fail every
+                    # in-flight request LOUDLY and rebuild the engine —
+                    # later requests get a fresh slot pool.
+                    logger.exception(
+                        "continuous engine step failed; failing %d "
+                        "in-flight request(s) and rebuilding the engine",
+                        len(self._results))
+                    for slot in self._results.values():
+                        if slot[1] is None:
+                            slot[1] = exc
+                            slot[0].set()
+                    self.engine = self._new_engine()
+                    busy = False
+            if not busy:
+                # idle: park until a submit wakes us (short timeout so
+                # shutdown stays prompt)
+                self.new_work.wait(0.05)
+                self.new_work.clear()
+
+    def shutdown(self):
+        self.stop.set()
+        self.new_work.set()
+        self.thread.join(timeout=10)
+
+
 class BundleServer:
     """Loads a serving bundle and answers generate/score requests.
 
@@ -76,7 +166,8 @@ class BundleServer:
     context (XLA inserts the collectives)."""
 
     def __init__(self, bundle_dir: str, mesh=None, int8_kv: bool = False,
-                 draft_bundle_dir: str = ""):
+                 draft_bundle_dir: str = "", continuous_slots: int = 0,
+                 continuous_chunk: int = 8):
         from pyspark_tf_gke_tpu.data.text import get_tokenizer
         from pyspark_tf_gke_tpu.train.export import load_serving_bundle
 
@@ -128,6 +219,21 @@ class BundleServer:
             raise ValueError("multi-host serving needs a mesh spanning "
                              "all processes (set --tp / SERVE_TP)")
         self._lock = threading.Lock()  # one model, one device queue
+        self._front = None
+        if continuous_slots:
+            if self.multi_host:
+                # the announce/replay wire serializes whole requests; a
+                # slot engine would need per-chunk announces — not built
+                raise ValueError(
+                    "--continuous-slots is single-host only")
+            if mesh is not None:
+                raise ValueError(
+                    "--continuous-slots currently requires no tp mesh "
+                    "(the engine's jits run un-meshed)")
+            self._front = _ContinuousFront(
+                self.model, self.params,
+                eos_id=getattr(self.tokenizer, "eos_id", None),
+                num_slots=continuous_slots, chunk=continuous_chunk)
 
     # -- health ----------------------------------------------------------
 
@@ -144,6 +250,8 @@ class BundleServer:
             "processes": jax.process_count(),
             "tp": dict(self.mesh.shape).get("tp", 1) if self.mesh else 1,
             "speculative_draft": self.draft_bundle_dir or None,
+            "continuous": (self._front.engine.stats
+                           if self._front is not None else None),
         }
 
     # -- generation ------------------------------------------------------
@@ -182,15 +290,40 @@ class BundleServer:
                     f"exceeds max_seq_len {cfg.max_seq_len}")
             encoded.append((i, ids))
 
-        use_spec = (self.draft_model is not None and len(prompts) == 1
-                    and not (temperature and temperature > 0)
-                    and not num_beams and repetition_penalty is None
-                    and top_k is None and top_p is None
-                    # a shorter draft context falls back to plain decode
-                    # rather than erroring a request the target can serve
-                    and len(encoded[0][1]) + max_new_tokens
-                    <= self.draft_model.cfg.max_seq_len)
-        if use_spec:
+        plain_greedy = (not (temperature and temperature > 0)
+                        and not num_beams and repetition_penalty is None
+                        and top_k is None and top_p is None)
+        # Routing order for plain-greedy traffic: speculative (when a
+        # draft is configured AND its context fits this request) →
+        # continuous slot engine → whole-batch. The draft-context check
+        # lives HERE so a too-long-for-the-draft request still gets the
+        # slot engine instead of a solo whole-batch call.
+        could_spec = (self.draft_model is not None and len(prompts) == 1
+                      and plain_greedy
+                      and len(encoded[0][1]) + max_new_tokens
+                      <= self.draft_model.cfg.max_seq_len)
+        if self._front is not None and plain_greedy and not could_spec:
+            # slot engine: each prompt is its own request — they share
+            # KV slots with every OTHER in-flight HTTP request, and a
+            # short completion returns without waiting for a long one.
+            t0 = time.perf_counter()
+            waits = [(i, ids) for i, ids in encoded]
+            toks = {}
+            import concurrent.futures as _fut
+
+            with _fut.ThreadPoolExecutor(
+                    max_workers=max(len(waits), 1)) as pool:
+                futs = {
+                    i: pool.submit(self._front.submit_and_wait, ids,
+                                   max_new_tokens)
+                    for i, ids in waits}
+                for i, fut in futs.items():
+                    toks[i] = fut.result()
+            dt = (time.perf_counter() - t0) * 1000.0
+            return [self._entry(prompts[i], toks[i], dt, eos_id)
+                    for i, _ in waits]
+
+        if could_spec:
             _, ids = encoded[0]
             from pyspark_tf_gke_tpu.train.serving import mh_speculative
 
@@ -444,6 +577,16 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="a smaller bundle (same tokenizer/vocab) used as "
                         "the speculative-decoding draft for single-prompt "
                         "greedy requests — identical tokens, lower latency")
+    p.add_argument("--continuous-slots", type=int,
+                   default=int(e("CONTINUOUS_SLOTS", "0")),
+                   help="enable continuous batching with this many KV "
+                        "slots (0 = whole-batch serving). Greedy "
+                        "requests from ALL connections share the slot "
+                        "pool; single-host, no tp")
+    p.add_argument("--continuous-chunk", type=int,
+                   default=int(e("CONTINUOUS_CHUNK", "8")),
+                   help="decode steps per engine dispatch between "
+                        "admission points")
     p.add_argument("--stdin", action="store_true",
                    help="serve stdin lines instead of HTTP: each input "
                         "line is a prompt, each output line a JSON result")
@@ -506,7 +649,9 @@ def main(argv=None) -> int:
     server = BundleServer(
         _resolve_bundle(args.bundle), mesh=mesh, int8_kv=args.int8_kv,
         draft_bundle_dir=(_resolve_bundle(args.draft_bundle)
-                          if args.draft_bundle else ""))
+                          if args.draft_bundle else ""),
+        continuous_slots=args.continuous_slots,
+        continuous_chunk=args.continuous_chunk)
     logger.info("bundle loaded: %s", server.health())
     if jax.process_count() > 1:
         # fail a misdeploy (draft bundle on some processes only) at
@@ -559,6 +704,8 @@ def main(argv=None) -> int:
             httpd.shutdown()
         return 0
     finally:
+        if server._front is not None:
+            server._front.shutdown()
         if jax.process_count() > 1:
             from pyspark_tf_gke_tpu.train.serving import announce_shutdown
 
