@@ -170,10 +170,17 @@ class ContinuousEngine:
     def __init__(self, model: CausalLM, params, num_slots: int = 8,
                  chunk: int = 8, eos_token_id: Optional[int] = None,
                  pad_id: int = 0,
-                 buckets: Sequence[int] = PAD_BUCKETS):
+                 buckets: Sequence[int] = PAD_BUCKETS,
+                 mesh=None):
         if num_slots < 1 or chunk < 1:
             raise ValueError("num_slots and chunk must be >= 1")
         self.model, self.params = model, params
+        # tp serving: ``params`` should already be placed
+        # (shard_params_for_serving); entering the mesh context around
+        # the jits lets the model's logical constraints resolve, exactly
+        # as serve_generate does. Single-process meshes only (the
+        # multi-host announce/replay wire serializes whole requests).
+        self.mesh = mesh
         self.num_slots, self.chunk = num_slots, chunk
         self.eos_token_id, self.pad_id = eos_token_id, pad_id
         # Default ladder adapts to the model: every standard bucket that
@@ -191,7 +198,8 @@ class ContinuousEngine:
         self._rid = itertools.count()
         self._queue: List[_Request] = []
         self._slots: Dict[int, _Request] = {}
-        self._finished: List[_Request] = []
+        self._n_finished = 0  # counter, not a list: a
+        # long-lived server must not retain every prompt it ever served
         self._state = None  # (cache, positions, last_logits, live)
 
     # -- submission ------------------------------------------------------
@@ -242,20 +250,27 @@ class ContinuousEngine:
                 jnp.zeros((b, v), jnp.float32),
                 jnp.zeros((b,), bool))
 
+    def _mesh_ctx(self):
+        import contextlib
+
+        return self.mesh if self.mesh is not None else (
+            contextlib.nullcontext())
+
     def _admit(self, slot: int, req: _Request) -> None:
         sb = bucket_length(req.prompt.size, self.buckets)
         padded = np.full((1, sb), self.pad_id, np.int32)
         padded[0, :req.prompt.size] = req.prompt
-        cache1, logits1 = _prefill_padded(
-            self.model, self.params, jnp.asarray(padded),
-            jnp.asarray(req.prompt.size, jnp.int32))
-        if self._state is None:
-            self._state = self._init_state(cache1)
-        cache, positions, last_logits, live = self._state
-        self._state = _insert_slot(
-            cache, positions, last_logits, live, cache1, logits1,
-            jnp.asarray(slot, jnp.int32),
-            jnp.asarray(req.prompt.size, jnp.int32))
+        with self._mesh_ctx():
+            cache1, logits1 = _prefill_padded(
+                self.model, self.params, jnp.asarray(padded),
+                jnp.asarray(req.prompt.size, jnp.int32))
+            if self._state is None:
+                self._state = self._init_state(cache1)
+            cache, positions, last_logits, live = self._state
+            self._state = _insert_slot(
+                cache, positions, last_logits, live, cache1, logits1,
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(req.prompt.size, jnp.int32))
         self._slots[slot] = req
 
     def _admit_waiting(self) -> None:
@@ -271,10 +286,11 @@ class ContinuousEngine:
         if not self._slots:
             return []
         cache, positions, last_logits, live = self._state
-        cache, positions, last_logits, live, toks = _decode_chunk(
-            self.model, self.params, cache, positions, last_logits, live,
-            chunk=self.chunk, eos_token_id=self.eos_token_id,
-            pad_id=self.pad_id)
+        with self._mesh_ctx():
+            cache, positions, last_logits, live, toks = _decode_chunk(
+                self.model, self.params, cache, positions, last_logits,
+                live, chunk=self.chunk, eos_token_id=self.eos_token_id,
+                pad_id=self.pad_id)
         self._state = (cache, positions, last_logits, live)
         toks = np.asarray(toks)
         live_host = np.asarray(live)
@@ -297,7 +313,7 @@ class ContinuousEngine:
                 _, _, _, live_arr = self._state
                 self._state = self._state[:3] + (
                     live_arr.at[slot].set(False),)
-        self._finished.extend(newly_done)
+        self._n_finished += len(newly_done)
         return newly_done
 
     def run_until_drained(self):
@@ -312,7 +328,7 @@ class ContinuousEngine:
         return {
             "queued": len(self._queue),
             "active": len(self._slots),
-            "finished": len(self._finished),
+            "finished": self._n_finished,
             "num_slots": self.num_slots,
             "chunk": self.chunk,
         }

@@ -76,8 +76,9 @@ class _ContinuousFront:
     the short ones behind it (the whole-batch path's failure mode)."""
 
     def __init__(self, model, params, eos_id, num_slots: int,
-                 chunk: int):
-        self._engine_args = (model, params, eos_id, num_slots, chunk)
+                 chunk: int, mesh=None):
+        self._engine_args = (model, params, eos_id, num_slots, chunk,
+                             mesh)
         self.engine = self._new_engine()
         self.lock = threading.Lock()
         self.new_work = threading.Event()
@@ -90,17 +91,26 @@ class _ContinuousFront:
     def _new_engine(self):
         from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
 
-        model, params, eos_id, num_slots, chunk = self._engine_args
+        model, params, eos_id, num_slots, chunk, mesh = self._engine_args
         return ContinuousEngine(model, params, num_slots=num_slots,
-                                chunk=chunk, eos_token_id=eos_id)
+                                chunk=chunk, eos_token_id=eos_id,
+                                mesh=mesh)
 
-    def submit_and_wait(self, prompt_ids, max_new_tokens: int,
-                        timeout_s: float = 600.0):
+    def submit(self, prompt_ids, max_new_tokens: int) -> int:
+        """Queue a request (non-blocking); pair with ``wait``."""
         done = threading.Event()
         with self.lock:
             rid = self.engine.submit(prompt_ids, max_new_tokens)
             self._results[rid] = [done, None]
         self.new_work.set()
+        return rid
+
+    def wait(self, rid: int, timeout_s: float = 600.0):
+        with self.lock:
+            entry = self._results.get(rid)
+        if entry is None:
+            raise KeyError(f"unknown or already-collected request {rid}")
+        done = entry[0]
         if not done.wait(timeout_s):
             with self.lock:
                 # free the KV slot too — an abandoned request must not
@@ -117,6 +127,11 @@ class _ContinuousFront:
             raise RuntimeError(
                 f"continuous engine failed this request: {result}")
         return result
+
+    def submit_and_wait(self, prompt_ids, max_new_tokens: int,
+                        timeout_s: float = 600.0):
+        return self.wait(self.submit(prompt_ids, max_new_tokens),
+                         timeout_s)
 
     def _loop(self):
         while not self.stop.is_set():
@@ -226,14 +241,11 @@ class BundleServer:
                 # slot engine would need per-chunk announces — not built
                 raise ValueError(
                     "--continuous-slots is single-host only")
-            if mesh is not None:
-                raise ValueError(
-                    "--continuous-slots currently requires no tp mesh "
-                    "(the engine's jits run un-meshed)")
             self._front = _ContinuousFront(
                 self.model, self.params,
                 eos_id=getattr(self.tokenizer, "eos_id", None),
-                num_slots=continuous_slots, chunk=continuous_chunk)
+                num_slots=continuous_slots, chunk=continuous_chunk,
+                mesh=mesh)
 
     # -- health ----------------------------------------------------------
 
@@ -307,21 +319,15 @@ class BundleServer:
             # KV slots with every OTHER in-flight HTTP request, and a
             # short completion returns without waiting for a long one.
             t0 = time.perf_counter()
-            waits = [(i, ids) for i, ids in encoded]
-            toks = {}
-            import concurrent.futures as _fut
-
-            with _fut.ThreadPoolExecutor(
-                    max_workers=max(len(waits), 1)) as pool:
-                futs = {
-                    i: pool.submit(self._front.submit_and_wait, ids,
-                                   max_new_tokens)
-                    for i, ids in waits}
-                for i, fut in futs.items():
-                    toks[i] = fut.result()
+            # submit everything first (non-blocking — they co-occupy
+            # slots), then collect in order; no thread pool needed to
+            # block on events.
+            rids = [(i, self._front.submit(ids, max_new_tokens))
+                    for i, ids in encoded]
+            toks = {i: self._front.wait(rid) for i, rid in rids}
             dt = (time.perf_counter() - t0) * 1000.0
             return [self._entry(prompts[i], toks[i], dt, eos_id)
-                    for i, _ in waits]
+                    for i, _ in rids]
 
         if could_spec:
             _, ids = encoded[0]

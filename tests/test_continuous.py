@@ -25,9 +25,11 @@ def _tiny_model(pos="rope", kv_quant=False, vocab=97):
         vocab_size=vocab, hidden_size=32, num_layers=2, num_heads=4,
         num_kv_heads=2, intermediate_size=64, max_seq_len=128,
         pos_embedding=pos, kv_cache_quant=kv_quant)
+    from flax import linen as nn
+
     model = CausalLM(cfg)
     ids = jnp.ones((1, 8), jnp.int32)
-    params = model.init(jax.random.key(0), ids)["params"]
+    params = nn.meta.unbox(model.init(jax.random.key(0), ids)["params"])
     return model, params
 
 
@@ -173,3 +175,24 @@ def test_cancel_frees_queued_and_active():
     rid = eng.submit(rng.integers(1, 97, 8), max_new_tokens=5)
     results = dict(eng.run_until_drained())
     assert len(results[rid]) == 5
+
+
+def test_tp_mesh_parity():
+    # tp=2 sharded params through the engine must produce the same
+    # tokens as the unsharded single-device run (the serve --tp path).
+    from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
+    from pyspark_tf_gke_tpu.train.serving import shard_params_for_serving
+
+    model, params = _tiny_model()
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(1, 97, 9), rng.integers(1, 97, 17)]
+    expected = [_reference_tokens(model, params, p, 6) for p in prompts]
+
+    mesh = make_mesh({"tp": 2}, jax.devices()[:2])
+    sharded = shard_params_for_serving(model, params, mesh)
+    eng = ContinuousEngine(model, sharded, num_slots=2, chunk=3,
+                           buckets=(16, 32), mesh=mesh)
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    results = dict(eng.run_until_drained())
+    for rid, exp in zip(rids, expected):
+        assert results[rid] == exp
