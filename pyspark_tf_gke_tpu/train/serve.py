@@ -133,6 +133,13 @@ class _ContinuousFront:
         return self.wait(self.submit(prompt_ids, max_new_tokens),
                          timeout_s)
 
+    def abandon(self, rid: int) -> None:
+        """Give up on a submitted request: free its KV slot / queue spot
+        and drop its results entry (idempotent)."""
+        with self.lock:
+            self.engine.cancel(rid)
+            self._results.pop(rid, None)
+
     def _loop(self):
         while not self.stop.is_set():
             busy = False
@@ -234,6 +241,20 @@ class BundleServer:
             raise ValueError("multi-host serving needs a mesh spanning "
                              "all processes (set --tp / SERVE_TP)")
         self._lock = threading.Lock()  # one model, one device queue
+        # operational counters for /metrics (Prometheus text format —
+        # what the reference world's kubectl-top/metrics-server loop
+        # becomes when the server itself is first-party,
+        # /root/reference/infra/local/external_workloads/README.md
+        # kubectl-top pattern)
+        self._metrics_lock = threading.Lock()
+        self._metrics = {
+            "requests_total": 0,       # by endpoint outcome below
+            "requests_failed_total": 0,
+            "generate_tokens_total": 0,
+            "generate_latency_ms_sum": 0.0,
+            "generate_requests_total": 0,
+            "score_requests_total": 0,
+        }
         self._front = None
         if continuous_slots:
             if self.multi_host:
@@ -324,7 +345,18 @@ class BundleServer:
             # block on events.
             rids = [(i, self._front.submit(ids, max_new_tokens))
                     for i, ids in encoded]
-            toks = {i: self._front.wait(rid) for i, rid in rids}
+            toks = {}
+            try:
+                for i, rid in rids:
+                    toks[i] = self._front.wait(rid)
+            except Exception:
+                # one failed wait must not leak its siblings: cancel
+                # every uncollected request (frees KV slots + results
+                # entries) before surfacing the error as this HTTP 500
+                for i, rid in rids:
+                    if i not in toks:
+                        self._front.abandon(rid)
+                raise
             dt = (time.perf_counter() - t0) * 1000.0
             return [self._entry(prompts[i], toks[i], dt, eos_id)
                     for i, _ in rids]
@@ -409,6 +441,43 @@ class BundleServer:
                     results[i] = self._entry(prompts[i], toks[row].tolist(),
                                              dt, eos_id, **extra)
         return results
+
+    def record_metrics(self, *, generate_entries=None, score: bool = False,
+                       failed: bool = False) -> None:
+        """Fold one request into the counters (handler-thread safe)."""
+        with self._metrics_lock:
+            self._metrics["requests_total"] += 1
+            if failed:
+                self._metrics["requests_failed_total"] += 1
+            if score:
+                self._metrics["score_requests_total"] += 1
+            if generate_entries:
+                self._metrics["generate_requests_total"] += 1
+                self._metrics["generate_tokens_total"] += sum(
+                    e.get("new_tokens", 0) for e in generate_entries)
+                self._metrics["generate_latency_ms_sum"] += max(
+                    (e.get("latency_ms", 0.0) for e in generate_entries),
+                    default=0.0)
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition text: counters + live engine gauges."""
+        with self._metrics_lock:
+            snap = dict(self._metrics)
+        lines = []
+        for key, val in snap.items():
+            name = f"pyspark_tf_gke_tpu_serve_{key}"
+            kind = "counter" if key.endswith("_total") or \
+                key.endswith("_sum") else "gauge"
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {val}")
+        if self._front is not None:
+            stats = self._front.engine.stats
+            for key in ("queued", "active", "finished", "num_slots"):
+                name = f"pyspark_tf_gke_tpu_serve_continuous_{key}"
+                kind = "counter" if key == "finished" else "gauge"
+                lines.append(f"# TYPE {name} {kind}")
+                lines.append(f"{name} {stats[key]}")
+        return "\n".join(lines) + "\n"
 
     def _entry(self, prompt, new_tokens, dt_ms, eos_id, **extra) -> dict:
         """Shared response assembly: eos truncation + decode back to
@@ -496,6 +565,14 @@ def _make_handler(server: BundleServer):
         def do_GET(self):
             if self.path in ("/healthz", "/health", "/"):
                 self._reply(200, server.health())
+            elif self.path == "/metrics":
+                body = server.metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -520,6 +597,7 @@ def _make_handler(server: BundleServer):
                         prompts = [req["prompt"]]
                     if not isinstance(prompts, list) or not all(
                             isinstance(p, str) for p in prompts or [None]):
+                        server.record_metrics(failed=True)
                         return self._reply(
                             400, {"error": "'prompts' must be a list of "
                                            "strings (or 'prompt': str)"})
@@ -531,23 +609,30 @@ def _make_handler(server: BundleServer):
                         top_p=req.get("top_p"),
                         num_beams=int(req.get("num_beams", 0)),
                         repetition_penalty=req.get("repetition_penalty"))
+                    server.record_metrics(generate_entries=out)
                     self._reply(200, {"completions": out})
                 elif self.path == "/v1/score":
                     texts = req.get("texts")
                     if not isinstance(texts, list) or not all(
                             isinstance(t, str) for t in texts or [None]):
+                        server.record_metrics(failed=True)
                         return self._reply(
                             400, {"error": "'texts' must be a list of "
                                            "strings"})
-                    self._reply(200, {"scores": server.score(texts)})
+                    scores = server.score(texts)
+                    server.record_metrics(score=True)
+                    self._reply(200, {"scores": scores})
                 else:
+                    server.record_metrics(failed=True)
                     self._reply(404, {"error": f"unknown path {self.path}"})
             except (TypeError, ValueError) as exc:
                 # TypeError too: int(None)/float([]) from JSON null/list
                 # field values is caller error, not a server fault
+                server.record_metrics(failed=True)
                 self._reply(400, {"error": str(exc)})
             except Exception as exc:  # noqa: BLE001 — keep the server up
                 logger.exception("request failed")
+                server.record_metrics(failed=True)
                 self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})
 
     return Handler

@@ -371,3 +371,30 @@ def test_continuous_front_engine_failure_unit(tmp_path):
         assert len(toks) == 4
     finally:
         front.shutdown()
+
+
+def test_metrics_endpoint(cb_endpoints):
+    plain_url, cont_url = cb_endpoints
+    _post(plain_url, "/v1/generate", {"prompts": ["zz"],
+                                      "max_new_tokens": 3})
+    _post(plain_url, "/v1/score", {"texts": ["zz"]})
+    try:
+        _post(plain_url, "/v1/generate", {"prompts": ["ok"],
+                                          "max_new_tokens": None})
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 400
+    with urllib.request.urlopen(plain_url + "/metrics") as resp:
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    metrics = {ln.split()[0]: float(ln.split()[1])
+               for ln in text.splitlines() if ln and not ln.startswith("#")}
+    pre = "pyspark_tf_gke_tpu_serve_"
+    assert metrics[pre + "generate_requests_total"] >= 1
+    assert metrics[pre + "generate_tokens_total"] >= 3
+    assert metrics[pre + "score_requests_total"] >= 1
+    assert metrics[pre + "requests_failed_total"] >= 1
+    assert metrics[pre + "generate_latency_ms_sum"] > 0
+    # the continuous server additionally exposes engine gauges
+    with urllib.request.urlopen(cont_url + "/metrics") as resp:
+        ctext = resp.read().decode()
+    assert pre + "continuous_num_slots 2" in ctext
