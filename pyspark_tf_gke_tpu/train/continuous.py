@@ -95,6 +95,25 @@ def _prefill_padded(model: CausalLM, params, padded_ids, true_len):
 
 
 @jax.jit
+def _clear_live(live, slot):
+    return live.at[slot].set(False)
+
+
+@functools.partial(jax.jit, static_argnames=("num_slots", "vocab"))
+def _zeros_state(cache1, *, num_slots: int, vocab: int):
+    """Fresh slot-pool state shaped after one prefill's cache tree."""
+    b = num_slots
+    cache = jax.tree.map(
+        lambda row: (jnp.zeros_like(row) if row.ndim == 0
+                     else jnp.zeros((b,) + row.shape[1:], row.dtype)),
+        cache1)
+    return (cache,
+            jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b, vocab), jnp.float32),
+            jnp.zeros((b,), bool))
+
+
+@jax.jit
 def _insert_slot(cache, positions, last_logits, live, cache1, logits1,
                  slot, fill):
     """Drop a prefilled request into slot ``slot`` (traced scalar — one
@@ -161,26 +180,112 @@ def _decode_chunk(model: CausalLM, params, cache, positions, last_logits,
     return cache, positions, last_logits, live, toks.T  # [B, chunk]
 
 
+class SlotDeviceState:
+    """The engine's DEVICE half: the slot arrays plus the three
+    replayable ops that mutate them (admit / chunk / free). Split from
+    the host-side bookkeeping so multi-host serving can run the exact
+    same op sequence on every process: process 0's engine announces
+    each op over the serving wire and the workers' ``serve_worker_loop``
+    replays it into their own ``SlotDeviceState`` — identical inputs in
+    identical order is the whole SPMD contract.
+
+    The chunk op ends with ``as_host_array`` gathers on the emitted
+    tokens and live flags. That is a collective on multi-process meshes,
+    so it is INSIDE the replayed op (every process participates), not a
+    process-0 afterthought."""
+
+    def __init__(self, model: CausalLM, params, num_slots: int,
+                 mesh=None):
+        self.model, self.params = model, params
+        self.num_slots = num_slots
+        self.mesh = mesh
+        self.state = None  # (cache, positions, last_logits, live)
+
+    def _mesh_ctx(self):
+        import contextlib
+
+        return self.mesh if self.mesh is not None else (
+            contextlib.nullcontext())
+
+    def _init_state(self, cache1):
+        # Inside a jit (under the caller's mesh context) so the zeros
+        # come out as GLOBAL arrays on multi-process meshes — eager
+        # jnp.zeros would commit to local devices and refuse to mix
+        # with the mesh-spanning prefill outputs.
+        return _zeros_state(cache1, num_slots=self.num_slots,
+                            vocab=self.model.cfg.vocab_size)
+
+    def admit_padded(self, padded: np.ndarray, true_len: int,
+                     slot: int) -> None:
+        """Prefill a right-padded [1, S_bucket] prompt and insert it
+        into ``slot`` at fill level ``true_len``."""
+        with self._mesh_ctx():
+            cache1, logits1 = _prefill_padded(
+                self.model, self.params, jnp.asarray(padded),
+                jnp.asarray(true_len, jnp.int32))
+            if self.state is None:
+                self.state = self._init_state(cache1)
+            cache, positions, last_logits, live = self.state
+            self.state = _insert_slot(
+                cache, positions, last_logits, live, cache1, logits1,
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(true_len, jnp.int32))
+
+    def chunk(self, chunk: int, eos_token_id: Optional[int],
+              pad_id: int):
+        """One decode chunk over all slots. Returns host-readable
+        (tokens [B, chunk], live [B]) — gathered on multi-process
+        meshes so every process can read them."""
+        from pyspark_tf_gke_tpu.parallel.distributed import as_host_array
+
+        cache, positions, last_logits, live = self.state
+        with self._mesh_ctx():
+            cache, positions, last_logits, live, toks = _decode_chunk(
+                self.model, self.params, cache, positions, last_logits,
+                live, chunk=chunk, eos_token_id=eos_token_id,
+                pad_id=pad_id)
+            self.state = (cache, positions, last_logits, live)
+            toks_host = np.asarray(as_host_array(toks))
+            live_host = np.asarray(as_host_array(live))
+        return toks_host, live_host
+
+    def free(self, slot: int) -> None:
+        """Drop a slot's live flag (request finished or cancelled)."""
+        if self.state is None:
+            return
+        with self._mesh_ctx():
+            cache, positions, last_logits, live = self.state
+            # jitted (not eager .at) so the update runs SPMD on global
+            # multi-process arrays like every other replayed op
+            self.state = (cache, positions, last_logits,
+                          _clear_live(live, jnp.asarray(slot, jnp.int32)))
+
+
 class ContinuousEngine:
     """Admit requests any time; every free KV slot is refilled at the
     next chunk boundary. ``submit`` queues, ``run_until_drained`` (or
     repeated ``step``) decodes; finished requests come back as
-    ``(rid, token_list)``."""
+    ``(rid, token_list)``.
+
+    ``announce=True`` (multi-host serving, process 0 only): every
+    device op is announced over the serving wire BEFORE it runs, under
+    the announce lock, so worker processes replay the identical op
+    stream — see ``train/serving.py`` OP_CB_*."""
 
     def __init__(self, model: CausalLM, params, num_slots: int = 8,
                  chunk: int = 8, eos_token_id: Optional[int] = None,
                  pad_id: int = 0,
                  buckets: Sequence[int] = PAD_BUCKETS,
-                 mesh=None):
+                 mesh=None, announce: bool = False):
         if num_slots < 1 or chunk < 1:
             raise ValueError("num_slots and chunk must be >= 1")
         self.model, self.params = model, params
         # tp serving: ``params`` should already be placed
         # (shard_params_for_serving); entering the mesh context around
         # the jits lets the model's logical constraints resolve, exactly
-        # as serve_generate does. Single-process meshes only (the
-        # multi-host announce/replay wire serializes whole requests).
+        # as serve_generate does.
         self.mesh = mesh
+        self.announce = announce
         self.num_slots, self.chunk = num_slots, chunk
         self.eos_token_id, self.pad_id = eos_token_id, pad_id
         # Default ladder adapts to the model: every standard bucket that
@@ -200,7 +305,7 @@ class ContinuousEngine:
         self._slots: Dict[int, _Request] = {}
         self._n_finished = 0  # counter, not a list: a
         # long-lived server must not retain every prompt it ever served
-        self._state = None  # (cache, positions, last_logits, live)
+        self._device = SlotDeviceState(model, params, num_slots, mesh)
 
     # -- submission ------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
@@ -231,46 +336,40 @@ class ContinuousEngine:
         for slot, req in list(self._slots.items()):
             if req.rid == rid:
                 del self._slots[slot]
-                if self._state is not None:
-                    cache, positions, last_logits, live = self._state
-                    self._state = (cache, positions, last_logits,
-                                   live.at[slot].set(False))
+                self._free_slot(slot)
                 return True
         return False
 
     # -- internals -------------------------------------------------------
-    def _init_state(self, cache1):
-        b, v = self.num_slots, self.model.cfg.vocab_size
-        cache = jax.tree.map(
-            lambda row: (jnp.zeros_like(row) if row.ndim == 0
-                         else jnp.zeros((b,) + row.shape[1:], row.dtype)),
-            cache1)
-        return (cache,
-                jnp.zeros((b,), jnp.int32),
-                jnp.zeros((b, v), jnp.float32),
-                jnp.zeros((b,), bool))
+    def _announced(self, announce_thunk, device_thunk):
+        """THE multi-host invariant, in one place: announce the op and
+        run its device work under one hold of the announce lock (the
+        workers execute ops in announce order, so process 0's device
+        work must happen in that same order); single-host skips
+        straight to the device work."""
+        if not self.announce:
+            return device_thunk()
+        from pyspark_tf_gke_tpu.train import serving
 
-    def _mesh_ctx(self):
-        import contextlib
+        with serving.mh_lock():
+            announce_thunk(serving)
+            return device_thunk()
 
-        return self.mesh if self.mesh is not None else (
-            contextlib.nullcontext())
+    def _free_slot(self, slot: int) -> None:
+        self._announced(
+            lambda wire: wire.announce_cb_free(self.num_slots, slot),
+            lambda: self._device.free(slot))
 
     def _admit(self, slot: int, req: _Request) -> None:
         sb = bucket_length(req.prompt.size, self.buckets)
         padded = np.full((1, sb), self.pad_id, np.int32)
         padded[0, :req.prompt.size] = req.prompt
-        with self._mesh_ctx():
-            cache1, logits1 = _prefill_padded(
-                self.model, self.params, jnp.asarray(padded),
-                jnp.asarray(req.prompt.size, jnp.int32))
-            if self._state is None:
-                self._state = self._init_state(cache1)
-            cache, positions, last_logits, live = self._state
-            self._state = _insert_slot(
-                cache, positions, last_logits, live, cache1, logits1,
-                jnp.asarray(slot, jnp.int32),
-                jnp.asarray(req.prompt.size, jnp.int32))
+        self._announced(
+            lambda wire: wire.announce_cb_admit(
+                self.num_slots, padded, req.prompt.size, slot,
+                self.eos_token_id, self.pad_id),
+            lambda: self._device.admit_padded(
+                padded, req.prompt.size, slot))
         self._slots[slot] = req
 
     def _admit_waiting(self) -> None:
@@ -285,15 +384,12 @@ class ContinuousEngine:
         self._admit_waiting()
         if not self._slots:
             return []
-        cache, positions, last_logits, live = self._state
-        with self._mesh_ctx():
-            cache, positions, last_logits, live, toks = _decode_chunk(
-                self.model, self.params, cache, positions, last_logits,
-                live, chunk=self.chunk, eos_token_id=self.eos_token_id,
-                pad_id=self.pad_id)
-        self._state = (cache, positions, last_logits, live)
-        toks = np.asarray(toks)
-        live_host = np.asarray(live)
+        toks, live_host = self._announced(
+            lambda wire: wire.announce_cb_chunk(
+                self.num_slots, self.chunk, self.eos_token_id,
+                self.pad_id),
+            lambda: self._device.chunk(
+                self.chunk, self.eos_token_id, self.pad_id))
         newly_done = []
         for slot, req in list(self._slots.items()):
             budget = req.max_new_tokens - len(req.tokens)
@@ -310,9 +406,7 @@ class ContinuousEngine:
                 newly_done.append(req)
                 del self._slots[slot]
                 # slot's live flag must drop so its rows stop advancing
-                _, _, _, live_arr = self._state
-                self._state = self._state[:3] + (
-                    live_arr.at[slot].set(False),)
+                self._free_slot(slot)
         self._n_finished += len(newly_done)
         return newly_done
 

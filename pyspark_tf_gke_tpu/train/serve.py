@@ -76,9 +76,9 @@ class _ContinuousFront:
     the short ones behind it (the whole-batch path's failure mode)."""
 
     def __init__(self, model, params, eos_id, num_slots: int,
-                 chunk: int, mesh=None):
+                 chunk: int, mesh=None, announce: bool = False):
         self._engine_args = (model, params, eos_id, num_slots, chunk,
-                             mesh)
+                             mesh, announce)
         self.engine = self._new_engine()
         self.lock = threading.Lock()
         self.new_work = threading.Event()
@@ -91,10 +91,11 @@ class _ContinuousFront:
     def _new_engine(self):
         from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
 
-        model, params, eos_id, num_slots, chunk, mesh = self._engine_args
+        (model, params, eos_id, num_slots, chunk, mesh,
+         announce) = self._engine_args
         return ContinuousEngine(model, params, num_slots=num_slots,
                                 chunk=chunk, eos_token_id=eos_id,
-                                mesh=mesh)
+                                mesh=mesh, announce=announce)
 
     def submit(self, prompt_ids, max_new_tokens: int) -> int:
         """Queue a request (non-blocking); pair with ``wait``."""
@@ -166,6 +167,14 @@ class _ContinuousFront:
                         if slot[1] is None:
                             slot[1] = exc
                             slot[0].set()
+                    if self._engine_args[-1]:  # announce mode
+                        # workers must restart from zeros WITH us: their
+                        # replica may hold the half-mutated state of the
+                        # op that just failed
+                        from pyspark_tf_gke_tpu.train import serving
+
+                        with serving.mh_lock():
+                            serving.announce_cb_reset()
                     self.engine = self._new_engine()
                     busy = False
             if not busy:
@@ -257,16 +266,14 @@ class BundleServer:
         }
         self._front = None
         if continuous_slots:
-            if self.multi_host:
-                # the announce/replay wire serializes whole requests; a
-                # slot engine would need per-chunk announces — not built
-                raise ValueError(
-                    "--continuous-slots is single-host only")
+            # multi-host: the engine announces each device op over the
+            # serving wire (OP_CB_*) and the worker loops replay it into
+            # their own SlotDeviceState replicas
             self._front = _ContinuousFront(
                 self.model, self.params,
                 eos_id=getattr(self.tokenizer, "eos_id", None),
                 num_slots=continuous_slots, chunk=continuous_chunk,
-                mesh=mesh)
+                mesh=mesh, announce=self.multi_host)
 
     # -- health ----------------------------------------------------------
 
@@ -584,11 +591,13 @@ def _make_handler(server: BundleServer):
                     # HTTP/1.1 keep-alive stream (the unread bytes would
                     # parse as the next request) — drop the connection.
                     self.close_connection = True
+                    server.record_metrics(failed=True)
                     return self._reply(413, {
                         "error": f"body too large ({n} bytes > "
                                  f"{MAX_BODY_BYTES})"})
                 req = json.loads(self.rfile.read(n) or b"{}")
             except (ValueError, json.JSONDecodeError) as exc:
+                server.record_metrics(failed=True)
                 return self._reply(400, {"error": f"bad JSON body: {exc}"})
             try:
                 if self.path == "/v1/generate":
@@ -673,7 +682,8 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="enable continuous batching with this many KV "
                         "slots (0 = whole-batch serving). Greedy "
                         "requests from ALL connections share the slot "
-                        "pool; single-host, no tp")
+                        "pool; composes with --tp and multi-host "
+                        "(device ops replayed over the announce wire)")
     p.add_argument("--continuous-chunk", type=int,
                    default=int(e("CONTINUOUS_CHUNK", "8")),
                    help="decode steps per engine dispatch between "

@@ -179,6 +179,20 @@ OP_SHUTDOWN = 0
 OP_GENERATE = 1
 OP_SCORE = 2
 OP_SPECULATIVE = 3
+# Continuous batching (train/continuous.py) rides the same wire: the
+# slot engine's DEVICE ops are announced individually so every process
+# mutates an identical SlotDeviceState replica in identical order.
+# ADMIT: [op, num_slots, s_bucket, true_len, eos, slot, pad_id, 0]
+#        + payload padded prompt [1, s_bucket]
+# CHUNK: [op, num_slots, 0, chunk, eos, 0, pad_id, 0]  (no payload; the
+#        op ends in as_host_array gathers every process joins)
+# FREE:  [op, num_slots, 0, 0, 0, slot, 0, 0]
+# RESET: [op, 0, ...] — drop the replica (process 0 rebuilt its engine
+#        after a failed step; states must restart from zeros together)
+OP_CB_ADMIT = 4
+OP_CB_CHUNK = 5
+OP_CB_FREE = 6
+OP_CB_RESET = 7
 # [op, batch, prompt_len, max_new_tokens, eos (-1=none), aux,
 #  top_k (-1=none), extras (0/1/2)]
 # aux = num_beams for OP_GENERATE (beams>1 -> the deterministic beam
@@ -220,6 +234,46 @@ def announce_generate(prompt_ids, max_new_tokens: int,
         _bcast(np.asarray(sampling["floats"], np.float32))
         if sampling["key"] is not None:
             _bcast(np.asarray(sampling["key"], np.uint32))
+
+
+def mh_lock():
+    """The announce lock, for callers that drive their own
+    announce+device sequences (the continuous engine). One announce +
+    its device work at a time — interleaved streams desync workers."""
+    return _MH_LOCK
+
+
+def announce_cb_admit(num_slots: int, padded, true_len: int, slot: int,
+                      eos_token_id, pad_id: int) -> None:
+    """Process 0 (caller already holds the announce lock): publish one
+    slot-admit op. ``padded`` is the [1, S_bucket] right-padded
+    prompt."""
+    header = np.zeros(_HEADER_LEN, np.int32)
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+    header[:7] = [OP_CB_ADMIT, num_slots, padded.shape[1], int(true_len),
+                  eos, slot, pad_id]
+    _bcast(header)
+    _bcast(np.asarray(padded, np.int32))
+
+
+def announce_cb_chunk(num_slots: int, chunk: int, eos_token_id,
+                      pad_id: int) -> None:
+    header = np.zeros(_HEADER_LEN, np.int32)
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+    header[:7] = [OP_CB_CHUNK, num_slots, 0, chunk, eos, 0, pad_id]
+    _bcast(header)
+
+
+def announce_cb_free(num_slots: int, slot: int) -> None:
+    header = np.zeros(_HEADER_LEN, np.int32)
+    header[:6] = [OP_CB_FREE, num_slots, 0, 0, 0, slot]
+    _bcast(header)
+
+
+def announce_cb_reset() -> None:
+    header = np.zeros(_HEADER_LEN, np.int32)
+    header[0] = OP_CB_RESET
+    _bcast(header)
 
 
 def announce_shutdown() -> None:
@@ -422,12 +476,68 @@ def serve_worker_loop(model, params, mesh: Mesh,
 
     logger = logging.getLogger("train.serving")
     served = 0
+    cb_replica = None  # SlotDeviceState mirror of process 0's engine
+    cb_poisoned = False  # a CB op failed HERE; only OP_CB_RESET heals
     while True:
         header = np.asarray(_bcast(np.zeros(_HEADER_LEN, np.int32)))
         op, b, s, max_new, eos, aux, tk, sampling = (
             int(v) for v in header)  # aux = beams (generate) / gamma (spec)
         if op == OP_SHUTDOWN:
             return served
+        if op in (OP_CB_ADMIT, OP_CB_CHUNK, OP_CB_FREE, OP_CB_RESET):
+            # continuous-batching replica ops. Field mapping per the
+            # OP_CB_* comment above: b=num_slots, s=s_bucket,
+            # max_new=true_len (admit) / chunk (chunk), aux=slot,
+            # tk=pad_id.
+            #
+            # Failure discipline: a CB op that fails HERE poisons this
+            # replica. The SYMMETRIC case (process 0's copy of the op
+            # failed too — the common one, same program + same inputs)
+            # heals: process 0 rebuilds its engine and announces
+            # OP_CB_RESET before any further CB op, and both sides
+            # restart from zeros. The ASYMMETRIC case (only this worker
+            # failed) is unhealable divergence — a rebuilt zeroed
+            # replica would either skip process 0's collectives (server
+            # hangs inside the chunk with its locks held) or join them
+            # with divergent state (clients get corrupt tokens with
+            # HTTP 200). So any CB op arriving while poisoned exits
+            # loudly — a dead, restartable process beats both (same
+            # stance as the missing-draft guard above).
+            from pyspark_tf_gke_tpu.train.continuous import SlotDeviceState
+
+            if op == OP_CB_RESET:
+                cb_replica, cb_poisoned = None, False
+                continue
+            if cb_poisoned:
+                logger.error(
+                    "CB op %d announced after this worker's replica "
+                    "failed without an intervening OP_CB_RESET "
+                    "(asymmetric failure) — exiting so the divergence "
+                    "is a dead process, not corrupt tokens or a hung "
+                    "server", op)
+                raise SystemExit(14)
+            # the admit payload broadcast is itself part of the ordered
+            # stream — consume it BEFORE anything that can fail, or a
+            # failed op would leave the next header read misaligned
+            padded = (np.asarray(_bcast(np.zeros((1, s), np.int32)))
+                      if op == OP_CB_ADMIT else None)
+            try:
+                if cb_replica is None or cb_replica.num_slots != b:
+                    cb_replica = SlotDeviceState(model, params, b, mesh)
+                if op == OP_CB_ADMIT:
+                    cb_replica.admit_padded(padded, max_new, aux)
+                elif op == OP_CB_CHUNK:
+                    cb_replica.chunk(
+                        max_new, None if eos < 0 else eos, tk)
+                    served += 1
+                else:  # OP_CB_FREE
+                    cb_replica.free(aux)
+            except Exception:  # noqa: BLE001 — symmetric failures heal
+                logger.exception(
+                    "continuous-batching replica op %d failed; replica "
+                    "poisoned until process 0's OP_CB_RESET", op)
+                cb_replica, cb_poisoned = None, True
+            continue
         prompt = np.asarray(_bcast(np.zeros((b, s), np.int32)))
         lengths = (np.asarray(_bcast(np.zeros(b, np.int32)))
                    if op == OP_SCORE else None)

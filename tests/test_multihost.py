@@ -562,3 +562,50 @@ def test_two_process_sigstop_stall_detection_and_restart(tmp_path):
         assert p.returncode == 0, f"restarted worker {i} failed:\n{text[-3000:]}"
         assert f"WORKER_OK {i}" in text
     assert any(f"Restored checkpoint step {killed_at}" in t for t in outputs)
+
+
+CB_RUNNER = _RUNNER_PREAMBLE + TP_SERVE_SETUP + r"""
+from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
+from pyspark_tf_gke_tpu.train.serving import serve_worker_loop as swl
+
+if pid == 0:
+    eng = ContinuousEngine(model, placed, num_slots=2, chunk=3,
+                           buckets=(8, 16), mesh=mesh, announce=True)
+    rids = [eng.submit(np.arange(4, 12, dtype=np.int32), 5),
+            eng.submit(np.arange(10, 16, dtype=np.int32), 7),
+            eng.submit(np.arange(2, 7, dtype=np.int32), 4)]
+    results = dict(eng.run_until_drained())
+    announce_shutdown()
+    print("CB_TOKENS", [results[r] for r in rids])
+else:
+    served = swl(model, placed, mesh)
+    print("CB_WORKER_OK", served)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_continuous_batching_matches_single_process():
+    """Continuous batching over the announce/replay wire: process 0's
+    slot engine announces every device op (admit/chunk/free); process 1
+    replays them into a SlotDeviceState replica. Three staggered
+    requests (slot reuse mid-flight, 2 slots) must produce the same
+    tokens as the identical engine on the in-process 8-device mesh."""
+    from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
+
+    model, placed, mesh = _tp_serve_fixture()
+    eng = ContinuousEngine(model, placed, num_slots=2, chunk=3,
+                           buckets=(8, 16), mesh=mesh)
+    rids = [eng.submit(np.arange(4, 12, dtype=np.int32), 5),
+            eng.submit(np.arange(10, 16, dtype=np.int32), 7),
+            eng.submit(np.arange(2, 7, dtype=np.int32), 4)]
+    results = dict(eng.run_until_drained())
+    ref = [results[r] for r in rids]
+
+    procs = _spawn_pair(lambda pid, port: [
+        "-c", CB_RUNNER, "2", str(pid), f"127.0.0.1:{port}"])
+    outputs = _communicate_pair(procs)
+    for i, (p, text) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"cb proc {i} failed:\n{text[-3000:]}"
+    assert "CB_WORKER_OK" in outputs[1]
+    toks = outputs[0].split("CB_TOKENS ")[1].splitlines()[0]
+    assert toks == str(ref)
