@@ -76,6 +76,9 @@ class _Request:
     max_new_tokens: int
     tokens: List[int] = field(default_factory=list)
     done: bool = False
+    # streaming: called with each newly decoded token group, on the
+    # engine's driver thread (keep it cheap — enqueue and return)
+    on_tokens: Optional[callable] = None
 
 
 @functools.partial(jax.jit, static_argnames=("model",))
@@ -309,7 +312,7 @@ class ContinuousEngine:
 
     # -- submission ------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
-               ) -> int:
+               on_tokens=None) -> int:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -320,7 +323,8 @@ class ContinuousEngine:
                 f"prompt {prompt.size} + {max_new_tokens} new tokens "
                 f"exceeds max_seq_len {self.model.cfg.max_seq_len}")
         bucket_length(prompt.size, self.buckets)  # raises if oversized
-        req = _Request(next(self._rid), prompt, max_new_tokens)
+        req = _Request(next(self._rid), prompt, max_new_tokens,
+                       on_tokens=on_tokens)
         self._queue.append(req)
         return req.rid
 
@@ -398,7 +402,16 @@ class ContinuousEngine:
                 hit = np.nonzero(take == self.eos_token_id)[0]
                 if hit.size:
                     take = take[:hit[0] + 1]
-            req.tokens.extend(int(t) for t in take)
+            new_toks = [int(t) for t in take]
+            req.tokens.extend(new_toks)
+            if req.on_tokens is not None and new_toks:
+                try:
+                    req.on_tokens(new_toks)
+                except Exception:  # noqa: BLE001 — a slow/broken stream
+                    # consumer must not take the whole engine down
+                    logger.exception(
+                        "on_tokens callback failed for request %d",
+                        req.rid)
             eos_done = (self.eos_token_id is not None
                         and not live_host[slot])
             if eos_done or len(req.tokens) >= req.max_new_tokens:

@@ -39,6 +39,7 @@ Implementation notes (TPU-shaped, not an afterthought):
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
 import os
 import sys
@@ -83,7 +84,8 @@ class _ContinuousFront:
         self.lock = threading.Lock()
         self.new_work = threading.Event()
         self.stop = threading.Event()
-        self._results = {}  # rid -> [threading.Event, tokens|None]
+        # rid -> [done_event, tokens|Exception|None, stream_q|None]
+        self._results = {}
         self.thread = threading.Thread(
             target=self._loop, name="continuous-engine", daemon=True)
         self.thread.start()
@@ -102,7 +104,7 @@ class _ContinuousFront:
         done = threading.Event()
         with self.lock:
             rid = self.engine.submit(prompt_ids, max_new_tokens)
-            self._results[rid] = [done, None]
+            self._results[rid] = [done, None, None]
         self.new_work.set()
         return rid
 
@@ -141,6 +143,22 @@ class _ContinuousFront:
             self.engine.cancel(rid)
             self._results.pop(rid, None)
 
+    def submit_stream(self, prompt_ids, max_new_tokens: int):
+        """Streaming variant: returns (rid, queue). The queue receives
+        token-id lists as they decode, then a terminal item — [] on
+        completion, an Exception on engine failure. The consumer must
+        drain it (bounded: max_new_tokens items + terminal)."""
+        import queue as _queue
+
+        q = _queue.Queue()
+        done = threading.Event()
+        with self.lock:
+            rid = self.engine.submit(prompt_ids, max_new_tokens,
+                                     on_tokens=q.put)
+            self._results[rid] = [done, None, q]  # same shape as submit
+        self.new_work.set()
+        return rid, q
+
     def _loop(self):
         while not self.stop.is_set():
             busy = False
@@ -154,6 +172,8 @@ class _ContinuousFront:
                         if slot is not None:
                             slot[1] = req.tokens
                             slot[0].set()
+                            if slot[2] is not None:  # streaming terminal
+                                slot[2].put([])
                 except Exception as exc:  # noqa: BLE001 — driver thread
                     # One failed step must not brick serving: the engine
                     # state may be mid-chunk garbage, so fail every
@@ -167,6 +187,8 @@ class _ContinuousFront:
                         if slot[1] is None:
                             slot[1] = exc
                             slot[0].set()
+                            if slot[2] is not None:
+                                slot[2].put(exc)
                     if self._engine_args[-1]:  # announce mode
                         # workers must restart from zeros WITH us: their
                         # replica may hold the half-mutated state of the
@@ -449,6 +471,67 @@ class BundleServer:
                                              dt, eos_id, **extra)
         return results
 
+    def generate_stream(self, prompt: str, max_new_tokens: int = 64):
+        """Greedy streaming completion through the slot engine: yields
+        one event dict per decoded token group (``token_ids`` plus the
+        full ``text`` so far — full text, not a delta, so multibyte
+        tokenizer sequences can't tear), then a terminal event with the
+        assembled completion. Requires --continuous-slots."""
+        if self._front is None:
+            raise ValueError(
+                "streaming requires --continuous-slots (the slot engine "
+                "is what yields tokens as they decode)")
+        ids = self.tokenizer.encode(prompt)
+        if not ids:
+            raise ValueError("prompt tokenized to zero tokens")
+        cfg = self.model.cfg
+        if len(ids) + max_new_tokens > cfg.max_seq_len:
+            raise ValueError(
+                f"{len(ids)} tokens + {max_new_tokens} new exceeds "
+                f"max_seq_len {cfg.max_seq_len}")
+        eos_id = getattr(self.tokenizer, "eos_id", None)
+        t0 = time.perf_counter()
+        rid, q = self._front.submit_stream(ids, max_new_tokens)
+        toks, finished = [], False
+        try:
+            while True:
+                item = q.get(timeout=600)
+                if isinstance(item, Exception):
+                    raise RuntimeError(
+                        f"continuous engine failed this request: {item}")
+                if item == []:
+                    break
+                if eos_id is not None and eos_id in item:
+                    item = item[:item.index(eos_id)]
+                    toks.extend(item)
+                    if item:
+                        yield {"token_ids": item,
+                               "text": prompt + self.tokenizer.decode(toks)}
+                    break
+                toks.extend(item)
+                yield {"token_ids": item,
+                       "text": prompt + self.tokenizer.decode(toks)}
+            # collect + release the results entry (event already set by
+            # the time the terminal item arrives; short timeout)
+            self._front.wait(rid, timeout_s=60)
+            finished = True
+        finally:
+            if not finished:
+                # engine failure or client disconnect mid-stream: the
+                # 200 is already committed, so /metrics is the only
+                # place this failure can still be seen
+                self._front.abandon(rid)
+                self.record_metrics(failed=True)
+        entry = {
+            "prompt": prompt,
+            "completion": prompt + self.tokenizer.decode(toks),
+            "new_tokens": len(toks),
+            "latency_ms": round((time.perf_counter() - t0) * 1000.0, 2),
+            "done": True,
+        }
+        self.record_metrics(generate_entries=[entry])
+        yield entry
+
     def record_metrics(self, *, generate_entries=None, score: bool = False,
                        failed: bool = False) -> None:
         """Fold one request into the counters (handler-thread safe)."""
@@ -569,6 +652,56 @@ def _make_handler(server: BundleServer):
             self.end_headers()
             self.wfile.write(body)
 
+        def _stream_generate(self, req, prompts):
+            """Server-sent events: one ``data:`` line per token group,
+            a terminal entry with the assembled completion, then
+            ``data: [DONE]``. Greedy single-prompt only (that's the
+            slot-engine path tokens stream FROM); the connection closes
+            at the end — no Content-Length on a stream."""
+            if len(prompts) != 1:
+                server.record_metrics(failed=True)
+                return self._reply(
+                    400, {"error": "streaming takes exactly one prompt"})
+            if (float(req.get("temperature", 0.0) or 0.0) > 0
+                    or req.get("num_beams") or req.get("top_k")
+                    or req.get("top_p") or req.get("repetition_penalty")):
+                server.record_metrics(failed=True)
+                return self._reply(
+                    400, {"error": "streaming is greedy-only (no "
+                                   "sampling/beam parameters)"})
+            try:
+                events = server.generate_stream(
+                    prompts[0],
+                    max_new_tokens=int(req.get("max_new_tokens", 64)))
+                first = next(events)  # validation errors surface BEFORE
+                #   the 200 status line is committed
+            except (TypeError, ValueError) as exc:
+                server.record_metrics(failed=True)
+                return self._reply(400, {"error": str(exc)})
+            self.close_connection = True
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            try:
+                for event in itertools.chain([first], events):
+                    self.wfile.write(
+                        f"data: {json.dumps(event)}\n\n".encode())
+                    self.wfile.flush()
+                self.wfile.write(b"data: [DONE]\n\n")
+            except Exception as exc:  # noqa: BLE001 — mid-stream: the
+                # status line is gone; emit an error event if the socket
+                # still listens, else just drop (client sees the cut)
+                logger.exception("stream failed mid-flight")
+                try:
+                    self.wfile.write(
+                        f"data: {json.dumps({'error': str(exc)})}"
+                        "\n\n".encode())
+                    self.wfile.write(b"data: [DONE]\n\n")
+                except OSError:
+                    pass
+
         def do_GET(self):
             if self.path in ("/healthz", "/health", "/"):
                 self._reply(200, server.health())
@@ -610,6 +743,8 @@ def _make_handler(server: BundleServer):
                         return self._reply(
                             400, {"error": "'prompts' must be a list of "
                                            "strings (or 'prompt': str)"})
+                    if req.get("stream"):
+                        return self._stream_generate(req, prompts)
                     out = server.generate(
                         prompts,
                         max_new_tokens=int(req.get("max_new_tokens", 64)),

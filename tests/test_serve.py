@@ -398,3 +398,58 @@ def test_metrics_endpoint(cb_endpoints):
     with urllib.request.urlopen(cont_url + "/metrics") as resp:
         ctext = resp.read().decode()
     assert pre + "continuous_num_slots 2" in ctext
+
+
+def test_streaming_generate_sse(cb_endpoints):
+    plain_url, cont_url = cb_endpoints
+    # reference: the non-streaming continuous completion
+    ref = _post(cont_url, "/v1/generate",
+                {"prompts": ["stream me"],
+                 "max_new_tokens": 7})["completions"][0]["completion"]
+
+    req = urllib.request.Request(
+        cont_url + "/v1/generate",
+        data=json.dumps({"prompt": "stream me", "max_new_tokens": 7,
+                         "stream": True}).encode())
+    events = []
+    with urllib.request.urlopen(req, timeout=300) as resp:
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        for raw in resp:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            events.append(json.loads(payload))
+    assert events, "no SSE events arrived"
+    final = events[-1]
+    assert final.get("done") is True
+    assert final["completion"] == ref  # token-identical to non-streaming
+    assert final["new_tokens"] == 7
+    token_events = [e for e in events if "token_ids" in e]
+    # chunk=3, budget 7 => at least 3 incremental groups
+    assert len(token_events) >= 2
+    assert sum(len(e["token_ids"]) for e in token_events) == 7
+    # each event carries the full text so far; they must be prefixes
+    texts = [e["text"] for e in token_events]
+    for a, b in zip(texts, texts[1:]):
+        assert b.startswith(a[:len("stream me")])
+
+
+def test_streaming_rejects_sampling_and_plain_server(cb_endpoints):
+    plain_url, cont_url = cb_endpoints
+    for url, payload, want in [
+        (cont_url, {"prompt": "x", "stream": True, "temperature": 0.9},
+         "greedy-only"),
+        (cont_url, {"prompts": ["a", "b"], "stream": True},
+         "exactly one prompt"),
+        (plain_url, {"prompt": "x", "stream": True},
+         "requires --continuous-slots"),
+    ]:
+        try:
+            _post(url, "/v1/generate", payload)
+            raise AssertionError(f"{payload} should have failed")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 400
+            assert want in json.loads(exc.read())["error"]
