@@ -97,6 +97,97 @@ def _prefill_padded(model: CausalLM, params, padded_ids, true_len):
     return mutated["cache"], last
 
 
+@functools.partial(jax.jit, static_argnames=("model",))
+def _extend_prefix(model: CausalLM, params, cache1, padded_rem, fill,
+                   rem_len):
+    """Extend a batch-1 prefix cache (fill level ``fill``) with the
+    right-padded remainder tokens in ONE multi-token slot-decode
+    forward: K/V for all remainder positions are written at
+    fill..fill+s-1 and the causal offset mask keeps every real token
+    blind to the padding after it (same argument as the padded
+    prefill). Returns (extended cache, logits at the last REAL
+    remainder token)."""
+    from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
+
+    s_b = padded_rem.shape[1]
+    positions = (fill + jnp.arange(s_b))[None, :]
+    logits, mutated = model.apply(
+        {"params": dequantize_tree(params), "cache": cache1}, padded_rem,
+        decode=True, slot_decode=True, positions=positions,
+        mutable=["cache"])
+    last = jnp.take_along_axis(
+        logits, (rem_len - 1)[None, None, None], axis=1)[:, 0]
+    return mutated["cache"], last
+
+
+class PrefixCache:
+    """LRU of prefilled prompt PREFIXES (the shared-system-prompt
+    serving pattern): each entry holds a batch-1 cache tree + the
+    last-token logits at its fill level. ``lookup`` returns the longest
+    entry that prefixes the prompt; admission inserts it into the slot
+    and only the remainder pays prefill compute. Each entry costs one
+    slot's worth of KV memory — size ``capacity`` accordingly."""
+
+    def __init__(self, capacity: int = 4):
+        if capacity < 1:
+            raise ValueError("prefix cache capacity must be >= 1")
+        self.capacity = capacity
+        self._entries = {}  # key tuple -> (cache_tree, last_logits)
+        self._order: List[tuple] = []  # LRU, most recent LAST
+        self.hits = self.misses = 0
+
+    def put(self, key_tokens, cache1, logits1) -> None:
+        key = tuple(int(t) for t in key_tokens)
+        if key in self._entries:
+            self._order.remove(key)
+        elif len(self._entries) >= self.capacity:
+            evict = self._order.pop(0)
+            del self._entries[evict]
+        self._entries[key] = (cache1, logits1)
+        self._order.append(key)
+
+    def lookup(self, prompt: np.ndarray):
+        """Best cached entry by LONGEST COMMON TOKEN PREFIX with the
+        prompt — not exact key-prefix match, because BPE tokenizers are
+        not prefix-stable: encode(system + user) can merge a token
+        across the boundary, so the warmed sequence and the prompt
+        diverge one token early. Matching the common prefix reuses
+        every row up to the divergence and recomputes only the rest.
+        Returns (usable_fill, cache_tree, last_logits_or_None) or None;
+        ``last_logits`` is only returned when the WHOLE entry matched
+        and equals the whole prompt's prefix fill (else the extension
+        recomputes the logits anyway)."""
+        toks = np.asarray(prompt, np.int64)
+        best, best_common = None, 0
+        for key in self._entries:
+            k = np.asarray(key, np.int64)
+            n = min(k.size, toks.size)
+            neq = np.nonzero(k[:n] != toks[:n])[0]
+            common = int(neq[0]) if neq.size else n
+            if common > best_common:
+                best, best_common = key, common
+        # A prompt that is a STRICT prefix of an entry (common == prompt
+        # length < entry length) would need logits at a fill level the
+        # entry doesn't store — decline; everything else either matched
+        # exactly (stored logits apply) or has a remainder whose
+        # extension recomputes them.
+        if best is None or best_common == 0 or (
+                best_common == toks.size and best_common != len(best)):
+            self.misses += 1
+            return None
+        self.hits += 1
+        self._order.remove(best)
+        self._order.append(best)  # LRU touch
+        cache1, logits1 = self._entries[best]
+        exact = best_common == len(best) == toks.size
+        return best_common, cache1, (logits1 if exact else None)
+
+    @property
+    def stats(self) -> dict:
+        return {"entries": len(self._entries), "capacity": self.capacity,
+                "hits": self.hits, "misses": self.misses}
+
+
 @jax.jit
 def _clear_live(live, slot):
     return live.at[slot].set(False)
@@ -279,9 +370,18 @@ class ContinuousEngine:
                  chunk: int = 8, eos_token_id: Optional[int] = None,
                  pad_id: int = 0,
                  buckets: Sequence[int] = PAD_BUCKETS,
-                 mesh=None, announce: bool = False):
+                 mesh=None, announce: bool = False,
+                 prefix_cache_size: int = 0):
         if num_slots < 1 or chunk < 1:
             raise ValueError("num_slots and chunk must be >= 1")
+        if prefix_cache_size and announce:
+            # the prefix entries and the extend op are not on the
+            # OP_CB_* wire (worker replicas would need the LRU too) —
+            # single-host only until they are
+            raise ValueError(
+                "prefix caching is single-host only (announce mode)")
+        self.prefix_cache = (PrefixCache(prefix_cache_size)
+                             if prefix_cache_size else None)
         self.model, self.params = model, params
         # tp serving: ``params`` should already be placed
         # (shard_params_for_serving); entering the mesh context around
@@ -328,6 +428,30 @@ class ContinuousEngine:
         self._queue.append(req)
         return req.rid
 
+    def warm_prefix(self, prefix_ids) -> int:
+        """Prefill ``prefix_ids`` once and cache the result; later
+        requests whose prompt starts with it skip that prefill. Returns
+        the prefix length. The prefix must leave room for at least one
+        more token (a full-context prefix could never be extended)."""
+        if self.prefix_cache is None:
+            raise ValueError("engine built without prefix_cache_size")
+        prefix = np.asarray(prefix_ids, np.int32).reshape(-1)
+        if prefix.size == 0:
+            raise ValueError("empty prefix")
+        if prefix.size >= self.model.cfg.max_seq_len:
+            raise ValueError(
+                f"prefix {prefix.size} leaves no room under max_seq_len "
+                f"{self.model.cfg.max_seq_len}")
+        sb = bucket_length(prefix.size, self.buckets)
+        padded = np.full((1, sb), self.pad_id, np.int32)
+        padded[0, :prefix.size] = prefix
+        with self._device._mesh_ctx():
+            cache1, logits1 = _prefill_padded(
+                self.model, self.params, jnp.asarray(padded),
+                jnp.asarray(prefix.size, jnp.int32))
+        self.prefix_cache.put(prefix, cache1, logits1)
+        return int(prefix.size)
+
     def cancel(self, rid: int) -> bool:
         """Drop a request (abandoned client / front-side timeout): a
         queued request is removed; an active one frees its KV slot
@@ -365,6 +489,12 @@ class ContinuousEngine:
             lambda: self._device.free(slot))
 
     def _admit(self, slot: int, req: _Request) -> None:
+        hit = (self.prefix_cache.lookup(req.prompt)
+               if self.prefix_cache is not None else None)
+        if hit is not None:
+            self._admit_from_prefix(slot, req, *hit)
+            self._slots[slot] = req
+            return
         sb = bucket_length(req.prompt.size, self.buckets)
         padded = np.full((1, sb), self.pad_id, np.int32)
         padded[0, :req.prompt.size] = req.prompt
@@ -375,6 +505,50 @@ class ContinuousEngine:
             lambda: self._device.admit_padded(
                 padded, req.prompt.size, slot))
         self._slots[slot] = req
+
+    def _admit_from_prefix(self, slot: int, req: _Request, fill: int,
+                           cache1, logits1) -> None:
+        """Admission on a prefix-cache hit: only the prompt REMAINDER
+        pays a forward (one multi-token slot-decode extension of the
+        cached batch-1 tree), then the extended tree drops into the
+        slot. Single-host only (guarded in __init__)."""
+        rem = req.prompt[fill:]
+        if rem.size == 0 and logits1 is None:
+            raise AssertionError(
+                "prefix lookup returned an empty remainder without "
+                "stored logits — lookup contract violated")
+        if rem.size:
+            # the remainder bucket must fit BOTH the remainder and the
+            # room left above ``fill`` — a write past max_seq_len would
+            # be clamped by dynamic_update_slice and land at the wrong
+            # positions (submit() guarantees rem fits the room). Shape
+            # discipline: prefer the engine buckets, then 32-multiples
+            # (bounds distinct _extend_prefix programs), exact room
+            # only as the last resort near the context limit.
+            s_max = self.model.cfg.max_seq_len
+            room = s_max - fill
+            candidates = [b for b in self.buckets
+                          if rem.size <= b <= room]
+            if candidates:
+                sb = min(candidates)
+            else:
+                quant = -(-int(rem.size) // 32) * 32
+                sb = quant if quant <= room else room
+            padded = np.full((1, sb), self.pad_id, np.int32)
+            padded[0, :rem.size] = rem
+            with self._device._mesh_ctx():
+                cache1, logits1 = _extend_prefix(
+                    self.model, self.params, cache1, jnp.asarray(padded),
+                    jnp.asarray(fill, jnp.int32),
+                    jnp.asarray(rem.size, jnp.int32))
+        if self._device.state is None:
+            self._device.state = self._device._init_state(cache1)
+        with self._device._mesh_ctx():
+            cache, positions, last_logits, live = self._device.state
+            self._device.state = _insert_slot(
+                cache, positions, last_logits, live, cache1, logits1,
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(req.prompt.size, jnp.int32))
 
     def _admit_waiting(self) -> None:
         free = [s for s in range(self.num_slots) if s not in self._slots]
@@ -438,4 +612,6 @@ class ContinuousEngine:
             "finished": self._n_finished,
             "num_slots": self.num_slots,
             "chunk": self.chunk,
+            **({"prefix_cache": self.prefix_cache.stats}
+               if self.prefix_cache is not None else {}),
         }

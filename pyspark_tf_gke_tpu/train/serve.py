@@ -77,15 +77,18 @@ class _ContinuousFront:
     the short ones behind it (the whole-batch path's failure mode)."""
 
     def __init__(self, model, params, eos_id, num_slots: int,
-                 chunk: int, mesh=None, announce: bool = False):
+                 chunk: int, mesh=None, announce: bool = False,
+                 prefix_cache_size: int = 0):
         self._engine_args = (model, params, eos_id, num_slots, chunk,
-                             mesh, announce)
+                             mesh, announce, prefix_cache_size)
+        self._announce = announce
         self.engine = self._new_engine()
         self.lock = threading.Lock()
         self.new_work = threading.Event()
         self.stop = threading.Event()
         # rid -> [done_event, tokens|Exception|None, stream_q|None]
         self._results = {}
+        self._warmed = []  # token lists, replayed into rebuilt engines
         self.thread = threading.Thread(
             target=self._loop, name="continuous-engine", daemon=True)
         self.thread.start()
@@ -93,11 +96,12 @@ class _ContinuousFront:
     def _new_engine(self):
         from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
 
-        (model, params, eos_id, num_slots, chunk, mesh,
-         announce) = self._engine_args
+        (model, params, eos_id, num_slots, chunk, mesh, announce,
+         prefix_cache_size) = self._engine_args
         return ContinuousEngine(model, params, num_slots=num_slots,
                                 chunk=chunk, eos_token_id=eos_id,
-                                mesh=mesh, announce=announce)
+                                mesh=mesh, announce=announce,
+                                prefix_cache_size=prefix_cache_size)
 
     def submit(self, prompt_ids, max_new_tokens: int) -> int:
         """Queue a request (non-blocking); pair with ``wait``."""
@@ -135,6 +139,21 @@ class _ContinuousFront:
                         timeout_s: float = 600.0):
         return self.wait(self.submit(prompt_ids, max_new_tokens),
                          timeout_s)
+
+    def warm_prefix(self, prefix_ids) -> int:
+        """Prefill + cache a shared prompt prefix (serialized with the
+        driver loop's device work). The token list is retained so an
+        engine rebuild after a failed step re-warms automatically —
+        deploy-time warms must not silently vanish on a transient
+        device error."""
+        with self.lock:
+            n = self.engine.warm_prefix(prefix_ids)
+            toks = [int(t) for t in prefix_ids]
+            if toks not in self._warmed:
+                self._warmed.append(toks)
+                cap = self.engine.prefix_cache.capacity
+                del self._warmed[:-cap]  # match the LRU's horizon
+            return n
 
     def abandon(self, rid: int) -> None:
         """Give up on a submitted request: free its KV slot / queue spot
@@ -189,7 +208,7 @@ class _ContinuousFront:
                             slot[0].set()
                             if slot[2] is not None:
                                 slot[2].put(exc)
-                    if self._engine_args[-1]:  # announce mode
+                    if self._announce:
                         # workers must restart from zeros WITH us: their
                         # replica may hold the half-mutated state of the
                         # op that just failed
@@ -198,6 +217,13 @@ class _ContinuousFront:
                         with serving.mh_lock():
                             serving.announce_cb_reset()
                     self.engine = self._new_engine()
+                    for toks in self._warmed:
+                        try:
+                            self.engine.warm_prefix(toks)
+                        except Exception:  # noqa: BLE001
+                            logger.exception(
+                                "re-warm of a cached prefix failed "
+                                "after engine rebuild")
                     busy = False
             if not busy:
                 # idle: park until a submit wakes us (short timeout so
@@ -220,7 +246,7 @@ class BundleServer:
 
     def __init__(self, bundle_dir: str, mesh=None, int8_kv: bool = False,
                  draft_bundle_dir: str = "", continuous_slots: int = 0,
-                 continuous_chunk: int = 8):
+                 continuous_chunk: int = 8, prefix_cache_size: int = 0):
         from pyspark_tf_gke_tpu.data.text import get_tokenizer
         from pyspark_tf_gke_tpu.train.export import load_serving_bundle
 
@@ -295,7 +321,8 @@ class BundleServer:
                 self.model, self.params,
                 eos_id=getattr(self.tokenizer, "eos_id", None),
                 num_slots=continuous_slots, chunk=continuous_chunk,
-                mesh=mesh, announce=self.multi_host)
+                mesh=mesh, announce=self.multi_host,
+                prefix_cache_size=prefix_cache_size)
 
     # -- health ----------------------------------------------------------
 
@@ -471,6 +498,20 @@ class BundleServer:
                                              dt, eos_id, **extra)
         return results
 
+    def warm_prefix(self, prefix: str) -> dict:
+        """Tokenize + prefill a shared prompt prefix into the slot
+        engine's prefix cache (the /v1/warm endpoint). Later greedy
+        requests whose prompt starts with it skip that prefill."""
+        if self._front is None:
+            raise ValueError("warming requires --continuous-slots")
+        ids = self.tokenizer.encode(prefix)
+        if not ids:
+            raise ValueError("prefix tokenized to zero tokens")
+        n = self._front.warm_prefix(ids)
+        return {"prefix_tokens": n,
+                "prefix_cache": self._front.engine.stats.get(
+                    "prefix_cache")}
+
     def generate_stream(self, prompt: str, max_new_tokens: int = 64):
         """Greedy streaming completion through the slot engine: yields
         one event dict per decoded token group (``token_ids`` plus the
@@ -567,6 +608,13 @@ class BundleServer:
                 kind = "counter" if key == "finished" else "gauge"
                 lines.append(f"# TYPE {name} {kind}")
                 lines.append(f"{name} {stats[key]}")
+            for key, val in (stats.get("prefix_cache") or {}).items():
+                name = ("pyspark_tf_gke_tpu_serve_continuous_"
+                        f"prefix_cache_{key}")
+                kind = ("counter" if key in ("hits", "misses")
+                        else "gauge")
+                lines.append(f"# TYPE {name} {kind}")
+                lines.append(f"{name} {val}")
         return "\n".join(lines) + "\n"
 
     def _entry(self, prompt, new_tokens, dt_ms, eos_id, **extra) -> dict:
@@ -755,6 +803,15 @@ def _make_handler(server: BundleServer):
                         repetition_penalty=req.get("repetition_penalty"))
                     server.record_metrics(generate_entries=out)
                     self._reply(200, {"completions": out})
+                elif self.path == "/v1/warm":
+                    prefix = req.get("prefix")
+                    if not isinstance(prefix, str):
+                        server.record_metrics(failed=True)
+                        return self._reply(
+                            400, {"error": "'prefix' must be a string"})
+                    out = server.warm_prefix(prefix)
+                    server.record_metrics()
+                    self._reply(200, out)
                 elif self.path == "/v1/score":
                     texts = req.get("texts")
                     if not isinstance(texts, list) or not all(
@@ -819,6 +876,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "requests from ALL connections share the slot "
                         "pool; composes with --tp and multi-host "
                         "(device ops replayed over the announce wire)")
+    p.add_argument("--prefix-cache", type=int,
+                   default=int(e("PREFIX_CACHE", "0")),
+                   help="LRU entries of prefilled shared prompt "
+                        "prefixes (POST /v1/warm); requires "
+                        "--continuous-slots, single-host")
     p.add_argument("--continuous-chunk", type=int,
                    default=int(e("CONTINUOUS_CHUNK", "8")),
                    help="decode steps per engine dispatch between "
@@ -887,7 +949,8 @@ def main(argv=None) -> int:
         draft_bundle_dir=(_resolve_bundle(args.draft_bundle)
                           if args.draft_bundle else ""),
         continuous_slots=args.continuous_slots,
-        continuous_chunk=args.continuous_chunk)
+        continuous_chunk=args.continuous_chunk,
+        prefix_cache_size=args.prefix_cache)
     logger.info("bundle loaded: %s", server.health())
     if jax.process_count() > 1:
         # fail a misdeploy (draft bundle on some processes only) at

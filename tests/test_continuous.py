@@ -196,3 +196,116 @@ def test_tp_mesh_parity():
     results = dict(eng.run_until_drained())
     for rid, exp in zip(rids, expected):
         assert results[rid] == exp
+
+
+def test_prefix_cache_hit_matches_cold_engine():
+    # warm a shared "system prompt" prefix; requests prefixed by it
+    # must produce exactly the cold engine's tokens while paying
+    # prefill only for the suffix.
+    model, params = _tiny_model()
+    rng = np.random.default_rng(7)
+    system = rng.integers(1, 97, 12)
+    suffixes = [rng.integers(1, 97, 4), rng.integers(1, 97, 9)]
+    prompts = [np.concatenate([system, s]) for s in suffixes]
+    expected = [_reference_tokens(model, params, p, 6) for p in prompts]
+
+    eng = ContinuousEngine(model, params, num_slots=2, chunk=3,
+                           buckets=(16, 32), prefix_cache_size=2)
+    assert eng.warm_prefix(system) == 12
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    results = dict(eng.run_until_drained())
+    for rid, exp in zip(rids, expected):
+        assert results[rid] == exp
+    st = eng.stats["prefix_cache"]
+    assert st["hits"] == 2 and st["entries"] == 1
+
+
+def test_prefix_cache_exact_prompt_hit():
+    # prompt == warmed prefix: no remainder forward at all.
+    model, params = _tiny_model()
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(1, 97, 10)
+    expected = _reference_tokens(model, params, prompt, 5)
+    eng = ContinuousEngine(model, params, num_slots=1, chunk=2,
+                           buckets=(16,), prefix_cache_size=1)
+    eng.warm_prefix(prompt)
+    rid = eng.submit(prompt, max_new_tokens=5)
+    results = dict(eng.run_until_drained())
+    assert results[rid] == expected
+
+
+def test_prefix_cache_longest_match_and_lru():
+    model, params = _tiny_model()
+    rng = np.random.default_rng(9)
+    short = rng.integers(1, 97, 4)
+    longer = np.concatenate([short, rng.integers(1, 97, 6)])  # 10 toks
+    prompt = np.concatenate([longer, rng.integers(1, 97, 3)])
+    expected = _reference_tokens(model, params, prompt, 4)
+    eng = ContinuousEngine(model, params, num_slots=1, chunk=2,
+                           buckets=(16, 32), prefix_cache_size=2)
+    eng.warm_prefix(short)
+    eng.warm_prefix(longer)
+    rid = eng.submit(prompt, max_new_tokens=4)
+    results = dict(eng.run_until_drained())
+    assert results[rid] == expected
+    # LRU: a third warm evicts `short` (longer was touched by the hit)
+    third = rng.integers(1, 97, 5)
+    eng.warm_prefix(third)
+    keys = set(eng.prefix_cache._entries)
+    assert tuple(int(t) for t in short) not in keys
+    assert tuple(int(t) for t in longer) in keys
+
+
+def test_prefix_cache_validation():
+    model, params = _tiny_model()
+    eng = ContinuousEngine(model, params, num_slots=1, buckets=(16,))
+    with pytest.raises(ValueError, match="prefix_cache_size"):
+        eng.warm_prefix([1, 2])
+    eng2 = ContinuousEngine(model, params, num_slots=1, buckets=(16,),
+                            prefix_cache_size=1)
+    with pytest.raises(ValueError, match="empty"):
+        eng2.warm_prefix([])
+    with pytest.raises(ValueError, match="no room"):
+        eng2.warm_prefix([1] * 128)  # == max_seq_len
+    with pytest.raises(ValueError, match="single-host"):
+        ContinuousEngine(model, params, num_slots=1, announce=True,
+                         prefix_cache_size=1)
+
+
+def test_prefix_cache_partial_match_bpe_boundary():
+    # BPE tokenizers are not prefix-stable: the prompt can diverge from
+    # the warmed sequence one token before the warm's end. The lookup
+    # must reuse the COMMON rows and recompute from the divergence —
+    # token-identical to the cold path.
+    model, params = _tiny_model()
+    rng = np.random.default_rng(11)
+    warmed = rng.integers(1, 97, 10)
+    prompt = np.concatenate([warmed[:7],          # shares 7 tokens
+                             rng.integers(1, 97, 5)])  # then diverges
+    assert prompt[7] != warmed[7] or True  # divergence point
+    expected = _reference_tokens(model, params, prompt, 6)
+    eng = ContinuousEngine(model, params, num_slots=1, chunk=3,
+                           buckets=(16, 32), prefix_cache_size=1)
+    eng.warm_prefix(warmed)
+    rid = eng.submit(prompt, max_new_tokens=6)
+    results = dict(eng.run_until_drained())
+    assert results[rid] == expected
+    assert eng.stats["prefix_cache"]["hits"] == 1
+
+
+def test_prefix_cache_declines_prompt_shorter_than_entry():
+    # A prompt that is a strict prefix of the warmed entry has no
+    # stored logits at its fill level — must be a clean miss, not a
+    # wrong-logits hit.
+    model, params = _tiny_model()
+    rng = np.random.default_rng(12)
+    warmed = rng.integers(1, 97, 12)
+    prompt = warmed[:8]
+    expected = _reference_tokens(model, params, prompt, 5)
+    eng = ContinuousEngine(model, params, num_slots=1, chunk=3,
+                           buckets=(16,), prefix_cache_size=1)
+    eng.warm_prefix(warmed)
+    rid = eng.submit(prompt, max_new_tokens=5)
+    results = dict(eng.run_until_drained())
+    assert results[rid] == expected
+    assert eng.stats["prefix_cache"]["misses"] >= 1

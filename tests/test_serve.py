@@ -453,3 +453,49 @@ def test_streaming_rejects_sampling_and_plain_server(cb_endpoints):
         except urllib.error.HTTPError as exc:
             assert exc.code == 400
             assert want in json.loads(exc.read())["error"]
+
+
+@pytest.fixture(scope="module")
+def warm_endpoint(tmp_path_factory):
+    cfg = CausalLMConfig(**CFG)
+    model = CausalLM(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = nn.meta.unbox(jax.jit(model.init)(make_rng(3), ids)["params"])
+    bundle = str(tmp_path_factory.mktemp("serve-warm") / "bundle")
+    export_serving_bundle(cfg, params, bundle)
+    server = BundleServer(bundle, continuous_slots=2, continuous_chunk=3,
+                          prefix_cache_size=2)
+    httpd = start_http_server(server, host="127.0.0.1", port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", server
+    httpd.shutdown()
+    server._front.shutdown()
+
+
+def test_warm_prefix_over_the_wire(warm_endpoint):
+    url, server = warm_endpoint
+    system = "system: answer briefly. "
+    # cold reference BEFORE warming (same engine, no prefix entries)
+    cold = _post(url, "/v1/generate",
+                 {"prompts": [system + "hi"],
+                  "max_new_tokens": 6})["completions"][0]["completion"]
+    out = _post(url, "/v1/warm", {"prefix": system})
+    assert out["prefix_tokens"] == len(system)
+    assert out["prefix_cache"]["entries"] == 1
+    warm = _post(url, "/v1/generate",
+                 {"prompts": [system + "hi"],
+                  "max_new_tokens": 6})["completions"][0]["completion"]
+    assert warm == cold  # prefix-hit path is token-identical
+    with urllib.request.urlopen(url + "/healthz") as resp:
+        health = json.loads(resp.read())
+    assert health["continuous"]["prefix_cache"]["hits"] >= 1
+
+
+def test_warm_validation(warm_endpoint):
+    url, _ = warm_endpoint
+    for payload in ({"prefix": 7}, {}):
+        try:
+            _post(url, "/v1/warm", payload)
+            raise AssertionError("should 400")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 400
