@@ -740,6 +740,32 @@ def bench_continuous(smoke: bool = False) -> dict:
             f"engine returned {got} tokens, expected {useful}")
     eng_tps = got / eng_dt / n_chips
 
+    # -- prefix-cache study: time-to-first-token for a long shared
+    # prefix + short suffix, cold vs warmed (the shared-system-prompt
+    # serving pattern). Engine with 1 slot + chunk 1 so the measured
+    # span is prefill + ONE decode step both ways.
+    plen = 16 if smoke else 384
+    slen = 4 if smoke else 64
+    prefix = rng.integers(0, cfg.vocab_size, plen).astype(np.int32)
+    suffix = rng.integers(0, cfg.vocab_size, slen).astype(np.int32)
+    full = np.concatenate([prefix, suffix])
+
+    def first_token_ms(engine):
+        engine.submit(full, max_new_tokens=1)
+        t0 = time.perf_counter()
+        while not engine.step():
+            pass
+        return (time.perf_counter() - t0) * 1000.0
+
+    cold_eng = ContinuousEngine(model, params, num_slots=1, chunk=1)
+    first_token_ms(cold_eng)  # compile both programs
+    cold_ms = first_token_ms(cold_eng)
+    warm_eng = ContinuousEngine(model, params, num_slots=1, chunk=1,
+                                prefix_cache_size=1)
+    warm_eng.warm_prefix(prefix)
+    first_token_ms(warm_eng)  # compile the extension program
+    warm_ms = first_token_ms(warm_eng)
+
     return {
         "metric": "continuous_batching_tokens_per_sec_per_chip",
         "value": round(eng_tps, 1),
@@ -747,6 +773,12 @@ def bench_continuous(smoke: bool = False) -> dict:
         "vs_baseline": None,
         "whole_batch_tokens_per_sec_per_chip": round(base_tps, 1),
         "speedup_vs_whole_batch": round(eng_tps / base_tps, 3),
+        "prefix_study": {
+            "prefix_len": plen, "suffix_len": slen,
+            "first_token_cold_ms": round(cold_ms, 2),
+            "first_token_warm_ms": round(warm_ms, 2),
+            "speedup": round(cold_ms / warm_ms, 3) if warm_ms else None,
+        },
         "num_slots": slots,
         "chunk": chunk,
         "n_requests": n_requests,

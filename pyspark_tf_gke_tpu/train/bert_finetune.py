@@ -36,6 +36,7 @@ from pyspark_tf_gke_tpu.train.harness import (
     local_batch_size,
     make_checkpoint,
     make_heartbeat,
+    OPTIMIZERS,
     make_optimizer,
 )
 from pyspark_tf_gke_tpu.train.resilience import run_with_recovery
@@ -79,7 +80,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--ema-decay", type=float, default=float(e("EMA_DECAY", "0")),
                    help=">0 maintains an EMA of params alongside training")
     p.add_argument("--optimizer", default=e("OPTIMIZER", "adam"),
-                   choices=["adam", "adamw", "sgd", "momentum", "lamb"],
+                   choices=list(OPTIMIZERS),
                    help="adamw + warmup_cosine is the standard transformer "
                         "recipe; adam (the prior default) stays default "
                         "for backward-compatible loss curves")

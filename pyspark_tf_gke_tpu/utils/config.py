@@ -79,7 +79,7 @@ class Config:
     learning_rate: float = _env_float("LEARNING_RATE", 1e-3)
     lr_schedule: str = _env("LR_SCHEDULE", "constant")  # constant|cosine|warmup_cosine
     warmup_steps: int = _env_int("WARMUP_STEPS", 0)
-    optimizer: str = _env("OPTIMIZER", "adam")  # adam|adamw|sgd|momentum|lamb
+    optimizer: str = _env("OPTIMIZER", "adam")  # adam|adamw|sgd|momentum|lamb|adafactor
     weight_decay: float = _env_float("WEIGHT_DECAY", 0.0)
     momentum: float = _env_float("MOMENTUM", 0.9)  # --optimizer momentum only
     grad_clip_norm: float = _env_float("GRAD_CLIP_NORM", 0.0)  # 0 → off
@@ -154,8 +154,10 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
     p.add_argument("--lr-schedule", default=cfg.lr_schedule,
                    choices=["constant", "cosine", "warmup_cosine"])
     p.add_argument("--warmup-steps", type=int, default=cfg.warmup_steps)
+    from pyspark_tf_gke_tpu.train.harness import OPTIMIZERS
+
     p.add_argument("--optimizer", default=cfg.optimizer,
-                   choices=["adam", "adamw", "sgd", "momentum", "lamb"])
+                   choices=list(OPTIMIZERS))
     p.add_argument("--weight-decay", type=float, default=cfg.weight_decay)
     p.add_argument("--momentum", type=float, default=cfg.momentum)
     p.add_argument("--grad-clip-norm", type=float, default=cfg.grad_clip_norm,

@@ -397,3 +397,46 @@ def test_adam_mu_dtype_bf16(mesh_dp):
     default = Trainer(model, TASKS["classification"](), mesh_dp)
     mus, _ = moment_dtypes(default)
     assert jnp.bfloat16 not in mus  # parity default untouched
+
+
+def test_adafactor_trains(mesh_dp):
+    """adafactor (t5x's TPU default) must train through the standard
+    Trainer path AND actually factor the second moments: optax only
+    factors dims >= 128, so the probe model carries a 128x192 matrix
+    and the opt_state must hold O(rows+cols) v_row/v_col stats for it
+    (not a full O(rows*cols) tensor)."""
+    from pyspark_tf_gke_tpu.train.harness import make_optimizer
+
+    X, y = synthetic_classification_arrays(n=96, num_classes=3)
+    model = MLPClassifier(num_classes=3, hidden=(128, 192))
+    trainer = Trainer(model, TASKS["classification"](), mesh_dp,
+                      tx=make_optimizer(1e-2, optimizer="adafactor"))
+    it = BatchIterator({"x": X, "y": y}, 32, seed=0)
+    batch = next(iter(it))
+    state = trainer.init_state(make_rng(0), batch)
+    losses = []
+    for _ in range(8):
+        state, metrics = trainer.step(state, batch)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    assert losses[-1] < losses[0]
+
+    # factored evidence: some second-moment leaves are 1-D rows/cols of
+    # the 128x192 kernel, and NO leaf stores its full 128x192 moment
+    shapes = [np.asarray(x).shape
+              for x in jax.tree.leaves(jax.device_get(state.opt_state))]
+    assert (128,) in shapes and (192,) in shapes, shapes
+    assert (128, 192) not in shapes, "second moment was NOT factored"
+
+    def nbytes(tree):
+        return sum(np.asarray(x).nbytes
+                   for x in jax.tree.leaves(jax.device_get(tree)))
+
+    adam_state = Trainer(model, TASKS["classification"](), mesh_dp,
+                         learning_rate=1e-2).init_state(make_rng(0), batch)
+    assert nbytes(state.opt_state) < nbytes(adam_state.opt_state)
+
+
+def test_adafactor_weight_decay_builds():
+    from pyspark_tf_gke_tpu.train.harness import make_optimizer
+
+    make_optimizer(1e-3, optimizer="adafactor", weight_decay=0.01)
