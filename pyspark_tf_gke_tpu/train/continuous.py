@@ -46,7 +46,7 @@ from __future__ import annotations
 import functools
 import itertools
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -79,6 +79,10 @@ class _Request:
     # streaming: called with each newly decoded token group, on the
     # engine's driver thread (keep it cheap — enqueue and return)
     on_tokens: Optional[callable] = None
+    # sampling lane (temperature 0 = greedy; per-request PRNG seed)
+    temperature: float = 0.0
+    top_p: Optional[float] = None
+    seed: int = 0
 
 
 @functools.partial(jax.jit, static_argnames=("model",))
@@ -188,31 +192,59 @@ class PrefixCache:
                 "hits": self.hits, "misses": self.misses}
 
 
+def _seed_key_data(seed) -> jnp.ndarray:
+    """[2] uint32 key data for the slot lane, with the impl PINNED to
+    threefry2x32: _decode_chunk wraps with that impl explicitly, and the
+    default-impl PRNGKey would hand back (4,)-shaped rbg data on
+    configs that set jax_default_prng_impl=rbg (common on TPU)."""
+    return jax.random.key_data(
+        jax.random.key(int(seed), impl="threefry2x32")).astype(jnp.uint32)
+
+
+class SlotState(NamedTuple):
+    """The slot pool's device arrays (a pytree — flows through jits).
+    Sampling lanes ride per slot: ``temps`` 0 = greedy for that row,
+    ``topps`` 1 = no nucleus filter, ``keys`` a per-slot PRNG key each
+    sampling row folds forward every step."""
+
+    cache: Any
+    positions: jnp.ndarray     # [B] int32 fill levels
+    last_logits: jnp.ndarray   # [B, V] carried logits
+    live: jnp.ndarray          # [B] bool
+    temps: jnp.ndarray         # [B] f32
+    topps: jnp.ndarray         # [B] f32
+    keys: jnp.ndarray          # [B, 2] uint32
+
+
 @jax.jit
-def _clear_live(live, slot):
-    return live.at[slot].set(False)
+def _clear_live(state: SlotState, slot):
+    return state._replace(live=state.live.at[slot].set(False))
 
 
 @functools.partial(jax.jit, static_argnames=("num_slots", "vocab"))
-def _zeros_state(cache1, *, num_slots: int, vocab: int):
+def _zeros_state(cache1, *, num_slots: int, vocab: int) -> SlotState:
     """Fresh slot-pool state shaped after one prefill's cache tree."""
     b = num_slots
     cache = jax.tree.map(
         lambda row: (jnp.zeros_like(row) if row.ndim == 0
                      else jnp.zeros((b,) + row.shape[1:], row.dtype)),
         cache1)
-    return (cache,
-            jnp.zeros((b,), jnp.int32),
-            jnp.zeros((b, vocab), jnp.float32),
-            jnp.zeros((b,), bool))
+    return SlotState(
+        cache=cache,
+        positions=jnp.zeros((b,), jnp.int32),
+        last_logits=jnp.zeros((b, vocab), jnp.float32),
+        live=jnp.zeros((b,), bool),
+        temps=jnp.zeros((b,), jnp.float32),
+        topps=jnp.ones((b,), jnp.float32),
+        keys=jnp.zeros((b, 2), jnp.uint32))
 
 
 @jax.jit
-def _insert_slot(cache, positions, last_logits, live, cache1, logits1,
-                 slot, fill):
+def _insert_slot(state: SlotState, cache1, logits1, slot, fill,
+                 temp, topp, key) -> SlotState:
     """Drop a prefilled request into slot ``slot`` (traced scalar — one
     compiled program serves every slot): cache rows, fill level, carried
-    logits, live flag."""
+    logits, live flag, sampling lane."""
     # Scalar leaves are the per-layer `index` fill counters — unused by
     # slot mode (per-row positions are the authority) but kept
     # conservative (max) so any non-slot reader of the cache var sees a
@@ -220,26 +252,38 @@ def _insert_slot(cache, positions, last_logits, live, cache1, logits1,
     cache = jax.tree.map(
         lambda big, row: (jnp.maximum(big, row) if row.ndim == 0
                           else big.at[slot].set(row[0])),
-        cache, cache1)
-    return (cache,
-            positions.at[slot].set(fill),
-            last_logits.at[slot].set(logits1[0]),
-            live.at[slot].set(True))
+        state.cache, cache1)
+    return SlotState(
+        cache=cache,
+        positions=state.positions.at[slot].set(fill),
+        last_logits=state.last_logits.at[slot].set(logits1[0]),
+        live=state.live.at[slot].set(True),
+        temps=state.temps.at[slot].set(temp),
+        topps=state.topps.at[slot].set(topp),
+        keys=state.keys.at[slot].set(key))
 
 
 @functools.partial(
-    jax.jit, static_argnames=("model", "chunk", "eos_token_id", "pad_id"))
-def _decode_chunk(model: CausalLM, params, cache, positions, last_logits,
-                  live, *, chunk: int, eos_token_id: Optional[int],
-                  pad_id: int):
-    """``chunk`` greedy decode steps for ALL slots in one dispatch.
+    jax.jit, static_argnames=("model", "chunk", "eos_token_id", "pad_id",
+                              "sampling", "mesh"))
+def _decode_chunk(model: CausalLM, params, state: SlotState, *,
+                  chunk: int, eos_token_id: Optional[int],
+                  pad_id: int, sampling: bool = False, mesh=None):
+    """``chunk`` decode steps for ALL slots in one dispatch.
 
     Mirrors ``causal_lm._decode``'s emit-then-step order exactly (the
     parity oracle): emit token t from the carried logits, then run the
     model at each row's own position to produce logits t+1. Rows that
     are dead (free slot) or that hit eos keep computing — static shapes
     — but their positions freeze (no cache growth past the fill level)
-    and their emitted tokens are ``pad_id``."""
+    and their emitted tokens are ``pad_id``.
+
+    Per-slot sampling: a row with ``temps > 0`` draws from its scaled,
+    top-p-filtered distribution with ITS OWN key (folded forward each
+    step); temp-0 rows take the argmax, and their token stream is
+    bit-identical to an all-greedy chunk (the sampling lanes touch
+    nothing they read)."""
+    from pyspark_tf_gke_tpu.models.causal_lm import _filter_logits
     from pyspark_tf_gke_tpu.ops.quant import (dequantize_embeddings,
                                               inloop_dequantize,
                                               is_quantized)
@@ -247,12 +291,49 @@ def _decode_chunk(model: CausalLM, params, cache, positions, last_logits,
     quantized = is_quantized(params)
     p = dequantize_embeddings(params) if quantized else params
 
+    def pick(logits, temps, topps, keys):
+        """[B] tokens: greedy rows argmax; sampling rows categorical
+        over their own scaled, nucleus-filtered distribution — reusing
+        the parity oracle's _filter_logits (its top_p comparison
+        broadcasts, so a [B, 1] per-row mass works; topp=1 keeps
+        everything)."""
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if not sampling:
+            # static: a pure-greedy pool compiles WITHOUT the per-step
+            # [B, V] sort/softmax/cumsum/categorical (the dominant
+            # serving path pays one argmax, as before sampling existed)
+            return greedy
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        if mesh is not None:
+            # replicate the tiny [B, V] working set first: the nucleus
+            # sort/cumsum over a tp-sharded vocab axis would otherwise
+            # compile NEW cross-process collective patterns, and the
+            # per-row categorical brings nothing worth sharding — the
+            # replicated math keeps the sampled chunk collective-free
+            # beyond what the greedy program already does (a fresh
+            # communicator mid-serving deadlocked the 2-process wire).
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            scaled = jax.lax.with_sharding_constraint(
+                scaled, NamedSharding(mesh, PartitionSpec()))
+        filtered = _filter_logits(scaled, None, topps[:, None])
+        sampled = jax.vmap(jax.random.categorical)(keys, filtered)
+        return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+
     def step(carry, _):
-        cache, positions, logits, live = carry
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B]
+        st = carry
+        if sampling:
+            keys = jax.vmap(
+                lambda k: jax.random.fold_in(k, 1))(
+                    jax.random.wrap_key_data(st.keys, impl="threefry2x32"))
+            keys_data = jax.vmap(jax.random.key_data)(keys)
+        else:
+            keys, keys_data = None, st.keys
+        tok = pick(st.last_logits, st.temps, st.topps, keys)
         # Emit BEFORE the eos latch drops `live`: the eos token itself
         # belongs to the output (generate pads WITH eos after it; the
         # host loop truncates inclusively on it).
+        live = st.live
         emitted = jnp.where(live, tok, pad_id)
         if eos_token_id is not None:
             live = live & (tok != eos_token_id)
@@ -260,18 +341,22 @@ def _decode_chunk(model: CausalLM, params, cache, positions, last_logits,
         # no position growth, slot cache row 0 is overwritten on the
         # next admit's prefill anyway.
         step_tok = jnp.where(live, tok, pad_id)
-        step_pos = jnp.where(live, positions, 0)
+        step_pos = jnp.where(live, st.positions, 0)
         logits, mutated = model.apply(
             {"params": inloop_dequantize(p) if quantized else p,
-             "cache": cache},
+             "cache": st.cache},
             step_tok[:, None], decode=True, slot_decode=True,
             positions=step_pos[:, None], mutable=["cache"])
-        positions = jnp.where(live, positions + 1, positions)
-        return (mutated["cache"], positions, logits[:, 0], live), emitted
+        st = st._replace(
+            cache=mutated["cache"],
+            positions=jnp.where(live, st.positions + 1, st.positions),
+            last_logits=logits[:, 0],
+            live=live,
+            keys=keys_data)
+        return st, emitted
 
-    (cache, positions, last_logits, live), toks = jax.lax.scan(
-        step, (cache, positions, last_logits, live), None, length=chunk)
-    return cache, positions, last_logits, live, toks.T  # [B, chunk]
+    state, toks = jax.lax.scan(step, state, None, length=chunk)
+    return state, toks.T  # [B, chunk]
 
 
 class SlotDeviceState:
@@ -310,37 +395,40 @@ class SlotDeviceState:
                             vocab=self.model.cfg.vocab_size)
 
     def admit_padded(self, padded: np.ndarray, true_len: int,
-                     slot: int) -> None:
+                     slot: int, temperature: float = 0.0,
+                     top_p: float = 1.0, seed: int = 0) -> None:
         """Prefill a right-padded [1, S_bucket] prompt and insert it
-        into ``slot`` at fill level ``true_len``."""
+        into ``slot`` at fill level ``true_len`` with its sampling lane
+        (temperature 0 = greedy)."""
         with self._mesh_ctx():
             cache1, logits1 = _prefill_padded(
                 self.model, self.params, jnp.asarray(padded),
                 jnp.asarray(true_len, jnp.int32))
             if self.state is None:
                 self.state = self._init_state(cache1)
-            cache, positions, last_logits, live = self.state
             self.state = _insert_slot(
-                cache, positions, last_logits, live, cache1, logits1,
+                self.state, cache1, logits1,
                 jnp.asarray(slot, jnp.int32),
-                jnp.asarray(true_len, jnp.int32))
+                jnp.asarray(true_len, jnp.int32),
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(top_p, jnp.float32),
+                _seed_key_data(seed))
 
     def chunk(self, chunk: int, eos_token_id: Optional[int],
-              pad_id: int):
-        """One decode chunk over all slots. Returns host-readable
-        (tokens [B, chunk], live [B]) — gathered on multi-process
-        meshes so every process can read them."""
+              pad_id: int, sampling: bool = False):
+        """One decode chunk over all slots (``sampling`` static: the
+        pure-greedy pool compiles without the sampling math). Returns
+        host-readable (tokens [B, chunk], live [B]) — gathered on
+        multi-process meshes so every process can read them."""
         from pyspark_tf_gke_tpu.parallel.distributed import as_host_array
 
-        cache, positions, last_logits, live = self.state
         with self._mesh_ctx():
-            cache, positions, last_logits, live, toks = _decode_chunk(
-                self.model, self.params, cache, positions, last_logits,
-                live, chunk=chunk, eos_token_id=eos_token_id,
-                pad_id=pad_id)
-            self.state = (cache, positions, last_logits, live)
+            self.state, toks = _decode_chunk(
+                self.model, self.params, self.state, chunk=chunk,
+                eos_token_id=eos_token_id, pad_id=pad_id,
+                sampling=sampling, mesh=self.mesh)
             toks_host = np.asarray(as_host_array(toks))
-            live_host = np.asarray(as_host_array(live))
+            live_host = np.asarray(as_host_array(self.state.live))
         return toks_host, live_host
 
     def free(self, slot: int) -> None:
@@ -348,11 +436,10 @@ class SlotDeviceState:
         if self.state is None:
             return
         with self._mesh_ctx():
-            cache, positions, last_logits, live = self.state
             # jitted (not eager .at) so the update runs SPMD on global
             # multi-process arrays like every other replayed op
-            self.state = (cache, positions, last_logits,
-                          _clear_live(live, jnp.asarray(slot, jnp.int32)))
+            self.state = _clear_live(self.state,
+                                     jnp.asarray(slot, jnp.int32))
 
 
 class ContinuousEngine:
@@ -412,7 +499,12 @@ class ContinuousEngine:
 
     # -- submission ------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
-               on_tokens=None) -> int:
+               on_tokens=None, temperature: float = 0.0,
+               top_p: Optional[float] = None, seed: int = 0) -> int:
+        if temperature and temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if top_p is not None and not 0 < top_p <= 1:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -424,7 +516,8 @@ class ContinuousEngine:
                 f"exceeds max_seq_len {self.model.cfg.max_seq_len}")
         bucket_length(prompt.size, self.buckets)  # raises if oversized
         req = _Request(next(self._rid), prompt, max_new_tokens,
-                       on_tokens=on_tokens)
+                       on_tokens=on_tokens, temperature=float(temperature),
+                       top_p=top_p, seed=int(seed))
         self._queue.append(req)
         return req.rid
 
@@ -498,12 +591,17 @@ class ContinuousEngine:
         sb = bucket_length(req.prompt.size, self.buckets)
         padded = np.full((1, sb), self.pad_id, np.int32)
         padded[0, :req.prompt.size] = req.prompt
+        sampling = (float(req.temperature),
+                    float(req.top_p if req.top_p is not None else 1.0),
+                    int(req.seed))
         self._announced(
             lambda wire: wire.announce_cb_admit(
                 self.num_slots, padded, req.prompt.size, slot,
-                self.eos_token_id, self.pad_id),
+                self.eos_token_id, self.pad_id, sampling=sampling),
             lambda: self._device.admit_padded(
-                padded, req.prompt.size, slot))
+                padded, req.prompt.size, slot,
+                temperature=sampling[0], top_p=sampling[1],
+                seed=sampling[2]))
         self._slots[slot] = req
 
     def _admit_from_prefix(self, slot: int, req: _Request, fill: int,
@@ -544,11 +642,14 @@ class ContinuousEngine:
         if self._device.state is None:
             self._device.state = self._device._init_state(cache1)
         with self._device._mesh_ctx():
-            cache, positions, last_logits, live = self._device.state
             self._device.state = _insert_slot(
-                cache, positions, last_logits, live, cache1, logits1,
+                self._device.state, cache1, logits1,
                 jnp.asarray(slot, jnp.int32),
-                jnp.asarray(req.prompt.size, jnp.int32))
+                jnp.asarray(req.prompt.size, jnp.int32),
+                jnp.asarray(req.temperature, jnp.float32),
+                jnp.asarray(req.top_p if req.top_p is not None else 1.0,
+                            jnp.float32),
+                _seed_key_data(req.seed))
 
     def _admit_waiting(self) -> None:
         free = [s for s in range(self.num_slots) if s not in self._slots]
@@ -562,12 +663,15 @@ class ContinuousEngine:
         self._admit_waiting()
         if not self._slots:
             return []
+        any_sampling = any(r.temperature > 0
+                           for r in self._slots.values())
         toks, live_host = self._announced(
             lambda wire: wire.announce_cb_chunk(
                 self.num_slots, self.chunk, self.eos_token_id,
-                self.pad_id),
+                self.pad_id, sampling=any_sampling),
             lambda: self._device.chunk(
-                self.chunk, self.eos_token_id, self.pad_id))
+                self.chunk, self.eos_token_id, self.pad_id,
+                sampling=any_sampling))
         newly_done = []
         for slot, req in list(self._slots.items()):
             budget = req.max_new_tokens - len(req.tokens)

@@ -103,11 +103,15 @@ class _ContinuousFront:
                                 mesh=mesh, announce=announce,
                                 prefix_cache_size=prefix_cache_size)
 
-    def submit(self, prompt_ids, max_new_tokens: int) -> int:
+    def submit(self, prompt_ids, max_new_tokens: int,
+               temperature: float = 0.0, top_p=None,
+               seed: int = 0) -> int:
         """Queue a request (non-blocking); pair with ``wait``."""
         done = threading.Event()
         with self.lock:
-            rid = self.engine.submit(prompt_ids, max_new_tokens)
+            rid = self.engine.submit(prompt_ids, max_new_tokens,
+                                     temperature=temperature, top_p=top_p,
+                                     seed=seed)
             self._results[rid] = [done, None, None]
         self.new_work.set()
         return rid
@@ -382,6 +386,11 @@ class BundleServer:
         plain_greedy = (not (temperature and temperature > 0)
                         and not num_beams and repetition_penalty is None
                         and top_k is None and top_p is None)
+        # the slot engine also serves temperature/top-p sampling (each
+        # slot draws with its own per-request key); beams, top-k and
+        # repetition penalty stay on the whole-batch path
+        engine_ok = (not num_beams and repetition_penalty is None
+                     and top_k is None)
         # Routing order for plain-greedy traffic: speculative (when a
         # draft is configured AND its context fits this request) →
         # continuous slot engine → whole-batch. The draft-context check
@@ -391,7 +400,7 @@ class BundleServer:
                       and plain_greedy
                       and len(encoded[0][1]) + max_new_tokens
                       <= self.draft_model.cfg.max_seq_len)
-        if self._front is not None and plain_greedy and not could_spec:
+        if self._front is not None and engine_ok and not could_spec:
             # slot engine: each prompt is its own request — they share
             # KV slots with every OTHER in-flight HTTP request, and a
             # short completion returns without waiting for a long one.
@@ -399,7 +408,11 @@ class BundleServer:
             # submit everything first (non-blocking — they co-occupy
             # slots), then collect in order; no thread pool needed to
             # block on events.
-            rids = [(i, self._front.submit(ids, max_new_tokens))
+            temp = float(temperature or 0.0)
+            rids = [(i, self._front.submit(
+                        ids, max_new_tokens, temperature=temp,
+                        top_p=top_p,
+                        seed=int.from_bytes(os.urandom(4), "little")))
                     for i, ids in encoded]
             toks = {}
             try:

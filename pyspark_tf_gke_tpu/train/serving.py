@@ -182,10 +182,16 @@ OP_SPECULATIVE = 3
 # Continuous batching (train/continuous.py) rides the same wire: the
 # slot engine's DEVICE ops are announced individually so every process
 # mutates an identical SlotDeviceState replica in identical order.
-# ADMIT: [op, num_slots, s_bucket, true_len, eos, slot, pad_id, 0]
-#        + payload padded prompt [1, s_bucket]
-# CHUNK: [op, num_slots, 0, chunk, eos, 0, pad_id, 0]  (no payload; the
-#        op ends in as_host_array gathers every process joins)
+# ADMIT: [op, num_slots, s_bucket, true_len, eos, slot, pad_id,
+#        has_sampling] + payload padded prompt [1, s_bucket]; when
+#        has_sampling=1 a float payload [temperature, top_p, seed]
+#        follows (per-slot sampling lane — every process seeds the
+#        same per-slot key, so sampled rows stay in lockstep)
+# CHUNK: [op, num_slots, 0, chunk, eos, has_sampling, pad_id, 0]
+#        (no payload; the op ends in as_host_array gathers every
+#        process joins; has_sampling is the STATIC flag choosing the
+#        greedy-only vs sampling-capable compiled chunk program — it
+#        must match across processes or they run different programs)
 # FREE:  [op, num_slots, 0, 0, 0, slot, 0, 0]
 # RESET: [op, 0, ...] — drop the replica (process 0 rebuilt its engine
 #        after a failed step; states must restart from zeros together)
@@ -244,23 +250,35 @@ def mh_lock():
 
 
 def announce_cb_admit(num_slots: int, padded, true_len: int, slot: int,
-                      eos_token_id, pad_id: int) -> None:
+                      eos_token_id, pad_id: int,
+                      sampling=None) -> None:
     """Process 0 (caller already holds the announce lock): publish one
-    slot-admit op. ``padded`` is the [1, S_bucket] right-padded
-    prompt."""
+    slot-admit op. ``padded`` is the [1, S_bucket] right-padded prompt;
+    ``sampling`` an optional (temperature, top_p, seed) triple for the
+    slot's lane (greedy = (0, 1, 0) or None)."""
     header = np.zeros(_HEADER_LEN, np.int32)
     eos = -1 if eos_token_id is None else int(eos_token_id)
-    header[:7] = [OP_CB_ADMIT, num_slots, padded.shape[1], int(true_len),
-                  eos, slot, pad_id]
+    has_sampling = int(sampling is not None and sampling[0] > 0)
+    header[:8] = [OP_CB_ADMIT, num_slots, padded.shape[1], int(true_len),
+                  eos, slot, pad_id, has_sampling]
     _bcast(header)
     _bcast(np.asarray(padded, np.int32))
+    if has_sampling:
+        # floats (temperature, top_p) + the seed as its OWN int64
+        # payload: a float32 round-trip would corrupt ~all urandom
+        # seeds (24-bit mantissa) and desync every process's sampled
+        # tokens — the exact bug class the OP_GENERATE wire avoids by
+        # broadcasting the raw uint32 key
+        _bcast(np.asarray(sampling[:2], np.float32))
+        _bcast(np.asarray([sampling[2]], np.int64))
 
 
 def announce_cb_chunk(num_slots: int, chunk: int, eos_token_id,
-                      pad_id: int) -> None:
+                      pad_id: int, sampling: bool = False) -> None:
     header = np.zeros(_HEADER_LEN, np.int32)
     eos = -1 if eos_token_id is None else int(eos_token_id)
-    header[:7] = [OP_CB_CHUNK, num_slots, 0, chunk, eos, 0, pad_id]
+    header[:7] = [OP_CB_CHUNK, num_slots, 0, chunk, eos, int(sampling),
+                  pad_id]
     _bcast(header)
 
 
@@ -516,16 +534,28 @@ def serve_worker_loop(model, params, mesh: Mesh,
                     "is a dead process, not corrupt tokens or a hung "
                     "server", op)
                 raise SystemExit(14)
-            # the admit payload broadcast is itself part of the ordered
-            # stream — consume it BEFORE anything that can fail, or a
-            # failed op would leave the next header read misaligned
-            padded = (np.asarray(_bcast(np.zeros((1, s), np.int32)))
-                      if op == OP_CB_ADMIT else None)
+            # the admit payload broadcasts are themselves part of the
+            # ordered stream — consume them BEFORE anything that can
+            # fail, or a failed op would leave the next header read
+            # misaligned
+            padded = samp = None
+            if op == OP_CB_ADMIT:
+                padded = np.asarray(_bcast(np.zeros((1, s), np.int32)))
+                if sampling:  # header slot 8: has_sampling
+                    floats = np.asarray(_bcast(np.zeros(2, np.float32)))
+                    seed = int(np.asarray(
+                        _bcast(np.zeros(1, np.int64)))[0])
+                    samp = (float(floats[0]), float(floats[1]), seed)
             try:
                 if cb_replica is None or cb_replica.num_slots != b:
                     cb_replica = SlotDeviceState(model, params, b, mesh)
                 if op == OP_CB_ADMIT:
-                    cb_replica.admit_padded(padded, max_new, aux)
+                    if samp is not None:
+                        cb_replica.admit_padded(
+                            padded, max_new, aux, temperature=samp[0],
+                            top_p=samp[1], seed=samp[2])
+                    else:
+                        cb_replica.admit_padded(padded, max_new, aux)
                 elif op == OP_CB_CHUNK:
                     cb_replica.chunk(
                         max_new, None if eos < 0 else eos, tk)

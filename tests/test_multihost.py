@@ -573,7 +573,9 @@ if pid == 0:
                            buckets=(8, 16), mesh=mesh, announce=True)
     rids = [eng.submit(np.arange(4, 12, dtype=np.int32), 5),
             eng.submit(np.arange(10, 16, dtype=np.int32), 7),
-            eng.submit(np.arange(2, 7, dtype=np.int32), 4)]
+            eng.submit(np.arange(2, 7, dtype=np.int32), 4),
+            eng.submit(np.arange(3, 9, dtype=np.int32), 5,
+                       temperature=0.8, top_p=0.9, seed=41)]
     results = dict(eng.run_until_drained())
     announce_shutdown()
     print("CB_TOKENS", [results[r] for r in rids])
@@ -597,7 +599,12 @@ def test_two_process_continuous_batching_matches_single_process():
                            buckets=(8, 16), mesh=mesh)
     rids = [eng.submit(np.arange(4, 12, dtype=np.int32), 5),
             eng.submit(np.arange(10, 16, dtype=np.int32), 7),
-            eng.submit(np.arange(2, 7, dtype=np.int32), 4)]
+            eng.submit(np.arange(2, 7, dtype=np.int32), 4),
+            # a SAMPLED request rides the wire too: the sampling lane
+            # (temperature/top_p/seed) is broadcast at admit, so every
+            # process draws the same tokens
+            eng.submit(np.arange(3, 9, dtype=np.int32), 5,
+                       temperature=0.8, top_p=0.9, seed=41)]
     results = dict(eng.run_until_drained())
     ref = [results[r] for r in rids]
 

@@ -331,14 +331,23 @@ def test_continuous_health_reports_engine(cb_endpoints):
     assert health["continuous"]["chunk"] == 3
 
 
-def test_continuous_sampling_falls_back_to_whole_batch(cb_endpoints):
-    # temperature > 0 is not a slot-engine path; it must still serve
-    # (whole-batch fallback), not 500.
+def test_continuous_sampling_routes_through_engine(cb_endpoints):
+    # temperature/top-p requests ride the slot engine (per-slot keys);
+    # beams stay on the whole-batch path — both must serve.
     _, cont_url = cb_endpoints
+    with urllib.request.urlopen(cont_url + "/healthz") as resp:
+        before = json.loads(resp.read())["continuous"]["finished"]
     out = _post(cont_url, "/v1/generate",
                 {"prompts": ["ab"], "max_new_tokens": 4,
-                 "temperature": 0.8})["completions"]
+                 "temperature": 0.8, "top_p": 0.9})["completions"]
     assert len(out) == 1 and out[0]["new_tokens"] > 0
+    with urllib.request.urlopen(cont_url + "/healthz") as resp:
+        after = json.loads(resp.read())["continuous"]["finished"]
+    assert after == before + 1  # the engine served it
+    beams = _post(cont_url, "/v1/generate",
+                  {"prompts": ["ab"], "max_new_tokens": 4,
+                   "num_beams": 2})["completions"]
+    assert "beam_score" in beams[0]  # whole-batch fallback intact
 
 
 def test_continuous_front_engine_failure_unit(tmp_path):
