@@ -43,6 +43,7 @@ via module-level jits); the multi-host announce/replay serving wire
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import itertools
 from dataclasses import dataclass, field
@@ -58,6 +59,15 @@ from pyspark_tf_gke_tpu.utils.logging import get_logger
 logger = get_logger("train.continuous")
 
 PAD_BUCKETS = (32, 64, 128, 256, 512, 1024)
+
+
+def right_pad(tokens: np.ndarray, width: int,
+              pad_id: int) -> np.ndarray:
+    """[1, width] int32 row: tokens then pad (the prefill/extend input
+    shape)."""
+    row = np.full((1, width), pad_id, np.int32)
+    row[0, :tokens.size] = tokens
+    return row
 
 
 def bucket_length(n: int, buckets: Sequence[int] = PAD_BUCKETS) -> int:
@@ -378,11 +388,9 @@ class SlotDeviceState:
         self.model, self.params = model, params
         self.num_slots = num_slots
         self.mesh = mesh
-        self.state = None  # (cache, positions, last_logits, live)
+        self.state: Optional[SlotState] = None
 
     def _mesh_ctx(self):
-        import contextlib
-
         return self.mesh if self.mesh is not None else (
             contextlib.nullcontext())
 
@@ -394,25 +402,33 @@ class SlotDeviceState:
         return _zeros_state(cache1, num_slots=self.num_slots,
                             vocab=self.model.cfg.vocab_size)
 
-    def admit_padded(self, padded: np.ndarray, true_len: int,
-                     slot: int, temperature: float = 0.0,
-                     top_p: float = 1.0, seed: int = 0) -> None:
-        """Prefill a right-padded [1, S_bucket] prompt and insert it
-        into ``slot`` at fill level ``true_len`` with its sampling lane
-        (temperature 0 = greedy)."""
+    def insert(self, cache1, logits1, slot: int, fill: int,
+               temperature: float = 0.0, top_p: float = 1.0,
+               seed: int = 0) -> None:
+        """Drop a prefilled/extended batch-1 tree into ``slot`` at
+        ``fill`` with its sampling lane (temperature 0 = greedy)."""
         with self._mesh_ctx():
-            cache1, logits1 = _prefill_padded(
-                self.model, self.params, jnp.asarray(padded),
-                jnp.asarray(true_len, jnp.int32))
             if self.state is None:
                 self.state = self._init_state(cache1)
             self.state = _insert_slot(
                 self.state, cache1, logits1,
                 jnp.asarray(slot, jnp.int32),
-                jnp.asarray(true_len, jnp.int32),
+                jnp.asarray(fill, jnp.int32),
                 jnp.asarray(temperature, jnp.float32),
                 jnp.asarray(top_p, jnp.float32),
                 _seed_key_data(seed))
+
+    def admit_padded(self, padded: np.ndarray, true_len: int,
+                     slot: int, temperature: float = 0.0,
+                     top_p: float = 1.0, seed: int = 0) -> None:
+        """Prefill a right-padded [1, S_bucket] prompt and insert it
+        into ``slot`` at fill level ``true_len``."""
+        with self._mesh_ctx():
+            cache1, logits1 = _prefill_padded(
+                self.model, self.params, jnp.asarray(padded),
+                jnp.asarray(true_len, jnp.int32))
+        self.insert(cache1, logits1, slot, true_len,
+                    temperature=temperature, top_p=top_p, seed=seed)
 
     def chunk(self, chunk: int, eos_token_id: Optional[int],
               pad_id: int, sampling: bool = False):
@@ -536,8 +552,7 @@ class ContinuousEngine:
                 f"prefix {prefix.size} leaves no room under max_seq_len "
                 f"{self.model.cfg.max_seq_len}")
         sb = bucket_length(prefix.size, self.buckets)
-        padded = np.full((1, sb), self.pad_id, np.int32)
-        padded[0, :prefix.size] = prefix
+        padded = right_pad(prefix, sb, self.pad_id)
         with self._device._mesh_ctx():
             cache1, logits1 = _prefill_padded(
                 self.model, self.params, jnp.asarray(padded),
@@ -589,8 +604,7 @@ class ContinuousEngine:
             self._slots[slot] = req
             return
         sb = bucket_length(req.prompt.size, self.buckets)
-        padded = np.full((1, sb), self.pad_id, np.int32)
-        padded[0, :req.prompt.size] = req.prompt
+        padded = right_pad(req.prompt, sb, self.pad_id)
         sampling = (float(req.temperature),
                     float(req.top_p if req.top_p is not None else 1.0),
                     int(req.seed))
@@ -599,9 +613,7 @@ class ContinuousEngine:
                 self.num_slots, padded, req.prompt.size, slot,
                 self.eos_token_id, self.pad_id, sampling=sampling),
             lambda: self._device.admit_padded(
-                padded, req.prompt.size, slot,
-                temperature=sampling[0], top_p=sampling[1],
-                seed=sampling[2]))
+                padded, req.prompt.size, slot, *sampling))
         self._slots[slot] = req
 
     def _admit_from_prefix(self, slot: int, req: _Request, fill: int,
