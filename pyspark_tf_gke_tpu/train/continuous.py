@@ -160,7 +160,7 @@ class PrefixCache:
         self._entries[key] = (cache1, logits1)
         self._order.append(key)
 
-    def lookup(self, prompt: np.ndarray):
+    def lookup(self, prompt: np.ndarray, peek: bool = False):
         """Best cached entry by LONGEST COMMON TOKEN PREFIX with the
         prompt — not exact key-prefix match, because BPE tokenizers are
         not prefix-stable: encode(system + user) can merge a token
@@ -187,11 +187,13 @@ class PrefixCache:
         # extension recomputes them.
         if best is None or best_common == 0 or (
                 best_common == toks.size and best_common != len(best)):
-            self.misses += 1
+            if not peek:
+                self.misses += 1
             return None
-        self.hits += 1
-        self._order.remove(best)
-        self._order.append(best)  # LRU touch
+        if not peek:
+            self.hits += 1
+            self._order.remove(best)
+            self._order.append(best)  # LRU touch
         cache1, logits1 = self._entries[best]
         exact = best_common == len(best) == toks.size
         return best_common, cache1, (logits1 if exact else None)
@@ -474,9 +476,21 @@ class ContinuousEngine:
                  pad_id: int = 0,
                  buckets: Sequence[int] = PAD_BUCKETS,
                  mesh=None, announce: bool = False,
-                 prefix_cache_size: int = 0):
+                 prefix_cache_size: int = 0,
+                 prefill_chunk: int = 0):
         if num_slots < 1 or chunk < 1:
             raise ValueError("num_slots and chunk must be >= 1")
+        if prefill_chunk and prefill_chunk < 32:
+            raise ValueError(
+                f"prefill_chunk must be 0 (off) or >= 32, got "
+                f"{prefill_chunk} (tiny pieces spend more dispatches "
+                "than they save)")
+        if prefill_chunk and announce:
+            # the piecewise extends are not on the OP_CB_* wire yet —
+            # same single-host gate as the prefix cache
+            raise ValueError(
+                "chunked prefill is single-host only (announce mode)")
+        self.prefill_chunk = prefill_chunk
         if prefix_cache_size and announce:
             # the prefix entries and the extend op are not on the
             # OP_CB_* wire (worker replicas would need the LRU too) —
@@ -509,6 +523,9 @@ class ContinuousEngine:
         self._rid = itertools.count()
         self._queue: List[_Request] = []
         self._slots: Dict[int, _Request] = {}
+        # piecewise admission in flight (chunked prefill): at most one,
+        # holding its reserved slot + the partially-built cache tree
+        self._admitting: Optional[dict] = None
         self._n_finished = 0  # counter, not a list: a
         # long-lived server must not retain every prompt it ever served
         self._device = SlotDeviceState(model, params, num_slots, mesh)
@@ -574,6 +591,12 @@ class ContinuousEngine:
                 del self._slots[slot]
                 self._free_slot(slot)
                 return True
+        if (self._admitting is not None
+                and self._admitting["req"].rid == rid):
+            # mid-admission: drop the partial tree; the reserved slot
+            # was never inserted, so nothing to free on device
+            self._admitting = None
+            return True
         return False
 
     # -- internals -------------------------------------------------------
@@ -596,13 +619,42 @@ class ContinuousEngine:
             lambda wire: wire.announce_cb_free(self.num_slots, slot),
             lambda: self._device.free(slot))
 
-    def _admit(self, slot: int, req: _Request) -> None:
+    def _try_admit(self, slot: int, req: _Request) -> bool:
+        """Admit ``req`` into ``slot`` — immediately, via the prefix
+        cache, or by STARTING a piecewise (chunked-prefill) admission.
+        Returns False only when the request needs piecewise admission
+        and one is already in flight (FIFO holds)."""
+        if (self._admitting is not None and self.prefill_chunk
+                and req.prompt.size > self.prefill_chunk):
+            # piecewise admission busy and this prompt MIGHT need one:
+            # peek (no stats/LRU churn on every retried step) to see if
+            # a prefix hit shrinks it under the threshold
+            hit = (self.prefix_cache.lookup(req.prompt, peek=True)
+                   if self.prefix_cache is not None else None)
+            if (req.prompt.size - (hit[0] if hit is not None else 0)
+                    > self.prefill_chunk):
+                return False
         hit = (self.prefix_cache.lookup(req.prompt)
                if self.prefix_cache is not None else None)
+        rem_size = req.prompt.size - (hit[0] if hit is not None else 0)
+        if self.prefill_chunk and rem_size > self.prefill_chunk:
+            if self._admitting is not None:
+                return False
+            # chunked prefill: long prompts admit one bounded piece per
+            # step, decode chunks interleave between pieces — a 1024-
+            # token arrival must not stall every streaming slot for a
+            # full prefill dispatch
+            self._admitting = {
+                "slot": slot, "req": req,
+                "fill": hit[0] if hit is not None else 0,
+                "cache1": hit[1] if hit is not None else None,
+            }
+            self._advance_admission()
+            return True
         if hit is not None:
             self._admit_from_prefix(slot, req, *hit)
             self._slots[slot] = req
-            return
+            return True
         sb = bucket_length(req.prompt.size, self.buckets)
         padded = right_pad(req.prompt, sb, self.pad_id)
         sampling = (float(req.temperature),
@@ -615,6 +667,7 @@ class ContinuousEngine:
             lambda: self._device.admit_padded(
                 padded, req.prompt.size, slot, *sampling))
         self._slots[slot] = req
+        return True
 
     def _admit_from_prefix(self, slot: int, req: _Request, fill: int,
                            cache1, logits1) -> None:
@@ -663,15 +716,61 @@ class ContinuousEngine:
                             jnp.float32),
                 _seed_key_data(req.seed))
 
+    def _advance_admission(self) -> None:
+        """One piece of the in-flight chunked prefill: width is ALWAYS
+        ``prefill_chunk`` (one compiled prefill + one compiled extend,
+        regardless of prompt length); the final piece inserts the
+        finished tree into the reserved slot."""
+        a = self._admitting
+        req, fill = a["req"], a["fill"]
+        # clamp the piece width to the room left under max_seq_len: a
+        # full-width pad at the context limit would make
+        # dynamic_update_slice CLAMP the write start below ``fill`` and
+        # overwrite real prompt rows (the same hazard
+        # _admit_from_prefix clamps against). Near-limit prompts pay a
+        # couple of extra compiled widths; everything else stays on the
+        # one full-width program.
+        w = min(self.prefill_chunk,
+                self.model.cfg.max_seq_len - fill)
+        piece = req.prompt[fill:fill + w]
+        padded = right_pad(piece, w, self.pad_id)
+        with self._device._mesh_ctx():
+            if a["cache1"] is None:
+                cache1, logits1 = _prefill_padded(
+                    self.model, self.params, jnp.asarray(padded),
+                    jnp.asarray(piece.size, jnp.int32))
+            else:
+                cache1, logits1 = _extend_prefix(
+                    self.model, self.params, a["cache1"],
+                    jnp.asarray(padded), jnp.asarray(fill, jnp.int32),
+                    jnp.asarray(piece.size, jnp.int32))
+        a["cache1"], a["fill"] = cache1, fill + piece.size
+        if a["fill"] == req.prompt.size:
+            self._device.insert(
+                cache1, logits1, a["slot"], req.prompt.size,
+                temperature=float(req.temperature),
+                top_p=float(req.top_p if req.top_p is not None else 1.0),
+                seed=int(req.seed))
+            self._slots[a["slot"]] = req
+            self._admitting = None
+
     def _admit_waiting(self) -> None:
-        free = [s for s in range(self.num_slots) if s not in self._slots]
+        reserved = (self._admitting["slot"]
+                    if self._admitting is not None else None)
+        free = [s for s in range(self.num_slots)
+                if s not in self._slots and s != reserved]
         while free and self._queue:
-            self._admit(free.pop(0), self._queue.pop(0))
+            if not self._try_admit(free[0], self._queue[0]):
+                break  # piecewise admission busy; FIFO holds
+            free.pop(0)
+            self._queue.pop(0)
 
     # -- the loop --------------------------------------------------------
     def step(self) -> List[_Request]:
         """Admit into free slots, run one decode chunk, collect tokens.
         Returns requests finished during this chunk."""
+        if self._admitting is not None:
+            self._advance_admission()
         self._admit_waiting()
         if not self._slots:
             return []
@@ -716,7 +815,7 @@ class ContinuousEngine:
     def run_until_drained(self):
         """Drive steps until queue + slots are empty; yields finished
         requests in completion order."""
-        while self._queue or self._slots:
+        while self._queue or self._slots or self._admitting:
             for req in self.step():
                 yield req.rid, req.tokens
 
@@ -728,6 +827,8 @@ class ContinuousEngine:
             "finished": self._n_finished,
             "num_slots": self.num_slots,
             "chunk": self.chunk,
+            "admitting": (self._admitting["req"].rid
+                          if self._admitting is not None else None),
             **({"prefix_cache": self.prefix_cache.stats}
                if self.prefix_cache is not None else {}),
         }

@@ -78,9 +78,10 @@ class _ContinuousFront:
 
     def __init__(self, model, params, eos_id, num_slots: int,
                  chunk: int, mesh=None, announce: bool = False,
-                 prefix_cache_size: int = 0):
+                 prefix_cache_size: int = 0, prefill_chunk: int = 0):
         self._engine_args = (model, params, eos_id, num_slots, chunk,
-                             mesh, announce, prefix_cache_size)
+                             mesh, announce, prefix_cache_size,
+                             prefill_chunk)
         self._announce = announce
         self.engine = self._new_engine()
         self.lock = threading.Lock()
@@ -97,11 +98,12 @@ class _ContinuousFront:
         from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
 
         (model, params, eos_id, num_slots, chunk, mesh, announce,
-         prefix_cache_size) = self._engine_args
+         prefix_cache_size, prefill_chunk) = self._engine_args
         return ContinuousEngine(model, params, num_slots=num_slots,
                                 chunk=chunk, eos_token_id=eos_id,
                                 mesh=mesh, announce=announce,
-                                prefix_cache_size=prefix_cache_size)
+                                prefix_cache_size=prefix_cache_size,
+                                prefill_chunk=prefill_chunk)
 
     def submit(self, prompt_ids, max_new_tokens: int,
                temperature: float = 0.0, top_p=None,
@@ -188,7 +190,8 @@ class _ContinuousFront:
             with self.lock:
                 try:
                     stats = self.engine.stats
-                    busy = bool(stats["active"] or stats["queued"])
+                    busy = bool(stats["active"] or stats["queued"]
+                                or stats["admitting"] is not None)
                     finished = self.engine.step() if busy else []
                     for req in finished:
                         slot = self._results.get(req.rid)
@@ -250,7 +253,8 @@ class BundleServer:
 
     def __init__(self, bundle_dir: str, mesh=None, int8_kv: bool = False,
                  draft_bundle_dir: str = "", continuous_slots: int = 0,
-                 continuous_chunk: int = 8, prefix_cache_size: int = 0):
+                 continuous_chunk: int = 8, prefix_cache_size: int = 0,
+                 prefill_chunk: int = 0):
         from pyspark_tf_gke_tpu.data.text import get_tokenizer
         from pyspark_tf_gke_tpu.train.export import load_serving_bundle
 
@@ -317,6 +321,10 @@ class BundleServer:
             "score_requests_total": 0,
         }
         self._front = None
+        if prefill_chunk and not continuous_slots:
+            raise ValueError(
+                "--prefill-chunk requires --continuous-slots (chunked "
+                "prefill is a slot-engine feature)")
         if continuous_slots:
             # multi-host: the engine announces each device op over the
             # serving wire (OP_CB_*) and the worker loops replay it into
@@ -326,7 +334,8 @@ class BundleServer:
                 eos_id=getattr(self.tokenizer, "eos_id", None),
                 num_slots=continuous_slots, chunk=continuous_chunk,
                 mesh=mesh, announce=self.multi_host,
-                prefix_cache_size=prefix_cache_size)
+                prefix_cache_size=prefix_cache_size,
+                prefill_chunk=prefill_chunk)
 
     # -- health ----------------------------------------------------------
 
@@ -894,6 +903,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="LRU entries of prefilled shared prompt "
                         "prefixes (POST /v1/warm); requires "
                         "--continuous-slots, single-host")
+    p.add_argument("--prefill-chunk", type=int,
+                   default=int(e("PREFILL_CHUNK", "0")),
+                   help="chunked prefill: admit prompts longer than "
+                        "this in bounded pieces with decode chunks "
+                        "interleaved (0 = whole-prompt prefill; "
+                        "requires --continuous-slots, single-host)")
     p.add_argument("--continuous-chunk", type=int,
                    default=int(e("CONTINUOUS_CHUNK", "8")),
                    help="decode steps per engine dispatch between "
@@ -963,7 +978,8 @@ def main(argv=None) -> int:
                           if args.draft_bundle else ""),
         continuous_slots=args.continuous_slots,
         continuous_chunk=args.continuous_chunk,
-        prefix_cache_size=args.prefix_cache)
+        prefix_cache_size=args.prefix_cache,
+        prefill_chunk=args.prefill_chunk)
     logger.info("bundle loaded: %s", server.health())
     if jax.process_count() > 1:
         # fail a misdeploy (draft bundle on some processes only) at

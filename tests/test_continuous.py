@@ -348,3 +348,103 @@ def test_sampling_validation():
         eng.submit([1, 2], 4, temperature=-0.5)
     with pytest.raises(ValueError, match="top_p"):
         eng.submit([1, 2], 4, temperature=0.9, top_p=1.5)
+
+
+def test_chunked_prefill_parity_and_interleaving():
+    # A long prompt admits in bounded pieces; decode chunks for already-
+    # streaming slots interleave between pieces; final tokens are
+    # identical to the whole-prefill path.
+    model, params = _tiny_model()
+    rng = np.random.default_rng(14)
+    long_prompt = rng.integers(1, 97, 100)
+    short_prompt = rng.integers(1, 97, 6)
+    exp_long = _reference_tokens(model, params, long_prompt, 5)
+    exp_short = _reference_tokens(model, params, short_prompt, 12)
+
+    eng = ContinuousEngine(model, params, num_slots=2, chunk=2,
+                           buckets=(16, 32, 64, 128),
+                           prefill_chunk=32)
+    rs = eng.submit(short_prompt, max_new_tokens=12)
+    rl = eng.submit(long_prompt, max_new_tokens=5)
+    interleaved = 0
+    results = {}
+    while eng.stats["queued"] or eng.stats["active"] or \
+            eng.stats["admitting"] is not None:
+        before = eng.stats
+        done = eng.step()
+        for req in done:
+            results[req.rid] = req.tokens
+        if before["admitting"] is not None and before["active"] > 0:
+            interleaved += 1  # a decode chunk ran for live slots WHILE
+            #   the long admission was still in flight
+    assert results[rl] == exp_long
+    assert results[rs] == exp_short
+    # the short request must stream during the long one's piecewise
+    # admission (100 tokens / 32-wide pieces = several pieces, with a
+    # decode chunk between each)
+    assert interleaved >= 2
+
+
+def test_chunked_prefill_with_prefix_hit():
+    # prefix hit + long remainder: pieces start from the cached fill.
+    model, params = _tiny_model()
+    rng = np.random.default_rng(15)
+    system = rng.integers(1, 97, 20)
+    prompt = np.concatenate([system, rng.integers(1, 97, 70)])
+    expected = _reference_tokens(model, params, prompt, 6)
+    eng = ContinuousEngine(model, params, num_slots=1, chunk=3,
+                           buckets=(32, 64, 128), prefill_chunk=32,
+                           prefix_cache_size=1)
+    eng.warm_prefix(system)
+    rid = eng.submit(prompt, max_new_tokens=6)
+    results = dict(eng.run_until_drained())
+    assert results[rid] == expected
+    assert eng.stats["prefix_cache"]["hits"] == 1
+
+
+def test_chunked_prefill_cancel_mid_admission():
+    model, params = _tiny_model()
+    rng = np.random.default_rng(16)
+    long_prompt = rng.integers(1, 97, 100)
+    eng = ContinuousEngine(model, params, num_slots=1, chunk=2,
+                           buckets=(16, 32, 64, 128), prefill_chunk=32)
+    rid = eng.submit(long_prompt, max_new_tokens=4)
+    eng.step()  # starts the piecewise admission
+    assert eng.stats["admitting"] == rid
+    assert eng.cancel(rid) is True
+    assert eng.stats["admitting"] is None
+    # engine still serves
+    r2 = eng.submit(rng.integers(1, 97, 8), max_new_tokens=3)
+    results = dict(eng.run_until_drained())
+    assert len(results[r2]) == 3
+
+
+def test_chunked_prefill_validation():
+    model, params = _tiny_model()
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ContinuousEngine(model, params, num_slots=1, prefill_chunk=8)
+    with pytest.raises(ValueError, match="single-host"):
+        ContinuousEngine(model, params, num_slots=1, announce=True,
+                         prefill_chunk=64)
+
+
+def test_chunked_prefill_near_context_limit():
+    # Regression (review finding): the final piece near max_seq_len
+    # must clamp its width — a full-width padded write would be
+    # position-clamped by dynamic_update_slice and overwrite real
+    # prompt rows, corrupting completions silently.
+    cfg = CausalLMConfig(
+        vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=64, max_seq_len=100)
+    from flax import linen as nn
+    model = CausalLM(cfg)
+    params = nn.meta.unbox(model.init(jax.random.key(0),
+                                      jnp.ones((1, 8), jnp.int32))["params"])
+    rng = np.random.default_rng(17)
+    prompt = rng.integers(1, 97, 98)  # 98 + 2 == max_seq_len
+    expected = _reference_tokens(model, params, prompt, 2)
+    eng = ContinuousEngine(model, params, num_slots=1, chunk=2,
+                           buckets=(32, 64, 100), prefill_chunk=32)
+    rid = eng.submit(prompt, max_new_tokens=2)
+    results = dict(eng.run_until_drained())
+    assert results[rid] == expected

@@ -508,3 +508,33 @@ def test_warm_validation(warm_endpoint):
             raise AssertionError("should 400")
         except urllib.error.HTTPError as exc:
             assert exc.code == 400
+
+
+def test_chunked_prefill_over_the_wire(tmp_path_factory):
+    # Regression: a request whose ONLY engine state is an in-flight
+    # piecewise admission (active=0, queued=0) must keep the driver
+    # loop stepping — the idle check parking on active/queued alone
+    # hung exactly this case.
+    cfg = dict(CFG)
+    cfg["max_seq_len"] = 128
+    c = CausalLMConfig(**cfg)
+    model = CausalLM(c)
+    params = nn.meta.unbox(jax.jit(model.init)(
+        make_rng(4), jnp.zeros((1, 8), jnp.int32))["params"])
+    bundle = str(tmp_path_factory.mktemp("serve-cp") / "bundle")
+    export_serving_bundle(c, params, bundle)
+    server = BundleServer(bundle, continuous_slots=2, continuous_chunk=2,
+                          prefill_chunk=32)
+    httpd = start_http_server(server, host="127.0.0.1", port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        long_prompt = "x" * 50  # 50 byte tokens > prefill_chunk 32
+        out = _post(url, "/v1/generate",
+                    {"prompt": long_prompt,
+                     "max_new_tokens": 4})["completions"][0]
+        assert out["new_tokens"] == 4
+        assert out["completion"].startswith(long_prompt)
+    finally:
+        httpd.shutdown()
+        server._front.shutdown()
