@@ -2,6 +2,8 @@ from pyspark_tf_gke_tpu.parallel.mesh import (
     AXES,
     DATA_AXES,
     make_mesh,
+    make_hybrid_mesh,
+    mesh_from_spec,
     batch_sharding,
     replicated_sharding,
     local_mesh_for_testing,
@@ -23,6 +25,8 @@ __all__ = [
     "AXES",
     "DATA_AXES",
     "make_mesh",
+    "make_hybrid_mesh",
+    "mesh_from_spec",
     "batch_sharding",
     "replicated_sharding",
     "local_mesh_for_testing",

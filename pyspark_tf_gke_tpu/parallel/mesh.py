@@ -75,6 +75,131 @@ def make_mesh(
     return Mesh(device_array, AXES)
 
 
+def make_hybrid_mesh(
+    dcn_axes: Optional[Mapping[str, int]] = None,
+    ici_axes: Optional[Mapping[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    force_contiguous: bool = False,
+) -> Mesh:
+    """Build a multi-slice ``Mesh`` whose device order respects the
+    ICI/DCN hierarchy.
+
+    A TPU pod slice is all-to-all connected over ICI; separate slices
+    only talk over DCN (data-center network, ~10-100x less bandwidth).
+    The reference never faces this — its gRPC parameter servers treat
+    every link the same (``train_tf_ps.py:440-511``) — but a mesh that
+    interleaves devices from different slices along an axis forces every
+    collective on that axis onto DCN. This constructor orders devices
+    **slice-major**: for each axis, the DCN component varies slowest, so
+    any axis-local group of ``ici_axes[a]`` neighbors is intra-slice and
+    XLA:TPU can decompose a cross-slice collective hierarchically
+    (reduce-scatter over ICI -> small allreduce over DCN -> all-gather
+    over ICI). Same contract as jax's
+    ``mesh_utils.create_hybrid_device_mesh``, restricted to the
+    canonical axis names.
+
+    ``dcn_axes``  axis -> number of slices it spans (usually ``{"dp": S}``:
+                  pure data parallelism is the only strategy cheap enough
+                  for DCN bandwidth).
+    ``ici_axes``  axis -> size within one slice (fsdp/tp/sp/ep/pp live
+                  here, where the collectives are per-step and heavy).
+    An axis present in both gets global size ``dcn*ici`` with slice-major
+    element order. ``make_mesh``'s flag UX carries over: at most one axis
+    (across both specs) may be -1 ("take the rest"), and an empty
+    ``ici_axes`` puts each slice's devices on ``dp`` — so adding
+    ``--dcn-mesh-shape dp=2`` to any working ``--mesh-shape`` keeps
+    working. ``force_contiguous`` skips slice-membership detection and
+    groups devices in order (tests pinning the CPU-fake layout).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    dcn = {a: 1 for a in AXES}
+    ici = {a: 1 for a in AXES}
+    for name, size in (dcn_axes or {}).items():
+        if name not in dcn:
+            raise ValueError(f"Unknown mesh axis {name!r}; valid axes: {AXES}")
+        dcn[name] = int(size)
+    if ici_axes:
+        for name, size in ici_axes.items():
+            if name not in ici:
+                raise ValueError(
+                    f"Unknown mesh axis {name!r}; valid axes: {AXES}")
+            ici[name] = int(size)
+    else:
+        ici["dp"] = -1  # make_mesh's default: remaining devices on dp
+
+    wildcard = [(spec, a) for spec in (dcn, ici)
+                for a, s in spec.items() if s == -1]
+    if len(wildcard) > 1:
+        raise ValueError("At most one hybrid-mesh axis may be -1")
+    if wildcard:
+        spec, axis = wildcard[0]
+        spec[axis] = 1
+        fixed = int(np.prod(list(dcn.values()))) * int(
+            np.prod(list(ici.values())))
+        if n % fixed:
+            raise ValueError(
+                f"{n} devices not divisible by fixed axes product {fixed}")
+        spec[axis] = n // fixed
+    n_slices = int(np.prod(list(dcn.values())))
+    per_slice = int(np.prod(list(ici.values())))
+    if n_slices * per_slice != n:
+        raise ValueError(
+            f"dcn {dict((a, s) for a, s in dcn.items() if s > 1)} x ici "
+            f"{dict((a, s) for a, s in ici.items() if s > 1)} require "
+            f"{n_slices}x{per_slice}={n_slices * per_slice} devices, have {n}")
+
+    # Group devices into slices: real TPU devices carry slice_index;
+    # fall back to process grouping (one host per slice is the common
+    # multi-slice deployment), then to contiguous chunks (CPU fake).
+    key = None
+    if not force_contiguous:
+        if all(getattr(d, "slice_index", None) is not None for d in devices):
+            key = lambda d: d.slice_index  # noqa: E731
+        elif n_slices > 1 and len({d.process_index for d in devices}) == n_slices:
+            key = lambda d: d.process_index  # noqa: E731
+    if key is None:
+        groups = [devices[i:i + per_slice]
+                  for i in range(0, n, per_slice)]
+    else:
+        by_slice: dict = {}
+        for d in devices:
+            by_slice.setdefault(key(d), []).append(d)
+        groups = [by_slice[k] for k in sorted(by_slice)]
+    if len(groups) != n_slices or any(len(g) != per_slice for g in groups):
+        raise ValueError(
+            f"Device slice grouping gave {[len(g) for g in groups]} devices "
+            f"per slice; need {n_slices} slices x {per_slice}")
+
+    dcn_shape = tuple(dcn[a] for a in AXES)
+    ici_shape = tuple(ici[a] for a in AXES)
+    global_shape = tuple(d * i for d, i in zip(dcn_shape, ici_shape))
+    arr = np.empty(global_shape, dtype=object)
+    for ordinal, group in enumerate(groups):
+        dcn_idx = np.unravel_index(ordinal, dcn_shape)
+        block = np.asarray(group, dtype=object).reshape(ici_shape)
+        dest = tuple(
+            slice(di * isz, (di + 1) * isz)
+            for di, isz in zip(dcn_idx, ici_shape)
+        )
+        arr[dest] = block
+    return Mesh(arr, AXES)
+
+
+def mesh_from_spec(
+    ici_axes: Optional[Mapping[str, int]] = None,
+    dcn_axes: Optional[Mapping[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Config-level dispatcher: a non-empty ``dcn_axes`` selects the
+    slice-major hybrid construction, otherwise the ordinary mesh."""
+    if dcn_axes:
+        return make_hybrid_mesh(dcn_axes, ici_axes, devices)
+    return make_mesh(ici_axes or None, devices)
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 1, extra: Optional[P] = None) -> NamedSharding:
     """Sharding for a host-fed batch: leading dim split over the data axes.
 

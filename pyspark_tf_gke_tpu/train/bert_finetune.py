@@ -30,7 +30,7 @@ import numpy as np
 from pyspark_tf_gke_tpu.data.native_tfrecord import read_tfrecord_batches
 from pyspark_tf_gke_tpu.models import BertConfig, BertForPretraining
 from pyspark_tf_gke_tpu.parallel.distributed import initialize_distributed
-from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
+from pyspark_tf_gke_tpu.parallel.mesh import mesh_from_spec
 from pyspark_tf_gke_tpu.train.harness import (
     finalize_run,
     local_batch_size,
@@ -94,6 +94,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--seed", type=int, default=int(e("SEED", "1337")))
     p.add_argument("--mesh-shape", default=e("MESH_SHAPE", ""),
                    help='e.g. "dp=2,fsdp=2" | "dp=2,sp=4" | "" → all chips on dp')
+    p.add_argument("--dcn-mesh-shape", default=e("DCN_MESH_SHAPE", ""),
+                   help='multi-slice: axes spanning DCN (e.g. "dp=2"); '
+                        "--mesh-shape then gives the intra-slice axes")
     p.add_argument("--output-dir", default=e("OUTPUT_DIR", "./bert-finetune"))
     p.add_argument("--checkpoint-every-steps", type=int,
                    default=int(e("CHECKPOINT_EVERY_STEPS", "0")))
@@ -150,7 +153,8 @@ def main(argv=None) -> dict:
         num_experts=args.num_experts,
         moe_every=args.moe_every,
     )
-    mesh = make_mesh(parse_mesh_shape(args.mesh_shape) or None)
+    mesh = mesh_from_spec(parse_mesh_shape(args.mesh_shape),
+                          parse_mesh_shape(args.dcn_mesh_shape))
     model = BertForPretraining(cfg, mesh=mesh, num_labels=args.num_labels)
     task = TASKS["bert_mlm" if args.objective == "mlm" else "bert_classification"]()
     tx = make_optimizer(

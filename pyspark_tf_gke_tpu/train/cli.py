@@ -31,7 +31,7 @@ from pyspark_tf_gke_tpu.data.pipeline import (
 )
 from pyspark_tf_gke_tpu.models import build_model
 from pyspark_tf_gke_tpu.parallel.distributed import initialize_distributed
-from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
+from pyspark_tf_gke_tpu.parallel.mesh import mesh_from_spec
 from pyspark_tf_gke_tpu.train.checkpoint import save_label_map
 from pyspark_tf_gke_tpu.train.harness import (
     finalize_run,
@@ -82,7 +82,7 @@ def run_csv_training(cfg: Config, fault_injector: Optional[FaultInjector] = None
     # one epoch = one dataset pass.
     steps = -(-steps // cfg.grad_accum_steps)
 
-    mesh = make_mesh(cfg.mesh_axes() or None)
+    mesh = mesh_from_spec(cfg.mesh_axes(), cfg.dcn_mesh_axes())
     model = build_model("mlp", num_classes=num_classes)
     tx = make_optimizer(cfg.learning_rate, cfg.lr_schedule,
                         total_steps=cfg.epochs * steps, warmup_steps=cfg.warmup_steps,
@@ -156,7 +156,7 @@ def run_image_training(cfg: Config, fault_injector: Optional[FaultInjector] = No
             f"Image mode trains the CNN regressor; got --model {cfg.model}. "
             "ResNet/BERT workloads have dedicated entry points (see bench.py)."
         )
-    mesh = make_mesh(cfg.mesh_axes() or None)
+    mesh = mesh_from_spec(cfg.mesh_axes(), cfg.dcn_mesh_axes())
     model = build_model("cnn", flat=cfg.flat_layer, dtype=_dtype(cfg.compute_dtype))
     tx = make_optimizer(cfg.learning_rate, cfg.lr_schedule,
                         total_steps=cfg.epochs * steps, warmup_steps=cfg.warmup_steps,

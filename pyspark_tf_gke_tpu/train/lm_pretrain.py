@@ -27,7 +27,7 @@ import numpy as np
 from pyspark_tf_gke_tpu.data.text import get_tokenizer, lm_batches
 from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
 from pyspark_tf_gke_tpu.parallel.distributed import initialize_distributed
-from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
+from pyspark_tf_gke_tpu.parallel.mesh import mesh_from_spec
 from pyspark_tf_gke_tpu.train.harness import (
     finalize_run,
     local_batch_size,
@@ -129,6 +129,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--seed", type=int, default=int(e("SEED", "1337")))
     p.add_argument("--mesh-shape", default=e("MESH_SHAPE", ""),
                    help='e.g. "dp=2,fsdp=2" | "" → all chips on dp')
+    p.add_argument("--dcn-mesh-shape", default=e("DCN_MESH_SHAPE", ""),
+                   help='multi-slice: axes spanning DCN (e.g. "dp=2"); '
+                        "--mesh-shape then gives the intra-slice axes")
     p.add_argument("--output-dir", default=e("OUTPUT_DIR", "./lm-pretrain"))
     p.add_argument("--checkpoint-every-steps", type=int,
                    default=int(e("CHECKPOINT_EVERY_STEPS", "0")))
@@ -202,7 +205,8 @@ def main(argv=None) -> dict:
         remat=args.remat,
         kv_cache_quant=args.kv_cache_quant,
     )
-    mesh = make_mesh(parse_mesh_shape(args.mesh_shape) or None)
+    mesh = mesh_from_spec(parse_mesh_shape(args.mesh_shape),
+                          parse_mesh_shape(args.dcn_mesh_shape))
     model = CausalLM(cfg, mesh=mesh)
     task = TASKS["causal_lm"](vocab_chunks=args.vocab_chunks or None)
     tx = make_optimizer(

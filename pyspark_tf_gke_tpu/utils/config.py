@@ -89,6 +89,10 @@ class Config:
     # --- mesh / parallelism (compile-time sharding, replaces the
     #     reference's WORKER_REPLICAS/PS_REPLICAS process topology) ---
     mesh_shape: str = _env("MESH_SHAPE", "")  # e.g. "dp=4,fsdp=2" | "" → all devices on dp
+    # Multi-slice: axes spanning DCN (slice-to-slice), e.g. "dp=2" for 2
+    # pod slices. Non-empty → the mesh is built slice-major
+    # (make_hybrid_mesh) with mesh_shape as the intra-slice (ICI) axes.
+    dcn_mesh_shape: str = _env("DCN_MESH_SHAPE", "")
     fsdp_min_size: int = _env_int("FSDP_MIN_SIZE", 256 << 10 >> 2)
     # ^ min number of elements before a param is FSDP-sharded — the analog of the
     #   reference's MinSizePartitioner(min_shard_bytes=256KB) (train_tf_ps.py:505-507).
@@ -118,6 +122,9 @@ class Config:
 
     def mesh_axes(self) -> dict:
         return parse_mesh_shape(self.mesh_shape)
+
+    def dcn_mesh_axes(self) -> dict:
+        return parse_mesh_shape(self.dcn_mesh_shape)
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -166,6 +173,10 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
                    help="microbatches accumulated per optimizer step")
     p.add_argument("--compute-dtype", default=cfg.compute_dtype)
     p.add_argument("--mesh-shape", default=cfg.mesh_shape, help='e.g. "dp=4,fsdp=2"; empty → all devices on dp')
+    p.add_argument("--dcn-mesh-shape", default=cfg.dcn_mesh_shape,
+                   help='multi-slice: axes spanning DCN, e.g. "dp=2" for 2 '
+                        "pod slices (mesh becomes slice-major; --mesh-shape "
+                        "then gives the intra-slice axes)")
     p.add_argument("--coordinator-addr", default=cfg.coordinator_addr)
     p.add_argument("--coordinator-port", type=int, default=cfg.coordinator_port)
     p.add_argument("--num-processes", type=int, default=cfg.num_processes)

@@ -7,7 +7,11 @@ from pyspark_tf_gke_tpu.parallel.distributed import (
     process_ordinal_from_hostname,
     validate_ipv4,
 )
-from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding, make_mesh
+from pyspark_tf_gke_tpu.parallel.mesh import (
+    batch_sharding,
+    make_hybrid_mesh,
+    make_mesh,
+)
 from pyspark_tf_gke_tpu.parallel.sharding import fsdp_spec
 
 
@@ -69,6 +73,58 @@ def test_validate_ipv4_rejects_bad():
         validate_ipv4("300.1.1.1")
     validate_ipv4("192.168.1.10")  # ok
     validate_ipv4("my-host.example:8476")  # DNS names ok
+
+
+def test_hybrid_mesh_slice_major_order(devices):
+    # 2 "slices" of 4 devices: dp over DCN, fsdp x tp inside a slice.
+    # Every intra-slice axis group must hold devices of ONE slice.
+    mesh = make_hybrid_mesh({"dp": 2}, {"fsdp": 2, "tp": 2},
+                            devices, force_contiguous=True)
+    assert mesh.shape["dp"] == 2
+    assert mesh.shape["fsdp"] == 2 and mesh.shape["tp"] == 2
+    arr = mesh.devices  # canonical order (dp, fsdp, pp, tp, sp, ep)
+    slice0 = set(d.id for d in devices[:4])
+    slice1 = set(d.id for d in devices[4:])
+    dp0 = {d.id for d in arr[0].flatten()}
+    dp1 = {d.id for d in arr[1].flatten()}
+    assert dp0 == slice0 and dp1 == slice1
+
+
+def test_hybrid_mesh_axis_spanning_both_networks(devices):
+    # dp = 2 slices x 2 in-slice -> global dp=4 with the DCN component
+    # varying slowest: dp rows [0,1] come from slice 0, [2,3] from slice 1.
+    mesh = make_hybrid_mesh({"dp": 2}, {"dp": 2, "tp": 2},
+                            devices, force_contiguous=True)
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+    arr = mesh.devices
+    slice0 = set(d.id for d in devices[:4])
+    first_half = {d.id for d in arr[:2].flatten()}
+    assert first_half == slice0
+
+
+def test_hybrid_mesh_validation(devices):
+    with pytest.raises(ValueError):
+        make_hybrid_mesh({"dp": 3}, {"tp": 2}, devices)  # 6 != 8
+    with pytest.raises(ValueError):
+        make_hybrid_mesh({"bogus": 2}, {"tp": 4}, devices)
+    with pytest.raises(ValueError):  # two wildcards
+        make_hybrid_mesh({"dp": -1}, {"tp": -1}, devices)
+
+
+def test_hybrid_mesh_executes_collectives(devices):
+    # A data-sharded mean over the hybrid mesh must equal the local mean:
+    # the psum rides dp (cross-slice) and fsdp (in-slice) together.
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    mesh = make_hybrid_mesh({"dp": 2}, {"fsdp": 2, "tp": 2},
+                            devices, force_contiguous=True)
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    xs = jax.device_put(x, batch_sharding(mesh, ndim=2))
+    out = jax.jit(lambda a: jnp.mean(a, axis=0),
+                  out_shardings=NamedSharding(mesh, P()))(xs)
+    np.testing.assert_allclose(np.asarray(out), x.mean(axis=0), rtol=1e-6)
 
 
 def test_mesh_extent_for_follows_rules(devices):
