@@ -323,3 +323,31 @@ def test_s2d_rejected_off_resnet50():
 
     with pytest.raises(SystemExit, match="resnet50 workload only"):
         bench.run_bench(["cnn", "--s2d"])
+
+
+def test_trail_report_latest_per_identity(tmp_path):
+    # The report must pick the LATEST entry per order-insensitive argv
+    # identity and render one markdown row for each.
+    from tools import trail_report
+
+    trail = tmp_path / "hist.jsonl"
+    rows = [
+        {"ts": "t1", "argv": ["cnn"],
+         "result": {"metric": "m", "value": 1.0, "unit": "u"}},
+        {"ts": "t2", "argv": ["cnn"],
+         "result": {"metric": "m", "value": 2.0, "unit": "u"}},
+        {"ts": "t3", "argv": ["--s2d", "resnet50"],
+         "result": {"metric": "r", "value": 3.0, "unit": "u"}},
+        "not json at all",
+    ]
+    trail.write_text("\n".join(
+        r if isinstance(r, str) else json.dumps(r) for r in rows) + "\n")
+    entries = trail_report.load(str(trail))
+    assert len(entries) == 3  # bad line tolerated
+    latest = trail_report.latest_per_identity(entries)
+    assert [e["ts"] for e in latest] == ["t2", "t3"]
+    # identity is order-insensitive: same as bench.py's variant guard
+    assert trail_report.identity(["resnet50", "--s2d"]) == \
+        trail_report.identity(["--s2d", "resnet50"])
+    out = trail_report.row(latest[0])
+    assert "**2 u**" in out and "`t2`" in out

@@ -1,0 +1,123 @@
+"""Render the committed evidence trail as a markdown table.
+
+The round-3 verdict's documentation rule is "no bare perf claim
+anywhere" — every figure in README/PARITY either cites a
+``tools/bench_history.jsonl`` timestamp or carries an explicit
+"unverified" tag. This tool makes honoring that rule mechanical: it
+groups the trail by bench identity (the full argv, order-insensitive —
+the same identity bench.py uses, so variants can never stand in for
+each other), keeps the latest entry per identity, and prints the
+markdown rows that PARITY's "Measured results" table is built from.
+
+    python tools/trail_report.py             # latest per identity
+    python tools/trail_report.py --all       # every entry, chronological
+    python tools/trail_report.py --json      # machine-readable summary
+
+Reference counterpart: the run-notes artifacts the reference checks in
+next to its model (`/root/reference/workloads/raw-tf/tf-model/*.txt`) —
+here an append-only measurement log with the rendering split out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+TRAIL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "bench_history.jsonl")
+
+# Keys worth a column when present (in display order).
+EXTRA_KEYS = ("step_time_ms", "mfu", "batch_size", "device_kind",
+              "vs_baseline")
+
+
+def identity(argv) -> str:
+    """Order-insensitive bench identity (argv sorted, joined)."""
+    return " ".join(sorted(argv)) if argv else "?"
+
+
+def load(path: str = TRAIL) -> list:
+    entries = []
+    try:
+        fh = open(path)
+    except OSError:
+        return entries
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue  # per-line tolerance, same stance as bench.py
+            if isinstance(e, dict) and isinstance(e.get("result"), dict):
+                entries.append(e)
+    return entries
+
+
+def latest_per_identity(entries: list) -> list:
+    """Latest entry per identity, in first-seen identity order."""
+    by_id: dict = {}
+    order = []
+    for e in entries:
+        key = identity(e.get("argv"))
+        if key not in by_id:
+            order.append(key)
+        by_id[key] = e  # trail is append-only chronological
+    return [by_id[k] for k in order]
+
+
+def row(e: dict) -> str:
+    r = e["result"]
+    extras = []
+    for k in EXTRA_KEYS:
+        if r.get(k) is not None:
+            v = r[k]
+            if k == "mfu":
+                extras.append(f"mfu {100 * v:.1f}%")
+            elif isinstance(v, float):
+                extras.append(f"{k} {v:g}")
+            else:
+                extras.append(f"{k} {v}")
+    return (f"| `{' '.join(e.get('argv') or [])}` | {r.get('metric')} | "
+            f"**{r.get('value'):g} {r.get('unit')}** | "
+            f"{'; '.join(extras)} | `{e.get('ts')}` |")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--all", action="store_true",
+                    help="every entry chronologically, not latest-per-identity")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary instead of markdown")
+    ap.add_argument("--trail", default=TRAIL)
+    args = ap.parse_args(argv)
+
+    entries = load(args.trail)
+    if not entries:
+        print(f"no trail entries at {args.trail}", file=sys.stderr)
+        return 1
+    picked = entries if args.all else latest_per_identity(entries)
+    if args.json:
+        print(json.dumps([
+            {"ts": e.get("ts"), "argv": e.get("argv"),
+             "metric": e["result"].get("metric"),
+             "value": e["result"].get("value"),
+             "unit": e["result"].get("unit")}
+            for e in picked]))
+        return 0
+    print("| Workload | Metric | Value | Detail | Trail ts |")
+    print("|---|---|---|---|---|")
+    for e in picked:
+        print(row(e))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `| head` closing the pipe is not an error
+        sys.exit(0)
