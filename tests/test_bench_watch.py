@@ -10,6 +10,8 @@ never touch the tunnel: subprocess/orchestrate layers are monkeypatched.
 import json
 import subprocess
 
+import pytest
+
 import bench
 from tools import bench_watch
 
@@ -351,3 +353,30 @@ def test_trail_report_latest_per_identity(tmp_path):
         trail_report.identity(["--s2d", "resnet50"])
     out = trail_report.row(latest[0])
     assert "**2 u**" in out and "`t2`" in out
+
+
+def test_trail_report_update_doc(tmp_path):
+    # --update must rewrite ONLY the marked block, idempotently, and
+    # refuse a doc without the marker pair (silent no-op would defeat
+    # the no-stale-figures guarantee).
+    from tools import trail_report
+
+    trail = tmp_path / "hist.jsonl"
+    trail.write_text(json.dumps(
+        {"ts": "t9", "argv": ["cnn"],
+         "result": {"metric": "m", "value": 7.5, "unit": "u"}}) + "\n")
+    doc = tmp_path / "doc.md"
+    doc.write_text("before\n<!-- trail:table:begin -->\nstale\n"
+                   "<!-- trail:table:end -->\nafter\n")
+    rc = trail_report.main(["--update", str(doc), "--trail", str(trail)])
+    assert rc == 0
+    text = doc.read_text()
+    assert "stale" not in text and "**7.5 u**" in text
+    assert text.startswith("before\n") and text.endswith("after\n")
+    # idempotent: second run leaves the file byte-identical
+    trail_report.main(["--update", str(doc), "--trail", str(trail)])
+    assert doc.read_text() == text
+    bare = tmp_path / "bare.md"
+    bare.write_text("no markers here\n")
+    with pytest.raises(SystemExit):
+        trail_report.main(["--update", str(bare), "--trail", str(trail)])

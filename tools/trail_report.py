@@ -12,6 +12,10 @@ markdown rows that PARITY's "Measured results" table is built from.
     python tools/trail_report.py             # latest per identity
     python tools/trail_report.py --all       # every entry, chronological
     python tools/trail_report.py --json      # machine-readable summary
+    python tools/trail_report.py --update docs/PARITY.md
+        # rewrite the table between the ``<!-- trail:table:begin -->`` /
+        # ``<!-- trail:table:end -->`` markers in place, so the published
+        # results table can never drift from the committed evidence
 
 Reference counterpart: the run-notes artifacts the reference checks in
 next to its model (`/root/reference/workloads/raw-tf/tf-model/*.txt`) —
@@ -28,9 +32,12 @@ import sys
 TRAIL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "bench_history.jsonl")
 
-# Keys worth a column when present (in display order).
+# Keys worth a column when present (in display order). Any
+# ``max_throughput_*`` keys (the disclosed throughput-batch secondaries)
+# are appended dynamically so a published secondary can't silently drop
+# out of the rendered table.
 EXTRA_KEYS = ("step_time_ms", "mfu", "batch_size", "device_kind",
-              "vs_baseline")
+              "vs_baseline", "write_rows_per_sec")
 
 
 def identity(argv) -> str:
@@ -73,11 +80,12 @@ def latest_per_identity(entries: list) -> list:
 def row(e: dict) -> str:
     r = e["result"]
     extras = []
-    for k in EXTRA_KEYS:
+    dynamic = sorted(k for k in r if k.startswith("max_throughput_"))
+    for k in (*EXTRA_KEYS, *dynamic):
         if r.get(k) is not None:
             v = r[k]
-            if k == "mfu":
-                extras.append(f"mfu {100 * v:.1f}%")
+            if k == "mfu" or k == "max_throughput_mfu":
+                extras.append(f"{k} {100 * v:.1f}%")
             elif isinstance(v, float):
                 extras.append(f"{k} {v:g}")
             else:
@@ -87,20 +95,59 @@ def row(e: dict) -> str:
             f"{'; '.join(extras)} | `{e.get('ts')}` |")
 
 
+BEGIN_MARK = "<!-- trail:table:begin -->"
+END_MARK = "<!-- trail:table:end -->"
+
+
+def render_table(picked: list) -> str:
+    lines = ["| Workload | Metric | Value | Detail | Trail ts |",
+             "|---|---|---|---|---|"]
+    lines += [row(e) for e in picked]
+    return "\n".join(lines)
+
+
+def update_doc(doc_path: str, picked: list) -> None:
+    """Replace the markdown between the trail markers with the freshly
+    rendered table. Raises if the markers are missing/misordered — a
+    silent no-op would defeat the no-stale-figures guarantee."""
+    with open(doc_path) as fh:
+        text = fh.read()
+    try:
+        head, rest = text.split(BEGIN_MARK, 1)
+        _, tail = rest.split(END_MARK, 1)
+    except ValueError:
+        raise SystemExit(
+            f"{doc_path} lacks the {BEGIN_MARK} / {END_MARK} marker pair")
+    new = (head + BEGIN_MARK + "\n" + render_table(picked) + "\n"
+           + END_MARK + tail)
+    with open(doc_path, "w") as fh:
+        fh.write(new)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--all", action="store_true",
                     help="every entry chronologically, not latest-per-identity")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable summary instead of markdown")
+    ap.add_argument("--update", metavar="DOC",
+                    help="rewrite DOC's marked trail table in place")
     ap.add_argument("--trail", default=TRAIL)
     args = ap.parse_args(argv)
 
+    if args.update and args.all:
+        raise SystemExit(
+            "--update publishes the latest entry per identity; --all would "
+            "write superseded rows into the doc (refusing the combination)")
     entries = load(args.trail)
     if not entries:
         print(f"no trail entries at {args.trail}", file=sys.stderr)
         return 1
     picked = entries if args.all else latest_per_identity(entries)
+    if args.update:
+        update_doc(args.update, picked)
+        print(f"updated {args.update} ({len(picked)} rows)", file=sys.stderr)
+        return 0
     if args.json:
         print(json.dumps([
             {"ts": e.get("ts"), "argv": e.get("argv"),
@@ -109,10 +156,7 @@ def main(argv=None) -> int:
              "unit": e["result"].get("unit")}
             for e in picked]))
         return 0
-    print("| Workload | Metric | Value | Detail | Trail ts |")
-    print("|---|---|---|---|---|")
-    for e in picked:
-        print(row(e))
+    print(render_table(picked))
     return 0
 
 
