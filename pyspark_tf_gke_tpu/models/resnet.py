@@ -61,6 +61,15 @@ class BottleneckBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+class _Identity(nn.Module):
+    """Norm stand-in for the ``norm_variant="none"`` diagnostic: accepts
+    and ignores the kwargs the real norm factory receives."""
+
+    @nn.compact
+    def __call__(self, x):
+        return x
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     num_classes: int = 1000
@@ -77,14 +86,39 @@ class ResNet(nn.Module):
     # (``bench.py resnet50 --s2d``), not a drop-in weight-compatible
     # swap.
     s2d_stem: bool = False
+    # Normalization lever for the MFU investigation (docs/PARITY.md):
+    # "bn" (default, bf16 normalize / f32 stats), "bn_f32" (the whole
+    # norm in f32 — isolates bf16 round-trips around the stat
+    # reductions), "gn" (GroupNorm-32: no batch reduction, fuses as
+    # plain elementwise), "none" (identity — bounds the total norm cost;
+    # diagnostic only, does not train well). Measured by
+    # tools/mfu_probe.py on hardware; the training default stays "bn".
+    norm_variant: str = "bn"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = functools.partial(
-            nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype,
-        )
+        if self.norm_variant == "bn":
+            norm = functools.partial(
+                nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                epsilon=1e-5, dtype=self.dtype,
+            )
+        elif self.norm_variant == "bn_f32":
+            norm = functools.partial(
+                nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                epsilon=1e-5, dtype=jnp.float32,
+            )
+        elif self.norm_variant == "gn":
+            norm = functools.partial(
+                nn.GroupNorm, num_groups=32, epsilon=1e-5, dtype=self.dtype,
+            )
+        elif self.norm_variant == "none":
+            def norm(**kw):  # swallow factory kwargs (scale_init, ...)
+                return _Identity(name=kw.get("name"))
+        else:
+            raise ValueError(
+                f"norm_variant must be bn|bn_f32|gn|none, got "
+                f"{self.norm_variant!r}")
         x = x.astype(self.dtype) if self.dtype else x
         if self.s2d_stem:
             x = space_to_depth(x, 2)

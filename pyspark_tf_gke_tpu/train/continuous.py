@@ -432,22 +432,38 @@ class SlotDeviceState:
         self.insert(cache1, logits1, slot, true_len,
                     temperature=temperature, top_p=top_p, seed=seed)
 
-    def chunk(self, chunk: int, eos_token_id: Optional[int],
-              pad_id: int, sampling: bool = False):
-        """One decode chunk over all slots (``sampling`` static: the
-        pure-greedy pool compiles without the sampling math). Returns
-        host-readable (tokens [B, chunk], live [B]) — gathered on
-        multi-process meshes so every process can read them."""
-        from pyspark_tf_gke_tpu.parallel.distributed import as_host_array
-
+    def chunk_async(self, chunk: int, eos_token_id: Optional[int],
+                    pad_id: int, sampling: bool = False):
+        """Dispatch one decode chunk over all slots (``sampling``
+        static: the pure-greedy pool compiles without the sampling
+        math) WITHOUT reading the result back: returns device arrays
+        (tokens [B, chunk], live [B]). The caller chooses when to pay
+        the device->host sync — the decode-ahead pipeline defers it one
+        chunk so the readback latency overlaps the next chunk's
+        compute."""
         with self._mesh_ctx():
             self.state, toks = _decode_chunk(
                 self.model, self.params, self.state, chunk=chunk,
                 eos_token_id=eos_token_id, pad_id=pad_id,
                 sampling=sampling, mesh=self.mesh)
+            return toks, self.state.live
+
+    def fetch(self, toks, live):
+        """Materialize a dispatched chunk's results on the host —
+        gathered on multi-process meshes so every process can read
+        them."""
+        from pyspark_tf_gke_tpu.parallel.distributed import as_host_array
+
+        with self._mesh_ctx():
             toks_host = np.asarray(as_host_array(toks))
-            live_host = np.asarray(as_host_array(self.state.live))
+            live_host = np.asarray(as_host_array(live))
         return toks_host, live_host
+
+    def chunk(self, chunk: int, eos_token_id: Optional[int],
+              pad_id: int, sampling: bool = False):
+        """Dispatch + immediate readback (the unpipelined path)."""
+        return self.fetch(*self.chunk_async(chunk, eos_token_id, pad_id,
+                                            sampling=sampling))
 
     def free(self, slot: int) -> None:
         """Drop a slot's live flag (request finished or cancelled)."""
@@ -477,9 +493,28 @@ class ContinuousEngine:
                  buckets: Sequence[int] = PAD_BUCKETS,
                  mesh=None, announce: bool = False,
                  prefix_cache_size: int = 0,
-                 prefill_chunk: int = 0):
+                 prefill_chunk: int = 0,
+                 pipeline_depth: int = 0):
         if num_slots < 1 or chunk < 1:
             raise ValueError("num_slots and chunk must be >= 1")
+        if pipeline_depth not in (0, 1):
+            raise ValueError("pipeline_depth must be 0 or 1")
+        if pipeline_depth and announce:
+            # Deferring process 0's readback would reorder the
+            # as_host_array collectives against the workers' replay
+            # order — same single-host gate as the prefix cache.
+            raise ValueError(
+                "decode-ahead pipelining is single-host only (announce "
+                "mode)")
+        # pipeline_depth=1 ("decode-ahead"): dispatch chunk N+1 before
+        # reading chunk N's tokens, so the device->host readback latency
+        # (which DOMINATES the cycle on a remote-attached chip) overlaps
+        # the next chunk's compute. Token content per request is
+        # unchanged — each slot's rows depend only on its own prompt —
+        # but eos frees and admissions take effect one chunk later
+        # (bounded extra compute, discarded by the host budget clamp).
+        self.pipeline_depth = pipeline_depth
+        self._inflight = None  # (toks_dev, live_dev, slots snapshot)
         if prefill_chunk and prefill_chunk < 32:
             raise ValueError(
                 f"prefill_chunk must be 0 (off) or >= 32, got "
@@ -588,6 +623,8 @@ class ContinuousEngine:
                 return True
         for slot, req in list(self._slots.items()):
             if req.rid == rid:
+                req.done = True  # an in-flight decode-ahead snapshot
+                #                  must skip it at collect time
                 del self._slots[slot]
                 self._free_slot(slot)
                 return True
@@ -766,25 +803,45 @@ class ContinuousEngine:
             self._queue.pop(0)
 
     # -- the loop --------------------------------------------------------
-    def step(self) -> List[_Request]:
-        """Admit into free slots, run one decode chunk, collect tokens.
-        Returns requests finished during this chunk."""
-        if self._admitting is not None:
-            self._advance_admission()
-        self._admit_waiting()
-        if not self._slots:
-            return []
+    def _dispatch_chunk(self):
+        """Dispatch one decode chunk over the current slots; returns the
+        in-flight record (arrays + the slot->request snapshot the chunk
+        was computed over). In announce mode the dispatch AND the
+        as_host_array gathers stay inside one hold of the announce lock
+        (the workers replay dispatch+gather as one op, so process 0
+        must not interleave another announced op between them); the
+        record then carries host arrays and ``_collect``'s fetch is a
+        no-op."""
         any_sampling = any(r.temperature > 0
                            for r in self._slots.values())
-        toks, live_host = self._announced(
-            lambda wire: wire.announce_cb_chunk(
-                self.num_slots, self.chunk, self.eos_token_id,
-                self.pad_id, sampling=any_sampling),
-            lambda: self._device.chunk(
-                self.chunk, self.eos_token_id, self.pad_id,
-                sampling=any_sampling))
+        if self.announce:
+            toks, live = self._announced(
+                lambda wire: wire.announce_cb_chunk(
+                    self.num_slots, self.chunk, self.eos_token_id,
+                    self.pad_id, sampling=any_sampling),
+                lambda: self._device.chunk(
+                    self.chunk, self.eos_token_id, self.pad_id,
+                    sampling=any_sampling))
+            return "host", toks, live, dict(self._slots)
+        toks_dev, live_dev = self._device.chunk_async(
+            self.chunk, self.eos_token_id, self.pad_id,
+            sampling=any_sampling)
+        return "dev", toks_dev, live_dev, dict(self._slots)
+
+    def _collect(self, inflight) -> List[_Request]:
+        """Read back one dispatched chunk and do the host bookkeeping
+        (token append, streaming callbacks, eos/budget completion,
+        frees) for the slot snapshot it was computed over."""
+        kind, a, b, snapshot = inflight
+        toks, live_host = (a, b) if kind == "host" \
+            else self._device.fetch(a, b)
         newly_done = []
-        for slot, req in list(self._slots.items()):
+        for slot, req in snapshot.items():
+            if req.done:
+                # freed/cancelled while this chunk was in flight (only
+                # possible with decode-ahead): its rows decoded garbage
+                # that nobody reads
+                continue
             budget = req.max_new_tokens - len(req.tokens)
             take = toks[slot, :budget]
             if self.eos_token_id is not None:
@@ -806,16 +863,39 @@ class ContinuousEngine:
             if eos_done or len(req.tokens) >= req.max_new_tokens:
                 req.done = True
                 newly_done.append(req)
-                del self._slots[slot]
+                if self._slots.get(slot) is req:
+                    del self._slots[slot]
                 # slot's live flag must drop so its rows stop advancing
                 self._free_slot(slot)
         self._n_finished += len(newly_done)
         return newly_done
 
+    def step(self) -> List[_Request]:
+        """Admit into free slots, run one decode chunk, collect tokens.
+        Returns requests finished during this chunk.
+
+        With ``pipeline_depth=1`` the collect is one chunk behind the
+        dispatch: the chunk launched this call is read back on the NEXT
+        call, so the device works through chunk N+1 while the host
+        waits on chunk N's tokens."""
+        if self._admitting is not None:
+            self._advance_admission()
+        self._admit_waiting()
+        if not self.pipeline_depth:
+            if not self._slots:
+                return []
+            return self._collect(self._dispatch_chunk())
+        new_inflight = self._dispatch_chunk() if self._slots else None
+        finished = (self._collect(self._inflight)
+                    if self._inflight is not None else [])
+        self._inflight = new_inflight
+        return finished
+
     def run_until_drained(self):
         """Drive steps until queue + slots are empty; yields finished
         requests in completion order."""
-        while self._queue or self._slots or self._admitting:
+        while (self._queue or self._slots or self._admitting
+               or self._inflight is not None):
             for req in self.step():
                 yield req.rid, req.tokens
 
@@ -829,6 +909,7 @@ class ContinuousEngine:
             "chunk": self.chunk,
             "admitting": (self._admitting["req"].rid
                           if self._admitting is not None else None),
+            "inflight": self._inflight is not None,
             **({"prefix_cache": self.prefix_cache.stats}
                if self.prefix_cache is not None else {}),
         }

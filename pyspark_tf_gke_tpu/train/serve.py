@@ -78,10 +78,11 @@ class _ContinuousFront:
 
     def __init__(self, model, params, eos_id, num_slots: int,
                  chunk: int, mesh=None, announce: bool = False,
-                 prefix_cache_size: int = 0, prefill_chunk: int = 0):
+                 prefix_cache_size: int = 0, prefill_chunk: int = 0,
+                 pipeline_depth: int = 0):
         self._engine_args = (model, params, eos_id, num_slots, chunk,
                              mesh, announce, prefix_cache_size,
-                             prefill_chunk)
+                             prefill_chunk, pipeline_depth)
         self._announce = announce
         self.engine = self._new_engine()
         self.lock = threading.Lock()
@@ -98,12 +99,14 @@ class _ContinuousFront:
         from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
 
         (model, params, eos_id, num_slots, chunk, mesh, announce,
-         prefix_cache_size, prefill_chunk) = self._engine_args
+         prefix_cache_size, prefill_chunk,
+         pipeline_depth) = self._engine_args
         return ContinuousEngine(model, params, num_slots=num_slots,
                                 chunk=chunk, eos_token_id=eos_id,
                                 mesh=mesh, announce=announce,
                                 prefix_cache_size=prefix_cache_size,
-                                prefill_chunk=prefill_chunk)
+                                prefill_chunk=prefill_chunk,
+                                pipeline_depth=pipeline_depth)
 
     def submit(self, prompt_ids, max_new_tokens: int,
                temperature: float = 0.0, top_p=None,
@@ -191,7 +194,8 @@ class _ContinuousFront:
                 try:
                     stats = self.engine.stats
                     busy = bool(stats["active"] or stats["queued"]
-                                or stats["admitting"] is not None)
+                                or stats["admitting"] is not None
+                                or stats["inflight"])
                     finished = self.engine.step() if busy else []
                     for req in finished:
                         slot = self._results.get(req.rid)
@@ -254,7 +258,7 @@ class BundleServer:
     def __init__(self, bundle_dir: str, mesh=None, int8_kv: bool = False,
                  draft_bundle_dir: str = "", continuous_slots: int = 0,
                  continuous_chunk: int = 8, prefix_cache_size: int = 0,
-                 prefill_chunk: int = 0):
+                 prefill_chunk: int = 0, continuous_pipeline: int = 0):
         from pyspark_tf_gke_tpu.data.text import get_tokenizer
         from pyspark_tf_gke_tpu.train.export import load_serving_bundle
 
@@ -335,7 +339,8 @@ class BundleServer:
                 num_slots=continuous_slots, chunk=continuous_chunk,
                 mesh=mesh, announce=self.multi_host,
                 prefix_cache_size=prefix_cache_size,
-                prefill_chunk=prefill_chunk)
+                prefill_chunk=prefill_chunk,
+                pipeline_depth=continuous_pipeline)
 
     # -- health ----------------------------------------------------------
 
@@ -913,6 +918,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default=int(e("CONTINUOUS_CHUNK", "8")),
                    help="decode steps per engine dispatch between "
                         "admission points")
+    p.add_argument("--continuous-pipeline", type=int,
+                   default=int(e("CONTINUOUS_PIPELINE", "0")),
+                   choices=(0, 1),
+                   help="decode-ahead: dispatch chunk N+1 before reading "
+                        "chunk N so the readback latency overlaps compute "
+                        "(measured +52%% engine tokens/sec over a "
+                        "remote-attached chip at chunk 64; single-host "
+                        "only)")
     p.add_argument("--stdin", action="store_true",
                    help="serve stdin lines instead of HTTP: each input "
                         "line is a prompt, each output line a JSON result")
@@ -979,7 +992,8 @@ def main(argv=None) -> int:
         continuous_slots=args.continuous_slots,
         continuous_chunk=args.continuous_chunk,
         prefix_cache_size=args.prefix_cache,
-        prefill_chunk=args.prefill_chunk)
+        prefill_chunk=args.prefill_chunk,
+        continuous_pipeline=args.continuous_pipeline)
     logger.info("bundle loaded: %s", server.health())
     if jax.process_count() > 1:
         # fail a misdeploy (draft bundle on some processes only) at

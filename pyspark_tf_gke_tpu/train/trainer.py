@@ -104,7 +104,10 @@ def resnet_task() -> TrainerTask:
             preds, new_state = model.apply(
                 variables, batch["image"], train=True, mutable=["batch_stats"]
             )
-            return preds, new_state["batch_stats"]
+            # Stat-free norm variants (gn/none diagnostics) yield no
+            # mutable collection; mirror init_state's None so the scan
+            # carry keeps one pytree structure either way.
+            return preds, new_state.get("batch_stats")
         return model.apply(variables, batch["image"], train=False), None
 
     return TrainerTask("resnet", forward, _image_cls_lam, has_batch_stats=True)

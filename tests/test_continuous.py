@@ -448,3 +448,67 @@ def test_chunked_prefill_near_context_limit():
     rid = eng.submit(prompt, max_new_tokens=2)
     results = dict(eng.run_until_drained())
     assert results[rid] == expected
+
+
+def test_decode_ahead_pipeline_parity_staggered():
+    # pipeline_depth=1 dispatches chunk N+1 before reading chunk N: the
+    # frees/admissions lag one chunk, but every request's TOKENS must be
+    # bit-identical to the unpipelined engine and to solo generate().
+    model, params = _tiny_model()
+    rng = np.random.default_rng(7)
+    specs = [(rng.integers(1, 97, int(n)), int(m))
+             for n, m in [(5, 12), (19, 3), (33, 8), (7, 15), (11, 5)]]
+    eng = ContinuousEngine(model, params, num_slots=2, chunk=3,
+                           buckets=(16, 32, 64), pipeline_depth=1)
+    rids = {eng.submit(p, max_new_tokens=m): (p, m) for p, m in specs}
+    results = dict(eng.run_until_drained())
+    assert set(results) == set(rids)
+    for rid, (p, m) in rids.items():
+        assert results[rid] == _reference_tokens(model, params, p, m), \
+            f"request {rid} diverged under decode-ahead"
+    assert eng.stats["finished"] == len(specs)
+    assert eng._inflight is None  # drained flushes the in-flight chunk
+
+
+def test_decode_ahead_eos_and_budget_clamp():
+    # eos mid-chunk with a chunk still in flight: the freed slot decodes
+    # one garbage chunk that must be discarded, and the emitted tokens
+    # stop exactly at eos — identical to the unpipelined engine.
+    model, params = _tiny_model()
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(1, 97, 8)
+    solo = _reference_tokens(model, params, prompt, 12)
+    eos = solo[2]
+    eng = ContinuousEngine(model, params, num_slots=1, chunk=4,
+                           eos_token_id=eos, buckets=(16,),
+                           pipeline_depth=1)
+    rid = eng.submit(prompt, max_new_tokens=12)
+    results = dict(eng.run_until_drained())
+    assert results[rid] == _reference_tokens(model, params, prompt, 12,
+                                             eos=eos)
+    assert results[rid][-1] == eos
+
+
+def test_decode_ahead_cancel_inflight_is_skipped():
+    # Cancel an ACTIVE request while its chunk is in flight: the stale
+    # snapshot must not resurrect it or yield it as finished.
+    model, params = _tiny_model()
+    rng = np.random.default_rng(9)
+    keep, drop = rng.integers(1, 97, 9), rng.integers(1, 97, 9)
+    eng = ContinuousEngine(model, params, num_slots=2, chunk=2,
+                           buckets=(16,), pipeline_depth=1)
+    rid_keep = eng.submit(keep, max_new_tokens=10)
+    rid_drop = eng.submit(drop, max_new_tokens=10)
+    eng.step()  # dispatches chunk 1 (nothing collected yet)
+    assert eng.cancel(rid_drop)
+    results = dict(eng.run_until_drained())
+    assert rid_drop not in results
+    assert results[rid_keep] == _reference_tokens(model, params, keep, 10)
+
+
+def test_decode_ahead_validation():
+    model, params = _tiny_model()
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        ContinuousEngine(model, params, pipeline_depth=2)
+    with pytest.raises(ValueError, match="single-host"):
+        ContinuousEngine(model, params, pipeline_depth=1, announce=True)

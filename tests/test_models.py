@@ -195,3 +195,37 @@ def test_resnet50_s2d_trains():
             params, batch_stats, opt_state)
         first = first if first is not None else float(loss)
     assert float(loss) < first  # the reparameterized stem learns
+
+
+def test_resnet_norm_variants_forward_and_trainer_step():
+    # The MFU-diagnostic norm lever (models/resnet.py norm_variant):
+    # every variant must produce finite logits of the right shape, and
+    # the stat-free variants (gn/none) must run through the Trainer's
+    # resnet task, whose batch_stats threading assumes BN by default.
+    import numpy as np
+
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding, make_mesh
+    from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": rng.uniform(0, 1, (4, 32, 32, 3)).astype(np.float32),
+        "label": rng.integers(0, 4, (4,)).astype(np.int32),
+    }
+    mesh = make_mesh({"dp": 2}, jax.devices()[:2])
+    for variant in ("bn_f32", "gn", "none"):
+        # num_filters=32: GroupNorm-32 needs channels divisible by 32
+        model = ResNet50(num_classes=4, num_filters=32, stage_sizes=(1, 1),
+                         dtype=None, norm_variant=variant)
+        trainer = Trainer(model, TASKS["resnet"](), mesh,
+                          learning_rate=1e-2)
+        state = trainer.init_state(make_rng(0), batch)
+        gb = {k: jax.device_put(v, batch_sharding(mesh))
+              for k, v in batch.items()}
+        state, metrics = trainer.step(state, gb)
+        assert np.isfinite(float(jax.device_get(metrics["loss"]))), variant
+
+    with pytest.raises(ValueError):
+        ResNet50(num_classes=4, norm_variant="bogus").init(
+            jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=True)
