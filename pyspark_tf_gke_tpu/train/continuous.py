@@ -499,13 +499,6 @@ class ContinuousEngine:
             raise ValueError("num_slots and chunk must be >= 1")
         if pipeline_depth not in (0, 1):
             raise ValueError("pipeline_depth must be 0 or 1")
-        if pipeline_depth and announce:
-            # Deferring process 0's readback would reorder the
-            # as_host_array collectives against the workers' replay
-            # order — same single-host gate as the prefix cache.
-            raise ValueError(
-                "decode-ahead pipelining is single-host only (announce "
-                "mode)")
         # pipeline_depth=1 ("decode-ahead"): dispatch chunk N+1 before
         # reading chunk N's tokens, so the device->host readback latency
         # (which DOMINATES the cycle on a remote-attached chip) overlaps
@@ -513,8 +506,12 @@ class ContinuousEngine:
         # unchanged — each slot's rows depend only on its own prompt —
         # but eos frees and admissions take effect one chunk later
         # (bounded extra compute, discarded by the host budget clamp).
+        # Multi-host (announce) composes: the chunk is announced
+        # deferred=1 (dispatch only) and the gathers run at a separately
+        # announced OP_CB_COLLECT, so every process defers identically
+        # and the collective order stays aligned with the replay order.
         self.pipeline_depth = pipeline_depth
-        self._inflight = None  # (toks_dev, live_dev, slots snapshot)
+        self._inflight = None  # (kind, toks, live, slots snapshot)
         if prefill_chunk and prefill_chunk < 32:
             raise ValueError(
                 f"prefill_chunk must be 0 (off) or >= 32, got "
@@ -814,7 +811,7 @@ class ContinuousEngine:
         no-op."""
         any_sampling = any(r.temperature > 0
                            for r in self._slots.values())
-        if self.announce:
+        if self.announce and not self.pipeline_depth:
             toks, live = self._announced(
                 lambda wire: wire.announce_cb_chunk(
                     self.num_slots, self.chunk, self.eos_token_id,
@@ -823,9 +820,13 @@ class ContinuousEngine:
                     self.chunk, self.eos_token_id, self.pad_id,
                     sampling=any_sampling))
             return "host", toks, live, dict(self._slots)
-        toks_dev, live_dev = self._device.chunk_async(
-            self.chunk, self.eos_token_id, self.pad_id,
-            sampling=any_sampling)
+        toks_dev, live_dev = self._announced(
+            lambda wire: wire.announce_cb_chunk(
+                self.num_slots, self.chunk, self.eos_token_id,
+                self.pad_id, sampling=any_sampling, deferred=True),
+            lambda: self._device.chunk_async(
+                self.chunk, self.eos_token_id, self.pad_id,
+                sampling=any_sampling))
         return "dev", toks_dev, live_dev, dict(self._slots)
 
     def _collect(self, inflight) -> List[_Request]:
@@ -833,8 +834,12 @@ class ContinuousEngine:
         (token append, streaming callbacks, eos/budget completion,
         frees) for the slot snapshot it was computed over."""
         kind, a, b, snapshot = inflight
-        toks, live_host = (a, b) if kind == "host" \
-            else self._device.fetch(a, b)
+        if kind == "host":
+            toks, live_host = a, b
+        else:
+            toks, live_host = self._announced(
+                lambda wire: wire.announce_cb_collect(self.num_slots),
+                lambda: self._device.fetch(a, b))
         newly_done = []
         for slot, req in snapshot.items():
             if req.done:

@@ -924,8 +924,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="decode-ahead: dispatch chunk N+1 before reading "
                         "chunk N so the readback latency overlaps compute "
                         "(measured +52%% engine tokens/sec over a "
-                        "remote-attached chip at chunk 64; single-host "
-                        "only)")
+                        "remote-attached chip at chunk 64; multi-host: "
+                        "the chunk is announced dispatch-only and the "
+                        "gathers replay at OP_CB_COLLECT)")
     p.add_argument("--stdin", action="store_true",
                    help="serve stdin lines instead of HTTP: each input "
                         "line is a prompt, each output line a JSON result")

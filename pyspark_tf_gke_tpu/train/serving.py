@@ -187,18 +187,27 @@ OP_SPECULATIVE = 3
 #        has_sampling=1 a float payload [temperature, top_p, seed]
 #        follows (per-slot sampling lane — every process seeds the
 #        same per-slot key, so sampled rows stay in lockstep)
-# CHUNK: [op, num_slots, 0, chunk, eos, has_sampling, pad_id, 0]
-#        (no payload; the op ends in as_host_array gathers every
-#        process joins; has_sampling is the STATIC flag choosing the
+# CHUNK: [op, num_slots, deferred, chunk, eos, has_sampling, pad_id, 0]
+#        (no payload; has_sampling is the STATIC flag choosing the
 #        greedy-only vs sampling-capable compiled chunk program — it
-#        must match across processes or they run different programs)
+#        must match across processes or they run different programs.
+#        deferred=0: the op ends in as_host_array gathers every process
+#        joins. deferred=1 — decode-ahead pipelining: the op is
+#        dispatch-ONLY; the gathers run at the matching OP_CB_COLLECT,
+#        so every process defers the readback identically and the
+#        collective order stays aligned)
+# COLLECT: [op, num_slots, 0, ...] — gather the OLDEST deferred
+#        chunk's tokens/live (at most two outstanding: process 0
+#        dispatches chunk N+1 before collecting chunk N)
 # FREE:  [op, num_slots, 0, 0, 0, slot, 0, 0]
 # RESET: [op, 0, ...] — drop the replica (process 0 rebuilt its engine
-#        after a failed step; states must restart from zeros together)
+#        after a failed step; states must restart from zeros together,
+#        any deferred chunk dropped with them)
 OP_CB_ADMIT = 4
 OP_CB_CHUNK = 5
 OP_CB_FREE = 6
 OP_CB_RESET = 7
+OP_CB_COLLECT = 8
 # [op, batch, prompt_len, max_new_tokens, eos (-1=none), aux,
 #  top_k (-1=none), extras (0/1/2)]
 # aux = num_beams for OP_GENERATE (beams>1 -> the deterministic beam
@@ -274,11 +283,19 @@ def announce_cb_admit(num_slots: int, padded, true_len: int, slot: int,
 
 
 def announce_cb_chunk(num_slots: int, chunk: int, eos_token_id,
-                      pad_id: int, sampling: bool = False) -> None:
+                      pad_id: int, sampling: bool = False,
+                      deferred: bool = False) -> None:
     header = np.zeros(_HEADER_LEN, np.int32)
     eos = -1 if eos_token_id is None else int(eos_token_id)
-    header[:7] = [OP_CB_CHUNK, num_slots, 0, chunk, eos, int(sampling),
-                  pad_id]
+    header[:7] = [OP_CB_CHUNK, num_slots, int(deferred), chunk, eos,
+                  int(sampling), pad_id]
+    _bcast(header)
+
+
+def announce_cb_collect(num_slots: int) -> None:
+    """Gather the one outstanding deferred chunk (decode-ahead)."""
+    header = np.zeros(_HEADER_LEN, np.int32)
+    header[:2] = [OP_CB_COLLECT, num_slots]
     _bcast(header)
 
 
@@ -493,19 +510,28 @@ def serve_worker_loop(model, params, mesh: Mesh,
     import logging
 
     logger = logging.getLogger("train.serving")
+    import collections
+
     served = 0
     cb_replica = None  # SlotDeviceState mirror of process 0's engine
     cb_poisoned = False  # a CB op failed HERE; only OP_CB_RESET heals
+    # deferred (decode-ahead) chunks awaiting COLLECT, oldest first.
+    # Process 0 dispatches chunk N+1 BEFORE collecting chunk N, so two
+    # may be outstanding between those ops; more means the streams
+    # desynced.
+    cb_inflight = collections.deque()
     while True:
         header = np.asarray(_bcast(np.zeros(_HEADER_LEN, np.int32)))
         op, b, s, max_new, eos, aux, tk, sampling = (
             int(v) for v in header)  # aux = beams (generate) / gamma (spec)
         if op == OP_SHUTDOWN:
             return served
-        if op in (OP_CB_ADMIT, OP_CB_CHUNK, OP_CB_FREE, OP_CB_RESET):
+        if op in (OP_CB_ADMIT, OP_CB_CHUNK, OP_CB_FREE, OP_CB_RESET,
+                  OP_CB_COLLECT):
             # continuous-batching replica ops. Field mapping per the
-            # OP_CB_* comment above: b=num_slots, s=s_bucket,
-            # max_new=true_len (admit) / chunk (chunk), aux=slot,
+            # OP_CB_* comment above: b=num_slots, s=s_bucket (admit) /
+            # deferred flag (chunk), max_new=true_len (admit) / chunk
+            # (chunk), aux=slot (admit/free) / has_sampling (chunk),
             # tk=pad_id.
             #
             # Failure discipline: a CB op that fails HERE poisons this
@@ -525,6 +551,7 @@ def serve_worker_loop(model, params, mesh: Mesh,
 
             if op == OP_CB_RESET:
                 cb_replica, cb_poisoned = None, False
+                cb_inflight.clear()
                 continue
             if cb_poisoned:
                 logger.error(
@@ -557,9 +584,30 @@ def serve_worker_loop(model, params, mesh: Mesh,
                     else:
                         cb_replica.admit_padded(padded, max_new, aux)
                 elif op == OP_CB_CHUNK:
-                    cb_replica.chunk(
-                        max_new, None if eos < 0 else eos, tk)
+                    # aux carries the STATIC has_sampling flag: the
+                    # replayed program must be the same one process 0
+                    # compiled (greedy-only vs sampling-capable), or
+                    # the processes execute different HLO over the
+                    # shared global slot state
+                    if s:  # deferred (decode-ahead): dispatch only,
+                        #    gathers run at the matching OP_CB_COLLECT
+                        if len(cb_inflight) >= 2:
+                            raise RuntimeError(
+                                "deferred-chunk stream desynced: "
+                                f"{len(cb_inflight)} outstanding")
+                        cb_inflight.append(cb_replica.chunk_async(
+                            max_new, None if eos < 0 else eos, tk,
+                            sampling=bool(aux)))
+                    else:
+                        cb_replica.chunk(
+                            max_new, None if eos < 0 else eos, tk,
+                            sampling=bool(aux))
                     served += 1
+                elif op == OP_CB_COLLECT:
+                    if not cb_inflight:
+                        raise RuntimeError(
+                            "OP_CB_COLLECT with no deferred chunk")
+                    cb_replica.fetch(*cb_inflight.popleft())
                 else:  # OP_CB_FREE
                     cb_replica.free(aux)
             except Exception:  # noqa: BLE001 — symmetric failures heal
@@ -567,6 +615,7 @@ def serve_worker_loop(model, params, mesh: Mesh,
                     "continuous-batching replica op %d failed; replica "
                     "poisoned until process 0's OP_CB_RESET", op)
                 cb_replica, cb_poisoned = None, True
+                cb_inflight.clear()
             continue
         prompt = np.asarray(_bcast(np.zeros((b, s), np.int32)))
         lengths = (np.asarray(_bcast(np.zeros(b, np.int32)))

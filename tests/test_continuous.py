@@ -510,5 +510,3 @@ def test_decode_ahead_validation():
     model, params = _tiny_model()
     with pytest.raises(ValueError, match="pipeline_depth"):
         ContinuousEngine(model, params, pipeline_depth=2)
-    with pytest.raises(ValueError, match="single-host"):
-        ContinuousEngine(model, params, pipeline_depth=1, announce=True)

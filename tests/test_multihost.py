@@ -36,7 +36,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn_pair(argv_for_pid):
+def _spawn_pair(argv_for_pid, extra_env=None):
     """Launch the 2-process fake-slice pair (4 virtual CPU devices per
     process): ``argv_for_pid(pid, port) -> argv after sys.executable``.
     One launch/env recipe for every multihost test in this file."""
@@ -44,6 +44,7 @@ def _spawn_pair(argv_for_pid):
         **os.environ,
         "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
         "JAX_PLATFORMS": "cpu",
+        **(extra_env or {}),
     }
     port = _free_port()
     return [
@@ -565,12 +566,15 @@ def test_two_process_sigstop_stall_detection_and_restart(tmp_path):
 
 
 CB_RUNNER = _RUNNER_PREAMBLE + TP_SERVE_SETUP + r"""
+import os
 from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
 from pyspark_tf_gke_tpu.train.serving import serve_worker_loop as swl
 
 if pid == 0:
     eng = ContinuousEngine(model, placed, num_slots=2, chunk=3,
-                           buckets=(8, 16), mesh=mesh, announce=True)
+                           buckets=(8, 16), mesh=mesh, announce=True,
+                           pipeline_depth=int(os.environ.get(
+                               "CB_PIPELINE", "0")))
     rids = [eng.submit(np.arange(4, 12, dtype=np.int32), 5),
             eng.submit(np.arange(10, 16, dtype=np.int32), 7),
             eng.submit(np.arange(2, 7, dtype=np.int32), 4),
@@ -613,6 +617,38 @@ def test_two_process_continuous_batching_matches_single_process():
     outputs = _communicate_pair(procs)
     for i, (p, text) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"cb proc {i} failed:\n{text[-3000:]}"
+    assert "CB_WORKER_OK" in outputs[1]
+    toks = outputs[0].split("CB_TOKENS ")[1].splitlines()[0]
+    assert toks == str(ref)
+
+
+@pytest.mark.slow
+def test_two_process_continuous_batching_decode_ahead_matches():
+    """Decode-ahead over the wire: process 0 announces deferred chunks
+    (dispatch-only) and separate OP_CB_COLLECT gathers; the worker
+    replays both, so the collective order stays aligned while the
+    readback overlaps compute. Tokens must equal the UNPIPELINED
+    single-process engine's (the oracle both paths share) — including
+    the sampled request's lane."""
+    from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
+
+    model, placed, mesh = _tp_serve_fixture()
+    eng = ContinuousEngine(model, placed, num_slots=2, chunk=3,
+                           buckets=(8, 16), mesh=mesh)
+    rids = [eng.submit(np.arange(4, 12, dtype=np.int32), 5),
+            eng.submit(np.arange(10, 16, dtype=np.int32), 7),
+            eng.submit(np.arange(2, 7, dtype=np.int32), 4),
+            eng.submit(np.arange(3, 9, dtype=np.int32), 5,
+                       temperature=0.8, top_p=0.9, seed=41)]
+    results = dict(eng.run_until_drained())
+    ref = [results[r] for r in rids]
+
+    procs = _spawn_pair(lambda pid, port: [
+        "-c", CB_RUNNER, "2", str(pid), f"127.0.0.1:{port}"],
+        extra_env={"CB_PIPELINE": "1"})
+    outputs = _communicate_pair(procs)
+    for i, (p, text) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"cb-pipe proc {i} failed:\n{text[-3000:]}"
     assert "CB_WORKER_OK" in outputs[1]
     toks = outputs[0].split("CB_TOKENS ")[1].splitlines()[0]
     assert toks == str(ref)
