@@ -380,3 +380,34 @@ def test_trail_report_update_doc(tmp_path):
     bare.write_text("no markers here\n")
     with pytest.raises(SystemExit):
         trail_report.main(["--update", str(bare), "--trail", str(trail)])
+
+
+def test_capture_refreshes_parity_table(monkeypatch, tmp_path):
+    # After bench.py all, the capture sequence must invoke
+    # trail_report --update on docs/PARITY.md (the no-drift rule holds
+    # for unattended captures too), before the roofline step.
+    calls = []
+
+    def fake_call(argv, **kw):
+        calls.append(("call", list(argv)))
+        return 0
+
+    def fake_run(argv, **kw):
+        calls.append(("run", list(argv)))
+        return _Proc(rc=0, out="{}")
+
+    monkeypatch.setattr(bench_watch.subprocess, "call", fake_call)
+    monkeypatch.setattr(bench_watch.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench_watch, "LOG_PATH", str(tmp_path / "w.log"))
+    monkeypatch.setattr(bench_watch, "ROOFLINE_OUT",
+                        str(tmp_path / "roofline_hw.json"))
+    rc = bench_watch.run_capture(timeout_s=5.0)
+    assert rc == 0
+    runs = [argv for kind, argv in calls if kind == "run"]
+    assert any("trail_report.py" in a for argv in runs for a in argv)
+    # ordering: the PARITY refresh comes before the roofline capture
+    refresh_i = next(i for i, argv in enumerate(runs)
+                     if any("trail_report.py" in a for a in argv))
+    roofline_i = next(i for i, argv in enumerate(runs)
+                      if any("roofline.py" in a for a in argv))
+    assert refresh_i < roofline_i

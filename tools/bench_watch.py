@@ -131,6 +131,21 @@ def run_capture(timeout_s: float) -> int:
             fh.close()
     log(f"bench.py all done rc={rc} in {time.time() - t0:.0f}s")
 
+    # Keep the published PARITY table in lockstep with the trail the
+    # capture just extended (the no-drift rule must survive unattended
+    # captures, not only interactive sessions). Best-effort: a doc
+    # failure must not count against the capture.
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "trail_report.py"),
+             "--update", os.path.join(REPO, "docs", "PARITY.md")],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+        )
+        log(f"PARITY trail table refresh rc={proc.returncode} "
+            f"{(proc.stderr or '').strip()[-200:]}")
+    except (subprocess.TimeoutExpired, OSError) as exc:
+        log(f"PARITY refresh skipped (non-fatal): {exc!r}")
+
     log("capturing hardware roofline (cnn resnet50 bert --measure)")
     try:
         proc = subprocess.run(
