@@ -411,3 +411,13 @@ def test_capture_refreshes_parity_table(monkeypatch, tmp_path):
     roofline_i = next(i for i, argv in enumerate(runs)
                       if any("roofline.py" in a for a in argv))
     assert refresh_i < roofline_i
+
+
+def test_adafactor_flag_guards():
+    # argv IS the measurement identity: a silently-ignored or ambiguous
+    # optimizer flag would mislabel a trail entry (same contract as the
+    # --bf16-moments guard).
+    with pytest.raises(SystemExit):
+        bench.run_bench(["resnet50", "--adafactor", "--smoke"])
+    with pytest.raises(SystemExit):
+        bench.run_bench(["cnn", "--bf16-moments", "--adafactor", "--smoke"])

@@ -14,10 +14,14 @@ hung ``jax.devices()`` attach cannot wedge the loop; see
 tools/bench_history.jsonl for why the probe is a subprocess). On the
 first successful probe it fires the full capture sequence:
 
-  1. ``python bench.py all``  — the 16-workload matrix; every success is
+  1. ``python bench.py all``  — the 17-workload matrix; every success is
      appended to the committed evidence trail ``tools/bench_history.jsonl``
      by bench.py itself.
-  2. ``python tools/roofline.py cnn resnet50 bert --measure`` — the
+  2. ``python tools/trail_report.py --update docs/PARITY.md`` — the
+     published results table regenerates from the just-extended trail
+     (the no-drift rule survives unattended captures; expect PARITY.md
+     to change on disk after a capture).
+  3. ``python tools/roofline.py cnn resnet50 bert --measure`` — the
      hardware roofline the round-3 verdict asked for (Weak #2), written
      to ``tools/roofline_hw.json``.
 
