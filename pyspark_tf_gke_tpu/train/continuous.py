@@ -803,12 +803,14 @@ class ContinuousEngine:
     def _dispatch_chunk(self):
         """Dispatch one decode chunk over the current slots; returns the
         in-flight record (arrays + the slot->request snapshot the chunk
-        was computed over). In announce mode the dispatch AND the
-        as_host_array gathers stay inside one hold of the announce lock
-        (the workers replay dispatch+gather as one op, so process 0
-        must not interleave another announced op between them); the
-        record then carries host arrays and ``_collect``'s fetch is a
-        no-op."""
+        was computed over). Announce mode, unpipelined: dispatch AND
+        the as_host_array gathers run inside one hold of the announce
+        lock (workers replay them as one op) and the record carries
+        host arrays. Announce mode, pipelined: the chunk is announced
+        deferred=1 (dispatch only, one lock hold) and the gathers run
+        at the separately announced OP_CB_COLLECT in ``_collect`` —
+        announced ops MAY legitimately sit between a deferred dispatch
+        and its collect, on every process in the same order."""
         any_sampling = any(r.temperature > 0
                            for r in self._slots.values())
         if self.announce and not self.pipeline_depth:

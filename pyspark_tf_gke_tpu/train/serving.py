@@ -576,6 +576,10 @@ def serve_worker_loop(model, params, mesh: Mesh,
             try:
                 if cb_replica is None or cb_replica.num_slots != b:
                     cb_replica = SlotDeviceState(model, params, b, mesh)
+                    # any deferred chunks belonged to the replaced
+                    # replica's state — collecting them would gather
+                    # stale arrays and desync from process 0
+                    cb_inflight.clear()
                 if op == OP_CB_ADMIT:
                     if samp is not None:
                         cb_replica.admit_padded(

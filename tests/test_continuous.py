@@ -510,3 +510,45 @@ def test_decode_ahead_validation():
     model, params = _tiny_model()
     with pytest.raises(ValueError, match="pipeline_depth"):
         ContinuousEngine(model, params, pipeline_depth=2)
+
+
+def test_decode_ahead_composes_with_chunked_prefill():
+    # prefill_chunk + pipeline_depth together: piecewise admission
+    # advances at step start while a dispatched chunk is still in
+    # flight; tokens must match solo generate() for both requests.
+    model, params = _tiny_model()
+    rng = np.random.default_rng(21)
+    long_prompt = rng.integers(1, 97, 100)
+    short_prompt = rng.integers(1, 97, 6)
+    eng = ContinuousEngine(model, params, num_slots=2, chunk=2,
+                           buckets=(16, 32, 64, 128),
+                           prefill_chunk=32, pipeline_depth=1)
+    rs = eng.submit(short_prompt, max_new_tokens=12)
+    rl = eng.submit(long_prompt, max_new_tokens=5)
+    results = dict(eng.run_until_drained())
+    assert results[rs] == _reference_tokens(model, params, short_prompt, 12)
+    assert results[rl] == _reference_tokens(model, params, long_prompt, 5)
+    assert eng._inflight is None
+
+
+def test_decode_ahead_composes_with_prefix_cache():
+    # A warmed prefix admission (insert of an extended batch-1 tree)
+    # between a deferred dispatch and its collect must not disturb the
+    # in-flight chunk; the warmed request's tokens stay cold-identical.
+    model, params = _tiny_model()
+    rng = np.random.default_rng(22)
+    prefix = rng.integers(1, 97, 24)
+    suffix = rng.integers(1, 97, 6)
+    full = np.concatenate([prefix, suffix])
+    other = rng.integers(1, 97, 9)
+    eng = ContinuousEngine(model, params, num_slots=2, chunk=3,
+                           buckets=(16, 32, 64), prefix_cache_size=2,
+                           pipeline_depth=1)
+    eng.warm_prefix(prefix)
+    r_other = eng.submit(other, max_new_tokens=8)
+    eng.step()  # dispatch a chunk for the first request (in flight)
+    r_full = eng.submit(full, max_new_tokens=7)  # admits via the cache
+    results = dict(eng.run_until_drained())
+    assert results[r_other] == _reference_tokens(model, params, other, 8)
+    assert results[r_full] == _reference_tokens(model, params, full, 7)
+    assert eng.prefix_cache.hits >= 1
