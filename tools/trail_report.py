@@ -37,7 +37,14 @@ TRAIL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # are appended dynamically so a published secondary can't silently drop
 # out of the rendered table.
 EXTRA_KEYS = ("step_time_ms", "mfu", "batch_size", "device_kind",
-              "vs_baseline", "write_rows_per_sec")
+              "vs_baseline", "write_rows_per_sec",
+              # decode/serving family: the comparisons ARE the result
+              "prefill_ms", "decode_step_ms", "kv_heads", "int8_weights",
+              "int8_kv_cache", "num_beams", "acceptance_rate",
+              "tokens_per_round", "whole_batch_tokens_per_sec_per_chip",
+              "speedup_vs_whole_batch",
+              "unpipelined_small_chunk_tokens_per_sec_per_chip",
+              "tuned_chunk", "chunk", "num_slots")
 
 
 def identity(argv) -> str:
