@@ -32,7 +32,7 @@ only int8, int8 KV cache, beam search); ``python bench.py spec
 [--gamma N]`` measures speculative decoding (lower + upper bounds).
 ``python bench.py cb`` compares continuous batching (slot engine,
 train/continuous.py) against whole-batch serving on one request set.
-``python bench.py all`` runs the full 17-workload matrix with ONE
+``python bench.py all`` runs the full 18-workload matrix with ONE
 backend probe, appending every success to tools/bench_history.jsonl.
 
 Resilience: the TPU backend attach through the tunnel is known-flaky
@@ -162,7 +162,8 @@ def _mfu(flops_per_step, step_seconds: float, device_kind: str):
 
 def build_workload(name: str, smoke: bool = False, batch_override: int = 0,
                    use_flash=None, seq_override=None, mu_dtype=None,
-                   s2d: bool = False, optimizer: str = "adam"):
+                   s2d: bool = False, optimizer: str = "adam",
+                   norm_variant: str = "bn"):
     """(trainer, batch, batch_size, extra) for a named workload — the
     single construction point shared by the bench passes below and by
     ``tools/roofline.py``, so the analysis tool always explains exactly
@@ -214,8 +215,12 @@ def build_workload(name: str, smoke: bool = False, batch_override: int = 0,
         # space_to_depth) — same output shapes and FLOP class, stem
         # contraction dim 4*4*12=192 instead of 7*7*3=147-with-3-wide
         # lanes; the next chip window A/Bs it against the plain headline.
+        # --gn: the norm lever tools/mfu_probe.py measured (GroupNorm-32
+        # ran within ~4% of the identity-norm floor's gap vs BN on the
+        # live chip) — a DISCLOSED model-semantics variant, not a
+        # drop-in: GN trains differently from BN.
         model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
-                         s2d_stem=s2d)
+                         s2d_stem=s2d, norm_variant=norm_variant)
         batch = {
             "image": rng.uniform(0, 1, (batch_size, hw, hw, 3)).astype(np.float32),
             "label": rng.integers(0, 1000, (batch_size,)).astype(np.int32),
@@ -223,6 +228,8 @@ def build_workload(name: str, smoke: bool = False, batch_override: int = 0,
         trainer = Trainer(model, TASKS["resnet"](), mesh, learning_rate=1e-3)
         if s2d:
             extra["stem"] = "space_to_depth_2x_4x4"
+        if norm_variant != "bn":
+            extra["norm_variant"] = norm_variant
     elif name == "vit":
         from pyspark_tf_gke_tpu.models import BertConfig, ViTClassifier
 
@@ -373,7 +380,8 @@ def main(batch_size: int = 32, steps: int = 100, throughput_batch: int = 128,
 
 def bench_workload(name: str, steps: int = 50, smoke: bool = False,
                    use_flash=None, seq_override=None,
-                   throughput_batch: int = 0, s2d: bool = False) -> dict:
+                   throughput_batch: int = 0, s2d: bool = False,
+                   norm_variant: str = "bn") -> dict:
     """Secondary workloads: resnet50 / bert (BASELINE configs 4 and 5).
     ``smoke`` shrinks shapes so the plumbing runs on the CPU fake slice.
     ``use_flash`` (bert only): None = model default (flash auto on TPU at
@@ -396,7 +404,7 @@ def bench_workload(name: str, steps: int = 50, smoke: bool = False,
 
     trainer, batch, batch_size, extra = build_workload(
         name, smoke=smoke, use_flash=use_flash, seq_override=seq_override,
-        s2d=s2d)
+        s2d=s2d, norm_variant=norm_variant)
     state = trainer.init_state(make_rng(1337), batch)
     sharding = batch_sharding(trainer.mesh)
     global_batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
@@ -1081,6 +1089,7 @@ ALL_WORKLOADS = (
     ["cnn", "--adafactor"],  # factored-second-moment traffic lever
     ["resnet50"],
     ["resnet50", "--s2d"],  # disclosed stem-layout lever
+    ["resnet50", "--gn"],  # disclosed norm-semantics lever (mfu_probe)
     ["vit"],
     ["bert"],
     ["bert", "--seq", "2048"],
@@ -1282,6 +1291,8 @@ def run_bench(argv) -> dict:
         raise SystemExit("--adafactor applies to the cnn workload only")
     if "--s2d" in argv and workload != "resnet50":
         raise SystemExit("--s2d applies to the resnet50 workload only")
+    if "--gn" in argv and workload != "resnet50":
+        raise SystemExit("--gn applies to the resnet50 workload only")
     if workload == "cnn":
         mu = None
         if "--bf16-moments" in argv:
@@ -1345,7 +1356,8 @@ def run_bench(argv) -> dict:
     tb = 256 if (workload in ("resnet50", "vit") and not smoke) else 0
     return bench_workload(workload, steps=2 if smoke else 50, smoke=smoke,
                           use_flash=use_flash, seq_override=seq,
-                          throughput_batch=tb, s2d="--s2d" in argv)
+                          throughput_batch=tb, s2d="--s2d" in argv,
+                          norm_variant="gn" if "--gn" in argv else "bn")
 
 
 if __name__ == "__main__":

@@ -11,9 +11,10 @@ Mechanics (standard batched beam search, TPU-shaped):
   is then tiled to ``B*K`` (tile beats re-prefilling K× — prefill is
   the expensive pass);
 * each step scores ``[B*K, V]`` continuations, flattens per batch row
-  to ``[B, K*V]``, takes the top-K, and reorders every cache leaf and
-  the token history with one ``take_along_axis`` gather over the beam
-  axis (no dynamic shapes — beams move by index, not by slicing);
+  to ``[B, K*V]``, takes the top-K, and reorders the cache and token
+  history over the beam axis with no dynamic shapes — large leaves via
+  a K-way broadcast select (vectorized; see ``_reorder_beams``), small
+  ones via ``take_along_axis``;
 * hypotheses that emit eos move into a FINISHED pool of K
   length-penalized entries (GNMT-style); active beams never carry eos,
   so a short finished hypothesis can never be evicted by longer
@@ -44,34 +45,30 @@ def _reorder_beams(tree, beam_idx):
     """Gather beams: tree leaves [B*K, ...], beam_idx [B, K] of source
     beam indices within each batch row. Scalar leaves pass through.
 
-    Large float leaves (the KV cache — hundreds of MB regathered EVERY
-    decode step) reorder as a one-hot contraction instead of
-    ``take_along_axis``: K is tiny, so the [B,K,K] x [B,K,F] einsum is
-    a dense streaming op XLA lowers well, where the row-gather lowering
-    has measured badly on TPU (32.9 ms/step at beam 4 vs 2.1 greedy —
-    far above the bandwidth arithmetic; same op class as the embedding
-    backward the round-4 iota-embed fix replaced). Exact: each output
-    row has ONE unit coefficient, so no accumulation error. Small and
-    integer leaves (token histories, int8 cache tiles + their scales)
-    keep the gather — their bytes are trivial."""
+    Large leaves (the KV cache — hundreds of MB regathered EVERY decode
+    step) reorder as a statically-unrolled K-way broadcast SELECT
+    instead of ``take_along_axis``: K is tiny, so the chained
+    ``where(beam_idx == j, source_j, acc)`` fuses into one vectorized
+    pass over the output reading the K source rows — where the
+    row-gather lowering has measured badly on TPU (32.9 ms/step at
+    beam 4 vs 2.1 greedy — far above the bandwidth arithmetic; same op
+    class as the embedding backward the round-4 iota-embed fix
+    replaced). Semantics are element-exact vs the gather (values only
+    ever COPIED, never multiplied — a NaN/inf travels with its own
+    beam and cannot leak across rows). Small leaves and wide beam
+    counts keep the gather."""
     b, k = beam_idx.shape
-    onehot = jax.nn.one_hot(beam_idx, k)  # [B, K, K], unit rows
 
     def gather(leaf):
         if leaf.ndim == 0:
             return leaf
         grouped = leaf.reshape(b, k, *leaf.shape[1:])
-        if (jnp.issubdtype(leaf.dtype, jnp.floating)
-                and leaf.size >= (1 << 16)):
+        if leaf.size >= (1 << 16) and k <= 16:
             flat = grouped.reshape(b, k, -1)
-            # 0 * inf = NaN: a non-finite value in one UNSELECTED beam
-            # would otherwise poison every beam of its batch row
-            # through the contraction (the gather only copied the
-            # selected beam). The where fuses into the einsum's operand
-            # read — no extra HBM pass.
-            flat = jnp.where(jnp.isfinite(flat), flat, 0)
-            out = jnp.einsum("bkj,bjf->bkf", onehot.astype(leaf.dtype),
-                             flat)
+            sel = beam_idx.reshape(b, k, 1)
+            out = flat  # j == identity covered by the wheres below
+            for j in range(k):
+                out = jnp.where(sel == j, flat[:, j][:, None, :], out)
             return out.reshape(leaf.shape)
         idx = beam_idx.reshape(b, k, *([1] * (leaf.ndim - 1)))
         return jnp.take_along_axis(grouped, idx, axis=1).reshape(leaf.shape)

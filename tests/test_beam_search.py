@@ -155,3 +155,30 @@ def test_bench_decode_beams_smoke():
     res = bench_decode(smoke=True, num_beams=2)
     assert res["num_beams"] == 2
     assert res["value"] > 0
+
+
+def test_reorder_beams_select_path_matches_gather():
+    # The large-leaf K-way select path must be element-exact vs the
+    # take_along_axis path — including NaN/inf semantics: a non-finite
+    # value travels with its OWN beam only (never leaks across rows the
+    # way a one-hot contraction's 0*inf would).
+    import numpy as np
+
+    from pyspark_tf_gke_tpu.models.beam_search import _reorder_beams
+
+    b, k, f = 2, 4, 9000  # k*f*b = 72k elements > the 1<<16 threshold
+    rng = np.random.default_rng(0)
+    for dtype in (jnp.float32, jnp.bfloat16, jnp.int8):
+        base = rng.normal(size=(b * k, f)) * 3
+        leaf = jnp.asarray(base, dtype)
+        if dtype != jnp.int8:
+            leaf = leaf.at[1, 7].set(jnp.nan)  # beam 1 of batch row 0
+            leaf = leaf.at[k + 2, 5].set(jnp.inf)
+        idx = jnp.asarray([[1, 1, 3, 0], [2, 0, 0, 3]], jnp.int32)
+        small = leaf.reshape(b, k, f)
+        expected = jnp.take_along_axis(
+            small, idx[:, :, None], axis=1).reshape(b * k, f)
+        got = _reorder_beams(leaf, idx)
+        assert got.shape == expected.shape and got.dtype == expected.dtype
+        np.testing.assert_array_equal(
+            np.asarray(got, np.float32), np.asarray(expected, np.float32))

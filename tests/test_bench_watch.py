@@ -421,3 +421,8 @@ def test_adafactor_flag_guards():
         bench.run_bench(["resnet50", "--adafactor", "--smoke"])
     with pytest.raises(SystemExit):
         bench.run_bench(["cnn", "--bf16-moments", "--adafactor", "--smoke"])
+
+
+def test_gn_flag_guard():
+    with pytest.raises(SystemExit):
+        bench.run_bench(["cnn", "--gn", "--smoke"])
