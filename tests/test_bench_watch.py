@@ -426,3 +426,4 @@ def test_adafactor_flag_guards():
 def test_gn_flag_guard():
     with pytest.raises(SystemExit):
         bench.run_bench(["cnn", "--gn", "--smoke"])
+
