@@ -948,6 +948,25 @@ def _normalize_argv(argv) -> list:
     return out
 
 
+def _load_history() -> list:
+    """Parse the evidence trail once, per-line tolerant: one truncated
+    line (a crash mid-append — exactly the outage scenario this serves)
+    must not discard every valid measurement before it."""
+    entries = []
+    try:
+        with open(HISTORY_PATH) as fh:
+            for ln in fh:
+                try:
+                    e = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(e, dict) and "ts" in e and "result" in e:
+                    entries.append(e)
+    except OSError:
+        pass
+    return entries
+
+
 def _latest_history(argv):
     """Most recent committed evidence-trail entry for EXACTLY this
     invocation (normalized argv match — a ``cnn --bf16-moments`` entry
@@ -956,28 +975,38 @@ def _latest_history(argv):
     still points the reader at the last REAL measurement — explicitly
     marked stale, never substituted for the live value."""
     want = _normalize_argv(argv)
-    entries = []
-    try:
-        with open(HISTORY_PATH) as fh:
-            for ln in fh:
-                # per-line parse: one truncated line (a crash mid-append
-                # — exactly the outage scenario this serves) must not
-                # discard every valid measurement before it
-                try:
-                    e = json.loads(ln)
-                except ValueError:
-                    continue
-                if isinstance(e, dict) and "ts" in e and "result" in e:
-                    entries.append(e)
-    except OSError:
-        return None
-    for entry in reversed(entries):
+    for entry in reversed(_load_history()):
         if _normalize_argv(entry.get("argv", []) or []) == want:
             return entry
     return None
 
 
-def _error_json(argv, stage: str, detail: str) -> dict:
+def _stale_matrix() -> dict:
+    """Latest trail entry for EVERY matrix workload, keyed by normalized
+    argv, each value ``{metric, value, unit, ts, stale: True}``.
+
+    Round-4 verdict (Weak #1 / Next #3): when the tunnel is dead at the
+    driver's capture time, ``last_recorded`` surfaced only the invoked
+    argv — 1 of 18 measured workloads reached the round artifact. A
+    probe-stage failure means the WHOLE matrix is unreachable, so the
+    error JSON now carries the full trail-backed map; every number is
+    explicitly stale, never substituted for a live value."""
+    want = {" ".join(_normalize_argv(wl)) for wl in ALL_WORKLOADS}
+    out = {}
+    # one trail parse for the whole map (not one per workload) — the
+    # trail grows every capture and this runs on the outage path
+    for entry in reversed(_load_history()):
+        key = " ".join(_normalize_argv(entry.get("argv", []) or []))
+        if key in want and key not in out:
+            r = entry.get("result") or {}
+            out[key] = {
+                "metric": r.get("metric"), "value": r.get("value"),
+                "unit": r.get("unit"), "ts": entry["ts"], "stale": True}
+    return out
+
+
+def _error_json(argv, stage: str, detail: str,
+                stale_matrix: bool = False) -> dict:
     norm = _normalize_argv(argv)
     workload = norm[0]
     out = {
@@ -995,6 +1024,15 @@ def _error_json(argv, stage: str, detail: str) -> dict:
     if last is not None:
         out["last_recorded"] = {"ts": last["ts"], "stale": True,
                                 "result": last["result"]}
+    if stale_matrix:
+        # A dead backend blocks the whole matrix, not just this argv —
+        # ship every trail-backed measurement with the error so the
+        # driver's one-line artifact carries all 18, explicitly stale.
+        # Opt-in at the single-line driver call sites only: the gated
+        # matrix run prints 17 of these and must not carry 17 copies.
+        stale = _stale_matrix()
+        if stale:
+            out["stale_matrix"] = stale
     return out
 
 
@@ -1176,9 +1214,17 @@ def orchestrate_all(extra) -> int:
             log("backend is the CPU fallback - device workloads fast-fail "
                 "(the trail records TPU evidence only)")
     failures = _run_matrix(extra, backend_ok, gate_reason=gate_reason)
-    print(json.dumps({"metric": "bench_all", "value": len(ALL_WORKLOADS) - failures,
-                      "unit": "workloads_measured", "vs_baseline": None,
-                      "total": len(ALL_WORKLOADS), "failures": failures}))
+    summary = {"metric": "bench_all", "value": len(ALL_WORKLOADS) - failures,
+               "unit": "workloads_measured", "vs_baseline": None,
+               "total": len(ALL_WORKLOADS), "failures": failures}
+    if not backend_ok:
+        # Whole matrix gated: the summary (ONE line, not 17 copies)
+        # carries the trail-backed stale map so the artifact is still
+        # complete evidence-wise.
+        stale = _stale_matrix()
+        if stale:
+            summary["stale_matrix"] = stale
+    print(json.dumps(summary))
     return 1 if failures else 0
 
 
@@ -1197,7 +1243,7 @@ def orchestrate_bare() -> int:
         print(json.dumps(_error_json(
             ["cnn"], "probe",
             f"backend attach failed after {PROBE_ATTEMPTS} attempts "
-            f"({PROBE_TIMEOUT_S}s timeout each)")))
+            f"({PROBE_TIMEOUT_S}s timeout each)", stale_matrix=True)))
         return 1
     if is_cpu_probe(desc):
         # The CPU fallback answering the probe is not a chip window. The
@@ -1233,7 +1279,7 @@ def orchestrate(argv, skip_probe: bool = False) -> int:
         print(json.dumps(_error_json(
             list(argv), "probe",
             f"backend attach failed after {PROBE_ATTEMPTS} attempts "
-            f"({PROBE_TIMEOUT_S}s timeout each)")))
+            f"({PROBE_TIMEOUT_S}s timeout each)", stale_matrix=True)))
         return 1
 
     cmd = [sys.executable, os.path.abspath(__file__), "--run", *argv]

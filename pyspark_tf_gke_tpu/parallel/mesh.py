@@ -21,6 +21,7 @@ Canonical axis names (any subset may be size 1 / absent):
 
 from __future__ import annotations
 
+import logging
 from typing import Mapping, Optional, Sequence
 
 import jax
@@ -159,6 +160,18 @@ def make_hybrid_mesh(
         if all(getattr(d, "slice_index", None) is not None for d in devices):
             key = lambda d: d.slice_index  # noqa: E731
         elif n_slices > 1 and len({d.process_index for d in devices}) == n_slices:
+            # Heuristic, not ground truth: a single-slice multi-host pod
+            # (e.g. v5e-16, 4 hosts) with --dcn-mesh-shape dp=4 lands
+            # here too, and the "slices" are really per-host ICI groups
+            # — numerically fine, but the hierarchical-collective layout
+            # premise (DCN between groups) is wrong. Surface it so a
+            # mis-deployed dcn spec is visible instead of silent.
+            logging.getLogger(__name__).warning(
+                "make_hybrid_mesh: devices carry no slice_index; treating "
+                "the %d process groups as the %d DCN slices. If these "
+                "processes are hosts of ONE pod slice, the dcn_axes spec "
+                "describes ICI links as DCN — pass force_contiguous=True "
+                "or drop --dcn-mesh-shape.", n_slices, n_slices)
             key = lambda d: d.process_index  # noqa: E731
     if key is None:
         groups = [devices[i:i + per_slice]

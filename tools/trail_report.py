@@ -44,7 +44,13 @@ EXTRA_KEYS = ("step_time_ms", "mfu", "batch_size", "device_kind",
               "tokens_per_round", "whole_batch_tokens_per_sec_per_chip",
               "speedup_vs_whole_batch",
               "unpipelined_small_chunk_tokens_per_sec_per_chip",
-              "tuned_chunk", "chunk", "num_slots")
+              # 'tuned_chunk' is the pre-round-4 cb schema; bench.py now
+              # writes 'chunk' (headline config) + 'unpipelined_chunk'
+              # (baseline) + 'pipeline_depth'. All four stay listed so
+              # neither the committed old entry nor new captures drop a
+              # disclosed field from the rendered table.
+              "tuned_chunk", "chunk", "unpipelined_chunk",
+              "pipeline_depth", "num_slots")
 
 
 def identity(argv) -> str:
@@ -86,6 +92,17 @@ def latest_per_identity(entries: list) -> list:
 
 def row(e: dict) -> str:
     r = e["result"]
+    # load() is per-line tolerant; a single malformed entry (missing or
+    # non-numeric 'value') must likewise not abort --update and take the
+    # whole published table with it.
+    value = r.get("value")
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        value_cell = f"**{value:g} {r.get('unit')}**"
+    else:
+        # escape table-breaking characters: a malformed entry must stay
+        # visibly malformed inside ONE cell, not corrupt the table
+        shown = repr(value).replace("|", "\\|").replace("\n", " ")
+        value_cell = f"{shown} {r.get('unit')}"
     extras = []
     dynamic = sorted(k for k in r if k.startswith("max_throughput_"))
     for k in (*EXTRA_KEYS, *dynamic):
@@ -98,7 +115,7 @@ def row(e: dict) -> str:
             else:
                 extras.append(f"{k} {v}")
     return (f"| `{' '.join(e.get('argv') or [])}` | {r.get('metric')} | "
-            f"**{r.get('value'):g} {r.get('unit')}** | "
+            f"{value_cell} | "
             f"{'; '.join(extras)} | `{e.get('ts')}` |")
 
 
