@@ -70,6 +70,153 @@ class _Identity(nn.Module):
         return x
 
 
+class FusedBottleneckBlock(nn.Module):
+    """Bottleneck block with the 1x1 convs as Pallas matmul kernels that
+    absorb the surrounding BatchNorm passes (``norm_variant="fused"``).
+
+    The round-4 MFU probe measured normalization at 8.2 ms = 29% of the
+    ResNet-50 step — all unfused HBM read-modify-writes of activation
+    tensors between convs (docs/PARITY.md). This block removes the
+    removable passes:
+
+    - conv1/conv3/proj write their raw output AND its per-channel
+      sum/sumsq in one kernel pass (no separate statistics read);
+    - conv3 reads conv2's RAW output and applies norm2's normalize+relu
+      on tiles in VMEM (no materialized normalized tensor);
+    - norm3+proj-norm+residual+relu remain one fused XLA elementwise
+      pass (they already were — XLA fuses elementwise chains fine; only
+      passes *adjacent to convs* needed kernel help).
+
+    The 3x3 conv stays an XLA conv: its normalized input (norm1) is
+    materialized, and its statistics cost one reduction read — a Pallas
+    3x3 conv with halo handling is the remaining (disclosed) step.
+
+    BatchNorm semantics match ``nn.BatchNorm(momentum=0.9, eps=1e-5)``:
+    biased batch variance, running-average updates in train mode, the
+    zero-init gamma on norm3. Statistics are batch-local to the device
+    set visible to the kernel (single-chip bench path; a dp-sharded
+    multi-chip wrapper needs a psum of the sum/sumsq vectors, which is
+    exactly what the epilogue exposes them for).
+    """
+
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    def _bn_params(self, name: str, dim: int, zero_scale: bool = False):
+        scale = self.param(
+            f"{name}_scale",
+            nn.initializers.zeros_init() if zero_scale
+            else nn.initializers.ones_init(), (dim,), jnp.float32)
+        bias = self.param(f"{name}_bias", nn.initializers.zeros_init(),
+                          (dim,), jnp.float32)
+        ra_mean = self.variable("batch_stats", f"{name}_mean",
+                                lambda: jnp.zeros((dim,), jnp.float32))
+        ra_var = self.variable("batch_stats", f"{name}_var",
+                               lambda: jnp.ones((dim,), jnp.float32))
+        return scale, bias, ra_mean, ra_var
+
+    def _update_ra(self, ra_mean, ra_var, mean, var):
+        if not self.is_initializing():
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1.0 - m) * mean
+            ra_var.value = m * ra_var.value + (1.0 - m) * var
+
+    def _fused_conv_bn(self, x_flat, w, bn, train, a_in=None, b_in=None):
+        """One fused 1x1-conv + BN-stat step: Pallas matmul (optional
+        on-read normalize+relu via ``a_in``/``b_in``), batch or running
+        statistics, running-average update, and the folded ``(a, b)``
+        affine for THIS conv's output norm. Returns ``(y_raw, a, b)``.
+
+        Single home for the sequence so the multi-chip psum of the
+        sum/sumsq vectors (when a dp-sharded wrapper lands) changes one
+        place, not three."""
+        from pyspark_tf_gke_tpu.ops.pallas.fused_matmul import (
+            bn_fold, norm_relu_matmul, stats_to_moments)
+
+        scale, bias, ra_mean, ra_var = bn
+        dt = self.dtype
+        if train:
+            y, s, ss = norm_relu_matmul(x_flat, w.astype(dt), a_in, b_in,
+                                        relu=a_in is not None,
+                                        want_stats=True)
+            mean, var = stats_to_moments(s, ss, y.shape[0])
+            self._update_ra(ra_mean, ra_var, mean, var)
+        else:
+            y = norm_relu_matmul(x_flat, w.astype(dt), a_in, b_in,
+                                 relu=a_in is not None)
+            mean, var = ra_mean.value, ra_var.value
+        a, b = bn_fold(mean, var, scale, bias, self.epsilon)
+        return y, a, b
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        from pyspark_tf_gke_tpu.ops.pallas.fused_matmul import bn_fold
+
+        b_, h, w_, cin = x.shape
+        f = self.features
+        init = nn.initializers.lecun_normal()
+        w1 = self.param("conv1_kernel", init, (cin, f), jnp.float32)
+        w3 = self.param("conv3_kernel", init, (f, f * 4), jnp.float32)
+        bn1 = self._bn_params("norm1", f)
+        bn2 = self._bn_params("norm2", f)
+        bn3 = self._bn_params("norm3", f * 4, zero_scale=True)
+        needs_proj = (self.strides != (1, 1)) or (cin != f * 4)
+        if needs_proj:
+            wp = self.param("proj_kernel", init, (cin, f * 4), jnp.float32)
+            bnp_ = self._bn_params("norm_proj", f * 4)
+
+        dt = self.dtype
+        x = x.astype(dt)
+        x_flat = x.reshape(-1, cin)
+
+        # conv1 (1x1): raw output + stats in one Pallas pass
+        y1, a1, b1 = self._fused_conv_bn(x_flat, w1, bn1, train)
+
+        # norm1+relu materializes for the XLA 3x3 conv (one fused
+        # elementwise pass; the stats read was already saved above)
+        n1 = jnp.maximum(
+            y1.astype(jnp.float32) * a1[None, :] + b1[None, :], 0.0
+        ).astype(dt).reshape(b_, h, w_, f)
+        y2 = nn.Conv(f, (3, 3), self.strides, use_bias=False, dtype=dt,
+                     name="conv2")(n1)
+        h2, w2 = y2.shape[1], y2.shape[2]
+
+        # norm2 statistics: one XLA reduction read of y2 (both moments
+        # in a single pass); the *normalize* is free — conv3 applies it
+        # on-read below
+        s2p, b2p, ra_m2, ra_v2 = bn2
+        if train:
+            y2f = y2.astype(jnp.float32)
+            mean2 = y2f.mean(axis=(0, 1, 2))
+            var2 = jnp.maximum((y2f * y2f).mean(axis=(0, 1, 2))
+                               - mean2 * mean2, 0.0)
+            self._update_ra(ra_m2, ra_v2, mean2, var2)
+        else:
+            mean2, var2 = ra_m2.value, ra_v2.value
+        a2, b2 = bn_fold(mean2, var2, s2p, b2p, self.epsilon)
+
+        # conv3 (1x1): normalize+relu on-read from RAW y2, stats epilogue
+        y3, a3, b3 = self._fused_conv_bn(y2.reshape(-1, f), w3, bn3, train,
+                                         a_in=a2, b_in=b2)
+
+        # residual path
+        if needs_proj:
+            xs = x[:, ::self.strides[0], ::self.strides[1], :]
+            yp, ap, bp = self._fused_conv_bn(xs.reshape(-1, cin), wp, bnp_,
+                                             train)
+            res = yp.astype(jnp.float32) * ap[None, :] + bp[None, :]
+        else:
+            res = x_flat.astype(jnp.float32)
+
+        # norm3 + residual add + relu: one fused XLA elementwise pass
+        out = jnp.maximum(
+            y3.astype(jnp.float32) * a3[None, :] + b3[None, :] + res, 0.0)
+        return out.astype(dt).reshape(b_, h2, w2, f * 4)
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     num_classes: int = 1000
@@ -91,14 +238,19 @@ class ResNet(nn.Module):
     # norm in f32 — isolates bf16 round-trips around the stat
     # reductions), "gn" (GroupNorm-32: no batch reduction, fuses as
     # plain elementwise), "none" (identity — bounds the total norm cost;
-    # diagnostic only, does not train well). Measured by
-    # tools/mfu_probe.py on hardware; the training default stays "bn".
+    # diagnostic only, does not train well), "fused" (BN semantics with
+    # the bottleneck 1x1 convs as Pallas kernels absorbing the norm
+    # passes — see FusedBottleneckBlock). Measured by tools/mfu_probe.py
+    # on hardware; the training default stays "bn".
     norm_variant: str = "bn"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        if self.norm_variant == "bn":
+        if self.norm_variant in ("bn", "fused"):
+            # "fused" uses BatchNorm semantics; the stem norm (one small
+            # tensor, between a 7x7 conv and a maxpool) stays nn.BatchNorm
+            # — only the bottleneck blocks swap to the Pallas path.
             norm = functools.partial(
                 nn.BatchNorm, use_running_average=not train, momentum=0.9,
                 epsilon=1e-5, dtype=self.dtype,
@@ -133,9 +285,16 @@ class ResNet(nn.Module):
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = BottleneckBlock(
-                    self.num_filters * 2 ** i, conv=conv, norm=norm, strides=strides
-                )(x)
+                if self.norm_variant == "fused":
+                    x = FusedBottleneckBlock(
+                        self.num_filters * 2 ** i, strides=strides,
+                        dtype=self.dtype or jnp.float32,
+                    )(x, train=train)
+                else:
+                    x = BottleneckBlock(
+                        self.num_filters * 2 ** i, conv=conv, norm=norm,
+                        strides=strides,
+                    )(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
         return x.astype(jnp.float32)

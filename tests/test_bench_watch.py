@@ -492,7 +492,7 @@ def test_stale_matrix_against_committed_trail():
     # acceptable holes; anything else means a workload's argv was
     # renamed and its history silently orphaned. Once the watcher
     # captures them this set just shrinks (subset check still passes).
-    queued = {"cnn --adafactor", "resnet50 --gn"}
+    queued = {"cnn --adafactor", "resnet50 --gn", "resnet50 --fused-bn"}
     assert missing <= queued, (
         f"matrix workloads with no trail entry: {sorted(missing - queued)}")
 
@@ -526,3 +526,10 @@ def test_trail_report_keeps_cb_schema_keys():
     out = trail_report.row(e)
     assert "chunk 64" in out and "unpipelined_chunk 16" in out
     assert "pipeline_depth 1" in out
+
+
+def test_fused_bn_flag_guards():
+    with pytest.raises(SystemExit):
+        bench.run_bench(["cnn", "--fused-bn", "--smoke"])
+    with pytest.raises(SystemExit):
+        bench.run_bench(["resnet50", "--fused-bn", "--gn", "--smoke"])
