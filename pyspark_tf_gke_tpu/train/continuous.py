@@ -497,21 +497,32 @@ class ContinuousEngine:
                  pipeline_depth: int = 0):
         if num_slots < 1 or chunk < 1:
             raise ValueError("num_slots and chunk must be >= 1")
-        if pipeline_depth not in (0, 1):
-            raise ValueError("pipeline_depth must be 0 or 1")
-        # pipeline_depth=1 ("decode-ahead"): dispatch chunk N+1 before
-        # reading chunk N's tokens, so the device->host readback latency
+        if pipeline_depth < 0:
+            raise ValueError("pipeline_depth must be >= 0")
+        # pipeline_depth=N ("decode-ahead"): keep up to N dispatched
+        # chunks un-collected, so the device->host readback latency
         # (which DOMINATES the cycle on a remote-attached chip) overlaps
-        # the next chunk's compute. Token content per request is
+        # the next chunks' compute. Token content per request is
         # unchanged — each slot's rows depend only on its own prompt —
-        # but eos frees and admissions take effect one chunk later
+        # but eos frees and admissions take effect up to N chunks later
         # (bounded extra compute, discarded by the host budget clamp).
-        # Multi-host (announce) composes: the chunk is announced
-        # deferred=1 (dispatch only) and the gathers run at a separately
-        # announced OP_CB_COLLECT, so every process defers identically
-        # and the collective order stays aligned with the replay order.
+        # Depth 1 hides one readback behind one chunk's compute; deeper
+        # helps when a single chunk's compute is SHORTER than the link
+        # RTT (small chunks, few live slots). Multi-host (announce)
+        # composes at depth 1: the chunk is announced deferred=1
+        # (dispatch only) and the gathers run at a separately announced
+        # OP_CB_COLLECT. Depth >= 2 is single-host only — the worker
+        # replay caps its deferred-chunk window at 2 outstanding
+        # (serving.py OP_CB_CHUNK), so a deeper stream would desync and
+        # kill replicas.
+        if pipeline_depth > 1 and announce:
+            raise ValueError(
+                "pipeline_depth >= 2 is single-host only (the announce "
+                "replay's deferred-chunk window is depth-1 sized)")
         self.pipeline_depth = pipeline_depth
-        self._inflight = None  # (kind, toks, live, slots snapshot)
+        from collections import deque
+
+        self._inflight_q = deque()  # (kind, toks, live, slots snapshot)
         if prefill_chunk and prefill_chunk < 32:
             raise ValueError(
                 f"prefill_chunk must be 0 (off) or >= 32, got "
@@ -881,10 +892,10 @@ class ContinuousEngine:
         """Admit into free slots, run one decode chunk, collect tokens.
         Returns requests finished during this chunk.
 
-        With ``pipeline_depth=1`` the collect is one chunk behind the
-        dispatch: the chunk launched this call is read back on the NEXT
-        call, so the device works through chunk N+1 while the host
-        waits on chunk N's tokens."""
+        With ``pipeline_depth=N`` the collect runs up to N chunks behind
+        the dispatch: the chunk launched this call is read back N calls
+        later, so the device works ahead while the host waits on older
+        tokens."""
         if self._admitting is not None:
             self._advance_admission()
         self._admit_waiting()
@@ -892,17 +903,26 @@ class ContinuousEngine:
             if not self._slots:
                 return []
             return self._collect(self._dispatch_chunk())
-        new_inflight = self._dispatch_chunk() if self._slots else None
-        finished = (self._collect(self._inflight)
-                    if self._inflight is not None else [])
-        self._inflight = new_inflight
+        if self._slots:
+            self._inflight_q.append(self._dispatch_chunk())
+        finished = []
+        # Drain down to the target depth. With live slots, exactly one
+        # collect runs per step (the break below) — the per-step
+        # announce-op cadence stays dispatch+collect. With all slots
+        # idle (everything finished/cancelled), the WHOLE backlog
+        # flushes in this one call, since no later step is guaranteed.
+        while (len(self._inflight_q) > self.pipeline_depth
+               or (self._inflight_q and not self._slots)):
+            finished += self._collect(self._inflight_q.popleft())
+            if self._slots:  # collects freed slots mid-flush: stop at
+                break        # target depth next call, after admissions
         return finished
 
     def run_until_drained(self):
         """Drive steps until queue + slots are empty; yields finished
         requests in completion order."""
         while (self._queue or self._slots or self._admitting
-               or self._inflight is not None):
+               or self._inflight_q):
             for req in self.step():
                 yield req.rid, req.tokens
 
@@ -916,7 +936,7 @@ class ContinuousEngine:
             "chunk": self.chunk,
             "admitting": (self._admitting["req"].rid
                           if self._admitting is not None else None),
-            "inflight": self._inflight is not None,
+            "inflight": bool(self._inflight_q),
             **({"prefix_cache": self.prefix_cache.stats}
                if self.prefix_cache is not None else {}),
         }

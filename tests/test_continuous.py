@@ -467,7 +467,7 @@ def test_decode_ahead_pipeline_parity_staggered():
         assert results[rid] == _reference_tokens(model, params, p, m), \
             f"request {rid} diverged under decode-ahead"
     assert eng.stats["finished"] == len(specs)
-    assert eng._inflight is None  # drained flushes the in-flight chunk
+    assert not eng._inflight_q  # drained flushes the in-flight chunks
 
 
 def test_decode_ahead_eos_and_budget_clamp():
@@ -509,7 +509,27 @@ def test_decode_ahead_cancel_inflight_is_skipped():
 def test_decode_ahead_validation():
     model, params = _tiny_model()
     with pytest.raises(ValueError, match="pipeline_depth"):
-        ContinuousEngine(model, params, pipeline_depth=2)
+        ContinuousEngine(model, params, pipeline_depth=-1)
+
+
+def test_decode_ahead_depth2_parity():
+    # depth 2 keeps TWO chunks un-collected (hides a readback even when
+    # one chunk's compute is shorter than the link RTT). Token content
+    # must stay bit-identical to solo generate(), same as depth 1.
+    model, params = _tiny_model()
+    rng = np.random.default_rng(11)
+    specs = [(rng.integers(1, 97, int(n)), int(m))
+             for n, m in [(5, 12), (19, 3), (33, 8), (7, 15), (11, 5)]]
+    eng = ContinuousEngine(model, params, num_slots=2, chunk=3,
+                           buckets=(16, 32, 64), pipeline_depth=2)
+    rids = {eng.submit(p, max_new_tokens=m): (p, m) for p, m in specs}
+    results = dict(eng.run_until_drained())
+    assert set(results) == set(rids)
+    for rid, (p, m) in rids.items():
+        assert results[rid] == _reference_tokens(model, params, p, m), \
+            f"request {rid} diverged at pipeline_depth=2"
+    assert eng.stats["finished"] == len(specs)
+    assert not eng._inflight_q
 
 
 def test_decode_ahead_composes_with_chunked_prefill():
@@ -528,7 +548,7 @@ def test_decode_ahead_composes_with_chunked_prefill():
     results = dict(eng.run_until_drained())
     assert results[rs] == _reference_tokens(model, params, short_prompt, 12)
     assert results[rl] == _reference_tokens(model, params, long_prompt, 5)
-    assert eng._inflight is None
+    assert not eng._inflight_q
 
 
 def test_decode_ahead_composes_with_prefix_cache():
@@ -552,3 +572,12 @@ def test_decode_ahead_composes_with_prefix_cache():
     assert results[r_other] == _reference_tokens(model, params, other, 8)
     assert results[r_full] == _reference_tokens(model, params, full, 7)
     assert eng.prefix_cache.hits >= 1
+
+
+def test_decode_ahead_depth2_rejects_announce():
+    # The worker replay's deferred-chunk window is depth-1 sized
+    # (serving.py OP_CB_CHUNK caps 2 outstanding); a deeper stream
+    # would desync replicas, so the engine refuses the combination.
+    model, params = _tiny_model()
+    with pytest.raises(ValueError, match="single-host"):
+        ContinuousEngine(model, params, pipeline_depth=2, announce=True)

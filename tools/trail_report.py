@@ -50,7 +50,7 @@ EXTRA_KEYS = ("step_time_ms", "mfu", "batch_size", "device_kind",
               # neither the committed old entry nor new captures drop a
               # disclosed field from the rendered table.
               "tuned_chunk", "chunk", "unpipelined_chunk",
-              "pipeline_depth", "num_slots")
+              "pipeline_depth", "dispatch_rtt_ms", "num_slots")
 
 
 def identity(argv) -> str:
