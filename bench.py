@@ -32,7 +32,7 @@ only int8, int8 KV cache, beam search); ``python bench.py spec
 [--gamma N]`` measures speculative decoding (lower + upper bounds).
 ``python bench.py cb`` compares continuous batching (slot engine,
 train/continuous.py) against whole-batch serving on one request set.
-``python bench.py all`` runs the full 18-workload matrix with ONE
+``python bench.py all`` runs the full 19-workload matrix with ONE
 backend probe, appending every success to tools/bench_history.jsonl.
 
 Resilience: the TPU backend attach through the tunnel is known-flaky
@@ -1065,7 +1065,8 @@ def _error_json(argv, stage: str, detail: str,
         # ship every trail-backed measurement with the error so the
         # driver's one-line artifact carries all 18, explicitly stale.
         # Opt-in at the single-line driver call sites only: the gated
-        # matrix run prints 17 of these and must not carry 17 copies.
+        # matrix run prints one per gated device workload (all but io)
+        # and must not carry that many copies.
         stale = _stale_matrix()
         if stale:
             out["stale_matrix"] = stale

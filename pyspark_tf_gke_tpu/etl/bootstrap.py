@@ -98,8 +98,15 @@ def _run_spark_chain(csv_path: str, mysql_host: Optional[str],
     t0 = time.time()
     spark = None
     try:
-        spark = (SparkSession.builder.master("local[2]")
-                 .appName("etl-bootstrap").getOrCreate())
+        builder = (SparkSession.builder.master("local[2]")
+                   .appName("etl-bootstrap"))
+        if mysql_host:
+            # the JDBC read needs Connector/J on the executor classpath;
+            # same coordinate the reference vendors as a jar
+            # (infra/local/local_spark/jars/mysql-connector-j-8.4.0.jar)
+            builder = builder.config(
+                "spark.jars.packages", "com.mysql:mysql-connector-j:8.4.0")
+        spark = builder.getOrCreate()
         if mysql_host:
             import logging
 
@@ -112,10 +119,11 @@ def _run_spark_chain(csv_path: str, mysql_host: Optional[str],
                 logging.getLogger("bootstrap"), cfg,
                 spark).read_data_from_mysql()
         else:
-            df = spark.read.option("header", True).csv(csv_path)
+            df = (spark.read.option("header", True)
+                  .option("inferSchema", True).csv(csv_path))
         wl = KMeansSparkWorkload()
         wl.k_means(df)
-        sil = wl.silhouette()
+        sil = wl.silhouette(df)
         summary["spark_chain"] = {
             "rows": df.count(), "silhouette": round(float(sil), 4),
             "seconds": round(time.time() - t0, 1)}

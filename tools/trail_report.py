@@ -50,7 +50,8 @@ EXTRA_KEYS = ("step_time_ms", "mfu", "batch_size", "device_kind",
               # neither the committed old entry nor new captures drop a
               # disclosed field from the rendered table.
               "tuned_chunk", "chunk", "unpipelined_chunk",
-              "pipeline_depth", "dispatch_rtt_ms", "num_slots")
+              "pipeline_depth", "dispatch_rtt_ms", "tuning_grid",
+              "num_slots")
 
 
 def identity(argv) -> str:
@@ -112,6 +113,12 @@ def row(e: dict) -> str:
                 extras.append(f"{k} {100 * v:.1f}%")
             elif isinstance(v, float):
                 extras.append(f"{k} {v:g}")
+            elif isinstance(v, dict):
+                # nested disclosure (e.g. the cb tuning grid): compact
+                # json, pipes escaped so the table cell stays one cell
+                body = json.dumps(v, separators=(",", ":")).replace(
+                    "|", "\\|")
+                extras.append(f"{k} {body}")
             else:
                 extras.append(f"{k} {v}")
     return (f"| `{' '.join(e.get('argv') or [])}` | {r.get('metric')} | "
