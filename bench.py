@@ -32,7 +32,7 @@ only int8, int8 KV cache, beam search); ``python bench.py spec
 [--gamma N]`` measures speculative decoding (lower + upper bounds).
 ``python bench.py cb`` compares continuous batching (slot engine,
 train/continuous.py) against whole-batch serving on one request set.
-``python bench.py all`` runs the full 19-workload matrix with ONE
+``python bench.py all`` runs the full 20-workload matrix with ONE
 backend probe, appending every success to tools/bench_history.jsonl.
 
 Resilience: the TPU backend attach through the tunnel is known-flaky
@@ -1168,6 +1168,9 @@ ALL_WORKLOADS = (
     # the round-4 verdict's named fix: Pallas 1x1-conv kernels absorbing
     # the BatchNorm passes (same BN semantics, fused pass structure)
     ["resnet50", "--fused-bn"],
+    # ...and the full form: the stride-1 3x3 convs are Pallas too
+    # (norm1 never materializes; norm2 stats from the conv epilogue)
+    ["resnet50", "--fused-bn3"],
     ["vit"],
     ["bert"],
     ["bert", "--seq", "2048"],
@@ -1381,8 +1384,12 @@ def run_bench(argv) -> dict:
         raise SystemExit("--gn applies to the resnet50 workload only")
     if "--fused-bn" in argv and workload != "resnet50":
         raise SystemExit("--fused-bn applies to the resnet50 workload only")
-    if "--fused-bn" in argv and "--gn" in argv:
-        raise SystemExit("--fused-bn and --gn are exclusive norm variants")
+    if "--fused-bn3" in argv and workload != "resnet50":
+        raise SystemExit("--fused-bn3 applies to the resnet50 workload only")
+    if ("--fused-bn" in argv or "--fused-bn3" in argv) and "--gn" in argv:
+        raise SystemExit("--fused-bn/--fused-bn3 and --gn are exclusive")
+    if "--fused-bn" in argv and "--fused-bn3" in argv:
+        raise SystemExit("--fused-bn and --fused-bn3 are exclusive variants")
     if workload == "cnn":
         mu = None
         if "--bf16-moments" in argv:
@@ -1448,6 +1455,7 @@ def run_bench(argv) -> dict:
                           use_flash=use_flash, seq_override=seq,
                           throughput_batch=tb, s2d="--s2d" in argv,
                           norm_variant=("gn" if "--gn" in argv
+                                        else "fused3" if "--fused-bn3" in argv
                                         else "fused" if "--fused-bn" in argv
                                         else "bn"))
 

@@ -14,6 +14,7 @@ import functools
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 ModuleDef = Any
@@ -87,9 +88,11 @@ class FusedBottleneckBlock(nn.Module):
       pass (they already were — XLA fuses elementwise chains fine; only
       passes *adjacent to convs* needed kernel help).
 
-    The 3x3 conv stays an XLA conv: its normalized input (norm1) is
-    materialized, and its statistics cost one reduction read — a Pallas
-    3x3 conv with halo handling is the remaining (disclosed) step.
+    By default the 3x3 conv stays an XLA conv: its normalized input
+    (norm1) is materialized, and its statistics cost one reduction
+    read. ``pallas_conv3=True`` (``norm_variant="fused3"``) removes
+    those too for stride-1 blocks via the fused 3x3 kernel
+    (``ops/pallas/fused_conv3.py``).
 
     BatchNorm semantics match ``nn.BatchNorm(momentum=0.9, eps=1e-5)``:
     biased batch variance, running-average updates in train mode, the
@@ -104,6 +107,11 @@ class FusedBottleneckBlock(nn.Module):
     dtype: Any = jnp.bfloat16
     momentum: float = 0.9
     epsilon: float = 1e-5
+    # Own the 3x3 conv too (ops/pallas/fused_conv3.py): norm1 never
+    # materializes (applied on-read inside the conv) and norm2's stats
+    # come from the conv's epilogue. Stride-2 blocks always use the XLA
+    # conv (3 of 16 blocks; see fused_conv3's docstring).
+    pallas_conv3: bool = False
 
     def _bn_params(self, name: str, dim: int, zero_scale: bool = False):
         scale = self.param(
@@ -124,37 +132,51 @@ class FusedBottleneckBlock(nn.Module):
             ra_mean.value = m * ra_mean.value + (1.0 - m) * mean
             ra_var.value = m * ra_var.value + (1.0 - m) * var
 
-    def _fused_conv_bn(self, x_flat, w, bn, train, a_in=None, b_in=None):
-        """One fused 1x1-conv + BN-stat step: Pallas matmul (optional
-        on-read normalize+relu via ``a_in``/``b_in``), batch or running
-        statistics, running-average update, and the folded ``(a, b)``
-        affine for THIS conv's output norm. Returns ``(y_raw, a, b)``.
+    def _fold_stats(self, bn, train, stats=None, count=None, moments=None):
+        """moments -> running-average update -> folded ``(a, b)``.
 
-        Single home for the sequence so the multi-chip psum of the
-        sum/sumsq vectors (when a dp-sharded wrapper lands) changes one
-        place, not three."""
+        SINGLE home for this tail across every conv+BN site (the 1x1
+        helper below, the Pallas 3x3 branch, the XLA 3x3 branch): pass
+        ``stats=(sum, sumsq)`` + ``count`` from a kernel epilogue, or
+        ``moments=(mean, var)`` from an XLA reduction. A future
+        dp-sharded wrapper psums the sum/sumsq vectors HERE, one place.
+        Eval mode reads the running stats regardless."""
         from pyspark_tf_gke_tpu.ops.pallas.fused_matmul import (
-            bn_fold, norm_relu_matmul, stats_to_moments)
+            bn_fold, stats_to_moments)
 
         scale, bias, ra_mean, ra_var = bn
+        if train:
+            if stats is not None:
+                mean, var = stats_to_moments(*stats, count)
+            else:
+                mean, var = moments
+            self._update_ra(ra_mean, ra_var, mean, var)
+        else:
+            mean, var = ra_mean.value, ra_var.value
+        return bn_fold(mean, var, scale, bias, self.epsilon)
+
+    def _fused_conv_bn(self, x_flat, w, bn, train, a_in=None, b_in=None):
+        """One fused 1x1-conv + BN-stat step: Pallas matmul (optional
+        on-read normalize+relu via ``a_in``/``b_in``), then the shared
+        ``_fold_stats`` tail. Returns ``(y_raw, a, b)``."""
+        from pyspark_tf_gke_tpu.ops.pallas.fused_matmul import (
+            norm_relu_matmul)
+
         dt = self.dtype
         if train:
             y, s, ss = norm_relu_matmul(x_flat, w.astype(dt), a_in, b_in,
                                         relu=a_in is not None,
                                         want_stats=True)
-            mean, var = stats_to_moments(s, ss, y.shape[0])
-            self._update_ra(ra_mean, ra_var, mean, var)
+            a, b = self._fold_stats(bn, train, stats=(s, ss),
+                                    count=y.shape[0])
         else:
             y = norm_relu_matmul(x_flat, w.astype(dt), a_in, b_in,
                                  relu=a_in is not None)
-            mean, var = ra_mean.value, ra_var.value
-        a, b = bn_fold(mean, var, scale, bias, self.epsilon)
+            a, b = self._fold_stats(bn, train)
         return y, a, b
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        from pyspark_tf_gke_tpu.ops.pallas.fused_matmul import bn_fold
-
         b_, h, w_, cin = x.shape
         f = self.features
         init = nn.initializers.lecun_normal()
@@ -175,28 +197,48 @@ class FusedBottleneckBlock(nn.Module):
         # conv1 (1x1): raw output + stats in one Pallas pass
         y1, a1, b1 = self._fused_conv_bn(x_flat, w1, bn1, train)
 
-        # norm1+relu materializes for the XLA 3x3 conv (one fused
-        # elementwise pass; the stats read was already saved above)
-        n1 = jnp.maximum(
-            y1.astype(jnp.float32) * a1[None, :] + b1[None, :], 0.0
-        ).astype(dt).reshape(b_, h, w_, f)
-        y2 = nn.Conv(f, (3, 3), self.strides, use_bias=False, dtype=dt,
-                     name="conv2")(n1)
-        h2, w2 = y2.shape[1], y2.shape[2]
+        w2 = self.param("conv2_kernel", init, (3, 3, f, f), jnp.float32)
+        if self.pallas_conv3 and self.strides == (1, 1):
+            # fully fused 3x3: reads RAW y1 (norm1 applied on tiles in
+            # VMEM — nothing materializes) and emits norm2's stats from
+            # the output-writing epilogue
+            from pyspark_tf_gke_tpu.ops.pallas.fused_conv3 import (
+                conv3_norm_stats)
 
-        # norm2 statistics: one XLA reduction read of y2 (both moments
-        # in a single pass); the *normalize* is free — conv3 applies it
-        # on-read below
-        s2p, b2p, ra_m2, ra_v2 = bn2
-        if train:
-            y2f = y2.astype(jnp.float32)
-            mean2 = y2f.mean(axis=(0, 1, 2))
-            var2 = jnp.maximum((y2f * y2f).mean(axis=(0, 1, 2))
-                               - mean2 * mean2, 0.0)
-            self._update_ra(ra_m2, ra_v2, mean2, var2)
+            y1_4d = y1.reshape(b_, h, w_, f)
+            if train:
+                y2, s2, ss2 = conv3_norm_stats(
+                    y1_4d, w2.astype(dt), a1, b1, relu=True,
+                    want_stats=True)
+                a2, b2 = self._fold_stats(
+                    bn2, train, stats=(s2, ss2),
+                    count=y2.shape[0] * y2.shape[1] * y2.shape[2])
+            else:
+                y2 = conv3_norm_stats(y1_4d, w2.astype(dt), a1, b1,
+                                      relu=True)
+                a2, b2 = self._fold_stats(bn2, train)
         else:
-            mean2, var2 = ra_m2.value, ra_v2.value
-        a2, b2 = bn_fold(mean2, var2, s2p, b2p, self.epsilon)
+            # norm1+relu materializes for the XLA 3x3 conv (one fused
+            # elementwise pass; the stats read was already saved above)
+            n1 = jnp.maximum(
+                y1.astype(jnp.float32) * a1[None, :] + b1[None, :], 0.0
+            ).astype(dt).reshape(b_, h, w_, f)
+            y2 = jax.lax.conv_general_dilated(
+                n1, w2.astype(dt), self.strides, "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            # norm2 statistics: one XLA reduction read of y2 (both
+            # moments in a single pass); the *normalize* is free —
+            # conv3 applies it on-read below
+            if train:
+                y2f = y2.astype(jnp.float32)
+                mean2 = y2f.mean(axis=(0, 1, 2))
+                var2 = jnp.maximum((y2f * y2f).mean(axis=(0, 1, 2))
+                                   - mean2 * mean2, 0.0)
+                a2, b2 = self._fold_stats(bn2, train,
+                                          moments=(mean2, var2))
+            else:
+                a2, b2 = self._fold_stats(bn2, train)
+        h2, w2_ = y2.shape[1], y2.shape[2]
 
         # conv3 (1x1): normalize+relu on-read from RAW y2, stats epilogue
         y3, a3, b3 = self._fused_conv_bn(y2.reshape(-1, f), w3, bn3, train,
@@ -214,7 +256,7 @@ class FusedBottleneckBlock(nn.Module):
         # norm3 + residual add + relu: one fused XLA elementwise pass
         out = jnp.maximum(
             y3.astype(jnp.float32) * a3[None, :] + b3[None, :] + res, 0.0)
-        return out.astype(dt).reshape(b_, h2, w2, f * 4)
+        return out.astype(dt).reshape(b_, h2, w2_, f * 4)
 
 
 class ResNet(nn.Module):
@@ -247,7 +289,7 @@ class ResNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        if self.norm_variant in ("bn", "fused"):
+        if self.norm_variant in ("bn", "fused", "fused3"):
             # "fused" uses BatchNorm semantics; the stem norm (one small
             # tensor, between a 7x7 conv and a maxpool) stays nn.BatchNorm
             # — only the bottleneck blocks swap to the Pallas path.
@@ -269,7 +311,7 @@ class ResNet(nn.Module):
                 return _Identity(name=kw.get("name"))
         else:
             raise ValueError(
-                f"norm_variant must be bn|bn_f32|gn|none, got "
+                f"norm_variant must be bn|bn_f32|gn|none|fused|fused3, got "
                 f"{self.norm_variant!r}")
         x = x.astype(self.dtype) if self.dtype else x
         if self.s2d_stem:
@@ -285,10 +327,11 @@ class ResNet(nn.Module):
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                if self.norm_variant == "fused":
+                if self.norm_variant in ("fused", "fused3"):
                     x = FusedBottleneckBlock(
                         self.num_filters * 2 ** i, strides=strides,
                         dtype=self.dtype or jnp.float32,
+                        pallas_conv3=self.norm_variant == "fused3",
                     )(x, train=train)
                 else:
                     x = BottleneckBlock(

@@ -492,7 +492,8 @@ def test_stale_matrix_against_committed_trail():
     # acceptable holes; anything else means a workload's argv was
     # renamed and its history silently orphaned. Once the watcher
     # captures them this set just shrinks (subset check still passes).
-    queued = {"cnn --adafactor", "resnet50 --gn", "resnet50 --fused-bn"}
+    queued = {"cnn --adafactor", "resnet50 --gn", "resnet50 --fused-bn",
+              "resnet50 --fused-bn3"}
     assert missing <= queued, (
         f"matrix workloads with no trail entry: {sorted(missing - queued)}")
 
