@@ -534,3 +534,18 @@ def test_fused_bn_flag_guards():
         bench.run_bench(["cnn", "--fused-bn", "--smoke"])
     with pytest.raises(SystemExit):
         bench.run_bench(["resnet50", "--fused-bn", "--gn", "--smoke"])
+
+
+def test_trail_report_renders_dict_disclosures():
+    # The cb tuning grid is a dict-valued disclosure; it must render as
+    # one escaped cell, not break the table or drop silently.
+    from tools import trail_report
+
+    assert "tuning_grid" in trail_report.EXTRA_KEYS
+    e = {"ts": "t1", "argv": ["cb"],
+         "result": {"metric": "m", "value": 1.0, "unit": "u",
+                    "tuning_grid": {"chunk64_depth1": 1700.1,
+                                    "chunk128_depth2": 1800.5}}}
+    out = trail_report.row(e)
+    assert '"chunk64_depth1":1700.1' in out
+    assert out.count("|") == 6  # 5 columns + borders: grid stayed one cell
