@@ -254,11 +254,15 @@ def test_matrix_fast_fails_when_tunnel_dies_mid_matrix(monkeypatch, capsys):
     monkeypatch.setattr(bench, "orchestrate", fake_orchestrate)
     monkeypatch.setattr(bench, "probe_backend_once", lambda *a: "")
     failures = bench._run_matrix([], backend_ok=True)
-    # Everything before vit ran; vit failed; after vit only io ran.
+    # Everything before vit ran; vit failed; after vit only io ran
+    # (order-agnostic: derive the post-vit set from ALL_WORKLOADS).
     names = [a[0] for a in ran]
     assert "vit" in names and "io" in names
     assert names.index("vit") < names.index("io")
-    assert "bert" not in names and "generate" not in names
+    vit_pos = [list(w) for w in bench.ALL_WORKLOADS].index(["vit"])
+    after_vit = [list(w) for w in bench.ALL_WORKLOADS[vit_pos + 1:]
+                 if w[0] != "io"]
+    assert all(w not in ran for w in after_vit)
     out = capsys.readouterr().out
     assert "mid-matrix" in out  # fast-fail error JSON names the cause
     dead_device = [w for w in bench.ALL_WORKLOADS
