@@ -918,15 +918,26 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default=int(e("CONTINUOUS_CHUNK", "8")),
                    help="decode steps per engine dispatch between "
                         "admission points")
-    p.add_argument("--continuous-pipeline", type=int,
+    def _pipeline_depth(v: str) -> int:
+        n = int(v)
+        if not 0 <= n <= 4:
+            # fail fast at argparse time, not after the bundle loads;
+            # depth beyond a few chunks only adds token latency and
+            # discarded post-eos decode work
+            raise argparse.ArgumentTypeError(
+                f"--continuous-pipeline must be 0..4, got {n}")
+        return n
+
+    p.add_argument("--continuous-pipeline", type=_pipeline_depth,
                    default=int(e("CONTINUOUS_PIPELINE", "0")),
-                   choices=(0, 1),
-                   help="decode-ahead: dispatch chunk N+1 before reading "
-                        "chunk N so the readback latency overlaps compute "
-                        "(measured +52%% engine tokens/sec over a "
-                        "remote-attached chip at chunk 64; multi-host: "
-                        "the chunk is announced dispatch-only and the "
-                        "gathers replay at OP_CB_COLLECT)")
+                   help="decode-ahead depth: keep up to N dispatched "
+                        "chunks un-collected so readback latency overlaps "
+                        "compute (measured +52%% engine tokens/sec over a "
+                        "remote-attached chip at chunk 64 depth 1; depth "
+                        ">=2 is single-host only — the engine enforces "
+                        "it; multi-host: the chunk is announced "
+                        "dispatch-only and the gathers replay at "
+                        "OP_CB_COLLECT)")
     p.add_argument("--stdin", action="store_true",
                    help="serve stdin lines instead of HTTP: each input "
                         "line is a prompt, each output line a JSON result")
