@@ -538,3 +538,15 @@ def test_chunked_prefill_over_the_wire(tmp_path_factory):
     finally:
         httpd.shutdown()
         server._front.shutdown()
+
+
+def test_continuous_pipeline_flag_bounds():
+    # depth validates at argparse time (before any bundle load): 0..4
+    # accepted, negatives and chunk-sized confusions fail fast.
+    from pyspark_tf_gke_tpu.train.serve import parse_args
+
+    assert parse_args(["--bundle", "x",
+                       "--continuous-pipeline", "2"]).continuous_pipeline == 2
+    for bad in ("-1", "5", "64"):
+        with pytest.raises(SystemExit):
+            parse_args(["--bundle", "x", "--continuous-pipeline", bad])
