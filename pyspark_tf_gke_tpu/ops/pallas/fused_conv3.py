@@ -43,8 +43,10 @@ from pyspark_tf_gke_tpu.ops.pallas.fused_matmul import (
 def _transform(x, a_ref, b_ref, transform: bool, relu: bool):
     if not transform:
         return x
-    t = x.astype(jnp.float32) * a_ref[...][None, None, :] \
-        + b_ref[...][None, None, :]
+    # a/b arrive as (1, K) blocks (Mosaic's 1-D operand layout check
+    # rejects partial 1-D tiles on real TPUs — see fused_matmul.py);
+    # [None] lifts them to (1, 1, K) to broadcast over (H, W, K).
+    t = x.astype(jnp.float32) * a_ref[...][None] + b_ref[...][None]
     if relu:
         t = jnp.maximum(t, 0.0)
     return t.astype(x.dtype)
@@ -86,8 +88,8 @@ def _fwd_call(x, w, a, b, *, relu, want_stats, interpret):
         in_specs=[
             pl.BlockSpec((1, h, w_, k), lambda i: (i, 0, 0, 0), **mem),
             pl.BlockSpec((3, 3, k, n), lambda i: (0, 0, 0, 0), **mem),
-            pl.BlockSpec((k,), lambda i: (0,), **mem),
-            pl.BlockSpec((k,), lambda i: (0,), **mem),
+            pl.BlockSpec((1, k), lambda i: (0, 0), **mem),
+            pl.BlockSpec((1, k), lambda i: (0, 0), **mem),
         ],
         out_specs=[
             pl.BlockSpec((1, h, w_, n), lambda i: (i, 0, 0, 0), **mem),
@@ -99,7 +101,7 @@ def _fwd_call(x, w, a, b, *, relu, want_stats, interpret):
         ],
         scratch_shapes=[_pad_scratch(h, w_, k, x.dtype)],
         interpret=interpret,
-    )(x, w, a, b)
+    )(x, w, a.reshape(1, k), b.reshape(1, k))
     return y, stats.sum(axis=0)
 
 
@@ -131,9 +133,9 @@ def _dx_kernel(dy_ref, w_ref, x_ref, a_ref, b_ref, dx_ref, ds_ref, pad_ref,
                 preferred_element_type=jnp.float32)
     if transform:
         xf = x_ref[0].astype(jnp.float32).reshape(h * w_, k)
-        a = a_ref[...][None, :]
+        a = a_ref[...]  # (1, k): broadcasts over rows
         if relu:
-            t = xf * a + b_ref[...][None, :]
+            t = xf * a + b_ref[...]
             u = jnp.where(t > 0.0, u, 0.0)
         dx_ref[0] = (u * a).reshape(h, w_, k).astype(dx_ref.dtype)
         ds_ref[0] = jnp.stack([(u * xf).sum(axis=0), u.sum(axis=0)])
@@ -157,8 +159,8 @@ def _dx_call(dy, w, x, a, b, *, relu, interpret):
             pl.BlockSpec((1, h, w_, n), lambda i: (i, 0, 0, 0), **mem),
             pl.BlockSpec((3, 3, k, n), lambda i: (0, 0, 0, 0), **mem),
             pl.BlockSpec((1, h, w_, k), lambda i: (i, 0, 0, 0), **mem),
-            pl.BlockSpec((k,), lambda i: (0,), **mem),
-            pl.BlockSpec((k,), lambda i: (0,), **mem),
+            pl.BlockSpec((1, k), lambda i: (0, 0), **mem),
+            pl.BlockSpec((1, k), lambda i: (0, 0), **mem),
         ],
         out_specs=[
             pl.BlockSpec((1, h, w_, k), lambda i: (i, 0, 0, 0), **mem),
@@ -170,7 +172,7 @@ def _dx_call(dy, w, x, a, b, *, relu, interpret):
         ],
         scratch_shapes=[_pad_scratch(h, w_, n, dy.dtype)],
         interpret=interpret,
-    )(dy, w, x, a, b)
+    )(dy, w, x, a.reshape(1, k), b.reshape(1, k))
     return dx, dstats.sum(axis=0)
 
 
@@ -214,14 +216,14 @@ def _dw_call(x, dy, a, b, *, relu, interpret):
         in_specs=[
             pl.BlockSpec((1, h, w_, k), lambda i: (i, 0, 0, 0), **mem),
             pl.BlockSpec((1, h, w_, n), lambda i: (i, 0, 0, 0), **mem),
-            pl.BlockSpec((k,), lambda i: (0,), **mem),
-            pl.BlockSpec((k,), lambda i: (0,), **mem),
+            pl.BlockSpec((1, k), lambda i: (0, 0), **mem),
+            pl.BlockSpec((1, k), lambda i: (0, 0), **mem),
         ],
         out_specs=pl.BlockSpec((3, 3, k, n), lambda i: (0, 0, 0, 0), **mem),
         out_shape=jax.ShapeDtypeStruct((3, 3, k, n), jnp.float32),
         scratch_shapes=[_pad_scratch(h, w_, k, x.dtype)],
         interpret=interpret,
-    )(x, dy, a, b)
+    )(x, dy, a.reshape(1, k), b.reshape(1, k))
     return dw
 
 

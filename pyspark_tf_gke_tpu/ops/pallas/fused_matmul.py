@@ -98,7 +98,12 @@ def _fwd_kernel(x_ref, w_ref, a_ref, b_ref, y_ref, s_ref, acc_ref, *,
 
     x = x_ref[...]
     if transform:
-        t = x.astype(jnp.float32) * a_ref[...][None, :] + b_ref[...][None, :]
+        # a/b ride as (1, bk) 2-D blocks: Mosaic rejects 1-D operand
+        # blocks that don't match XLA's 1-D layout tile (seen on real
+        # v5e: "XLA layout {0:T(1024)} does not match Mosaic layout
+        # {0:T(512)} for f32[1024]"), while (1, K) lanes-shaped vectors
+        # follow the ordinary 2-D tiling rules.
+        t = x.astype(jnp.float32) * a_ref[...] + b_ref[...]
         if relu:
             t = jnp.maximum(t, 0.0)
         xn = t.astype(x.dtype)  # bf16 feed matches the unfused norm's dtype
@@ -146,8 +151,8 @@ def _fwd_call(x, w, a, b, *, relu, want_stats, block_m, block_n, block_k,
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, k: (i, k), **mem),
             pl.BlockSpec((bk, bn), lambda i, j, k: (k, j), **mem),
-            pl.BlockSpec((bk,), lambda i, j, k: (k,), **mem),
-            pl.BlockSpec((bk,), lambda i, j, k: (k,), **mem),
+            pl.BlockSpec((1, bk), lambda i, j, k: (0, k), **mem),
+            pl.BlockSpec((1, bk), lambda i, j, k: (0, k), **mem),
         ],
         out_specs=[
             pl.BlockSpec((bm, bn), lambda i, j, k: (i, j), **mem),
@@ -159,7 +164,7 @@ def _fwd_call(x, w, a, b, *, relu, want_stats, block_m, block_n, block_k,
         ],
         scratch_shapes=[_scratch((bm, bn))],
         interpret=interpret,
-    )(x, w, a, b)
+    )(x, w, a.reshape(1, kdim), b.reshape(1, kdim))
     # reduce the per-M-tile partials: (m_tiles, 2, n) f32 — a few MB at
     # most, one cheap XLA pass, no undefined revisit semantics
     return y, stats.sum(axis=0)
@@ -187,9 +192,9 @@ def _dx_kernel(dy_ref, w_ref, x_ref, a_ref, b_ref, dx_ref, ds_ref, acc_ref,
         u = acc_ref[...]  # d xn
         if transform:
             xf = x_ref[...].astype(jnp.float32)
-            a = a_ref[...][None, :]
+            a = a_ref[...]  # (1, bk): broadcasts over rows
             if relu:
-                t = xf * a + b_ref[...][None, :]
+                t = xf * a + b_ref[...]
                 u = jnp.where(t > 0.0, u, 0.0)  # relu mask on d t
             dx_ref[...] = (u * a).astype(dx_ref.dtype)
             # per-M-tile partials for (da, db) — same no-revisit rule as
@@ -221,8 +226,8 @@ def _dx_call(dy, w, x, a, b, *, relu, block_m, block_n, block_k, interpret):
             pl.BlockSpec((bm, bn), lambda i, j, n: (i, n), **mem),
             pl.BlockSpec((bk, bn), lambda i, j, n: (j, n), **mem),
             pl.BlockSpec((bm, bk), lambda i, j, n: (i, j), **mem),
-            pl.BlockSpec((bk,), lambda i, j, n: (j,), **mem),
-            pl.BlockSpec((bk,), lambda i, j, n: (j,), **mem),
+            pl.BlockSpec((1, bk), lambda i, j, n: (0, j), **mem),
+            pl.BlockSpec((1, bk), lambda i, j, n: (0, j), **mem),
         ],
         out_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, n: (i, j), **mem),
@@ -234,7 +239,7 @@ def _dx_call(dy, w, x, a, b, *, relu, block_m, block_n, block_k, interpret):
         ],
         scratch_shapes=[_scratch((bm, bk))],
         interpret=interpret,
-    )(dy, w, x, a, b)
+    )(dy, w, x, a.reshape(1, kdim), b.reshape(1, kdim))
     return dx, dstats.sum(axis=0)
 
 
@@ -248,7 +253,7 @@ def _dw_kernel(x_ref, dy_ref, a_ref, b_ref, dw_ref, acc_ref, *,
 
     x = x_ref[...]
     if transform:
-        t = x.astype(jnp.float32) * a_ref[...][None, :] + b_ref[...][None, :]
+        t = x.astype(jnp.float32) * a_ref[...] + b_ref[...]
         if relu:
             t = jnp.maximum(t, 0.0)
         xn = t.astype(x.dtype)
@@ -283,14 +288,14 @@ def _dw_call(x, dy, a, b, *, relu, block_m, block_n, block_k, interpret):
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, mstep: (mstep, i), **mem),
             pl.BlockSpec((bm, bn), lambda i, j, mstep: (mstep, j), **mem),
-            pl.BlockSpec((bk,), lambda i, j, mstep: (i,), **mem),
-            pl.BlockSpec((bk,), lambda i, j, mstep: (i,), **mem),
+            pl.BlockSpec((1, bk), lambda i, j, mstep: (0, i), **mem),
+            pl.BlockSpec((1, bk), lambda i, j, mstep: (0, i), **mem),
         ],
         out_specs=pl.BlockSpec((bk, bn), lambda i, j, mstep: (i, j), **mem),
         out_shape=jax.ShapeDtypeStruct((kdim, n), dy.dtype),
         scratch_shapes=[_scratch((bk, bn))],
         interpret=interpret,
-    )(x, dy, a, b)
+    )(x, dy, a.reshape(1, kdim), b.reshape(1, kdim))
 
 
 # ---------------------------------------------------------------------------
