@@ -12,9 +12,9 @@ Mechanics (standard batched beam search, TPU-shaped):
   the expensive pass);
 * each step scores ``[B*K, V]`` continuations, flattens per batch row
   to ``[B, K*V]``, takes the top-K, and reorders the cache and token
-  history over the beam axis with no dynamic shapes — large leaves via
-  a K-way broadcast select (vectorized; see ``_reorder_beams``), small
-  ones via ``take_along_axis``;
+  history over the beam axis with no dynamic shapes via
+  ``take_along_axis`` (the hardware-measured winner — see
+  ``_reorder_beams`` for the K-way-select A/B result);
 * hypotheses that emit eos move into a FINISHED pool of K
   length-penalized entries (GNMT-style); active beams never carry eos,
   so a short finished hypothesis can never be evicted by longer
@@ -41,29 +41,28 @@ def _tile_beams(tree, k: int):
         lambda l: l if l.ndim == 0 else jnp.repeat(l, k, axis=0), tree)
 
 
-def _reorder_beams(tree, beam_idx):
+def _reorder_beams(tree, beam_idx, select: bool = False):
     """Gather beams: tree leaves [B*K, ...], beam_idx [B, K] of source
     beam indices within each batch row. Scalar leaves pass through.
 
-    Large leaves (the KV cache — hundreds of MB regathered EVERY decode
-    step) reorder as a statically-unrolled K-way broadcast SELECT
-    instead of ``take_along_axis``: K is tiny, so the chained
-    ``where(beam_idx == j, source_j, acc)`` fuses into one vectorized
-    pass over the output reading the K source rows — where the
-    row-gather lowering has measured badly on TPU (32.9 ms/step at
-    beam 4 vs 2.1 greedy — far above the bandwidth arithmetic; same op
-    class as the embedding backward the round-4 iota-embed fix
-    replaced). Semantics are element-exact vs the gather (values only
-    ever COPIED, never multiplied — a NaN/inf travels with its own
-    beam and cannot leak across rows). Small leaves and wide beam
-    counts keep the gather."""
+    The round-4 hypothesis was that a statically-unrolled K-way
+    broadcast SELECT (``where(beam_idx == j, source_j, acc)`` chained
+    over the K source rows) would beat ``take_along_axis`` for the
+    large KV-cache leaves, the way the iota-embed rewrite beat the
+    embedding backward's gather. The round-5 hardware A/B answered NO:
+    on the v5e the select path measured 95.5 ms/decode-step at beam 4
+    vs the gather's 32.9 ms (trail ``generate --beams 4``, ts
+    2026-08-01 vs 2026-07-31) — the K-fold read amplification of the
+    chained wheres costs 3x more than the gather lowering it replaced.
+    The gather is the default again; ``select=True`` keeps the losing
+    variant reachable for future re-measurement on other topologies."""
     b, k = beam_idx.shape
 
     def gather(leaf):
         if leaf.ndim == 0:
             return leaf
         grouped = leaf.reshape(b, k, *leaf.shape[1:])
-        if leaf.size >= (1 << 16) and k <= 16:
+        if select and leaf.size >= (1 << 16) and k <= 16:
             flat = grouped.reshape(b, k, -1)
             sel = beam_idx.reshape(b, k, 1)
             out = flat  # j == identity covered by the wheres below

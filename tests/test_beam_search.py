@@ -178,7 +178,7 @@ def test_reorder_beams_select_path_matches_gather():
         small = leaf.reshape(b, k, f)
         expected = jnp.take_along_axis(
             small, idx[:, :, None], axis=1).reshape(b * k, f)
-        got = _reorder_beams(leaf, idx)
+        got = _reorder_beams(leaf, idx, select=True)
         assert got.shape == expected.shape and got.dtype == expected.dtype
         np.testing.assert_array_equal(
             np.asarray(got, np.float32), np.asarray(expected, np.float32))
