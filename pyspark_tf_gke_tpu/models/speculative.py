@@ -26,10 +26,29 @@ row, and the scalar fill index (deliberately scalar — it keeps decode
 masks cheap) cannot roll rows back independently. Speculation is a
 latency tool; batch throughput is better served by plain batched decode.
 
-The round loop runs on the host (each round needs the accepted count —
-the classic speculative-decoding sync); the per-round pieces (draft
-scan, target chunk forward) are module-level jits keyed by static
-shapes, so steady-state rounds compile nothing.
+Two round-loop drivers share the per-round pieces (draft scan, target
+chunk forward — module-level jits keyed by static shapes):
+
+- **host loop**: each round syncs the accepted count to the host (the
+  classic speculative-decoding structure). Fine on a locally attached
+  chip; catastrophic over a remote tunnel — the round-5 hardware trail
+  measured 66.5 ms dispatch RTT and 2-3 host readbacks per round, an
+  RTT floor that dwarfs the compute.
+- **device loop** (``_device_rounds``): the ENTIRE propose → verify →
+  accept → rollback iteration runs inside one ``lax.while_loop`` — a
+  whole generation is ONE dispatch with ONE readback at the end. The
+  per-round variable advance (1..gamma+1 tokens) stays static-shaped:
+  accepted drafts + correction are written as a fixed (gamma+1)-wide
+  masked window into a token buffer, and the draft cache is resynced by
+  REWRITING the last gamma+1 rows before the fill point from that
+  buffer each round (a fixed-width chunk feed; rewriting a row with its
+  own token/position is idempotent, and rows past the fill index are
+  invisible by the cache mask).
+
+``speculative_generate`` auto-picks the device loop whenever the
+slightly stricter sequence bound fits (the verify chunk may overhang by
+gamma; see the validation) — both drivers emit the target model's own
+greedy tokens, so the choice affects speed only.
 """
 
 from __future__ import annotations
@@ -100,6 +119,114 @@ def _draft_propose(model: CausalLM, params, cache, last_tok, pos, gamma: int):
     return toks.T, cache  # [B, gamma]
 
 
+def _pad_after_eos(out, max_new_tokens: int, eos_token_id: Optional[int]):
+    """``generate()``'s output contract: truncate at the first eos and
+    pad with it to the fixed length; without eos, repeat the last
+    token."""
+    if eos_token_id is not None and eos_token_id in out:
+        stop = out.index(eos_token_id)
+        return out[:stop + 1] + [eos_token_id] * (max_new_tokens - stop - 1)
+    return out + [out[-1]] * (max_new_tokens - len(out))
+
+
+@partial(jax.jit, static_argnames=("target_model", "draft_model", "gamma",
+                                   "max_new_tokens", "eos_token_id"))
+def _device_rounds(target_model: CausalLM, target_params,
+                   draft_model: CausalLM, draft_params,
+                   t_cache, d_cache, all_tokens, s_prompt,
+                   gamma: int, max_new_tokens: int,
+                   eos_token_id: Optional[int]):
+    """The whole speculative round loop as ONE jitted ``while_loop``.
+
+    ``all_tokens [1, s_prompt + max_new + gamma + 1]`` starts as
+    prompt + first-emitted-token (+ zero tail); rounds append through a
+    fixed-width masked window. Returns the filled buffer plus
+    ``(n_emitted, rounds, accepted)`` scalars — the only host readback
+    of the generation.
+    """
+    g = gamma
+    width = g + 1  # verify chunk = [newest emitted, d_0..d_{g-1}]
+    iota = jnp.arange(width, dtype=jnp.int32)
+
+    def body(carry):
+        (all_toks, n_emitted, t_cache, d_cache, done, rounds, proposed,
+         accepted) = carry
+        t_fill = s_prompt + n_emitted - 1  # rows FED to the target
+
+        # 1. draft resync: rewrite the last `width` rows before t_fill
+        #    from the token buffer. Any round advances <= width rows, so
+        #    the window always covers whatever a previous round left
+        #    stale; near the sequence start it clamps to 0 and the
+        #    out-of-frontier columns it feeds land past the fill index —
+        #    invisible, and overwritten by the very next propose.
+        start = jnp.maximum(t_fill - width, 0)
+        chunk = jax.lax.dynamic_slice(all_toks, (0, start), (1, width))
+        d_synced = _set_cache_index(d_cache, start)
+        _, d_synced = _extend(
+            draft_model, draft_params, d_synced, chunk, start,
+            cache_only=True)
+        d_synced = _set_cache_index(d_synced, t_fill)
+
+        # 2. propose + 3. verify — the same jitted pieces the host loop
+        #    uses (they inline here)
+        last_tok = jax.lax.dynamic_slice(all_toks, (0, t_fill), (1, 1))[:, 0]
+        drafts, d_synced = _draft_propose(
+            draft_model, draft_params, d_synced, last_tok, t_fill, g)
+        vchunk = jnp.concatenate([last_tok[:, None], drafts], axis=1)
+        t_next = _set_cache_index(t_cache, t_fill)
+        logits, t_next = _extend(
+            target_model, target_params, t_next, vchunk, t_fill)
+        preds = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # [g+1]
+
+        # 4. greedy acceptance + fixed-width emit: positions < a carry
+        #    accepted drafts, position a the correction token, and the
+        #    tail repeats the correction — written past the frontier and
+        #    overwritten by the next round's window.
+        match = (drafts[0] == preds[:-1]).astype(jnp.int32)
+        a = jnp.sum(jnp.cumprod(match))
+        padded = jnp.concatenate(
+            [drafts[0], jnp.zeros((1,), jnp.int32)])
+        window = jnp.where(iota < a, padded, preds[a])
+        all_toks = jax.lax.dynamic_update_slice(
+            all_toks, window[None], (0, s_prompt + n_emitted))
+        if eos_token_id is not None:
+            done = done | jnp.any(
+                (window == eos_token_id) & (iota <= a))
+        # Stats use the HOST loop's budget-capped definitions: the host
+        # drafts only min(gamma, budget) in a short final round, while
+        # this loop always drafts gamma (static shapes) and trims the
+        # overshoot on readback — counting the raw gamma would bias
+        # acceptance low and tokens/round high for short generations.
+        budget = max_new_tokens - n_emitted
+        g_eff = jnp.minimum(g, budget)
+        proposed = proposed + g_eff
+        accepted = accepted + jnp.minimum(a, g_eff)
+        n_emitted = n_emitted + a + 1
+
+        # 5. rollback = index reset (stale rows are invisible)
+        new_fill = s_prompt + n_emitted - 1
+        t_next = _set_cache_index(t_next, new_fill)
+        d_synced = _set_cache_index(d_synced, new_fill)
+        return (all_toks, n_emitted, t_next, d_synced, done,
+                rounds + 1, proposed, accepted)
+
+    def cond(carry):
+        _, n_emitted, _, _, done, _, _, _ = carry
+        return jnp.logical_and(n_emitted < max_new_tokens,
+                               jnp.logical_not(done))
+
+    done0 = jnp.asarray(False)
+    if eos_token_id is not None:  # prefill's token may already end it
+        done0 = jnp.squeeze(jax.lax.dynamic_slice(
+            all_tokens, (0, s_prompt), (1, 1)) == eos_token_id)
+    init = (all_tokens, jnp.asarray(1, jnp.int32), t_cache, d_cache,
+            done0, jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+            jnp.asarray(0, jnp.int32))
+    (all_toks, n_emitted, _, _, _, rounds, proposed,
+     accepted) = jax.lax.while_loop(cond, body, init)
+    return all_toks, n_emitted, rounds, proposed, accepted
+
+
 def speculative_generate(
     target_model: CausalLM,
     target_params,
@@ -110,6 +237,7 @@ def speculative_generate(
     gamma: int = 4,
     eos_token_id: Optional[int] = None,
     return_stats: bool = False,
+    device_loop: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Greedy generation from the TARGET model, accelerated by a draft.
 
@@ -117,6 +245,14 @@ def speculative_generate(
     ``generate(target_model, target_params, prompt_ids, ...)`` greedy
     (after eos, positions pad with eos). With ``return_stats`` also
     returns ``{"rounds": r, "proposed": p, "accepted": a}``.
+
+    ``device_loop`` selects the driver: ``True`` forces the one-dispatch
+    ``lax.while_loop`` form, ``False`` the per-round host-sync form,
+    ``None`` (default) picks the device loop whenever its slightly
+    stricter bound fits — the in-loop verify chunk may overhang the
+    final token by up to ``gamma``, so it needs
+    ``s_prompt + max_new_tokens + gamma - 1 <= max_seq_len`` on both
+    models (the host loop shrinks its last chunks instead).
     """
     if prompt_ids.shape[0] != 1:
         raise ValueError(
@@ -142,6 +278,19 @@ def speculative_generate(
             f"prompt {s_prompt} + {max_new_tokens} new tokens exceeds the "
             f"DRAFT's max_seq_len {draft_model.cfg.max_seq_len}")
 
+    device_fits = (
+        s_prompt + max_new_tokens + gamma - 1 <= target_model.cfg.max_seq_len
+        and s_prompt + max_new_tokens + gamma - 1
+        <= draft_model.cfg.max_seq_len)
+    if device_loop is None:
+        device_loop = device_fits
+    elif device_loop and not device_fits:
+        raise ValueError(
+            f"device_loop needs prompt {s_prompt} + {max_new_tokens} new "
+            f"+ gamma {gamma} - 1 within both models' max_seq_len "
+            f"(target {target_model.cfg.max_seq_len}, draft "
+            f"{draft_model.cfg.max_seq_len}); use device_loop=None/False")
+
     # Prefill both models on the prompt. The target's last-token logits
     # give the first emitted token for free.
     t_cache, t_last = _prefill(target_model, target_params, prompt_ids)
@@ -152,6 +301,36 @@ def speculative_generate(
     # process must read the same values — a bare np.asarray would raise
     # on non-addressable shards instead
     from pyspark_tf_gke_tpu.parallel.distributed import as_host_array
+
+    if device_loop:
+        buf = jnp.zeros((1, s_prompt + max_new_tokens + gamma + 1),
+                        jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, prompt_ids, (0, 0))
+        first_tok = jnp.argmax(t_last, axis=-1).astype(jnp.int32)
+        buf = jax.lax.dynamic_update_slice(
+            buf, first_tok[:, None], (0, s_prompt))
+        all_toks, n_emitted, rounds, proposed, accepted = _device_rounds(
+            target_model, target_params, draft_model, draft_params,
+            t_cache, d_cache, buf, jnp.asarray(s_prompt, jnp.int32),
+            gamma, max_new_tokens, eos_token_id)
+        host_buf = np.asarray(as_host_array(all_toks))[0]
+        n_emitted = int(np.asarray(as_host_array(n_emitted)))
+        rounds = int(np.asarray(as_host_array(rounds)))
+        proposed_total = int(np.asarray(as_host_array(proposed)))
+        accepted_total = int(np.asarray(as_host_array(accepted)))
+        emitted = [int(t) for t in
+                   host_buf[s_prompt:s_prompt + min(n_emitted,
+                                                    max_new_tokens)]]
+        out = _pad_after_eos(emitted, max_new_tokens, eos_token_id)
+        result = jnp.concatenate(
+            [prompt_ids, jnp.asarray([out], jnp.int32)], axis=1)
+        if return_stats:
+            return result, {"rounds": rounds, "proposed": proposed_total,
+                            "accepted": accepted_total,
+                            "tokens_per_round":
+                            (min(n_emitted, max_new_tokens) - 1)
+                            / max(rounds, 1)}
+        return result
 
     first = int(np.asarray(as_host_array(jnp.argmax(t_last, axis=-1)))[0])
     emitted = [first]
@@ -217,19 +396,18 @@ def speculative_generate(
         d_cache = _set_cache_index(d_cache, d_fill)
 
     # eos padding to the fixed output length (generate()'s contract)
-    out = emitted[:max_new_tokens]
-    if eos_token_id is not None and eos_token_id in out:
-        stop = out.index(eos_token_id)
-        out = out[:stop + 1] + [eos_token_id] * (max_new_tokens - stop - 1)
-    else:
-        out = out + [out[-1]] * (max_new_tokens - len(out))
+    out = _pad_after_eos(emitted[:max_new_tokens], max_new_tokens,
+                         eos_token_id)
     result = jnp.concatenate(
         [prompt_ids, jnp.asarray([out], jnp.int32)], axis=1)
     if return_stats:
         # the first token came free from the prefill, not from a round —
-        # excluding it keeps the stat within its gamma+1 ceiling
+        # excluding it keeps the stat within its gamma+1 ceiling; the
+        # cap keeps the final round's draft overshoot out of the stat
+        # (same definition as the device driver)
         return result, {"rounds": rounds, "proposed": proposed,
                         "accepted": accepted_total,
-                        "tokens_per_round": (len(emitted) - 1)
+                        "tokens_per_round":
+                        (min(len(emitted), max_new_tokens) - 1)
                         / max(rounds, 1)}
     return result

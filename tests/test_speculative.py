@@ -120,6 +120,57 @@ def test_speculative_composes_with_gqa_and_int8_kv(models):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_device_loop_matches_host_loop(models):
+    """The one-dispatch while_loop driver and the per-round host-sync
+    driver must produce identical tokens AND consistent stats — the
+    driver choice is a speed lever only (round-4 verdict: the host
+    loop's accept/rollback readbacks are RTT-bound over a tunnel)."""
+    (tm, tp), (dm, dp) = models
+    rng = np.random.default_rng(7)
+    for mnt, gamma in ((20, 4), (7, 3), (1, 2)):
+        prompt = jnp.asarray(rng.integers(0, 97, (1, 5)).astype(np.int32))
+        host_out, host_stats = speculative_generate(
+            tm, tp, dm, dp, prompt, max_new_tokens=mnt, gamma=gamma,
+            return_stats=True, device_loop=False)
+        dev_out, dev_stats = speculative_generate(
+            tm, tp, dm, dp, prompt, max_new_tokens=mnt, gamma=gamma,
+            return_stats=True, device_loop=True)
+        np.testing.assert_array_equal(np.asarray(dev_out),
+                                      np.asarray(host_out))
+        assert dev_stats["accepted"] <= dev_stats["proposed"]
+        if mnt > 1:
+            assert dev_stats["rounds"] >= 1
+
+
+def test_device_loop_eos_matches_host_loop(models):
+    (tm, tp), (dm, dp) = models
+    prompt = jnp.asarray(
+        np.random.default_rng(8).integers(0, 97, (1, 5)).astype(np.int32))
+    plain = np.asarray(generate(tm, tp, prompt, max_new_tokens=16))[0, 5:]
+    eos = int(plain[len(plain) // 2])
+    host_out = speculative_generate(tm, tp, dm, dp, prompt,
+                                    max_new_tokens=16, gamma=3,
+                                    eos_token_id=eos, device_loop=False)
+    dev_out = speculative_generate(tm, tp, dm, dp, prompt,
+                                   max_new_tokens=16, gamma=3,
+                                   eos_token_id=eos, device_loop=True)
+    np.testing.assert_array_equal(np.asarray(dev_out), np.asarray(host_out))
+
+
+def test_device_loop_seq_bound(models):
+    """Forcing the device loop past its stricter bound errors; auto mode
+    falls back to the host loop and still matches plain greedy."""
+    (tm, tp), (dm, dp) = models
+    prompt = jnp.zeros((1, 80), jnp.int32)  # 80 + 16 + 4 - 1 = 99 > 96
+    with pytest.raises(ValueError, match="device_loop"):
+        speculative_generate(tm, tp, dm, dp, prompt, max_new_tokens=16,
+                             gamma=4, device_loop=True)
+    ref = generate(tm, tp, prompt, max_new_tokens=16)
+    out = speculative_generate(tm, tp, dm, dp, prompt, max_new_tokens=16,
+                               gamma=4)  # auto -> host driver
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
 def test_trained_fixture_meaningful_acceptance():
     """Round-3 VERDICT Weak #5: a REAL draft/target pair (both trained
     on the same synthetic text, train/spec_fixture.py) must land the
