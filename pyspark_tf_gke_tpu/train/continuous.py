@@ -60,6 +60,12 @@ logger = get_logger("train.continuous")
 
 PAD_BUCKETS = (32, 64, 128, 256, 512, 1024)
 
+# Smallest chunk the budget-aligned adaptive scheduler will dispatch:
+# floors the jit-cache size (adaptive sizes are powers of two between
+# this and the engine's ``chunk``) and bounds the overshoot on a
+# sub-minimum remainder.
+_MIN_ADAPTIVE_CHUNK = 8
+
 
 def right_pad(tokens: np.ndarray, width: int,
               pad_id: int) -> np.ndarray:
@@ -95,19 +101,34 @@ class _Request:
     seed: int = 0
 
 
-@functools.partial(jax.jit, static_argnames=("model",))
 def _prefill_padded(model: CausalLM, params, padded_ids, true_len):
     """Prefill on a right-padded [1, S_bucket] prompt. Returns the full
     cache and the logits at the LAST REAL token (index true_len-1 —
     ``_prefill``'s logits[:, -1] would read a pad position). Causality
-    makes the padding invisible to every real position."""
+    makes the padding invisible to every real position. Exactly the
+    batch-1 case of ``_prefill_padded_batch`` — delegated so the two
+    cannot drift."""
+    return _prefill_padded_batch(model, params, padded_ids,
+                                 jnp.asarray(true_len)[None])
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _prefill_padded_batch(model: CausalLM, params, padded_ids, true_lens):
+    """Batched right-padded prefill: ``[k, S_bucket]`` prompts with
+    per-row true lengths, ONE weight-streaming forward. The batch-1
+    admission loop pays the full HBM weight read per request — on the
+    round-5 hardware trail that made slot refills the engine's dominant
+    overhead vs whole-batch serving (32 batch-1 prefills vs 4 batch-8
+    ones; prefill is bandwidth-bound, so batch-1 costs nearly as much
+    as batch-8). Returns the k-row cache tree and the logits at each
+    row's last real token."""
     from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
 
     logits, mutated = model.apply(
         {"params": dequantize_tree(params)}, padded_ids, prefill=True,
         mutable=["cache"])
     last = jnp.take_along_axis(
-        logits, (true_len - 1)[None, None, None], axis=1)[:, 0]
+        logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
     return mutated["cache"], last
 
 
@@ -249,6 +270,35 @@ def _zeros_state(cache1, *, num_slots: int, vocab: int) -> SlotState:
         temps=jnp.zeros((b,), jnp.float32),
         topps=jnp.ones((b,), jnp.float32),
         keys=jnp.zeros((b, 2), jnp.uint32))
+
+
+@jax.jit
+def _insert_slots_batch(state: SlotState, caches, logits, slots, fills,
+                        temps, topps, keys) -> SlotState:
+    """Batched ``_insert_slot``: scatter a batched prefill's rows into
+    the slot pool in ONE compiled program. The first cut looped batch-1
+    inserts over sliced rows — hundreds of tiny slice/insert dispatches
+    whose submission overhead over a remote tunnel UNDID the batched
+    prefill's win (round-5 trail: 1774 -> 1197 tok/s). Every operand is
+    padded to the power-of-two batch ``k_pad`` by the caller and
+    ``slots`` is a traced [k_pad] index vector whose pad entries hold
+    the OUT-OF-BOUNDS sentinel ``num_slots`` — jnp scatter drops
+    out-of-bounds updates, so pad rows never land and the program count
+    stays one per k_pad shape (a static real-k argument would have
+    compiled one program per group size 2..num_slots, paid inside the
+    first measured serving run)."""
+    cache = jax.tree.map(
+        lambda big, rows: (jnp.maximum(big, rows) if rows.ndim == 0
+                           else big.at[slots].set(rows, mode="drop")),
+        state.cache, caches)
+    return SlotState(
+        cache=cache,
+        positions=state.positions.at[slots].set(fills, mode="drop"),
+        last_logits=state.last_logits.at[slots].set(logits, mode="drop"),
+        live=state.live.at[slots].set(True, mode="drop"),
+        temps=state.temps.at[slots].set(temps, mode="drop"),
+        topps=state.topps.at[slots].set(topps, mode="drop"),
+        keys=state.keys.at[slots].set(keys, mode="drop"))
 
 
 @jax.jit
@@ -432,6 +482,41 @@ class SlotDeviceState:
         self.insert(cache1, logits1, slot, true_len,
                     temperature=temperature, top_p=top_p, seed=seed)
 
+    def admit_padded_batch(self, padded: np.ndarray, true_lens,
+                           slots, samplings) -> None:
+        """ONE batched prefill + ONE batched slot scatter admits
+        ``len(slots)`` requests; rows past ``len(slots)`` are shape
+        padding (computed, never inserted — their scatter index is the
+        out-of-bounds sentinel). Two async device ops total — no
+        readback, no RTT, no per-row dispatch chatter."""
+        k, k_pad = len(slots), padded.shape[0]
+        slot_idx = np.full((k_pad,), self.num_slots, np.int32)
+        slot_idx[:k] = slots  # pad rows -> OOB sentinel, dropped
+        temps = np.zeros((k_pad,), np.float32)
+        topps = np.ones((k_pad,), np.float32)
+        temps[:k] = [s[0] for s in samplings]
+        topps[:k] = [s[1] for s in samplings]
+        # keys stay ON DEVICE: np.asarray(key_data) would be a
+        # synchronous device->host readback per row — k+1 RTTs that the
+        # solo admit path never pays (measured: batched admission LOST
+        # its own win to them on the tunneled chip)
+        keys = jnp.stack(
+            [_seed_key_data(s[2]) for s in samplings]
+            + [jnp.zeros((2,), jnp.uint32)] * (k_pad - k))
+        with self._mesh_ctx():
+            caches, logits = _prefill_padded_batch(
+                self.model, self.params, jnp.asarray(padded),
+                jnp.asarray(true_lens, jnp.int32))
+            if self.state is None:
+                # _zeros_state only reads shape[1:] per leaf, so the
+                # k-row tree is as good a template as a batch-1 one
+                self.state = self._init_state(caches)
+            self.state = _insert_slots_batch(
+                self.state, caches, logits,
+                jnp.asarray(slot_idx),
+                jnp.asarray(true_lens, jnp.int32),
+                jnp.asarray(temps), jnp.asarray(topps), keys)
+
     def chunk_async(self, chunk: int, eos_token_id: Optional[int],
                     pad_id: int, sampling: bool = False):
         """Dispatch one decode chunk over all slots (``sampling``
@@ -494,9 +579,24 @@ class ContinuousEngine:
                  mesh=None, announce: bool = False,
                  prefix_cache_size: int = 0,
                  prefill_chunk: int = 0,
-                 pipeline_depth: int = 0):
+                 pipeline_depth: int = 0,
+                 adaptive_chunk: bool = False,
+                 batch_admit: bool = True,
+                 schedule: str = "fifo"):
         if num_slots < 1 or chunk < 1:
             raise ValueError("num_slots and chunk must be >= 1")
+        if schedule not in ("fifo", "longest"):
+            raise ValueError(
+                f"schedule must be 'fifo' or 'longest', got {schedule!r}")
+        # "longest" = LPT (longest-processing-time-first) admission: the
+        # queue stays sorted by remaining budget, so the long requests
+        # anchor the slot pool early and the short ones pack the gaps.
+        # Classic makespan result; on the round-5 trail the FIFO tail —
+        # one long request decoding alone while 7 slots idle — was the
+        # engine's largest remaining loss vs whole-batch. Throughput
+        # policy: short requests wait longer (keep "fifo" when
+        # first-come latency matters more than chip utilization).
+        self.schedule = schedule
         if pipeline_depth < 0:
             raise ValueError("pipeline_depth must be >= 0")
         # pipeline_depth=N ("decode-ahead"): keep up to N dispatched
@@ -520,9 +620,31 @@ class ContinuousEngine:
                 "pipeline_depth >= 2 is single-host only (the announce "
                 "replay's deferred-chunk window is depth-1 sized)")
         self.pipeline_depth = pipeline_depth
+        # adaptive_chunk ("budget-aligned chunking"): size each dispatch
+        # to the MINIMUM remaining token budget over the active slots
+        # (bucketed to powers of two >= _MIN_ADAPTIVE_CHUNK so the jit
+        # cache stays small), so a slot whose request ends at its budget
+        # frees at the earliest collectable boundary instead of decoding
+        # dead rows for the rest of a fixed chunk. The round-5 hardware
+        # trail motivated this: at chunk 64 x depth 2 a finished request
+        # wastes up to (depth+1) x chunk slot-steps before its
+        # replacement admits — more than the decode-ahead saves in RTT.
+        # eos-terminated requests still finish early inside a chunk
+        # (budget is an upper bound); the alignment is exact for
+        # budget-terminated ones.
+        self.adaptive_chunk = bool(adaptive_chunk)
+        # batch_admit=False disables the batched-admission fast path —
+        # the A/B lever for measuring what it buys on a given link
+        self.batch_admit = bool(batch_admit)
+        self._n_batch_admits = 0   # requests admitted via batched ops
+        self._n_solo_admits = 0    # requests admitted one at a time
+        self._n_dispatched_steps = 0  # decode steps dispatched (sum of
+        #   chunk sizes) — the exact device-work count, immune to link
+        #   noise; see bench.py cb's device_step accounting
         from collections import deque
 
-        self._inflight_q = deque()  # (kind, toks, live, slots snapshot)
+        # (kind, toks, live, slots snapshot, chunk size)
+        self._inflight_q = deque()
         if prefill_chunk and prefill_chunk < 32:
             raise ValueError(
                 f"prefill_chunk must be 0 (off) or >= 32, got "
@@ -594,7 +716,16 @@ class ContinuousEngine:
         req = _Request(next(self._rid), prompt, max_new_tokens,
                        on_tokens=on_tokens, temperature=float(temperature),
                        top_p=top_p, seed=int(seed))
-        self._queue.append(req)
+        if self.schedule == "longest":
+            # insertion point keeps the queue budget-descending; ties
+            # stay FIFO (stable insert after equal budgets)
+            i = 0
+            while (i < len(self._queue)
+                   and self._queue[i].max_new_tokens >= max_new_tokens):
+                i += 1
+            self._queue.insert(i, req)
+        else:
+            self._queue.append(req)
         return req.rid
 
     def warm_prefix(self, prefix_ids) -> int:
@@ -799,54 +930,140 @@ class ContinuousEngine:
             self._slots[a["slot"]] = req
             self._admitting = None
 
+    def _admit_batch(self, free: List[int]) -> None:
+        """Batched-admission fast path (single-host): take the FIFO
+        prefix of the queue that admits immediately (no prefix-cache
+        hit, no chunked-prefill route) into ONE shared prompt bucket
+        and prefill it all in one device op. The batch dimension is
+        padded to a power of two (shape discipline: {2,4,8,...} x
+        buckets compiled programs); pad rows replicate row 0 and are
+        never inserted. FIFO order is preserved — the batch stops at
+        the first request needing a different bucket or a special
+        admission route."""
+        group: List[_Request] = []
+        sb0 = None
+        for req in self._queue:
+            if len(group) >= len(free):
+                break
+            if (self.prefix_cache is not None
+                    and self.prefix_cache.lookup(req.prompt, peek=True)):
+                break  # the hit path is cheaper than a fresh prefill
+            if self.prefill_chunk and req.prompt.size > self.prefill_chunk:
+                break  # piecewise route
+            sb = bucket_length(req.prompt.size, self.buckets)
+            if sb0 is None:
+                sb0 = sb
+            elif sb != sb0:
+                break
+            group.append(req)
+        if len(group) < 2:
+            return
+        k = len(group)
+        k_pad = 1 << (k - 1).bit_length()
+        padded = np.full((k_pad, sb0), self.pad_id, np.int32)
+        lens = np.ones((k_pad,), np.int32)
+        for i, req in enumerate(group):
+            padded[i, :req.prompt.size] = req.prompt
+            lens[i] = req.prompt.size
+        for i in range(k, k_pad):
+            padded[i] = padded[0]
+            lens[i] = lens[0]
+        samplings = [(float(r.temperature),
+                      float(r.top_p if r.top_p is not None else 1.0),
+                      int(r.seed)) for r in group]
+        self._device.admit_padded_batch(padded, lens, free[:k], samplings)
+        for slot, req in zip(free[:k], group):
+            self._slots[slot] = req
+        del self._queue[:k]
+        self._n_batch_admits += k
+
     def _admit_waiting(self) -> None:
         reserved = (self._admitting["slot"]
                     if self._admitting is not None else None)
         free = [s for s in range(self.num_slots)
                 if s not in self._slots and s != reserved]
+        if (self.batch_admit and len(free) >= 2 and len(self._queue) >= 2
+                and not self.announce and self._admitting is None):
+            # the batched prefill is not on the OP_CB_* wire — announce
+            # mode keeps the per-request ops (same single-host gate as
+            # the prefix cache and chunked prefill)
+            self._admit_batch(free)
+            free = [s for s in range(self.num_slots)
+                    if s not in self._slots and s != reserved]
         while free and self._queue:
             if not self._try_admit(free[0], self._queue[0]):
                 break  # piecewise admission busy; FIFO holds
             free.pop(0)
             self._queue.pop(0)
+            self._n_solo_admits += 1
 
     # -- the loop --------------------------------------------------------
-    def _dispatch_chunk(self):
-        """Dispatch one decode chunk over the current slots; returns the
-        in-flight record (arrays + the slot->request snapshot the chunk
-        was computed over). Announce mode, unpipelined: dispatch AND
-        the as_host_array gathers run inside one hold of the announce
-        lock (workers replay them as one op) and the record carries
-        host arrays. Announce mode, pipelined: the chunk is announced
-        deferred=1 (dispatch only, one lock hold) and the gathers run
-        at the separately announced OP_CB_COLLECT in ``_collect`` —
-        announced ops MAY legitimately sit between a deferred dispatch
-        and its collect, on every process in the same order."""
+    def _effective_chunk(self) -> int:
+        """Chunk size for the next dispatch. Fixed mode: ``self.chunk``.
+        Adaptive mode: the largest power-of-two bucket (floored at
+        ``_MIN_ADAPTIVE_CHUNK``, capped at ``self.chunk``) that does not
+        overshoot the smallest remaining per-slot budget, counting steps
+        already dispatched but not yet collected. Returns 0 when every
+        active slot's budget is fully covered by in-flight chunks —
+        dispatching more would be pure dead-row decode."""
+        if not self.adaptive_chunk or not self._slots:
+            return self.chunk
+        pending: Dict[int, int] = {}
+        for _, _, _, snapshot, size in self._inflight_q:
+            for slot, sreq in snapshot.items():
+                if self._slots.get(slot) is sreq:  # not a freed slot's
+                    #       stale snapshot (those rows are dead anyway)
+                    pending[slot] = pending.get(slot, 0) + size
+        remaining = min(
+            req.max_new_tokens - len(req.tokens) - pending.get(slot, 0)
+            for slot, req in self._slots.items())
+        if remaining <= 0:
+            return 0
+        c = min(remaining, self.chunk)
+        b = _MIN_ADAPTIVE_CHUNK  # a sub-minimum remainder overshoots by
+        while b * 2 <= c:        # < _MIN_ADAPTIVE_CHUNK steps; the
+            b *= 2               # collect-side budget clamp discards it
+        return min(b, self.chunk)  # an engine configured below the
+        #   floor keeps its own (smaller) chunk size
+
+    def _dispatch_chunk(self, size: int):
+        """Dispatch one ``size``-step decode chunk over the current
+        slots; returns the in-flight record (arrays + the slot->request
+        snapshot the chunk was computed over). Announce mode,
+        unpipelined: dispatch AND the as_host_array gathers run inside
+        one hold of the announce lock (workers replay them as one op)
+        and the record carries host arrays. Announce mode, pipelined:
+        the chunk is announced deferred=1 (dispatch only, one lock
+        hold) and the gathers run at the separately announced
+        OP_CB_COLLECT in ``_collect`` — announced ops MAY legitimately
+        sit between a deferred dispatch and its collect, on every
+        process in the same order."""
         any_sampling = any(r.temperature > 0
                            for r in self._slots.values())
+        self._n_dispatched_steps += size
         if self.announce and not self.pipeline_depth:
             toks, live = self._announced(
                 lambda wire: wire.announce_cb_chunk(
-                    self.num_slots, self.chunk, self.eos_token_id,
+                    self.num_slots, size, self.eos_token_id,
                     self.pad_id, sampling=any_sampling),
                 lambda: self._device.chunk(
-                    self.chunk, self.eos_token_id, self.pad_id,
+                    size, self.eos_token_id, self.pad_id,
                     sampling=any_sampling))
-            return "host", toks, live, dict(self._slots)
+            return "host", toks, live, dict(self._slots), size
         toks_dev, live_dev = self._announced(
             lambda wire: wire.announce_cb_chunk(
-                self.num_slots, self.chunk, self.eos_token_id,
+                self.num_slots, size, self.eos_token_id,
                 self.pad_id, sampling=any_sampling, deferred=True),
             lambda: self._device.chunk_async(
-                self.chunk, self.eos_token_id, self.pad_id,
+                size, self.eos_token_id, self.pad_id,
                 sampling=any_sampling))
-        return "dev", toks_dev, live_dev, dict(self._slots)
+        return "dev", toks_dev, live_dev, dict(self._slots), size
 
     def _collect(self, inflight) -> List[_Request]:
         """Read back one dispatched chunk and do the host bookkeeping
         (token append, streaming callbacks, eos/budget completion,
         frees) for the slot snapshot it was computed over."""
-        kind, a, b, snapshot = inflight
+        kind, a, b, snapshot, _size = inflight
         if kind == "host":
             toks, live_host = a, b
         else:
@@ -902,17 +1119,26 @@ class ContinuousEngine:
         if not self.pipeline_depth:
             if not self._slots:
                 return []
-            return self._collect(self._dispatch_chunk())
+            return self._collect(
+                self._dispatch_chunk(self._effective_chunk()
+                                     or self.chunk))
+        dispatched = False
         if self._slots:
-            self._inflight_q.append(self._dispatch_chunk())
+            size = self._effective_chunk()
+            if size:  # 0 = every slot's budget is already in flight
+                self._inflight_q.append(self._dispatch_chunk(size))
+                dispatched = True
         finished = []
         # Drain down to the target depth. With live slots, exactly one
         # collect runs per step (the break below) — the per-step
         # announce-op cadence stays dispatch+collect. With all slots
         # idle (everything finished/cancelled), the WHOLE backlog
         # flushes in this one call, since no later step is guaranteed.
+        # A dispatch-skipped step (adaptive, budgets fully in flight)
+        # must also collect one, or run_until_drained would livelock.
         while (len(self._inflight_q) > self.pipeline_depth
-               or (self._inflight_q and not self._slots)):
+               or (self._inflight_q and not self._slots)
+               or (self._inflight_q and not dispatched)):
             finished += self._collect(self._inflight_q.popleft())
             if self._slots:  # collects freed slots mid-flush: stop at
                 break        # target depth next call, after admissions
@@ -934,6 +1160,9 @@ class ContinuousEngine:
             "finished": self._n_finished,
             "num_slots": self.num_slots,
             "chunk": self.chunk,
+            "batch_admits": self._n_batch_admits,
+            "solo_admits": self._n_solo_admits,
+            "dispatched_steps": self._n_dispatched_steps,
             "admitting": (self._admitting["req"].rid
                           if self._admitting is not None else None),
             "inflight": bool(self._inflight_q),

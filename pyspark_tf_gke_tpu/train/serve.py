@@ -79,10 +79,12 @@ class _ContinuousFront:
     def __init__(self, model, params, eos_id, num_slots: int,
                  chunk: int, mesh=None, announce: bool = False,
                  prefix_cache_size: int = 0, prefill_chunk: int = 0,
-                 pipeline_depth: int = 0):
+                 pipeline_depth: int = 0, adaptive_chunk: bool = False,
+                 schedule: str = "fifo"):
         self._engine_args = (model, params, eos_id, num_slots, chunk,
                              mesh, announce, prefix_cache_size,
-                             prefill_chunk, pipeline_depth)
+                             prefill_chunk, pipeline_depth, adaptive_chunk,
+                             schedule)
         self._announce = announce
         self.engine = self._new_engine()
         self.lock = threading.Lock()
@@ -99,14 +101,16 @@ class _ContinuousFront:
         from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
 
         (model, params, eos_id, num_slots, chunk, mesh, announce,
-         prefix_cache_size, prefill_chunk,
-         pipeline_depth) = self._engine_args
+         prefix_cache_size, prefill_chunk, pipeline_depth,
+         adaptive_chunk, schedule) = self._engine_args
         return ContinuousEngine(model, params, num_slots=num_slots,
                                 chunk=chunk, eos_token_id=eos_id,
                                 mesh=mesh, announce=announce,
                                 prefix_cache_size=prefix_cache_size,
                                 prefill_chunk=prefill_chunk,
-                                pipeline_depth=pipeline_depth)
+                                pipeline_depth=pipeline_depth,
+                                adaptive_chunk=adaptive_chunk,
+                                schedule=schedule)
 
     def submit(self, prompt_ids, max_new_tokens: int,
                temperature: float = 0.0, top_p=None,
@@ -258,7 +262,8 @@ class BundleServer:
     def __init__(self, bundle_dir: str, mesh=None, int8_kv: bool = False,
                  draft_bundle_dir: str = "", continuous_slots: int = 0,
                  continuous_chunk: int = 8, prefix_cache_size: int = 0,
-                 prefill_chunk: int = 0, continuous_pipeline: int = 0):
+                 prefill_chunk: int = 0, continuous_pipeline: int = 0,
+                 adaptive_chunk: bool = False, schedule: str = "fifo"):
         from pyspark_tf_gke_tpu.data.text import get_tokenizer
         from pyspark_tf_gke_tpu.train.export import load_serving_bundle
 
@@ -340,7 +345,9 @@ class BundleServer:
                 mesh=mesh, announce=self.multi_host,
                 prefix_cache_size=prefix_cache_size,
                 prefill_chunk=prefill_chunk,
-                pipeline_depth=continuous_pipeline)
+                pipeline_depth=continuous_pipeline,
+                adaptive_chunk=adaptive_chunk,
+                schedule=schedule)
 
     # -- health ----------------------------------------------------------
 
@@ -938,6 +945,20 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "it; multi-host: the chunk is announced "
                         "dispatch-only and the gathers replay at "
                         "OP_CB_COLLECT)")
+    p.add_argument("--schedule", choices=("fifo", "longest"),
+                   default=e("CB_SCHEDULE", "fifo"),
+                   help="slot admission policy: fifo (arrival order) or "
+                        "longest (LPT: longest remaining budget first — "
+                        "smaller makespan / higher chip utilization, at "
+                        "the cost of short-request queueing latency)")
+    p.add_argument("--adaptive-chunk", action="store_true",
+                   default=e("ADAPTIVE_CHUNK", "") not in ("", "0"),
+                   help="budget-aligned chunking: size each engine "
+                        "dispatch to the minimum remaining token budget "
+                        "over the active slots (bucketed powers of two "
+                        "down to 8), so a slot whose request ends at its "
+                        "budget frees at the earliest collect instead of "
+                        "decoding dead rows to the end of a fixed chunk")
     p.add_argument("--stdin", action="store_true",
                    help="serve stdin lines instead of HTTP: each input "
                         "line is a prompt, each output line a JSON result")
@@ -1005,7 +1026,9 @@ def main(argv=None) -> int:
         continuous_chunk=args.continuous_chunk,
         prefix_cache_size=args.prefix_cache,
         prefill_chunk=args.prefill_chunk,
-        continuous_pipeline=args.continuous_pipeline)
+        continuous_pipeline=args.continuous_pipeline,
+        adaptive_chunk=args.adaptive_chunk,
+        schedule=args.schedule)
     logger.info("bundle loaded: %s", server.health())
     if jax.process_count() > 1:
         # fail a misdeploy (draft bundle on some processes only) at

@@ -581,3 +581,173 @@ def test_decode_ahead_depth2_rejects_announce():
     model, params = _tiny_model()
     with pytest.raises(ValueError, match="single-host"):
         ContinuousEngine(model, params, pipeline_depth=2, announce=True)
+
+
+def _spy_dispatch_sizes(eng):
+    """Record every dispatched chunk size without changing behavior."""
+    sizes = []
+    orig = eng._dispatch_chunk
+
+    def spy(size):
+        sizes.append(size)
+        return orig(size)
+
+    eng._dispatch_chunk = spy
+    return sizes
+
+
+def test_adaptive_chunk_parity_and_bucketed_sizes():
+    # Budget-aligned chunking: dispatch sizes follow the minimum
+    # remaining slot budget (power-of-two buckets, floor 8) and tokens
+    # stay bit-identical to solo generate() — the scheduler only moves
+    # chunk boundaries, never content.
+    model, params = _tiny_model()
+    rng = np.random.default_rng(23)
+    specs = [(rng.integers(1, 97, int(n)), int(m))
+             for n, m in [(5, 37), (19, 9), (33, 21), (7, 12), (11, 30)]]
+    eng = ContinuousEngine(model, params, num_slots=2, chunk=32,
+                           buckets=(16, 32, 64), adaptive_chunk=True,
+                           pipeline_depth=1)
+    sizes = _spy_dispatch_sizes(eng)
+    rids = {eng.submit(p, max_new_tokens=m): (p, m) for p, m in specs}
+    results = dict(eng.run_until_drained())
+    assert set(results) == set(rids)
+    for rid, (p, m) in rids.items():
+        assert results[rid] == _reference_tokens(model, params, p, m), \
+            f"request {rid} diverged under adaptive chunking"
+    assert sizes and all(s in (8, 16, 32) for s in sizes)
+    assert min(sizes) < 32  # it really adapted below the fixed chunk
+    assert not eng._inflight_q
+
+
+def test_adaptive_chunk_skips_dead_dispatch():
+    # A slot whose whole budget is already in flight must not get more
+    # chunks dispatched (dead-row decode); the step still collects, so
+    # the drain cannot livelock. Budget 16 = one aligned dispatch.
+    model, params = _tiny_model()
+    rng = np.random.default_rng(24)
+    p = rng.integers(1, 97, 5)
+    eng = ContinuousEngine(model, params, num_slots=1, chunk=32,
+                           buckets=(16,), adaptive_chunk=True,
+                           pipeline_depth=2)
+    sizes = _spy_dispatch_sizes(eng)
+    rid = eng.submit(p, max_new_tokens=16)
+    results = dict(eng.run_until_drained())
+    assert results[rid] == _reference_tokens(model, params, p, 16)
+    assert sizes == [16], f"expected one aligned dispatch, got {sizes}"
+
+
+def _spy_batch_admits(eng):
+    """Record every batched-admission call (padded shape, slots)."""
+    calls = []
+    orig = eng._device.admit_padded_batch
+
+    def spy(padded, lens, slots, samplings):
+        calls.append((padded.shape, list(slots)))
+        return orig(padded, lens, slots, samplings)
+
+    eng._device.admit_padded_batch = spy
+    return calls
+
+
+def test_batched_admission_parity_single_bucket():
+    # A queue of same-bucket requests with several free slots must
+    # admit through ONE batched prefill (the round-5 trail's dominant
+    # engine overhead was per-request batch-1 prefills), with tokens
+    # bit-identical to solo generate().
+    model, params = _tiny_model()
+    rng = np.random.default_rng(26)
+    specs = [(rng.integers(1, 97, int(n)), int(m))
+             for n, m in [(5, 8), (9, 5), (13, 11), (7, 7), (11, 9)]]
+    eng = ContinuousEngine(model, params, num_slots=4, chunk=4,
+                           buckets=(16,))
+    calls = _spy_batch_admits(eng)
+    rids = {eng.submit(p, max_new_tokens=m): (p, m) for p, m in specs}
+    results = dict(eng.run_until_drained())
+    assert set(results) == set(rids)
+    for rid, (p, m) in rids.items():
+        assert results[rid] == _reference_tokens(model, params, p, m), \
+            f"request {rid} diverged under batched admission"
+    assert calls, "batched admission never fired"
+    assert calls[0][0] == (4, 16) and calls[0][1] == [0, 1, 2, 3]
+
+
+def test_batched_admission_stops_at_bucket_change():
+    # FIFO discipline: the batch takes only the queue prefix sharing
+    # one prompt bucket; the rest admit per-request afterwards. A
+    # 3-wide group pads its batch dimension to 4 (power-of-two shapes).
+    model, params = _tiny_model()
+    rng = np.random.default_rng(27)
+    short = [rng.integers(1, 97, int(n)) for n in (5, 9, 7)]
+    long_p = rng.integers(1, 97, 30)  # bucket 32, breaks the batch
+    eng = ContinuousEngine(model, params, num_slots=4, chunk=4,
+                           buckets=(16, 32))
+    calls = _spy_batch_admits(eng)
+    rids = {}
+    for p in short:
+        rids[eng.submit(p, max_new_tokens=6)] = p
+    rid_long = eng.submit(long_p, max_new_tokens=6)
+    results = dict(eng.run_until_drained())
+    for rid, p in rids.items():
+        assert results[rid] == _reference_tokens(model, params, p, 6)
+    assert results[rid_long] == _reference_tokens(model, params, long_p, 6)
+    assert calls[0][0] == (4, 16) and calls[0][1] == [0, 1, 2]
+
+
+def test_batched_admission_defers_to_prefix_cache():
+    # A queue head with a warm prefix must use the (cheaper) extension
+    # path, not be swept into a batched fresh prefill.
+    model, params = _tiny_model()
+    rng = np.random.default_rng(28)
+    prefix = rng.integers(1, 97, 12)
+    full = np.concatenate([prefix, rng.integers(1, 97, 3)])
+    other = rng.integers(1, 97, 8)
+    eng = ContinuousEngine(model, params, num_slots=2, chunk=4,
+                           buckets=(16, 32), prefix_cache_size=1)
+    calls = _spy_batch_admits(eng)
+    eng.warm_prefix(prefix)
+    r_full = eng.submit(full, max_new_tokens=6)
+    r_other = eng.submit(other, max_new_tokens=6)
+    results = dict(eng.run_until_drained())
+    assert results[r_full] == _reference_tokens(model, params, full, 6)
+    assert results[r_other] == _reference_tokens(model, params, other, 6)
+    assert not calls  # head hit the prefix cache -> per-request path
+    assert eng.prefix_cache.hits >= 1
+
+
+def test_lpt_schedule_orders_queue_and_keeps_parity():
+    # schedule="longest" (LPT): the queue stays budget-descending so
+    # long requests anchor slots early (makespan, not content — every
+    # request's tokens stay bit-identical to solo generate()).
+    model, params = _tiny_model()
+    rng = np.random.default_rng(29)
+    specs = [(rng.integers(1, 97, 6), m) for m in (3, 14, 6, 10, 4)]
+    eng = ContinuousEngine(model, params, num_slots=2, chunk=4,
+                           buckets=(16,), schedule="longest")
+    rids = {eng.submit(p, max_new_tokens=m): (p, m) for p, m in specs}
+    assert [r.max_new_tokens for r in eng._queue] == [14, 10, 6, 4, 3]
+    results = dict(eng.run_until_drained())
+    for rid, (p, m) in rids.items():
+        assert results[rid] == _reference_tokens(model, params, p, m), \
+            f"request {rid} diverged under LPT scheduling"
+    with pytest.raises(ValueError, match="schedule"):
+        ContinuousEngine(model, params, schedule="shortest")
+
+
+def test_adaptive_chunk_eos_unpipelined_parity():
+    # eos ends a request before its budget: adaptive sizing only uses
+    # budgets as upper bounds, so the eos path must stay identical to
+    # the fixed-chunk engine (truncate inclusively at eos).
+    model, params = _tiny_model()
+    rng = np.random.default_rng(25)
+    prompt = rng.integers(1, 97, 8)
+    solo = _reference_tokens(model, params, prompt, 12)
+    eos = solo[2]
+    eng = ContinuousEngine(model, params, num_slots=1, chunk=16,
+                           eos_token_id=eos, buckets=(16,),
+                           adaptive_chunk=True)
+    rid = eng.submit(prompt, max_new_tokens=12)
+    results = dict(eng.run_until_drained())
+    assert results[rid] == _reference_tokens(model, params, prompt, 12,
+                                             eos=eos)
+    assert results[rid][-1] == eos
