@@ -736,20 +736,25 @@ def bench_continuous(smoke: bool = False) -> dict:
     # group decodes to its LONGEST budget (idle-slot steps included in
     # its wall time), warmup group first so both sides time compiled
     # programs only.
+    def run_whole_batch(max_new: int) -> float:
+        """Timed whole-batch pass: groups of `slots` in arrival order,
+        ragged tail padded to the full slot width (ONE compiled batch
+        shape, same as a real fixed-batch server); pad rows' tokens
+        are not counted in `useful`."""
+        t0 = time.perf_counter()
+        for g0 in range(0, n_requests, slots):
+            group = prompts[g0:g0 + slots]
+            if group.shape[0] < slots:
+                pad = np.repeat(prompts[:1], slots - group.shape[0],
+                                axis=0)
+                group = np.concatenate([group, pad], axis=0)
+            np.asarray(generate(model, params, jnp.asarray(group),
+                                max_new_tokens=max_new))
+        return time.perf_counter() - t0
+
     gb = jnp.asarray(prompts[:slots])
     np.asarray(generate(model, params, gb, max_new_tokens=int(hi)))
-    t0 = time.perf_counter()
-    for g0 in range(0, n_requests, slots):
-        group = prompts[g0:g0 + slots]
-        if group.shape[0] < slots:
-            # pad the ragged tail group to the full slot width (ONE
-            # compiled batch shape, same as a real fixed-batch server);
-            # pad rows' tokens are not counted in `useful`.
-            pad = np.repeat(prompts[:1], slots - group.shape[0], axis=0)
-            group = np.concatenate([group, pad], axis=0)
-        np.asarray(generate(model, params, jnp.asarray(group),
-                            max_new_tokens=int(hi)))
-    base_dt = time.perf_counter() - t0
+    base_dt = run_whole_batch(int(hi))
     # NOTE the baseline decodes max_new=hi for every group (a server
     # must compile ONE program, so it runs the worst-case budget; the
     # per-group max would recompile per group). Useful tokens only.
@@ -765,43 +770,127 @@ def bench_continuous(smoke: bool = False) -> dict:
     # the next chunk's compute (measured 527 -> 1701 tok/s live on the
     # tunneled v5e; on a locally attached chip the engine's no-padding
     # advantage dominates instead).
-    def run_engine(chunk_n: int, pipeline: int) -> float:
+    def run_engine(chunk_n: int, pipeline: int, adaptive: bool = False,
+                   batch: bool = True, req_budgets=None,
+                   schedule: str = "fifo"):
+        req_budgets = budgets if req_budgets is None else req_budgets
         warm = ContinuousEngine(model, params, num_slots=slots,
-                                chunk=chunk_n, pipeline_depth=pipeline)
-        warm.submit(prompts[0], max_new_tokens=2)
-        list(warm.run_until_drained())
+                                chunk=chunk_n, pipeline_depth=pipeline,
+                                adaptive_chunk=adaptive, batch_admit=batch)
+        # Compile coverage BEFORE timing: every batched-admission group
+        # shape (k_pad 8/2/4 via group sizes 8, 2, 3) and — for the
+        # adaptive scheduler — every chunk bucket the measured budgets
+        # can trigger: one request whose budget is the sum of all
+        # power-of-two buckets (2*chunk - 8) walks down through each.
+        # Without this the adaptive and batch=True grid entries timed
+        # XLA compiles, not the scheduler (round-5 code review).
+        for group in (slots, 2, 3):
+            for p in prompts[:group]:
+                warm.submit(p, max_new_tokens=2)
+            list(warm.run_until_drained())
+        if adaptive:
+            warm.submit(prompts[0], max_new_tokens=2 * chunk_n - 8)
+            list(warm.run_until_drained())
         eng = ContinuousEngine(model, params, num_slots=slots,
-                               chunk=chunk_n, pipeline_depth=pipeline)
+                               chunk=chunk_n, pipeline_depth=pipeline,
+                               adaptive_chunk=adaptive, batch_admit=batch,
+                               schedule=schedule)
         t0 = time.perf_counter()
-        for p, b in zip(prompts, budgets):
+        for p, b in zip(prompts, req_budgets):
             eng.submit(p, max_new_tokens=int(b))
         done = list(eng.run_until_drained())
         eng_dt = time.perf_counter() - t0
         got = sum(len(toks) for _, toks in done)
-        if got != useful:
+        want = int(req_budgets.sum())
+        if got != want:
             raise RuntimeError(
-                f"engine returned {got} tokens, expected {useful}")
-        return got / eng_dt / n_chips
+                f"engine returned {got} tokens, expected {want}")
+        st = eng.stats
+        return got / eng_dt / n_chips, {
+            "batch_admits": st["batch_admits"],
+            "solo_admits": st["solo_admits"],
+            # exact device-work count (sum of dispatched chunk sizes):
+            # the link-noise-immune half of the engine-vs-whole-batch
+            # comparison — wall-clock on a tunneled chip swings with
+            # RTT drift, the step count does not
+            "dispatched_steps": st["dispatched_steps"]}
 
-    base_cfg_tps = run_engine(chunk, 0)
+    base_cfg_tps, _ = run_engine(chunk, 0)
     if smoke:
-        tuned_chunk, tuned_depth = chunk, 1
-        eng_tps = run_engine(tuned_chunk, tuned_depth)
+        tuned_chunk, tuned_depth, tuned_adaptive = chunk, 1, False
+        tuned_sched, tuned_batch = "fifo", True
+        eng_tps, admit_stats = run_engine(tuned_chunk, tuned_depth)
         tried = {}
     else:
         # Round-4 verdict Next #4: the 0.92x entry's named suspects are
         # per-chunk RTT not yet hidden by depth-1 decode-ahead. Sweep a
-        # small chunk x depth grid and take the best MEASURED config as
-        # the headline; every tried config is disclosed in the result
-        # (no silent cherry-pick — the grid IS the experiment).
-        tried = {}
-        best = (None, None, -1.0)
-        for chunk_n, depth in ((64, 1), (64, 2), (128, 1), (128, 2)):
-            tps = run_engine(chunk_n, depth)
-            tried[f"chunk{chunk_n}_depth{depth}"] = round(tps, 1)
-            if tps > best[2]:
-                best = (chunk_n, depth, tps)
-        tuned_chunk, tuned_depth, eng_tps = best
+        # chunk x depth x scheduler grid and take the best MEASURED
+        # config as the headline; every tried config is disclosed in
+        # the result (no silent cherry-pick — the grid IS the
+        # experiment). Round-5 lessons already in the grid: depth 2 at
+        # fixed chunk LOSES (dead finished-slot decode grows with
+        # depth x chunk); budget-aligned ADAPTIVE chunking loses over a
+        # high-RTT link (smaller chunks pay more round trips than the
+        # dead decode they save — disclosed, it wins on local links);
+        # BATCHED ADMISSION (one prefill op for a group of admissions)
+        # gets an explicit in-run A/B because cross-run tunnel-RTT
+        # drift (66 -> 76 ms within one morning) swamps cross-run
+        # comparisons of dispatch-bound configs.
+        tried, stats_by = {}, {}
+        best = (None, None, False, True, "fifo", -1.0, None)
+        for chunk_n, depth, adaptive, batch, sched in (
+                (64, 1, False, True, "fifo"),
+                (128, 1, False, True, "fifo"),
+                (128, 1, False, False, "fifo"),
+                (128, 1, False, True, "longest"),
+                (64, 2, True, True, "fifo"),
+                (128, 2, True, True, "fifo")):
+            tps, st = run_engine(chunk_n, depth, adaptive, batch,
+                                 schedule=sched)
+            key = (f"chunk{chunk_n}_depth{depth}"
+                   + ("_adaptive" if adaptive else "")
+                   + ("" if batch else "_nobatchadmit")
+                   + ("_lpt" if sched == "longest" else ""))
+            tried[key] = round(tps, 1)
+            stats_by[key] = st
+            if tps > best[5]:
+                best = (chunk_n, depth, adaptive, batch, sched, tps, key)
+        (tuned_chunk, tuned_depth, tuned_adaptive, tuned_batch,
+         tuned_sched, eng_tps, best_key) = best
+        admit_stats = stats_by[best_key]
+
+    # -- high-variance mix: the workload continuous batching exists
+    # for. Budgets span the model's whole decode headroom, so the
+    # whole-batch server idles slots up to ~hi_hv steps per group while
+    # the engine refills them. Disclosed as a SECONDARY result — the
+    # primary mix stays comparable with the round-2..5 trail entries.
+    high_variance = None
+    if not smoke:
+        hi_hv = cfg.max_seq_len - s_prompt
+        budgets_hv = rng.integers(16, hi_hv + 1, n_requests)
+        useful_hv = int(budgets_hv.sum())
+        np.asarray(generate(model, params, gb, max_new_tokens=int(hi_hv)))
+        base_hv_tps = useful_hv / run_whole_batch(int(hi_hv)) / n_chips
+        eng_hv_tps, hv_stats = run_engine(
+            tuned_chunk, tuned_depth, adaptive=tuned_adaptive,
+            batch=tuned_batch, schedule=tuned_sched,
+            req_budgets=budgets_hv)
+        wb_hv_steps = -(-n_requests // slots) * int(hi_hv)
+        high_variance = {
+            "budget_range": [16, int(hi_hv)],
+            "whole_batch_tokens_per_sec_per_chip": round(base_hv_tps, 1),
+            "engine_tokens_per_sec_per_chip": round(eng_hv_tps, 1),
+            "speedup_vs_whole_batch": round(eng_hv_tps / base_hv_tps, 3),
+            "whole_batch_decode_steps": wb_hv_steps,
+            "engine_decode_steps": hv_stats["dispatched_steps"],
+            "device_step_ratio": round(
+                wb_hv_steps / max(hv_stats["dispatched_steps"], 1), 3),
+            "engine_config": {"chunk": tuned_chunk,
+                              "pipeline_depth": tuned_depth,
+                              "schedule": tuned_sched,
+                              "adaptive_chunk": tuned_adaptive,
+                              "batch_admit": tuned_batch, **hv_stats},
+        }
 
     # Direct per-dispatch round-trip estimate: a trivial device op +
     # host readback, timed warm. This is the floor a chunk's collect
@@ -854,7 +943,27 @@ def bench_continuous(smoke: bool = False) -> dict:
             base_cfg_tps, 1),
         "unpipelined_chunk": chunk,
         "pipeline_depth": tuned_depth,
+        "adaptive_chunk": tuned_adaptive,
+        "schedule": tuned_sched,
+        "batch_admit": tuned_batch,
+        "admit_stats": admit_stats,
+        # The noise-immune half of the comparison: the engine retires
+        # the same request mix in FEWER device decode steps than the
+        # compiled-once whole-batch server (which runs every group to
+        # the worst-case budget); wall-clock on a tunneled chip is then
+        # dominated by dispatch RTT x chunk count (dispatch_rtt_ms is
+        # measured alongside), so a step_ratio > 1 with speedup < 1
+        # localizes the residue to the link, not the scheduler.
+        "device_step_accounting": {
+            "whole_batch_decode_steps": -(-n_requests // slots) * int(hi),
+            "engine_decode_steps": admit_stats["dispatched_steps"],
+            "step_ratio": round(
+                (-(-n_requests // slots) * int(hi))
+                / max(admit_stats["dispatched_steps"], 1), 3),
+        },
         "tuning_grid": tried,  # every config measured for the headline
+        **({"high_variance": high_variance}
+           if high_variance is not None else {}),
         "dispatch_rtt_ms": round(rtt_ms, 2),
         "prefix_study": {
             "prefix_len": plen, "suffix_len": slen,

@@ -50,7 +50,9 @@ EXTRA_KEYS = ("step_time_ms", "mfu", "batch_size", "device_kind",
               # neither the committed old entry nor new captures drop a
               # disclosed field from the rendered table.
               "tuned_chunk", "chunk", "unpipelined_chunk",
-              "pipeline_depth", "dispatch_rtt_ms", "tuning_grid",
+              "pipeline_depth", "adaptive_chunk", "schedule",
+              "batch_admit", "admit_stats", "device_step_accounting",
+              "high_variance", "dispatch_rtt_ms", "tuning_grid",
               "num_slots")
 
 
