@@ -58,6 +58,10 @@ import numpy as np
 HISTORY_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "tools", "bench_history.jsonl")
 
+# flagship metric name, shared by the live result and the outage error
+# JSON so BENCH_rN artifacts key identically either way
+CNN_METRIC = "cnn_b1_train_images_per_sec_per_chip"
+
 PROBE_ATTEMPTS = 4
 PROBE_TIMEOUT_S = 240
 RUN_ATTEMPTS = 2
@@ -358,7 +362,7 @@ def main(batch_size: int = 32, steps: int = 100, throughput_batch: int = 128,
             vs_baseline = images_per_sec_per_chip / base
 
     result = {
-        "metric": "cnn_b1_train_images_per_sec_per_chip",
+        "metric": CNN_METRIC,
         "value": round(images_per_sec_per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs_baseline, 3) if vs_baseline is not None else None,
@@ -1155,7 +1159,7 @@ def _error_json(argv, stage: str, detail: str,
     norm = _normalize_argv(argv)
     workload = norm[0]
     out = {
-        "metric": f"{workload}_train_images_per_sec_per_chip" if workload == "cnn"
+        "metric": CNN_METRIC if workload == "cnn"
         else f"{workload}_bench",
         "value": None,
         "unit": "images/sec/chip" if workload == "cnn" else "examples/sec/chip",
@@ -1288,7 +1292,7 @@ ALL_WORKLOADS = (
     ["resnet50", "--gn"],  # disclosed norm-semantics lever (mfu_probe)
     ["cnn", "--adafactor"],  # factored-second-moment traffic lever
     ["cb"],  # continuous batching: chunk x depth autotune vs whole-batch
-    ["spec"],  # retrained 0.6-skew fixture's first TPU acceptance
+    ["spec"],  # device-loop tok/s + the 0.75-skew fixture's acceptance
     ["generate", "--beams", "4"],  # broadcast-select reorder rebuild A/B
     # --- measured re-confirmations ---
     ["resnet50"],
