@@ -32,7 +32,7 @@ only int8, int8 KV cache, beam search); ``python bench.py spec
 [--gamma N]`` measures speculative decoding (lower + upper bounds).
 ``python bench.py cb`` compares continuous batching (slot engine,
 train/continuous.py) against whole-batch serving on one request set.
-``python bench.py all`` runs the full 20-workload matrix with ONE
+``python bench.py all`` runs the full 21-workload matrix with ONE
 backend probe, appending every success to tools/bench_history.jsonl.
 
 Resilience: the TPU backend attach through the tunnel is known-flaky
@@ -1290,6 +1290,9 @@ ALL_WORKLOADS = (
     # (norm1 never materializes; norm2 stats from the conv epilogue)
     ["resnet50", "--fused-bn3"],
     ["resnet50", "--gn"],  # disclosed norm-semantics lever (mfu_probe)
+    # normalizer-free variant: scaled WS convs, the activation-norm HBM
+    # pass deleted outright (the lever PARITY's fused negative points at)
+    ["resnet50", "--nf"],
     ["cnn", "--adafactor"],  # factored-second-moment traffic lever
     ["cb"],  # continuous batching: chunk x depth autotune vs whole-batch
     ["spec"],  # device-loop tok/s + the 0.75-skew fixture's acceptance
@@ -1514,6 +1517,11 @@ def run_bench(argv) -> dict:
         raise SystemExit("--fused-bn/--fused-bn3 and --gn are exclusive")
     if "--fused-bn" in argv and "--fused-bn3" in argv:
         raise SystemExit("--fused-bn and --fused-bn3 are exclusive variants")
+    if "--nf" in argv:
+        if workload != "resnet50":
+            raise SystemExit("--nf applies to the resnet50 workload only")
+        if any(f in argv for f in ("--gn", "--fused-bn", "--fused-bn3")):
+            raise SystemExit("--nf is exclusive with the other norm variants")
     if workload == "cnn":
         mu = None
         if "--bf16-moments" in argv:
@@ -1581,6 +1589,7 @@ def run_bench(argv) -> dict:
                           norm_variant=("gn" if "--gn" in argv
                                         else "fused3" if "--fused-bn3" in argv
                                         else "fused" if "--fused-bn" in argv
+                                        else "nf" if "--nf" in argv
                                         else "bn"))
 
 

@@ -62,6 +62,104 @@ class BottleneckBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+# Variance gain of relu on a unit gaussian: sqrt(2 / (1 - 1/pi)). Scaled
+# weight standardization + this constant keep every NF conv's output at
+# ~unit variance without reading activation statistics (Brock et al.,
+# "Characterizing signal propagation to close the performance gap in
+# unnormalized ResNets", and NFNets, arXiv:2102.06171).
+_GAMMA_RELU = 1.7139588594436646
+
+
+class WSConv(nn.Module):
+    """Scaled weight-standardized conv for the normalizer-free variant.
+
+    The kernel is standardized per OUTPUT channel over its fan-in and
+    scaled by ``1/sqrt(fan_in)`` so a unit-variance input yields a
+    unit-variance output at init, with a learnable per-channel ``gain``
+    on top. The whole standardization runs in weight space — cost is
+    per-parameter, not per-activation, which is the entire point: the
+    8.2 ms/step of activation-norm HBM traffic named by the MFU probe
+    (docs/PARITY.md) has no analog here. Convs stay XLA convs (the
+    Pallas replacements measured slower — PARITY's fused-BN negative
+    result), and XLA hoists nothing: the standardize recomputes each
+    step in f32 over ~25M weights, noise next to the conv FLOPs.
+
+    Carries a learnable per-channel bias (the ScaledStdConv recipe):
+    standardization pins every kernel to zero output-channel mean and
+    the NF path has no norm offsets, so without this bias nothing in
+    the network could shift a pre-relu activation."""
+
+    features: int
+    kernel_size: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        cin = x.shape[-1]
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (kh, kw, cin, self.features), jnp.float32)
+        gain = self.param("gain", nn.initializers.ones_init(),
+                          (self.features,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,), jnp.float32)
+        fan_in = kh * kw * cin
+        mean = w.mean(axis=(0, 1, 2), keepdims=True)
+        var = w.var(axis=(0, 1, 2), keepdims=True)
+        w = (w - mean) * jax.lax.rsqrt(var * fan_in + 1e-4)
+        w = w * gain[None, None, None, :]
+        y = jax.lax.conv_general_dilated(
+            x.astype(self.dtype), w.astype(self.dtype), self.strides,
+            self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y + bias.astype(y.dtype)[None, None, None, :]
+
+
+class NFBottleneckBlock(nn.Module):
+    """Pre-activation normalizer-free bottleneck:
+    ``h' = h + alpha * skip_gain * f(relu(h / beta) * gamma)``.
+
+    ``beta = sqrt(E[Var(h)])`` is a COMPILE-TIME constant from the
+    analytic variance recursion (var grows by ``alpha**2`` per block,
+    resets at transitions) — so the only activation-space work this
+    block adds over bare convs is the relu chain the BN model also has,
+    with two scalar multiplies XLA folds into those same elementwise
+    passes. No statistics reduction, no normalize read-modify-write.
+    ``skip_gain`` is the NFNets zero-init scalar: blocks start as
+    identity, which replaces BatchNorm's zero-init gamma on norm3 in
+    the BN twin (BottleneckBlock above)."""
+
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    alpha: float = 0.2
+    beta: float = 1.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        f = self.features
+        conv = functools.partial(WSConv, dtype=self.dtype)
+        y = (nn.relu(x.astype(jnp.float32)) *
+             (_GAMMA_RELU / self.beta)).astype(self.dtype)
+        needs_proj = self.strides != (1, 1) or x.shape[-1] != 4 * f
+        # transition blocks route the shortcut through the NORMALIZED
+        # pre-activation (variance resets to ~1 downstream)
+        shortcut = conv(4 * f, (1, 1), self.strides,
+                        name="conv_proj")(y) if needs_proj else x
+        z = conv(f, (1, 1), name="conv1")(y)
+        z = (nn.relu(z.astype(jnp.float32)) * _GAMMA_RELU).astype(self.dtype)
+        z = conv(f, (3, 3), self.strides, name="conv2")(z)
+        z = (nn.relu(z.astype(jnp.float32)) * _GAMMA_RELU).astype(self.dtype)
+        z = conv(4 * f, (1, 1), name="conv3")(z)
+        skip_gain = self.param("skip_gain", nn.initializers.zeros_init(),
+                               (), jnp.float32)
+        out = (shortcut.astype(jnp.float32) +
+               self.alpha * skip_gain * z.astype(jnp.float32))
+        return out.astype(self.dtype)
+
+
 class _Identity(nn.Module):
     """Norm stand-in for the ``norm_variant="none"`` diagnostic: accepts
     and ignores the kwargs the real norm factory receives."""
@@ -282,12 +380,18 @@ class ResNet(nn.Module):
     # plain elementwise), "none" (identity — bounds the total norm cost;
     # diagnostic only, does not train well), "fused" (BN semantics with
     # the bottleneck 1x1 convs as Pallas kernels absorbing the norm
-    # passes — see FusedBottleneckBlock). Measured by tools/mfu_probe.py
+    # passes — see FusedBottleneckBlock), "nf" (normalizer-free: scaled
+    # weight-standardized convs + analytic variance tracking, no
+    # activation norms AT ALL — the lever the fused-kernel negative
+    # result points at: don't fuse the 8.2 ms normalize pass, delete
+    # it; ``bench.py resnet50 --nf``). Measured by tools/mfu_probe.py
     # on hardware; the training default stays "bn".
     norm_variant: str = "bn"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        if self.norm_variant == "nf":
+            return self._nf_forward(x)
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
         if self.norm_variant in ("bn", "fused", "fused3"):
             # "fused" uses BatchNorm semantics; the stem norm (one small
@@ -311,8 +415,8 @@ class ResNet(nn.Module):
                 return _Identity(name=kw.get("name"))
         else:
             raise ValueError(
-                f"norm_variant must be bn|bn_f32|gn|none|fused|fused3, got "
-                f"{self.norm_variant!r}")
+                f"norm_variant must be bn|bn_f32|gn|none|fused|fused3|nf, "
+                f"got {self.norm_variant!r}")
         x = x.astype(self.dtype) if self.dtype else x
         if self.s2d_stem:
             x = space_to_depth(x, 2)
@@ -340,6 +444,39 @@ class ResNet(nn.Module):
                     )(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+    def _nf_forward(self, x):
+        """Normalizer-free path (``norm_variant="nf"``): WS-conv stem,
+        NF bottleneck stack with the analytic beta schedule, no
+        train/eval mode split (no statistics exist to toggle)."""
+        dt = self.dtype or jnp.float32
+        x = x.astype(dt)
+        if self.s2d_stem:
+            x = space_to_depth(x, 2)
+            x = WSConv(self.num_filters, (4, 4), (1, 1), "SAME", dtype=dt,
+                       name="conv_init_s2d")(x)
+        else:
+            x = WSConv(self.num_filters, (7, 7), (2, 2),
+                       [(3, 3), (3, 3)], dtype=dt, name="conv_init")(x)
+        x = (nn.relu(x.astype(jnp.float32)) * _GAMMA_RELU).astype(dt)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        alpha = 0.2
+        expected_var = 1.0
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = NFBottleneckBlock(
+                    self.num_filters * 2 ** i, strides=strides, alpha=alpha,
+                    beta=float(expected_var) ** 0.5, dtype=dt)(x)
+                if j == 0:
+                    # transition (width x4 and/or stride): the shortcut
+                    # consumed the normalized pre-activation
+                    expected_var = 1.0
+                expected_var += alpha * alpha
+        x = nn.relu(x.astype(jnp.float32)).astype(dt)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=dt)(x)
         return x.astype(jnp.float32)
 
 

@@ -14,7 +14,7 @@ hung ``jax.devices()`` attach cannot wedge the loop; see
 tools/bench_history.jsonl for why the probe is a subprocess). On the
 first successful probe it fires the full capture sequence:
 
-  1. ``python bench.py all``  — the 20-workload matrix; every success is
+  1. ``python bench.py all``  — the 21-workload matrix; every success is
      appended to the committed evidence trail ``tools/bench_history.jsonl``
      by bench.py itself.
   2. ``python tools/trail_report.py --update docs/PARITY.md`` — the

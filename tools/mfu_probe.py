@@ -43,7 +43,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-VARIANTS = ("bn", "bn_f32", "gn", "none", "fused", "fused3")
+VARIANTS = ("bn", "bn_f32", "gn", "none", "fused", "fused3", "nf")
 
 
 def main(argv=None) -> int:
