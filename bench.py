@@ -1151,6 +1151,9 @@ def _stale_matrix() -> dict:
             out[key] = {
                 "metric": r.get("metric"), "value": r.get("value"),
                 "unit": r.get("unit"), "ts": entry["ts"], "stale": True}
+            if entry.get("host_load_1m") is not None:
+                # contention disclosure rides along (see append_history)
+                out[key]["host_load_1m"] = entry["host_load_1m"]
     return out
 
 
@@ -1206,6 +1209,17 @@ def append_history(argv, result: dict) -> None:
         "argv": list(argv),
         "result": result,
     }
+    # Host-contention disclosure: dispatch-bound step times on this
+    # 1-vCPU host inflate under concurrent compilation (the 2026-08-02
+    # cnn entry measured 1,898 img/s vs ~3,470 idle because a test run
+    # shared the core). Record the 1-minute load average at append time
+    # so a polluted entry is distinguishable from a clean one IN the
+    # trail, not only in session notes. loadavg ~1 = this process alone;
+    # >~1.5 = something else was competing.
+    try:
+        entry["host_load_1m"] = round(os.getloadavg()[0], 2)
+    except OSError:  # pragma: no cover - non-POSIX
+        pass
     try:
         with open(HISTORY_PATH, "a") as fh:
             fh.write(json.dumps(entry) + "\n")

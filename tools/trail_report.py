@@ -123,6 +123,13 @@ def row(e: dict) -> str:
                 extras.append(f"{k} {body}")
             else:
                 extras.append(f"{k} {v}")
+    # entry-level host-contention disclosure (bench.py append_history):
+    # a loadavg well above ~1 on the 1-vCPU bench host means another
+    # process shared the core during the measurement — render it so a
+    # polluted entry is visibly polluted in the published table
+    load_1m = e.get("host_load_1m")
+    if isinstance(load_1m, (int, float)) and not isinstance(load_1m, bool):
+        extras.append(f"host_load {load_1m:g}")
     return (f"| `{' '.join(e.get('argv') or [])}` | {r.get('metric')} | "
             f"{value_cell} | "
             f"{'; '.join(extras)} | `{e.get('ts')}` |")
