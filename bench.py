@@ -52,6 +52,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -1157,8 +1158,31 @@ def _stale_matrix() -> dict:
     return out
 
 
+def _stale_summary() -> Optional[dict]:
+    """Compact stale-matrix summary for a stdout artifact line; the
+    FULL trail-backed map goes to stderr (and the trail keeps the
+    underlying entries). Round-5 verdict #4: five consecutive rounds
+    the driver's tail window truncated the in-line map and recorded
+    parsed=null — the one-line artifact must stay tail-sized (verify:
+    pipe stdout through ``tail -c 2000``; the last line must still
+    json-parse). Returns None when the trail is empty."""
+    stale = _stale_matrix()
+    if not stale:
+        return None
+    log("stale matrix (trail-backed, stderr only): "
+        + json.dumps(stale, sort_keys=True))
+    ts = sorted(v["ts"] for v in stale.values() if v.get("ts"))
+    return {
+        "workloads": len(stale),
+        "oldest_ts": ts[0] if ts else None,
+        "newest_ts": ts[-1] if ts else None,
+        "detail": "full per-workload map on stderr and in "
+                  "tools/bench_history.jsonl",
+    }
+
+
 def _error_json(argv, stage: str, detail: str,
-                stale_matrix: bool = False) -> dict:
+                stale_matrix: bool = False, rc: int = 1) -> dict:
     norm = _normalize_argv(argv)
     workload = norm[0]
     out = {
@@ -1170,22 +1194,29 @@ def _error_json(argv, stage: str, detail: str,
         # full normalized argv so two variants of one workload (e.g.
         # cnn vs cnn --bf16-moments) stay distinguishable in error lines
         "argv": norm,
-        "error": {"stage": stage, "detail": detail[-2000:]},
+        # the failing command's exit context, compact and first-class —
+        # NOT a raw output tail: the driver's BENCH artifact records
+        # whatever this line says, and a blob doesn't parse. detail is
+        # clamped so the WHOLE line stays inside a tail -c 2000 window
+        # even with last_recorded attached.
+        "error": {"stage": stage, "detail": detail[-600:], "rc": rc,
+                  "cmd": "python bench.py " + " ".join(norm)},
     }
     last = _latest_history(argv)
     if last is not None:
+        r = last.get("result") or {}
+        # headline fields only — a full result dict (committed entries
+        # reach ~1.6 KB) would blow the tail-window budget by itself
         out["last_recorded"] = {"ts": last["ts"], "stale": True,
-                                "result": last["result"]}
+                                "metric": r.get("metric"),
+                                "value": r.get("value"),
+                                "unit": r.get("unit")}
     if stale_matrix:
         # A dead backend blocks the whole matrix, not just this argv —
-        # ship every trail-backed measurement with the error so the
-        # driver's one-line artifact carries all 18, explicitly stale.
-        # Opt-in at the single-line driver call sites only: the gated
-        # matrix run prints one per gated device workload (all but io)
-        # and must not carry that many copies.
-        stale = _stale_matrix()
-        if stale:
-            out["stale_matrix"] = stale
+        # attach the compact summary (full map: stderr + trail).
+        summary = _stale_summary()
+        if summary:
+            out["stale_matrix_summary"] = summary
     return out
 
 
@@ -1221,8 +1252,12 @@ def append_history(argv, result: dict) -> None:
     except OSError:  # pragma: no cover - non-POSIX
         pass
     try:
-        with open(HISTORY_PATH, "a") as fh:
-            fh.write(json.dumps(entry) + "\n")
+        # The obs event-trail primitive: ONE O_APPEND write per line, so
+        # a capture racing the chip-watcher (or a second bench process)
+        # interleaves whole lines, never torn ones.
+        from pyspark_tf_gke_tpu.obs.events import append_jsonl_line
+
+        append_jsonl_line(HISTORY_PATH, entry)
         log(f"history: appended to {HISTORY_PATH}")
     except OSError as exc:  # pragma: no cover - read-only checkouts
         log(f"history append failed: {exc!r}")
@@ -1402,12 +1437,13 @@ def orchestrate_all(extra) -> int:
                "unit": "workloads_measured", "vs_baseline": None,
                "total": len(ALL_WORKLOADS), "failures": failures}
     if not backend_ok:
-        # Whole matrix gated: the summary (ONE line, not 17 copies)
-        # carries the trail-backed stale map so the artifact is still
-        # complete evidence-wise.
-        stale = _stale_matrix()
-        if stale:
-            summary["stale_matrix"] = stale
+        # Whole matrix gated: stdout stays ONE compact line; the
+        # complete trail-backed stale map goes to stderr (see
+        # _stale_summary for the tail-window rationale).
+        stale_summary = _stale_summary()
+        if stale_summary:
+            summary["stale_matrix_summary"] = stale_summary
+            summary["gate_reason"] = gate_reason[:300]
     print(json.dumps(summary))
     return 1 if failures else 0
 
@@ -1468,6 +1504,8 @@ def orchestrate(argv, skip_probe: bool = False) -> int:
 
     cmd = [sys.executable, os.path.abspath(__file__), "--run", *argv]
     last = ""
+    last_rc = 1  # what the structured exit context reports; a timeout
+    # (no child rc) keeps the generic 1
     for attempt in range(RUN_ATTEMPTS):
         try:
             proc = subprocess.run(
@@ -1502,10 +1540,11 @@ def orchestrate(argv, skip_probe: bool = False) -> int:
                 log(f"history: stdout line was not JSON, not recorded: {exc!r}")
             return 0
         last = f"rc={proc.returncode}: {proc.stderr.strip()[-800:]}"
+        last_rc = proc.returncode
         log(f"[run {attempt + 1}/{RUN_ATTEMPTS}] failed: {last}")
         if attempt < RUN_ATTEMPTS - 1:
             time.sleep(BACKOFF_S[0])
-    print(json.dumps(_error_json(list(argv), "run", last)))
+    print(json.dumps(_error_json(list(argv), "run", last, rc=last_rc)))
     return 1
 
 

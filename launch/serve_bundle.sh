@@ -41,4 +41,11 @@ Remote eval (perplexity + samples) from the bastion:
     --endpoint http://tpu-serve:8000 \
     --data-pattern 'gs://<project>-datasets/corpus/heldout/*.txt' \
     --prompt "the tpu"
+Observability (docs/OBSERVABILITY.md): Prometheus scrape at
+  http://tpu-serve:8000/metrics      (train_/serve_/runtime_ families
+                                      + legacy pyspark_tf_gke_tpu_serve_*)
+  http://tpu-serve:8000/metrics.json (JSON snapshot)
+  http://tpu-serve:8000/events       (recent event trail)
+No Service? set METRICS_TEXTFILE=/var/lib/node_exporter/textfile/serve.prom
+on the deployment and node-exporter's textfile collector picks it up.
 EON

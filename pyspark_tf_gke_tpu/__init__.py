@@ -27,6 +27,7 @@ Subpackages
 ``ops``       attention (blockwise + ring), Pallas TPU kernels
 ``data``      CSV / image / synthetic loaders, host pipeline, TFRecord bridge
 ``train``     train step, loop, metrics, checkpointing, CLI
+``obs``       unified metrics registry + event trail (docs/OBSERVABILITY.md)
 ``etl``       TPU-native KMeans + gated PySpark workloads
 ``evaluate``  saved-model visual checker
 """

@@ -39,6 +39,7 @@ from pyspark_tf_gke_tpu.ops.attention import (
 )
 from pyspark_tf_gke_tpu.models.embedding import TokenEmbed
 from pyspark_tf_gke_tpu.parallel.mesh import DATA_AXES
+from pyspark_tf_gke_tpu.parallel.compat import shard_map
 
 
 # Shared flash-vs-dense dispatch constants (ops/pallas/common.py) —
@@ -167,7 +168,7 @@ class FusedLayerNorm(nn.Module):
                     return fused_layernorm(xx, a[-2], a[-1], eps=self.epsilon,
                                            residual=rr)
 
-                y = jax.shard_map(ln_shard, mesh=self.mesh, in_specs=specs,
+                y = shard_map(ln_shard, mesh=self.mesh, in_specs=specs,
                                   out_specs=row_spec, check_vma=False)(*args)
             else:
                 y = fused_layernorm(x, scale, bias, eps=self.epsilon,
@@ -230,7 +231,7 @@ class BertSelfAttention(nn.Module):
                 # tp. Without this the partitioner replicates the opaque
                 # Pallas custom call on every chip.
                 qkv_spec = P(DATA_AXES, None, "tp", None)
-                fn = jax.shard_map(
+                fn = shard_map(
                     lambda qq, kk, vv, mm: flash_attention(qq, kk, vv, kv_mask=mm),
                     mesh=self.mesh,
                     in_specs=(qkv_spec,) * 3 + (P(DATA_AXES, None),),

@@ -38,6 +38,7 @@ from jax.sharding import Mesh
 from pyspark_tf_gke_tpu.models.bert import _data_shards, _dense
 from pyspark_tf_gke_tpu.models.embedding import TokenEmbed
 from pyspark_tf_gke_tpu.parallel.sharding import mesh_extent_for
+from pyspark_tf_gke_tpu.parallel.compat import shard_map
 from pyspark_tf_gke_tpu.ops.attention import dot_product_attention
 
 NEG_INF = -1e30
@@ -243,7 +244,7 @@ class CausalSelfAttention(nn.Module):
                 if segment_ids is not None:
                     operands += (segment_ids,)
                     in_specs += (P(DATA_AXES, None),)
-                fn = jax.shard_map(
+                fn = shard_map(
                     lambda qq, kk, vv, *rest: flash_attention(
                         qq, kk, vv, causal=True,
                         segment_ids=rest[0] if rest else None),

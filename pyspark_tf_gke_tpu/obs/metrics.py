@@ -1,0 +1,495 @@
+"""Thread-safe metrics registry: labeled Counter / Gauge / Histogram
+with Prometheus text exposition and a JSON snapshot.
+
+Design constraints, in order:
+
+* **Hot-path cheap.** ``inc``/``set``/``observe`` on an unlabeled
+  metric is one lock acquire and one float op — the trainer calls
+  ``observe`` once per optimizer step and the slot engine once per
+  decode chunk. Labeled metrics resolve their child once and cache the
+  handle (``labels()`` returns a child object callers keep).
+* **One name, one meaning.** Registering the same name twice with the
+  same type/label names returns the EXISTING metric (two BundleServers
+  in one process share counters on the shared registry); the same name
+  with a different type or label set raises :class:`MetricsError`.
+  Every registration is also recorded process-globally so
+  ``tools/smoke_check.py`` can lint for cross-registry conflicts after
+  an import sweep.
+* **Fixed log-scale latency buckets.** Histograms default to
+  power-of-2 millisecond buckets spanning 0.25 ms – 64 s: step times,
+  decode chunks, and HTTP latencies all land mid-range, and a fixed
+  scheme means two histograms are always comparable.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# 0.25ms .. 65536ms in powers of 2 (19 finite buckets + +Inf): log-scale
+# so one scheme covers a 40us dispatch and a 60s compile without
+# per-metric tuning.
+DEFAULT_LATENCY_BUCKETS_MS: Tuple[float, ...] = tuple(
+    0.25 * (2 ** i) for i in range(19)
+)
+
+_VALID_FIRST = set("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ_:")
+_VALID_REST = _VALID_FIRST | set("0123456789")
+
+
+class MetricsError(ValueError):
+    """Invalid metric name/labels or a conflicting re-registration."""
+
+
+def _check_name(name: str) -> str:
+    if not name or name[0] not in _VALID_FIRST or any(
+            c not in _VALID_REST for c in name):
+        raise MetricsError(f"invalid metric name {name!r}")
+    return name
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _format_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+# Process-global record of every registration on ANY registry, for the
+# duplicate-metric lint (same name, different shape — across registries
+# too, since each BundleServer may carry its own registry).
+_REG_LOCK = threading.Lock()
+_REGISTRATIONS: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}
+
+
+def _record_registration(name: str, kind: str,
+                         labelnames: Tuple[str, ...]) -> None:
+    with _REG_LOCK:
+        shapes = _REGISTRATIONS.setdefault(name, [])
+        if (kind, labelnames) not in shapes:
+            shapes.append((kind, labelnames))
+
+
+def duplicate_metric_conflicts() -> List[str]:
+    """Names registered (anywhere in the process) with more than one
+    (type, labelnames) shape — the lint ``tools/smoke_check.py`` fails
+    on. Empty list = clean."""
+    out = []
+    with _REG_LOCK:
+        for name, shapes in sorted(_REGISTRATIONS.items()):
+            if len(shapes) > 1:
+                out.append(
+                    f"{name}: " + " vs ".join(
+                        f"{kind}{list(labels)}" for kind, labels in shapes))
+    return out
+
+
+class _Metric:
+    """Common machinery: label-name validation + child management."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str,
+                 labelnames: Sequence[str] = ()):
+        self.name = _check_name(name)
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        for ln in self.labelnames:
+            _check_name(ln)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], "_Metric"] = {}
+
+    def labels(self, *values, **kw) -> "_Metric":
+        """Child metric for one label-value combination (handle is
+        cached — hold it in hot paths)."""
+        if kw:
+            if values:
+                raise MetricsError("pass label values positionally OR by "
+                                   "name, not both")
+            try:
+                values = tuple(str(kw[ln]) for ln in self.labelnames)
+            except KeyError as exc:
+                raise MetricsError(
+                    f"{self.name}: missing label {exc}") from None
+            if len(kw) != len(self.labelnames):
+                raise MetricsError(
+                    f"{self.name}: unexpected labels "
+                    f"{sorted(set(kw) - set(self.labelnames))}")
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise MetricsError(
+                f"{self.name}: got {len(values)} label values for "
+                f"{len(self.labelnames)} label names")
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._make_child()
+                child._labelvalues = values  # type: ignore[attr-defined]
+                self._children[values] = child
+            return child
+
+    def _make_child(self) -> "_Metric":
+        return type(self)(self.name, self.help)
+
+    # -- exposition helpers ---------------------------------------------
+
+    def _series(self) -> List[Tuple[Tuple[str, ...], "_Metric"]]:
+        """(labelvalues, leaf) pairs. An unlabeled metric is its own
+        single leaf; a labeled one exposes only its children."""
+        if not self.labelnames:
+            return [((), self)]
+        with self._lock:
+            return sorted(self._children.items())
+
+    def _label_str(self, values: Tuple[str, ...],
+                   extra: Tuple[Tuple[str, str], ...] = ()) -> str:
+        pairs = [(ln, lv) for ln, lv in zip(self.labelnames, values)]
+        pairs += list(extra)
+        if not pairs:
+            return ""
+        return ("{" + ",".join(
+            f'{ln}="{_escape_label(lv)}"' for ln, lv in pairs) + "}")
+
+
+class Counter(_Metric):
+    """Monotonically increasing count."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str,
+                 labelnames: Sequence[str] = ()):
+        super().__init__(name, help, labelnames)
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise MetricsError(f"{self.name}: counters only go up "
+                               f"(inc({amount}))")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _expose(self) -> List[str]:
+        return [f"{self.name}{self._label_str(lv)} "
+                f"{_format_value(leaf.value)}"
+                for lv, leaf in self._series()]
+
+    def _snapshot_one(self):
+        return self.value
+
+
+class Gauge(_Metric):
+    """Point-in-time value; optionally backed by a callable collector
+    (``set_function``) evaluated at exposition time."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str,
+                 labelnames: Sequence[str] = ()):
+        super().__init__(name, help, labelnames)
+        self._value = 0.0
+        self._fn = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def set_function(self, fn) -> None:
+        """Lazy gauge: ``fn()`` is called at exposition/snapshot time
+        (collector pattern — runtime RSS, live-array bytes). A failing
+        collector reads 0, never breaks exposition."""
+        with self._lock:
+            self._fn = fn
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return float(fn())
+        except Exception:  # noqa: BLE001 — collectors must never break /metrics
+            return 0.0
+
+    def _expose(self) -> List[str]:
+        return [f"{self.name}{self._label_str(lv)} "
+                f"{_format_value(leaf.value)}"
+                for lv, leaf in self._series()]
+
+    def _snapshot_one(self):
+        return self.value
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics): ``observe``
+    adds to every bucket whose upper bound is >= the value, plus
+    ``_sum`` and ``_count`` series. Default buckets are the fixed
+    log-scale millisecond ladder."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str,
+                 labelnames: Sequence[str] = (),
+                 buckets: Optional[Sequence[float]] = None):
+        super().__init__(name, help, labelnames)
+        bs = tuple(sorted(float(b) for b in (
+            buckets if buckets is not None else DEFAULT_LATENCY_BUCKETS_MS)))
+        if not bs:
+            raise MetricsError(f"{self.name}: histogram needs >= 1 bucket")
+        if bs[-1] != math.inf:
+            bs = bs + (math.inf,)
+        self.buckets = bs
+        self._counts = [0] * len(bs)
+        self._sum = 0.0
+        self._count = 0
+
+    def _make_child(self) -> "Histogram":
+        # children share the parent's bucket layout, not the defaults
+        return Histogram(self.name, self.help, buckets=self.buckets)
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            # first bucket that holds v; cumulative counts are computed
+            # at exposition so the hot path is one increment
+            lo, hi = 0, len(self.buckets) - 1
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if v <= self.buckets[mid]:
+                    hi = mid
+                else:
+                    lo = mid + 1
+            self._counts[lo] += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def _state(self):
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+    def _expose(self) -> List[str]:
+        lines: List[str] = []
+        for lv, leaf in self._series():
+            counts, total, n = leaf._state()
+            cum = 0
+            for ub, c in zip(leaf.buckets, counts):
+                cum += c
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{self._label_str(lv, (('le', _format_value(ub)),))}"
+                    f" {cum}")
+            lines.append(f"{self.name}_sum{self._label_str(lv)} "
+                         f"{_format_value(total)}")
+            lines.append(f"{self.name}_count{self._label_str(lv)} {n}")
+        return lines
+
+    def _snapshot_one(self):
+        counts, total, n = self._state()
+        return {"count": n, "sum": total,
+                "buckets": {_format_value(ub): c
+                            for ub, c in zip(self.buckets, counts)}}
+
+
+class MetricsRegistry:
+    """Holds metrics; hands out idempotent registration and the two
+    export formats (Prometheus text, JSON snapshot)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    # -- registration ----------------------------------------------------
+
+    def _register(self, cls, name: str, help: str,
+                  labelnames: Sequence[str], **kw) -> _Metric:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if (type(existing) is not cls
+                        or existing.labelnames != labelnames):
+                    raise MetricsError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}{list(existing.labelnames)}, "
+                        f"requested {cls.kind}{list(labelnames)}")
+                return existing
+            metric = cls(name, help, labelnames, **kw)
+            self._metrics[name] = metric
+        # lint bookkeeping (process-global, across registries) — child
+        # metrics are not recorded, only top-level registrations
+        _record_registration(name, metric.kind, labelnames)
+        return metric
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._register(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._register(Histogram, name, help, labelnames,
+                              buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    # -- export ----------------------------------------------------------
+
+    def exposition(self) -> str:
+        """Prometheus text format 0.0.4 — ``# HELP``/``# TYPE`` headers
+        then the series, families in name order (stable golden
+        output)."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        lines: List[str] = []
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m._expose())
+        return "\n".join(lines) + "\n" if lines else ""
+
+    def snapshot(self) -> dict:
+        """JSON-ready dict: name -> value (scalar metrics) or
+        {labels: value} / histogram state for labeled ones."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        out = {}
+        for m in metrics:
+            if not m.labelnames:
+                out[m.name] = m._snapshot_one()
+            else:
+                out[m.name] = {
+                    ",".join(f"{ln}={lv}"
+                             for ln, lv in zip(m.labelnames, values)):
+                    leaf._snapshot_one()
+                    for values, leaf in m._series()
+                }
+        return out
+
+    def snapshot_json(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+
+# -- process default registry ------------------------------------------------
+
+_default_lock = threading.Lock()
+_default_registry: Optional[MetricsRegistry] = None
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide shared registry: the trainer, the serving
+    plane, and the runtime collectors all land here by default so one
+    ``/metrics`` scrape correlates all three."""
+    global _default_registry
+    with _default_lock:
+        if _default_registry is None:
+            _default_registry = MetricsRegistry()
+        return _default_registry
+
+
+def set_registry(registry: Optional[MetricsRegistry]) -> None:
+    """Swap the process default (tests install a fresh one so
+    observation counts are exact; None resets to lazy re-create)."""
+    global _default_registry
+    with _default_lock:
+        _default_registry = registry
+
+
+def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
+    """Register (idempotently) the platform's core metric families and
+    return their handles by name.
+
+    ONE definition site for the cross-plane names: the trainer and the
+    serving front both call this, so ``/metrics`` on either plane
+    exposes the full ``train_``/``serve_``/``runtime_`` family set
+    (zero-valued until that plane observes something) and the two can
+    never drift into conflicting shapes. Runtime collectors are wired
+    separately (:func:`pyspark_tf_gke_tpu.obs.runtime
+    .install_runtime_metrics`) because they attach live callables.
+    """
+    r = registry if registry is not None else get_registry()
+    return {
+        # train plane
+        "train_step_time_ms": r.histogram(
+            "train_step_time_ms",
+            "Steady-step dispatch interval; per-epoch first steps "
+            "(compile / queue-drain syncs) are excluded"),
+        "train_examples_total": r.counter(
+            "train_examples_total",
+            "Global training rows consumed"),
+        "train_steps_total": r.counter(
+            "train_steps_total",
+            "Optimizer steps run (includes the compile step)"),
+        "train_epochs_total": r.counter(
+            "train_epochs_total", "Epochs completed"),
+        "train_last_loss": r.gauge(
+            "train_last_loss", "Mean loss of the last completed epoch"),
+        # serve plane (canonical names; BundleServer.metrics_text keeps
+        # the legacy pyspark_tf_gke_tpu_serve_* aliases)
+        "serve_requests_total": r.counter(
+            "serve_requests_total", "HTTP requests handled"),
+        "serve_requests_failed_total": r.counter(
+            "serve_requests_failed_total", "HTTP requests failed"),
+        "serve_generate_requests_total": r.counter(
+            "serve_generate_requests_total", "Generate requests"),
+        "serve_generate_tokens_total": r.counter(
+            "serve_generate_tokens_total", "New tokens returned"),
+        "serve_score_requests_total": r.counter(
+            "serve_score_requests_total", "Score requests"),
+        "serve_generate_latency_ms": r.histogram(
+            "serve_generate_latency_ms",
+            "Generate request latency (per HTTP request)"),
+        # continuous-batching slot engine
+        "serve_slots_total": r.gauge(
+            "serve_slots_total", "KV slots in the engine pool"),
+        "serve_slots_active": r.gauge(
+            "serve_slots_active", "KV slots currently decoding"),
+        "serve_useful_tokens_total": r.counter(
+            "serve_useful_tokens_total",
+            "Tokens decoded into live requests (excludes dead rows)"),
+        "serve_engine_rebuilds_total": r.counter(
+            "serve_engine_rebuilds_total",
+            "Slot-engine rebuilds after a failed device step"),
+    }

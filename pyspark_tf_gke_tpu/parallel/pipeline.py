@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from pyspark_tf_gke_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from pyspark_tf_gke_tpu.parallel.mesh import DATA_AXES

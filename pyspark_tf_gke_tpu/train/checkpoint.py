@@ -18,6 +18,7 @@ from typing import Any, Dict, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from pyspark_tf_gke_tpu.obs.events import get_event_log
 from pyspark_tf_gke_tpu.utils.fs import fs_makedirs, fs_write_text, is_remote
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
@@ -77,11 +78,15 @@ class CheckpointManager:
             )
             logger.info("Scheduled async checkpoint save of step %d to %s",
                         step, self.directory)
+            get_event_log().emit("checkpoint_scheduled", step=step,
+                                 directory=self.directory)
             return
         self._mgr.wait_until_finished()
         if history is not None:
             self._write_history(history)
         logger.info("Saved checkpoint at step %d to %s", step, self.directory)
+        get_event_log().emit("checkpoint_saved", step=step,
+                             directory=self.directory)
 
     def wait(self) -> None:
         """Block until any in-flight async save is durable (and flush the

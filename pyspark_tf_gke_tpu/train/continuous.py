@@ -54,6 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pyspark_tf_gke_tpu.models.causal_lm import CausalLM
+from pyspark_tf_gke_tpu.obs.metrics import platform_families
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
 logger = get_logger("train.continuous")
@@ -582,7 +583,7 @@ class ContinuousEngine:
                  pipeline_depth: int = 0,
                  adaptive_chunk: bool = False,
                  batch_admit: bool = True,
-                 schedule: str = "fifo"):
+                 schedule: str = "fifo", obs=None):
         if num_slots < 1 or chunk < 1:
             raise ValueError("num_slots and chunk must be >= 1")
         if schedule not in ("fifo", "longest"):
@@ -694,6 +695,13 @@ class ContinuousEngine:
         self._n_finished = 0  # counter, not a list: a
         # long-lived server must not retain every prompt it ever served
         self._device = SlotDeviceState(model, params, num_slots, mesh)
+        # shared metrics plane: slot occupancy + useful-token counters
+        # (the cb bench's useful_tokens/sec, now scrapable live). One
+        # lock op per CHUNK, not per token — hot-path safe. ``obs``
+        # threads an injected registry's handles through (BundleServer
+        # passes its own); default is the process registry.
+        self._obs = obs if obs is not None else platform_families()
+        self._obs["serve_slots_total"].set(num_slots)
 
     # -- submission ------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
@@ -1071,6 +1079,7 @@ class ContinuousEngine:
                 lambda wire: wire.announce_cb_collect(self.num_slots),
                 lambda: self._device.fetch(a, b))
         newly_done = []
+        useful_tokens = 0
         for slot, req in snapshot.items():
             if req.done:
                 # freed/cancelled while this chunk was in flight (only
@@ -1084,6 +1093,7 @@ class ContinuousEngine:
                 if hit.size:
                     take = take[:hit[0] + 1]
             new_toks = [int(t) for t in take]
+            useful_tokens += len(new_toks)
             req.tokens.extend(new_toks)
             if req.on_tokens is not None and new_toks:
                 try:
@@ -1103,6 +1113,9 @@ class ContinuousEngine:
                 # slot's live flag must drop so its rows stop advancing
                 self._free_slot(slot)
         self._n_finished += len(newly_done)
+        if useful_tokens:
+            self._obs["serve_useful_tokens_total"].inc(useful_tokens)
+        self._obs["serve_slots_active"].set(len(self._slots))
         return newly_done
 
     def step(self) -> List[_Request]:

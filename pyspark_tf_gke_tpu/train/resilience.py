@@ -30,6 +30,7 @@ from typing import Callable, Iterable, Optional, Sequence, TypeVar
 
 import jax
 
+from pyspark_tf_gke_tpu.obs.events import get_event_log
 from pyspark_tf_gke_tpu.utils.fs import is_remote
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
@@ -169,6 +170,9 @@ class FaultInjector:
     def maybe_fail(self, step: int) -> None:
         if int(step) in self.pending:
             self.pending.discard(int(step))
+            # preemption-simulation evidence rides the shared trail: a
+            # chaos run's injected faults and its retries correlate by seq
+            get_event_log().emit("fault_injected", step=int(step))
             raise InjectedFault(f"injected fault at step {step}")
 
 
@@ -223,15 +227,25 @@ def run_with_recovery(
     attempt = 0
     while True:
         try:
-            return train_once(attempt)
+            result = train_once(attempt)
+            if attempt:
+                get_event_log().emit("recovery_succeeded", attempt=attempt)
+            return result
         except BaseException as e:  # noqa: BLE001 — resilience boundary
             if isinstance(e, tuple(fatal)) or attempt >= max_restarts:
+                get_event_log().emit(
+                    "recovery_exhausted", attempt=attempt,
+                    error=f"{type(e).__name__}: {e}"[:500],
+                    fatal=isinstance(e, tuple(fatal)))
                 raise
             attempt += 1
             logger.warning(
                 "Training attempt %d failed (%s: %s); restarting with resume "
                 "(%d/%d)", attempt, type(e).__name__, e, attempt, max_restarts,
             )
+            get_event_log().emit(
+                "retry", attempt=attempt, max_restarts=max_restarts,
+                error=f"{type(e).__name__}: {e}"[:500])
             if retry_delay_s:
                 time.sleep(retry_delay_s)
 

@@ -51,6 +51,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pyspark_tf_gke_tpu.obs.events import get_event_log
+from pyspark_tf_gke_tpu.obs.export import handle_obs_request
+from pyspark_tf_gke_tpu.obs.metrics import get_registry, platform_families
+from pyspark_tf_gke_tpu.obs.runtime import install_runtime_metrics
 from pyspark_tf_gke_tpu.parallel.distributed import as_host_array
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
@@ -80,12 +84,15 @@ class _ContinuousFront:
                  chunk: int, mesh=None, announce: bool = False,
                  prefix_cache_size: int = 0, prefill_chunk: int = 0,
                  pipeline_depth: int = 0, adaptive_chunk: bool = False,
-                 schedule: str = "fifo"):
+                 schedule: str = "fifo", obs=None, event_log=None):
         self._engine_args = (model, params, eos_id, num_slots, chunk,
                              mesh, announce, prefix_cache_size,
                              prefill_chunk, pipeline_depth, adaptive_chunk,
                              schedule)
         self._announce = announce
+        self._obs = obs if obs is not None else platform_families()
+        self._event_log = (event_log if event_log is not None
+                           else get_event_log())
         self.engine = self._new_engine()
         self.lock = threading.Lock()
         self.new_work = threading.Event()
@@ -110,7 +117,7 @@ class _ContinuousFront:
                                 prefill_chunk=prefill_chunk,
                                 pipeline_depth=pipeline_depth,
                                 adaptive_chunk=adaptive_chunk,
-                                schedule=schedule)
+                                schedule=schedule, obs=self._obs)
 
     def submit(self, prompt_ids, max_new_tokens: int,
                temperature: float = 0.0, top_p=None,
@@ -217,6 +224,10 @@ class _ContinuousFront:
                         "continuous engine step failed; failing %d "
                         "in-flight request(s) and rebuilding the engine",
                         len(self._results))
+                    self._obs["serve_engine_rebuilds_total"].inc()
+                    self._event_log.emit(
+                        "engine_rebuilt", inflight=len(self._results),
+                        error=f"{type(exc).__name__}: {exc}"[:500])
                     for slot in self._results.values():
                         if slot[1] is None:
                             slot[1] = exc
@@ -263,7 +274,8 @@ class BundleServer:
                  draft_bundle_dir: str = "", continuous_slots: int = 0,
                  continuous_chunk: int = 8, prefix_cache_size: int = 0,
                  prefill_chunk: int = 0, continuous_pipeline: int = 0,
-                 adaptive_chunk: bool = False, schedule: str = "fifo"):
+                 adaptive_chunk: bool = False, schedule: str = "fifo",
+                 registry=None, event_log=None):
         from pyspark_tf_gke_tpu.data.text import get_tokenizer
         from pyspark_tf_gke_tpu.train.export import load_serving_bundle
 
@@ -315,20 +327,19 @@ class BundleServer:
             raise ValueError("multi-host serving needs a mesh spanning "
                              "all processes (set --tp / SERVE_TP)")
         self._lock = threading.Lock()  # one model, one device queue
-        # operational counters for /metrics (Prometheus text format —
+        # Operational metrics live on the SHARED obs registry (obs/):
+        # one /metrics scrape correlates serve counters with the train
+        # plane (same-process trainers) and the runtime collectors —
         # what the reference world's kubectl-top/metrics-server loop
-        # becomes when the server itself is first-party,
-        # /root/reference/infra/local/external_workloads/README.md
-        # kubectl-top pattern)
-        self._metrics_lock = threading.Lock()
-        self._metrics = {
-            "requests_total": 0,       # by endpoint outcome below
-            "requests_failed_total": 0,
-            "generate_tokens_total": 0,
-            "generate_latency_ms_sum": 0.0,
-            "generate_requests_total": 0,
-            "score_requests_total": 0,
-        }
+        # becomes when the server itself is first-party. The legacy
+        # pyspark_tf_gke_tpu_serve_* exposition names stay as aliases
+        # (metrics_text) so serve_bundle.sh-era scrape configs keep
+        # working.
+        self.registry = registry if registry is not None else get_registry()
+        self._obs = platform_families(self.registry)
+        install_runtime_metrics(self.registry)
+        self.event_log = (event_log if event_log is not None
+                          else get_event_log())
         self._front = None
         if prefill_chunk and not continuous_slots:
             raise ValueError(
@@ -347,7 +358,8 @@ class BundleServer:
                 prefill_chunk=prefill_chunk,
                 pipeline_depth=continuous_pipeline,
                 adaptive_chunk=adaptive_chunk,
-                schedule=schedule)
+                schedule=schedule, obs=self._obs,
+                event_log=self.event_log)
 
     # -- health ----------------------------------------------------------
 
@@ -609,32 +621,46 @@ class BundleServer:
 
     def record_metrics(self, *, generate_entries=None, score: bool = False,
                        failed: bool = False) -> None:
-        """Fold one request into the counters (handler-thread safe)."""
-        with self._metrics_lock:
-            self._metrics["requests_total"] += 1
-            if failed:
-                self._metrics["requests_failed_total"] += 1
-            if score:
-                self._metrics["score_requests_total"] += 1
-            if generate_entries:
-                self._metrics["generate_requests_total"] += 1
-                self._metrics["generate_tokens_total"] += sum(
-                    e.get("new_tokens", 0) for e in generate_entries)
-                self._metrics["generate_latency_ms_sum"] += max(
-                    (e.get("latency_ms", 0.0) for e in generate_entries),
-                    default=0.0)
+        """Fold one request into the shared registry (handler-thread
+        safe — every metric holds its own lock)."""
+        m = self._obs
+        m["serve_requests_total"].inc()
+        if failed:
+            m["serve_requests_failed_total"].inc()
+        if score:
+            m["serve_score_requests_total"].inc()
+        if generate_entries:
+            m["serve_generate_requests_total"].inc()
+            m["serve_generate_tokens_total"].inc(sum(
+                e.get("new_tokens", 0) for e in generate_entries))
+            m["serve_generate_latency_ms"].observe(max(
+                (e.get("latency_ms", 0.0) for e in generate_entries),
+                default=0.0))
 
-    def metrics_text(self) -> str:
-        """Prometheus exposition text: counters + live engine gauges."""
-        with self._metrics_lock:
-            snap = dict(self._metrics)
+    def _legacy_metrics_text(self) -> str:
+        """The pre-obs exposition names, aliased onto registry values —
+        a strict superset guarantee for existing scrape configs. New
+        dashboards should use the canonical ``serve_*`` families."""
+        m = self._obs
+        alias = [
+            ("requests_total", "counter", m["serve_requests_total"].value),
+            ("requests_failed_total", "counter",
+             m["serve_requests_failed_total"].value),
+            ("generate_tokens_total", "counter",
+             m["serve_generate_tokens_total"].value),
+            ("generate_latency_ms_sum", "counter",
+             m["serve_generate_latency_ms"].sum),
+            ("generate_requests_total", "counter",
+             m["serve_generate_requests_total"].value),
+            ("score_requests_total", "counter",
+             m["serve_score_requests_total"].value),
+        ]
         lines = []
-        for key, val in snap.items():
+        for key, kind, val in alias:
             name = f"pyspark_tf_gke_tpu_serve_{key}"
-            kind = "counter" if key.endswith("_total") or \
-                key.endswith("_sum") else "gauge"
             lines.append(f"# TYPE {name} {kind}")
-            lines.append(f"{name} {val}")
+            lines.append(f"{name} "
+                         f"{int(val) if float(val).is_integer() else val}")
         if self._front is not None:
             stats = self._front.engine.stats
             for key in ("queued", "active", "finished", "num_slots"):
@@ -650,6 +676,20 @@ class BundleServer:
                 lines.append(f"# TYPE {name} {kind}")
                 lines.append(f"{name} {val}")
         return "\n".join(lines) + "\n"
+
+    def _refresh_engine_gauges(self) -> None:
+        """Pull-model scrape prep: the engine only updates its gauges
+        at collect boundaries, so re-read them at exposition time."""
+        if self._front is not None:
+            stats = self._front.engine.stats
+            self._obs["serve_slots_total"].set(stats["num_slots"])
+            self._obs["serve_slots_active"].set(stats["active"])
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition text: the full shared registry
+        (train_/serve_/runtime_ families) plus the legacy alias block."""
+        self._refresh_engine_gauges()
+        return self.registry.exposition() + self._legacy_metrics_text()
 
     def _entry(self, prompt, new_tokens, dt_ms, eos_id, **extra) -> dict:
         """Shared response assembly: eos truncation + decode back to
@@ -785,18 +825,29 @@ def _make_handler(server: BundleServer):
                     pass
 
         def do_GET(self):
-            if self.path in ("/healthz", "/health", "/"):
-                self._reply(200, server.health())
-            elif self.path == "/metrics":
-                body = server.metrics_text().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-            else:
-                self._reply(404, {"error": f"unknown path {self.path}"})
+            route = self.path.partition("?")[0]  # scrape configs may
+            # append query params; routing must ignore them
+            if route in ("/healthz", "/health", "/"):
+                return self._reply(200, server.health())
+            # /metrics, /metrics.json, /events — the obs package owns
+            # the response assembly; this server contributes the live
+            # engine-gauge refresh and its legacy alias block
+            extra = ""
+            if route == "/metrics":
+                server._refresh_engine_gauges()
+                extra = server._legacy_metrics_text()
+            out = handle_obs_request(self.path, server.registry,
+                                     server.event_log,
+                                     extra_exposition=extra)
+            if out is None:
+                return self._reply(404,
+                                   {"error": f"unknown path {self.path}"})
+            code, ctype, body = out
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def do_POST(self):
             try:
@@ -959,6 +1010,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "down to 8), so a slot whose request ends at its "
                         "budget frees at the earliest collect instead of "
                         "decoding dead rows to the end of a fixed chunk")
+    p.add_argument("--metrics-textfile", default=e("METRICS_TEXTFILE", ""),
+                   help="also export the metrics registry to this .prom "
+                        "file every --metrics-interval seconds (atomic "
+                        "rename; point node-exporter's textfile collector "
+                        "at the directory — scraping without a Service)")
+    p.add_argument("--metrics-interval", type=float,
+                   default=float(e("METRICS_INTERVAL", "15")))
     p.add_argument("--stdin", action="store_true",
                    help="serve stdin lines instead of HTTP: each input "
                         "line is a prompt, each output line a JSON result")
@@ -1030,6 +1088,12 @@ def main(argv=None) -> int:
         adaptive_chunk=args.adaptive_chunk,
         schedule=args.schedule)
     logger.info("bundle loaded: %s", server.health())
+    exporter = None
+    if args.metrics_textfile:
+        from pyspark_tf_gke_tpu.obs.export import TextfileExporter
+
+        exporter = TextfileExporter(server.registry, args.metrics_textfile,
+                                    args.metrics_interval).start()
     if jax.process_count() > 1:
         # fail a misdeploy (draft bundle on some processes only) at
         # startup, not mid-collective on the first speculative request
@@ -1081,6 +1145,8 @@ def main(argv=None) -> int:
             httpd.shutdown()
         return 0
     finally:
+        if exporter is not None:
+            exporter.stop()  # final write captures the shutdown state
         if server._front is not None:
             server._front.shutdown()
         if jax.process_count() > 1:

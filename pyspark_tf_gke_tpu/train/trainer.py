@@ -30,6 +30,8 @@ import optax
 from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pyspark_tf_gke_tpu.obs.events import get_event_log
+from pyspark_tf_gke_tpu.obs.metrics import get_registry, platform_families
 from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding
 from pyspark_tf_gke_tpu.parallel.sharding import (
     DEFAULT_MIN_SIZE,
@@ -287,6 +289,8 @@ class Trainer:
         # — the flagship (43M params, batch 32) is bound on exactly that
         # stream (tools/roofline.py analytic model). Default f32 keeps
         # reference-parity optimizer numerics; ignored when tx is given.
+        metrics_registry=None,  # obs.MetricsRegistry (default: shared)
+        event_log=None,  # obs.EventLog (default: shared trail)
     ):
         self.model = model
         self.task = task
@@ -305,6 +309,12 @@ class Trainer:
         self._apply_step = None
         self._scan_steps: Dict[int, Any] = {}
         self.state_shardings = None
+        # observability plane (obs/): history stays the artifact format;
+        # these are the live/scrapable view of the same loop
+        self.metrics_registry = (metrics_registry if metrics_registry
+                                 is not None else get_registry())
+        self._obs = platform_families(self.metrics_registry)
+        self._event_log = event_log if event_log is not None else get_event_log()
 
     # ---- state construction -------------------------------------------------
 
@@ -606,6 +616,10 @@ class Trainer:
     ):
         from pyspark_tf_gke_tpu.data.pipeline import put_global_batch
 
+        self._event_log.emit(
+            "train_fit_start", task=self.task.name, epochs=epochs,
+            steps_per_epoch=steps_per_epoch, start_step=global_step,
+            grad_accum=grad_accum)
         for epoch in range(epochs):
             # Metrics accumulate as device scalars — no host sync inside the
             # step loop, so dispatch overlaps with next-batch preparation.
@@ -625,8 +639,25 @@ class Trainer:
                     jax.block_until_ready(metrics)
                     t_first_step = time.perf_counter() - t0
                 # global rows consumed this optimizer step
-                examples += device_batches.rows - rows_before
+                step_rows = device_batches.rows - rows_before
+                examples += step_rows
                 global_step += 1
+                # obs plane: counters record everything; the histogram
+                # records steady steps only — each epoch's step 0 is
+                # excluded (epoch 0's includes compile; later epochs'
+                # absorb the drained dispatch queue at the
+                # block_until_ready above), mirroring the history's
+                # steady_steps accounting. Steady observations are the
+                # host dispatch interval: with the step loop kept
+                # async by design, this equals device step time once
+                # the in-flight queue saturates, and under-reads it
+                # before then — the history's synced epoch-level
+                # step_time_ms stays the calibration reference.
+                self._obs["train_steps_total"].inc()
+                self._obs["train_examples_total"].inc(step_rows)
+                if step_i != 0:
+                    self._obs["train_step_time_ms"].observe(
+                        (time.perf_counter() - t0) * 1000.0)
                 if heartbeat is not None:
                     heartbeat.beat(global_step)
                 if fault_injector is not None:
@@ -656,6 +687,13 @@ class Trainer:
                 f"{k}: {history[k][-1]:.4f}" for k in sums
             )
             logger.info("Epoch %d/%d - %s - %.1f ms/step", epoch + 1, epochs, msg, step_ms)
+            self._obs["train_epochs_total"].inc()
+            if "loss" in history:
+                self._obs["train_last_loss"].set(history["loss"][-1])
+            self._event_log.emit(
+                "train_epoch_end", epoch=epoch + 1, global_step=global_step,
+                step_time_ms=round(step_ms, 3),
+                loss=history.get("loss", [None])[-1])
 
             if val_batches is not None:
                 val_sharding = batch_sharding(self.mesh)

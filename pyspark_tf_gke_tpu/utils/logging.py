@@ -9,14 +9,51 @@ and banner-line delimiters around major phases
 from __future__ import annotations
 
 import logging
+import os
 import sys
+from typing import Optional, Union
 
 _FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
 
+# Loggers whose level was pinned by an explicit ``level=`` argument —
+# a later default-level call must not silently reset them.
+_explicit_levels: set = set()
 
-def get_logger(name: str, level: int = logging.INFO) -> logging.Logger:
+
+def _env_level() -> Optional[int]:
+    """``PYSPARK_TF_GKE_TPU_LOG_LEVEL`` as a logging level: a name
+    ("DEBUG", "warning") or a numeric string. Invalid values are
+    ignored (a typo'd env var must not crash every import)."""
+    raw = os.environ.get("PYSPARK_TF_GKE_TPU_LOG_LEVEL", "").strip()
+    if not raw:
+        return None
+    if raw.isdigit():
+        return int(raw)
+    level = logging.getLevelName(raw.upper())
+    return level if isinstance(level, int) else None
+
+
+def get_logger(name: str,
+               level: Optional[Union[int, str]] = None) -> logging.Logger:
+    """Per-component logger with a single stdout handler.
+
+    Level resolution: an explicit ``level=`` always wins and UPDATES an
+    existing logger (a second call is a deliberate change, not a no-op);
+    otherwise the ``PYSPARK_TF_GKE_TPU_LOG_LEVEL`` env override applies;
+    otherwise INFO on first creation — and a later default-level call
+    leaves an explicitly-set level alone.
+    """
     logger = logging.getLogger(name)
-    logger.setLevel(level)
+    if level is not None:
+        if isinstance(level, str):
+            resolved = logging.getLevelName(level.upper())
+            if not isinstance(resolved, int):
+                raise ValueError(f"unknown log level {level!r}")
+            level = resolved
+        logger.setLevel(level)
+        _explicit_levels.add(name)
+    elif name not in _explicit_levels:
+        logger.setLevel(_env_level() or logging.INFO)
     # Guard against duplicated handlers when called twice for the same name.
     if not logger.handlers:
         handler = logging.StreamHandler(sys.stdout)

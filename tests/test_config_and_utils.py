@@ -30,3 +30,41 @@ def test_np_rng_deterministic():
     a = np_rng(1337).permutation(100)
     b = np_rng(1337).permutation(100)
     assert (a == b).all()
+
+
+def test_get_logger_explicit_level_updates_on_second_call():
+    import logging
+
+    from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+    name = "test.level.update"
+    first = get_logger(name)
+    assert first.level == logging.INFO
+    # an explicit level on a SECOND call is a deliberate change and
+    # must take effect (previously it was silently ignored territory)
+    second = get_logger(name, level=logging.DEBUG)
+    assert second is first and first.level == logging.DEBUG
+    # a later default-level call leaves the explicit choice alone
+    get_logger(name)
+    assert first.level == logging.DEBUG
+    # string levels resolve too
+    get_logger(name, level="warning")
+    assert first.level == logging.WARNING
+    # one handler no matter how many calls
+    assert len(first.handlers) == 1
+
+
+def test_get_logger_env_override(monkeypatch):
+    import logging
+
+    from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+    monkeypatch.setenv("PYSPARK_TF_GKE_TPU_LOG_LEVEL", "DEBUG")
+    lg = get_logger("test.level.env")
+    assert lg.level == logging.DEBUG
+    # explicit argument still beats the env
+    lg2 = get_logger("test.level.env2", level=logging.ERROR)
+    assert lg2.level == logging.ERROR
+    # junk env values are ignored, not fatal
+    monkeypatch.setenv("PYSPARK_TF_GKE_TPU_LOG_LEVEL", "NOTALEVEL")
+    assert get_logger("test.level.env3").level == logging.INFO

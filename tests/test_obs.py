@@ -1,0 +1,360 @@
+"""obs/ subsystem: registry semantics, exposition format, event trail,
+and the trainer/serve wiring contracts (ISSUE 1 acceptance criteria).
+All CPU-only, tier-1 safe.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from pyspark_tf_gke_tpu.obs.events import (
+    EventLog,
+    append_jsonl_line,
+    read_events,
+)
+from pyspark_tf_gke_tpu.obs.export import (
+    TextfileExporter,
+    atomic_write_text,
+    handle_obs_request,
+)
+from pyspark_tf_gke_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    MetricsError,
+    MetricsRegistry,
+)
+from pyspark_tf_gke_tpu.obs.runtime import install_runtime_metrics
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_counter_concurrency():
+    # N threads hammering ONE counter: the registry's per-metric lock
+    # must make the total exact, not approximate.
+    r = MetricsRegistry()
+    c = r.counter("t_concurrency_total")
+    n_threads, n_incs = 8, 5000
+
+    def worker():
+        for _ in range(n_incs):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * n_incs
+
+
+def test_counter_rejects_negative():
+    r = MetricsRegistry()
+    with pytest.raises(MetricsError):
+        r.counter("t_neg_total").inc(-1)
+
+
+def test_duplicate_registration_same_shape_returns_existing():
+    r = MetricsRegistry()
+    a = r.counter("t_dup_total", "first")
+    b = r.counter("t_dup_total", "second")
+    assert a is b
+
+
+def test_duplicate_registration_different_shape_raises():
+    r = MetricsRegistry()
+    r.counter("t_shape_total")
+    with pytest.raises(MetricsError):
+        r.gauge("t_shape_total")
+    with pytest.raises(MetricsError):
+        r.counter("t_shape_total", labelnames=("endpoint",))
+
+
+def test_labeled_children_are_cached_and_independent():
+    r = MetricsRegistry()
+    c = r.counter("t_labeled_total", labelnames=("endpoint",))
+    gen = c.labels(endpoint="generate")
+    assert c.labels("generate") is gen
+    gen.inc(3)
+    c.labels(endpoint="score").inc()
+    text = r.exposition()
+    assert 't_labeled_total{endpoint="generate"} 3' in text
+    assert 't_labeled_total{endpoint="score"} 1' in text
+
+
+def test_histogram_bucket_boundaries():
+    # Prometheus semantics: le is INCLUSIVE, buckets are cumulative,
+    # the top bucket is +Inf and equals _count.
+    r = MetricsRegistry()
+    h = r.histogram("t_hist_ms", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 1.0, 1.0001, 10.0, 99.9, 100.0, 5000.0):
+        h.observe(v)
+    text = r.exposition()
+    assert 't_hist_ms_bucket{le="1"} 2' in text       # 0.5, 1.0
+    assert 't_hist_ms_bucket{le="10"} 4' in text      # + 1.0001, 10.0
+    assert 't_hist_ms_bucket{le="100"} 6' in text     # + 99.9, 100.0
+    assert 't_hist_ms_bucket{le="+Inf"} 7' in text    # + 5000.0
+    assert "t_hist_ms_count 7" in text
+    assert h.count == 7
+    assert h.sum == pytest.approx(sum((0.5, 1.0, 1.0001, 10.0, 99.9,
+                                       100.0, 5000.0)))
+
+
+def test_default_latency_buckets_are_log_scale():
+    bs = DEFAULT_LATENCY_BUCKETS_MS
+    assert bs[0] == 0.25
+    ratios = {bs[i + 1] / bs[i] for i in range(len(bs) - 1)}
+    assert ratios == {2.0}
+    assert bs[-1] >= 60_000  # covers a full XLA compile
+
+
+def test_prometheus_text_golden():
+    # Exact exposition: families in name order, HELP/TYPE headers,
+    # histogram bucket/sum/count series. A format drift here breaks
+    # real scrapers, so the assertion is the whole document.
+    r = MetricsRegistry()
+    g = r.gauge("aa_gauge", "a gauge")
+    g.set(2.5)
+    c = r.counter("bb_total", "a counter")
+    c.inc(3)
+    h = r.histogram("cc_ms", "a histogram", buckets=(1.0, 2.0))
+    h.observe(1.5)
+    assert r.exposition() == (
+        "# HELP aa_gauge a gauge\n"
+        "# TYPE aa_gauge gauge\n"
+        "aa_gauge 2.5\n"
+        "# HELP bb_total a counter\n"
+        "# TYPE bb_total counter\n"
+        "bb_total 3\n"
+        "# HELP cc_ms a histogram\n"
+        "# TYPE cc_ms histogram\n"
+        'cc_ms_bucket{le="1"} 0\n'
+        'cc_ms_bucket{le="2"} 1\n'
+        'cc_ms_bucket{le="+Inf"} 1\n'
+        "cc_ms_sum 1.5\n"
+        "cc_ms_count 1\n"
+    )
+
+
+def test_snapshot_json_roundtrips():
+    r = MetricsRegistry()
+    r.counter("t_snap_total").inc(2)
+    r.histogram("t_snap_ms", buckets=(1.0,)).observe(0.5)
+    snap = json.loads(r.snapshot_json())
+    assert snap["t_snap_total"] == 2
+    assert snap["t_snap_ms"]["count"] == 1
+
+
+def test_gauge_collector_function_and_failure():
+    r = MetricsRegistry()
+    g = r.gauge("t_lazy")
+    g.set_function(lambda: 42)
+    assert "t_lazy 42" in r.exposition()
+    g.set_function(lambda: 1 / 0)  # a broken collector reads 0,
+    assert "t_lazy 0" in r.exposition()  # never breaks the scrape
+
+
+def test_runtime_collectors_cpu_only():
+    r = MetricsRegistry()
+    handles = install_runtime_metrics(r)
+    assert handles["runtime_process_rss_bytes"].value > 0
+    assert handles["runtime_jax_device_count"].value >= 1
+    text = r.exposition()
+    assert "runtime_process_rss_bytes" in text
+    assert "runtime_uptime_seconds" in text
+
+
+# -- events -----------------------------------------------------------------
+
+
+def test_event_log_sequence_and_fields(tmp_path):
+    log = EventLog(str(tmp_path / "ev.jsonl"))
+    log.emit("checkpoint_saved", step=10)
+    log.emit("retry", attempt=1)
+    events = list(read_events(log.path))
+    assert [e["seq"] for e in events] == [0, 1]
+    assert events[0]["kind"] == "checkpoint_saved"
+    assert events[0]["step"] == 10
+    assert all("ts" in e and "v" in e for e in events)
+
+
+def test_event_log_bounded_rotation(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    log = EventLog(path, max_bytes=400)
+    for i in range(100):
+        log.emit("tick", i=i)
+    assert os.path.getsize(path) < 1600  # bounded, not unbounded growth
+    assert os.path.exists(path + ".1")   # one rotated generation
+    # seq numbers stay monotonic across rotation
+    current = list(read_events(path))
+    assert current[-1]["seq"] == 99
+    assert [e["seq"] for e in current] == sorted(e["seq"] for e in current)
+
+
+def test_event_log_seq_resumes_across_restart(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    EventLog(path).emit("first")
+    log2 = EventLog(path)  # a restarted process re-opens the trail
+    rec = log2.emit("second")
+    assert rec["seq"] == 1
+
+
+def test_append_jsonl_line_is_line_atomic(tmp_path):
+    # concurrent appenders interleave whole lines, never torn ones
+    path = str(tmp_path / "trail.jsonl")
+
+    def worker(tag):
+        for i in range(200):
+            append_jsonl_line(path, {"tag": tag, "i": i})
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = open(path).read().splitlines()
+    assert len(lines) == 800
+    parsed = [json.loads(ln) for ln in lines]  # every line parses
+    for tag in range(4):
+        assert [p["i"] for p in parsed if p["tag"] == tag] == list(range(200))
+
+
+def test_event_log_tolerates_foreign_lines(tmp_path):
+    # a non-dict JSON line (another tool sharing the file) must not
+    # crash resume — skipped like a torn line
+    path = str(tmp_path / "ev.jsonl")
+    log = EventLog(path)
+    log.emit("ok")
+    with open(path, "a") as fh:
+        fh.write("[1, 2]\nnull\n")
+    rec = EventLog(path).emit("next")
+    assert rec["seq"] == 1
+    assert rec["pid"] == os.getpid()  # (pid, seq) is the cross-writer key
+
+
+def test_event_log_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    log = EventLog(path)
+    log.emit("ok")
+    with open(path, "a") as fh:
+        fh.write('{"seq": 1, "kind": "torn...')  # crash mid-append
+    log2 = EventLog(path)
+    rec = log2.emit("next")
+    assert rec["seq"] == 1  # torn line skipped, numbering continues
+    assert [e["kind"] for e in read_events(path)] == ["ok", "next"]
+
+
+# -- export -----------------------------------------------------------------
+
+
+def test_textfile_exporter_atomic_write(tmp_path):
+    r = MetricsRegistry()
+    r.counter("t_export_total").inc(5)
+    prom = str(tmp_path / "metrics.prom")
+    ex = TextfileExporter(r, prom, interval_s=60)
+    ex.write_once()
+    text = open(prom).read()
+    assert "t_export_total 5" in text
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+
+
+def test_atomic_write_never_leaves_partial(tmp_path):
+    p = str(tmp_path / "x.txt")
+    atomic_write_text(p, "one")
+    atomic_write_text(p, "two")
+    assert open(p).read() == "two"
+
+
+def test_handle_obs_request_routes(tmp_path):
+    r = MetricsRegistry()
+    r.counter("t_route_total").inc()
+    log = EventLog(str(tmp_path / "ev.jsonl"))
+    log.emit("hello", x=1)
+    code, ctype, body = handle_obs_request("/metrics", r)
+    assert code == 200 and ctype.startswith("text/plain")
+    assert b"t_route_total 1" in body
+    code, ctype, body = handle_obs_request("/metrics.json", r)
+    assert code == 200 and json.loads(body)["t_route_total"] == 1
+    code, ctype, body = handle_obs_request("/events?n=5", r, log)
+    events = json.loads(body)["events"]
+    assert code == 200 and events[-1]["kind"] == "hello"
+    assert handle_obs_request("/nope", r) is None
+
+
+# -- trainer wiring (acceptance: observations == post-compile steps) --------
+
+
+@pytest.mark.parametrize("epochs,steps", [(1, 3), (2, 4)])
+def test_trainer_records_step_histogram_and_events(tmp_path, epochs, steps):
+    jax = pytest.importorskip("jax")
+    from pyspark_tf_gke_tpu.data.pipeline import BatchIterator
+    from pyspark_tf_gke_tpu.data.synthetic import (
+        synthetic_classification_arrays,
+    )
+    from pyspark_tf_gke_tpu.models import MLPClassifier
+    from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
+    from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    registry = MetricsRegistry()
+    trail = EventLog(str(tmp_path / "trail.jsonl"))
+    mesh = make_mesh({"dp": 2}, jax.devices()[:2])
+    X, y = synthetic_classification_arrays(n=256, num_classes=4)
+    it = BatchIterator({"x": X, "y": y}, 16)
+    trainer = Trainer(MLPClassifier(num_classes=4),
+                      TASKS["classification"](), mesh, learning_rate=1e-2,
+                      metrics_registry=registry, event_log=trail)
+    state = trainer.init_state(make_rng(0), next(iter(it)))
+    state, history = trainer.fit(state, it, epochs=epochs,
+                                 steps_per_epoch=steps)
+
+    total_steps = epochs * steps
+    hist = registry.get("train_step_time_ms")
+    # steady steps only: each epoch's step 0 is excluded (epoch 0's
+    # includes compile, later epochs' absorb the drained dispatch
+    # queue) — the same accounting as the history's steady_steps
+    assert hist.count == epochs * (steps - 1)
+    assert registry.get("train_steps_total").value == total_steps
+    assert registry.get("train_examples_total").value == total_steps * 16
+    assert registry.get("train_epochs_total").value == epochs
+    # non-empty event trail with fit start + one epoch_end per epoch
+    events = list(read_events(trail.path))
+    assert events, "trainer run must produce a non-empty event trail"
+    kinds = [e["kind"] for e in events]
+    assert kinds.count("train_fit_start") == 1
+    assert kinds.count("train_epoch_end") == epochs
+    # the exposition carries the full naming scheme
+    text = registry.exposition()
+    assert "train_step_time_ms_bucket" in text
+    assert "serve_requests_total" in text  # families pre-registered
+
+
+def test_trainer_histogram_counts_accumulate_across_fits(tmp_path):
+    # two fits on one trainer: per-epoch steady-step exclusion applies
+    # to each (fit #2's first step still absorbs the queue sync)
+    jax = pytest.importorskip("jax")
+    from pyspark_tf_gke_tpu.data.pipeline import BatchIterator
+    from pyspark_tf_gke_tpu.data.synthetic import (
+        synthetic_classification_arrays,
+    )
+    from pyspark_tf_gke_tpu.models import MLPClassifier
+    from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
+    from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    registry = MetricsRegistry()
+    trail = EventLog(str(tmp_path / "trail.jsonl"))
+    mesh = make_mesh({"dp": 2}, jax.devices()[:2])
+    X, y = synthetic_classification_arrays(n=128, num_classes=4)
+
+    def batches():
+        return BatchIterator({"x": X, "y": y}, 16)
+
+    trainer = Trainer(MLPClassifier(num_classes=4),
+                      TASKS["classification"](), mesh, learning_rate=1e-2,
+                      metrics_registry=registry, event_log=trail)
+    state = trainer.init_state(make_rng(0), next(iter(batches())))
+    state, _ = trainer.fit(state, batches(), epochs=1, steps_per_epoch=2)
+    state, _ = trainer.fit(state, batches(), epochs=1, steps_per_epoch=3)
+    assert registry.get("train_step_time_ms").count == (2 - 1) + (3 - 1)

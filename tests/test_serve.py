@@ -408,6 +408,41 @@ def test_metrics_endpoint(cb_endpoints):
         ctext = resp.read().decode()
     assert pre + "continuous_num_slots 2" in ctext
 
+    # ISSUE 1 acceptance: the exposition is the shared obs registry, so
+    # after a served request it carries at least one family from each
+    # plane (train_ families are pre-registered by the shared naming
+    # scheme; serve_/runtime_ carry live values here)
+    families = {ln.split("{")[0].split()[0] for ln in text.splitlines()
+                if ln and not ln.startswith("#")}
+    assert any(f.startswith("train_") for f in families)
+    assert any(f.startswith("serve_") for f in families)
+    assert any(f.startswith("runtime_") for f in families)
+    # canonical serve counters carry the same live values the legacy
+    # aliases report
+    assert metrics["serve_requests_total"] >= metrics[
+        pre + "generate_requests_total"]
+    assert metrics["serve_generate_tokens_total"] == metrics[
+        pre + "generate_tokens_total"]
+    # strict superset of the pre-obs exposition names
+    legacy = {pre + k for k in (
+        "requests_total", "requests_failed_total", "generate_tokens_total",
+        "generate_latency_ms_sum", "generate_requests_total",
+        "score_requests_total")}
+    assert legacy <= families
+
+
+def test_metrics_json_and_events_endpoints(cb_endpoints):
+    plain_url, _ = cb_endpoints
+    _post(plain_url, "/v1/generate", {"prompts": ["zz"],
+                                      "max_new_tokens": 2})
+    with urllib.request.urlopen(plain_url + "/metrics.json") as resp:
+        snap = json.loads(resp.read())
+    assert snap["serve_requests_total"] >= 1
+    assert "runtime_process_rss_bytes" in snap
+    with urllib.request.urlopen(plain_url + "/events?n=10") as resp:
+        out = json.loads(resp.read())
+    assert "events" in out  # shape contract; content depends on session
+
 
 def test_streaming_generate_sse(cb_endpoints):
     plain_url, cont_url = cb_endpoints

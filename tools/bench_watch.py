@@ -53,6 +53,8 @@ if REPO not in sys.path:
 from bench import PROBE_CODE, is_cpu_probe  # noqa: E402  (shared probe
 #   snippet + CPU-fallback test: the guards parse the probe's output
 #   format, so both files must agree on it — single source of truth)
+from pyspark_tf_gke_tpu.obs.events import get_event_log  # noqa: E402
+from pyspark_tf_gke_tpu.obs.export import atomic_write_text  # noqa: E402
 
 BENCH = os.path.join(REPO, "bench.py")
 ROOFLINE = os.path.join(REPO, "tools", "roofline.py")
@@ -79,15 +81,23 @@ def log(msg: str) -> None:
 
 
 def write_state(**kw) -> None:
-    """Rewrite the one-line observability file. Best-effort: the watcher
-    must keep probing even on a read-only checkout."""
+    """Rewrite the one-line observability file (atomic rename — a
+    mid-write reader must never see a torn line) and mirror the state
+    change into the shared obs event trail, where it correlates with
+    the trainer/server events by timestamp (seq is per-writer).
+    Best-effort: the watcher must keep probing even on a read-only
+    checkout."""
     kw.setdefault("ts", _now())
     kw.setdefault("pid", os.getpid())
     try:
-        with open(STATE_PATH, "w") as fh:
-            fh.write(json.dumps(kw) + "\n")
+        atomic_write_text(STATE_PATH, json.dumps(kw) + "\n")
     except OSError:
         pass
+    if kw.get("status") != "waiting":  # probe ticks would drown the trail
+        try:
+            get_event_log().emit("bench_watch_state", **kw)
+        except OSError:
+            pass
 
 
 def probe_once(timeout_s: float) -> str | None:

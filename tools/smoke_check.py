@@ -5,7 +5,13 @@ builds a ``local[2]`` in-process Spark session and runs a toy job, this
 builds a 2-device virtual CPU mesh and runs a toy sharded training step.
 Exit 0 = the framework and its distributed machinery work on this box.
 
-Usage: python tools/smoke_check.py
+Also the CI hook for the obs metric-naming contract: after an import
+sweep over every ``pyspark_tf_gke_tpu`` module, any metric name
+registered with two different shapes (type or label set) anywhere in
+the process fails the check — a duplicate-name metric would make one
+``/metrics`` scrape silently ambiguous.
+
+Usage: python tools/smoke_check.py [--lint-only]
 """
 
 import os
@@ -31,22 +37,89 @@ from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer  # noqa: E402
 from pyspark_tf_gke_tpu.utils.seeding import make_rng  # noqa: E402
 
 
-def main() -> int:
-    devices = jax.devices()
-    print(f"devices: {devices}")
-    assert len(devices) >= 2, "expected a 2-device virtual mesh"
+def lint_duplicate_metrics() -> int:
+    """Import every package module, run the platform's registration
+    entry points, then fail on any metric name registered with more
+    than one (type, labelnames) shape.
 
-    mesh = make_mesh({"dp": 2}, devices[:2])
-    X, y = synthetic_classification_arrays(n=128, num_classes=4)
-    it = BatchIterator({"x": X, "y": y}, 32)
-    trainer = Trainer(MLPClassifier(num_classes=4), TASKS["classification"](),
-                      mesh, learning_rate=1e-2)
-    state = trainer.init_state(make_rng(0), next(iter(it)))
-    state, history = trainer.fit(state, it, epochs=2, steps_per_epoch=4)
-    ok = history["loss"][-1] < history["loss"][0]
-    print(f"loss {history['loss'][0]:.4f} -> {history['loss'][-1]:.4f}  "
-          f"({'OK' if ok else 'NOT DECREASING'})")
-    return 0 if ok else 1
+    Two stages make the lint non-vacuous: (1) the import sweep catches
+    module-level registrations anywhere in the package; (2) the
+    canonical constructor-time entry points — ``platform_families``
+    (the whole train_/serve_ naming scheme, what Trainer, BundleServer
+    and ContinuousEngine register through) and
+    ``install_runtime_metrics`` — are invoked explicitly, so a scheme
+    name colliding with any module-level registration fails here, not
+    in production. A guard asserts the registration record is
+    non-empty afterwards: if a refactor ever disconnects the entry
+    points from the record, the lint fails loudly instead of passing
+    on nothing. Modules that cannot import on this box (optional
+    accelerator deps) are reported but don't fail the lint — a missing
+    dep is not a naming conflict."""
+    import importlib
+    import pkgutil
+
+    import pyspark_tf_gke_tpu
+    from pyspark_tf_gke_tpu.obs.metrics import (
+        MetricsRegistry,
+        _REGISTRATIONS,
+        duplicate_metric_conflicts,
+        platform_families,
+    )
+    from pyspark_tf_gke_tpu.obs.runtime import install_runtime_metrics
+
+    skipped = []
+    for info in pkgutil.walk_packages(pyspark_tf_gke_tpu.__path__,
+                                      prefix="pyspark_tf_gke_tpu."):
+        try:
+            importlib.import_module(info.name)
+        except Exception as exc:  # noqa: BLE001 — optional deps may be absent
+            skipped.append(f"{info.name}: {type(exc).__name__}: {exc}")
+    if skipped:
+        print(f"metric lint: {len(skipped)} module(s) not importable "
+              "(skipped, not a naming failure):")
+        for s in skipped:
+            print(f"  - {s}")
+    # exercise the canonical registration paths (throwaway registry —
+    # the record is process-global either way)
+    scheme = MetricsRegistry()
+    platform_families(scheme)
+    install_runtime_metrics(scheme)
+    if not _REGISTRATIONS:
+        print("metric lint FAILED — registration record is empty after "
+              "the sweep; the lint is observing nothing")
+        return 1
+    conflicts = duplicate_metric_conflicts()
+    if conflicts:
+        print("metric lint FAILED — same name, different shape:")
+        for c in conflicts:
+            print(f"  - {c}")
+        return 1
+    print(f"metric lint OK: {len(_REGISTRATIONS)} metric name(s), "
+          "no duplicate shapes")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--lint-only" not in argv:
+        devices = jax.devices()
+        print(f"devices: {devices}")
+        assert len(devices) >= 2, "expected a 2-device virtual mesh"
+
+        mesh = make_mesh({"dp": 2}, devices[:2])
+        X, y = synthetic_classification_arrays(n=128, num_classes=4)
+        it = BatchIterator({"x": X, "y": y}, 32)
+        trainer = Trainer(MLPClassifier(num_classes=4),
+                          TASKS["classification"](),
+                          mesh, learning_rate=1e-2)
+        state = trainer.init_state(make_rng(0), next(iter(it)))
+        state, history = trainer.fit(state, it, epochs=2, steps_per_epoch=4)
+        ok = history["loss"][-1] < history["loss"][0]
+        print(f"loss {history['loss'][0]:.4f} -> {history['loss'][-1]:.4f}  "
+              f"({'OK' if ok else 'NOT DECREASING'})")
+        if not ok:
+            return 1
+    return lint_duplicate_metrics()
 
 
 if __name__ == "__main__":
