@@ -695,7 +695,7 @@ def bench_decode(smoke: bool = False, kv_heads=None, int8: bool = False,
     }
 
 
-def bench_continuous(smoke: bool = False) -> dict:
+def bench_continuous(smoke: bool = False, paged: bool = False) -> dict:
     """Continuous batching vs whole-batch serving on the SAME request
     set (train/continuous.py). The workload that separates them is
     budget variance: a whole-batch server runs every group for its
@@ -727,6 +727,22 @@ def bench_continuous(smoke: bool = False) -> dict:
         slots, chunk, s_prompt, n_requests, lo, hi = 8, 16, 128, 32, 32, 512
 
     model = CausalLM(cfg)
+    # --paged: the ENGINE runs the paged KV cache (global page pool +
+    # block tables + the ragged paged_attention decode read,
+    # ops/pallas/paged_attention.py) at the SAME slot count; the
+    # whole-batch baseline and the parity oracle stay on the dense
+    # layout (params are identical — the config only shapes the cache).
+    # The pool is sized to full capacity (slots x max_pages_per_slot)
+    # so throughput is comparable; the memory win is read off the
+    # pages-in-use gauge, which tracks allocated tokens.
+    eng_model = model
+    if paged:
+        import dataclasses as _dc
+
+        page_size = 32 if smoke else 64
+        pool = slots * (cfg.max_seq_len // page_size)
+        eng_model = CausalLM(_dc.replace(
+            cfg, kv_page_size=page_size, kv_num_pages=pool))
     rng = np.random.default_rng(0)
     prompts = rng.integers(
         0, cfg.vocab_size, (n_requests, s_prompt)).astype(np.int32)
@@ -779,7 +795,7 @@ def bench_continuous(smoke: bool = False) -> dict:
                    batch: bool = True, req_budgets=None,
                    schedule: str = "fifo"):
         req_budgets = budgets if req_budgets is None else req_budgets
-        warm = ContinuousEngine(model, params, num_slots=slots,
+        warm = ContinuousEngine(eng_model, params, num_slots=slots,
                                 chunk=chunk_n, pipeline_depth=pipeline,
                                 adaptive_chunk=adaptive, batch_admit=batch)
         # Compile coverage BEFORE timing: every batched-admission group
@@ -796,7 +812,7 @@ def bench_continuous(smoke: bool = False) -> dict:
         if adaptive:
             warm.submit(prompts[0], max_new_tokens=2 * chunk_n - 8)
             list(warm.run_until_drained())
-        eng = ContinuousEngine(model, params, num_slots=slots,
+        eng = ContinuousEngine(eng_model, params, num_slots=slots,
                                chunk=chunk_n, pipeline_depth=pipeline,
                                adaptive_chunk=adaptive, batch_admit=batch,
                                schedule=schedule)
@@ -818,7 +834,8 @@ def bench_continuous(smoke: bool = False) -> dict:
             # the link-noise-immune half of the engine-vs-whole-batch
             # comparison — wall-clock on a tunneled chip swings with
             # RTT drift, the step count does not
-            "dispatched_steps": st["dispatched_steps"]}
+            "dispatched_steps": st["dispatched_steps"],
+            **({"paged": st["paged"]} if "paged" in st else {})}
 
     base_cfg_tps, _ = run_engine(chunk, 0)
     if smoke:
@@ -952,6 +969,25 @@ def bench_continuous(smoke: bool = False) -> dict:
         "schedule": tuned_sched,
         "batch_admit": tuned_batch,
         "admit_stats": admit_stats,
+        # --paged identity: page-pool accounting vs the dense layout's
+        # fixed num_slots x max_seq_len rows (the obs gauge
+        # serve_kv_cache_bytes_per_layer tracks the in-use number live)
+        **({"paged_kv": {
+            "page_size": eng_model.cfg.kv_page_size,
+            "pages_total": eng_model.cfg.kv_num_pages,
+            "peak_pages_in_use": admit_stats.get(
+                "paged", {}).get("peak_pages_in_use"),
+            "page_alloc_failures": admit_stats.get(
+                "paged", {}).get("page_alloc_failures"),
+            "peak_kv_bytes_per_layer": (
+                admit_stats.get("paged", {}).get("peak_pages_in_use", 0)
+                * admit_stats.get("paged", {}).get(
+                    "page_bytes_per_layer", 0)),
+            "dense_kv_bytes_per_layer": (
+                2 * slots * cfg.max_seq_len * cfg.kv_heads
+                * (cfg.head_dim * 1 + 4 if cfg.kv_cache_quant  # +f32 scales
+                   else cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)),
+        }} if paged else {}),
         # The noise-immune half of the comparison: the engine retires
         # the same request mix in FEWER device decode steps than the
         # compiled-once whole-batch server (which runs every group to
@@ -1344,6 +1380,9 @@ ALL_WORKLOADS = (
     ["resnet50", "--nf"],
     ["cnn", "--adafactor"],  # factored-second-moment traffic lever
     ["cb"],  # continuous batching: chunk x depth autotune vs whole-batch
+    # paged KV cache A/B: same slot count, engine on the page pool +
+    # ragged paged_attention decode; cache bytes tracked by pages in use
+    ["cb", "--paged"],
     ["spec"],  # device-loop tok/s + the 0.75-skew fixture's acceptance
     ["generate", "--beams", "4"],  # broadcast-select reorder rebuild A/B
     # --- measured re-confirmations ---
@@ -1558,6 +1597,8 @@ def run_bench(argv) -> dict:
         raise SystemExit("--bf16-moments applies to the cnn workload only")
     if "--adafactor" in argv and workload != "cnn":
         raise SystemExit("--adafactor applies to the cnn workload only")
+    if "--paged" in argv and workload != "cb":
+        raise SystemExit("--paged applies to the cb workload only")
     if "--s2d" in argv and workload != "resnet50":
         raise SystemExit("--s2d applies to the resnet50 workload only")
     if "--gn" in argv and workload != "resnet50":
@@ -1595,7 +1636,7 @@ def run_bench(argv) -> dict:
     if workload == "io":
         return bench_io(smoke=smoke)
     if workload == "cb":
-        return bench_continuous(smoke=smoke)
+        return bench_continuous(smoke=smoke, paged="--paged" in argv)
     if workload == "spec":
         gamma = 4
         if "--gamma" in argv:

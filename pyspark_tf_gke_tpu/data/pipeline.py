@@ -168,6 +168,13 @@ def prefetch_to_device(
     (the tf.data ``prefetch(AUTOTUNE)`` analog, ``train_tf_ps.py:322``,
     but placing *sharded global* arrays). ``size=0`` degrades to inline
     transfer. Exceptions in the source iterator re-raise at the consumer.
+
+    The queue's occupancy is exported as the ``data_prefetch_queue_depth``
+    obs gauge (sampled at each producer put and consumer get): a scrape
+    reading 0 while steps run means the input pipeline is the
+    bottleneck (input-starved steps); pinned at ``size`` means the
+    device is — the signal that separates feed-rate problems from
+    HBM/compute-bound ones in the shared metrics plane.
     """
     if size <= 0:
         for b in batches:
@@ -176,6 +183,10 @@ def prefetch_to_device(
 
     import queue
     import threading
+
+    from pyspark_tf_gke_tpu.obs.metrics import platform_families
+
+    depth_gauge = platform_families()["data_prefetch_queue_depth"]
 
     q: "queue.Queue" = queue.Queue(maxsize=size)
     done = object()
@@ -186,6 +197,7 @@ def prefetch_to_device(
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.1)
+                depth_gauge.set(q.qsize())
                 return True
             except queue.Full:
                 continue
@@ -205,6 +217,7 @@ def prefetch_to_device(
     try:
         while True:
             item = q.get()
+            depth_gauge.set(q.qsize())
             if item is done:
                 return
             if isinstance(item, BaseException):

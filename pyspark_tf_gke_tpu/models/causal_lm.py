@@ -80,6 +80,27 @@ class CausalLMConfig:
     # num_heads/kv_heads shrink; the dequant (convert+scale) fuses into
     # the attention einsums. Composes with beam search and tp sharding.
     kv_cache_quant: bool = False
+    # Paged KV cache (slot-decode / continuous batching only): when
+    # kv_num_pages is set, slot mode stores K/V in ONE global page pool
+    # per layer — (kv_num_pages, kv_page_size, kv_heads, head_dim) —
+    # plus an int32 block table (num_slots, max_pages_per_slot) naming
+    # each slot's pages. Cache memory then tracks tokens actually
+    # allocated by the engine (train/continuous.py manages page
+    # alloc/free on admit/free), not num_slots x max_seq_len, and the
+    # decode read is the ragged ops/pallas/paged_attention kernel whose
+    # HBM traffic stops at each slot's last live page. The non-slot
+    # paths (training, prefill, whole-batch generate) are unaffected —
+    # they keep the dense layouts.
+    kv_page_size: int = 64
+    kv_num_pages: Optional[int] = None  # None = dense slot cache
+
+    @property
+    def paged_kv(self) -> bool:
+        return self.kv_num_pages is not None
+
+    @property
+    def max_pages_per_slot(self) -> int:
+        return -(-self.max_seq_len // self.kv_page_size)
 
     @property
     def head_dim(self) -> int:
@@ -263,6 +284,81 @@ class CausalSelfAttention(nn.Module):
                     segment_ids[:, None, None, :])
         return dot_product_attention(q, k, v, mask=mask, causal=True)
 
+    def _paged_cache_vars(self, b, h, d, dtype):
+        """Paged slot-cache variables: the global page pool (shared by
+        every slot), the per-slot block table, and the conservative
+        fill counter. The block table initializes to the OUT-OF-RANGE
+        sentinel ``kv_num_pages`` — a row with no pages writes nowhere
+        (scatter mode="drop") and reads only masked garbage — so a
+        freed slot's rows can never touch pages reallocated to another
+        request."""
+        cfg = self.cfg
+        store = jnp.int8 if cfg.kv_cache_quant else dtype
+        n, ps = cfg.kv_num_pages, cfg.kv_page_size
+        if cfg.max_seq_len % ps:
+            raise ValueError(
+                f"kv_page_size {ps} must divide max_seq_len "
+                f"{cfg.max_seq_len}")
+        mp = cfg.max_pages_per_slot
+        kp = self.variable("cache", "k_pages", jnp.zeros, (n, ps, h, d),
+                           store)
+        vp = self.variable("cache", "v_pages", jnp.zeros, (n, ps, h, d),
+                           store)
+        bt = self.variable("cache", "block_table",
+                           lambda: jnp.full((b, mp), n, jnp.int32))
+        idx = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
+        if not cfg.kv_cache_quant:
+            return kp, vp, bt, None, None, idx
+        ks = self.variable("cache", "k_scale_pages", jnp.zeros,
+                           (n, ps, h), jnp.float32)
+        vs = self.variable("cache", "v_scale_pages", jnp.zeros,
+                           (n, ps, h), jnp.float32)
+        return kp, vp, bt, ks, vs, idx
+
+    def _paged_decode_attend(self, q, k, v, row_positions):
+        """Slot-decode step against the paged pool: write each row's
+        single new K/V at (block_table[row, pos // P], pos % P), then
+        attend through the block table with the ragged
+        ``paged_attention`` kernel (pure-JAX reference off-TPU). One
+        token per row only — the engine's paged mode admits via dense
+        prefill + page scatter, never multi-token slot decode."""
+        cfg = self.cfg
+        b, s, h, d = q.shape
+        if s != 1:
+            raise ValueError(
+                "paged slot decode is single-token (chunked prefill / "
+                "prefix extension run on dense batch-1 trees)")
+        from pyspark_tf_gke_tpu.ops.pallas.paged_attention import (
+            paged_attention,
+        )
+
+        hkv = k.shape[2]
+        kp, vp, bt, ks, vs, idx = self._paged_cache_vars(b, hkv, d, k.dtype)
+        pos_b = row_positions[:, 0]                              # [B]
+        ps = cfg.kv_page_size
+        # take_along_axis clips an over-long dead row's page index into
+        # the table; a sentinel entry there makes the write a no-op.
+        page = jnp.take_along_axis(
+            bt.value, jnp.minimum(pos_b // ps, bt.value.shape[1] - 1)[:, None],
+            axis=1)[:, 0]
+        off = pos_b % ps
+        krow, vrow = k[:, 0], v[:, 0]                            # [B,Hkv,D]
+        if ks is not None:
+            krow, k_scale = self._quantize_kv(krow)
+            vrow, v_scale = self._quantize_kv(vrow)
+            ks.value = ks.value.at[page, off].set(k_scale, mode="drop")
+            vs.value = vs.value.at[page, off].set(v_scale, mode="drop")
+        kp.value = kp.value.at[page, off].set(
+            krow.astype(kp.value.dtype), mode="drop")
+        vp.value = vp.value.at[page, off].set(
+            vrow.astype(vp.value.dtype), mode="drop")
+        idx.value = jnp.maximum(idx.value, jnp.max(pos_b) + 1)
+        out = paged_attention(
+            q[:, 0], kp.value, vp.value, bt.value, pos_b + 1,
+            k_scales=ks.value if ks is not None else None,
+            v_scales=vs.value if vs is not None else None)
+        return out[:, None]                                      # [B,1,H,D]
+
     def _cache_vars(self, b, h, d, dtype):
         cfg = self.cfg
         store = jnp.int8 if cfg.kv_cache_quant else dtype
@@ -351,6 +447,8 @@ class CausalSelfAttention(nn.Module):
         cfg = self.cfg
         b, s, h, d = q.shape
         hkv = k.shape[2]
+        if row_positions is not None and cfg.paged_kv:
+            return self._paged_decode_attend(q, k, v, row_positions)
         cache = self._cache_vars(b, hkv, d, k.dtype)
         ck, cv, ks, vs, idx = cache
         if row_positions is not None:

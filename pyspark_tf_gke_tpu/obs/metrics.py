@@ -492,4 +492,24 @@ def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
         "serve_engine_rebuilds_total": r.counter(
             "serve_engine_rebuilds_total",
             "Slot-engine rebuilds after a failed device step"),
+        # paged KV cache (engine-managed page pool; zero unless the
+        # engine runs a paged model)
+        "serve_kv_pages_total": r.gauge(
+            "serve_kv_pages_total", "KV page-pool capacity (pages)"),
+        "serve_kv_pages_in_use": r.gauge(
+            "serve_kv_pages_in_use",
+            "KV pages currently allocated to slots"),
+        "serve_kv_cache_bytes_per_layer": r.gauge(
+            "serve_kv_cache_bytes_per_layer",
+            "Bytes of KV cache in use per layer (pages_in_use x page "
+            "bytes) — scales with live tokens, not slots x max_len"),
+        "serve_kv_page_alloc_failures_total": r.counter(
+            "serve_kv_page_alloc_failures_total",
+            "Admission attempts deferred because the page pool could "
+            "not cover the request (it stays queued)"),
+        # data plane
+        "data_prefetch_queue_depth": r.gauge(
+            "data_prefetch_queue_depth",
+            "Device-prefetch queue occupancy (0 at a fetch = input-"
+            "starved step; full = HBM/compute-bound)"),
     }

@@ -1,4 +1,9 @@
 from pyspark_tf_gke_tpu.ops.pallas.flash_attention import flash_attention
 from pyspark_tf_gke_tpu.ops.pallas.layernorm import fused_layernorm
+from pyspark_tf_gke_tpu.ops.pallas.paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+)
 
-__all__ = ["flash_attention", "fused_layernorm"]
+__all__ = ["flash_attention", "fused_layernorm", "paged_attention",
+           "paged_attention_reference"]

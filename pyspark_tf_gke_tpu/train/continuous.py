@@ -255,6 +255,166 @@ def _clear_live(state: SlotState, slot):
     return state._replace(live=state.live.at[slot].set(False))
 
 
+# -- paged KV cache (models/causal_lm.py CausalLMConfig.kv_num_pages) --------
+#
+# Slot mode stores K/V in one global page pool per layer plus a per-slot
+# block table; the ENGINE owns page allocation (host-side free list,
+# admit/free boundaries only — no mid-decode allocation, so no shape
+# recompiles). Prefill still runs on the dense batch-1 layout (it is
+# compute-bound and transient); the insert ops below scatter its rows
+# into the slot's pages. A slot's block-table row is reset to the
+# OUT-OF-RANGE sentinel on free, so rows of freed/dead slots can never
+# write into pages reallocated to another request.
+
+
+def _map_paged_layers(pool_tree, fn, dense_tree=None):
+    """Rebuild a paged cache tree: ``fn`` is applied to every subtree
+    holding the paged leaves (``k_pages``/``block_table``/...), paired
+    with the same-path subtree of ``dense_tree`` when given (the dense
+    prefill cache has ``k``/``v``/``index`` at identical paths — both
+    come from the same attention modules)."""
+    def walk(pool, dense):
+        if hasattr(pool, "keys"):
+            if "k_pages" in pool:
+                return fn(pool) if dense is None else fn(pool, dense)
+            return {key: walk(pool[key],
+                              None if dense is None else dense[key])
+                    for key in pool}
+        return pool
+    return walk(pool_tree, dense_tree)
+
+
+@functools.partial(jax.jit, static_argnames=("model", "num_slots"))
+def _paged_zeros_state(model: CausalLM, params, *,
+                       num_slots: int) -> SlotState:
+    """Fresh paged slot-pool state. The paged cache tree's shapes come
+    from the model config, not from a prefill template, so it is built
+    by one throwaway slot-decode forward whose cache writes all drop
+    (block tables initialize to the sentinel)."""
+    from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
+
+    b = num_slots
+    tok = jnp.zeros((b, 1), jnp.int32)
+    pos = jnp.zeros((b, 1), jnp.int32)
+    _, mutated = model.apply(
+        {"params": dequantize_tree(params)}, tok, decode=True,
+        slot_decode=True, positions=pos, mutable=["cache"])
+    return SlotState(
+        cache=mutated["cache"],
+        positions=jnp.zeros((b,), jnp.int32),
+        last_logits=jnp.zeros((b, model.cfg.vocab_size), jnp.float32),
+        live=jnp.zeros((b,), bool),
+        temps=jnp.zeros((b,), jnp.float32),
+        topps=jnp.ones((b,), jnp.float32),
+        keys=jnp.zeros((b, 2), jnp.uint32))
+
+
+@functools.partial(jax.jit, static_argnames=("n_rows",))
+def _insert_slot_paged(state: SlotState, cache1, logits1, slot, fill,
+                       pages, temp, topp, key, *, n_rows: int) -> SlotState:
+    """Paged ``_insert_slot``: scatter the dense batch-1 prefill's first
+    ``n_rows`` cache rows (the padded bucket — ``n_rows`` static, so
+    one program per bucket) into the slot's allocated pages and point
+    its block-table row at them. ``pages`` is the sentinel-padded
+    ``[max_pages_per_slot]`` allocation; only its first
+    ``n_rows / page_size`` entries receive prefill rows."""
+    def layer(pool, dense):
+        ps = pool["k_pages"].shape[1]
+        nc = n_rows // ps
+        idx = pages[:nc]
+
+        def scat(pool_leaf, dense_leaf):
+            rows = dense_leaf[0, :n_rows]
+            chunks = rows.reshape((nc, ps) + rows.shape[1:])
+            return pool_leaf.at[idx].set(
+                chunks.astype(pool_leaf.dtype), mode="drop")
+
+        out = dict(pool)
+        out["k_pages"] = scat(pool["k_pages"], dense["k"])
+        out["v_pages"] = scat(pool["v_pages"], dense["v"])
+        if "k_scale_pages" in pool:
+            out["k_scale_pages"] = scat(pool["k_scale_pages"],
+                                        dense["k_scale"])
+            out["v_scale_pages"] = scat(pool["v_scale_pages"],
+                                        dense["v_scale"])
+        out["block_table"] = pool["block_table"].at[slot].set(pages)
+        out["index"] = jnp.maximum(pool["index"], dense["index"])
+        return out
+
+    cache = _map_paged_layers(state.cache, layer, cache1)
+    return SlotState(
+        cache=cache,
+        positions=state.positions.at[slot].set(fill),
+        last_logits=state.last_logits.at[slot].set(logits1[0]),
+        live=state.live.at[slot].set(True),
+        temps=state.temps.at[slot].set(temp),
+        topps=state.topps.at[slot].set(topp),
+        keys=state.keys.at[slot].set(key))
+
+
+@functools.partial(jax.jit, static_argnames=("n_rows",))
+def _insert_slots_batch_paged(state: SlotState, caches, logits, slots,
+                              fills, pages_b, temps, topps, keys, *,
+                              n_rows: int) -> SlotState:
+    """Paged ``_insert_slots_batch``: one scatter lands every admitted
+    row's prefill pages AND block-table rows. Shape-padding rows carry
+    the out-of-bounds slot sentinel and all-sentinel page rows, so
+    both scatters drop them."""
+    def layer(pool, dense):
+        ps = pool["k_pages"].shape[1]
+        nc = n_rows // ps
+        idx = pages_b[:, :nc].reshape(-1)
+
+        def scat(pool_leaf, dense_leaf):
+            rows = dense_leaf[:, :n_rows]
+            chunks = rows.reshape(
+                (rows.shape[0] * nc, ps) + rows.shape[2:])
+            return pool_leaf.at[idx].set(
+                chunks.astype(pool_leaf.dtype), mode="drop")
+
+        out = dict(pool)
+        out["k_pages"] = scat(pool["k_pages"], dense["k"])
+        out["v_pages"] = scat(pool["v_pages"], dense["v"])
+        if "k_scale_pages" in pool:
+            out["k_scale_pages"] = scat(pool["k_scale_pages"],
+                                        dense["k_scale"])
+            out["v_scale_pages"] = scat(pool["v_scale_pages"],
+                                        dense["v_scale"])
+        out["block_table"] = pool["block_table"].at[slots].set(
+            pages_b, mode="drop")
+        out["index"] = jnp.maximum(pool["index"], dense["index"])
+        return out
+
+    cache = _map_paged_layers(state.cache, layer, caches)
+    return SlotState(
+        cache=cache,
+        positions=state.positions.at[slots].set(fills, mode="drop"),
+        last_logits=state.last_logits.at[slots].set(logits, mode="drop"),
+        live=state.live.at[slots].set(True, mode="drop"),
+        temps=state.temps.at[slots].set(temps, mode="drop"),
+        topps=state.topps.at[slots].set(topps, mode="drop"),
+        keys=state.keys.at[slots].set(keys, mode="drop"))
+
+
+@jax.jit
+def _clear_live_paged(state: SlotState, slot):
+    """Paged free: drop the live flag AND reset the slot's block-table
+    row to the sentinel, so in-flight dead-row replays (decode-ahead)
+    scatter nowhere instead of into pages the engine is about to hand
+    to another request."""
+    def layer(pool):
+        out = dict(pool)
+        n = pool["k_pages"].shape[0]
+        mp = pool["block_table"].shape[1]
+        out["block_table"] = pool["block_table"].at[slot].set(
+            jnp.full((mp,), n, jnp.int32))
+        return out
+
+    return state._replace(
+        cache=_map_paged_layers(state.cache, layer),
+        live=state.live.at[slot].set(False))
+
+
 @functools.partial(jax.jit, static_argnames=("num_slots", "vocab"))
 def _zeros_state(cache1, *, num_slots: int, vocab: int) -> SlotState:
     """Fresh slot-pool state shaped after one prefill's cache tree."""
@@ -441,6 +601,7 @@ class SlotDeviceState:
         self.model, self.params = model, params
         self.num_slots = num_slots
         self.mesh = mesh
+        self.paged = bool(getattr(model.cfg, "paged_kv", False))
         self.state: Optional[SlotState] = None
 
     def _mesh_ctx(self):
@@ -452,17 +613,40 @@ class SlotDeviceState:
         # come out as GLOBAL arrays on multi-process meshes — eager
         # jnp.zeros would commit to local devices and refuse to mix
         # with the mesh-spanning prefill outputs.
+        if self.paged:
+            # paged shapes come from the model config, not the dense
+            # prefill template
+            return _paged_zeros_state(self.model, self.params,
+                                      num_slots=self.num_slots)
         return _zeros_state(cache1, num_slots=self.num_slots,
                             vocab=self.model.cfg.vocab_size)
 
     def insert(self, cache1, logits1, slot: int, fill: int,
                temperature: float = 0.0, top_p: float = 1.0,
-               seed: int = 0) -> None:
+               seed: int = 0, pages=None, n_rows: Optional[int] = None
+               ) -> None:
         """Drop a prefilled/extended batch-1 tree into ``slot`` at
-        ``fill`` with its sampling lane (temperature 0 = greedy)."""
+        ``fill`` with its sampling lane (temperature 0 = greedy).
+        Paged mode additionally needs the slot's page allocation
+        (``pages``, sentinel-padded) and the dense row count to
+        scatter (``n_rows``, the padded bucket width)."""
         with self._mesh_ctx():
             if self.state is None:
                 self.state = self._init_state(cache1)
+            if self.paged:
+                if pages is None or n_rows is None:
+                    raise ValueError(
+                        "paged insert needs pages + n_rows (the "
+                        "engine allocates pages at admission)")
+                self.state = _insert_slot_paged(
+                    self.state, cache1, logits1,
+                    jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(fill, jnp.int32),
+                    jnp.asarray(pages, jnp.int32),
+                    jnp.asarray(temperature, jnp.float32),
+                    jnp.asarray(top_p, jnp.float32),
+                    _seed_key_data(seed), n_rows=int(n_rows))
+                return
             self.state = _insert_slot(
                 self.state, cache1, logits1,
                 jnp.asarray(slot, jnp.int32),
@@ -473,18 +657,21 @@ class SlotDeviceState:
 
     def admit_padded(self, padded: np.ndarray, true_len: int,
                      slot: int, temperature: float = 0.0,
-                     top_p: float = 1.0, seed: int = 0) -> None:
+                     top_p: float = 1.0, seed: int = 0,
+                     pages=None) -> None:
         """Prefill a right-padded [1, S_bucket] prompt and insert it
-        into ``slot`` at fill level ``true_len``."""
+        into ``slot`` at fill level ``true_len`` (``pages``: the
+        slot's page allocation, paged mode only)."""
         with self._mesh_ctx():
             cache1, logits1 = _prefill_padded(
                 self.model, self.params, jnp.asarray(padded),
                 jnp.asarray(true_len, jnp.int32))
         self.insert(cache1, logits1, slot, true_len,
-                    temperature=temperature, top_p=top_p, seed=seed)
+                    temperature=temperature, top_p=top_p, seed=seed,
+                    pages=pages, n_rows=padded.shape[1])
 
     def admit_padded_batch(self, padded: np.ndarray, true_lens,
-                           slots, samplings) -> None:
+                           slots, samplings, pages=None) -> None:
         """ONE batched prefill + ONE batched slot scatter admits
         ``len(slots)`` requests; rows past ``len(slots)`` are shape
         padding (computed, never inserted — their scatter index is the
@@ -512,11 +699,23 @@ class SlotDeviceState:
                 # _zeros_state only reads shape[1:] per leaf, so the
                 # k-row tree is as good a template as a batch-1 one
                 self.state = self._init_state(caches)
-            self.state = _insert_slots_batch(
-                self.state, caches, logits,
-                jnp.asarray(slot_idx),
-                jnp.asarray(true_lens, jnp.int32),
-                jnp.asarray(temps), jnp.asarray(topps), keys)
+            if self.paged:
+                if pages is None:
+                    raise ValueError(
+                        "paged batch insert needs per-row pages")
+                self.state = _insert_slots_batch_paged(
+                    self.state, caches, logits,
+                    jnp.asarray(slot_idx),
+                    jnp.asarray(true_lens, jnp.int32),
+                    jnp.asarray(pages, jnp.int32),
+                    jnp.asarray(temps), jnp.asarray(topps), keys,
+                    n_rows=padded.shape[1])
+            else:
+                self.state = _insert_slots_batch(
+                    self.state, caches, logits,
+                    jnp.asarray(slot_idx),
+                    jnp.asarray(true_lens, jnp.int32),
+                    jnp.asarray(temps), jnp.asarray(topps), keys)
 
     def chunk_async(self, chunk: int, eos_token_id: Optional[int],
                     pad_id: int, sampling: bool = False):
@@ -557,9 +756,11 @@ class SlotDeviceState:
             return
         with self._mesh_ctx():
             # jitted (not eager .at) so the update runs SPMD on global
-            # multi-process arrays like every other replayed op
-            self.state = _clear_live(self.state,
-                                     jnp.asarray(slot, jnp.int32))
+            # multi-process arrays like every other replayed op; paged
+            # mode also resets the slot's block-table row to the
+            # sentinel (its pages are about to return to the pool)
+            clear = _clear_live_paged if self.paged else _clear_live
+            self.state = clear(self.state, jnp.asarray(slot, jnp.int32))
 
 
 class ContinuousEngine:
@@ -686,6 +887,38 @@ class ContinuousEngine:
         if not self.buckets:
             raise ValueError(
                 f"no prompt bucket fits max_seq_len {s_max}")
+        # -- paged KV cache: the engine owns the page pool ------------------
+        self.paged = bool(getattr(model.cfg, "paged_kv", False))
+        self._free_pages: List[int] = []
+        self._slot_pages: Dict[int, List[int]] = {}
+        self._peak_pages_in_use = 0
+        self._n_page_alloc_failures = 0
+        if self.paged:
+            ps = model.cfg.kv_page_size
+            if s_max % ps:
+                raise ValueError(
+                    f"kv_page_size {ps} must divide max_seq_len {s_max}")
+            if prefix_cache_size or prefill_chunk:
+                # both build/extend dense batch-1 cache trees that the
+                # paged insert cannot consume incrementally — dense
+                # engines keep them; wire them onto pages in a later PR
+                raise ValueError(
+                    "prefix caching / chunked prefill are unsupported "
+                    "with the paged KV cache")
+            # prefill rows scatter whole pages, so every admissible
+            # bucket must be page-aligned
+            self.buckets = tuple(b for b in self.buckets if b % ps == 0)
+            if not self.buckets:
+                raise ValueError(
+                    f"no prompt bucket is a multiple of kv_page_size {ps}")
+            self._free_pages = list(range(model.cfg.kv_num_pages))
+            itemsize = 1 if model.cfg.kv_cache_quant else jnp.dtype(
+                model.cfg.dtype).itemsize
+            per_page = 2 * ps * model.cfg.kv_heads * model.cfg.head_dim * (
+                itemsize)                                   # K + V pages
+            if model.cfg.kv_cache_quant:
+                per_page += 2 * ps * model.cfg.kv_heads * 4  # f32 scales
+            self._page_bytes_per_layer = per_page
         self._rid = itertools.count()
         self._queue: List[_Request] = []
         self._slots: Dict[int, _Request] = {}
@@ -702,6 +935,9 @@ class ContinuousEngine:
         # passes its own); default is the process registry.
         self._obs = obs if obs is not None else platform_families()
         self._obs["serve_slots_total"].set(num_slots)
+        if self.paged:
+            self._obs["serve_kv_pages_total"].set(model.cfg.kv_num_pages)
+            self._update_page_gauges()
 
     # -- submission ------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
@@ -720,7 +956,17 @@ class ContinuousEngine:
             raise ValueError(
                 f"prompt {prompt.size} + {max_new_tokens} new tokens "
                 f"exceeds max_seq_len {self.model.cfg.max_seq_len}")
-        bucket_length(prompt.size, self.buckets)  # raises if oversized
+        sb = bucket_length(prompt.size, self.buckets)  # raises if oversized
+        if self.paged:
+            need = self._pages_needed(sb, prompt.size, max_new_tokens)
+            total = self.model.cfg.kv_num_pages
+            if need > total:
+                # with the whole pool free this request still couldn't
+                # admit — queueing it would livelock run_until_drained
+                raise ValueError(
+                    f"request needs {need} KV pages but the pool has "
+                    f"{total} (page_size "
+                    f"{self.model.cfg.kv_page_size})")
         req = _Request(next(self._rid), prompt, max_new_tokens,
                        on_tokens=on_tokens, temperature=float(temperature),
                        top_p=top_p, seed=int(seed))
@@ -798,16 +1044,91 @@ class ContinuousEngine:
             announce_thunk(serving)
             return device_thunk()
 
+    # -- page-pool bookkeeping (paged mode; host-side, process 0 only —
+    # workers replay the announced allocations verbatim) ------------------
+    def _pages_needed(self, s_bucket: int, true_len: int,
+                      max_new: int) -> int:
+        """Pages covering BOTH the padded prefill scatter (``s_bucket``
+        rows land in pages) and the request's maximum token extent."""
+        ps = self.model.cfg.kv_page_size
+        return -(-max(int(s_bucket), int(true_len) + int(max_new)) // ps)
+
+    def _update_page_gauges(self) -> None:
+        used = self.model.cfg.kv_num_pages - len(self._free_pages)
+        self._peak_pages_in_use = max(self._peak_pages_in_use, used)
+        self._obs["serve_kv_pages_in_use"].set(used)
+        self._obs["serve_kv_cache_bytes_per_layer"].set(
+            used * self._page_bytes_per_layer)
+
+    def _alloc_pages(self, n: int):
+        """``(row, taken)`` — the sentinel-padded ``[max_pages_per_slot]``
+        block-table row and the allocated page list — or None when the
+        pool cannot cover ``n`` (the request stays queued; the counter
+        increments once per failed admission attempt)."""
+        if n > len(self._free_pages):
+            self._n_page_alloc_failures += 1
+            self._obs["serve_kv_page_alloc_failures_total"].inc()
+            return None
+        taken = [self._free_pages.pop() for _ in range(n)]
+        cfg = self.model.cfg
+        row = np.full((cfg.max_pages_per_slot,), cfg.kv_num_pages,
+                      np.int32)
+        row[:n] = taken
+        return row, taken
+
+    def _note_pages(self, slot: int, taken: List[int]) -> None:
+        self._slot_pages[slot] = taken
+        self._update_page_gauges()
+
+    def _release_pages(self, slot: int) -> None:
+        taken = self._slot_pages.pop(slot, None)
+        if taken:
+            self._free_pages.extend(taken)
+            self._update_page_gauges()
+
     def _free_slot(self, slot: int) -> None:
         self._announced(
             lambda wire: wire.announce_cb_free(self.num_slots, slot),
             lambda: self._device.free(slot))
+        if self.paged:
+            self._release_pages(slot)
 
     def _try_admit(self, slot: int, req: _Request) -> bool:
         """Admit ``req`` into ``slot`` — immediately, via the prefix
         cache, or by STARTING a piecewise (chunked-prefill) admission.
         Returns False only when the request needs piecewise admission
-        and one is already in flight (FIFO holds)."""
+        and one is already in flight, or (paged mode) the page pool
+        cannot cover it yet (FIFO holds; the request stays queued)."""
+        if self.paged:
+            sb = bucket_length(req.prompt.size, self.buckets)
+            alloc = self._alloc_pages(self._pages_needed(
+                sb, req.prompt.size, req.max_new_tokens))
+            if alloc is None:
+                return False  # pool exhausted — admit at a later chunk
+                #               boundary, after frees return pages
+            row, taken = alloc
+            padded = right_pad(req.prompt, sb, self.pad_id)
+            sampling = (float(req.temperature),
+                        float(req.top_p if req.top_p is not None else 1.0),
+                        int(req.seed))
+            try:
+                self._announced(
+                    lambda wire: wire.announce_cb_admit(
+                        self.num_slots, padded, req.prompt.size, slot,
+                        self.eos_token_id, self.pad_id, sampling=sampling,
+                        pages=row),
+                    lambda: self._device.admit_padded(
+                        padded, req.prompt.size, slot, *sampling,
+                        pages=row))
+            except BaseException:
+                # a failed admit must not leak its pages: the caller may
+                # catch and keep driving this engine, and leaked pages
+                # would shrink the pool below submit()'s livelock bound
+                self._free_pages.extend(taken)
+                raise
+            self._note_pages(slot, taken)
+            self._slots[slot] = req
+            return True
         if (self._admitting is not None and self.prefill_chunk
                 and req.prompt.size > self.prefill_chunk):
             # piecewise admission busy and this prompt MIGHT need one:
@@ -950,6 +1271,8 @@ class ContinuousEngine:
         admission route."""
         group: List[_Request] = []
         sb0 = None
+        pages_left = len(self._free_pages)
+        needs: List[int] = []
         for req in self._queue:
             if len(group) >= len(free):
                 break
@@ -963,6 +1286,14 @@ class ContinuousEngine:
                 sb0 = sb
             elif sb != sb0:
                 break
+            if self.paged:
+                need = self._pages_needed(sb, req.prompt.size,
+                                          req.max_new_tokens)
+                if need > pages_left:
+                    break  # pool covers the prefix only; rest stays
+                    #        queued until frees return pages
+                pages_left -= need
+                needs.append(need)
             group.append(req)
         if len(group) < 2:
             return
@@ -979,9 +1310,28 @@ class ContinuousEngine:
         samplings = [(float(r.temperature),
                       float(r.top_p if r.top_p is not None else 1.0),
                       int(r.seed)) for r in group]
-        self._device.admit_padded_batch(padded, lens, free[:k], samplings)
-        for slot, req in zip(free[:k], group):
+        pages_b = None
+        takens: List[List[int]] = []
+        if self.paged:
+            cfgm = self.model.cfg
+            pages_b = np.full((k_pad, cfgm.max_pages_per_slot),
+                              cfgm.kv_num_pages, np.int32)
+            for i, need in enumerate(needs):
+                row, taken = self._alloc_pages(need)  # covered: the
+                #   grouping loop already bounded the sum by the pool
+                pages_b[i] = row
+                takens.append(taken)
+        try:
+            self._device.admit_padded_batch(padded, lens, free[:k],
+                                            samplings, pages=pages_b)
+        except BaseException:
+            for taken in takens:  # failed admit must not leak pages
+                self._free_pages.extend(taken)
+            raise
+        for i, (slot, req) in enumerate(zip(free[:k], group)):
             self._slots[slot] = req
+            if self.paged:
+                self._note_pages(slot, takens[i])
         del self._queue[:k]
         self._n_batch_admits += k
 
@@ -1181,4 +1531,13 @@ class ContinuousEngine:
             "inflight": bool(self._inflight_q),
             **({"prefix_cache": self.prefix_cache.stats}
                if self.prefix_cache is not None else {}),
+            **({"paged": {
+                "page_size": self.model.cfg.kv_page_size,
+                "pages_total": self.model.cfg.kv_num_pages,
+                "pages_in_use": (self.model.cfg.kv_num_pages
+                                 - len(self._free_pages)),
+                "peak_pages_in_use": self._peak_pages_in_use,
+                "page_alloc_failures": self._n_page_alloc_failures,
+                "page_bytes_per_layer": self._page_bytes_per_layer,
+            }} if self.paged else {}),
         }

@@ -186,7 +186,13 @@ OP_SPECULATIVE = 3
 #        has_sampling] + payload padded prompt [1, s_bucket]; when
 #        has_sampling=1 a float payload [temperature, top_p, seed]
 #        follows (per-slot sampling lane — every process seeds the
-#        same per-slot key, so sampled rows stay in lockstep)
+#        same per-slot key, so sampled rows stay in lockstep). With a
+#        PAGED model (CausalLMConfig.kv_num_pages) one more payload
+#        follows: the slot's sentinel-padded page allocation
+#        [max_pages_per_slot] int32 — process 0's engine owns the page
+#        pool and every worker replays the identical assignment, so
+#        block tables never diverge. Both sides derive the payload
+#        shape (and whether it exists) from the shared model config.
 # CHUNK: [op, num_slots, deferred, chunk, eos, has_sampling, pad_id, 0]
 #        (no payload; has_sampling is the STATIC flag choosing the
 #        greedy-only vs sampling-capable compiled chunk program — it
@@ -260,11 +266,13 @@ def mh_lock():
 
 def announce_cb_admit(num_slots: int, padded, true_len: int, slot: int,
                       eos_token_id, pad_id: int,
-                      sampling=None) -> None:
+                      sampling=None, pages=None) -> None:
     """Process 0 (caller already holds the announce lock): publish one
     slot-admit op. ``padded`` is the [1, S_bucket] right-padded prompt;
     ``sampling`` an optional (temperature, top_p, seed) triple for the
-    slot's lane (greedy = (0, 1, 0) or None)."""
+    slot's lane (greedy = (0, 1, 0) or None); ``pages`` the slot's
+    sentinel-padded page allocation (paged engines only — workers know
+    to read it from their own model config)."""
     header = np.zeros(_HEADER_LEN, np.int32)
     eos = -1 if eos_token_id is None else int(eos_token_id)
     has_sampling = int(sampling is not None and sampling[0] > 0)
@@ -280,6 +288,8 @@ def announce_cb_admit(num_slots: int, padded, true_len: int, slot: int,
         # broadcasting the raw uint32 key
         _bcast(np.asarray(sampling[:2], np.float32))
         _bcast(np.asarray([sampling[2]], np.int64))
+    if pages is not None:
+        _bcast(np.asarray(pages, np.int32))
 
 
 def announce_cb_chunk(num_slots: int, chunk: int, eos_token_id,
@@ -565,7 +575,7 @@ def serve_worker_loop(model, params, mesh: Mesh,
             # ordered stream — consume them BEFORE anything that can
             # fail, or a failed op would leave the next header read
             # misaligned
-            padded = samp = None
+            padded = samp = pages = None
             if op == OP_CB_ADMIT:
                 padded = np.asarray(_bcast(np.zeros((1, s), np.int32)))
                 if sampling:  # header slot 8: has_sampling
@@ -573,6 +583,12 @@ def serve_worker_loop(model, params, mesh: Mesh,
                     seed = int(np.asarray(
                         _bcast(np.zeros(1, np.int64)))[0])
                     samp = (float(floats[0]), float(floats[1]), seed)
+                if getattr(model.cfg, "paged_kv", False):
+                    # paged engines broadcast the slot's page
+                    # allocation; the shape comes from the shared
+                    # model config on both sides
+                    pages = np.asarray(_bcast(np.zeros(
+                        (model.cfg.max_pages_per_slot,), np.int32)))
             try:
                 if cb_replica is None or cb_replica.num_slots != b:
                     cb_replica = SlotDeviceState(model, params, b, mesh)
@@ -584,9 +600,10 @@ def serve_worker_loop(model, params, mesh: Mesh,
                     if samp is not None:
                         cb_replica.admit_padded(
                             padded, max_new, aux, temperature=samp[0],
-                            top_p=samp[1], seed=samp[2])
+                            top_p=samp[1], seed=samp[2], pages=pages)
                     else:
-                        cb_replica.admit_padded(padded, max_new, aux)
+                        cb_replica.admit_padded(padded, max_new, aux,
+                                                pages=pages)
                 elif op == OP_CB_CHUNK:
                     # aux carries the STATIC has_sampling flag: the
                     # replayed program must be the same one process 0

@@ -520,7 +520,9 @@ def test_stale_matrix_against_committed_trail():
     # renamed and its history silently orphaned. Once the watcher
     # captures them this set just shrinks (subset check still passes).
     queued = {"cnn --adafactor", "resnet50 --gn", "resnet50 --fused-bn",
-              "resnet50 --fused-bn3"}
+              "resnet50 --fused-bn3",
+              # round-5/6 additions awaiting their first chip window
+              "resnet50 --nf", "cb --paged"}
     assert missing <= queued, (
         f"matrix workloads with no trail entry: {sorted(missing - queued)}")
 
@@ -576,3 +578,65 @@ def test_trail_report_renders_dict_disclosures():
     out = trail_report.row(e)
     assert '"chunk64_depth1":1700.1' in out
     assert out.count("|") == 6  # 5 columns + borders: grid stayed one cell
+
+
+def test_outage_and_summary_lines_fit_tail_window(monkeypatch, tmp_path):
+    """BENCH_r05 recorded parsed:null because the final stdout JSON was
+    cut by the driver's ``tail -c 2000`` window. Guard the PR-1 fix:
+    with a WORST-CASE trail (every matrix workload recorded, long
+    details, stale summary attached), both outage line shapes — the
+    probe-failure error JSON and the gated bench-all summary — must
+    individually fit inside 2000 bytes and parse after an actual tail
+    cut."""
+    hist = tmp_path / "hist.jsonl"
+    # one plausible-size entry per matrix workload, fat result payloads
+    entries = []
+    for i, argv in enumerate(bench.ALL_WORKLOADS):
+        entries.append(json.dumps({
+            "ts": f"2026-08-0{(i % 7) + 1}T12:00:00+00:00",
+            "argv": list(argv),
+            "host_load_1m": 1.23,
+            "result": {"metric": f"{argv[0]}_bench_metric_name",
+                       "value": 12345.678, "unit": "examples/sec/chip",
+                       "filler": "x" * 1500}}))
+    hist.write_text("\n".join(entries) + "\n")
+    monkeypatch.setattr(bench, "HISTORY_PATH", str(hist))
+
+    def tail_parse(line):
+        # exactly what the driver does: tail -c 2000 of stdout, then
+        # parse the last line
+        blob = ("padding that fills the window\n" * 50) + line + "\n"
+        tail = blob[-2000:]
+        last = [ln for ln in tail.splitlines() if ln.strip()][-1]
+        return json.loads(last)
+
+    # probe-failure outage line with the full stale-matrix attachment
+    err_line = json.dumps(bench._error_json(
+        ["cnn"], "probe", "backend attach failed: " + "e" * 5000,
+        stale_matrix=True, rc=17))
+    assert len(err_line) + 1 <= 2000, \
+        f"outage line is {len(err_line)}B — exceeds the tail window"
+    parsed = tail_parse(err_line)
+    assert parsed["error"]["rc"] == 17
+    assert parsed["stale_matrix_summary"]["workloads"] == len(
+        bench.ALL_WORKLOADS)
+
+    # gated bench-all summary line (orchestrate_all, backend down)
+    summary = {"metric": "bench_all", "value": 0,
+               "unit": "workloads_measured", "vs_baseline": None,
+               "total": len(bench.ALL_WORKLOADS),
+               "failures": len(bench.ALL_WORKLOADS),
+               "stale_matrix_summary": bench._stale_summary(),
+               "gate_reason": ("g" * 300)}
+    sum_line = json.dumps(summary)
+    assert len(sum_line) + 1 <= 2000, \
+        f"summary line is {len(sum_line)}B — exceeds the tail window"
+    assert tail_parse(sum_line)["metric"] == "bench_all"
+
+
+def test_paged_flag_guard():
+    # --paged off the cb workload must be rejected, not silently
+    # ignored (argv IS the trail identity)
+    with pytest.raises(SystemExit, match="cb workload only"):
+        bench.run_bench(["cnn", "--paged"])
+    assert ["cb", "--paged"] in [list(w) for w in bench.ALL_WORKLOADS]

@@ -642,9 +642,9 @@ def _spy_batch_admits(eng):
     calls = []
     orig = eng._device.admit_padded_batch
 
-    def spy(padded, lens, slots, samplings):
+    def spy(padded, lens, slots, samplings, pages=None):
         calls.append((padded.shape, list(slots)))
-        return orig(padded, lens, slots, samplings)
+        return orig(padded, lens, slots, samplings, pages=pages)
 
     eng._device.admit_padded_batch = spy
     return calls
@@ -751,3 +751,163 @@ def test_adaptive_chunk_eos_unpipelined_parity():
     assert results[rid] == _reference_tokens(model, params, prompt, 12,
                                              eos=eos)
     assert results[rid][-1] == eos
+
+
+# ---- paged KV cache ---------------------------------------------------------
+#
+# Same oracle as everything above: the PAGED engine (global page pool,
+# block tables, ragged paged-attention reads, engine-managed page
+# alloc/free) must produce exactly the tokens the dense one-request
+# generate() produces — under slot reuse, pool contention, sampling
+# lanes and decode-ahead alike.
+
+
+def _paged_model(pos="rope", kv_quant=False, page_size=16, num_pages=24):
+    """A dense tiny model plus its PAGED twin sharing the same params
+    (the config only shapes the cache, never the weights)."""
+    import dataclasses
+
+    model, params = _tiny_model(pos=pos, kv_quant=kv_quant)
+    paged = CausalLM(dataclasses.replace(
+        model.cfg, kv_page_size=page_size, kv_num_pages=num_pages))
+    return model, paged, params
+
+
+@pytest.mark.slow  # heavy compile set; tier-1 keeps the fast paged subset
+def test_paged_staggered_requests_match_generate_each():
+    model, paged, params = _paged_model()
+    rng = np.random.default_rng(30)
+    specs = [(rng.integers(1, 97, int(n)), int(m))
+             for n, m in [(5, 12), (19, 3), (33, 8), (7, 15), (11, 5)]]
+    eng = ContinuousEngine(paged, params, num_slots=2, chunk=3,
+                           buckets=(16, 32, 64))
+    rids = {eng.submit(p, max_new_tokens=m): (p, m) for p, m in specs}
+    results = dict(eng.run_until_drained())
+    for rid, (p, m) in rids.items():
+        assert results[rid] == _reference_tokens(model, params, p, m), \
+            f"paged request {rid} diverged from solo generate"
+    st = eng.stats["paged"]
+    assert st["pages_in_use"] == 0          # everything returned
+    assert st["peak_pages_in_use"] > 0
+
+
+@pytest.mark.slow  # heavy compile set; tier-1 keeps the fast paged subset
+def test_paged_learned_positions_and_int8_kv():
+    for pos, quant in (("learned", False), ("rope", True)):
+        model, paged, params = _paged_model(pos=pos, kv_quant=quant)
+        rng = np.random.default_rng(31)
+        prompt = rng.integers(1, 97, 10)
+        eng = ContinuousEngine(paged, params, num_slots=2, chunk=4,
+                               buckets=(16,))
+        rid = eng.submit(prompt, max_new_tokens=8)
+        results = dict(eng.run_until_drained())
+        assert results[rid] == _reference_tokens(model, params, prompt, 8)
+
+
+@pytest.mark.slow  # heavy compile set; tier-1 keeps the fast paged subset
+def test_paged_pool_exhaustion_queues_and_recovers():
+    # Pool of 4 pages, each request needs 2 (prompt 10 + budget 20 >
+    # one 16-token page): only two requests can hold pages at once, so
+    # the rest must STAY QUEUED (no crash, no recompile, counter
+    # increments) and admit as frees return pages — finishing with
+    # exact parity.
+    model, paged, params = _paged_model(page_size=16, num_pages=4)
+    rng = np.random.default_rng(32)
+    eng = ContinuousEngine(paged, params, num_slots=4, chunk=3,
+                           buckets=(16, 32), batch_admit=False)
+    specs = [(rng.integers(1, 97, 10), 20) for _ in range(4)]
+    rids = {eng.submit(p, max_new_tokens=m): (p, m) for p, m in specs}
+    results = dict(eng.run_until_drained())
+    for rid, (p, m) in rids.items():
+        assert results[rid] == _reference_tokens(model, params, p, m)
+    st = eng.stats["paged"]
+    assert st["page_alloc_failures"] > 0    # the pool did run dry
+    assert st["pages_in_use"] == 0
+    assert st["peak_pages_in_use"] <= 4
+
+
+def test_paged_oversized_request_rejected_at_submit():
+    # A request no amount of freeing could ever admit must fail fast at
+    # submit (queueing it would livelock the drain loop).
+    _, paged, params = _paged_model(page_size=16, num_pages=4)
+    eng = ContinuousEngine(paged, params, num_slots=2, chunk=2,
+                           buckets=(16,))
+    with pytest.raises(ValueError, match="KV pages"):
+        eng.submit(np.arange(1, 11, dtype=np.int32), max_new_tokens=110)
+
+
+@pytest.mark.slow  # heavy compile set; tier-1 keeps the fast paged subset
+def test_paged_batch_admission_and_decode_ahead_parity():
+    model, paged, params = _paged_model(num_pages=32)
+    rng = np.random.default_rng(33)
+    specs = [(rng.integers(1, 97, int(n)), int(m))
+             for n, m in [(5, 7), (9, 5), (12, 9), (7, 4), (15, 6)]]
+    eng = ContinuousEngine(paged, params, num_slots=4, chunk=3,
+                           buckets=(16, 32), pipeline_depth=1,
+                           batch_admit=True)
+    rids = {eng.submit(p, max_new_tokens=m): (p, m) for p, m in specs}
+    results = dict(eng.run_until_drained())
+    for rid, (p, m) in rids.items():
+        assert results[rid] == _reference_tokens(model, params, p, m)
+    assert eng.stats["batch_admits"] >= 2   # the batched path ran paged
+
+
+@pytest.mark.slow  # heavy compile set; tier-1 keeps the fast paged subset
+def test_paged_cancel_releases_pages():
+    _, paged, params = _paged_model(num_pages=32)
+    rng = np.random.default_rng(34)
+    eng = ContinuousEngine(paged, params, num_slots=2, chunk=2,
+                           buckets=(16,))
+    rid = eng.submit(rng.integers(1, 97, 8), max_new_tokens=20)
+    eng.step()
+    assert eng.stats["paged"]["pages_in_use"] > 0
+    assert eng.cancel(rid)
+    assert eng.stats["paged"]["pages_in_use"] == 0
+
+
+def test_paged_gates_dense_only_features():
+    _, paged, params = _paged_model()
+    for kw in (dict(prefix_cache_size=2), dict(prefill_chunk=32)):
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousEngine(paged, params, num_slots=2, chunk=2, **kw)
+    # buckets that aren't page-aligned are filtered; none left -> raise
+    with pytest.raises(ValueError, match="multiple of kv_page_size"):
+        ContinuousEngine(paged, params, num_slots=2, chunk=2,
+                         buckets=(24,))
+
+
+def test_paged_obs_gauges_track_pool():
+    from pyspark_tf_gke_tpu.obs.metrics import MetricsRegistry, platform_families
+
+    _, paged, params = _paged_model(page_size=16, num_pages=24)
+    reg = MetricsRegistry()
+    fam = platform_families(reg)
+    eng = ContinuousEngine(paged, params, num_slots=2, chunk=4,
+                           buckets=(16,), obs=fam)
+    assert fam["serve_kv_pages_total"].value == 24
+    rng = np.random.default_rng(35)
+    rid = eng.submit(rng.integers(1, 97, 10), max_new_tokens=8)
+    eng.step()
+    in_use = fam["serve_kv_pages_in_use"].value
+    assert in_use > 0
+    # bytes gauge = pages x page bytes, NOT slots x max_len
+    assert fam["serve_kv_cache_bytes_per_layer"].value == (
+        in_use * eng._page_bytes_per_layer)
+    list(eng.run_until_drained())
+    assert fam["serve_kv_pages_in_use"].value == 0
+    assert fam["serve_kv_cache_bytes_per_layer"].value == 0
+    assert rid is not None
+
+
+def test_paged_announce_single_process_parity():
+    # announce mode broadcasts the page allocation on the admit op;
+    # on one process the wire is trivial but the full (announce +
+    # pages payload + device) path executes.
+    model, paged, params = _paged_model()
+    rng = np.random.default_rng(36)
+    prompt = rng.integers(1, 97, 9)
+    eng = ContinuousEngine(paged, params, num_slots=2, chunk=3,
+                           buckets=(16,), announce=True)
+    rid = eng.submit(prompt, max_new_tokens=6)
+    results = dict(eng.run_until_drained())
+    assert results[rid] == _reference_tokens(model, params, prompt, 6)

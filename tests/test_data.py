@@ -247,3 +247,81 @@ def test_parallel_decode_bit_identical_to_serial(tmp_path):
     serial = np.stack([load_image(p, 16, 20) for p in fp])
     parallel, _ = make_image_arrays(d, (16, 20))
     np.testing.assert_array_equal(serial, parallel)
+
+
+def test_batch_iterator_fast_forward_no_drop_remainder():
+    # ceil steps_per_epoch: the partial final batch counts as a step,
+    # and fast_forward must land on the identical mid/cross-epoch state
+    # (same rows, same partial-batch boundary) as consuming k batches.
+    x = np.arange(13)
+    base = BatchIterator({"x": x}, batch_size=5, seed=9,
+                         drop_remainder=False)
+    assert base.steps_per_epoch == 3  # 5 + 5 + 3
+    seq = [next(base)["x"] for _ in range(8)]
+    for k in range(8):
+        ffwd = BatchIterator({"x": x}, batch_size=5, seed=9,
+                             drop_remainder=False).fast_forward(k)
+        got = [next(ffwd)["x"] for _ in range(8 - k)]
+        for a, b in zip(got, seq[k:]):
+            assert (a == b).all(), f"divergence after fast_forward({k})"
+
+
+def test_prefetch_worker_joins_on_close(mesh_dp):
+    # Closing the consumer generator mid-stream must JOIN the worker
+    # thread (not just signal it): a caller may hand the same source
+    # iterator to a new prefetcher, and two threads on one generator is
+    # undefined.
+    import threading
+
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding
+    from pyspark_tf_gke_tpu.data.pipeline import prefetch_to_device
+
+    def source():
+        for i in range(100):
+            yield {"x": np.full((8, 2), i, dtype=np.float32)}
+
+    it = prefetch_to_device(source(), batch_sharding(mesh_dp), size=2)
+    next(it)
+    assert any(t.name == "device-prefetch" and t.is_alive()
+               for t in threading.enumerate())
+    it.close()
+    assert not any(t.name == "device-prefetch" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_prefetch_relays_exception_and_joins(mesh_dp):
+    # The relay and the join compose: after the source's exception
+    # surfaces at the consumer, no worker thread lingers.
+    import threading
+
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding
+    from pyspark_tf_gke_tpu.data.pipeline import prefetch_to_device
+
+    def bad():
+        yield {"x": np.zeros((8, 2), dtype=np.float32)}
+        raise RuntimeError("source died")
+
+    it = prefetch_to_device(bad(), batch_sharding(mesh_dp), size=2)
+    next(it)
+    with pytest.raises(RuntimeError, match="source died"):
+        list(it)
+    assert not any(t.name == "device-prefetch" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_prefetch_exports_queue_depth_gauge(mesh_dp):
+    # The obs gauge distinguishes input-starved steps (depth 0 at the
+    # fetch) from device-bound ones (queue full); here we only assert
+    # the plumbing: the gauge exists and was touched by a prefetch run.
+    from pyspark_tf_gke_tpu.obs.metrics import get_registry
+    from pyspark_tf_gke_tpu.parallel.mesh import batch_sharding
+    from pyspark_tf_gke_tpu.data.pipeline import prefetch_to_device
+
+    batches = [{"x": np.full((8, 2), i, dtype=np.float32)}
+               for i in range(4)]
+    out = list(prefetch_to_device(iter(batches), batch_sharding(mesh_dp),
+                                  size=2))
+    assert len(out) == 4
+    gauge = get_registry().get("data_prefetch_queue_depth")
+    assert gauge is not None
+    assert gauge.value == 0  # drained stream ends with an empty queue

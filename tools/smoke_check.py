@@ -11,7 +11,12 @@ registered with two different shapes (type or label set) anywhere in
 the process fails the check — a duplicate-name metric would make one
 ``/metrics`` scrape silently ambiguous.
 
-Usage: python tools/smoke_check.py [--lint-only]
+``--kernels-only`` runs the interpret-mode kernel sweep instead: every
+``ops/pallas/*`` kernel executes (interpret=True, tiny shapes) against
+its pure-JAX reference, so kernel/reference drift fails fast on a CPU
+box long before a TPU ever compiles it.
+
+Usage: python tools/smoke_check.py [--lint-only|--kernels-only]
 """
 
 import os
@@ -99,8 +104,128 @@ def lint_duplicate_metrics() -> int:
     return 0
 
 
+def kernel_interpret_sweep() -> int:
+    """Run every ``ops/pallas`` kernel in interpret mode on tiny shapes
+    and compare against its pure-JAX reference. One tolerance for all:
+    these run in f32, so 1e-4 absolute catches real drift (a changed
+    mask, a dropped scale) without flaking on accumulation-order ulps.
+    Returns the number of failing kernels."""
+    import jax.numpy as jnp
+
+    from pyspark_tf_gke_tpu.utils.seeding import np_rng
+
+    rng = np_rng(0)
+    failures = []
+
+    def check(name, got, want, atol=1e-4):
+        got, want = np.asarray(got), np.asarray(want)
+        err = float(np.max(np.abs(got - want))) if got.size else 0.0
+        ok = got.shape == want.shape and err <= atol
+        print(f"kernel {name}: max|err| = {err:.2e} "
+              f"({'OK' if ok else 'FAIL'})")
+        if not ok:
+            failures.append(name)
+
+    # flash attention (fwd, causal + padding mask) vs the dense path
+    from pyspark_tf_gke_tpu.ops.attention import dot_product_attention
+    from pyspark_tf_gke_tpu.ops.pallas.flash_attention import flash_attention
+
+    b, s, h, d = 2, 16, 2, 8
+    q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+               for _ in range(3))
+    mask = jnp.asarray(rng.integers(0, 2, (b, s)).astype(bool))
+    mask = mask.at[:, 0].set(True)  # >= 1 live key per row
+    check("flash_attention[causal]",
+          flash_attention(q, k, v, causal=True, interpret=True),
+          dot_product_attention(q, k, v, causal=True))
+    check("flash_attention[kv_mask]",
+          flash_attention(q, k, v, kv_mask=mask, interpret=True),
+          dot_product_attention(q, k, v,
+                                mask=mask[:, None, None, :]))
+
+    # fused layernorm vs the textbook f32 math
+    from pyspark_tf_gke_tpu.ops.pallas.layernorm import fused_layernorm
+
+    x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    scale = jnp.asarray(rng.standard_normal(16), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal(16), jnp.float32)
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    check("fused_layernorm",
+          fused_layernorm(x, scale, bias, eps=1e-6, interpret=True),
+          (x - mu) / jnp.sqrt(var + 1e-6) * scale + bias)
+
+    # fused norm+relu matmul (+stats epilogue) vs jnp
+    from pyspark_tf_gke_tpu.ops.pallas.fused_matmul import norm_relu_matmul
+
+    xm = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    wm = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+    am = jnp.asarray(rng.standard_normal(16), jnp.float32)
+    bm = jnp.asarray(rng.standard_normal(16), jnp.float32)
+    y, ssum, ssq = norm_relu_matmul(xm, wm, am, bm, want_stats=True,
+                                    interpret=True)
+    y_ref = jnp.maximum(xm * am + bm, 0.0) @ wm
+    check("norm_relu_matmul", y, y_ref)
+    check("norm_relu_matmul[stats]",
+          jnp.stack([ssum, ssq]),
+          jnp.stack([y_ref.sum(0), (y_ref * y_ref).sum(0)]))
+
+    # fused 3x3 conv vs lax.conv
+    from pyspark_tf_gke_tpu.ops.pallas.fused_conv3 import conv3_norm_stats
+
+    xc = jnp.asarray(rng.standard_normal((1, 6, 6, 4)), jnp.float32)
+    wc = jnp.asarray(rng.standard_normal((3, 3, 4, 4)) * 0.2, jnp.float32)
+    ac = jnp.asarray(rng.standard_normal(4), jnp.float32)
+    bc = jnp.asarray(rng.standard_normal(4), jnp.float32)
+    ref_in = jnp.maximum(xc * ac + bc, 0.0)
+    conv_ref = jax.lax.conv_general_dilated(
+        ref_in, wc, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    check("conv3_norm_stats",
+          conv3_norm_stats(xc, wc, ac, bc, interpret=True), conv_ref)
+
+    # paged attention (block-table gather, ragged fills, int8 pages)
+    from pyspark_tf_gke_tpu.ops.pallas.paged_attention import (
+        paged_attention,
+        paged_attention_reference,
+    )
+
+    n_pg, p_sz, hkv, mp = 8, 4, 2, 3
+    kp, vp = (jnp.asarray(rng.standard_normal((n_pg, p_sz, hkv, d)),
+                          jnp.float32) for _ in range(2))
+    qp = jnp.asarray(rng.standard_normal((3, h * 2, d)), jnp.float32)
+    table = jnp.asarray(rng.integers(0, n_pg, (3, mp)), jnp.int32)
+    table = table.at[1, 1:].set(n_pg)  # sentinel (unallocated) entries
+    fills = jnp.asarray([mp * p_sz, 3, 0], jnp.int32)  # full/partial/empty
+    check("paged_attention",
+          paged_attention(qp, kp, vp, table, fills, interpret=True),
+          paged_attention_reference(qp, kp, vp, table, fills))
+    kq = jnp.asarray(rng.integers(-127, 128, (n_pg, p_sz, hkv, d)),
+                     jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, (n_pg, p_sz, hkv, d)),
+                     jnp.int8)
+    ks = jnp.asarray(rng.random((n_pg, p_sz, hkv)) * 0.02 + 1e-3,
+                     jnp.float32)
+    vs = jnp.asarray(rng.random((n_pg, p_sz, hkv)) * 0.02 + 1e-3,
+                     jnp.float32)
+    check("paged_attention[int8]",
+          paged_attention(qp, kq, vq, table, fills, k_scales=ks,
+                          v_scales=vs, interpret=True),
+          paged_attention_reference(qp, kq, vq, table, fills,
+                                    k_scales=ks, v_scales=vs))
+
+    if failures:
+        print(f"kernel sweep FAILED: {failures}")
+        return 1
+    print("kernel sweep OK: every ops/pallas kernel matches its "
+          "pure-JAX reference in interpret mode")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    if "--kernels-only" in argv:
+        return kernel_interpret_sweep()
     if "--lint-only" not in argv:
         devices = jax.devices()
         print(f"devices: {devices}")
