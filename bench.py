@@ -695,7 +695,93 @@ def bench_decode(smoke: bool = False, kv_heads=None, int8: bool = False,
     }
 
 
-def bench_continuous(smoke: bool = False, paged: bool = False) -> dict:
+def _chaos_ab(model, params, slots: int, chunk: int, prompts, budgets,
+              chaos_spec: str) -> dict:
+    """Goodput + p99 A/B for ``cb --chaos``: the SAME concurrent
+    request mix against a clean serving front and one with faults
+    injected into its driver loop (``train/serve._ContinuousFront`` +
+    ``resilience.FaultInjector.from_chaos_spec``). Failed requests
+    (those killed by an engine rebuild) are excluded from goodput but
+    INCLUDED in the latency population — a client that waited and then
+    got a 500 still waited. The rebuild counter is read off a private
+    registry so the A and B runs can't contaminate each other."""
+    import threading as _threading
+
+    from pyspark_tf_gke_tpu.obs.metrics import (MetricsRegistry,
+                                                platform_families)
+    from pyspark_tf_gke_tpu.train.resilience import FaultInjector
+    from pyspark_tf_gke_tpu.train.serve import _ContinuousFront
+
+    def run(spec: str) -> dict:
+        reg = MetricsRegistry()
+        fam = platform_families(reg)
+        chaos = FaultInjector.from_chaos_spec(spec) if spec else None
+        front = _ContinuousFront(model, params, eos_id=None,
+                                 num_slots=slots, chunk=chunk,
+                                 obs=fam, chaos=chaos)
+        lock = _threading.Lock()
+        lat_ms, ok_tokens, failures = [], [0], [0]
+        t0 = time.perf_counter()
+
+        def client(i: int) -> None:
+            p = prompts[i % len(prompts)]
+            b = int(budgets[i % len(budgets)])
+            t = time.perf_counter()
+            try:
+                toks = front.submit_and_wait(p, b, timeout_s=600)
+                with lock:
+                    ok_tokens[0] += len(toks)
+                    lat_ms.append((time.perf_counter() - t) * 1000.0)
+            except Exception:  # noqa: BLE001 — failure IS the datum
+                with lock:
+                    failures[0] += 1
+                    lat_ms.append((time.perf_counter() - t) * 1000.0)
+
+        threads = [_threading.Thread(target=client, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=900)
+        wall = time.perf_counter() - t0
+        front.shutdown()
+        lat_ms.sort()
+        p99 = (lat_ms[min(len(lat_ms) - 1, int(0.99 * len(lat_ms)))]
+               if lat_ms else 0.0)
+        return {
+            "goodput_tokens_per_sec": round(ok_tokens[0] / wall, 1),
+            "p99_latency_ms": round(p99, 1),
+            "ok_requests": len(lat_ms) - failures[0],
+            "failed_requests": failures[0],
+            "engine_rebuilds": int(
+                fam["serve_engine_rebuilds_total"].value),
+            "faults_fired": chaos.fired_faults if chaos else 0,
+        }
+
+    # warmup outside both timed runs: the front's jit programs are
+    # module-level, so one tiny drained pass compiles for A and B alike
+    warm = _ContinuousFront(model, params, eos_id=None, num_slots=slots,
+                            chunk=chunk,
+                            obs=platform_families(MetricsRegistry()))
+    warm.submit_and_wait(prompts[0], 2, timeout_s=600)
+    warm.shutdown()
+    clean = run("")
+    faulted = run(chaos_spec)
+    return {
+        "spec": chaos_spec,
+        "clean": clean,
+        "faulted": faulted,
+        "goodput_ratio": round(
+            faulted["goodput_tokens_per_sec"]
+            / max(clean["goodput_tokens_per_sec"], 1e-9), 3),
+        "p99_ratio": round(
+            faulted["p99_latency_ms"]
+            / max(clean["p99_latency_ms"], 1e-9), 3),
+    }
+
+
+def bench_continuous(smoke: bool = False, paged: bool = False,
+                     chaos: bool = False) -> dict:
     """Continuous batching vs whole-batch serving on the SAME request
     set (train/continuous.py). The workload that separates them is
     budget variance: a whole-batch server runs every group for its
@@ -954,6 +1040,18 @@ def bench_continuous(smoke: bool = False, paged: bool = False) -> dict:
     first_token_ms(warm_eng)  # compile the extension program
     warm_ms = first_token_ms(warm_eng)
 
+    # -- --chaos: goodput/p99 under injected engine faults vs clean.
+    # The fault steps are DRIVER-LOOP iterations (so the count scales
+    # with load, not wall time); the A/B answers "what does one engine
+    # rebuild cost the fleet" in the two units that matter — surviving
+    # tokens/sec and tail latency.
+    chaos_ab = None
+    if chaos:
+        spec = ("fail@4,slow@8:0.05" if smoke
+                else "fail@40,fail@120,slow@80:0.25")
+        chaos_ab = _chaos_ab(eng_model, params, slots, chunk,
+                             prompts, budgets, spec)
+
     return {
         "metric": "continuous_batching_tokens_per_sec_per_chip",
         "value": round(eng_tps, 1),
@@ -1005,6 +1103,7 @@ def bench_continuous(smoke: bool = False, paged: bool = False) -> dict:
         "tuning_grid": tried,  # every config measured for the headline
         **({"high_variance": high_variance}
            if high_variance is not None else {}),
+        **({"chaos": chaos_ab} if chaos_ab is not None else {}),
         "dispatch_rtt_ms": round(rtt_ms, 2),
         "prefix_study": {
             "prefix_len": plen, "suffix_len": slen,
@@ -1383,6 +1482,9 @@ ALL_WORKLOADS = (
     # paged KV cache A/B: same slot count, engine on the page pool +
     # ragged paged_attention decode; cache bytes tracked by pages in use
     ["cb", "--paged"],
+    # chaos A/B: goodput + p99 with faults injected into the serving
+    # driver loop vs clean — what one engine rebuild costs the endpoint
+    ["cb", "--chaos"],
     ["spec"],  # device-loop tok/s + the 0.75-skew fixture's acceptance
     ["generate", "--beams", "4"],  # broadcast-select reorder rebuild A/B
     # --- measured re-confirmations ---
@@ -1599,6 +1701,8 @@ def run_bench(argv) -> dict:
         raise SystemExit("--adafactor applies to the cnn workload only")
     if "--paged" in argv and workload != "cb":
         raise SystemExit("--paged applies to the cb workload only")
+    if "--chaos" in argv and workload != "cb":
+        raise SystemExit("--chaos applies to the cb workload only")
     if "--s2d" in argv and workload != "resnet50":
         raise SystemExit("--s2d applies to the resnet50 workload only")
     if "--gn" in argv and workload != "resnet50":
@@ -1636,7 +1740,8 @@ def run_bench(argv) -> dict:
     if workload == "io":
         return bench_io(smoke=smoke)
     if workload == "cb":
-        return bench_continuous(smoke=smoke, paged="--paged" in argv)
+        return bench_continuous(smoke=smoke, paged="--paged" in argv,
+                                chaos="--chaos" in argv)
     if workload == "spec":
         gamma = 4
         if "--gamma" in argv:

@@ -481,6 +481,26 @@ def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
         "serve_generate_latency_ms": r.histogram(
             "serve_generate_latency_ms",
             "Generate request latency (per HTTP request)"),
+        # overload / lifecycle (bounded admission, deadlines, drain)
+        "serve_requests_rejected_total": r.counter(
+            "serve_requests_rejected_total",
+            "Requests shed before any device work",
+            labelnames=("reason",)),  # queue_full | deadline | draining
+        "serve_request_deadline_exceeded_total": r.counter(
+            "serve_request_deadline_exceeded_total",
+            "Requests whose client-supplied deadline passed (expired in "
+            "queue or cancelled in-slot at a chunk boundary)"),
+        "serve_queue_depth": r.gauge(
+            "serve_queue_depth",
+            "Requests waiting for a KV slot (admission queue)"),
+        "serve_draining": r.gauge(
+            "serve_draining",
+            "1 while the server is draining (SIGTERM received; new "
+            "requests get 503)"),
+        "retries_total": r.counter(
+            "retries_total",
+            "Transient-failure retries fired by retry_with_backoff",
+            labelnames=("op",)),
         # continuous-batching slot engine
         "serve_slots_total": r.gauge(
             "serve_slots_total", "KV slots in the engine pool"),

@@ -61,8 +61,22 @@ class CheckpointManager:
                           json.dumps(history))
 
     def save(self, state: Any, history: Optional[Dict] = None, force: bool = False) -> None:
+        from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
+
         step = int(jax.device_get(state.step))
-        self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
+        # transient storage faults (GCS 5xx, NFS hiccups) retry with
+        # backoff before escalating to the restart-with-resume path;
+        # retries force-overwrite — the failed attempt may have left a
+        # partially written step directory behind
+        attempt_force = {"force": force}
+
+        def _save():
+            force_now = attempt_force["force"]
+            attempt_force["force"] = True
+            self._mgr.save(step, args=ocp.args.StandardSave(state),
+                           force=force_now)
+
+        retry_with_backoff(_save, op="checkpoint_save")
         if self.async_save:
             # orbax joins the PRIOR in-flight save before starting this
             # one, so the previously deferred history is durable now.
@@ -123,7 +137,14 @@ class CheckpointManager:
             if hasattr(x, "sharding") else x,
             state_like,
         )
-        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
+
+        # a pure read — safe to retry as-is on transient storage faults;
+        # a checkpoint that simply isn't there is permanent, fail fast
+        restored = retry_with_backoff(
+            lambda: self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract)),
+            op="checkpoint_restore", give_up_on=(FileNotFoundError,))
         logger.info("Restored checkpoint step %d from %s", step, self.directory)
         return restored
 

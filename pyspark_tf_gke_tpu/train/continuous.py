@@ -46,6 +46,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import itertools
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence
 
@@ -100,6 +101,11 @@ class _Request:
     temperature: float = 0.0
     top_p: Optional[float] = None
     seed: int = 0
+    # absolute time.monotonic() deadline (None = no deadline); past it
+    # the request is expired at the next chunk boundary — queued ones
+    # never admit, in-slot ones free their KV slot immediately
+    deadline: Optional[float] = None
+    expired: bool = False
 
 
 def _prefill_padded(model: CausalLM, params, padded_ids, true_len):
@@ -927,6 +933,7 @@ class ContinuousEngine:
         self._admitting: Optional[dict] = None
         self._n_finished = 0  # counter, not a list: a
         # long-lived server must not retain every prompt it ever served
+        self._n_deadline_expired = 0
         self._device = SlotDeviceState(model, params, num_slots, mesh)
         # shared metrics plane: slot occupancy + useful-token counters
         # (the cb bench's useful_tokens/sec, now scrapable live). One
@@ -942,9 +949,12 @@ class ContinuousEngine:
     # -- submission ------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
                on_tokens=None, temperature: float = 0.0,
-               top_p: Optional[float] = None, seed: int = 0) -> int:
+               top_p: Optional[float] = None, seed: int = 0,
+               deadline_s: Optional[float] = None) -> int:
         if temperature and temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         if top_p is not None and not 0 < top_p <= 1:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
@@ -969,7 +979,9 @@ class ContinuousEngine:
                     f"{self.model.cfg.kv_page_size})")
         req = _Request(next(self._rid), prompt, max_new_tokens,
                        on_tokens=on_tokens, temperature=float(temperature),
-                       top_p=top_p, seed=int(seed))
+                       top_p=top_p, seed=int(seed),
+                       deadline=(time.monotonic() + float(deadline_s)
+                                 if deadline_s is not None else None))
         if self.schedule == "longest":
             # insertion point keeps the queue budget-descending; ties
             # stay FIFO (stable insert after equal budgets)
@@ -1335,6 +1347,64 @@ class ContinuousEngine:
         del self._queue[:k]
         self._n_batch_admits += k
 
+    def _expire_deadlines(self) -> List[_Request]:
+        """Chunk-boundary deadline enforcement: queued requests past
+        their deadline never admit (a dead client must not win a KV
+        slot over a live one), in-slot ones are cancelled so the slot
+        frees NOW instead of after a budget of decode nobody will read,
+        and a mid-admission (chunked-prefill) request drops its partial
+        tree. Returns the expired requests, marked ``expired``/``done``
+        — ``step`` folds them into its finished list so drivers collect
+        them like completions and can tell the two apart by the flag."""
+        now = time.monotonic()
+        expired: List[_Request] = []
+        queued_expired = 0
+        keep = []
+        for req in self._queue:
+            if req.deadline is not None and now > req.deadline:
+                expired.append(req)
+                queued_expired += 1
+            else:
+                keep.append(req)
+        if expired:
+            self._queue[:] = keep
+        for slot, req in list(self._slots.items()):
+            if req.deadline is not None and now > req.deadline:
+                req.done = True  # decode-ahead snapshots skip it
+                del self._slots[slot]
+                self._free_slot(slot)
+                expired.append(req)
+        if (self._admitting is not None
+                and self._admitting["req"].deadline is not None
+                and now > self._admitting["req"].deadline):
+            # partial cache tree dropped; the reserved slot was never
+            # inserted, so nothing to free on device
+            expired.append(self._admitting["req"])
+            self._admitting = None
+        for req in expired:
+            req.expired = True
+            req.done = True
+        if expired:
+            self._n_deadline_expired += len(expired)
+            self._obs["serve_request_deadline_exceeded_total"].inc(
+                len(expired))
+            if queued_expired:
+                # expired before ANY device work — load-shedding taxonomy
+                self._obs["serve_requests_rejected_total"].labels(
+                    reason="deadline").inc(queued_expired)
+        return expired
+
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot (admission queue length)."""
+        return len(self._queue)
+
+    def queued_tokens(self) -> int:
+        """Token footprint of the admission queue: prompt + budget per
+        queued request (the bound ``max_queued_tokens`` shedding uses —
+        an upper bound on the KV the queue will claim)."""
+        return sum(int(r.prompt.size) + int(r.max_new_tokens)
+                   for r in self._queue)
+
     def _admit_waiting(self) -> None:
         reserved = (self._admitting["slot"]
                     if self._admitting is not None else None)
@@ -1466,6 +1536,7 @@ class ContinuousEngine:
         if useful_tokens:
             self._obs["serve_useful_tokens_total"].inc(useful_tokens)
         self._obs["serve_slots_active"].set(len(self._slots))
+        self._obs["serve_queue_depth"].set(len(self._queue))
         return newly_done
 
     def step(self) -> List[_Request]:
@@ -1476,13 +1547,14 @@ class ContinuousEngine:
         the dispatch: the chunk launched this call is read back N calls
         later, so the device works ahead while the host waits on older
         tokens."""
+        expired = self._expire_deadlines()
         if self._admitting is not None:
             self._advance_admission()
         self._admit_waiting()
         if not self.pipeline_depth:
             if not self._slots:
-                return []
-            return self._collect(
+                return expired
+            return expired + self._collect(
                 self._dispatch_chunk(self._effective_chunk()
                                      or self.chunk))
         dispatched = False
@@ -1491,7 +1563,7 @@ class ContinuousEngine:
             if size:  # 0 = every slot's budget is already in flight
                 self._inflight_q.append(self._dispatch_chunk(size))
                 dispatched = True
-        finished = []
+        finished = list(expired)
         # Drain down to the target depth. With live slots, exactly one
         # collect runs per step (the break below) — the per-step
         # announce-op cadence stays dispatch+collect. With all slots
@@ -1519,8 +1591,10 @@ class ContinuousEngine:
     def stats(self) -> dict:
         return {
             "queued": len(self._queue),
+            "queued_tokens": self.queued_tokens(),
             "active": len(self._slots),
             "finished": self._n_finished,
+            "deadline_expired": self._n_deadline_expired,
             "num_slots": self.num_slots,
             "chunk": self.chunk,
             "batch_admits": self._n_batch_admits,

@@ -13,20 +13,30 @@ is the required upgrade, trainer-level and infra-consumable:
   ``mysqladmin ping`` probe), and :meth:`Heartbeat.is_stalled` gives the
   same check programmatically for a watchdog.
 * :class:`FaultInjector` — deterministic chaos hook: raise at chosen
-  global steps, so the recovery path is *tested*, not assumed.
+  global steps, so the recovery path is *tested*, not assumed. The
+  serve-side extension (``from_chaos_spec``) adds SLOW steps — a wedged
+  chunk is the other real device-loop failure shape — and injects into
+  the serving driver loop (``train/serve.py`` ``--chaos``).
 * :func:`run_with_recovery` — restart-with-resume wrapper: on failure,
   re-enter the training function with ``resume=True`` so it restores the
   latest orbax checkpoint (train/checkpoint.py) and continues. In-process
   retry covers single-host faults; multi-host pod failures restart the
   whole SPMD process via k8s, landing in the same resume path.
+* :func:`retry_with_backoff` — the shared transient-failure policy
+  (exponential backoff, jittered so replicas retrying the same storage
+  outage de-synchronize): checkpoint save/restore and serving-bundle
+  loads all ride this one helper, and every retry lands on the event
+  trail + the ``retries_total{op=...}`` counter.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
 import time
-from typing import Callable, Iterable, Optional, Sequence, TypeVar
+from typing import (Callable, Dict, Iterable, Mapping, Optional, Sequence,
+                    TypeVar)
 
 import jax
 
@@ -156,16 +166,58 @@ class FaultInjector:
     """Deterministic chaos: raise :class:`InjectedFault` when the step
     loop reaches any of ``fail_at_steps`` — once per step value, so the
     post-recovery pass (which replays the same global step after resume)
-    does not immediately re-fail."""
+    does not immediately re-fail. ``slow_at_steps`` (step → seconds)
+    injects SLOW steps instead of failures — the wedged-device shape a
+    liveness probe must catch — each fired once as well."""
 
-    def __init__(self, fail_at_steps: Iterable[int]):
+    def __init__(self, fail_at_steps: Iterable[int] = (),
+                 slow_at_steps: Optional[Mapping[int, float]] = None):
         self.pending = set(int(s) for s in fail_at_steps)
+        self.slow_pending: Dict[int, float] = {
+            int(k): float(v) for k, v in (slow_at_steps or {}).items()}
+        # the injection plan, for post-run accounting (a chaos soak
+        # asserts rebuilds == faults that actually fired)
+        self.n_faults = len(self.pending)
+        self.n_slow = len(self.slow_pending)
 
     @classmethod
     def from_spec(cls, spec: str) -> Optional["FaultInjector"]:
         """Parse a "12,40" CLI/env spec; empty → None (no injection)."""
         steps = [int(s) for s in spec.split(",") if s.strip()]
         return cls(steps) if steps else None
+
+    @classmethod
+    def from_chaos_spec(cls, spec: str) -> Optional["FaultInjector"]:
+        """Parse the serve-side chaos spec: comma-separated tokens
+        ``fail@STEP`` (raise at driver step STEP) and
+        ``slow@STEP:SECONDS`` (sleep SECONDS at that step); a bare
+        integer is a failure (the training spec's shorthand). Empty →
+        None (no injection). ``SERVE_CHAOS="fail@10,slow@25:0.5"``
+        fails the 10th busy driver iteration and wedges the 25th."""
+        fails, slows = [], {}
+        for tok in spec.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if tok.startswith("slow@"):
+                where, _, dur = tok[len("slow@"):].partition(":")
+                if not where or not dur:
+                    raise ValueError(
+                        f"chaos token {tok!r}: slow takes "
+                        f"slow@STEP:SECONDS")
+                slows[int(where)] = float(dur)
+            elif tok.startswith("fail@"):
+                fails.append(int(tok[len("fail@"):]))
+            else:
+                fails.append(int(tok))
+        if not fails and not slows:
+            return None
+        return cls(fails, slows)
+
+    @property
+    def fired_faults(self) -> int:
+        """Failures injected so far (plan minus still-pending)."""
+        return self.n_faults - len(self.pending)
 
     def maybe_fail(self, step: int) -> None:
         if int(step) in self.pending:
@@ -174,6 +226,17 @@ class FaultInjector:
             # chaos run's injected faults and its retries correlate by seq
             get_event_log().emit("fault_injected", step=int(step))
             raise InjectedFault(f"injected fault at step {step}")
+
+    def maybe_slow(self, step: int) -> float:
+        """Sleep (once) if ``step`` is a planned slow step; returns the
+        injected delay in seconds (0.0 when none fired)."""
+        dur = self.slow_pending.pop(int(step), None)
+        if not dur:
+            return 0.0
+        get_event_log().emit("slow_step_injected", step=int(step),
+                             seconds=float(dur))
+        time.sleep(dur)
+        return float(dur)
 
 
 def _watch_main(argv=None) -> int:
@@ -248,6 +311,73 @@ def run_with_recovery(
                 error=f"{type(e).__name__}: {e}"[:500])
             if retry_delay_s:
                 time.sleep(retry_delay_s)
+
+
+def retry_with_backoff(
+    fn: Callable[[], T],
+    *,
+    attempts: int = 3,
+    base_delay_s: float = 0.1,
+    max_delay_s: float = 5.0,
+    jitter: float = 0.5,
+    retry_on: Sequence[type] = (Exception,),
+    give_up_on: Sequence[type] = (),
+    op: str = "operation",
+    sleep: Callable[[float], None] = time.sleep,
+    rng: Optional[random.Random] = None,
+) -> T:
+    """Call ``fn()`` with exponential backoff + jitter between attempts.
+
+    The shared transient-failure policy for side-effect-safe I/O
+    (checkpoint save/restore, bundle loads — all idempotent): retry
+    before escalating to the heavyweight recovery path, because a GCS
+    503 should cost milliseconds, not a job restart.
+
+    ``attempts`` counts CALLS (``attempts=3`` → up to 2 retries). The
+    delay before retry *k* is ``base_delay_s * 2**(k-1)`` capped at
+    ``max_delay_s``, with the top ``jitter`` fraction randomized
+    (``delay * (1-jitter) .. delay``) so N replicas retrying the same
+    storage outage de-synchronize instead of stampeding it in lockstep.
+    Exceptions not matching ``retry_on`` propagate immediately — and
+    ``KeyboardInterrupt``/``SystemExit`` always do (they are not
+    ``Exception`` subclasses). ``give_up_on`` carves deterministic,
+    permanent classes OUT of a broad ``retry_on`` (a mistyped path's
+    ``FileNotFoundError`` must fail fast, not masquerade as a storage
+    outage in the retry telemetry). Every retry emits a ``retry`` event
+    on the trail (with ``op``/attempt/delay/error) and increments
+    ``retries_total{op=...}``; ``sleep``/``rng`` are injectable for
+    tests.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    if not 0 <= jitter <= 1:
+        raise ValueError(f"jitter must be in [0, 1], got {jitter}")
+    r = rng if rng is not None else random
+    retry_on = tuple(retry_on)
+    give_up_on = tuple(give_up_on)
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except Exception as exc:  # noqa: BLE001 — filtered by retry_on
+            if (isinstance(exc, give_up_on)
+                    or not isinstance(exc, retry_on)
+                    or attempt >= attempts):
+                raise
+            delay = min(max_delay_s, base_delay_s * (2 ** (attempt - 1)))
+            delay = delay * (1.0 - jitter) + delay * jitter * r.random()
+            from pyspark_tf_gke_tpu.obs.metrics import platform_families
+
+            platform_families()["retries_total"].labels(op=op).inc()
+            get_event_log().emit(
+                "retry", op=op, attempt=attempt, max_attempts=attempts,
+                delay_s=round(delay, 4),
+                error=f"{type(exc).__name__}: {exc}"[:500])
+            logger.warning(
+                "%s failed (%s: %s); retrying in %.2fs (%d/%d)",
+                op, type(exc).__name__, exc, delay, attempt, attempts - 1)
+            if delay > 0:
+                sleep(delay)
+    raise AssertionError("unreachable")  # the loop returns or raises
 
 
 if __name__ == "__main__":

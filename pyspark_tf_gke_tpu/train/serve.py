@@ -73,6 +73,40 @@ def _bucket(n: int, cap: int) -> int:
     return min(-(-n // SCORE_BUCKET) * SCORE_BUCKET, cap)
 
 
+class RequestRejected(RuntimeError):
+    """Load-shed / drain rejection BEFORE any device work: maps to HTTP
+    429 (``queue_full``) or 503 (``draining``) with a ``Retry-After``
+    header — overload degrades to fast rejection, not collapse."""
+
+    def __init__(self, reason: str, message: str, status: int,
+                 retry_after_s: int = 1):
+        super().__init__(message)
+        self.reason = reason
+        self.status = int(status)
+        self.retry_after_s = int(retry_after_s)
+
+
+def _draining_rejection() -> RequestRejected:
+    """THE draining rejection — one definition for the front's
+    admission gate, the whole-batch path, and the HTTP handler, so the
+    status/message/Retry-After can never drift apart."""
+    return RequestRejected(
+        "draining",
+        "server is draining (shutting down); retry against a live "
+        "replica", status=503, retry_after_s=5)
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's client-supplied deadline passed (HTTP 504): it was
+    expired in queue or cancelled in-slot at a chunk boundary, so a
+    dead client never holds a KV slot."""
+
+
+class EngineShutdown(RuntimeError):
+    """Terminal error delivered to every pending waiter when the front
+    shuts down — a waiter must fail NOW, not at its wait() timeout."""
+
+
 class _ContinuousFront:
     """Thread front for the slot engine (train/continuous.py): ONE
     driver thread owns the device loop; HTTP handler threads submit
@@ -84,7 +118,9 @@ class _ContinuousFront:
                  chunk: int, mesh=None, announce: bool = False,
                  prefix_cache_size: int = 0, prefill_chunk: int = 0,
                  pipeline_depth: int = 0, adaptive_chunk: bool = False,
-                 schedule: str = "fifo", obs=None, event_log=None):
+                 schedule: str = "fifo", obs=None, event_log=None,
+                 max_queue_depth: int = 0, max_queued_tokens: int = 0,
+                 chaos=None, heartbeat=None):
         self._engine_args = (model, params, eos_id, num_slots, chunk,
                              mesh, announce, prefix_cache_size,
                              prefill_chunk, pipeline_depth, adaptive_chunk,
@@ -93,6 +129,20 @@ class _ContinuousFront:
         self._obs = obs if obs is not None else platform_families()
         self._event_log = (event_log if event_log is not None
                            else get_event_log())
+        # bounded admission: 0 = unbounded (the pre-hardening behavior);
+        # past either bound submit() sheds with RequestRejected instead
+        # of queueing work the server cannot finish in time
+        self.max_queue_depth = int(max_queue_depth)
+        self.max_queued_tokens = int(max_queued_tokens)
+        # serve-side chaos (resilience.FaultInjector via --chaos): fires
+        # inside the driver loop so the REAL rebuild path is exercised
+        self._chaos = chaos
+        self._chaos_step = 0
+        # liveness signal from the driver loop itself — /healthz answers
+        # from an HTTP thread even when the device loop is wedged, so
+        # the k8s liveness probe watches THIS file's age instead
+        self._heartbeat = heartbeat
+        self.draining = threading.Event()
         self.engine = self._new_engine()
         self.lock = threading.Lock()
         self.new_work = threading.Event()
@@ -119,15 +169,58 @@ class _ContinuousFront:
                                 adaptive_chunk=adaptive_chunk,
                                 schedule=schedule, obs=self._obs)
 
+    def _check_admission(self, prompt_len: int,
+                         max_new_tokens: int) -> None:
+        """Bounded admission + drain gate (caller holds ``self.lock``).
+        Raises :class:`RequestRejected` — BEFORE the engine sees the
+        request, so shedding costs no device work and no KV pages."""
+        if self.draining.is_set():
+            self._obs["serve_requests_rejected_total"].labels(
+                reason="draining").inc()
+            raise _draining_rejection()
+        if self.max_queue_depth:
+            depth = self.engine.queue_depth()
+            if depth >= self.max_queue_depth:
+                self._obs["serve_requests_rejected_total"].labels(
+                    reason="queue_full").inc()
+                raise RequestRejected(
+                    "queue_full",
+                    f"admission queue full ({depth} waiting >= "
+                    f"max_queue_depth {self.max_queue_depth})",
+                    status=429, retry_after_s=1)
+        if self.max_queued_tokens:
+            queued = self.engine.queued_tokens()
+            ask = int(prompt_len) + int(max_new_tokens)
+            if ask > self.max_queued_tokens:
+                # the request ALONE busts the budget: no amount of
+                # retrying can ever clear that — terminal 400 (caller
+                # error), not a 429 retry-forever loop
+                raise ValueError(
+                    f"request footprint {ask} tokens (prompt + budget) "
+                    f"exceeds max_queued_tokens {self.max_queued_tokens}")
+            if queued + ask > self.max_queued_tokens:
+                self._obs["serve_requests_rejected_total"].labels(
+                    reason="queue_full").inc()
+                raise RequestRejected(
+                    "queue_full",
+                    f"queued-token budget exhausted ({queued} queued + "
+                    f"{ask} requested > max_queued_tokens "
+                    f"{self.max_queued_tokens})",
+                    status=429, retry_after_s=1)
+
     def submit(self, prompt_ids, max_new_tokens: int,
                temperature: float = 0.0, top_p=None,
-               seed: int = 0) -> int:
-        """Queue a request (non-blocking); pair with ``wait``."""
+               seed: int = 0, deadline_s=None) -> int:
+        """Queue a request (non-blocking); pair with ``wait``.
+        ``deadline_s``: seconds from now the client still cares about
+        the answer — past it the engine expires the request at the next
+        chunk boundary and ``wait`` raises :class:`DeadlineExceeded`."""
         done = threading.Event()
         with self.lock:
+            self._check_admission(len(prompt_ids), max_new_tokens)
             rid = self.engine.submit(prompt_ids, max_new_tokens,
                                      temperature=temperature, top_p=top_p,
-                                     seed=seed)
+                                     seed=seed, deadline_s=deadline_s)
             self._results[rid] = [done, None, None]
         self.new_work.set()
         return rid
@@ -150,6 +243,8 @@ class _ContinuousFront:
                 f"continuous decode timed out after {timeout_s}s")
         with self.lock:
             result = self._results.pop(rid)[1]
+        if isinstance(result, (DeadlineExceeded, EngineShutdown)):
+            raise result  # typed: the handler maps these to 504 / 500
         if isinstance(result, Exception):
             raise RuntimeError(
                 f"continuous engine failed this request: {result}")
@@ -182,24 +277,35 @@ class _ContinuousFront:
             self.engine.cancel(rid)
             self._results.pop(rid, None)
 
-    def submit_stream(self, prompt_ids, max_new_tokens: int):
+    def submit_stream(self, prompt_ids, max_new_tokens: int,
+                      deadline_s=None):
         """Streaming variant: returns (rid, queue). The queue receives
         token-id lists as they decode, then a terminal item — [] on
-        completion, an Exception on engine failure. The consumer must
-        drain it (bounded: max_new_tokens items + terminal)."""
+        completion, an Exception on engine failure / deadline expiry /
+        shutdown. The consumer must drain it (bounded: max_new_tokens
+        items + terminal)."""
         import queue as _queue
 
         q = _queue.Queue()
         done = threading.Event()
         with self.lock:
+            self._check_admission(len(prompt_ids), max_new_tokens)
             rid = self.engine.submit(prompt_ids, max_new_tokens,
-                                     on_tokens=q.put)
+                                     on_tokens=q.put,
+                                     deadline_s=deadline_s)
             self._results[rid] = [done, None, q]  # same shape as submit
         self.new_work.set()
         return rid, q
 
     def _loop(self):
+        beat = 0
         while not self.stop.is_set():
+            beat += 1
+            if self._heartbeat is not None:
+                try:
+                    self._heartbeat.beat(beat)
+                except OSError:  # liveness signal must never take the
+                    pass         # driver loop down with it
             busy = False
             with self.lock:
                 try:
@@ -207,10 +313,27 @@ class _ContinuousFront:
                     busy = bool(stats["active"] or stats["queued"]
                                 or stats["admitting"] is not None
                                 or stats["inflight"])
+                    if busy and self._chaos is not None:
+                        # counted on BUSY iterations only (deterministic
+                        # against idle-spin timing); a raise here lands
+                        # in the rebuild handler below — the exact path
+                        # a real failed device step takes
+                        self._chaos_step += 1
+                        self._chaos.maybe_slow(self._chaos_step)
+                        self._chaos.maybe_fail(self._chaos_step)
                     finished = self.engine.step() if busy else []
                     for req in finished:
                         slot = self._results.get(req.rid)
                         if slot is not None:
+                            if req.expired:
+                                err = DeadlineExceeded(
+                                    f"request deadline exceeded after "
+                                    f"{len(req.tokens)} decoded token(s)")
+                                slot[1] = err
+                                slot[0].set()
+                                if slot[2] is not None:
+                                    slot[2].put(err)
+                                continue
                             slot[1] = req.tokens
                             slot[0].set()
                             if slot[2] is not None:  # streaming terminal
@@ -257,10 +380,49 @@ class _ContinuousFront:
                 self.new_work.wait(0.05)
                 self.new_work.clear()
 
+    def begin_drain(self) -> None:
+        """Stop admission: every later submit is rejected 503. Requests
+        already queued or in slots keep decoding to completion."""
+        self.draining.set()
+        self._obs["serve_draining"].set(1)
+
+    def drain(self, timeout_s: float) -> bool:
+        """Block until every accepted request has delivered its result
+        (completion, deadline expiry, or error) and the engine is idle,
+        or ``timeout_s`` elapses. Returns True when fully drained.
+        Call :meth:`begin_drain` first or new work keeps arriving."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self.lock:
+                stats = self.engine.stats
+                pending = any(slot[1] is None and not slot[0].is_set()
+                              for slot in self._results.values())
+                busy = bool(stats["active"] or stats["queued"]
+                            or stats["admitting"] is not None
+                            or stats["inflight"])
+            if not pending and not busy:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+
     def shutdown(self):
         self.stop.set()
         self.new_work.set()
         self.thread.join(timeout=10)
+        # Fail every still-pending waiter NOW with a terminal shutdown
+        # error — before this, a waiter blocked in wait() sat out its
+        # FULL timeout (600s default) against a driver thread that was
+        # already gone, and a streaming consumer hung on its queue.
+        err = EngineShutdown(
+            "serving front shut down while the request was in flight")
+        with self.lock:
+            for slot in self._results.values():
+                if slot[1] is None and not slot[0].is_set():
+                    slot[1] = err
+                    slot[0].set()
+                    if slot[2] is not None:
+                        slot[2].put(err)
 
 
 class BundleServer:
@@ -275,19 +437,32 @@ class BundleServer:
                  continuous_chunk: int = 8, prefix_cache_size: int = 0,
                  prefill_chunk: int = 0, continuous_pipeline: int = 0,
                  adaptive_chunk: bool = False, schedule: str = "fifo",
-                 registry=None, event_log=None):
+                 registry=None, event_log=None,
+                 max_queue_depth: int = 0, max_queued_tokens: int = 0,
+                 chaos_spec: str = "", heartbeat_file: str = ""):
         from pyspark_tf_gke_tpu.data.text import get_tokenizer
         from pyspark_tf_gke_tpu.train.export import load_serving_bundle
+        from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
 
-        self.model, params, self.meta = load_serving_bundle(bundle_dir)
+        # bundle loads retry with backoff: a GCS blip or a bundle
+        # mid-upload should cost seconds, not a CrashLoopBackOff cycle.
+        # Deterministic config errors fail FAST instead of masquerading
+        # as storage outages: a mistyped --bundle (FileNotFoundError),
+        # a corrupt/unsupported config.json (ValueError incl.
+        # JSONDecodeError, KeyError/TypeError from missing fields).
+        _permanent = (FileNotFoundError, ValueError, KeyError, TypeError)
+        self.model, params, self.meta = retry_with_backoff(
+            lambda: load_serving_bundle(bundle_dir), op="bundle_load",
+            give_up_on=_permanent)
         self.draft_model = self.draft_params = None
         self.draft_bundle_dir = draft_bundle_dir
         if draft_bundle_dir:
             # speculative decoding: single-prompt greedy requests verify
             # a cheap draft's proposals in chunk forwards — same tokens,
             # fewer target steps (models/speculative.py)
-            self.draft_model, self.draft_params, _ = load_serving_bundle(
-                draft_bundle_dir)
+            self.draft_model, self.draft_params, _ = retry_with_backoff(
+                lambda: load_serving_bundle(draft_bundle_dir),
+                op="bundle_load", give_up_on=_permanent)
             if (self.draft_model.cfg.vocab_size
                     != self.model.cfg.vocab_size):
                 raise ValueError(
@@ -340,12 +515,31 @@ class BundleServer:
         install_runtime_metrics(self.registry)
         self.event_log = (event_log if event_log is not None
                           else get_event_log())
+        # drain lifecycle: SIGTERM (or begin_drain) flips this, /healthz
+        # starts answering 503 draining, admission stops, and drain()
+        # waits out the in-flight work
+        self._draining = threading.Event()
+        self._inflight_lock = threading.Lock()
+        self._inflight_http = 0
         self._front = None
         if prefill_chunk and not continuous_slots:
             raise ValueError(
                 "--prefill-chunk requires --continuous-slots (chunked "
                 "prefill is a slot-engine feature)")
         if continuous_slots:
+            chaos = heartbeat = None
+            if chaos_spec:
+                from pyspark_tf_gke_tpu.train.resilience import (
+                    FaultInjector,
+                )
+
+                chaos = FaultInjector.from_chaos_spec(chaos_spec)
+            if heartbeat_file:
+                from pyspark_tf_gke_tpu.train.resilience import Heartbeat
+
+                # every_steps throttles the idle spin (~20 Hz) to a few
+                # writes/sec; a busy loop beats once per engine chunk
+                heartbeat = Heartbeat(heartbeat_file, every_steps=5)
             # multi-host: the engine announces each device op over the
             # serving wire (OP_CB_*) and the worker loops replay it into
             # their own SlotDeviceState replicas
@@ -359,13 +553,64 @@ class BundleServer:
                 pipeline_depth=continuous_pipeline,
                 adaptive_chunk=adaptive_chunk,
                 schedule=schedule, obs=self._obs,
-                event_log=self.event_log)
+                event_log=self.event_log,
+                max_queue_depth=max_queue_depth,
+                max_queued_tokens=max_queued_tokens,
+                chaos=chaos, heartbeat=heartbeat)
+
+    # -- drain lifecycle -------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def begin_drain(self) -> None:
+        """Flip to draining: /healthz readiness goes 503 (k8s stops
+        routing), admission stops (new requests get 503 + Retry-After),
+        in-flight requests keep decoding. Idempotent."""
+        if self._draining.is_set():
+            return
+        self._draining.set()
+        self._obs["serve_draining"].set(1)
+        self.event_log.emit("serve_drain_started", bundle=self.bundle_dir)
+        if self._front is not None:
+            self._front.begin_drain()
+
+    def _http_enter(self) -> None:
+        with self._inflight_lock:
+            self._inflight_http += 1
+
+    def _http_exit(self) -> None:
+        with self._inflight_lock:
+            self._inflight_http -= 1
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Wait for every in-flight HTTP request AND the slot engine to
+        finish, up to ``timeout_s``. Returns True when fully drained —
+        the CLI then exits 0; False means the grace window expired with
+        work still in flight (k8s SIGKILL follows; the trail records
+        it)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._inflight_lock:
+                busy_http = self._inflight_http
+            front_idle = (self._front is None
+                          or self._front.drain(timeout_s=0))
+            if not busy_http and front_idle:
+                self.event_log.emit("serve_drain_finished", drained=True)
+                return True
+            if time.monotonic() >= deadline:
+                self.event_log.emit(
+                    "serve_drain_finished", drained=False,
+                    inflight_http=busy_http)
+                return False
+            time.sleep(0.05)
 
     # -- health ----------------------------------------------------------
 
     def health(self) -> dict:
         return {
-            "status": "ok",
+            "status": "draining" if self.draining else "ok",
             "bundle": self.bundle_dir,
             "model": self.meta.get("model"),
             "quantized": bool(self.meta.get("quantized")),
@@ -376,6 +621,11 @@ class BundleServer:
             "processes": jax.process_count(),
             "tp": dict(self.mesh.shape).get("tp", 1) if self.mesh else 1,
             "speculative_draft": self.draft_bundle_dir or None,
+            "draining": self.draining,
+            "admission": ({"max_queue_depth": self._front.max_queue_depth,
+                           "max_queued_tokens":
+                               self._front.max_queued_tokens}
+                          if self._front is not None else None),
             "continuous": (self._front.engine.stats
                            if self._front is not None else None),
         }
@@ -384,17 +634,36 @@ class BundleServer:
 
     def generate(self, prompts, max_new_tokens: int = 64,
                  temperature: float = 0.0, top_k=None, top_p=None,
-                 num_beams: int = 0, repetition_penalty=None) -> list:
+                 num_beams: int = 0, repetition_penalty=None,
+                 deadline_s=None) -> list:
         """Batch completion. Prompts are grouped by token length so each
         group decodes as one batched call; the batch dimension pads up
         to power-of-2 buckets (repeating the first row) so mixed traffic
         reuses a handful of compiled shapes instead of recompiling per
         group size; results return in input order. Sampling requests get
         a fresh per-request PRNG key — a fixed seed would hand every
-        client the same 'random' completion."""
+        client the same 'random' completion.
+
+        ``deadline_s``: seconds from now the client still wants the
+        answer (HTTP ``deadline_ms`` / 1000). The slot engine enforces
+        it at chunk boundaries (queued requests expire before admission,
+        in-slot ones free their KV slot); the whole-batch path checks
+        between length groups — both raise :class:`DeadlineExceeded`."""
         from pyspark_tf_gke_tpu.models.causal_lm import generate
         from pyspark_tf_gke_tpu.train.serving import serve_generate
 
+        if self.draining:
+            self._obs["serve_requests_rejected_total"].labels(
+                reason="draining").inc()
+            raise _draining_rejection()
+        t_deadline = None
+        if deadline_s is not None:
+            if deadline_s <= 0:
+                self._obs["serve_request_deadline_exceeded_total"].inc()
+                raise DeadlineExceeded(
+                    f"deadline of {deadline_s * 1000.0:.0f}ms already "
+                    "expired at submission")
+            t_deadline = time.monotonic() + float(deadline_s)
         if not prompts:
             return []
         if len(prompts) > MAX_BATCH:
@@ -429,8 +698,12 @@ class BundleServer:
         # continuous slot engine → whole-batch. The draft-context check
         # lives HERE so a too-long-for-the-draft request still gets the
         # slot engine instead of a solo whole-batch call.
+        # a deadline-bearing request skips speculation: the spec loop
+        # has no chunk boundary to cancel at, so it would decode its
+        # full budget past a dead client — the slot engine (or the
+        # group-checked whole-batch path) enforces deadlines instead
         could_spec = (self.draft_model is not None and len(prompts) == 1
-                      and plain_greedy
+                      and plain_greedy and deadline_s is None
                       and len(encoded[0][1]) + max_new_tokens
                       <= self.draft_model.cfg.max_seq_len)
         if self._front is not None and engine_ok and not could_spec:
@@ -442,11 +715,20 @@ class BundleServer:
             # slots), then collect in order; no thread pool needed to
             # block on events.
             temp = float(temperature or 0.0)
-            rids = [(i, self._front.submit(
+            rids = []
+            try:
+                for i, ids in encoded:
+                    rids.append((i, self._front.submit(
                         ids, max_new_tokens, temperature=temp,
                         top_p=top_p,
-                        seed=int.from_bytes(os.urandom(4), "little")))
-                    for i, ids in encoded]
+                        seed=int.from_bytes(os.urandom(4), "little"),
+                        deadline_s=deadline_s)))
+            except Exception:
+                # a mid-batch rejection (queue filled between rows) must
+                # not strand the rows already submitted
+                for _, rid in rids:
+                    self._front.abandon(rid)
+                raise
             toks = {}
             try:
                 for i, rid in rids:
@@ -495,6 +777,14 @@ class BundleServer:
         results = [None] * len(prompts)
         with self._lock:
             for length, members in sorted(groups.items()):
+                if t_deadline is not None and time.monotonic() > t_deadline:
+                    # whole-batch granularity: between length groups (a
+                    # dispatched group runs to completion — the compiled
+                    # scan has no host re-entry to cancel at)
+                    self._obs["serve_request_deadline_exceeded_total"].inc()
+                    raise DeadlineExceeded(
+                        "request deadline exceeded before the batch "
+                        "finished decoding")
                 rows = [ids for _, ids in members]
                 n_real = len(rows)
                 bucket = 1 << (n_real - 1).bit_length()  # next power of 2
@@ -558,7 +848,8 @@ class BundleServer:
                 "prefix_cache": self._front.engine.stats.get(
                     "prefix_cache")}
 
-    def generate_stream(self, prompt: str, max_new_tokens: int = 64):
+    def generate_stream(self, prompt: str, max_new_tokens: int = 64,
+                        deadline_s=None):
         """Greedy streaming completion through the slot engine: yields
         one event dict per decoded token group (``token_ids`` plus the
         full ``text`` so far — full text, not a delta, so multibyte
@@ -568,6 +859,14 @@ class BundleServer:
             raise ValueError(
                 "streaming requires --continuous-slots (the slot engine "
                 "is what yields tokens as they decode)")
+        if deadline_s is not None and deadline_s <= 0:
+            # same contract as the blocking path: an already-dead
+            # deadline is a 504 + the deadline counter, not a 400
+            # leaking the internal parameter name
+            self._obs["serve_request_deadline_exceeded_total"].inc()
+            raise DeadlineExceeded(
+                f"deadline of {deadline_s * 1000.0:.0f}ms already "
+                "expired at submission")
         ids = self.tokenizer.encode(prompt)
         if not ids:
             raise ValueError("prompt tokenized to zero tokens")
@@ -578,12 +877,16 @@ class BundleServer:
                 f"max_seq_len {cfg.max_seq_len}")
         eos_id = getattr(self.tokenizer, "eos_id", None)
         t0 = time.perf_counter()
-        rid, q = self._front.submit_stream(ids, max_new_tokens)
-        toks, finished = [], False
+        rid, q = self._front.submit_stream(ids, max_new_tokens,
+                                           deadline_s=deadline_s)
+        toks, finished, yielded = [], False, False
         try:
             while True:
                 item = q.get(timeout=600)
                 if isinstance(item, Exception):
+                    if isinstance(item, (DeadlineExceeded,
+                                         EngineShutdown)):
+                        raise item
                     raise RuntimeError(
                         f"continuous engine failed this request: {item}")
                 if item == []:
@@ -592,10 +895,12 @@ class BundleServer:
                     item = item[:item.index(eos_id)]
                     toks.extend(item)
                     if item:
+                        yielded = True
                         yield {"token_ids": item,
                                "text": prompt + self.tokenizer.decode(toks)}
                     break
                 toks.extend(item)
+                yielded = True
                 yield {"token_ids": item,
                        "text": prompt + self.tokenizer.decode(toks)}
             # collect + release the results entry (event already set by
@@ -604,11 +909,22 @@ class BundleServer:
             finished = True
         finally:
             if not finished:
-                # engine failure or client disconnect mid-stream: the
-                # 200 is already committed, so /metrics is the only
-                # place this failure can still be seen
                 self._front.abandon(rid)
-                self.record_metrics(failed=True)
+                exc_type = sys.exc_info()[0]
+                if (not yielded and exc_type is not None and issubclass(
+                        exc_type, (DeadlineExceeded, RequestRejected))):
+                    # expired/rejected BEFORE the first event: the
+                    # exception propagates to the HTTP handler, which
+                    # does this request's accounting (504/503 + the
+                    # dedicated counters) — counting here too would
+                    # double-book serve_requests_total and brand a shed
+                    # request as a server failure
+                    pass
+                else:
+                    # engine failure or client disconnect mid-stream:
+                    # the 200 is already committed, so /metrics is the
+                    # only place this failure can still be seen
+                    self.record_metrics(failed=True)
         entry = {
             "prompt": prompt,
             "completion": prompt + self.tokenizer.decode(toks),
@@ -684,6 +1000,7 @@ class BundleServer:
             stats = self._front.engine.stats
             self._obs["serve_slots_total"].set(stats["num_slots"])
             self._obs["serve_slots_active"].set(stats["active"])
+            self._obs["serve_queue_depth"].set(stats["queued"])
 
     def metrics_text(self) -> str:
         """Prometheus exposition text: the full shared registry
@@ -762,11 +1079,13 @@ def _make_handler(server: BundleServer):
         def log_message(self, fmt, *args):  # route through our logger
             logger.info("%s %s", self.address_string(), fmt % args)
 
-        def _reply(self, code: int, payload: dict):
+        def _reply(self, code: int, payload: dict, headers=()):
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for name, value in headers:
+                self.send_header(name, value)
             if self.close_connection:
                 # advertise the close (http.server's send_error does the
                 # same) so pooling clients don't reuse a dying socket
@@ -791,12 +1110,20 @@ def _make_handler(server: BundleServer):
                 return self._reply(
                     400, {"error": "streaming is greedy-only (no "
                                    "sampling/beam parameters)"})
+            deadline_ms = req.get("deadline_ms")
             try:
                 events = server.generate_stream(
                     prompts[0],
-                    max_new_tokens=int(req.get("max_new_tokens", 64)))
+                    max_new_tokens=int(req.get("max_new_tokens", 64)),
+                    deadline_s=(float(deadline_ms) / 1000.0
+                                if deadline_ms is not None else None))
                 first = next(events)  # validation errors surface BEFORE
                 #   the 200 status line is committed
+            except RequestRejected as exc:
+                server.record_metrics()
+                return self._reply(
+                    exc.status, {"error": str(exc), "reason": exc.reason},
+                    headers=(("Retry-After", str(exc.retry_after_s)),))
             except (TypeError, ValueError) as exc:
                 server.record_metrics(failed=True)
                 return self._reply(400, {"error": str(exc)})
@@ -828,7 +1155,12 @@ def _make_handler(server: BundleServer):
             route = self.path.partition("?")[0]  # scrape configs may
             # append query params; routing must ignore them
             if route in ("/healthz", "/health", "/"):
-                return self._reply(200, server.health())
+                # draining → 503: the k8s readiness probe fails and the
+                # Service stops routing here, while /metrics and /events
+                # below keep answering (drain is exactly when you want
+                # to watch the queue empty)
+                return self._reply(503 if server.draining else 200,
+                                   server.health())
             # /metrics, /metrics.json, /events — the obs package owns
             # the response assembly; this server contributes the live
             # engine-gauge refresh and its legacy alias block
@@ -850,6 +1182,25 @@ def _make_handler(server: BundleServer):
             self.wfile.write(body)
 
         def do_POST(self):
+            server._http_enter()  # drain() waits for this to reach zero
+            try:
+                self._do_POST()
+            finally:
+                server._http_exit()
+
+        def _do_POST(self):
+            if server.draining:
+                # shed BEFORE reading the body — the connection is
+                # closing anyway, so the keep-alive desync the 413 path
+                # guards against doesn't apply
+                self.close_connection = True
+                server.record_metrics()
+                server._obs["serve_requests_rejected_total"].labels(
+                    reason="draining").inc()
+                exc = _draining_rejection()
+                return self._reply(
+                    exc.status, {"error": str(exc), "reason": exc.reason},
+                    headers=(("Retry-After", str(exc.retry_after_s)),))
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 if n > MAX_BODY_BYTES:
@@ -866,6 +1217,10 @@ def _make_handler(server: BundleServer):
                 server.record_metrics(failed=True)
                 return self._reply(400, {"error": f"bad JSON body: {exc}"})
             try:
+                deadline_ms = req.get("deadline_ms") if isinstance(
+                    req, dict) else None
+                deadline_s = (float(deadline_ms) / 1000.0
+                              if deadline_ms is not None else None)
                 if self.path == "/v1/generate":
                     prompts = req.get("prompts")
                     if prompts is None and "prompt" in req:
@@ -885,7 +1240,8 @@ def _make_handler(server: BundleServer):
                         top_k=req.get("top_k"),
                         top_p=req.get("top_p"),
                         num_beams=int(req.get("num_beams", 0)),
-                        repetition_penalty=req.get("repetition_penalty"))
+                        repetition_penalty=req.get("repetition_penalty"),
+                        deadline_s=deadline_s)
                     server.record_metrics(generate_entries=out)
                     self._reply(200, {"completions": out})
                 elif self.path == "/v1/warm":
@@ -911,6 +1267,19 @@ def _make_handler(server: BundleServer):
                 else:
                     server.record_metrics(failed=True)
                     self._reply(404, {"error": f"unknown path {self.path}"})
+            except RequestRejected as exc:
+                # load shedding is not a server fault: counted in the
+                # rejected{reason} family (incremented at the raise
+                # site), not in requests_failed
+                server.record_metrics()
+                self._reply(
+                    exc.status, {"error": str(exc), "reason": exc.reason},
+                    headers=(("Retry-After", str(exc.retry_after_s)),))
+            except DeadlineExceeded as exc:
+                # the dedicated deadline counter (incremented where the
+                # expiry was detected) carries the signal
+                server.record_metrics()
+                self._reply(504, {"error": str(exc)})
             except (TypeError, ValueError) as exc:
                 # TypeError too: int(None)/float([]) from JSON null/list
                 # field values is caller error, not a server fault
@@ -1010,6 +1379,37 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "down to 8), so a slot whose request ends at its "
                         "budget frees at the earliest collect instead of "
                         "decoding dead rows to the end of a fixed chunk")
+    p.add_argument("--max-queue-depth", type=int,
+                   default=int(e("MAX_QUEUE_DEPTH", "0")),
+                   help="bounded admission: shed (HTTP 429 + "
+                        "Retry-After) once this many requests wait for "
+                        "a KV slot (0 = unbounded); overload degrades "
+                        "to fast rejection instead of collapse")
+    p.add_argument("--max-queued-tokens", type=int,
+                   default=int(e("MAX_QUEUED_TOKENS", "0")),
+                   help="bounded admission by token budget: shed when "
+                        "queued prompt+budget tokens would exceed this "
+                        "(0 = unbounded)")
+    p.add_argument("--drain-timeout", type=float,
+                   default=float(e("DRAIN_TIMEOUT", "30")),
+                   help="seconds SIGTERM waits for in-flight requests "
+                        "before exiting; pair with a k8s "
+                        "terminationGracePeriodSeconds comfortably "
+                        "above it (see infra/k8s/tpu/tpu-serve.yaml)")
+    p.add_argument("--chaos", default=e("SERVE_CHAOS", ""),
+                   help="serve-side fault injection into the engine "
+                        "driver loop: comma-separated fail@STEP / "
+                        "slow@STEP:SECONDS tokens (e.g. "
+                        "'fail@50,slow@80:0.5'); exercises the "
+                        "engine-rebuild path under real traffic — "
+                        "NEVER set in production")
+    p.add_argument("--heartbeat-file", default=e("HEARTBEAT_FILE", ""),
+                   help="node-local path the engine DRIVER LOOP beats "
+                        "(train/resilience.Heartbeat); the k8s liveness "
+                        "probe watches its age, catching a wedged "
+                        "device loop that /healthz (answered from an "
+                        "HTTP thread) cannot see. Continuous-slots "
+                        "mode only")
     p.add_argument("--metrics-textfile", default=e("METRICS_TEXTFILE", ""),
                    help="also export the metrics registry to this .prom "
                         "file every --metrics-interval seconds (atomic "
@@ -1086,7 +1486,13 @@ def main(argv=None) -> int:
         prefill_chunk=args.prefill_chunk,
         continuous_pipeline=args.continuous_pipeline,
         adaptive_chunk=args.adaptive_chunk,
-        schedule=args.schedule)
+        schedule=args.schedule,
+        max_queue_depth=args.max_queue_depth,
+        max_queued_tokens=args.max_queued_tokens,
+        chaos_spec=args.chaos,
+        heartbeat_file=args.heartbeat_file)
+    if args.chaos:
+        logger.warning("serve-side chaos injection ACTIVE: %s", args.chaos)
     logger.info("bundle loaded: %s", server.health())
     exporter = None
     if args.metrics_textfile:
@@ -1106,6 +1512,17 @@ def main(argv=None) -> int:
         # process 0 shuts the job down
         from pyspark_tf_gke_tpu.train.serving import serve_worker_loop
 
+        if threading.current_thread() is threading.main_thread():
+            import signal
+
+            # a rolling restart SIGTERMs EVERY pod: a worker dying
+            # immediately would sever the announce wire while pod 0 is
+            # still draining, failing the very in-flight requests the
+            # grace window protects. Ignore it — the loop ends when
+            # process 0 announces shutdown (end of its drain), and the
+            # k8s SIGKILL at the end of the grace period is the
+            # backstop for a wedged drain.
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
         served = serve_worker_loop(server.model, server.params, server.mesh,
                                    draft_model=server.draft_model,
                                    draft_params=server.draft_params)
@@ -1138,6 +1555,27 @@ def main(argv=None) -> int:
         logger.info(
             "serving on http://%s:%d (healthz, /v1/generate, /v1/score)",
             *httpd.server_address[:2])
+
+        def _drain_then_stop():
+            # graceful drain (the k8s rolling-restart contract):
+            # readiness flips to draining → admission stops → in-flight
+            # requests finish (bounded by --drain-timeout) → the accept
+            # loop stops → main() falls through its finally and exits 0
+            server.begin_drain()
+            drained = server.drain(args.drain_timeout)
+            logger.info("drain %s after SIGTERM; stopping HTTP server",
+                        "complete" if drained else
+                        f"TIMED OUT at {args.drain_timeout}s")
+            httpd.shutdown()
+
+        if threading.current_thread() is threading.main_thread():
+            import signal
+
+            signal.signal(
+                signal.SIGTERM,
+                lambda signum, frame: threading.Thread(
+                    target=_drain_then_stop, name="drain",
+                    daemon=True).start())
         try:
             httpd.serve_forever()
         except KeyboardInterrupt:

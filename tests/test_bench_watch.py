@@ -521,8 +521,8 @@ def test_stale_matrix_against_committed_trail():
     # captures them this set just shrinks (subset check still passes).
     queued = {"cnn --adafactor", "resnet50 --gn", "resnet50 --fused-bn",
               "resnet50 --fused-bn3",
-              # round-5/6 additions awaiting their first chip window
-              "resnet50 --nf", "cb --paged"}
+              # round-5/6/7 additions awaiting their first chip window
+              "resnet50 --nf", "cb --paged", "cb --chaos"}
     assert missing <= queued, (
         f"matrix workloads with no trail entry: {sorted(missing - queued)}")
 
@@ -640,3 +640,10 @@ def test_paged_flag_guard():
     with pytest.raises(SystemExit, match="cb workload only"):
         bench.run_bench(["cnn", "--paged"])
     assert ["cb", "--paged"] in [list(w) for w in bench.ALL_WORKLOADS]
+
+
+def test_chaos_flag_guard():
+    # --chaos (the goodput/p99-under-faults A/B) is a cb-only lever too
+    with pytest.raises(SystemExit, match="cb workload only"):
+        bench.run_bench(["generate", "--chaos"])
+    assert ["cb", "--chaos"] in [list(w) for w in bench.ALL_WORKLOADS]

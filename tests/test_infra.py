@@ -84,20 +84,41 @@ def test_launch_scripts_are_valid_bash():
 
 def test_tpu_serve_manifest_conventions():
     """The serving Deployment must run the serve CLI, probe /healthz on
-    the served port, and claim the slice's TPU resources."""
+    the served port, and claim the slice's TPU resources; SRE hardening
+    adds the drain lifecycle (preStop + grace window covering
+    DRAIN_TIMEOUT) and the heartbeat-age exec liveness probe (the HTTP
+    thread answers /healthz even when the driver loop is wedged)."""
     docs = _load("infra/k8s/tpu/tpu-serve.yaml")
     svc = next(d for d in docs if d["kind"] == "Service")
     dep = next(d for d in docs if d["kind"] == "Deployment")
     port = svc["spec"]["ports"][0]["port"]
-    c = dep["spec"]["template"]["spec"]["containers"][0]
+    pod = dep["spec"]["template"]["spec"]
+    c = pod["containers"][0]
     assert c["command"][-1] == "pyspark_tf_gke_tpu.train.serve"
     assert c["ports"][0]["containerPort"] == port
     env = {e["name"]: e["value"] for e in c["env"]}
     assert env["SERVE_PORT"] == str(port)
     assert env["BUNDLE_DIR"].startswith("gs://")
-    for probe in ("startupProbe", "readinessProbe", "livenessProbe"):
+    # startup + readiness stay on /healthz (it answers 503 draining so
+    # readiness fails the moment SIGTERM lands)
+    for probe in ("startupProbe", "readinessProbe"):
         assert c[probe]["httpGet"]["path"] == "/healthz"
         assert c[probe]["httpGet"]["port"] == port
+    # liveness = heartbeat AGE via stdlib exec (tpu-worker.yaml idiom),
+    # pointed at the same file the serve CLI is told to beat, PLUS an
+    # HTTP reachability fallback (covers whole-batch mode, where no
+    # driver loop beats, and a hung accept thread)
+    probe_src = c["livenessProbe"]["exec"]["command"][2]
+    assert c["livenessProbe"]["exec"]["command"][0] == "python"
+    assert env["HEARTBEAT_FILE"] in probe_src
+    assert "/healthz" in probe_src
+    assert "HTTPError" in probe_src  # a draining 503 must count as alive
+    # drain lifecycle: preStop sleep + DRAIN_TIMEOUT fit the grace window
+    assert c["lifecycle"]["preStop"]["exec"]["command"]
+    grace = pod["terminationGracePeriodSeconds"]
+    assert float(env["DRAIN_TIMEOUT"]) + 5 < grace
+    # bounded admission is ON in the canonical deployment
+    assert int(env["MAX_QUEUE_DEPTH"]) > 0
     assert c["resources"]["requests"]["google.com/tpu"] == "4"
 
 

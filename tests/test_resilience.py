@@ -17,6 +17,7 @@ from pyspark_tf_gke_tpu.train.resilience import (
     FaultInjector,
     Heartbeat,
     InjectedFault,
+    retry_with_backoff,
     run_with_recovery,
 )
 
@@ -52,6 +53,133 @@ def test_fault_injector_fires_once():
     fi.maybe_fail(4)  # replay after resume: no re-fire
     assert FaultInjector.from_spec("") is None
     assert FaultInjector.from_spec("2, 7").pending == {2, 7}
+
+
+def test_fault_injector_chaos_spec_parses_fail_and_slow():
+    fi = FaultInjector.from_chaos_spec("fail@3, 7,slow@5:0.25")
+    assert fi.pending == {3, 7}
+    assert fi.slow_pending == {5: 0.25}
+    assert fi.n_faults == 2 and fi.n_slow == 1
+    assert FaultInjector.from_chaos_spec("") is None
+    with pytest.raises(ValueError, match="slow@STEP:SECONDS"):
+        FaultInjector.from_chaos_spec("slow@5")
+    with pytest.raises(ValueError):
+        FaultInjector.from_chaos_spec("fail@x")
+
+
+def test_fault_injector_slow_fires_once(monkeypatch):
+    from pyspark_tf_gke_tpu.train import resilience
+
+    slept = []
+    monkeypatch.setattr(resilience.time, "sleep",
+                        lambda s: slept.append(s))
+    fi = FaultInjector(slow_at_steps={4: 0.5})
+    assert fi.maybe_slow(3) == 0.0
+    assert fi.maybe_slow(4) == 0.5
+    assert fi.maybe_slow(4) == 0.0  # once per planned step
+    assert slept == [0.5]
+    assert fi.fired_faults == 0  # slow steps are not failures
+
+
+def test_fault_injector_fired_faults_accounting():
+    fi = FaultInjector([2, 9])
+    assert fi.fired_faults == 0
+    with pytest.raises(InjectedFault):
+        fi.maybe_fail(2)
+    assert fi.fired_faults == 1 and fi.n_faults == 2
+
+
+def test_retry_with_backoff_succeeds_with_jittered_delays():
+    calls = []
+    delays = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_with_backoff(
+        flaky, attempts=4, base_delay_s=0.1, max_delay_s=5.0,
+        jitter=0.5, op="test_op", sleep=delays.append) == "ok"
+    assert len(calls) == 3 and len(delays) == 2
+    # exponential with the top half jittered: delay_k in
+    # [nominal/2, nominal] for nominal = base * 2**(k-1)
+    assert 0.05 <= delays[0] <= 0.1
+    assert 0.1 <= delays[1] <= 0.2
+
+
+def test_retry_with_backoff_exhausts_and_reraises():
+    calls = []
+
+    def always(*_):
+        calls.append(1)
+        raise RuntimeError("permanent")
+
+    with pytest.raises(RuntimeError, match="permanent"):
+        retry_with_backoff(always, attempts=2, sleep=lambda _: None)
+    assert len(calls) == 2  # attempts counts calls
+
+
+def test_retry_with_backoff_give_up_on_fails_fast():
+    # deterministic/permanent classes carve OUT of a broad retry_on:
+    # a mistyped path must not masquerade as a storage outage
+    calls = []
+
+    def missing():
+        calls.append(1)
+        raise FileNotFoundError("no such bundle")
+
+    with pytest.raises(FileNotFoundError):
+        retry_with_backoff(missing, attempts=5,
+                           give_up_on=(FileNotFoundError,),
+                           sleep=lambda _: None)
+    assert len(calls) == 1
+
+
+def test_retry_with_backoff_non_matching_propagates_immediately():
+    calls = []
+
+    def wrong_kind():
+        calls.append(1)
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        retry_with_backoff(wrong_kind, attempts=5, retry_on=(OSError,),
+                           sleep=lambda _: None)
+    assert len(calls) == 1
+
+
+def test_retry_with_backoff_emits_trail_and_counter(tmp_path):
+    from pyspark_tf_gke_tpu.obs.events import (EventLog, read_events,
+                                               set_event_log)
+    from pyspark_tf_gke_tpu.obs.metrics import (MetricsRegistry,
+                                                set_registry)
+
+    trail = str(tmp_path / "trail.jsonl")
+    set_event_log(EventLog(trail))
+    reg = MetricsRegistry()
+    set_registry(reg)
+    try:
+        state = {"n": 0}
+
+        def once():
+            state["n"] += 1
+            if state["n"] == 1:
+                raise OSError("blip")
+            return state["n"]
+
+        assert retry_with_backoff(once, op="unit_op",
+                                  base_delay_s=0.001,
+                                  sleep=lambda _: None) == 2
+        events = [e for e in read_events(trail) if e["kind"] == "retry"]
+        assert len(events) == 1
+        assert events[0]["op"] == "unit_op" and events[0]["attempt"] == 1
+        assert "OSError" in events[0]["error"]
+        assert reg.get("retries_total").labels(op="unit_op").value == 1
+    finally:
+        set_event_log(None)
+        set_registry(None)
 
 
 def test_run_with_recovery_retries_then_succeeds():
