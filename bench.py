@@ -1123,6 +1123,168 @@ def bench_continuous(smoke: bool = False, paged: bool = False,
     }
 
 
+def bench_chunked_prefill(smoke: bool = False) -> dict:
+    """``cb --chunked-prefill``: the head-of-line-blocking A/B. A mixed
+    prompt-length request set (mostly short prompts, periodic LONG
+    ones) runs through the PAGED slot engine at equal slot count twice:
+    chunked prefill + step-token budget ON (long prompts admit in
+    bounded pieces, decode chunks interleave) vs OFF (every admission
+    is a monolithic prefill that stalls all live slots for the whole
+    prompt). Streaming callbacks timestamp every token-group delivery;
+    TBT samples are the gaps between consecutive deliveries per request
+    (the first delivery is TTFT and excluded). Reported: useful
+    tokens/sec/chip both ways plus p50/p99 TBT — the tail is what
+    chunking exists to flatten; throughput must stay within a few
+    percent (the same device work, rescheduled)."""
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
+    from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    device_kind = devices[0].device_kind
+
+    if smoke:
+        cfg = CausalLMConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                             num_heads=4, intermediate_size=128,
+                             max_seq_len=256, dtype=jnp.float32)
+        slots, chunk, n_requests = 2, 4, 6
+        short_len, long_len, budget = 16, 100, 8
+        page_size, prefill_chunk, step_budget = 32, 32, 40
+    else:
+        cfg = CausalLMConfig(max_seq_len=2048)  # GPT-small, long context
+        slots, chunk, n_requests = 8, 16, 32
+        short_len, long_len, budget = 64, 1024, 64
+        page_size, prefill_chunk, step_budget = 64, 256, 384
+
+    import dataclasses as _dc
+
+    model = CausalLM(cfg)
+    pool = slots * (cfg.max_seq_len // page_size)
+    eng_model = CausalLM(_dc.replace(
+        cfg, kv_page_size=page_size, kv_num_pages=pool))
+    rng = np.random.default_rng(0)
+    # mixed arrival pattern: every 4th request is a LONG prompt — each
+    # long admission lands while the short ones are mid-decode, which
+    # is exactly the stall the unchunked engine exposes
+    lens = [long_len if i % 4 == 3 else short_len
+            for i in range(n_requests)]
+    prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+               for n in lens]
+    variables = jax.jit(model.init)(
+        make_rng(1337), jnp.asarray(prompts[0][None, :8]))
+    params = nn.meta.unbox(variables["params"])
+    useful = budget * n_requests
+
+    from pyspark_tf_gke_tpu.train import continuous as _cont
+
+    def jit_cache_size() -> int:
+        """Total compiled-program count across the engine's module-
+        level jits — the acceptance criterion's 'zero steady-state
+        recompiles' is measured, not asserted: warmup compiles
+        everything, the timed run must add nothing."""
+        return sum(
+            f._cache_size() for f in (
+                _cont._prefill_padded_batch, _cont._decode_chunk,
+                _cont._paged_prefill_chunk, _cont._activate_slot_paged,
+                _cont._insert_slot_paged, _cont._insert_slots_batch_paged,
+                _cont._paged_zeros_state, _cont._clear_live_paged))
+
+    def run(chunked: bool):
+        kw = (dict(prefill_chunk=prefill_chunk,
+                   step_token_budget=step_budget) if chunked else {})
+        eng = ContinuousEngine(eng_model, params, num_slots=slots,
+                               chunk=chunk, **kw)
+        arrivals = []  # per request: [t0, t1, ...] delivery timestamps
+        jits0 = jit_cache_size()
+
+        t0 = time.perf_counter()
+        for p in prompts:
+            ts = []
+            arrivals.append(ts)
+            # the driver thread runs callbacks synchronously — append
+            # is the whole cost, timestamps are delivery times
+            eng.submit(p, max_new_tokens=budget,
+                       on_tokens=lambda _t, ts=ts: ts.append(
+                           time.perf_counter()))
+        done = list(eng.run_until_drained())
+        dt = time.perf_counter() - t0
+        got = sum(len(toks) for _, toks in done)
+        if got != useful:
+            raise RuntimeError(
+                f"engine returned {got} tokens, expected {useful}")
+        gaps = []
+        for ts in arrivals:
+            gaps += [(b - a) * 1000.0 for a, b in zip(ts, ts[1:])]
+        gaps.sort()
+
+        def pct(p):
+            return (round(gaps[min(len(gaps) - 1,
+                                   int(p * len(gaps)))], 2)
+                    if gaps else None)
+
+        return {
+            "tokens_per_sec_per_chip": round(got / dt / n_chips, 1),
+            "tbt_p50_ms": pct(0.50),
+            "tbt_p99_ms": pct(0.99),
+            "tbt_max_ms": round(gaps[-1], 2) if gaps else None,
+            "tbt_samples": len(gaps),
+            "prefill_chunks": eng.stats["prefill_chunks"],
+            "dispatched_steps": eng.stats["dispatched_steps"],
+            "steady_state_recompiles": jit_cache_size() - jits0,
+        }
+
+    # warmup: compile both sides' program sets outside the timed runs —
+    # both prompt buckets, the k_pad=2 batched admission the short
+    # prompts trigger, the chunked side's piece width, and a
+    # full-budget decode so the budget scheduler's bucketed chunk
+    # sizes compile
+    for chunked in (False, True):
+        warm_kw = (dict(prefill_chunk=prefill_chunk,
+                        step_token_budget=step_budget) if chunked else {})
+        warm = ContinuousEngine(eng_model, params, num_slots=slots,
+                                chunk=chunk, **warm_kw)
+        for p in (prompts[0], prompts[1], prompts[3]):
+            warm.submit(p, max_new_tokens=2)
+        list(warm.run_until_drained())
+        warm.submit(prompts[3], max_new_tokens=budget)
+        warm.submit(prompts[0], max_new_tokens=budget)
+        list(warm.run_until_drained())
+    off = run(chunked=False)
+    on = run(chunked=True)
+    return {
+        "metric": "continuous_batching_chunked_prefill_tokens_per_sec_per_chip",
+        "value": on["tokens_per_sec_per_chip"],
+        "unit": "useful_tokens/sec/chip",
+        "vs_baseline": None,
+        "chunked": on,
+        "unchunked": off,
+        "tokens_ratio": round(
+            on["tokens_per_sec_per_chip"]
+            / max(off["tokens_per_sec_per_chip"], 1e-9), 3),
+        "tbt_p99_ratio": (round(on["tbt_p99_ms"] / off["tbt_p99_ms"], 3)
+                          if on["tbt_p99_ms"] and off["tbt_p99_ms"]
+                          else None),
+        "prefill_chunk_tokens": prefill_chunk,
+        "step_token_budget": step_budget,
+        "num_slots": slots,
+        "chunk": chunk,
+        "n_requests": n_requests,
+        "prompt_lens": [short_len, long_len],
+        "budget": budget,
+        "paged_kv": {"page_size": page_size, "pages_total": pool},
+        "n_chips": n_chips,
+        "device_kind": device_kind,
+        "workload": (f"CausalLM {cfg.num_layers}L h{cfg.hidden_size} "
+                     f"paged slot-engine, mixed {short_len}/{long_len}-"
+                     f"token prompts: chunked prefill A/B"),
+    }
+
+
 def bench_io(smoke: bool = False) -> dict:
     """Input-pipeline throughput on the native IO plane: TFRecord shards
     → ``native.ExamplePool`` → shuffled host batches at the BERT
@@ -1355,6 +1517,71 @@ def _error_json(argv, stage: str, detail: str,
     return out
 
 
+# Kernel/config VARIANTS of a committed baseline workload, for the
+# regression guard below: same metric, same unit, same workload shape —
+# only the lever under test differs, so value ratios are meaningful.
+# (Workloads that change the SHAPE — bert --seq, cb --chunked-prefill's
+# mixed prompt mix — are deliberately absent.)
+VARIANT_BASELINES = {
+    "resnet50 --fused-bn": ["resnet50"],
+    "resnet50 --fused-bn3": ["resnet50"],
+    "resnet50 --gn": ["resnet50"],
+    "resnet50 --nf": ["resnet50"],
+    "resnet50 --s2d": ["resnet50"],
+    "cnn --bf16-moments": ["cnn"],
+    "cnn --adafactor": ["cnn"],
+    "cb --paged": ["cb"],
+    "generate --kv-heads 2": ["generate"],
+    "generate --int8 --kv-heads 2": ["generate", "--kv-heads", "2"],
+    "generate --int8 --int8-kv --kv-heads 2":
+        ["generate", "--int8", "--kv-heads", "2"],
+}
+
+REGRESSION_THRESHOLD = 0.9  # variant >10% below baseline -> flagged
+
+
+def annotate_variant_regression(argv, result: dict) -> None:
+    """A/B guard for variant workloads: compare a just-measured variant
+    against its baseline workload's latest COMMITTED trail entry, emit
+    a delta line (stderr), and attach ``vs_variant_baseline`` — with
+    ``"regression": true`` when the variant lands more than 10% below.
+    BENCH_r05 motivated this: ``resnet50 --fused-bn`` recorded 1481
+    ex/s against the 2431 plain baseline with no flag raised anywhere —
+    a 0.61x kernel-variant regression that only a human diffing trail
+    entries could catch. Mutates ``result`` in place; silently a no-op
+    when there is no baseline entry or the units mismatch (a guard must
+    never block the measurement it guards)."""
+    if "--smoke" in argv or result.get("value") is None:
+        return
+    key = " ".join(_normalize_argv(argv))
+    base_argv = VARIANT_BASELINES.get(key)
+    if base_argv is None:
+        return
+    base = _latest_history(base_argv)
+    if base is None:
+        return
+    r = base.get("result") or {}
+    base_value = r.get("value")
+    if not base_value or r.get("unit") != result.get("unit"):
+        return
+    ratio = float(result["value"]) / float(base_value)
+    ab = {
+        "baseline_argv": " ".join(_normalize_argv(base_argv)),
+        "baseline_value": base_value,
+        "baseline_ts": base.get("ts"),
+        "ratio": round(ratio, 3),
+    }
+    regressed = ratio < REGRESSION_THRESHOLD
+    if regressed:
+        ab["regression"] = True
+        result["regression"] = True
+    result["vs_variant_baseline"] = ab
+    log(f"variant A/B: {key} = {result['value']} {result.get('unit')} "
+        f"vs [{ab['baseline_argv']}] = {base_value} -> {ab['ratio']}x"
+        + (" REGRESSION (>10% below committed baseline)"
+           if regressed else ""))
+
+
 def append_history(argv, result: dict) -> None:
     """Append a successful measurement to the committed evidence trail.
 
@@ -1485,6 +1712,10 @@ ALL_WORKLOADS = (
     # chaos A/B: goodput + p99 with faults injected into the serving
     # driver loop vs clean — what one engine rebuild costs the endpoint
     ["cb", "--chaos"],
+    # chunked-prefill A/B: mixed prompt lengths through the paged
+    # engine, pieces + step budget vs monolithic prefill — p50/p99
+    # time-between-tokens is the tail this exists to flatten
+    ["cb", "--chunked-prefill"],
     ["spec"],  # device-loop tok/s + the 0.75-skew fixture's acceptance
     ["generate", "--beams", "4"],  # broadcast-select reorder rebuild A/B
     # --- measured re-confirmations ---
@@ -1674,11 +1905,23 @@ def orchestrate(argv, skip_probe: bool = False) -> int:
             (ln for ln in reversed(proc.stdout.splitlines())
              if ln.startswith("{")), None)
         if proc.returncode == 0 and line:
-            print(line)
             try:
-                append_history(argv, json.loads(line))
+                result = json.loads(line)
             except ValueError as exc:
-                log(f"history: stdout line was not JSON, not recorded: {exc!r}")
+                log(f"history: stdout line was not JSON, not recorded: "
+                    f"{exc!r}")
+                print(line)
+                return 0
+            # variant regression guard BEFORE print/append: the flag
+            # must reach both the stdout artifact and the trail entry.
+            # Tolerant: a malformed baseline entry must never cost the
+            # just-measured result (minutes of chip time).
+            try:
+                annotate_variant_regression(argv, result)
+            except Exception as exc:  # noqa: BLE001
+                log(f"variant A/B guard failed (ignored): {exc!r}")
+            print(json.dumps(result))
+            append_history(argv, result)
             return 0
         last = f"rc={proc.returncode}: {proc.stderr.strip()[-800:]}"
         last_rc = proc.returncode
@@ -1703,6 +1946,12 @@ def run_bench(argv) -> dict:
         raise SystemExit("--paged applies to the cb workload only")
     if "--chaos" in argv and workload != "cb":
         raise SystemExit("--chaos applies to the cb workload only")
+    if "--chunked-prefill" in argv and workload != "cb":
+        raise SystemExit("--chunked-prefill applies to the cb workload only")
+    if "--chunked-prefill" in argv and ("--paged" in argv
+                                        or "--chaos" in argv):
+        raise SystemExit("--chunked-prefill is its own A/B (the engine "
+                         "under it is already paged)")
     if "--s2d" in argv and workload != "resnet50":
         raise SystemExit("--s2d applies to the resnet50 workload only")
     if "--gn" in argv and workload != "resnet50":
@@ -1740,6 +1989,8 @@ def run_bench(argv) -> dict:
     if workload == "io":
         return bench_io(smoke=smoke)
     if workload == "cb":
+        if "--chunked-prefill" in argv:
+            return bench_chunked_prefill(smoke=smoke)
         return bench_continuous(smoke=smoke, paged="--paged" in argv,
                                 chaos="--chaos" in argv)
     if workload == "spec":

@@ -317,47 +317,55 @@ class CausalSelfAttention(nn.Module):
 
     def _paged_decode_attend(self, q, k, v, row_positions):
         """Slot-decode step against the paged pool: write each row's
-        single new K/V at (block_table[row, pos // P], pos % P), then
-        attend through the block table with the ragged
-        ``paged_attention`` kernel (pure-JAX reference off-TPU). One
-        token per row only — the engine's paged mode admits via dense
-        prefill + page scatter, never multi-token slot decode."""
+        new K/V at (block_table[row, pos // P], pos % P) — one token
+        per row on the decode path, or a CHUNK of s consecutive tokens
+        (chunked prefill writes a prompt piece straight into the slot's
+        pages; ``row_positions[b]`` must then be ``fill + arange(s)``)
+        — then attend through the block table with the ragged
+        ``paged_attention`` / ``paged_attention_chunk`` kernel
+        (pure-JAX reference off-TPU). Writing BEFORE attending makes
+        in-chunk causality fall out of the position mask: each chunk
+        query sees exactly the keys at positions <= its own."""
         cfg = self.cfg
         b, s, h, d = q.shape
-        if s != 1:
-            raise ValueError(
-                "paged slot decode is single-token (chunked prefill / "
-                "prefix extension run on dense batch-1 trees)")
         from pyspark_tf_gke_tpu.ops.pallas.paged_attention import (
             paged_attention,
+            paged_attention_chunk,
         )
 
         hkv = k.shape[2]
         kp, vp, bt, ks, vs, idx = self._paged_cache_vars(b, hkv, d, k.dtype)
-        pos_b = row_positions[:, 0]                              # [B]
+        pos = row_positions                                      # [B, s]
         ps = cfg.kv_page_size
         # take_along_axis clips an over-long dead row's page index into
         # the table; a sentinel entry there makes the write a no-op.
         page = jnp.take_along_axis(
-            bt.value, jnp.minimum(pos_b // ps, bt.value.shape[1] - 1)[:, None],
-            axis=1)[:, 0]
-        off = pos_b % ps
-        krow, vrow = k[:, 0], v[:, 0]                            # [B,Hkv,D]
+            bt.value, jnp.minimum(pos // ps, bt.value.shape[1] - 1),
+            axis=1)                                              # [B, s]
+        off = pos % ps
+        krows, vrows = k, v                                  # [B,s,Hkv,D]
         if ks is not None:
-            krow, k_scale = self._quantize_kv(krow)
-            vrow, v_scale = self._quantize_kv(vrow)
+            krows, k_scale = self._quantize_kv(krows)
+            vrows, v_scale = self._quantize_kv(vrows)
             ks.value = ks.value.at[page, off].set(k_scale, mode="drop")
             vs.value = vs.value.at[page, off].set(v_scale, mode="drop")
         kp.value = kp.value.at[page, off].set(
-            krow.astype(kp.value.dtype), mode="drop")
+            krows.astype(kp.value.dtype), mode="drop")
         vp.value = vp.value.at[page, off].set(
-            vrow.astype(vp.value.dtype), mode="drop")
-        idx.value = jnp.maximum(idx.value, jnp.max(pos_b) + 1)
-        out = paged_attention(
-            q[:, 0], kp.value, vp.value, bt.value, pos_b + 1,
+            vrows.astype(vp.value.dtype), mode="drop")
+        idx.value = jnp.maximum(idx.value, jnp.max(pos) + 1)
+        scales = dict(
             k_scales=ks.value if ks is not None else None,
             v_scales=vs.value if vs is not None else None)
-        return out[:, None]                                      # [B,1,H,D]
+        if s == 1:
+            out = paged_attention(
+                q[:, 0], kp.value, vp.value, bt.value, pos[:, 0] + 1,
+                **scales)
+            return out[:, None]                              # [B,1,H,D]
+        # fills = live tokens INCLUDING the chunk (positions must be
+        # consecutive per row — the chunked-prefill contract)
+        return paged_attention_chunk(
+            q, kp.value, vp.value, bt.value, pos[:, -1] + 1, **scales)
 
     def _cache_vars(self, b, h, d, dtype):
         cfg = self.cfg

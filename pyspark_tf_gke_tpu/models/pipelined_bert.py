@@ -29,6 +29,8 @@ from flax import linen as nn
 from jax import lax
 from jax.sharding import Mesh
 
+from pyspark_tf_gke_tpu.parallel.compat import unbox_without_constraint
+
 from pyspark_tf_gke_tpu.models.bert import BertConfig
 from pyspark_tf_gke_tpu.parallel.pipeline import (
     merge_stages,
@@ -214,7 +216,7 @@ class PipelinedBertClassifier:
 
     def apply(self, variables: Dict[str, Any], input_ids, attention_mask=None,
               token_type_ids=None, train: bool = True) -> Dict[str, jnp.ndarray]:
-        p = nn.meta.unbox(variables["params"])
+        p = unbox_without_constraint(variables["params"])
         cfg = self.cfg
         hidden = self._embed(p, input_ids, token_type_ids, train=train)
         bias = self._bias(input_ids, attention_mask)
@@ -237,7 +239,7 @@ class PipelinedBertClassifier:
                          train: bool = True) -> Dict[str, jnp.ndarray]:
         """Oracle path: same params, plain layer loop, no mesh/pipeline —
         the parity reference for tests."""
-        p = nn.meta.unbox(variables["params"])
+        p = unbox_without_constraint(variables["params"])
         hidden = self._embed(p, input_ids, token_type_ids, train=train)
         bias = self._bias(input_ids, attention_mask)
         flat = merge_stages(p["layers"])
@@ -248,4 +250,4 @@ class PipelinedBertClassifier:
 
     def parameter_count(self, variables) -> int:
         return int(sum(np.prod(l.shape) for l in jax.tree.leaves(
-            nn.meta.unbox(variables["params"]))))
+            unbox_without_constraint(variables["params"]))))

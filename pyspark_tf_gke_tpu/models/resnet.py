@@ -70,6 +70,48 @@ class BottleneckBlock(nn.Module):
 _GAMMA_RELU = 1.7139588594436646
 
 
+def _pin_to_batch_sharding(x: jnp.ndarray) -> jnp.ndarray:
+    """Pin an NHWC activation to the data-parallel batch sharding (the
+    sharding the batch arrives in). The forward is already there; what
+    this buys is the BACKWARD — ``with_sharding_constraint`` transposes
+    to itself, so the cotangents of the NF blocks' elementwise muls
+    stay batch-sharded instead of inheriting the weight-grad reduce's
+    channel sharding, which the dp x fsdp partitioner could only reach
+    by involuntary full rematerialization (a replicate-then-reshard
+    warning per block on the MULTICHIP trail). No-op off-mesh."""
+    from jax.interpreters import pxla
+    from jax.sharding import PartitionSpec as P
+
+    from pyspark_tf_gke_tpu.parallel.mesh import DATA_AXES
+
+    mesh = pxla.thread_resources.env.physical_mesh
+    if mesh is None or mesh.empty or mesh.shape.get("fsdp", 1) <= 1:
+        return x
+    from jax.sharding import NamedSharding
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(DATA_AXES, *([None] * (x.ndim - 1)))))
+
+
+def _pin_to_param_sharding(w: jnp.ndarray) -> jnp.ndarray:
+    """``with_sharding_constraint`` to the sharding ``fsdp_shardings``
+    gives a param of this shape (the shape-based partitioner ResNets
+    use), read from the ambient mesh context — a no-op off-mesh or
+    without an fsdp axis, so single-chip and dp-only runs are
+    untouched."""
+    from jax.interpreters import pxla
+
+    mesh = pxla.thread_resources.env.physical_mesh
+    if mesh is None or mesh.empty or mesh.shape.get("fsdp", 1) <= 1:
+        return w
+    from jax.sharding import NamedSharding
+
+    from pyspark_tf_gke_tpu.parallel.sharding import fsdp_spec
+
+    return jax.lax.with_sharding_constraint(
+        w, NamedSharding(mesh, fsdp_spec(w.shape, mesh)))
+
+
 class WSConv(nn.Module):
     """Scaled weight-standardized conv for the normalizer-free variant.
 
@@ -110,6 +152,17 @@ class WSConv(nn.Module):
         var = w.var(axis=(0, 1, 2), keepdims=True)
         w = (w - mean) * jax.lax.rsqrt(var * fan_in + 1e-4)
         w = w * gain[None, None, None, :]
+        # Pin the standardized kernel (and with it the whole
+        # standardization chain's backward) to the PARAM's fsdp
+        # sharding: without the explicit constraint the dp x fsdp
+        # partitioner propagates the batch sharding from the conv side
+        # into the weight-standardization muls and then "involuntarily
+        # fully rematerializes" (replicates) the tensor to reach the
+        # param sharding the gradient needs — an spmd_partitioner
+        # warning per block on the MULTICHIP trail. Function-of-params
+        # stays sharded like the params; the conv's all-gather happens
+        # once, on the finished kernel.
+        w = _pin_to_param_sharding(w)
         y = jax.lax.conv_general_dilated(
             x.astype(self.dtype), w.astype(self.dtype), self.strides,
             self.padding,
@@ -141,17 +194,20 @@ class NFBottleneckBlock(nn.Module):
     def __call__(self, x):
         f = self.features
         conv = functools.partial(WSConv, dtype=self.dtype)
-        y = (nn.relu(x.astype(jnp.float32)) *
-             (_GAMMA_RELU / self.beta)).astype(self.dtype)
+        y = _pin_to_batch_sharding(
+            (nn.relu(x.astype(jnp.float32)) *
+             (_GAMMA_RELU / self.beta)).astype(self.dtype))
         needs_proj = self.strides != (1, 1) or x.shape[-1] != 4 * f
         # transition blocks route the shortcut through the NORMALIZED
         # pre-activation (variance resets to ~1 downstream)
         shortcut = conv(4 * f, (1, 1), self.strides,
                         name="conv_proj")(y) if needs_proj else x
         z = conv(f, (1, 1), name="conv1")(y)
-        z = (nn.relu(z.astype(jnp.float32)) * _GAMMA_RELU).astype(self.dtype)
+        z = _pin_to_batch_sharding(
+            (nn.relu(z.astype(jnp.float32)) * _GAMMA_RELU).astype(self.dtype))
         z = conv(f, (3, 3), self.strides, name="conv2")(z)
-        z = (nn.relu(z.astype(jnp.float32)) * _GAMMA_RELU).astype(self.dtype)
+        z = _pin_to_batch_sharding(
+            (nn.relu(z.astype(jnp.float32)) * _GAMMA_RELU).astype(self.dtype))
         z = conv(4 * f, (1, 1), name="conv3")(z)
         skip_gain = self.param("skip_gain", nn.initializers.zeros_init(),
                                (), jnp.float32)

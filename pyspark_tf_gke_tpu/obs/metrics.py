@@ -512,6 +512,21 @@ def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
         "serve_engine_rebuilds_total": r.counter(
             "serve_engine_rebuilds_total",
             "Slot-engine rebuilds after a failed device step"),
+        # chunked prefill / token-level scheduling
+        "serve_tbt_ms": r.histogram(
+            "serve_tbt_ms",
+            "Time between consecutive token deliveries to one request "
+            "(a decode chunk lands as one delivery); prefill "
+            "head-of-line stalls appear as tail buckets here"),
+        "serve_prefill_chunk_tokens": r.histogram(
+            "serve_prefill_chunk_tokens",
+            "Prompt tokens per chunked-prefill piece (one observation "
+            "per piece; whole-prompt admissions don't observe)",
+            buckets=(32, 64, 128, 256, 512, 1024, 2048, 4096)),
+        "serve_prefill_inflight": r.gauge(
+            "serve_prefill_inflight",
+            "1 while a chunked-prefill admission is mid-flight "
+            "(prompt pieces interleaving with decode chunks)"),
         # paged KV cache (engine-managed page pool; zero unless the
         # engine runs a paged model)
         "serve_kv_pages_total": r.gauge(

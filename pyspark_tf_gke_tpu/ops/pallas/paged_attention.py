@@ -32,6 +32,15 @@ dot, the same math as the dense slot-decode path in
 reference the interpret-mode kernel is tested against, mirroring
 ``flash_attention.py``'s ``interpret=`` pattern so CPU CI exercises
 the identical code path.
+
+Multi-query chunks (``paged_attention_chunk``): chunked prefill writes
+a prompt piece of ``S`` tokens straight into a slot's pages and then
+needs attention FOR those S queries over the slot's prior pages plus
+the piece itself — the same block-table gather with an in-chunk causal
+mask (query ``i`` at absolute position ``fill - S + i`` sees keys at
+positions ``<= fill - S + i``). The single-query decode kernel is the
+``S = 1`` instance of the same program; both share one kernel body, so
+the sweep in ``tools/smoke_check.py --kernels-only`` covers both.
 """
 
 from __future__ import annotations
@@ -51,23 +60,28 @@ except Exception:  # pragma: no cover
 NEG_INF = -1e30
 
 
-def paged_attention_reference(
-    q: jnp.ndarray,            # [B, H, D]
+def paged_attention_chunk_reference(
+    q: jnp.ndarray,            # [B, S, H, D] chunk of query tokens
     k_pages: jnp.ndarray,      # [N, P, H_kv, D] (dtype or int8)
     v_pages: jnp.ndarray,      # [N, P, H_kv, D]
     block_table: jnp.ndarray,  # [B, max_pages] int32; >= N = unallocated
-    fills: jnp.ndarray,        # [B] int32 live tokens per slot
+    fills: jnp.ndarray,        # [B] int32 live tokens INCLUDING the chunk
     k_scales: Optional[jnp.ndarray] = None,  # [N, P, H_kv] f32 (int8 pool)
     v_scales: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """Pure-JAX oracle: gather every table page densely, mask
-    ``k_pos < fill``, softmax in f32 — mathematically identical to the
-    dense slot-decode attention (masked scores contribute exactly 0
-    mass), so it doubles as the parity bridge to the unpaged engine.
-    Rows with ``fills <= 0`` return zeros. Sentinel (out-of-range)
-    table entries are clamped; whatever page they read is masked."""
+    """Pure-JAX oracle for the multi-query chunk: gather every table
+    page densely, mask causally per query (query ``i`` sits at absolute
+    position ``fills - S + i`` and sees keys at positions ``<= fills -
+    S + i``), softmax in f32 — mathematically identical to the dense
+    slot-decode chunk attention in ``models/causal_lm.py`` (masked
+    scores contribute exactly 0 mass). The chunk's own K/V must already
+    be IN the pages (the caller writes before attending — in-chunk
+    causality then falls out of the same position mask). Query rows
+    with no valid key (``fills - S + i < 0``, incl. ``fills <= 0``
+    empty slots) return zeros. Sentinel (out-of-range) table entries
+    are clamped; whatever page they read is masked."""
     n, p_sz, hkv, d = k_pages.shape
-    b, h, _ = q.shape
+    b, s, h, _ = q.shape
     mp = block_table.shape[1]
     g = h // hkv
     safe = jnp.minimum(block_table, n - 1)
@@ -78,21 +92,43 @@ def paged_attention_reference(
         vs = v_scales[safe].reshape(b, mp * p_sz, hkv)
         k = (k.astype(jnp.float32) * ks[..., None]).astype(q.dtype)
         v = (v.astype(jnp.float32) * vs[..., None]).astype(q.dtype)
-    q4 = q.reshape(b, hkv, g, d)
-    scores = jnp.einsum("bhgd,bkhd->bhgk", q4, k,
+    q5 = q.reshape(b, s, hkv, g, d)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k,
                         preferred_element_type=jnp.float32) * (d ** -0.5)
-    valid = jnp.arange(mp * p_sz)[None, :] < fills[:, None]      # [B, K]
-    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    q_abs = fills[:, None] - s + jnp.arange(s)[None, :]          # [B, S]
+    valid = (jnp.arange(mp * p_sz)[None, None, :]
+             <= q_abs[:, :, None])                               # [B, S, K]
+    scores = jnp.where(valid[:, None, None, :, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhgk,bkhd->bhgd", probs, v).reshape(b, h, d)
-    return jnp.where(fills[:, None, None] > 0, out, 0).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(b, s, h, d)
+    return jnp.where(q_abs[:, :, None, None] >= 0, out, 0).astype(q.dtype)
+
+
+def paged_attention_reference(
+    q: jnp.ndarray,            # [B, H, D]
+    k_pages: jnp.ndarray,      # [N, P, H_kv, D] (dtype or int8)
+    v_pages: jnp.ndarray,      # [N, P, H_kv, D]
+    block_table: jnp.ndarray,  # [B, max_pages] int32; >= N = unallocated
+    fills: jnp.ndarray,        # [B] int32 live tokens per slot
+    k_scales: Optional[jnp.ndarray] = None,  # [N, P, H_kv] f32 (int8 pool)
+    v_scales: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Single-query decode oracle: the ``S = 1`` case of the chunk
+    reference (query at position ``fill - 1`` masks ``k_pos < fill``).
+    Rows with ``fills <= 0`` return zeros."""
+    return paged_attention_chunk_reference(
+        q[:, None], k_pages, v_pages, block_table, fills,
+        k_scales=k_scales, v_scales=v_scales)[:, 0]
 
 
 def _paged_kernel(bt_ref, fills_ref, q_ref, kp_ref, vp_ref, *rest,
-                  page_size: int, hkv: int, scale: float, quant: bool):
-    # Shapes: q [1, H, D]; kp/vp [1, P, Hkv, D] (the table-gathered
-    # page); with quant also ks/vs [1, P, Hkv] f32; o [1, H, D];
-    # scratch m/l [H, 1] f32, acc [H, D] f32.
+                  page_size: int, hkv: int, scale: float, quant: bool,
+                  s_q: int):
+    # Shapes: q [1, S, H, D] (S = s_q query tokens — 1 on the decode
+    # path); kp/vp [1, P, Hkv, D] (the table-gathered page); with quant
+    # also ks/vs [1, P, Hkv] f32; o [1, S, H, D]; scratch m/l
+    # [S*H, 1] f32, acc [S*H, D] f32, rows laid out kv-head-major:
+    # row = hk * (S * G) + s * G + g.
     if quant:
         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -111,8 +147,8 @@ def _paged_kernel(bt_ref, fills_ref, q_ref, kp_ref, vp_ref, *rest,
 
     @pl.when(j < live_pages)
     def _accumulate():
-        q = q_ref[0]                                 # [H, D]
-        h, d = q.shape
+        q = q_ref[0]                                 # [S, H, D]
+        s, h, d = q.shape
         g = h // hkv
         k = kp_ref[0]                                # [P, Hkv, D]
         v = vp_ref[0]
@@ -120,18 +156,24 @@ def _paged_kernel(bt_ref, fills_ref, q_ref, kp_ref, vp_ref, *rest,
             k = (k.astype(jnp.float32) * ks_ref[0][..., None]).astype(q.dtype)
             v = (v.astype(jnp.float32) * vs_ref[0][..., None]).astype(q.dtype)
         # Per-KV-head 2D dots (Mosaic wants plain matmuls): each cached
-        # KV head is read ONCE for its whole query group — the GQA
-        # bandwidth win survives paging.
+        # KV head is read ONCE for its whole query group x chunk — the
+        # GQA bandwidth win survives paging and chunking alike.
         rows = []
         for hk in range(hkv):
             rows.append(jax.lax.dot_general(
-                q[hk * g:(hk + 1) * g], k[:, hk, :],
+                q[:, hk * g:(hk + 1) * g].reshape(s * g, d), k[:, hk, :],
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32))
-        scores = jnp.concatenate(rows, axis=0) * scale       # [H, P] f32
+        scores = jnp.concatenate(rows, axis=0) * scale   # [S*H, P] f32
+        # Causal mask per query row: row r holds query s_idx = (r mod
+        # S*G) // G at absolute position fill - S + s_idx; it sees keys
+        # at positions <= that. S = 1 degenerates to k_pos < fill (the
+        # decode mask).
         k_pos = j * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, page_size), 1)
-        scores = jnp.where(k_pos < fill, scores, NEG_INF)
+            jnp.int32, (s * h, page_size), 1)
+        r = jax.lax.broadcasted_iota(jnp.int32, (s * h, page_size), 0)
+        q_abs = fill - s + (r % (s * g)) // g
+        scores = jnp.where(k_pos <= q_abs, scores, NEG_INF)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, scores.max(axis=1, keepdims=True))
         p = jnp.exp(scores - m_new)
@@ -139,7 +181,8 @@ def _paged_kernel(bt_ref, fills_ref, q_ref, kp_ref, vp_ref, *rest,
         outs = []
         for hk in range(hkv):
             outs.append(jax.lax.dot_general(
-                p[hk * g:(hk + 1) * g].astype(v.dtype), v[:, hk, :],
+                p[hk * (s * g):(hk + 1) * (s * g)].astype(v.dtype),
+                v[:, hk, :],
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32))
         m_ref[:] = m_new
@@ -150,15 +193,26 @@ def _paged_kernel(bt_ref, fills_ref, q_ref, kp_ref, vp_ref, *rest,
     def _finalize():
         m = m_ref[:]
         l = l_ref[:]
-        valid = m > NEG_INF / 2              # slots with >= 1 live token
+        valid = m > NEG_INF / 2      # query rows with >= 1 live key
         l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = jnp.where(valid, acc_ref[:] / l, 0.0).astype(o_ref.dtype)
+        out = jnp.where(valid, acc_ref[:] / l, 0.0)      # [S*H, D]
+        s, h, d = o_ref.shape[1:]
+        g = h // hkv
+        if s_q == 1:
+            # kv-head-major row layout IS head order when S = 1 — keep
+            # the decode path free of the transpose below
+            o_ref[0] = out.reshape(1, h, d).astype(o_ref.dtype)
+        else:
+            out = out.reshape(hkv, s, g, d).transpose(1, 0, 2, 3)
+            o_ref[0] = out.reshape(s, h, d).astype(o_ref.dtype)
 
 
 def _paged_pallas(q, k_pages, v_pages, block_table, fills, k_scales,
                   v_scales, interpret: bool):
+    # q arrives [B, S, H, D]; S is static (one compiled program per
+    # chunk width — the engine uses exactly one width plus S=1 decode).
     n, p_sz, hkv, d = k_pages.shape
-    b, h, _ = q.shape
+    b, s_q, h, _ = q.shape
     mp = block_table.shape[1]
     quant = k_scales is not None
 
@@ -172,7 +226,7 @@ def _paged_pallas(q, k_pages, v_pages, block_table, fills, k_scales,
         page = bt[i, jnp.minimum(j, last)]
         return jnp.minimum(page, n - 1), 0, 0, 0
 
-    q_spec = pl.BlockSpec((1, h, d), lambda i, j, bt, f: (i, 0, 0))
+    q_spec = pl.BlockSpec((1, s_q, h, d), lambda i, j, bt, f: (i, 0, 0, 0))
     page_spec = pl.BlockSpec((1, p_sz, hkv, d), page_map)
     in_specs = [q_spec, page_spec, page_spec]
     args = [q, k_pages, v_pages]
@@ -187,19 +241,20 @@ def _paged_pallas(q, k_pages, v_pages, block_table, fills, k_scales,
         num_scalar_prefetch=2,
         grid=(b, mp),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, h, d), lambda i, j, bt, f: (i, 0, 0)),
+        out_specs=pl.BlockSpec((1, s_q, h, d),
+                               lambda i, j, bt, f: (i, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((h, 1), jnp.float32),
-            pltpu.VMEM((h, 1), jnp.float32),
-            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((s_q * h, 1), jnp.float32),
+            pltpu.VMEM((s_q * h, 1), jnp.float32),
+            pltpu.VMEM((s_q * h, d), jnp.float32),
         ],
     )
     kernel = functools.partial(_paged_kernel, page_size=p_sz, hkv=hkv,
-                               scale=d ** -0.5, quant=quant)
+                               scale=d ** -0.5, quant=quant, s_q=s_q)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, s_q, h, d), q.dtype),
         interpret=interpret,
     )(block_table.astype(jnp.int32), fills.astype(jnp.int32), *args)
 
@@ -220,16 +275,38 @@ def paged_attention(
     falls back to the pure-JAX reference — the same dispatch contract
     as ``flash_attention``; ``interpret=True`` forces the kernel in
     interpret mode (tests / numerics oracle)."""
+    return paged_attention_chunk(
+        q[:, None], k_pages, v_pages, block_table, fills,
+        k_scales=k_scales, v_scales=v_scales, interpret=interpret)[:, 0]
+
+
+def paged_attention_chunk(
+    q: jnp.ndarray,            # [B, S, H, D] chunk of query tokens
+    k_pages: jnp.ndarray,      # [N, P, H_kv, D]
+    v_pages: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, max_pages] int32
+    fills: jnp.ndarray,        # [B] int32 live tokens INCLUDING the
+    #                            chunk's S (query i sits at fill-S+i;
+    #                            0 = empty slot -> zeros)
+    k_scales: Optional[jnp.ndarray] = None,
+    v_scales: Optional[jnp.ndarray] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Multi-query chunk attention through a block table (chunked
+    prefill: the chunk's K/V are already in the pages; each query masks
+    causally at its own absolute position). Returns ``[B, S, H, D]``.
+    ``S`` is static — one compiled program per chunk width. Dispatch
+    contract matches :func:`paged_attention`."""
     if (k_scales is None) != (v_scales is None):
         raise ValueError("k_scales and v_scales must be passed together")
-    h, hkv = q.shape[1], k_pages.shape[2]
+    h, hkv = q.shape[2], k_pages.shape[2]
     if h % hkv:
         raise ValueError(f"num_kv_heads {hkv} must divide num_heads {h}")
     if interpret is None:
         from pyspark_tf_gke_tpu.ops.pallas.common import on_tpu
 
         if pltpu is None or not on_tpu():
-            return paged_attention_reference(
+            return paged_attention_chunk_reference(
                 q, k_pages, v_pages, block_table, fills,
                 k_scales=k_scales, v_scales=v_scales)
         interpret = False

@@ -106,6 +106,10 @@ class _Request:
     # never admit, in-slot ones free their KV slot immediately
     deadline: Optional[float] = None
     expired: bool = False
+    # time.monotonic() of the last token delivery — the per-request
+    # time-between-tokens (serve_tbt_ms) clock; None until the first
+    # tokens land (the first gap is TTFT, not TBT)
+    last_emit: Optional[float] = None
 
 
 def _prefill_padded(model: CausalLM, params, padded_ids, true_len):
@@ -400,6 +404,72 @@ def _insert_slots_batch_paged(state: SlotState, caches, logits, slots,
         temps=state.temps.at[slots].set(temps, mode="drop"),
         topps=state.topps.at[slots].set(topps, mode="drop"),
         keys=state.keys.at[slots].set(keys, mode="drop"))
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _paged_prefill_chunk(model: CausalLM, params, state: SlotState,
+                         padded, fill, true_len, row):
+    """One chunked-prefill piece written STRAIGHT into the page pool
+    (no dense staging cache, no scatter): a batch-1 multi-token
+    slot-decode forward whose cache view aliases the shared pool
+    leaves but substitutes ``row`` (the admission's sentinel-padded
+    page allocation) for the block table — the SLOT STATE's own table
+    row stays at the sentinel until activation, so interleaved decode
+    chunks' dead-row writes for the reserved slot drop instead of
+    corrupting the half-written prompt. Returns ``(state with updated
+    pool leaves, logits at the piece's last REAL token)``. Width is
+    static: one compiled program per piece width."""
+    from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
+
+    def view(pool):
+        out = dict(pool)
+        out["block_table"] = row[None]
+        return out
+
+    cache1 = _map_paged_layers(state.cache, view)
+    w = padded.shape[1]
+    positions = (fill + jnp.arange(w))[None, :]
+    logits, mutated = model.apply(
+        {"params": dequantize_tree(params), "cache": cache1}, padded,
+        decode=True, slot_decode=True, positions=positions,
+        mutable=["cache"])
+
+    def merge(pool, new):
+        out = dict(pool)
+        for key in ("k_pages", "v_pages", "k_scale_pages",
+                    "v_scale_pages"):
+            if key in pool:
+                out[key] = new[key]
+        out["index"] = jnp.maximum(pool["index"], new["index"])
+        return out
+
+    cache = _map_paged_layers(state.cache, merge, mutated["cache"])
+    last = jnp.take_along_axis(
+        logits, (true_len - 1)[None, None, None], axis=1)[:, 0]
+    return state._replace(cache=cache), last
+
+
+@jax.jit
+def _activate_slot_paged(state: SlotState, slot, row, fill, logits1,
+                         temp, topp, key) -> SlotState:
+    """Chunked-prefill admission complete: point the slot's block-table
+    row at the admission's pages (every piece already lives in them)
+    and flip the slot live with its fill level, carried logits and
+    sampling lane — the paged analog of ``_insert_slot`` with no cache
+    rows to move."""
+    def layer(pool):
+        out = dict(pool)
+        out["block_table"] = pool["block_table"].at[slot].set(row)
+        return out
+
+    return SlotState(
+        cache=_map_paged_layers(state.cache, layer),
+        positions=state.positions.at[slot].set(fill),
+        last_logits=state.last_logits.at[slot].set(logits1[0]),
+        live=state.live.at[slot].set(True),
+        temps=state.temps.at[slot].set(temp),
+        topps=state.topps.at[slot].set(topp),
+        keys=state.keys.at[slot].set(key))
 
 
 @jax.jit
@@ -723,6 +793,43 @@ class SlotDeviceState:
                     jnp.asarray(true_lens, jnp.int32),
                     jnp.asarray(temps), jnp.asarray(topps), keys)
 
+    def prefill_chunk(self, padded: np.ndarray, fill: int,
+                      true_len: int, row):
+        """Write one chunked-prefill piece straight into the page pool
+        through ``row`` (paged models only). The slot's own table row
+        keeps the sentinel until :meth:`activate_slot`. Returns the
+        piece's last-real-token logits as a DEVICE array (no readback
+        — only the final piece's logits are ever consumed, by the
+        activation)."""
+        if not self.paged:
+            raise ValueError(
+                "prefill_chunk writes into the paged pool; dense "
+                "engines stage chunked prefill on batch-1 trees")
+        with self._mesh_ctx():
+            if self.state is None:
+                self.state = self._init_state(None)  # paged shapes come
+                #   from the model config, not a prefill template
+            self.state, logits1 = _paged_prefill_chunk(
+                self.model, self.params, self.state, jnp.asarray(padded),
+                jnp.asarray(fill, jnp.int32),
+                jnp.asarray(true_len, jnp.int32),
+                jnp.asarray(row, jnp.int32))
+            return logits1
+
+    def activate_slot(self, slot: int, fill: int, logits1, row,
+                      temperature: float = 0.0, top_p: float = 1.0,
+                      seed: int = 0) -> None:
+        """Flip a chunk-admitted slot live: block-table row, fill
+        level, carried logits, sampling lane (paged models only)."""
+        with self._mesh_ctx():
+            self.state = _activate_slot_paged(
+                self.state, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(row, jnp.int32),
+                jnp.asarray(fill, jnp.int32), logits1,
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(top_p, jnp.float32),
+                _seed_key_data(seed))
+
     def chunk_async(self, chunk: int, eos_token_id: Optional[int],
                     pad_id: int, sampling: bool = False):
         """Dispatch one decode chunk over all slots (``sampling``
@@ -787,6 +894,7 @@ class ContinuousEngine:
                  mesh=None, announce: bool = False,
                  prefix_cache_size: int = 0,
                  prefill_chunk: int = 0,
+                 step_token_budget: int = 0,
                  pipeline_depth: int = 0,
                  adaptive_chunk: bool = False,
                  batch_admit: bool = True,
@@ -858,12 +966,29 @@ class ContinuousEngine:
                 f"prefill_chunk must be 0 (off) or >= 32, got "
                 f"{prefill_chunk} (tiny pieces spend more dispatches "
                 "than they save)")
-        if prefill_chunk and announce:
-            # the piecewise extends are not on the OP_CB_* wire yet —
-            # same single-host gate as the prefix cache
+        paged = bool(getattr(model.cfg, "paged_kv", False))
+        if prefill_chunk and announce and not paged:
+            # the DENSE piecewise extends are not on the OP_CB_* wire
+            # (batch-1 staging trees live only on process 0); the paged
+            # route IS — chunk progress rides OP_CB_ADMIT
             raise ValueError(
-                "chunked prefill is single-host only (announce mode)")
+                "dense chunked prefill is single-host only (announce "
+                "mode); the paged engine replays chunk progress over "
+                "the wire")
         self.prefill_chunk = prefill_chunk
+        if step_token_budget < 0:
+            raise ValueError(
+                f"step_token_budget must be >= 0, got {step_token_budget}")
+        # step_token_budget ("Sarathi-style" iteration budget): cap the
+        # work one engine step dispatches at ~this many tokens, split
+        # between ONE prefill piece (chunked admission, up to
+        # prefill_chunk tokens) and the decode chunk (live_slots x
+        # steps tokens) — so a 4k-token arrival costs every streaming
+        # slot a bounded stall per step instead of a whole-prompt
+        # prefill. Decode steps are bucketed to powers of two (jit
+        # cache: log2(chunk) programs), floored at 1 so the engine
+        # always makes progress. 0 = off (fixed decode chunk).
+        self.step_token_budget = int(step_token_budget)
         if prefix_cache_size and announce:
             # the prefix entries and the extend op are not on the
             # OP_CB_* wire (worker replicas would need the LRU too) —
@@ -904,13 +1029,14 @@ class ContinuousEngine:
             if s_max % ps:
                 raise ValueError(
                     f"kv_page_size {ps} must divide max_seq_len {s_max}")
-            if prefix_cache_size or prefill_chunk:
-                # both build/extend dense batch-1 cache trees that the
+            if prefix_cache_size:
+                # prefix entries are dense batch-1 cache trees the
                 # paged insert cannot consume incrementally — dense
-                # engines keep them; wire them onto pages in a later PR
+                # engines keep them; chunked prefill, by contrast,
+                # writes pieces STRAIGHT into the pool (no staging)
                 raise ValueError(
-                    "prefix caching / chunked prefill are unsupported "
-                    "with the paged KV cache")
+                    "prefix caching is unsupported with the paged KV "
+                    "cache")
             # prefill rows scatter whole pages, so every admissible
             # bucket must be page-aligned
             self.buckets = tuple(b for b in self.buckets if b % ps == 0)
@@ -942,6 +1068,10 @@ class ContinuousEngine:
         # passes its own); default is the process registry.
         self._obs = obs if obs is not None else platform_families()
         self._obs["serve_slots_total"].set(num_slots)
+        self._n_prefill_chunks = 0  # pieces processed (all admissions)
+        self._step_prefill_tokens = 0  # this step's piece tokens (the
+        #   budget split's prefill half; reset at each step() top)
+        self._obs["serve_prefill_inflight"].set(0)
         if self.paged:
             self._obs["serve_kv_pages_total"].set(model.cfg.kv_num_pages)
             self._update_page_gauges()
@@ -966,9 +1096,24 @@ class ContinuousEngine:
             raise ValueError(
                 f"prompt {prompt.size} + {max_new_tokens} new tokens "
                 f"exceeds max_seq_len {self.model.cfg.max_seq_len}")
-        sb = bucket_length(prompt.size, self.buckets)  # raises if oversized
+        chunked_route = bool(self.prefill_chunk
+                             and prompt.size > self.prefill_chunk)
+        if not chunked_route:
+            # raises if no bucket fits; chunked-route prompts never
+            # touch a bucket (pieces are prefill_chunk-wide, and the
+            # dense remainder paths quantize to 32-multiples), so
+            # their only bound is max_seq_len, checked above
+            sb = bucket_length(prompt.size, self.buckets)
         if self.paged:
-            need = self._pages_needed(sb, prompt.size, max_new_tokens)
+            if chunked_route:
+                # chunked route: pieces write real tokens only — no
+                # padded-bucket scatter, so the bound is the true
+                # token extent, not the bucket's
+                need = -(-(prompt.size + max_new_tokens)
+                         // self.model.cfg.kv_page_size)
+            else:
+                need = self._pages_needed(sb, prompt.size,
+                                          max_new_tokens)
             total = self.model.cfg.kv_num_pages
             if need > total:
                 # with the whole pool free this request still couldn't
@@ -1035,9 +1180,10 @@ class ContinuousEngine:
                 return True
         if (self._admitting is not None
                 and self._admitting["req"].rid == rid):
-            # mid-admission: drop the partial tree; the reserved slot
-            # was never inserted, so nothing to free on device
-            self._admitting = None
+            # mid-admission: drop the partial tree (paged: return the
+            # held pages); the reserved slot was never inserted/
+            # activated, so nothing live to free on device
+            self._drop_admitting()
             return True
         return False
 
@@ -1112,6 +1258,23 @@ class ContinuousEngine:
         and one is already in flight, or (paged mode) the page pool
         cannot cover it yet (FIFO holds; the request stays queued)."""
         if self.paged:
+            if (self.prefill_chunk
+                    and req.prompt.size > self.prefill_chunk):
+                if self._admitting is not None:
+                    return False  # one piecewise admission at a time
+                # paged chunked prefill: pieces write straight into the
+                # pool; pages allocate page-by-page as pieces land and
+                # the slot's table row stays at the sentinel until the
+                # final piece activates it
+                cfg = self.model.cfg
+                self._admitting = {
+                    "slot": slot, "req": req, "fill": 0, "paged": True,
+                    "row": np.full((cfg.max_pages_per_slot,),
+                                   cfg.kv_num_pages, np.int32),
+                    "pages": [],
+                }
+                self._advance_admission()
+                return True
             sb = bucket_length(req.prompt.size, self.buckets)
             alloc = self._alloc_pages(self._pages_needed(
                 sb, req.prompt.size, req.max_new_tokens))
@@ -1237,7 +1400,13 @@ class ContinuousEngine:
         """One piece of the in-flight chunked prefill: width is ALWAYS
         ``prefill_chunk`` (one compiled prefill + one compiled extend,
         regardless of prompt length); the final piece inserts the
-        finished tree into the reserved slot."""
+        finished tree into the reserved slot. Tokens processed land in
+        ``_step_prefill_tokens`` (via ``_note_prefill_piece``) — the
+        step-budget accounting, which must also see pieces run from
+        ``_try_admit`` inside ``_admit_waiting``, not only the
+        step-top call."""
+        if self._admitting.get("paged"):
+            return self._advance_admission_paged()
         a = self._admitting
         req, fill = a["req"], a["fill"]
         # clamp the piece width to the room left under max_seq_len: a
@@ -1262,6 +1431,7 @@ class ContinuousEngine:
                     jnp.asarray(padded), jnp.asarray(fill, jnp.int32),
                     jnp.asarray(piece.size, jnp.int32))
         a["cache1"], a["fill"] = cache1, fill + piece.size
+        self._note_prefill_piece(piece.size)
         if a["fill"] == req.prompt.size:
             self._device.insert(
                 cache1, logits1, a["slot"], req.prompt.size,
@@ -1270,6 +1440,93 @@ class ContinuousEngine:
                 seed=int(req.seed))
             self._slots[a["slot"]] = req
             self._admitting = None
+
+    def _note_prefill_piece(self, n: int) -> None:
+        self._n_prefill_chunks += 1
+        self._step_prefill_tokens += int(n)
+        self._obs["serve_prefill_chunk_tokens"].observe(n)
+
+    def _advance_admission_paged(self) -> None:
+        """One piece of a PAGED chunked-prefill admission: extend the
+        page allocation to cover the piece (page-by-page, as chunks
+        land), run the batch-1 multi-token slot-decode forward that
+        writes the piece's K/V straight into the pool, and — on the
+        final piece — claim the decode extent's pages and activate the
+        slot. Announce mode replays the identical piece (fill + row on
+        the OP_CB_ADMIT wire) on every worker. Pool dry -> the
+        admission stalls (no piece; the alloc-failure counter
+        increments once per stalled STEP, so its rate reads as
+        stall duration) and retries at the next chunk boundary after
+        frees."""
+        a = self._admitting
+        req, fill = a["req"], a["fill"]
+        cfg = self.model.cfg
+        ps = cfg.kv_page_size
+        # same near-context-limit clamp as the dense path: a full-width
+        # pad past max_seq_len would write real rows at clamped
+        # positions
+        w = min(self.prefill_chunk, cfg.max_seq_len - fill)
+        piece = req.prompt[fill:fill + w]
+        final = fill + piece.size == req.prompt.size
+        # pages covering the piece's REAL tokens; the final piece also
+        # claims the full decode extent — the engine never allocates
+        # mid-decode (PR 2's zero-recompile invariant)
+        need_tokens = (req.prompt.size + req.max_new_tokens if final
+                       else fill + piece.size)
+        need = -(-need_tokens // ps) - len(a["pages"])
+        if need > 0:
+            if need > len(self._free_pages):
+                self._n_page_alloc_failures += 1
+                self._obs["serve_kv_page_alloc_failures_total"].inc()
+                return  # stall; frees at later chunk boundaries
+                #         return pages and the admission resumes
+            taken = [self._free_pages.pop() for _ in range(need)]
+            a["row"][len(a["pages"]):len(a["pages"]) + need] = taken
+            a["pages"].extend(taken)
+            self._update_page_gauges()
+        padded = right_pad(piece, w, self.pad_id)
+        sampling = (float(req.temperature),
+                    float(req.top_p if req.top_p is not None else 1.0),
+                    int(req.seed))
+
+        def device():
+            logits1 = self._device.prefill_chunk(
+                padded, fill, piece.size, a["row"])
+            if final:
+                self._device.activate_slot(
+                    a["slot"], req.prompt.size, logits1, a["row"],
+                    *sampling)
+
+        try:
+            self._announced(
+                lambda wire: wire.announce_cb_admit(
+                    self.num_slots, padded, piece.size, a["slot"],
+                    self.eos_token_id, self.pad_id,
+                    sampling=sampling if final else None,
+                    pages=a["row"], chunk_fill=fill, final=final),
+                device)
+        except BaseException:
+            # a failed piece must not leak the admission's pages (the
+            # caller may keep driving this engine)
+            self._drop_admitting()
+            raise
+        a["fill"] = fill + piece.size
+        self._note_prefill_piece(piece.size)
+        if final:
+            self._slots[a["slot"]] = req
+            self._note_pages(a["slot"], a["pages"])
+            self._admitting = None
+
+    def _drop_admitting(self) -> None:
+        """Abandon the in-flight piecewise admission (cancel, deadline,
+        failed piece): paged admissions return their pages to the free
+        list — the slot's table row was never set, so whatever the
+        pieces wrote is unreachable and safely overwritten by the
+        pages' next owner."""
+        a, self._admitting = self._admitting, None
+        if a is not None and a.get("paged") and a["pages"]:
+            self._free_pages.extend(a["pages"])
+            self._update_page_gauges()
 
     def _admit_batch(self, free: List[int]) -> None:
         """Batched-admission fast path (single-host): take the FIFO
@@ -1377,10 +1634,11 @@ class ContinuousEngine:
         if (self._admitting is not None
                 and self._admitting["req"].deadline is not None
                 and now > self._admitting["req"].deadline):
-            # partial cache tree dropped; the reserved slot was never
-            # inserted, so nothing to free on device
+            # partial cache tree dropped (paged: pages returned); the
+            # reserved slot was never inserted/activated, so nothing
+            # live to free on device
             expired.append(self._admitting["req"])
-            self._admitting = None
+            self._drop_admitting()
         for req in expired:
             req.expired = True
             req.done = True
@@ -1454,6 +1712,23 @@ class ContinuousEngine:
         return min(b, self.chunk)  # an engine configured below the
         #   floor keeps its own (smaller) chunk size
 
+    def _budget_cap(self, prefill_tokens: int) -> Optional[int]:
+        """Decode steps the step-token budget leaves after this step's
+        prefill piece: (budget - piece) / live_slots, bucketed DOWN to
+        a power of two (jit cache: <= log2(chunk) sizes) and floored at
+        1 (a piece bigger than the budget must not starve decode — the
+        budget bounds the stall, it never stops token flow). None =
+        budget off."""
+        if not self.step_token_budget:
+            return None
+        live = max(len(self._slots), 1)
+        steps = max((self.step_token_budget - int(prefill_tokens))
+                    // live, 1)
+        b = 1
+        while b * 2 <= steps:
+            b *= 2
+        return b
+
     def _dispatch_chunk(self, size: int):
         """Dispatch one ``size``-step decode chunk over the current
         slots; returns the in-flight record (arrays + the slot->request
@@ -1500,6 +1775,7 @@ class ContinuousEngine:
                 lambda: self._device.fetch(a, b))
         newly_done = []
         useful_tokens = 0
+        now = time.monotonic()
         for slot, req in snapshot.items():
             if req.done:
                 # freed/cancelled while this chunk was in flight (only
@@ -1514,6 +1790,16 @@ class ContinuousEngine:
                     take = take[:hit[0] + 1]
             new_toks = [int(t) for t in take]
             useful_tokens += len(new_toks)
+            if new_toks:
+                # time-between-tokens, as a CLIENT sees it: the gap
+                # between consecutive token deliveries to one request
+                # (a chunk lands as one delivery). Prefill head-of-line
+                # stalls show up here — the histogram chunked prefill
+                # exists to flatten.
+                if req.last_emit is not None:
+                    self._obs["serve_tbt_ms"].observe(
+                        (now - req.last_emit) * 1000.0)
+                req.last_emit = now
             req.tokens.extend(new_toks)
             if req.on_tokens is not None and new_toks:
                 try:
@@ -1548,18 +1834,28 @@ class ContinuousEngine:
         later, so the device works ahead while the host waits on older
         tokens."""
         expired = self._expire_deadlines()
+        # per-step prefill-token accounting for the budget: pieces run
+        # here AND inside _admit_waiting (a fresh admission's first
+        # piece runs from _try_admit) — the counter sees both, so the
+        # admission-start step's decode chunk is capped too
+        self._step_prefill_tokens = 0
         if self._admitting is not None:
             self._advance_admission()
         self._admit_waiting()
+        self._obs["serve_prefill_inflight"].set(
+            1 if self._admitting is not None else 0)
+        cap = self._budget_cap(self._step_prefill_tokens)
         if not self.pipeline_depth:
             if not self._slots:
                 return expired
+            size = self._effective_chunk() or self.chunk
             return expired + self._collect(
-                self._dispatch_chunk(self._effective_chunk()
-                                     or self.chunk))
+                self._dispatch_chunk(min(size, cap) if cap else size))
         dispatched = False
         if self._slots:
             size = self._effective_chunk()
+            if size and cap:
+                size = min(size, cap)
             if size:  # 0 = every slot's budget is already in flight
                 self._inflight_q.append(self._dispatch_chunk(size))
                 dispatched = True
@@ -1600,6 +1896,9 @@ class ContinuousEngine:
             "batch_admits": self._n_batch_admits,
             "solo_admits": self._n_solo_admits,
             "dispatched_steps": self._n_dispatched_steps,
+            "prefill_chunks": self._n_prefill_chunks,
+            **({"step_token_budget": self.step_token_budget}
+               if self.step_token_budget else {}),
             "admitting": (self._admitting["req"].rid
                           if self._admitting is not None else None),
             "inflight": bool(self._inflight_q),

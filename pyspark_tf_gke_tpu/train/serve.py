@@ -117,13 +117,15 @@ class _ContinuousFront:
     def __init__(self, model, params, eos_id, num_slots: int,
                  chunk: int, mesh=None, announce: bool = False,
                  prefix_cache_size: int = 0, prefill_chunk: int = 0,
+                 step_token_budget: int = 0,
                  pipeline_depth: int = 0, adaptive_chunk: bool = False,
                  schedule: str = "fifo", obs=None, event_log=None,
                  max_queue_depth: int = 0, max_queued_tokens: int = 0,
                  chaos=None, heartbeat=None):
         self._engine_args = (model, params, eos_id, num_slots, chunk,
                              mesh, announce, prefix_cache_size,
-                             prefill_chunk, pipeline_depth, adaptive_chunk,
+                             prefill_chunk, step_token_budget,
+                             pipeline_depth, adaptive_chunk,
                              schedule)
         self._announce = announce
         self._obs = obs if obs is not None else platform_families()
@@ -158,13 +160,14 @@ class _ContinuousFront:
         from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
 
         (model, params, eos_id, num_slots, chunk, mesh, announce,
-         prefix_cache_size, prefill_chunk, pipeline_depth,
-         adaptive_chunk, schedule) = self._engine_args
+         prefix_cache_size, prefill_chunk, step_token_budget,
+         pipeline_depth, adaptive_chunk, schedule) = self._engine_args
         return ContinuousEngine(model, params, num_slots=num_slots,
                                 chunk=chunk, eos_token_id=eos_id,
                                 mesh=mesh, announce=announce,
                                 prefix_cache_size=prefix_cache_size,
                                 prefill_chunk=prefill_chunk,
+                                step_token_budget=step_token_budget,
                                 pipeline_depth=pipeline_depth,
                                 adaptive_chunk=adaptive_chunk,
                                 schedule=schedule, obs=self._obs)
@@ -435,7 +438,8 @@ class BundleServer:
     def __init__(self, bundle_dir: str, mesh=None, int8_kv: bool = False,
                  draft_bundle_dir: str = "", continuous_slots: int = 0,
                  continuous_chunk: int = 8, prefix_cache_size: int = 0,
-                 prefill_chunk: int = 0, continuous_pipeline: int = 0,
+                 prefill_chunk: int = 0, step_token_budget: int = 0,
+                 continuous_pipeline: int = 0,
                  adaptive_chunk: bool = False, schedule: str = "fifo",
                  registry=None, event_log=None,
                  max_queue_depth: int = 0, max_queued_tokens: int = 0,
@@ -550,6 +554,7 @@ class BundleServer:
                 mesh=mesh, announce=self.multi_host,
                 prefix_cache_size=prefix_cache_size,
                 prefill_chunk=prefill_chunk,
+                step_token_budget=step_token_budget,
                 pipeline_depth=continuous_pipeline,
                 adaptive_chunk=adaptive_chunk,
                 schedule=schedule, obs=self._obs,
@@ -1335,12 +1340,24 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="LRU entries of prefilled shared prompt "
                         "prefixes (POST /v1/warm); requires "
                         "--continuous-slots, single-host")
-    p.add_argument("--prefill-chunk", type=int,
+    p.add_argument("--prefill-chunk", "--prefill-chunk-tokens",
+                   dest="prefill_chunk", type=int,
                    default=int(e("PREFILL_CHUNK", "0")),
                    help="chunked prefill: admit prompts longer than "
                         "this in bounded pieces with decode chunks "
                         "interleaved (0 = whole-prompt prefill; "
-                        "requires --continuous-slots, single-host)")
+                        "requires --continuous-slots; paged engines "
+                        "write pieces straight into the page pool and "
+                        "replay chunk progress over the multi-host "
+                        "wire; dense engines are single-host)")
+    p.add_argument("--step-token-budget", type=int,
+                   default=int(e("STEP_TOKEN_BUDGET", "0")),
+                   help="cap the tokens one engine step dispatches, "
+                        "split between one prefill piece and the "
+                        "decode chunk (live_slots x steps) — bounds "
+                        "time-between-tokens under long-prompt "
+                        "arrivals (0 = off; pair with "
+                        "--prefill-chunk)")
     p.add_argument("--continuous-chunk", type=int,
                    default=int(e("CONTINUOUS_CHUNK", "8")),
                    help="decode steps per engine dispatch between "
@@ -1484,6 +1501,7 @@ def main(argv=None) -> int:
         continuous_chunk=args.continuous_chunk,
         prefix_cache_size=args.prefix_cache,
         prefill_chunk=args.prefill_chunk,
+        step_token_budget=args.step_token_budget,
         continuous_pipeline=args.continuous_pipeline,
         adaptive_chunk=args.adaptive_chunk,
         schedule=args.schedule,

@@ -183,16 +183,24 @@ OP_SPECULATIVE = 3
 # slot engine's DEVICE ops are announced individually so every process
 # mutates an identical SlotDeviceState replica in identical order.
 # ADMIT: [op, num_slots, s_bucket, true_len, eos, slot, pad_id,
-#        has_sampling] + payload padded prompt [1, s_bucket]; when
-#        has_sampling=1 a float payload [temperature, top_p, seed]
-#        follows (per-slot sampling lane — every process seeds the
-#        same per-slot key, so sampled rows stay in lockstep). With a
-#        PAGED model (CausalLMConfig.kv_num_pages) one more payload
-#        follows: the slot's sentinel-padded page allocation
-#        [max_pages_per_slot] int32 — process 0's engine owns the page
-#        pool and every worker replays the identical assignment, so
-#        block tables never diverge. Both sides derive the payload
-#        shape (and whether it exists) from the shared model config.
+#        flags] + payload padded prompt [1, s_bucket]. ``flags`` is a
+#        bitfield: bit0 = has_sampling (a float payload [temperature,
+#        top_p] + an int64 seed follow — per-slot sampling lane; every
+#        process seeds the same per-slot key, so sampled rows stay in
+#        lockstep; plain 0/1 values keep the pre-bitfield wire
+#        readable), bit1 = chunked-prefill PIECE (an int32 payload
+#        [fill] follows the prompt — the piece's start offset; the
+#        worker replays prefill_chunk() into its replica's pool),
+#        bit2 = FINAL piece (the worker also replays activate_slot()
+#        at fill+true_len with the sampling lane — chunk progress on
+#        the wire is what keeps worker block tables bit-identical to
+#        process 0's schedule). With a PAGED model
+#        (CausalLMConfig.kv_num_pages) one more payload follows: the
+#        slot's sentinel-padded page allocation [max_pages_per_slot]
+#        int32 — process 0's engine owns the page pool and every
+#        worker replays the identical assignment, so block tables
+#        never diverge. Both sides derive the payload shape (and
+#        whether it exists) from the shared model config.
 # CHUNK: [op, num_slots, deferred, chunk, eos, has_sampling, pad_id, 0]
 #        (no payload; has_sampling is the STATIC flag choosing the
 #        greedy-only vs sampling-capable compiled chunk program — it
@@ -266,20 +274,30 @@ def mh_lock():
 
 def announce_cb_admit(num_slots: int, padded, true_len: int, slot: int,
                       eos_token_id, pad_id: int,
-                      sampling=None, pages=None) -> None:
+                      sampling=None, pages=None,
+                      chunk_fill=None, final: bool = False) -> None:
     """Process 0 (caller already holds the announce lock): publish one
-    slot-admit op. ``padded`` is the [1, S_bucket] right-padded prompt;
-    ``sampling`` an optional (temperature, top_p, seed) triple for the
-    slot's lane (greedy = (0, 1, 0) or None); ``pages`` the slot's
-    sentinel-padded page allocation (paged engines only — workers know
-    to read it from their own model config)."""
+    slot-admit op. ``padded`` is the [1, S_bucket] right-padded prompt
+    (or one chunked-prefill PIECE); ``sampling`` an optional
+    (temperature, top_p, seed) triple for the slot's lane (greedy =
+    (0, 1, 0) or None); ``pages`` the slot's sentinel-padded page
+    allocation (paged engines only — workers know to read it from
+    their own model config). ``chunk_fill`` marks a chunked-prefill
+    piece starting at that offset; ``final`` marks the piece that
+    activates the slot (paged chunked prefill rides this same op so
+    workers replay the identical piece schedule)."""
     header = np.zeros(_HEADER_LEN, np.int32)
     eos = -1 if eos_token_id is None else int(eos_token_id)
     has_sampling = int(sampling is not None and sampling[0] > 0)
+    flags = has_sampling
+    if chunk_fill is not None:
+        flags |= 2 | (4 if final else 0)
     header[:8] = [OP_CB_ADMIT, num_slots, padded.shape[1], int(true_len),
-                  eos, slot, pad_id, has_sampling]
+                  eos, slot, pad_id, flags]
     _bcast(header)
     _bcast(np.asarray(padded, np.int32))
+    if chunk_fill is not None:
+        _bcast(np.asarray([chunk_fill], np.int32))
     if has_sampling:
         # floats (temperature, top_p) + the seed as its OWN int64
         # payload: a float32 round-trip would corrupt ~all urandom
@@ -575,10 +593,17 @@ def serve_worker_loop(model, params, mesh: Mesh,
             # ordered stream — consume them BEFORE anything that can
             # fail, or a failed op would leave the next header read
             # misaligned
-            padded = samp = pages = None
+            padded = samp = pages = chunk_fill = None
+            final = False
             if op == OP_CB_ADMIT:
+                # header slot 8 is the flags bitfield: bit0 sampling,
+                # bit1 chunked-prefill piece, bit2 final piece
                 padded = np.asarray(_bcast(np.zeros((1, s), np.int32)))
-                if sampling:  # header slot 8: has_sampling
+                if sampling & 2:  # chunked piece: its start offset
+                    chunk_fill = int(np.asarray(
+                        _bcast(np.zeros(1, np.int32)))[0])
+                    final = bool(sampling & 4)
+                if sampling & 1:
                     floats = np.asarray(_bcast(np.zeros(2, np.float32)))
                     seed = int(np.asarray(
                         _bcast(np.zeros(1, np.int64)))[0])
@@ -597,7 +622,21 @@ def serve_worker_loop(model, params, mesh: Mesh,
                     # stale arrays and desync from process 0
                     cb_inflight.clear()
                 if op == OP_CB_ADMIT:
-                    if samp is not None:
+                    if chunk_fill is not None:
+                        # chunked-prefill piece: the replica's pool
+                        # takes the same writes through the same row;
+                        # the final piece activates the slot at the
+                        # prompt's full fill (chunk_fill + true piece
+                        # len) with the sampling lane — identical
+                        # schedule, identical block tables
+                        logits1 = cb_replica.prefill_chunk(
+                            padded, chunk_fill, max_new, pages)
+                        if final:
+                            cb_replica.activate_slot(
+                                aux, chunk_fill + max_new, logits1,
+                                pages, *(samp if samp is not None
+                                         else (0.0, 1.0, 0)))
+                    elif samp is not None:
                         cb_replica.admit_padded(
                             padded, max_new, aux, temperature=samp[0],
                             top_p=samp[1], seed=samp[2], pages=pages)

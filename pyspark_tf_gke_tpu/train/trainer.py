@@ -375,7 +375,16 @@ class Trainer:
         if boxed:
             specs = nn.get_partition_spec(abstract)
             shardings = nn.logical_to_mesh_sharding(specs, self.mesh, self.logical_rules)
-            create_unboxed = lambda r: nn.meta.unbox(create(r))
+
+            # Unbox WITHOUT the in-jit constraint (see the shim's
+            # docstring — raw-Partitioned LOGICAL names crash strict
+            # NamedSharding validation); the jit's ``out_shardings``
+            # below is the placement authority either way.
+            from pyspark_tf_gke_tpu.parallel.compat import (
+                unbox_without_constraint,
+            )
+
+            create_unboxed = lambda r: unbox_without_constraint(create(r))
         else:
             shardings = jax.tree.map(
                 lambda l: NamedSharding(

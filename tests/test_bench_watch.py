@@ -521,8 +521,9 @@ def test_stale_matrix_against_committed_trail():
     # captures them this set just shrinks (subset check still passes).
     queued = {"cnn --adafactor", "resnet50 --gn", "resnet50 --fused-bn",
               "resnet50 --fused-bn3",
-              # round-5/6/7 additions awaiting their first chip window
-              "resnet50 --nf", "cb --paged", "cb --chaos"}
+              # round-5/6/7/8 additions awaiting their first chip window
+              "resnet50 --nf", "cb --paged", "cb --chaos",
+              "cb --chunked-prefill"}
     assert missing <= queued, (
         f"matrix workloads with no trail entry: {sorted(missing - queued)}")
 
@@ -556,6 +557,61 @@ def test_trail_report_keeps_cb_schema_keys():
     out = trail_report.row(e)
     assert "chunk 64" in out and "unpipelined_chunk 16" in out
     assert "pipeline_depth 1" in out
+
+
+def test_variant_regression_guard(monkeypatch):
+    # BENCH_r05: resnet50 --fused-bn at 1481 vs 2431 baseline raised no
+    # flag. The guard must attach the A/B delta and "regression": true
+    # past the 10% threshold — and stay silent within it.
+    base_entry = {"ts": "2026-01-01T00:00:00+00:00", "argv": ["resnet50"],
+                  "result": {"metric": "m", "value": 2431.0,
+                             "unit": "examples/sec/chip"}}
+    monkeypatch.setattr(bench, "_latest_history",
+                        lambda argv: base_entry)
+    result = {"metric": "m", "value": 1481.0, "unit": "examples/sec/chip"}
+    bench.annotate_variant_regression(["resnet50", "--fused-bn"], result)
+    assert result["regression"] is True
+    ab = result["vs_variant_baseline"]
+    assert ab["regression"] is True
+    assert ab["baseline_value"] == 2431.0
+    assert ab["ratio"] == round(1481.0 / 2431.0, 3)
+    # within threshold: delta attached, no regression flag
+    ok = {"metric": "m", "value": 2300.0, "unit": "examples/sec/chip"}
+    bench.annotate_variant_regression(["resnet50", "--fused-bn"], ok)
+    assert "regression" not in ok
+    assert ok["vs_variant_baseline"]["ratio"] == round(2300 / 2431.0, 3)
+    # unit mismatch or no trail entry: silent no-op
+    other = {"metric": "m", "value": 1.0, "unit": "tokens/sec"}
+    bench.annotate_variant_regression(["resnet50", "--fused-bn"], other)
+    assert "vs_variant_baseline" not in other
+    monkeypatch.setattr(bench, "_latest_history", lambda argv: None)
+    miss = {"metric": "m", "value": 1.0, "unit": "examples/sec/chip"}
+    bench.annotate_variant_regression(["resnet50", "--fused-bn"], miss)
+    assert "vs_variant_baseline" not in miss
+    # non-variant workloads and smoke runs never compare
+    plain = {"metric": "m", "value": 1.0, "unit": "examples/sec/chip"}
+    bench.annotate_variant_regression(["resnet50"], plain)
+    bench.annotate_variant_regression(
+        ["resnet50", "--fused-bn", "--smoke"], plain)
+    assert "vs_variant_baseline" not in plain
+
+
+def test_variant_baselines_are_matrix_workloads():
+    # every guard mapping must point at real matrix identities on both
+    # sides, or a renamed argv silently disables its A/B
+    matrix = {" ".join(bench._normalize_argv(w))
+              for w in bench.ALL_WORKLOADS}
+    for variant, base in bench.VARIANT_BASELINES.items():
+        assert variant in matrix, f"unknown variant {variant!r}"
+        assert " ".join(bench._normalize_argv(base)) in matrix, \
+            f"unknown baseline for {variant!r}"
+
+
+def test_chunked_prefill_flag_guards():
+    with pytest.raises(SystemExit):
+        bench.run_bench(["generate", "--chunked-prefill", "--smoke"])
+    with pytest.raises(SystemExit):
+        bench.run_bench(["cb", "--chunked-prefill", "--paged", "--smoke"])
 
 
 def test_fused_bn_flag_guards():

@@ -145,6 +145,115 @@ def test_non_tpu_dispatch_uses_reference():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+# ---- multi-query chunks (chunked prefill) ----------------------------------
+
+
+def _dense_chunk_attend(q, k_dense, v_dense, fills):
+    """The unpaged slot-decode CHUNK math (models/causal_lm
+    ._decode_attend, s>1): query i at absolute position fill - S + i
+    masks ``k_pos <= fill - S + i``."""
+    b, s, h, d = q.shape
+    hkv = k_dense.shape[2]
+    g = h // hkv
+    q5 = q.reshape(b, s, hkv, g, d)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k_dense,
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
+    q_abs = fills[:, None] - s + jnp.arange(s)[None, :]
+    valid = jnp.arange(k_dense.shape[1])[None, None, :] <= q_abs[..., None]
+    scores = jnp.where(valid[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v_dense)
+    return out.reshape(b, s, h, d)
+
+
+def test_chunk_reference_matches_dense_chunk_attention():
+    from pyspark_tf_gke_tpu.ops.pallas.paged_attention import (
+        paged_attention_chunk_reference,
+    )
+
+    rng = np.random.default_rng(10)
+    b, s, hkv, g, d, ps, sq = 4, 32, 2, 3, 8, 8, 5
+    h = hkv * g
+    q = _rand(rng, (b, sq, h, d))
+    k_dense = _rand(rng, (b, s, hkv, d))
+    v_dense = _rand(rng, (b, s, hkv, d))
+    # fills INCLUDE the chunk: min live, mid, page boundary, full
+    fills = jnp.asarray([sq, 13, 16, 32], jnp.int32)
+    kp, vp, table = _paged_from_dense(k_dense, v_dense, ps, 24, rng)
+    ref = paged_attention_chunk_reference(q, kp, vp, table, fills)
+    dense = _dense_chunk_attend(q, k_dense, v_dense, fills)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(dense),
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("g", [1, 4])  # MHA and grouped-query
+def test_chunk_kernel_matches_reference(g):
+    from pyspark_tf_gke_tpu.ops.pallas.paged_attention import (
+        paged_attention_chunk,
+        paged_attention_chunk_reference,
+    )
+
+    rng = np.random.default_rng(11)
+    n, ps, hkv, d, b, mp, sq = 12, 8, 2, 16, 5, 4, 8
+    h = hkv * g
+    kp = _rand(rng, (n, ps, hkv, d))
+    vp = _rand(rng, (n, ps, hkv, d))
+    q = _rand(rng, (b, sq, h, d))
+    table = jnp.asarray(rng.integers(0, n, (b, mp)), jnp.int32)
+    table = table.at[0].set(n)          # fully unallocated row
+    table = table.at[1, 2:].set(n)      # allocated prefix only
+    # empty slot, chunk-only fill, chunk == page-size boundary,
+    # mid-page partial ("partial last chunk"), full table
+    fills = jnp.asarray([0, sq, ps, ps + 3, mp * ps], jnp.int32)
+    ref = paged_attention_chunk_reference(q, kp, vp, table, fills)
+    out = paged_attention_chunk(q, kp, vp, table, fills, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5)
+    assert np.all(np.asarray(out[0]) == 0.0)  # empty slot exact zeros
+
+
+def test_chunk_kernel_int8_pages():
+    from pyspark_tf_gke_tpu.ops.pallas.paged_attention import (
+        paged_attention_chunk,
+        paged_attention_chunk_reference,
+    )
+
+    rng = np.random.default_rng(12)
+    n, ps, hkv, d, b, mp, g, sq = 8, 4, 2, 8, 3, 3, 2, 4
+    kq = jnp.asarray(rng.integers(-127, 128, (n, ps, hkv, d)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, (n, ps, hkv, d)), jnp.int8)
+    ks = jnp.asarray(rng.random((n, ps, hkv)) * 0.02 + 1e-3, jnp.float32)
+    vs = jnp.asarray(rng.random((n, ps, hkv)) * 0.02 + 1e-3, jnp.float32)
+    q = _rand(rng, (b, sq, hkv * g, d))
+    table = jnp.asarray(rng.integers(0, n, (b, mp)), jnp.int32)
+    fills = jnp.asarray([sq, ps * mp, sq + 1], jnp.int32)
+    ref = paged_attention_chunk_reference(q, kq, vq, table, fills,
+                                          k_scales=ks, v_scales=vs)
+    out = paged_attention_chunk(q, kq, vq, table, fills, k_scales=ks,
+                                v_scales=vs, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5)
+
+
+def test_decode_is_chunk_s1():
+    # the single-query API must be exactly the S=1 chunk — one kernel,
+    # two entry points, no drift
+    from pyspark_tf_gke_tpu.ops.pallas.paged_attention import (
+        paged_attention_chunk,
+    )
+
+    rng = np.random.default_rng(13)
+    kp = _rand(rng, (6, 4, 2, 8))
+    vp = _rand(rng, (6, 4, 2, 8))
+    q = _rand(rng, (2, 4, 8))
+    table = jnp.asarray(rng.integers(0, 6, (2, 3)), jnp.int32)
+    fills = jnp.asarray([5, 12], jnp.int32)
+    out1 = paged_attention(q, kp, vp, table, fills, interpret=True)
+    outc = paged_attention_chunk(q[:, None], kp, vp, table, fills,
+                                 interpret=True)[:, 0]
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(outc))
+
+
 def test_smoke_check_kernel_sweep_passes():
     """The CI hook itself: every ops/pallas kernel against its
     reference on tiny shapes (tools/smoke_check.py --kernels-only)."""

@@ -53,7 +53,12 @@ EXTRA_KEYS = ("step_time_ms", "mfu", "batch_size", "device_kind",
               "pipeline_depth", "adaptive_chunk", "schedule",
               "batch_admit", "admit_stats", "device_step_accounting",
               "high_variance", "dispatch_rtt_ms", "tuning_grid",
-              "num_slots")
+              "num_slots",
+              # chunked-prefill A/B (cb --chunked-prefill) + the
+              # variant regression guard's delta
+              "tokens_ratio", "tbt_p99_ratio", "step_token_budget",
+              "prefill_chunk_tokens", "vs_variant_baseline",
+              "regression")
 
 
 def identity(argv) -> str:
