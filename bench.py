@@ -1343,6 +1343,152 @@ def bench_io(smoke: bool = False) -> dict:
     }
 
 
+def bench_router(smoke: bool = False) -> dict:
+    """``python bench.py router``: the replica-router A/B. One router +
+    two CPU replica subprocesses vs direct single-server traffic on the
+    same request mix — throughput and p99 quantify the gateway hop and
+    the 2x capacity; a kill-one-replica goodput run quantifies what the
+    hedge/failover path saves when a pod dies mid-traffic.
+
+    Host-only by design (like ``io``): the replicas are pinned to the
+    CPU backend in their OWN subprocesses (the contract under test is
+    routing, not decode speed), so a down TPU tunnel never gates this
+    measurement and the bench parent does no jax device work at all.
+    Launch scaffolding lives in ``router/localfleet.py`` (shared with
+    ``smoke_check --router`` and the test soak)."""
+    import shutil
+    import signal
+    import tempfile
+    import threading
+
+    from pyspark_tf_gke_tpu.router.localfleet import (
+        export_tiny_bundle,
+        free_port,
+        launch_replica,
+        launch_router,
+        post_generate,
+        wait_healthy,
+    )
+
+    n_requests = 16 if smoke else 64
+    workers = 4 if smoke else 8
+    max_new = 8
+
+    def post(url, prompt, timeout=120.0):
+        return post_generate(url, prompt, max_new_tokens=max_new,
+                             timeout_s=timeout)
+
+    def drive(url, n, kill_proc_at=None):
+        """n requests over `workers` concurrent client threads; returns
+        (ok, lost, wall_s, latencies_ms). ``kill_proc_at``: (proc,
+        request_index) — SIGKILL that replica when the index dispatches
+        (the failover goodput run)."""
+        lat, errors = [], []
+        idx_lock = threading.Lock()
+        state = {"next": 0}
+
+        def worker():
+            while True:
+                with idx_lock:
+                    i = state["next"]
+                    if i >= n:
+                        return
+                    state["next"] += 1
+                    if kill_proc_at is not None \
+                            and i == kill_proc_at[1] \
+                            and kill_proc_at[0].poll() is None:
+                        kill_proc_at[0].send_signal(signal.SIGKILL)
+                t0 = time.perf_counter()
+                try:
+                    post(url, f"bench request {i}")
+                    lat.append((time.perf_counter() - t0) * 1000.0)
+                except Exception as exc:  # noqa: BLE001 — counted
+                    errors.append((i, repr(exc)))
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker)
+                   for _ in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.perf_counter() - t0
+        return len(lat), len(errors), wall, sorted(lat)
+
+    def pct(xs, q):
+        return round(xs[min(len(xs) - 1, int(q * (len(xs) - 1)))], 1) \
+            if xs else None
+
+    tmp = tempfile.mkdtemp(prefix="bench-router-")
+    procs, router_proc = [], None
+    try:
+        bundle = export_tiny_bundle(os.path.join(tmp, "bundle"))
+        ports = [free_port(), free_port()]
+        router_port = free_port()
+        procs = [launch_replica(bundle, p) for p in ports]
+        router_proc = launch_router(ports, router_port,
+                                    extra_args=("--hedge-max-ms", "500"))
+        direct_url = f"http://127.0.0.1:{ports[0]}"
+        router_url = f"http://127.0.0.1:{router_port}"
+        deadline = time.time() + 300
+        for p in ports:
+            wait_healthy(f"http://127.0.0.1:{p}", deadline)
+        wait_healthy(router_url, deadline)
+        # warm each replica DIRECTLY: routed warms can all land on one
+        # replica (affinity hash on an idle fleet), leaving the other
+        # to pay its first-request JIT compile inside the timed routed
+        # run — which would charge a compile stall to routed_p99_ms
+        for prompt in ("warm a", "warm b", "warm c", "warm d"):
+            for p in ports:
+                post(f"http://127.0.0.1:{p}", prompt)
+
+        ok_d, lost_d, wall_d, lat_d = drive(direct_url, n_requests)
+        ok_r, lost_r, wall_r, lat_r = drive(router_url, n_requests)
+        # failover goodput: kill replica[1] a third of the way in; the
+        # router must keep goodput near 1.0 (hedge/re-route), where a
+        # client pinned to the dead server would lose the remainder
+        ok_f, lost_f, wall_f, lat_f = drive(
+            router_url, n_requests,
+            kill_proc_at=(procs[1], n_requests // 3))
+    finally:
+        for p in [router_proc, *procs]:
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    routed_rps = ok_r / wall_r if wall_r else 0.0
+    direct_rps = ok_d / wall_d if wall_d else 0.0
+    return {
+        "metric": "router_requests_per_sec",
+        "value": round(routed_rps, 2),
+        "unit": "requests/sec",
+        "vs_baseline": None,
+        "direct_requests_per_sec": round(direct_rps, 2),
+        "speedup_vs_direct": round(routed_rps / direct_rps, 3)
+        if direct_rps else None,
+        "direct_p50_ms": pct(lat_d, 0.50),
+        "direct_p99_ms": pct(lat_d, 0.99),
+        "routed_p50_ms": pct(lat_r, 0.50),
+        "routed_p99_ms": pct(lat_r, 0.99),
+        "failover": {
+            "requests": n_requests,
+            "ok": ok_f,
+            "lost": lost_f,
+            "goodput": round(ok_f / n_requests, 3),
+            "p99_ms": pct(lat_f, 0.99),
+            "wall_s": round(wall_f, 2),
+        },
+        "n_requests": n_requests,
+        "client_workers": workers,
+        "max_new_tokens": max_new,
+        "n_replicas": 2,
+        "replica_slots": 2,
+        "workload": ("1 router + 2 CPU BundleServer replicas vs direct "
+                     "single-server; kill-one-replica goodput"),
+    }
+
+
 # ---- orchestrator ----------------------------------------------------------
 
 
@@ -1716,6 +1862,9 @@ ALL_WORKLOADS = (
     # engine, pieces + step budget vs monolithic prefill — p50/p99
     # time-between-tokens is the tail this exists to flatten
     ["cb", "--chunked-prefill"],
+    # replica-router data plane: 1 router + 2 CPU replicas vs direct,
+    # plus the kill-one-replica failover goodput (host-only, like io)
+    ["router"],
     ["spec"],  # device-loop tok/s + the 0.75-skew fixture's acceptance
     ["generate", "--beams", "4"],  # broadcast-select reorder rebuild A/B
     # --- measured re-confirmations ---
@@ -1751,13 +1900,14 @@ def _run_matrix(extra, backend_ok: bool, skip=(),
         if list(argv) in [list(s) for s in skip]:
             continue
         log(f"=== bench matrix: {' '.join(argv)} ===")
-        if argv[0] != "io" and not backend_ok:
+        if argv[0] not in ("io", "router") and not backend_ok:
             print(json.dumps(_error_json(list(argv), "probe", gate_reason)))
             failures += 1
             continue
         rc = orchestrate([*argv, *extra], skip_probe=True)
         failures += 1 if rc else 0
-        if rc and argv[0] != "io" and "--smoke" not in extra and backend_ok:
+        if rc and argv[0] not in ("io", "router") \
+                and "--smoke" not in extra and backend_ok:
             # A device workload just failed mid-matrix. The usual cause in
             # this environment is the tunnel dying UNDER the matrix (it
             # happened live in round 4: vit hung in attach after cnn/
@@ -1862,12 +2012,13 @@ def orchestrate(argv, skip_probe: bool = False) -> int:
     workload = positionals[0] if positionals else "cnn"
     if workload == "all":
         return orchestrate_all([a for a in argv if a != "all"])
-    # The io workload is host-only (TFRecord read/write, no devices) —
-    # don't let a down backend block the one bench that doesn't need it.
+    # The io workload is host-only (TFRecord read/write, no devices),
+    # and router's replicas are CPU-pinned subprocesses by design —
+    # don't let a down backend block the benches that don't need it.
     # --smoke runs pin the CPU fake slice (the --run child forces the
     # platform), so a down tunnel must not block them either.
-    if (workload != "io" and "--smoke" not in argv and not skip_probe
-            and not probe_backend()):
+    if (workload not in ("io", "router") and "--smoke" not in argv
+            and not skip_probe and not probe_backend()):
         print(json.dumps(_error_json(
             list(argv), "probe",
             f"backend attach failed after {PROBE_ATTEMPTS} attempts "
@@ -1886,7 +2037,8 @@ def orchestrate(argv, skip_probe: bool = False) -> int:
         except subprocess.TimeoutExpired:
             last = f"bench run timed out after {RUN_TIMEOUT_S}s"
             log(f"[run {attempt + 1}/{RUN_ATTEMPTS}] {last}")
-            if (workload != "io" and "--smoke" not in argv
+            if (workload not in ("io", "router")
+                    and "--smoke" not in argv
                     and attempt < RUN_ATTEMPTS - 1):
                 # A full-RUN_TIMEOUT_S hang usually means the tunnel died
                 # under the run, not that the workload was slow. Retrying
@@ -1988,6 +2140,8 @@ def run_bench(argv) -> dict:
                 if smoke else main(mu_dtype=mu, optimizer=opt))
     if workload == "io":
         return bench_io(smoke=smoke)
+    if workload == "router":
+        return bench_router(smoke=smoke)
     if workload == "cb":
         if "--chunked-prefill" in argv:
             return bench_chunked_prefill(smoke=smoke)

@@ -548,3 +548,48 @@ def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "Device-prefetch queue occupancy (0 at a fetch = input-"
             "starved step; full = HBM/compute-bound)"),
     }
+
+
+def router_families(registry: Optional[MetricsRegistry] = None) -> dict:
+    """Register (idempotently) the replica-router's metric families.
+
+    Separate from :func:`platform_families` because the router is its
+    own plane — a jax-free gateway process in front of N BundleServer
+    replicas (``pyspark_tf_gke_tpu/router/``) — but defined HERE so the
+    whole platform's metric names keep one definition site and the
+    duplicate-name lint (``tools/smoke_check.py``) covers them."""
+    r = registry if registry is not None else get_registry()
+    return {
+        "router_requests_total": r.counter(
+            "router_requests_total",
+            "Requests routed, by terminal replica and outcome "
+            "(ok | upstream_error | shed | unreachable | client_error "
+            "| client_disconnect)",
+            labelnames=("replica", "outcome")),
+        "router_replica_up": r.gauge(
+            "router_replica_up",
+            "1 while the replica is UP (routable); 0 for DRAINING/DOWN",
+            labelnames=("replica",)),
+        "router_replicas_routable": r.gauge(
+            "router_replicas_routable",
+            "Replicas currently accepting new work (readiness fails "
+            "at 0 — a router with no backends must leave rotation)"),
+        "router_hedges_total": r.counter(
+            "router_hedges_total",
+            "Hedge requests fired (non-streamed generate past the "
+            "adaptive p99 delay)"),
+        "router_hedge_wins_total": r.counter(
+            "router_hedge_wins_total",
+            "Hedges that beat the primary (the loser was cancelled)"),
+        "router_affinity_hits_total": r.counter(
+            "router_affinity_hits_total",
+            "Requests routed by prefix affinity (vs least-loaded)"),
+        "router_reroutes_total": r.counter(
+            "router_reroutes_total",
+            "Requests re-routed once to the next-best replica",
+            labelnames=("reason",)),  # backpressure | failover | stream
+        "router_request_latency_ms": r.histogram(
+            "router_request_latency_ms",
+            "End-to-end routed request latency (also feeds the "
+            "adaptive hedge delay's p99 estimate)"),
+    }

@@ -1,0 +1,50 @@
+"""Replica-aware serving router: the data-plane gateway in front of N
+``BundleServer`` replicas.
+
+The source platform's whole point is a *routed* system — a coordinator
+submits work to a master that fans out across workers. PRs 2–4 made one
+serving replica fast (paged KV, chunked prefill) and survivable
+(deadlines, drain, chaos); this package is the tier that spreads traffic
+across N of them:
+
+* :mod:`discovery`   — membership (static list / DNS headless Service)
+  + a background prober tracking UP / DRAINING / DOWN per replica from
+  its ``/loadz`` snapshot;
+* :mod:`policy`      — least-outstanding-tokens scoring with a
+  prefix-affinity override (same-prefix traffic lands on the replica
+  whose engine prefix cache is already warm);
+* :mod:`client`      — thin cancellable HTTP client + the ONE
+  ``Retry-After`` parser both the forwarding path and the prober use;
+* :mod:`gateway`     — the HTTP server: backpressure propagation
+  (honor ``Retry-After``, re-route once, never amplify retries into an
+  overloaded pod) and hedged failover for non-streamed generates.
+
+The router deliberately imports no jax: it is a pure control/data-plane
+process (the ``tpu-router.yaml`` Deployment runs it on a CPU node pool).
+"""
+
+from pyspark_tf_gke_tpu.router.client import parse_retry_after
+from pyspark_tf_gke_tpu.router.discovery import (
+    DOWN,
+    DRAINING,
+    UP,
+    HealthProber,
+    Replica,
+    ReplicaSet,
+    parse_replica_list,
+    resolve_dns_replicas,
+)
+from pyspark_tf_gke_tpu.router.gateway import (
+    RouterServer,
+    start_router_http_server,
+)
+from pyspark_tf_gke_tpu.router.policy import affinity_key, choose_replica
+
+__all__ = [
+    "parse_retry_after",
+    "UP", "DRAINING", "DOWN",
+    "Replica", "ReplicaSet", "HealthProber",
+    "parse_replica_list", "resolve_dns_replicas",
+    "affinity_key", "choose_replica",
+    "RouterServer", "start_router_http_server",
+]

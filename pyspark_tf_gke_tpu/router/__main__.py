@@ -1,0 +1,10 @@
+"""CLI entry: ``python -m pyspark_tf_gke_tpu.router --replicas ...``
+(what ``infra/k8s/tpu/tpu-router.yaml`` and ``tools/smoke_check.py
+--router`` run)."""
+
+import sys
+
+from pyspark_tf_gke_tpu.router.gateway import main
+
+if __name__ == "__main__":
+    sys.exit(main())

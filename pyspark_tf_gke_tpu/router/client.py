@@ -1,0 +1,192 @@
+"""Replica HTTP client: cancellable requests + shared header parsing.
+
+``urllib`` hides its socket, so a hedged request could not be cancelled
+when its twin wins — this module talks :mod:`http.client` directly and
+hands the caller a :class:`ReplicaCall` whose :meth:`ReplicaCall.cancel`
+closes the underlying connection (the only cancel HTTP/1.1 has: the
+replica sees the reset and its own deadline/timeout machinery reclaims
+the slot).
+
+:func:`parse_retry_after` is THE ``Retry-After`` parser — the gateway's
+backpressure path and the round-trip tests both use it, so the engine's
+429/503 responses (``train/serve.py`` ``RequestRejected``) can never
+drift from what the router honors.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import threading
+from email.utils import parsedate_to_datetime
+from typing import Optional, Tuple
+from urllib.parse import urlsplit
+
+
+class ReplicaUnreachable(RuntimeError):
+    """Transport-level failure (connect refused/reset/timeout): the
+    request never produced an HTTP status line, so it is SAFE to
+    re-route — the alternative (an HTTP error status) means the replica
+    saw the request and re-sending could duplicate work."""
+
+
+def parse_retry_after(value: Optional[str],
+                      default_s: float = 1.0) -> float:
+    """Seconds to back off, from a ``Retry-After`` header value.
+
+    Accepts the delta-seconds form (what ``train/serve.py`` sends) and
+    the HTTP-date form; garbage or a missing header degrades to
+    ``default_s`` — a malformed header from an overloaded replica must
+    never crash the router's backpressure path, and backing off *some*
+    amount is strictly safer than not backing off at all."""
+    if value is None:
+        return float(default_s)
+    value = value.strip()
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    try:
+        import datetime
+
+        when = parsedate_to_datetime(value)
+        if when.tzinfo is None:
+            when = when.replace(tzinfo=datetime.timezone.utc)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        return max(0.0, (when - now).total_seconds())
+    except (TypeError, ValueError):
+        return float(default_s)
+
+
+def split_base_url(base_url: str) -> Tuple[str, int]:
+    """``http://host:port`` -> (host, port). The router speaks plain
+    HTTP to replicas inside the cluster; a scheme other than http is a
+    config error worth failing fast on."""
+    parts = urlsplit(base_url if "//" in base_url else "//" + base_url)
+    if parts.scheme not in ("", "http"):
+        raise ValueError(f"replica URLs must be http:// ({base_url!r})")
+    if not parts.hostname:
+        raise ValueError(f"replica URL has no host: {base_url!r}")
+    return parts.hostname, parts.port or 80
+
+
+class ReplicaCall:
+    """One in-flight HTTP request to a replica, cancellable from
+    another thread. ``close``/``cancel`` are idempotent and safe to
+    race with the reading thread — losing a hedge race closes the
+    loser's socket mid-read and the reader surfaces
+    :class:`ReplicaUnreachable`."""
+
+    def __init__(self, base_url: str, timeout_s: float = 600.0):
+        host, port = split_base_url(base_url)
+        self._conn = http.client.HTTPConnection(host, port,
+                                                timeout=timeout_s)
+        self._lock = threading.Lock()
+        self._cancelled = False
+        self.response: Optional[http.client.HTTPResponse] = None
+
+    def request(self, method: str, path: str,
+                body: Optional[bytes] = None,
+                headers: Optional[dict] = None) -> "ReplicaCall":
+        hdrs = {"Content-Type": "application/json", **(headers or {})}
+        try:
+            self._conn.request(method, path, body=body, headers=hdrs)
+            self.response = self._conn.getresponse()
+        except Exception as exc:  # noqa: BLE001 — one taxonomy: either
+            # we were cancelled (hedge loser) or the replica is gone;
+            # both are transport failures, not HTTP statuses
+            self.close()
+            raise ReplicaUnreachable(
+                f"{method} {path} to replica failed before a status "
+                f"line: {type(exc).__name__}: {exc}") from exc
+        return self
+
+    @property
+    def status(self) -> int:
+        assert self.response is not None
+        return self.response.status
+
+    def header(self, name: str) -> Optional[str]:
+        assert self.response is not None
+        return self.response.getheader(name)
+
+    def read_json(self) -> dict:
+        """Read + parse the full body. A replica dying mid-body is a
+        transport failure (the status line alone proves nothing about a
+        completed response)."""
+        assert self.response is not None
+        try:
+            raw = self.response.read()
+        except Exception as exc:  # noqa: BLE001
+            raise ReplicaUnreachable(
+                f"replica connection died mid-body: "
+                f"{type(exc).__name__}: {exc}") from exc
+        try:
+            return json.loads(raw or b"{}")
+        except ValueError as exc:
+            raise ReplicaUnreachable(
+                f"replica sent unparseable JSON ({len(raw)} bytes): "
+                f"{exc}") from exc
+
+    def iter_lines(self):
+        """Yield response lines as bytes (SSE proxying). Raises
+        :class:`ReplicaUnreachable` if the connection dies mid-stream —
+        the caller decides whether any event already reached the client
+        (re-route) or not (surface the terminal error)."""
+        assert self.response is not None
+        try:
+            while True:
+                line = self.response.readline()
+                if not line:
+                    return
+                yield line
+        except Exception as exc:  # noqa: BLE001
+            raise ReplicaUnreachable(
+                f"replica stream died: {type(exc).__name__}: "
+                f"{exc}") from exc
+
+    def cancel(self) -> None:
+        """Abandon the call: shutdown + close the socket so a blocked
+        read in the request thread unblocks NOW (a bare ``close`` does
+        not reliably interrupt another thread's ``recv``). The replica
+        sees a reset — its deadline/drain machinery reclaims the
+        work."""
+        with self._lock:
+            self._cancelled = True
+        try:
+            sock = self._conn.sock
+            if sock is not None:
+                sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.close()
+
+    @property
+    def cancelled(self) -> bool:
+        with self._lock:
+            return self._cancelled
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except Exception:  # noqa: BLE001 — closing must never raise
+            pass
+
+
+def get_json(base_url: str, path: str,
+             timeout_s: float = 5.0) -> Tuple[int, dict]:
+    """One-shot GET -> (status, parsed body). Raises
+    :class:`ReplicaUnreachable` on transport failure. Non-JSON bodies
+    parse to {} — /healthz during startup may answer anything."""
+    call = ReplicaCall(base_url, timeout_s=timeout_s)
+    try:
+        call.request("GET", path)
+        status = call.status
+        try:
+            body = call.read_json()
+        except ReplicaUnreachable:
+            body = {}
+        return status, body
+    finally:
+        call.close()

@@ -1,0 +1,371 @@
+"""Replica membership + health: who exists, and who can take work.
+
+Membership comes from either a static ``--replicas`` list (tests,
+docker-compose, fixed StatefulSets) or a DNS name (`--discover`) that
+resolves to one A record per pod — the k8s headless-Service contract
+(``infra/k8s/tpu/tpu-router.yaml`` publishes ``tpu-serve-replicas``
+with ``clusterIP: None`` exactly so this resolver sees pod IPs, not a
+load-balanced VIP that would hide them).
+
+Health is a background :class:`HealthProber` polling each replica's
+``GET /loadz`` (one cheap JSON snapshot — queued, queued_tokens, active
+slots, kv pages free, draining — so the prober never scrapes Prometheus
+text) and folding the answer into one of three states:
+
+* ``UP``        — 200: routable, snapshot fresh;
+* ``DRAINING``  — 503 with ``draining`` truthy (PR 3's drain
+  semantics): receives NO new work but is NOT dead — its open streams
+  finish, so the router must not reset connections to it;
+* ``DOWN``      — transport failure / timeout: excluded from routing;
+  in-flight requests to it fail over (gateway.py).
+
+The gateway also feeds *passive* health in: a transport failure on a
+real request marks the replica DOWN immediately instead of waiting out
+a probe interval — that is what makes kill-one-replica failover fast.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from pyspark_tf_gke_tpu.router.client import ReplicaUnreachable, get_json
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+logger = get_logger("router.discovery")
+
+UP = "up"
+DRAINING = "draining"
+DOWN = "down"
+
+
+@dataclass
+class Replica:
+    """One replica's live routing record. ``load`` is the last /loadz
+    snapshot (may be stale by one probe interval — the gateway layers
+    its own in-flight accounting on top); ``backoff_until`` implements
+    Retry-After honoring: the replica said "not now", so the router
+    stops OFFERING it work until the moment passes instead of hammering
+    an overloaded pod."""
+
+    rid: str
+    base_url: str
+    state: str = DOWN
+    load: dict = field(default_factory=dict)
+    backoff_until: float = 0.0
+    consecutive_failures: int = 0
+    # True when this replica came from --discover (DNS) rather than the
+    # static --replicas list: only discovered replicas are ever pruned
+    discovered: bool = False
+    # consecutive DNS refreshes that did NOT list this replica — the
+    # prune countdown (rolling restarts hand pods new IPs; old ones
+    # must not pile up and slow every probe sweep forever)
+    dns_absent: int = 0
+    # router-side in-flight accounting (gateway increments/decrements):
+    # requests and their token footprint currently proxied to this
+    # replica — the fresh half of least-outstanding-tokens scoring
+    inflight: int = 0
+    inflight_tokens: int = 0
+
+    def routable(self, now: Optional[float] = None) -> bool:
+        return (self.state == UP
+                and (now if now is not None else time.monotonic())
+                >= self.backoff_until)
+
+    def outstanding_tokens(self) -> int:
+        """Least-outstanding-tokens score: the replica's own queue
+        footprint (from /loadz) plus what this router has in flight to
+        it that the snapshot may not see yet."""
+        return (int(self.load.get("queued_tokens", 0))
+                + int(self.load.get("active", 0))
+                + self.inflight_tokens)
+
+
+def parse_replica_list(spec: str) -> List["Replica"]:
+    """``http://a:8000,http://b:8000`` -> replicas keyed by their URL
+    (the stable identity label ``router_requests_total{replica=...}``
+    uses)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip().rstrip("/")
+        if not part:
+            continue
+        if "://" not in part:
+            part = "http://" + part
+        out.append(Replica(rid=part, base_url=part))
+    if not out:
+        raise ValueError(f"no replicas in spec {spec!r}")
+    return out
+
+
+def resolve_dns_replicas(hostname: str, port: int,
+                         resolver: Optional[Callable] = None
+                         ) -> List["Replica"]:
+    """One A-record per pod (headless Service) -> replica list.
+    ``resolver`` is injectable for tests; the default is
+    ``socket.getaddrinfo``. Resolution failure returns [] — a router
+    must keep serving its last-known membership through a DNS blip,
+    so the caller MERGES rather than replaces on empty."""
+    import socket
+
+    try:
+        infos = (resolver or socket.getaddrinfo)(hostname, port)
+    except OSError as exc:
+        logger.warning("DNS resolve of %s failed: %s", hostname, exc)
+        return []
+    seen, out = set(), []
+    for info in infos:
+        addr = info[4][0]
+        if addr in seen:
+            continue
+        seen.add(addr)
+        url = (f"http://[{addr}]:{port}" if ":" in addr
+               else f"http://{addr}:{port}")
+        out.append(Replica(rid=url, base_url=url, discovered=True))
+    return out
+
+
+class ReplicaSet:
+    """Thread-safe replica table. The prober, the DNS refresher, and
+    every HTTP handler thread all touch it; one lock, short holds."""
+
+    def __init__(self, replicas: List[Replica], obs=None, event_log=None):
+        self._lock = threading.Lock()
+        # first-wins on duplicate rids: a URL listed in --replicas AND
+        # resolved by --discover must keep its static (never-pruned)
+        # record, not be demoted to a prunable discovered one
+        self._replicas: Dict[str, Replica] = {}
+        for r in replicas:
+            self._replicas.setdefault(r.rid, r)
+        self._obs = obs
+        self._event_log = event_log
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def get(self, rid: str) -> Optional[Replica]:
+        with self._lock:
+            return self._replicas.get(rid)
+
+    def all(self) -> List[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def routable(self) -> List[Replica]:
+        now = time.monotonic()
+        with self._lock:
+            return [r for r in self._replicas.values() if r.routable(now)]
+
+    # DNS refreshes a replica must miss, while already DOWN and with
+    # nothing in flight, before it is pruned: rolling restarts retire
+    # pod IPs for good, and un-pruned dead entries each cost a probe
+    # timeout per sweep forever
+    PRUNE_AFTER_ABSENT = 3
+
+    def merge(self, discovered: List[Replica]) -> None:
+        """Fold a DNS resolution in: new addresses join (state DOWN
+        until the prober confirms them), known ones keep their state.
+        A replica that vanished from DNS is NOT removed immediately —
+        a DNS blip must not amputate healthy replicas — but one that
+        stays absent for ``PRUNE_AFTER_ABSENT`` refreshes AND is DOWN
+        AND has nothing in flight is pruned (its pod IP is gone for
+        good). Static (``--replicas``) entries are never pruned; an
+        empty resolution (resolver failure) changes nothing."""
+        if not discovered:
+            return
+        listed = {r.rid for r in discovered}
+        pruned = []
+        with self._lock:
+            for r in discovered:
+                self._replicas.setdefault(r.rid, r)
+            for rid, r in list(self._replicas.items()):
+                if not r.discovered:
+                    continue
+                if rid in listed:
+                    r.dns_absent = 0
+                    continue
+                r.dns_absent += 1
+                if (r.dns_absent >= self.PRUNE_AFTER_ABSENT
+                        and r.state == DOWN and r.inflight == 0):
+                    del self._replicas[rid]
+                    pruned.append(rid)
+        for rid in pruned:
+            logger.info("replica %s pruned (absent from DNS)", rid)
+            if self._obs is not None:
+                self._obs["router_replica_up"].labels(replica=rid).set(0)
+            if self._event_log is not None:
+                self._event_log.emit("router_replica_state", replica=rid,
+                                     prev=DOWN, state="removed",
+                                     reason="absent from DNS")
+
+    def set_state(self, rid: str, state: str, load: Optional[dict] = None,
+                  reason: str = "") -> None:
+        """One transition point: metrics gauge + event emit live here so
+        the prober and the gateway's passive marking can't diverge."""
+        with self._lock:
+            r = self._replicas.get(rid)
+            if r is None:
+                return
+            prev = r.state
+            r.state = state
+            if load is not None:
+                r.load = load
+            if state == UP:
+                r.consecutive_failures = 0
+        if self._obs is not None:
+            self._obs["router_replica_up"].labels(replica=rid).set(
+                1 if state == UP else 0)
+        if prev != state:
+            logger.info("replica %s: %s -> %s%s", rid, prev, state,
+                        f" ({reason})" if reason else "")
+            if self._event_log is not None:
+                self._event_log.emit("router_replica_state", replica=rid,
+                                     prev=prev, state=state,
+                                     reason=reason[:200])
+
+    def note_probe_failure(self, rid: str):
+        """Count one transport failure; returns (state_before, count)
+        so the prober can apply its threshold."""
+        with self._lock:
+            r = self._replicas.get(rid)
+            if r is None:
+                return None, 0
+            r.consecutive_failures += 1
+            return r.state, r.consecutive_failures
+
+    def note_backoff(self, rid: str, seconds: float) -> None:
+        """Honor a Retry-After: stop offering this replica new work for
+        ``seconds`` (state stays UP — it answered, it's alive)."""
+        until = time.monotonic() + max(0.0, float(seconds))
+        with self._lock:
+            r = self._replicas.get(rid)
+            if r is not None and until > r.backoff_until:
+                r.backoff_until = until
+
+    def track(self, rid: str, tokens: int) -> None:
+        with self._lock:
+            r = self._replicas.get(rid)
+            if r is not None:
+                r.inflight += 1
+                r.inflight_tokens += int(tokens)
+
+    def untrack(self, rid: str, tokens: int) -> None:
+        with self._lock:
+            r = self._replicas.get(rid)
+            if r is not None:
+                r.inflight = max(0, r.inflight - 1)
+                r.inflight_tokens = max(0,
+                                        r.inflight_tokens - int(tokens))
+
+    def snapshot(self) -> List[dict]:
+        """JSON-ready table for the router's own /healthz."""
+        now = time.monotonic()
+        with self._lock:
+            return [{
+                "replica": r.rid,
+                "state": r.state,
+                "inflight": r.inflight,
+                "inflight_tokens": r.inflight_tokens,
+                "backoff_s": round(max(0.0, r.backoff_until - now), 3),
+                "load": r.load,
+            } for r in sorted(self._replicas.values(),
+                              key=lambda x: x.rid)]
+
+
+class HealthProber:
+    """Background thread: every ``interval_s`` poll each replica's
+    ``/loadz`` and update the table. ``fail_threshold`` consecutive
+    transport failures before UP -> DOWN (one lost packet must not
+    flap a healthy replica out of rotation); recovery is immediate
+    (first good answer re-admits)."""
+
+    def __init__(self, replicas: ReplicaSet, interval_s: float = 1.0,
+                 timeout_s: float = 2.0, fail_threshold: int = 2,
+                 dns_refresh: Optional[Callable[[], List[Replica]]] = None,
+                 dns_every: int = 10):
+        self.replicas = replicas
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self.fail_threshold = max(1, int(fail_threshold))
+        self._dns_refresh = dns_refresh
+        self._dns_every = max(1, int(dns_every))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="router-prober", daemon=True)
+
+    def start(self) -> "HealthProber":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def probe_once(self) -> None:
+        """One synchronous sweep (the loop body; tests call it directly
+        for determinism). Replicas are probed CONCURRENTLY, so a sweep
+        costs ~one probe timeout no matter how many dead entries sit in
+        the table — a fleet of unreachable pods probed serially would
+        delay a live replica's DRAINING flip by (N x timeout)."""
+        reps = self.replicas.all()
+        if len(reps) <= 1:
+            for r in reps:
+                self._probe_one(r)
+            return
+        threads = [threading.Thread(target=self._probe_one, args=(r,),
+                                    name=f"router-probe-{i}", daemon=True)
+                   for i, r in enumerate(reps)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.timeout_s + 5.0)
+
+    def _probe_one(self, r: Replica) -> None:
+        try:
+            status, body = get_json(r.base_url, "/loadz",
+                                    timeout_s=self.timeout_s)
+            if status == 404:
+                # pre-/loadz replica: degrade to /healthz (strict
+                # superset keys are absent but draining/liveness
+                # still route correctly)
+                status, body = get_json(r.base_url, "/healthz",
+                                        timeout_s=self.timeout_s)
+        except ReplicaUnreachable as exc:
+            was, failures = self.replicas.note_probe_failure(r.rid)
+            if was is not None and was != DOWN \
+                    and failures >= self.fail_threshold:
+                self.replicas.set_state(r.rid, DOWN,
+                                        reason=str(exc)[:120])
+            return
+        except Exception:  # noqa: BLE001 — a probe thread must never
+            logger.exception("probe of %s failed", r.rid)  # die silently
+            return
+        if bool(body.get("draining")) or status == 503:
+            self.replicas.set_state(r.rid, DRAINING, load=body,
+                                    reason=f"http {status}")
+        elif 200 <= status < 300:
+            self.replicas.set_state(r.rid, UP, load=body)
+        else:
+            # answered but unwell (500s): alive enough not to
+            # count toward the DOWN threshold, sick enough not to
+            # route to — DRAINING's "no new work" is the right bucket
+            self.replicas.set_state(r.rid, DRAINING, load=body,
+                                    reason=f"http {status}")
+
+    def _loop(self) -> None:
+        beat = 0
+        while not self._stop.is_set():
+            if self._dns_refresh is not None and beat % self._dns_every == 0:
+                try:
+                    self.replicas.merge(self._dns_refresh())
+                except Exception:  # noqa: BLE001 — discovery must not
+                    logger.exception("DNS refresh failed")  # kill probing
+            beat += 1
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — the prober thread must
+                logger.exception("probe sweep failed")  # never die
+            self._stop.wait(self.interval_s)

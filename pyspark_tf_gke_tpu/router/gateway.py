@@ -1,0 +1,870 @@
+"""The router's HTTP data plane: one gateway in front of N replicas.
+
+Request lifecycle for ``POST /v1/generate`` (non-streamed):
+
+1. **Route** — :func:`policy.choose_replica`: prefix-affinity target if
+   it can absorb the work, else least-outstanding-tokens.
+2. **Backpressure** — a 429/503 from the replica is an explicit "not
+   now": honor its ``Retry-After`` (stop offering that replica work for
+   that long), re-route ONCE to the next-best replica, and only if that
+   one also sheds surface 429 to the client. One re-route, never a
+   retry loop — the router must not amplify load into an overloaded
+   fleet.
+3. **Hedged failover** — past an adaptive delay (p99 of recent routed
+   latencies, clamped to [--hedge-min-ms, --hedge-max-ms]) with no
+   answer, fire the SAME request at a second replica and take whichever
+   answers first; the loser's connection is closed (the HTTP-level
+   cancel — the replica's own deadline/drain machinery reclaims the
+   work). Generation here is deterministic-greedy or seeded sampling,
+   so duplicated work is wasted compute, not wrong answers.
+4. **Transport failure** — :class:`client.ReplicaUnreachable` (no HTTP
+   status line) marks the replica DOWN immediately (passive health) and
+   fails over to the next-best; this is what makes a SIGKILLed pod cost
+   ~one probe interval, not a k8s Endpoints propagation delay.
+
+Streams (``"stream": true``): a replica death BEFORE the first event
+re-routes the whole request (nothing reached the client yet); after the
+first event the router surfaces the terminal error — re-running the
+request would silently replay tokens the client already consumed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+from pyspark_tf_gke_tpu.obs.events import get_event_log
+from pyspark_tf_gke_tpu.obs.export import handle_obs_request
+from pyspark_tf_gke_tpu.obs.metrics import get_registry, router_families
+from pyspark_tf_gke_tpu.router.client import (
+    ReplicaCall,
+    ReplicaUnreachable,
+    parse_retry_after,
+)
+from pyspark_tf_gke_tpu.router.discovery import (
+    DOWN,
+    HealthProber,
+    Replica,
+    ReplicaSet,
+    parse_replica_list,
+    resolve_dns_replicas,
+)
+from pyspark_tf_gke_tpu.router.policy import affinity_key, choose_replica
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+logger = get_logger("router.gateway")
+
+MAX_BODY_BYTES = 8 << 20  # mirror the replica's cap: reject before proxy
+
+
+class _LatencyWindow:
+    """Ring of recent routed-request latencies; p99 drives the hedge
+    delay. Until ``min_samples`` land the estimate is the max clamp —
+    hedging on no evidence would double cold-start compile traffic."""
+
+    def __init__(self, size: int = 256, min_samples: int = 20):
+        self._lock = threading.Lock()
+        self._window = deque(maxlen=size)
+        self.min_samples = min_samples
+
+    def observe(self, ms: float) -> None:
+        with self._lock:
+            self._window.append(float(ms))
+
+    def p99_ms(self) -> Optional[float]:
+        with self._lock:
+            if len(self._window) < self.min_samples:
+                return None
+            xs = sorted(self._window)
+        return xs[min(len(xs) - 1, int(0.99 * (len(xs) - 1)))]
+
+
+class RouterServer:
+    """Route/forward engine behind the HTTP handler (transport-free so
+    tests drive it directly)."""
+
+    def __init__(self, replicas: List[Replica], *,
+                 affinity_tokens: int = 32,
+                 inflight_cap: int = 0,
+                 hedge_min_ms: float = 50.0,
+                 hedge_max_ms: float = 2000.0,
+                 hedge: bool = True,
+                 request_timeout_s: float = 600.0,
+                 registry=None, event_log=None):
+        self.registry = registry if registry is not None else get_registry()
+        self._obs = router_families(self.registry)
+        self.event_log = (event_log if event_log is not None
+                          else get_event_log())
+        self.replicas = ReplicaSet(replicas, obs=self._obs,
+                                   event_log=self.event_log)
+        self.affinity_tokens = int(affinity_tokens)
+        self.inflight_cap = int(inflight_cap)
+        self.hedge_enabled = bool(hedge)
+        self.hedge_min_ms = float(hedge_min_ms)
+        self.hedge_max_ms = float(hedge_max_ms)
+        self.request_timeout_s = float(request_timeout_s)
+        self.latency = _LatencyWindow()
+        self.draining = threading.Event()
+        self._http_lock = threading.Lock()
+        self._http_inflight = 0
+
+    # -- in-flight accounting (drain) ------------------------------------
+
+    def http_enter(self) -> None:
+        with self._http_lock:
+            self._http_inflight += 1
+
+    def http_exit(self) -> None:
+        with self._http_lock:
+            self._http_inflight -= 1
+
+    def http_inflight(self) -> int:
+        with self._http_lock:
+            return self._http_inflight
+
+    # -- routing ---------------------------------------------------------
+
+    def _affinity_for(self, req: dict) -> Optional[str]:
+        if not self.affinity_tokens:
+            return None
+        prompts = req.get("prompts")
+        prompt = (prompts[0] if isinstance(prompts, list) and prompts
+                  else req.get("prompt") or req.get("prefix"))
+        if not isinstance(prompt, str) or not prompt:
+            return None
+        return affinity_key(prompt, self.affinity_tokens)
+
+    @staticmethod
+    def _token_ask(req: dict) -> int:
+        """Crude token footprint for in-flight scoring: prompt bytes
+        (byte tokenizer: bytes == tokens) + the new-token budget."""
+        prompts = req.get("prompts") or (
+            [req["prompt"]] if isinstance(req.get("prompt"), str) else [])
+        try:
+            ask = sum(len(p.encode()) for p in prompts
+                      if isinstance(p, str))
+            ask += int(req.get("max_new_tokens", 64) or 0) * max(
+                1, len(prompts))
+        except (TypeError, ValueError):
+            ask = 64
+        return ask
+
+    def pick(self, affinity: Optional[str],
+             exclude: Tuple[str, ...] = ()) -> Optional[Replica]:
+        routable = self.replicas.routable()
+        self._obs["router_replicas_routable"].set(len(routable))
+        chosen, used_affinity = choose_replica(
+            routable, affinity=affinity, inflight_cap=self.inflight_cap,
+            exclude=exclude)
+        if used_affinity:
+            self._obs["router_affinity_hits_total"].inc()
+        return chosen
+
+    def hedge_delay_s(self) -> float:
+        p99 = self.latency.p99_ms()
+        ms = self.hedge_max_ms if p99 is None else min(
+            max(p99, self.hedge_min_ms), self.hedge_max_ms)
+        return ms / 1000.0
+
+    # -- forwarding ------------------------------------------------------
+
+    def _forward_once(self, replica: Replica, path: str, body: bytes,
+                      tokens: int) -> ReplicaCall:
+        """One proxied request; transport failure marks the replica DOWN
+        (passive health) and re-raises for the caller's failover."""
+        self.replicas.track(replica.rid, tokens)
+        call = ReplicaCall(replica.base_url,
+                           timeout_s=self.request_timeout_s)
+        try:
+            call.request("POST", path, body=body)
+        except ReplicaUnreachable:
+            self.replicas.untrack(replica.rid, tokens)
+            if not call.cancelled:
+                self.replicas.set_state(replica.rid, DOWN,
+                                        reason="request transport failure")
+            raise
+        return call
+
+    def _count(self, replica_rid: str, outcome: str) -> None:
+        self._obs["router_requests_total"].labels(
+            replica=replica_rid, outcome=outcome).inc()
+
+    def route_json(self, path: str, req: dict
+                   ) -> Tuple[int, dict, Tuple[Tuple[str, str], ...]]:
+        """Route a non-streamed JSON POST end to end. Returns
+        (status, body, extra headers) for the HTTP layer."""
+        body = json.dumps(req).encode()
+        affinity = (self._affinity_for(req)
+                    if path in ("/v1/generate", "/v1/warm") else None)
+        tokens = self._token_ask(req)
+        t0 = time.perf_counter()
+        tried: List[str] = []
+
+        primary = self.pick(affinity)
+        if primary is None:
+            self._count("none", "shed")
+            return 503, {"error": "no routable replica",
+                         "reason": "no_replicas"}, (("Retry-After", "1"),)
+
+        status, out, hdrs, terminal_rid = self._route_with_failover(
+            primary, path, body, tokens, tried,
+            hedge=(self.hedge_enabled and path == "/v1/generate"
+                   and not req.get("stream")))
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        self._obs["router_request_latency_ms"].observe(dt_ms)
+        if 200 <= status < 300:
+            self.latency.observe(dt_ms)
+            self._count(terminal_rid, "ok")
+        elif status in (429, 503):
+            self._count(terminal_rid, "shed")
+        elif status == 502:
+            self._count(terminal_rid, "unreachable")
+        elif 400 <= status < 500:
+            self._count(terminal_rid, "client_error")
+        else:
+            self._count(terminal_rid, "upstream_error")
+        return status, out, hdrs
+
+    def _finish_call(self, call: ReplicaCall, replica: Replica,
+                     tokens: int) -> Tuple[int, dict,
+                                           Tuple[Tuple[str, str], ...]]:
+        """Read one completed call's body + relay Retry-After. A death
+        mid-body gets the same passive-health verdict as one mid-connect
+        (DOWN immediately) — a status line alone proves nothing about a
+        live replica — then re-raises for the caller's failover."""
+        try:
+            status = call.status
+            out = call.read_json()
+        except ReplicaUnreachable:
+            if not call.cancelled:
+                self.replicas.set_state(replica.rid, DOWN,
+                                        reason="died mid-body")
+            raise
+        finally:
+            self.replicas.untrack(replica.rid, tokens)
+            call.close()
+        hdrs: Tuple[Tuple[str, str], ...] = ()
+        ra = call.header("Retry-After")
+        if ra is not None:
+            hdrs = (("Retry-After", ra),)
+        return status, out, hdrs
+
+    def _route_with_failover(self, primary: Replica, path: str,
+                             body: bytes, tokens: int, tried: List[str],
+                             hedge: bool):
+        """primary -> (maybe hedge) -> (maybe one re-route). Returns
+        (status, body, headers, terminal_replica_rid)."""
+        tried.append(primary.rid)
+        try:
+            if hedge:
+                status, out, hdrs, rid = self._call_hedged(
+                    primary, path, body, tokens, tried)
+            else:
+                call = self._forward_once(primary, path, body, tokens)
+                status, out, hdrs = self._finish_call(call, primary,
+                                                      tokens)
+                rid = primary.rid
+        except ReplicaUnreachable as exc:
+            # transport failure: no status line ever arrived, safe to
+            # re-route once (failover)
+            self._obs["router_reroutes_total"].labels(
+                reason="failover").inc()
+            self.event_log.emit("router_reroute", path=path,
+                                reason="failover", failed=tried[-1],
+                                error=str(exc)[:200])
+            return self._reroute_once(path, body, tokens, tried,
+                                      shed_status=502,
+                                      shed_error=str(exc))
+        if status in (429, 503):
+            # backpressure: honor Retry-After on the shedding replica,
+            # then ONE re-route to the next best
+            backoff = parse_retry_after(dict(hdrs).get("Retry-After"))
+            self.replicas.note_backoff(rid, backoff)
+            self._obs["router_reroutes_total"].labels(
+                reason="backpressure").inc()
+            self.event_log.emit("router_reroute", path=path,
+                                reason="backpressure", shed_by=rid,
+                                retry_after_s=backoff)
+            return self._reroute_once(path, body, tokens, tried,
+                                      shed_status=status,
+                                      shed_error=out.get("error", ""),
+                                      shed_hdrs=hdrs)
+        return status, out, hdrs, rid
+
+    def _reroute_once(self, path: str, body: bytes, tokens: int,
+                      tried: List[str], *, shed_status: int,
+                      shed_error: str, shed_hdrs=()):
+        """The single permitted re-route. A second failure — of any
+        kind — surfaces to the client; the router never turns one
+        request into a retry storm against a struggling fleet."""
+        nxt = self.pick(None, exclude=tuple(tried))
+        if nxt is None:
+            status = shed_status if shed_status in (429, 503) else 502
+            return status, {
+                "error": f"request failed on {tried[-1]} and no other "
+                         f"replica can take it: {shed_error}"[:500],
+                "reason": "no_reroute_target",
+            }, (tuple(shed_hdrs) or (("Retry-After", "1"),)), tried[-1]
+        tried.append(nxt.rid)
+        try:
+            call = self._forward_once(nxt, path, body, tokens)
+            status, out, hdrs = self._finish_call(call, nxt, tokens)
+        except ReplicaUnreachable as exc:
+            return 502, {"error": f"re-routed request failed too: "
+                                  f"{exc}"[:500],
+                         "reason": "reroute_failed"}, (), nxt.rid
+        if status in (429, 503):
+            # the fallback shed too: its Retry-After is honored (stop
+            # offering it work) even though the request now surfaces —
+            # the next request must not hammer the same pair
+            self.replicas.note_backoff(
+                nxt.rid, parse_retry_after(dict(hdrs).get("Retry-After")))
+        return status, out, hdrs, nxt.rid
+
+    def _call_hedged(self, primary: Replica, path: str, body: bytes,
+                     tokens: int, tried: List[str]):
+        """Primary + (after the adaptive delay) one hedge; the first
+        USABLE response wins and the loser is cancelled (socket close —
+        the replica's own deadline machinery reclaims the work). Each
+        leg reads its full body before reporting, so a replica that
+        sheds 429/503 or dies mid-body cannot "win" the race and get a
+        healthy in-flight twin cancelled — the collector waits for the
+        outstanding leg and prefers its answer. Leg lifecycle is
+        leak-free: error legs untrack themselves; answered legs are
+        untracked + closed by the collector (winner and losers alike),
+        which consumes every started leg's report before returning.
+        Both legs unreachable re-raises :class:`ReplicaUnreachable` so
+        the caller's single re-route applies."""
+        import queue as _queue
+
+        results: "_queue.Queue" = _queue.Queue()
+        lock = threading.Lock()
+        calls: List[ReplicaCall] = []
+        state = {"committed": False}
+
+        def leg(replica: Replica):
+            call = ReplicaCall(replica.base_url,
+                               timeout_s=self.request_timeout_s)
+            with lock:
+                if state["committed"]:
+                    # the race was decided before this leg even
+                    # registered: abandon without sending (a cancel
+                    # loop that ran already could not have seen us)
+                    results.put((replica, None, None, None,
+                                 ReplicaUnreachable(
+                                     "hedge leg abandoned: race "
+                                     "already committed")))
+                    return
+                # registered BEFORE the blocking request so the
+                # collector can cancel a leg still on its socket
+                calls.append(call)
+            self.replicas.track(replica.rid, tokens)
+            try:
+                call.request("POST", path, body=body)
+                status = call.status
+                out = call.read_json()
+            except ReplicaUnreachable as exc:
+                self.replicas.untrack(replica.rid, tokens)
+                if not call.cancelled:
+                    self.replicas.set_state(
+                        replica.rid, DOWN,
+                        reason="request transport failure")
+                results.put((replica, None, None, None, exc))
+                return
+            results.put((replica, call, status, out, None))
+
+        threading.Thread(target=leg, args=(primary,),
+                         daemon=True).start()
+        n_legs = 1
+        delay = self.hedge_delay_s()
+        try:
+            first = results.get(timeout=delay)
+        except _queue.Empty:
+            first = None
+        hedge_rep = None
+        if first is None:
+            hedge_rep = self.pick(None, exclude=tuple(tried))
+            if hedge_rep is not None:
+                tried.append(hedge_rep.rid)
+                n_legs = 2
+                self._obs["router_hedges_total"].inc()
+                self.event_log.emit("router_hedge", path=path,
+                                    primary=primary.rid,
+                                    hedge=hedge_rep.rid,
+                                    delay_ms=round(delay * 1000.0, 1))
+                threading.Thread(target=leg, args=(hedge_rep,),
+                                 daemon=True).start()
+            first = results.get()  # one leg WILL answer or error
+
+        def usable(r):
+            return r[4] is None and r[2] not in (429, 503)
+
+        gathered = [first]
+        # a shed or transport error must not beat a leg that may yet
+        # answer: wait for the outstanding leg before committing
+        while len(gathered) < n_legs and not any(map(usable, gathered)):
+            gathered.append(results.get())
+        winner = next((r for r in gathered if usable(r)), None)
+        won_usable = winner is not None
+        if winner is None:
+            # no usable answer: a shed verdict (relayable, carries
+            # Retry-After) still beats a transport error
+            winner = next((r for r in gathered if r[4] is None), None)
+        if winner is None:
+            raise gathered[-1][4]  # every leg transport-failed
+        with lock:
+            state["committed"] = True
+            for c in calls:
+                if c is not winner[1]:
+                    c.cancel()
+        # loser cleanup happens OFF the response path: every remaining
+        # leg report is consumed by a janitor, so the winner's reply is
+        # never gated on a loser's socket (an answered loser untracks +
+        # closes there; error legs already untracked themselves). A
+        # loser that shed still gets its Retry-After honored — losing
+        # the race doesn't make the replica less overloaded, and the
+        # next request must not route straight back into it.
+        losers = [r for r in gathered if r is not winner and r[4] is None]
+        outstanding = n_legs - len(gathered)
+
+        def _reap():
+            got = list(losers)
+            for _ in range(outstanding):
+                r = results.get()
+                if r[4] is None:
+                    got.append(r)
+            for r in got:
+                if r[2] in (429, 503):
+                    self.replicas.note_backoff(
+                        r[0].rid,
+                        parse_retry_after(r[1].header("Retry-After")))
+                self.replicas.untrack(r[0].rid, tokens)
+                r[1].close()
+
+        if losers or outstanding:
+            threading.Thread(target=_reap, name="hedge-reap",
+                             daemon=True).start()
+        replica, call, status, out, _ = winner
+        if won_usable and hedge_rep is not None \
+                and replica.rid == hedge_rep.rid:
+            # only a USABLE hedge answer is a win — a shed verdict that
+            # surfaced because every leg shed is a relay, not a rescue
+            self._obs["router_hedge_wins_total"].inc()
+        hdrs: Tuple[Tuple[str, str], ...] = ()
+        ra = call.header("Retry-After")
+        if ra is not None:
+            hdrs = (("Retry-After", ra),)
+        self.replicas.untrack(replica.rid, tokens)
+        call.close()
+        return status, out, hdrs, replica.rid
+
+    # -- streaming -------------------------------------------------------
+
+    def open_stream(self, req: dict):
+        """Route a streamed generate. Returns ``(replica, call,
+        first_lines, tokens)``: for a 200 the stream is PRIMED — the
+        response lines up to and including the first ``data:`` event
+        are already read into ``first_lines``, so a replica death
+        anywhere before the first event (connect refused, died after
+        the status line) re-routes here, where nothing has reached the
+        client yet. After this returns, the no-replay rule applies: the
+        HTTP layer relays and a later death surfaces as a terminal
+        error. A 429/503 shed gets the same single re-route as the
+        non-streamed path (a shed produced no client-visible bytes, so
+        replay is not a concern); if no other replica can take it, the
+        FIRST shed verdict is relayed. Other non-200 verdicts return
+        unprimed (JSON body, relayed verbatim)."""
+        body = json.dumps(req).encode()
+        tokens = self._token_ask(req)
+        affinity = self._affinity_for(req)
+        tried: List[str] = []
+        # a held shed verdict: still tracked, relayed only if no later
+        # attempt produces anything better (_stream untracks + closes)
+        shed = None
+        for attempt in range(2):
+            replica = self.pick(affinity if attempt == 0 else None,
+                                exclude=tuple(tried))
+            if replica is None:
+                break
+            tried.append(replica.rid)
+            try:
+                call = self._forward_once(replica, "/v1/generate", body,
+                                          tokens)
+            except ReplicaUnreachable as exc:
+                self._note_stream_reroute(replica.rid, str(exc))
+                continue
+            if call.status in (429, 503) and shed is None \
+                    and attempt == 0:
+                # backpressure before any bytes reached the client:
+                # honor Retry-After and try the next-best replica once,
+                # exactly like the non-streamed path
+                self.replicas.note_backoff(
+                    replica.rid,
+                    parse_retry_after(call.header("Retry-After")))
+                self._obs["router_reroutes_total"].labels(
+                    reason="backpressure").inc()
+                self.event_log.emit("router_reroute",
+                                    path="/v1/generate",
+                                    reason="backpressure",
+                                    shed_by=replica.rid, stream=True)
+                shed = (replica, call)
+                continue
+            if call.status != 200:
+                if shed is not None:
+                    self.replicas.untrack(shed[0].rid, tokens)
+                    shed[1].close()
+                return replica, call, [], tokens
+            first_lines: List[bytes] = []
+            try:
+                for line in call.iter_lines():
+                    first_lines.append(line)
+                    if line.startswith(b"data:"):
+                        break
+                else:
+                    raise ReplicaUnreachable(
+                        "stream ended before the first event")
+            except ReplicaUnreachable as exc:
+                self.replicas.untrack(replica.rid, tokens)
+                call.close()
+                self.replicas.set_state(replica.rid, DOWN,
+                                        reason="died before first event")
+                self._note_stream_reroute(replica.rid, str(exc))
+                continue
+            if shed is not None:
+                self.replicas.untrack(shed[0].rid, tokens)
+                shed[1].close()
+            return replica, call, first_lines, tokens
+        if shed is not None:
+            return shed[0], shed[1], [], tokens
+        self._count("none", "shed")
+        return None, None, [], tokens
+
+    def _note_stream_reroute(self, rid: str, error: str) -> None:
+        self._obs["router_reroutes_total"].labels(reason="stream").inc()
+        self.event_log.emit("router_reroute", path="/v1/generate",
+                            reason="stream_connect", failed=rid,
+                            error=error[:200])
+
+    # -- health ----------------------------------------------------------
+
+    def health(self) -> Tuple[int, dict]:
+        routable = len(self.replicas.routable())
+        self._obs["router_replicas_routable"].set(routable)
+        status = 200 if routable and not self.draining.is_set() else 503
+        return status, {
+            "status": ("draining" if self.draining.is_set()
+                       else "ok" if routable else "no_replicas"),
+            "routable": routable,
+            "replicas": self.replicas.snapshot(),
+            "hedge": {"enabled": self.hedge_enabled,
+                      "delay_ms": round(self.hedge_delay_s() * 1000.0, 1)},
+            "affinity_tokens": self.affinity_tokens,
+            "inflight_cap": self.inflight_cap,
+        }
+
+
+# -- HTTP plumbing -----------------------------------------------------------
+
+
+def _make_handler(router: RouterServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            logger.info("%s %s", self.address_string(), fmt % args)
+
+        def _reply(self, code: int, payload: dict, headers=()):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for name, value in headers:
+                self.send_header(name, value)
+            if self.close_connection:
+                self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            route = self.path.partition("?")[0]
+            if route in ("/healthz", "/health", "/"):
+                code, payload = router.health()
+                return self._reply(code, payload)
+            out = handle_obs_request(self.path, router.registry,
+                                     router.event_log)
+            if out is None:
+                return self._reply(404,
+                                   {"error": f"unknown path {self.path}"})
+            code, ctype, body = out
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _stream(self, req: dict):
+            """Relay a replica's SSE stream byte-for-byte. Failures
+            before the first event already failed over inside
+            open_stream; once bytes flow, a death surfaces as an error
+            event + [DONE] — never a silent replay from another
+            replica."""
+            replica, call, first_lines, tokens = router.open_stream(req)
+            if call is None:
+                return self._reply(
+                    503, {"error": "no routable replica for the stream",
+                          "reason": "no_replicas"},
+                    headers=(("Retry-After", "1"),))
+            try:
+                if call.status != 200:
+                    # replica rejected before streaming (400/429/503):
+                    # relay its JSON verdict + headers verbatim
+                    out = call.read_json()
+                    hdrs = ()
+                    ra = call.header("Retry-After")
+                    if ra is not None:
+                        router.replicas.note_backoff(
+                            replica.rid, parse_retry_after(ra))
+                        hdrs = (("Retry-After", ra),)
+                    router._count(replica.rid,
+                                  "shed" if call.status in (429, 503)
+                                  else "client_error"
+                                  if call.status < 500
+                                  else "upstream_error")
+                    return self._reply(call.status, out, headers=hdrs)
+                self.close_connection = True
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                saw_done = False
+                try:
+                    for line in itertools.chain(first_lines,
+                                                call.iter_lines()):
+                        if line.strip() == b"data: [DONE]":
+                            saw_done = True
+                        self.wfile.write(line)
+                        self.wfile.flush()
+                    if not saw_done:
+                        # clean EOF without the SSE terminator: the
+                        # replica died mid-stream (a socket close reads
+                        # as EOF, not an error) — same taxonomy as a
+                        # reset
+                        raise ReplicaUnreachable(
+                            "stream ended without [DONE] (replica died "
+                            "mid-stream)")
+                    router._count(replica.rid, "ok")
+                except OSError:
+                    # the CLIENT hung up mid-relay (routine): the
+                    # replica is fine — stop relaying, count the
+                    # outcome, never write another byte at the dead
+                    # socket
+                    router._count(replica.rid, "client_disconnect")
+                except ReplicaUnreachable as exc:
+                    router.replicas.set_state(
+                        replica.rid, DOWN, reason="died mid-stream")
+                    router._count(replica.rid, "upstream_error")
+                    # the terminal error the client is OWED: tokens
+                    # already delivered stay delivered (no silent
+                    # replay from another replica), the stream ends
+                    # with an explicit error event
+                    try:
+                        self.wfile.write(
+                            f"data: {json.dumps({'error': str(exc)})}"
+                            "\n\n".encode())
+                        self.wfile.write(b"data: [DONE]\n\n")
+                    except OSError:
+                        pass
+            finally:
+                router.replicas.untrack(replica.rid, tokens)
+                call.close()
+
+        def do_POST(self):
+            if router.draining.is_set():
+                self.close_connection = True
+                return self._reply(
+                    503, {"error": "router is draining",
+                          "reason": "draining"},
+                    headers=(("Retry-After", "5"),))
+            router.http_enter()
+            try:
+                self._do_post_inner()
+            finally:
+                router.http_exit()
+
+        def _do_post_inner(self):
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                if n > MAX_BODY_BYTES:
+                    self.close_connection = True
+                    return self._reply(413, {
+                        "error": f"body too large ({n} bytes > "
+                                 f"{MAX_BODY_BYTES})"})
+                req = json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, json.JSONDecodeError) as exc:
+                return self._reply(400, {"error": f"bad JSON body: {exc}"})
+            if self.path not in ("/v1/generate", "/v1/score", "/v1/warm"):
+                return self._reply(404,
+                                   {"error": f"unknown path {self.path}"})
+            if not isinstance(req, dict):
+                return self._reply(400, {"error": "body must be a JSON "
+                                                  "object"})
+            try:
+                if self.path == "/v1/generate" and req.get("stream"):
+                    return self._stream(req)
+                status, out, hdrs = router.route_json(self.path, req)
+            except OSError as exc:
+                # replica-side transport errors all surface as
+                # ReplicaUnreachable, so a raw OSError here is the
+                # CLIENT's socket dying mid-write — there is nobody
+                # left to reply to (writing a 500 at the dead socket
+                # would just double-fault)
+                logger.info("client disconnected mid-request: %s", exc)
+                return
+            except Exception as exc:  # noqa: BLE001 — keep the gateway up
+                logger.exception("routing failed")
+                status, out, hdrs = 500, {
+                    "error": f"{type(exc).__name__}: {exc}"}, ()
+            try:
+                self._reply(status, out, headers=hdrs)
+            except OSError:
+                logger.info("client disconnected before the reply")
+
+    return Handler
+
+
+def start_router_http_server(router: RouterServer, host: str = "0.0.0.0",
+                             port: int = 8800) -> ThreadingHTTPServer:
+    """Bind and return the router's HTTP server (``port=0`` →
+    ephemeral). Caller runs ``serve_forever``."""
+    return ThreadingHTTPServer((host, port), _make_handler(router))
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    e = os.environ.get
+    p = argparse.ArgumentParser(
+        description="Replica-aware router for BundleServer fleets")
+    p.add_argument("--replicas", default=e("ROUTER_REPLICAS", ""),
+                   help="comma-separated replica base URLs "
+                        "(http://host:port,...) — static membership")
+    p.add_argument("--discover", default=e("ROUTER_DISCOVER", ""),
+                   help="DNS name to resolve replicas from (k8s headless "
+                        "Service: one A record per pod); merged with "
+                        "--replicas")
+    p.add_argument("--discover-port", type=int,
+                   default=int(e("ROUTER_DISCOVER_PORT", "8000")),
+                   help="replica port for --discover addresses")
+    p.add_argument("--host", default=e("ROUTER_HOST", "0.0.0.0"))
+    p.add_argument("--port", type=int, default=int(e("ROUTER_PORT", "8800")))
+    p.add_argument("--probe-interval", type=float,
+                   default=float(e("ROUTER_PROBE_INTERVAL", "1.0")),
+                   help="seconds between /loadz health sweeps")
+    p.add_argument("--probe-timeout", type=float,
+                   default=float(e("ROUTER_PROBE_TIMEOUT", "2.0")))
+    p.add_argument("--fail-threshold", type=int,
+                   default=int(e("ROUTER_FAIL_THRESHOLD", "2")),
+                   help="consecutive probe failures before UP -> DOWN "
+                        "(request-path transport failures mark DOWN "
+                        "immediately)")
+    p.add_argument("--affinity-tokens", type=int,
+                   default=int(e("ROUTER_AFFINITY_TOKENS", "32")),
+                   help="hash this many leading prompt tokens for "
+                        "prefix-affinity routing (0 = pure least-loaded)")
+    p.add_argument("--inflight-cap", type=int,
+                   default=int(e("ROUTER_INFLIGHT_CAP", "0")),
+                   help="per-replica in-flight request cap (0 = none); "
+                        "a saturated affinity target spills to the "
+                        "least-loaded replica")
+    p.add_argument("--no-hedge", action="store_true",
+                   default=e("ROUTER_NO_HEDGE", "") == "1",
+                   help="disable hedged failover for non-streamed "
+                        "generates")
+    p.add_argument("--hedge-min-ms", type=float,
+                   default=float(e("ROUTER_HEDGE_MIN_MS", "50")))
+    p.add_argument("--hedge-max-ms", type=float,
+                   default=float(e("ROUTER_HEDGE_MAX_MS", "2000")))
+    p.add_argument("--request-timeout", type=float,
+                   default=float(e("ROUTER_REQUEST_TIMEOUT", "600")))
+    p.add_argument("--drain-timeout", type=float,
+                   default=float(e("ROUTER_DRAIN_TIMEOUT", "15")),
+                   help="seconds SIGTERM waits before stopping the "
+                        "accept loop (in-flight proxies finish)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if not args.replicas and not args.discover:
+        print("router needs --replicas and/or --discover",
+              file=sys.stderr)
+        return 2
+    replicas = parse_replica_list(args.replicas) if args.replicas else []
+    dns_refresh = None
+    if args.discover:
+        def dns_refresh():
+            return resolve_dns_replicas(args.discover, args.discover_port)
+
+        replicas = replicas + dns_refresh()
+    router = RouterServer(
+        replicas,
+        affinity_tokens=args.affinity_tokens,
+        inflight_cap=args.inflight_cap,
+        hedge=not args.no_hedge,
+        hedge_min_ms=args.hedge_min_ms,
+        hedge_max_ms=args.hedge_max_ms,
+        request_timeout_s=args.request_timeout)
+    prober = HealthProber(
+        router.replicas, interval_s=args.probe_interval,
+        timeout_s=args.probe_timeout, fail_threshold=args.fail_threshold,
+        dns_refresh=dns_refresh)
+    prober.probe_once()  # first sweep before accepting traffic
+    prober.start()
+    httpd = start_router_http_server(router, args.host, args.port)
+    router.event_log.emit("router_started",
+                          replicas=[r.rid for r in router.replicas.all()],
+                          port=httpd.server_address[1])
+    logger.info("routing on http://%s:%d across %d replica(s)",
+                *httpd.server_address[:2], len(router.replicas))
+
+    def _drain_then_stop():
+        # new POSTs shed 503 the instant draining is set, so the wait
+        # only covers proxies already in flight — poll them down and
+        # stop early (an idle router drains in one poll interval, not
+        # the full --drain-timeout), mirroring BundleServer.drain
+        router.draining.set()
+        deadline = time.monotonic() + args.drain_timeout
+        while time.monotonic() < deadline and router.http_inflight() > 0:
+            time.sleep(0.2)
+        httpd.shutdown()
+
+    if threading.current_thread() is threading.main_thread():
+        import signal
+
+        signal.signal(
+            signal.SIGTERM,
+            lambda signum, frame: threading.Thread(
+                target=_drain_then_stop, name="router-drain",
+                daemon=True).start())
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        logger.info("shutting down")
+        httpd.shutdown()
+    finally:
+        prober.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

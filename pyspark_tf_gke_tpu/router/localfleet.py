@@ -1,0 +1,129 @@
+"""Local replica-fleet harness: the ONE copy of the launch scaffolding
+shared by ``bench.py router``, ``tools/smoke_check.py --router``, and
+the slow kill-one-replica soak in ``tests/test_router.py``.
+
+All three drive the same contract — N tiny CPU ``BundleServer``
+subprocesses behind the real router CLI — and before this module each
+carried its own bundle-export recipe, port allocator, Popen argv, and
+wait-for-healthy loop; a replica CLI flag change had to be edited three
+times and would silently drift. Everything here is stdlib-only and
+keeps the CALLING process jax-free: the tiny serving bundle is exported
+by a CPU-pinned child process, so a bench/smoke parent never
+initializes a jax backend (a down TPU tunnel must not gate a
+router-plane check).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from typing import Optional, Sequence
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# byte-tokenizer-compatible CausalLM (vocab 259 covers the byte range);
+# small enough that two replicas + a router fit a 1-vCPU box
+TINY_BUNDLE_EXPORT_SRC = (
+    "import jax, sys\n"
+    "jax.config.update('jax_platforms', 'cpu')\n"
+    "import jax.numpy as jnp\n"
+    "from flax import linen as nn\n"
+    "from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig\n"
+    "from pyspark_tf_gke_tpu.train.export import export_serving_bundle\n"
+    "from pyspark_tf_gke_tpu.utils.seeding import make_rng\n"
+    "cfg = CausalLMConfig(vocab_size=259, hidden_size=32,\n"
+    "                     num_layers=2, num_heads=2,\n"
+    "                     intermediate_size=64, max_seq_len=64,\n"
+    "                     dtype=jnp.float32)\n"
+    "model = CausalLM(cfg)\n"
+    "params = nn.meta.unbox(jax.jit(model.init)(\n"
+    "    make_rng(0), jnp.zeros((1, 8), jnp.int32))['params'])\n"
+    "export_serving_bundle(cfg, params, sys.argv[1], quantize=False)\n")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def cpu_env() -> dict:
+    return dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def export_tiny_bundle(dest: str, timeout_s: float = 600.0) -> str:
+    """Export the tiny serving bundle via a CPU-pinned child process
+    (the caller's jax stays un-initialized)."""
+    proc = subprocess.run(
+        [sys.executable, "-c", TINY_BUNDLE_EXPORT_SRC, dest],
+        env=cpu_env(), cwd=REPO_ROOT, capture_output=True, text=True,
+        timeout=timeout_s)
+    if proc.returncode != 0:
+        raise RuntimeError(f"bundle export failed: {proc.stderr[-800:]}")
+    return dest
+
+
+def launch_replica(bundle: str, port: int,
+                   extra_args: Sequence[str] = (),
+                   quiet: bool = True) -> subprocess.Popen:
+    """One CPU-pinned ``train.serve`` replica on 127.0.0.1:port."""
+    kw = ({"stdout": subprocess.DEVNULL, "stderr": subprocess.DEVNULL}
+          if quiet else {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "pyspark_tf_gke_tpu.train.serve",
+         "--bundle", bundle, "--host", "127.0.0.1", "--port", str(port),
+         "--continuous-slots", "2", "--continuous-chunk", "2",
+         *extra_args],
+        env=cpu_env(), cwd=REPO_ROOT, **kw)
+
+
+def launch_router(replica_ports: Sequence[int], port: int,
+                  extra_args: Sequence[str] = (),
+                  quiet: bool = True) -> subprocess.Popen:
+    """The real router CLI fronting ``replica_ports``, tuned for local
+    checks: tight probe interval, single-failure DOWN."""
+    kw = ({"stdout": subprocess.DEVNULL, "stderr": subprocess.DEVNULL}
+          if quiet else {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "pyspark_tf_gke_tpu.router",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--replicas", ",".join(f"http://127.0.0.1:{p}"
+                                for p in replica_ports),
+         "--probe-interval", "0.2", "--fail-threshold", "1",
+         *extra_args],
+        env=dict(os.environ), cwd=REPO_ROOT, **kw)
+
+
+def wait_healthy(base_url: str, deadline: float,
+                 proc: Optional[subprocess.Popen] = None) -> None:
+    """Poll ``/healthz`` until 200 or ``deadline`` (epoch seconds);
+    fail fast if ``proc`` exits before answering."""
+    while True:
+        try:
+            urllib.request.urlopen(base_url + "/healthz", timeout=2)
+            return
+        except Exception:  # noqa: BLE001 — still booting
+            if proc is not None and proc.poll() is not None:
+                raise RuntimeError(
+                    f"{base_url} process died at startup "
+                    f"(rc={proc.returncode})")
+            if time.time() > deadline:
+                raise RuntimeError(f"{base_url} never became healthy")
+            time.sleep(0.3)
+
+
+def post_generate(base_url: str, prompt: str, max_new_tokens: int = 6,
+                  timeout_s: float = 120.0) -> dict:
+    req = urllib.request.Request(
+        base_url + "/v1/generate",
+        data=json.dumps({"prompts": [prompt],
+                         "max_new_tokens": max_new_tokens}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.loads(resp.read())

@@ -1,0 +1,93 @@
+"""Routing policy: least-outstanding-tokens with a prefix-affinity
+override.
+
+**Why affinity.** Each replica's slot engine keeps a per-process prefix
+cache (``train/continuous.py`` ``prefix_cache_size``): a prompt whose
+prefix was prefilled there skips that prefill entirely. The cache is
+replica-LOCAL, so a load balancer that sprays same-prefix traffic
+uniformly warms N caches to 1/N usefulness each. Hashing the first K
+prompt tokens and pinning that hash to one replica (SGLang's
+cache-aware routing shape) concentrates the hits.
+
+**Why rendezvous hashing.** ``hash % n`` reshuffles almost every key
+when membership changes by one; highest-random-weight (rendezvous)
+hashing moves only the keys owned by the lost replica — exactly the
+stability a prefix cache wants through a rolling restart.
+
+**Why the override is soft.** Affinity wins only while the target can
+absorb the work (UP, not backing off, in-flight below the cap, and not
+carrying more than ``spill_ratio`` x the least-loaded replica's
+outstanding tokens). Past that, a hot prefix must spill — a cache hit
+saved is worth one prefill, not an unbounded queue.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Tuple
+
+from pyspark_tf_gke_tpu.router.discovery import Replica
+
+# Default K: hash this many leading prompt tokens. The platform's
+# default byte tokenizer makes bytes == tokens; for other tokenizers
+# the prefix of the UTF-8 encoding is a stable proxy (the router has no
+# tokenizer on purpose — it must not load a model).
+DEFAULT_AFFINITY_TOKENS = 32
+
+
+def affinity_key(prompt: str, k: int = DEFAULT_AFFINITY_TOKENS) -> str:
+    """Stable hash of the first ``k`` prompt tokens (prompt bytes under
+    the default byte tokenizer). Same prefix -> same key -> same
+    replica -> warm prefix cache."""
+    head = prompt.encode("utf-8", "surrogatepass")[:k]
+    return hashlib.sha1(head).hexdigest()[:16]
+
+
+def _rendezvous_weight(key: str, rid: str) -> int:
+    return int.from_bytes(
+        hashlib.sha1(f"{key}|{rid}".encode()).digest()[:8], "big")
+
+
+def rendezvous_pick(key: str, replicas: List[Replica]) -> Optional[Replica]:
+    """Highest-random-weight owner of ``key`` among ``replicas``."""
+    if not replicas:
+        return None
+    return max(replicas, key=lambda r: _rendezvous_weight(key, r.rid))
+
+
+def choose_replica(replicas: List[Replica], *,
+                   affinity: Optional[str] = None,
+                   inflight_cap: int = 0,
+                   spill_ratio: float = 4.0,
+                   exclude: Tuple[str, ...] = ()
+                   ) -> Tuple[Optional[Replica], bool]:
+    """Pick the replica for one request.
+
+    ``replicas``: the ROUTABLE set (UP, backoff passed — the caller
+    filters). ``affinity``: an :func:`affinity_key`, or None for pure
+    load balancing. ``inflight_cap``: per-replica in-flight request cap
+    (0 = uncapped). ``exclude``: rids already tried (re-route/hedge must
+    not land on the same pod twice).
+
+    Returns ``(replica | None, affinity_used)`` — None when nothing can
+    take the request (caller sheds 503)."""
+    candidates = [r for r in replicas if r.rid not in exclude]
+    if not candidates:
+        return None, False
+    under_cap = [r for r in candidates
+                 if not inflight_cap or r.inflight < inflight_cap]
+    if not under_cap:
+        return None, False
+    least = min(under_cap, key=lambda r: (r.outstanding_tokens(),
+                                          r.inflight, r.rid))
+    if affinity is not None:
+        target = rendezvous_pick(affinity, candidates)
+        if (target is not None and target in under_cap
+                and target.outstanding_tokens()
+                <= max(spill_ratio * least.outstanding_tokens(),
+                       # an idle fleet has score 0 everywhere — the
+                       # floor keeps affinity sticky until real load
+                       # separates the replicas
+                       spill_ratio * 256)):
+            return target, True
+    return least, False

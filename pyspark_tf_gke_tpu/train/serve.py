@@ -635,6 +635,37 @@ class BundleServer:
                            if self._front is not None else None),
         }
 
+    def loadz(self) -> dict:
+        """One cheap JSON load snapshot (``GET /loadz``): what the
+        replica router's prober polls instead of scraping Prometheus
+        text. The key set is a STABLE contract (tests pin it) — the
+        router scores replicas by ``queued_tokens``/``active`` and
+        gates on ``draining``; whole-batch servers (no slot engine)
+        report zeros so the router can still rank them by in-flight
+        HTTP load."""
+        with self._inflight_lock:
+            inflight_http = self._inflight_http
+        out = {
+            "queued": 0,
+            "queued_tokens": 0,
+            "active": 0,
+            "slots_total": 0,
+            "kv_pages_free": None,
+            "inflight_http": inflight_http,
+            "draining": self.draining,
+        }
+        if self._front is not None:
+            stats = self._front.engine.stats
+            out["queued"] = stats["queued"]
+            out["queued_tokens"] = stats["queued_tokens"]
+            out["active"] = stats["active"]
+            out["slots_total"] = stats["num_slots"]
+            paged = stats.get("paged")
+            if paged:
+                out["kv_pages_free"] = (paged["pages_total"]
+                                        - paged["pages_in_use"])
+        return out
+
     # -- generation ------------------------------------------------------
 
     def generate(self, prompts, max_new_tokens: int = 64,
@@ -1166,6 +1197,13 @@ def _make_handler(server: BundleServer):
                 # to watch the queue empty)
                 return self._reply(503 if server.draining else 200,
                                    server.health())
+            if route == "/loadz":
+                # the router's prober polls this every second per
+                # replica: one dict assembly, no registry walk, no
+                # Prometheus text parse on the other end. Draining
+                # answers 200 — the field carries the state; the 503
+                # convention stays on /healthz (readiness)
+                return self._reply(200, server.loadz())
             # /metrics, /metrics.json, /events — the obs package owns
             # the response assembly; this server contributes the live
             # engine-gauge refresh and its legacy alias block

@@ -122,6 +122,46 @@ def test_tpu_serve_manifest_conventions():
     assert c["resources"]["requests"]["google.com/tpu"] == "4"
 
 
+def test_tpu_router_manifest_conventions():
+    """The router tier must agree with the code's contracts: the
+    discovery Service is HEADLESS and selects the SERVE pods (per-pod A
+    records, not a VIP), ROUTER_DISCOVER names it, the router runs the
+    router CLI on a CPU node (no TPU resources), readiness rides
+    /healthz (503 with zero routable replicas) while liveness rides
+    /metrics (a router with no backends is degraded, not dead)."""
+    docs = _load("infra/k8s/tpu/tpu-router.yaml")
+    serve = _load("infra/k8s/tpu/tpu-serve.yaml")
+    serve_dep = next(d for d in serve if d["kind"] == "Deployment")
+    discovery = next(d for d in docs if d["kind"] == "Service"
+                     and d["spec"].get("clusterIP") == "None")
+    front = next(d for d in docs if d["kind"] == "Service"
+                 and d["spec"].get("clusterIP") != "None")
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    # discovery targets the serve pods on the serve port
+    serve_labels = serve_dep["spec"]["selector"]["matchLabels"]
+    assert discovery["spec"]["selector"] == serve_labels
+    assert discovery["spec"]["ports"][0]["port"] == 8000
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"][-1] == "pyspark_tf_gke_tpu.router"
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["ROUTER_DISCOVER"] == discovery["metadata"]["name"]
+    assert int(env["ROUTER_DISCOVER_PORT"]) == 8000
+    # client-facing Service port matches the router's listen port
+    assert front["spec"]["ports"][0]["port"] == int(env["ROUTER_PORT"])
+    assert c["ports"][0]["containerPort"] == int(env["ROUTER_PORT"])
+    # pure CPU gateway: claims no TPU and avoids the TPU node selector
+    assert "google.com/tpu" not in c.get("resources", {}).get(
+        "requests", {})
+    assert "nodeSelector" not in dep["spec"]["template"]["spec"]
+    # readiness on /healthz, liveness decoupled from replica health
+    assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
+    assert c["livenessProbe"]["httpGet"]["path"] == "/metrics"
+    # drain fits the grace window (preStop sleep + drain timeout)
+    grace = dep["spec"]["template"]["spec"][
+        "terminationGracePeriodSeconds"]
+    assert float(env["ROUTER_DRAIN_TIMEOUT"]) + 5 < grace
+
+
 def test_tpu_serve_multihost_manifest_conventions():
     """The multi-host serving StatefulSet must agree with the CLI's
     addressing contract: hostname-ordinal process ids, pod-0 headless

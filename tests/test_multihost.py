@@ -36,6 +36,69 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# -- backend capability probe -------------------------------------------------
+# Some jax builds/backends cannot run multi-PROCESS computations at all
+# (this env's CPU backend raises "Multiprocess computations aren't
+# implemented on the CPU backend" from every cross-process collective).
+# That is an environment capability gap, not a regression in the code
+# under test — probe ONCE per session and skip the 2-proc tests with an
+# explicit reason instead of failing them, so the tier-1/slow log stops
+# carrying known-env noise. Any OTHER probe failure does NOT skip: the
+# tests run and fail attributably.
+
+_MULTIPROC_UNIMPL_MARKERS = ("aren't implemented", "not implemented",
+                             "unimplemented")
+
+_PROBE_RUNNER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+jax.distributed.initialize(coordinator_address=sys.argv[1],
+                           num_processes=2, process_id=int(sys.argv[2]))
+x = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(
+    jnp.ones((jax.local_device_count(),)))
+assert float(x[0]) == jax.device_count(), x
+print("PROBE_OK", jax.process_index())
+"""
+
+_multiproc_probe_memo: list = []  # [reason_or_None], filled once
+
+
+def _multiprocess_unimplemented_reason():
+    """None when 2-process jax.distributed works here; otherwise the
+    backend's own 'unimplemented' line (the skip reason)."""
+    if _multiproc_probe_memo:
+        return _multiproc_probe_memo[0]
+    procs = _spawn_pair(
+        lambda pid, port: ["-c", _PROBE_RUNNER,
+                           f"127.0.0.1:{port}", str(pid)])
+    outs = _communicate_pair(procs, timeout_s=180)
+    reason = None
+    if not all(p.returncode == 0 and "PROBE_OK" in t
+               for p, t in zip(procs, outs)):
+        marker = next(
+            (ln.strip()[-300:] for text in outs
+             for ln in text.splitlines()
+             if any(m in ln.lower() for m in _MULTIPROC_UNIMPL_MARKERS)),
+            None)
+        # only the capability gap converts to a skip; other failures
+        # leave reason None and the real tests surface them
+        reason = marker
+    _multiproc_probe_memo.append(reason)
+    return reason
+
+
+@pytest.fixture()
+def multiproc_backend():
+    """Skip (with the backend's own words) when this environment cannot
+    run 2-process jax computations at all."""
+    reason = _multiprocess_unimplemented_reason()
+    if reason:
+        pytest.skip("backend reports multiprocess unimplemented: "
+                    + reason)
+
+
 def _spawn_pair(argv_for_pid, extra_env=None):
     """Launch the 2-process fake-slice pair (4 virtual CPU devices per
     process): ``argv_for_pid(pid, port) -> argv after sys.executable``.
@@ -114,7 +177,7 @@ def _wait_for_checkpoint(procs, ckdir, extra_ready=None, timeout_s=300):
 
 
 @pytest.mark.slow
-def test_two_process_csv_training(tmp_path):
+def test_two_process_csv_training(multiproc_backend, tmp_path):
     from pyspark_tf_gke_tpu.data.synthetic import make_synthetic_csv
 
     csv = str(tmp_path / "d.csv")
@@ -137,7 +200,7 @@ def test_two_process_csv_training(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_kill_and_resume(tmp_path):
+def test_two_process_kill_and_resume(multiproc_backend, tmp_path):
     """Fault-tolerance across real process boundaries: both workers are
     SIGKILLed mid-training (the synchronous SPMD failure unit is the
     whole job — one dead worker stalls collectives, so k8s restarts the
@@ -241,7 +304,7 @@ def _tp_serve_fixture():
 
 
 @pytest.mark.slow
-def test_two_process_tp_serving_matches_single_process(tmp_path):
+def test_two_process_tp_serving_matches_single_process(multiproc_backend, tmp_path):
     """VERDICT round-3 #5: serving exercised across real process
     boundaries. A 2-process x 4-device dp=4 x tp=2 ``serve_generate``
     (tensor-parallel param placement + collectives over the wire) must
@@ -304,7 +367,7 @@ else:
 
 
 @pytest.mark.slow
-def test_two_process_serving_driver_worker_loop(tmp_path):
+def test_two_process_serving_driver_worker_loop(multiproc_backend, tmp_path):
     """The multi-host serving CONTROL plane (train/serving.py): process
     0 announces each request (header + payload broadcast), process 1
     replays it in serve_worker_loop, and the collective-backed decode
@@ -358,7 +421,7 @@ sys.exit(serve.main(sys.argv[1:]))
 
 
 @pytest.mark.slow
-def test_two_process_serve_cli_http_end_to_end(tmp_path):
+def test_two_process_serve_cli_http_end_to_end(multiproc_backend, tmp_path):
     """The DEPLOYMENT surface on a multi-host mesh: two processes run
     the real `train.serve` CLI (process 0 = HTTP server, process 1 =
     worker loop), the parent speaks HTTP to process 0, and greedy
@@ -490,7 +553,7 @@ def test_two_process_serve_cli_http_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_sigstop_stall_detection_and_restart(tmp_path):
+def test_two_process_sigstop_stall_detection_and_restart(multiproc_backend, tmp_path):
     """The REAL TPU-pod failure shape: a worker that is alive but hung
     (SIGSTOP — the process exists, collectives never complete). End to
     end: per-process heartbeats -> watchdog detects the stalled worker
@@ -590,7 +653,7 @@ else:
 
 
 @pytest.mark.slow
-def test_two_process_continuous_batching_matches_single_process():
+def test_two_process_continuous_batching_matches_single_process(multiproc_backend):
     """Continuous batching over the announce/replay wire: process 0's
     slot engine announces every device op (admit/chunk/free); process 1
     replays them into a SlotDeviceState replica. Three staggered
@@ -623,7 +686,7 @@ def test_two_process_continuous_batching_matches_single_process():
 
 
 @pytest.mark.slow
-def test_two_process_continuous_batching_decode_ahead_matches():
+def test_two_process_continuous_batching_decode_ahead_matches(multiproc_backend):
     """Decode-ahead over the wire: process 0 announces deferred chunks
     (dispatch-only) and separate OP_CB_COLLECT gathers; the worker
     replays both, so the collective order stays aligned while the
@@ -695,7 +758,7 @@ else:
 
 
 @pytest.mark.slow
-def test_two_process_chunked_prefill_paged_matches_single_process():
+def test_two_process_chunked_prefill_paged_matches_single_process(multiproc_backend):
     """Chunked prefill over the announce/replay wire (paged engine):
     process 0 announces each prompt PIECE on OP_CB_ADMIT (flags
     bitfield + fill payload + block-table row) and the final

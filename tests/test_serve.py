@@ -331,6 +331,29 @@ def test_continuous_health_reports_engine(cb_endpoints):
     assert health["continuous"]["chunk"] == 3
 
 
+def test_loadz_snapshot_key_stability(cb_endpoints):
+    """GET /loadz is the router-prober contract: the KEY SET is pinned
+    here so a refactor can't silently break replica scoring (the
+    router reads queued_tokens/active/draining; kv_pages_free is None
+    on dense engines, a number on paged ones)."""
+    plain_url, cont_url = cb_endpoints
+    want_keys = {"queued", "queued_tokens", "active", "slots_total",
+                 "kv_pages_free", "inflight_http", "draining"}
+    for url in (plain_url, cont_url):
+        with urllib.request.urlopen(url + "/loadz") as resp:
+            assert resp.status == 200
+            out = json.loads(resp.read())
+        assert set(out) == want_keys
+        assert out["draining"] is False
+        assert out["kv_pages_free"] is None  # dense engine / whole-batch
+    with urllib.request.urlopen(cont_url + "/loadz") as resp:
+        cont = json.loads(resp.read())
+    assert cont["slots_total"] == 2  # the slot engine's pool
+    with urllib.request.urlopen(plain_url + "/loadz") as resp:
+        plain = json.loads(resp.read())
+    assert plain["slots_total"] == 0  # whole-batch: zeros, still ranks
+
+
 def test_continuous_sampling_routes_through_engine(cb_endpoints):
     # temperature/top-p requests ride the slot engine (per-slot keys);
     # beams stay on the whole-batch path — both must serve.

@@ -27,8 +27,14 @@ decode chunks and keep the streamer's worst token gap bounded
 (chunking on), while the monolithic prefill's unbounded stall is
 detected with it off.
 
+``--router`` checks the replica-router failover contract: 2 CPU
+replica subprocesses behind a router subprocess, concurrent requests,
+SIGKILL one replica mid-run — every request must reach a terminal
+outcome (the survivors via hedge/re-route), and the router must drain
+and exit 0 on SIGTERM.
+
 Usage: python tools/smoke_check.py
-       [--lint-only|--kernels-only|--serve-lifecycle|--serve-tbt]
+       [--lint-only|--kernels-only|--serve-lifecycle|--serve-tbt|--router]
 """
 
 import os
@@ -97,9 +103,14 @@ def lint_duplicate_metrics() -> int:
         for s in skipped:
             print(f"  - {s}")
     # exercise the canonical registration paths (throwaway registry —
-    # the record is process-global either way)
+    # the record is process-global either way). router_families is the
+    # router plane's entry point (pyspark_tf_gke_tpu/router/) — its
+    # router_* names ride the same one-name-one-shape contract.
+    from pyspark_tf_gke_tpu.obs.metrics import router_families
+
     scheme = MetricsRegistry()
     platform_families(scheme)
+    router_families(scheme)
     install_runtime_metrics(scheme)
     if not _REGISTRATIONS:
         print("metric lint FAILED — registration record is empty after "
@@ -498,6 +509,125 @@ def serve_tbt_check() -> int:
     return 0
 
 
+def router_check(grace_s: float = 30.0, n_requests: int = 10) -> int:
+    """``--router``: the kill-one-replica failover contract as a
+    subprocess check. 2 tiny CPU replicas + the router (all
+    subprocesses, the real CLIs), concurrent generates, SIGKILL one
+    replica mid-run:
+
+    1. every request reaches a terminal outcome (no hangs),
+    2. ZERO requests are lost — the failover/hedge path absorbs the
+       kill (two idle replicas can carry this load),
+    3. SIGTERM drains the router and it exits 0.
+
+    The in-process fast variants live in tests/test_router.py; the
+    bench A/B (throughput + p99 + failover goodput) is
+    ``bench.py router``. Launch scaffolding is shared with both via
+    ``router/localfleet.py``."""
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+    import time as _time
+
+    from pyspark_tf_gke_tpu.router.localfleet import (
+        export_tiny_bundle,
+        free_port,
+        launch_replica,
+        launch_router,
+        post_generate,
+        wait_healthy,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="router-smoke-")
+    bundle = export_tiny_bundle(os.path.join(tmp, "bundle"))
+
+    ports = [free_port(), free_port()]
+    router_port = free_port()
+    # not quiet: replica/router logs belong in the smoke transcript
+    replicas = [launch_replica(bundle, p, quiet=False) for p in ports]
+    router_proc = None
+    failures = []
+    try:
+        deadline = _time.time() + 180
+        for p, proc in zip(ports, replicas):
+            try:
+                wait_healthy(f"http://127.0.0.1:{p}", deadline,
+                             proc=proc)
+            except RuntimeError as exc:
+                print(str(exc))
+                return 1
+        router_proc = launch_router(
+            ports, router_port, quiet=False,
+            extra_args=("--hedge-max-ms", "500", "--drain-timeout", "1"))
+        url = f"http://127.0.0.1:{router_port}"
+        try:
+            wait_healthy(url, deadline, proc=router_proc)
+        except RuntimeError as exc:
+            print(str(exc))
+            return 1
+
+        def post(prompt, timeout=120.0, base=None):
+            return post_generate(base or url, prompt,
+                                 max_new_tokens=6, timeout_s=timeout)
+
+        # warm each replica DIRECTLY — routed warms can hash onto the
+        # same replica, leaving the other to pay first-request JIT
+        # compile mid-run (slower smoke, muddier timings)
+        for p in ports:
+            post("warm a", base=f"http://127.0.0.1:{p}")
+            post("warm b", base=f"http://127.0.0.1:{p}")
+
+        done, errors = [], []
+
+        def one(i):
+            try:
+                out = post(f"req {i}")
+                done.append(out["completions"][0]["new_tokens"])
+            except Exception as exc:  # noqa: BLE001 — judged below
+                errors.append((i, repr(exc)))
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n_requests)]
+        for i, t in enumerate(threads):
+            t.start()
+            if i == n_requests // 3:
+                replicas[0].send_signal(signal.SIGKILL)  # mid-traffic
+            _time.sleep(0.05)
+        for t in threads:
+            t.join(timeout=grace_s * 4)
+        hung = sum(t.is_alive() for t in threads)
+        if hung:
+            failures.append(f"{hung} request(s) never reached a "
+                            "terminal outcome")
+        if errors:
+            failures.append(
+                f"{len(errors)} request(s) lost to the kill (want 0 — "
+                f"failover should absorb it): {errors[:3]}")
+        router_proc.send_signal(signal.SIGTERM)
+        try:
+            rc = router_proc.wait(timeout=grace_s)
+            if rc != 0:
+                failures.append(f"router exited {rc}, want 0")
+        except subprocess.TimeoutExpired:
+            failures.append(f"router still alive {grace_s}s after "
+                            "SIGTERM")
+    finally:
+        for p in [router_proc, *replicas]:
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+    if failures:
+        print("router smoke FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(f"router smoke OK: {len(done)}/{n_requests} requests "
+          "terminal with one replica SIGKILLed mid-run; router "
+          "drained and exited 0")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--kernels-only" in argv:
@@ -506,6 +636,8 @@ def main(argv=None) -> int:
         return serve_lifecycle_check()
     if "--serve-tbt" in argv:
         return serve_tbt_check()
+    if "--router" in argv:
+        return router_check()
     if "--lint-only" not in argv:
         devices = jax.devices()
         print(f"devices: {devices}")
