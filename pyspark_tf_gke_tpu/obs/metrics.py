@@ -538,6 +538,24 @@ def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "serve_kv_cache_bytes_per_layer",
             "Bytes of KV cache in use per layer (pages_in_use x page "
             "bytes) — scales with live tokens, not slots x max_len"),
+        # radix prefix cache (engine-level trie over the paged KV
+        # pool; the dense LRU's hits ride the same counters)
+        "serve_prefix_cache_hits_total": r.counter(
+            "serve_prefix_cache_hits_total",
+            "Admissions that matched a cached prompt prefix (radix "
+            "trie over the paged pool, or the dense LRU)"),
+        "serve_prefix_cache_hit_tokens_total": r.counter(
+            "serve_prefix_cache_hit_tokens_total",
+            "Prompt tokens whose prefill was SKIPPED via cached "
+            "prefix pages — the prefill-FLOP savings, in tokens"),
+        "serve_prefix_cache_pages": r.gauge(
+            "serve_prefix_cache_pages",
+            "KV pages currently indexed by the radix prefix cache "
+            "(trie-resident; evictable when no slot shares them)"),
+        "serve_prefix_cache_evictions_total": r.counter(
+            "serve_prefix_cache_evictions_total",
+            "Cache-resident pages LRU-evicted back to the free list "
+            "(pool pressure or resident-page cap)"),
         "serve_kv_page_alloc_failures_total": r.counter(
             "serve_kv_page_alloc_failures_total",
             "Admission attempts deferred because the page pool could "

@@ -19,6 +19,15 @@ absorb the work (UP, not backing off, in-flight below the cap, and not
 carrying more than ``spill_ratio`` x the least-loaded replica's
 outstanding tokens). Past that, a hot prefix must spill — a cache hit
 saved is worth one prefill, not an unbounded queue.
+
+**Why the measured hit rate widens the spill bound.** ``/loadz`` now
+reports each replica's ``prefix_hit_rate`` — what its engine-level
+radix cache ACTUALLY absorbs, not just hashed ownership. A replica
+whose admissions demonstrably hit pays ~the unique-suffix prefill per
+request, so the same queue clears faster there: the affinity override
+scales its allowance by ``(1 + hit_rate)``, letting a provably-warm
+replica hold up to twice the baseline spill threshold before traffic
+spills to a cold one (which would re-prefill the whole prefix).
 """
 
 from __future__ import annotations
@@ -82,12 +91,23 @@ def choose_replica(replicas: List[Replica], *,
                                           r.inflight, r.rid))
     if affinity is not None:
         target = rendezvous_pick(affinity, candidates)
-        if (target is not None and target in under_cap
-                and target.outstanding_tokens()
-                <= max(spill_ratio * least.outstanding_tokens(),
-                       # an idle fleet has score 0 everywhere — the
-                       # floor keeps affinity sticky until real load
-                       # separates the replicas
-                       spill_ratio * 256)):
-            return target, True
+        if target is not None and target in under_cap:
+            # measured cache effectiveness widens the allowance: a
+            # replica whose /loadz hit rate says the prefix cache is
+            # absorbing admissions costs ~unique-suffix prefill per
+            # request, so it may run up to (1 + hit_rate) x deeper
+            # before a spill to a cold replica (full re-prefill) wins
+            try:
+                hit = min(max(float(
+                    target.load.get("prefix_hit_rate") or 0.0), 0.0), 1.0)
+            except (TypeError, ValueError):
+                hit = 0.0
+            allowance = spill_ratio * (1.0 + hit)
+            if (target.outstanding_tokens()
+                    <= max(allowance * least.outstanding_tokens(),
+                           # an idle fleet has score 0 everywhere — the
+                           # floor keeps affinity sticky until real load
+                           # separates the replicas
+                           allowance * 256)):
+                return target, True
     return least, False

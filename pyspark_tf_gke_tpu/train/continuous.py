@@ -47,8 +47,9 @@ import contextlib
 import functools
 import itertools
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+from typing import Any, Deque, Dict, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -234,6 +235,255 @@ class PrefixCache:
     def stats(self) -> dict:
         return {"entries": len(self._entries), "capacity": self.capacity,
                 "hits": self.hits, "misses": self.misses}
+
+
+class _RadixNode:
+    """One KV page in the radix prefix cache: ``tokens`` is the page's
+    token content (``page_size`` long for interior/full pages, shorter
+    for a TAIL page holding a partially-filled final page — always a
+    leaf). Children are keyed by their token tuple, but LOOKUP scans
+    children for the longest common prefix rather than dict-probing:
+    two siblings may share an in-page prefix after divergent inserts
+    ("efgh" and "efxy"), and a tail node matches any prompt that
+    extends its tokens."""
+
+    __slots__ = ("tokens", "page", "children", "parent", "last_used")
+
+    def __init__(self, tokens: tuple, page: Optional[int], parent):
+        self.tokens = tokens
+        self.page = page
+        self.parent = parent
+        self.children: Dict[tuple, "_RadixNode"] = {}
+        self.last_used = 0
+
+
+class RadixPrefixCache:
+    """SGLang-style trie index over the PAGED KV pool (the engine owns
+    the pages; this class owns only the token->page index): completed
+    prompts' pages stay resident, a new prompt matches its longest
+    cached prefix at page granularity and SHARES those pages
+    copy-on-write, so prefill compute and pool traffic are ∝ the
+    unique suffix only.
+
+    Division of labor with the engine: the trie never touches device
+    state or refcounts. ``match``/``insert``/``evict`` return page-id
+    lists and the ENGINE moves the refcounts (+1 for every page the
+    trie adopts, -1 for every page it releases) — one owner for the
+    page lifecycle, so the refcount invariants are checkable in one
+    place. Eviction is LRU over leaf nodes whose page has no slot
+    reference (``busy`` predicate), leaf-first so a cached path is
+    always contiguous from the root."""
+
+    def __init__(self, page_size: int, capacity_pages: int):
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        if capacity_pages < 1:
+            raise ValueError("capacity_pages must be >= 1")
+        self.page_size = int(page_size)
+        self.capacity = int(capacity_pages)
+        self._root = _RadixNode((), None, None)
+        self._tick = 0
+        self.resident_pages = 0
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.evictions = 0
+        # last-N admission outcomes: the hit-rate signal the router
+        # scores spill allowance on must track CURRENT absorption, not
+        # the lifetime ratio — a cache that went cold (eviction, mix
+        # shift) would otherwise keep advertising its warm past
+        self._recent: Deque[int] = deque(maxlen=64)
+
+    @staticmethod
+    def _common(a, b) -> int:
+        n = min(len(a), len(b))
+        i = 0
+        while i < n and a[i] == b[i]:
+            i += 1
+        return i
+
+    def _touch(self, node: _RadixNode) -> None:
+        # the whole matched path was used: eviction is leaf-only, but
+        # a deep leaf must keep its ancestors young for when IT is
+        # evicted and they become leaves
+        self._tick += 1
+        while node is not None and node.page is not None:
+            node.last_used = self._tick
+            node = node.parent
+
+    def match(self, prompt, limit: Optional[int] = None,
+              peek: bool = False, count: bool = True):
+        """Longest cached prefix of ``prompt``. Returns
+        ``(matched_tokens, full_page_ids, cow)`` where ``cow`` is
+        ``(src_page, rows)`` when the match ends INSIDE a page — the
+        admission must clone those rows into a fresh page before its
+        suffix can append there (copy-on-write; the full pages are
+        shared read-only, the slot never writes below the match
+        boundary). ``limit`` caps the match — default
+        ``len(prompt) - 1``, because at least one suffix token must be
+        computed to produce the carried decode logits (the trie stores
+        pages, not logits). ``peek`` skips stats and LRU touching;
+        ``count=False`` touches the LRU but leaves the hit/miss stats
+        to an explicit ``note()`` — for callers whose effective match
+        may still shrink (COW degrade) or that aren't admissions at
+        all (warm no-ops): the hit rate is a ROUTING signal, so only
+        real admission outcomes may feed it."""
+        toks = tuple(int(t) for t in prompt)
+        limit = len(toks) - 1 if limit is None else min(limit, len(toks))
+        node = self._root
+        t = 0
+        pages: List[int] = []
+        cow = None
+        last = None
+        while t < limit:
+            rem = toks[t:]
+            best, best_c = None, 0
+            for child in node.children.values():
+                c = self._common(child.tokens, rem)
+                if c > best_c:
+                    best, best_c = child, c
+            if best is None:
+                break
+            best_c = min(best_c, limit - t)
+            if best_c <= 0:
+                break
+            last = best
+            if best_c == len(best.tokens) == self.page_size:
+                pages.append(best.page)
+                t += self.page_size
+                node = best
+                continue
+            # partial in-page match: a tail node, a mid-page
+            # divergence, or the limit cap — the walk ends here
+            cow = (best.page, best_c)
+            t += best_c
+            break
+        if not peek:
+            if last is not None:
+                # ONE root-ward walk from the deepest matched node
+                # marks the whole path (O(depth), not O(depth^2));
+                # leaf-first eviction makes intra-path order moot
+                self._touch(last)
+            if count:
+                self.note(t)
+        return t, pages, cow
+
+    def note(self, matched: int) -> None:
+        """Record one ADMISSION outcome: the cumulative hit counters
+        plus the recent-outcome window behind ``recent_hit_rate``."""
+        if matched > 0:
+            self.hits += 1
+            self.hit_tokens += int(matched)
+            self._recent.append(1)
+        else:
+            self.misses += 1
+            self._recent.append(0)
+
+    @property
+    def recent_hit_rate(self) -> float:
+        """Hit rate over the last up-to-64 admissions — what ``/loadz``
+        exports for the router's spill allowance. Windowed, not
+        lifetime: a cache that went cold (eviction, traffic-mix shift)
+        stops advertising its warm past within one window."""
+        if not self._recent:
+            return 0.0
+        return sum(self._recent) / len(self._recent)
+
+    def insert(self, tokens, pages):
+        """Index ``tokens`` (chunked per page) over their physical
+        ``pages`` (block-table row order). Chunks an existing node
+        already covers are NOT re-adopted (the duplicate page simply
+        loses its slot ref when the caller releases it); a partial
+        tail node that is a strict prefix of a longer chunk is
+        UPGRADED in place to the new, fuller page — that is how a
+        cached conversation prefix grows turn by turn. Returns
+        ``(adopted, released)`` page-id lists for the engine's
+        refcount moves."""
+        toks = tuple(int(t) for t in tokens)
+        ps = self.page_size
+        adopted: List[int] = []
+        released: List[int] = []
+        node = self._root
+        self._tick += 1
+        for i in range(0, len(toks), ps):
+            chunk = toks[i:i + ps]
+            page = int(pages[i // ps])
+            nxt = None
+            for child in node.children.values():
+                c = self._common(child.tokens, chunk)
+                if c == len(chunk) and len(child.tokens) >= len(chunk):
+                    nxt = child  # already covered (possibly by a
+                    break        # longer tail) — keep the cached page
+                if c == len(child.tokens) and c < len(chunk):
+                    # the cached tail is a strict prefix of our chunk:
+                    # upgrade the node to the fuller page (identical
+                    # token prefix -> identical KV rows; slots still
+                    # reading the old page keep it alive by refcount)
+                    del node.children[child.tokens]
+                    released.append(child.page)
+                    child.tokens = chunk
+                    child.page = page
+                    node.children[chunk] = child
+                    adopted.append(page)
+                    nxt = child
+                    break
+            if nxt is None:
+                nxt = _RadixNode(chunk, page, node)
+                node.children[chunk] = nxt
+                adopted.append(page)
+                self.resident_pages += 1
+            nxt.last_used = self._tick
+            if len(nxt.tokens) < ps or len(chunk) < ps:
+                break  # a tail page ends the path
+            node = nxt
+        return adopted, released
+
+    def evict(self, n_pages: int, busy) -> List[int]:
+        """Drop up to ``n_pages`` least-recently-used LEAF pages whose
+        page ``busy(page)`` reports free of slot references; returns
+        the released page ids (the caller unrefs them back to the
+        pool). Interior nodes become eligible as their children go —
+        O(nodes) per eviction, fine at page-pool scale."""
+        released: List[int] = []
+        while len(released) < n_pages:
+            victim = None
+            stack = [self._root]
+            while stack:
+                node = stack.pop()
+                for child in node.children.values():
+                    if child.children:
+                        stack.append(child)
+                    elif not busy(child.page) and (
+                            victim is None
+                            or child.last_used < victim.last_used):
+                        victim = child
+            if victim is None:
+                break  # everything left is pinned by live slots
+            del victim.parent.children[victim.tokens]
+            released.append(victim.page)
+            self.resident_pages -= 1
+            self.evictions += 1
+        return released
+
+    def indexed_pages(self) -> List[int]:
+        """Every page the trie currently references (invariant checks:
+        each must hold exactly one trie refcount)."""
+        out: List[int] = []
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            for child in node.children.values():
+                out.append(child.page)
+                stack.append(child)
+        return out
+
+    @property
+    def stats(self) -> dict:
+        return {"kind": "radix", "resident_pages": self.resident_pages,
+                "capacity_pages": self.capacity, "hits": self.hits,
+                "misses": self.misses, "hit_tokens": self.hit_tokens,
+                "evictions": self.evictions,
+                "recent_hit_rate": round(self.recent_hit_rate, 4)}
 
 
 def _seed_key_data(seed) -> jnp.ndarray:
@@ -473,6 +723,28 @@ def _activate_slot_paged(state: SlotState, slot, row, fill, logits1,
 
 
 @jax.jit
+def _copy_page(state: SlotState, src, dst):
+    """Copy-on-write clone of one KV page (every layer's K/V leaves,
+    int8 scale pages included): the radix prefix cache shares FULL
+    pages read-only, but a match that ends inside a partially-filled
+    tail page must clone it before the new slot can append its suffix
+    rows there — the source page may be read concurrently by the trie
+    and other slots. Whole-page copy (static shape, one compiled
+    program for any src/dst pair); rows past the matched fill are
+    garbage the suffix prefill overwrites or the fill mask hides."""
+    def layer(pool):
+        out = dict(pool)
+        for key in ("k_pages", "v_pages", "k_scale_pages",
+                    "v_scale_pages"):
+            if key in pool:
+                out[key] = pool[key].at[dst].set(pool[key][src],
+                                                 mode="drop")
+        return out
+
+    return state._replace(cache=_map_paged_layers(state.cache, layer))
+
+
+@jax.jit
 def _clear_live_paged(state: SlotState, slot):
     """Paged free: drop the live flag AND reset the slot's block-table
     row to the sentinel, so in-flight dead-row replays (decode-ahead)
@@ -636,11 +908,16 @@ def _decode_chunk(model: CausalLM, params, state: SlotState, *,
         emitted = jnp.where(live, tok, pad_id)
         if eos_token_id is not None:
             live = live & (tok != eos_token_id)
-        # Dead rows replay position 0 with a pad token: static shape,
-        # no position growth, slot cache row 0 is overwritten on the
-        # next admit's prefill anyway.
+        # Dead rows replay their FROZEN position with a pad token:
+        # static shape, no position growth (positions only advance
+        # while live). NOT position 0: with radix prefix sharing, page
+        # 0 of a slot's block table can be a page SHARED with other
+        # slots and the cache — a pad-KV write there would corrupt
+        # every reader. The frozen position is one past the row's last
+        # real token, always inside its OWN (never-shared) allocation
+        # and beyond the extent the prefix cache adopts at free time.
         step_tok = jnp.where(live, tok, pad_id)
-        step_pos = jnp.where(live, st.positions, 0)
+        step_pos = st.positions
         logits, mutated = model.apply(
             {"params": inloop_dequantize(p) if quantized else p,
              "cache": st.cache},
@@ -830,6 +1107,17 @@ class SlotDeviceState:
                 jnp.asarray(top_p, jnp.float32),
                 _seed_key_data(seed))
 
+    def copy_page(self, src: int, dst: int) -> None:
+        """Clone page ``src`` into page ``dst`` across every layer's
+        pool leaves (the radix cache's copy-on-write; paged models
+        only). Replayed on workers via the OP_CB_ADMIT cow payload."""
+        with self._mesh_ctx():
+            if self.state is None:
+                self.state = self._init_state(None)
+            self.state = _copy_page(
+                self.state, jnp.asarray(src, jnp.int32),
+                jnp.asarray(dst, jnp.int32))
+
     def chunk_async(self, chunk: int, eos_token_id: Optional[int],
                     pad_id: int, sampling: bool = False):
         """Dispatch one decode chunk over all slots (``sampling``
@@ -989,14 +1277,18 @@ class ContinuousEngine:
         # cache: log2(chunk) programs), floored at 1 so the engine
         # always makes progress. 0 = off (fixed decode chunk).
         self.step_token_budget = int(step_token_budget)
-        if prefix_cache_size and announce:
-            # the prefix entries and the extend op are not on the
+        if prefix_cache_size and announce and not paged:
+            # the DENSE prefix entries and the extend op are not on the
             # OP_CB_* wire (worker replicas would need the LRU too) —
-            # single-host only until they are
+            # single-host only. The PAGED radix cache IS on the wire:
+            # cache-hit admissions replay as OP_CB_ADMIT pieces with a
+            # nonzero fill (+ the COW page copy), so worker replicas
+            # install identical block tables.
             raise ValueError(
-                "prefix caching is single-host only (announce mode)")
+                "dense prefix caching is single-host only (announce "
+                "mode); the paged radix cache replays over the wire")
         self.prefix_cache = (PrefixCache(prefix_cache_size)
-                             if prefix_cache_size else None)
+                             if prefix_cache_size and not paged else None)
         self.model, self.params = model, params
         # tp serving: ``params`` should already be placed
         # (shard_params_for_serving); entering the mesh context around
@@ -1022,6 +1314,13 @@ class ContinuousEngine:
         self.paged = bool(getattr(model.cfg, "paged_kv", False))
         self._free_pages: List[int] = []
         self._slot_pages: Dict[int, List[int]] = {}
+        # page -> refcount: slots and in-flight admissions hold one ref
+        # per page they reference, the radix trie holds one per page it
+        # indexes. A page is in ``_free_pages`` iff its refcount is 0 —
+        # page lifetime is refcount-owned, not slot-owned, so the SAME
+        # physical page can back the shared prefix of many requests.
+        self._page_refs: Dict[int, int] = {}
+        self.radix: Optional[RadixPrefixCache] = None
         self._peak_pages_in_use = 0
         self._n_page_alloc_failures = 0
         if self.paged:
@@ -1030,13 +1329,16 @@ class ContinuousEngine:
                 raise ValueError(
                     f"kv_page_size {ps} must divide max_seq_len {s_max}")
             if prefix_cache_size:
-                # prefix entries are dense batch-1 cache trees the
-                # paged insert cannot consume incrementally — dense
-                # engines keep them; chunked prefill, by contrast,
-                # writes pieces STRAIGHT into the pool (no staging)
-                raise ValueError(
-                    "prefix caching is unsupported with the paged KV "
-                    "cache")
+                # engine-level RADIX prefix cache over the page pool:
+                # completed prompts stay resident as refcounted pages
+                # indexed by a token trie; admissions share the longest
+                # match copy-on-write and prefill only the suffix.
+                # ``prefix_cache_size`` caps the trie's resident pages
+                # (clamped to the pool; LRU-evicted under pool
+                # pressure either way) — NOT dense-LRU entry count.
+                self.radix = RadixPrefixCache(
+                    ps, min(int(prefix_cache_size),
+                            model.cfg.kv_num_pages))
             # prefill rows scatter whole pages, so every admissible
             # bucket must be page-aligned
             self.buckets = tuple(b for b in self.buckets if b % ps == 0)
@@ -1069,6 +1371,10 @@ class ContinuousEngine:
         self._obs = obs if obs is not None else platform_families()
         self._obs["serve_slots_total"].set(num_slots)
         self._n_prefill_chunks = 0  # pieces processed (all admissions)
+        self._n_prefill_tokens = 0  # prompt tokens actually COMPUTED
+        #   by prefill forwards (pieces, buckets, extensions) — the
+        #   prefix cache's whole point is keeping this ∝ unique-suffix
+        #   tokens; bench/smoke read it from stats
         self._step_prefill_tokens = 0  # this step's piece tokens (the
         #   budget split's prefill half; reset at each step() top)
         self._obs["serve_prefill_inflight"].set(0)
@@ -1143,7 +1449,12 @@ class ContinuousEngine:
         """Prefill ``prefix_ids`` once and cache the result; later
         requests whose prompt starts with it skip that prefill. Returns
         the prefix length. The prefix must leave room for at least one
-        more token (a full-context prefix could never be extended)."""
+        more token (a full-context prefix could never be extended).
+        Paged engines route to the radix cache (the prefix lands
+        straight in trie-owned pages); dense engines keep the batch-1
+        LRU."""
+        if self.radix is not None:
+            return self._warm_prefix_paged(prefix_ids)
         if self.prefix_cache is None:
             raise ValueError("engine built without prefix_cache_size")
         prefix = np.asarray(prefix_ids, np.int32).reshape(-1)
@@ -1159,7 +1470,83 @@ class ContinuousEngine:
             cache1, logits1 = _prefill_padded(
                 self.model, self.params, jnp.asarray(padded),
                 jnp.asarray(prefix.size, jnp.int32))
+        self._n_prefill_tokens += int(prefix.size)
         self.prefix_cache.put(prefix, cache1, logits1)
+        return int(prefix.size)
+
+    def _warm_prefix_paged(self, prefix_ids) -> int:
+        """Paged ``warm_prefix``: prefill the prefix STRAIGHT into
+        trie-owned pages (no slot involved) and index it, so later
+        prompts starting with it admit at the match boundary. Restarts
+        from the last fully-cached page when part of the prefix is
+        already resident. Announce mode replays the pieces on every
+        worker (OP_CB_ADMIT, never final — no slot is activated), so
+        replica pools warm identically."""
+        prefix = np.asarray(prefix_ids, np.int32).reshape(-1)
+        if prefix.size == 0:
+            raise ValueError("empty prefix")
+        cfg = self.model.cfg
+        if prefix.size >= cfg.max_seq_len:
+            raise ValueError(
+                f"prefix {prefix.size} leaves no room under max_seq_len "
+                f"{cfg.max_seq_len}")
+        ps = cfg.kv_page_size
+        matched, shared, _cow = self.radix.match(
+            prefix, limit=int(prefix.size), peek=True)
+        if matched >= prefix.size:
+            # every prefix token is already derivable from cached
+            # pages (possibly ending inside a fuller page): future
+            # prompts will match through them — warming adds nothing.
+            # Touch the path (LRU) WITHOUT counting: a warm no-op is
+            # not an admission, and repeated warms (rebuild replay,
+            # periodic POST /v1/warm) must not inflate the hit rate
+            # the router scores spill allowance on.
+            self.radix.match(prefix, limit=int(prefix.size),
+                             count=False)
+            return int(prefix.size)
+        fill0 = len(shared) * ps  # restart at the last FULL cached
+        #   page; a partial tail match re-prefills into a fresh page
+        #   that the insert below UPGRADES the tail node to
+        need = -(-int(prefix.size) // ps) - len(shared)
+        self._ref_pages(shared)  # pin through the pieces below
+        taken = self._take_pages(need)
+        if taken is None:
+            self._unref_pages(shared)
+            raise ValueError(
+                f"KV page pool cannot hold the prefix ({need} pages "
+                f"needed, {len(self._free_pages)} free after eviction)")
+        row = np.full((cfg.max_pages_per_slot,), cfg.kv_num_pages,
+                      np.int32)
+        row[:len(shared)] = shared
+        row[len(shared):len(shared) + need] = taken
+        fill = fill0
+        try:
+            while fill < prefix.size:
+                if self.prefill_chunk:
+                    w = min(self.prefill_chunk, cfg.max_seq_len - fill)
+                else:
+                    rem = int(prefix.size) - fill
+                    w = min(-(-rem // 32) * 32, cfg.max_seq_len - fill)
+                piece = prefix[fill:fill + w]
+                padded = right_pad(piece, w, self.pad_id)
+                f0 = fill
+                self._announced(
+                    lambda wire, padded=padded, piece=piece, f0=f0:
+                        wire.announce_cb_admit(
+                            self.num_slots, padded, piece.size, 0,
+                            self.eos_token_id, self.pad_id, pages=row,
+                            chunk_fill=f0),
+                    lambda padded=padded, piece=piece, f0=f0:
+                        self._device.prefill_chunk(
+                            padded, f0, piece.size, row))
+                self._n_prefill_tokens += int(piece.size)
+                fill += int(piece.size)
+        except BaseException:
+            self._unref_pages(list(shared) + taken)
+            raise
+        # trie refs keep the pages; the warm's own holds drop with them
+        self._adopt_into_trie(prefix, list(shared) + taken,
+                              holds=list(shared) + taken)
         return int(prefix.size)
 
     def cancel(self, rid: int) -> bool:
@@ -1218,16 +1605,93 @@ class ContinuousEngine:
         self._obs["serve_kv_cache_bytes_per_layer"].set(
             used * self._page_bytes_per_layer)
 
+    def _ref_pages(self, pages) -> None:
+        """+1 refcount on every page (a slot, admission, or the trie
+        took a reference)."""
+        for p in pages:
+            self._page_refs[p] = self._page_refs.get(p, 0) + 1
+
+    def _unref_pages(self, pages) -> None:
+        """-1 refcount; pages reaching zero return to the free list.
+        Raises on a double free — the refcount invariant every
+        admit/cancel/deadline/drain/eviction path must uphold."""
+        for p in pages:
+            left = self._page_refs.get(p, 0) - 1
+            if left > 0:
+                self._page_refs[p] = left
+            elif left == 0:
+                del self._page_refs[p]
+                self._free_pages.append(p)
+            else:
+                raise RuntimeError(
+                    f"KV page {p} unreferenced while already free "
+                    "(double free)")
+        self._update_page_gauges()
+
+    def _adopt_into_trie(self, tokens, pages,
+                         holds: Optional[List[int]] = None) -> None:
+        """Index ``tokens`` over ``pages`` and move the refcounts in
+        ONE place (the finish path and the warm path must never
+        drift): +1 per page the trie adopts, -1 per page it releases,
+        then the caller's own ``holds`` drop and the resident-page cap
+        is enforced."""
+        adopted, released = self.radix.insert(tokens, pages)
+        if adopted:
+            self._ref_pages(adopted)
+        if released:
+            self._unref_pages(released)
+        if holds:
+            self._unref_pages(holds)
+        self._enforce_cache_cap()
+        self._obs["serve_prefix_cache_pages"].set(
+            self.radix.resident_pages)
+
+    def _evict_cache_pages(self, n: int) -> int:
+        """LRU-evict up to ``n`` trie-resident pages with no slot
+        reference back to the free list (pool pressure / resident
+        cap). Returns how many actually freed."""
+        released = self.radix.evict(
+            n, busy=lambda p: self._page_refs.get(p, 0) > 1)
+        if released:
+            self._obs["serve_prefix_cache_evictions_total"].inc(
+                len(released))
+            self._unref_pages(released)
+            self._obs["serve_prefix_cache_pages"].set(
+                self.radix.resident_pages)
+        return len(released)
+
+    def _enforce_cache_cap(self) -> None:
+        over = (self.radix.resident_pages - self.radix.capacity
+                if self.radix is not None else 0)
+        if over > 0:
+            self._evict_cache_pages(over)
+
+    def _take_pages(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` fresh pages (refcount 1 each); under pressure the
+        radix cache's coldest resident pages are evicted first — cache
+        residency never starves a live admission. None when even that
+        cannot cover ``n``."""
+        if n > len(self._free_pages) and self.radix is not None:
+            self._evict_cache_pages(n - len(self._free_pages))
+        if n > len(self._free_pages):
+            return None
+        taken = [self._free_pages.pop() for _ in range(n)]
+        for p in taken:
+            self._page_refs[p] = 1
+        self._update_page_gauges()
+        return taken
+
     def _alloc_pages(self, n: int):
         """``(row, taken)`` — the sentinel-padded ``[max_pages_per_slot]``
         block-table row and the allocated page list — or None when the
-        pool cannot cover ``n`` (the request stays queued; the counter
-        increments once per failed admission attempt)."""
-        if n > len(self._free_pages):
+        pool (after cache eviction) cannot cover ``n`` (the request
+        stays queued; the counter increments once per failed admission
+        attempt)."""
+        taken = self._take_pages(n)
+        if taken is None:
             self._n_page_alloc_failures += 1
             self._obs["serve_kv_page_alloc_failures_total"].inc()
             return None
-        taken = [self._free_pages.pop() for _ in range(n)]
         cfg = self.model.cfg
         row = np.full((cfg.max_pages_per_slot,), cfg.kv_num_pages,
                       np.int32)
@@ -1241,8 +1705,7 @@ class ContinuousEngine:
     def _release_pages(self, slot: int) -> None:
         taken = self._slot_pages.pop(slot, None)
         if taken:
-            self._free_pages.extend(taken)
-            self._update_page_gauges()
+            self._unref_pages(taken)
 
     def _free_slot(self, slot: int) -> None:
         self._announced(
@@ -1258,22 +1721,25 @@ class ContinuousEngine:
         and one is already in flight, or (paged mode) the page pool
         cannot cover it yet (FIFO holds; the request stays queued)."""
         if self.paged:
-            if (self.prefill_chunk
-                    and req.prompt.size > self.prefill_chunk):
+            # ONE trie walk decides the route AND seeds the admission
+            # (count=False: stats wait for the final post-COW outcome;
+            # the LRU touch is wanted — a queued hit keeps its path
+            # warm while it waits). Safe to hand the result through:
+            # nothing between here and _start_paged_admission can
+            # evict (eviction only runs inside page allocation).
+            m = (self.radix.match(req.prompt, count=False)
+                 if self.radix is not None else (0, [], None))
+            if m[0] or (self.prefill_chunk
+                        and req.prompt.size - m[0]
+                        > self.prefill_chunk):
+                # piecewise route: chunked prefill for long prompts
+                # AND every radix-cache hit (the hit installs shared
+                # pages and starts the pieces at the match boundary;
+                # an unchunked engine runs the whole suffix as one
+                # piece)
                 if self._admitting is not None:
                     return False  # one piecewise admission at a time
-                # paged chunked prefill: pieces write straight into the
-                # pool; pages allocate page-by-page as pieces land and
-                # the slot's table row stays at the sentinel until the
-                # final piece activates it
-                cfg = self.model.cfg
-                self._admitting = {
-                    "slot": slot, "req": req, "fill": 0, "paged": True,
-                    "row": np.full((cfg.max_pages_per_slot,),
-                                   cfg.kv_num_pages, np.int32),
-                    "pages": [],
-                }
-                self._advance_admission()
+                self._start_paged_admission(slot, req, m)
                 return True
             sb = bucket_length(req.prompt.size, self.buckets)
             alloc = self._alloc_pages(self._pages_needed(
@@ -1299,10 +1765,18 @@ class ContinuousEngine:
                 # a failed admit must not leak its pages: the caller may
                 # catch and keep driving this engine, and leaked pages
                 # would shrink the pool below submit()'s livelock bound
-                self._free_pages.extend(taken)
+                self._unref_pages(taken)
                 raise
+            self._n_prefill_tokens += int(req.prompt.size)
             self._note_pages(slot, taken)
             self._slots[slot] = req
+            if self.radix is not None:
+                # this path only runs when the peek matched nothing
+                # (hits route piecewise): a MISS must land in the
+                # recent window too, or /loadz's hit rate would stay
+                # pinned at its last warm reading while cold prompts
+                # re-prefill from token 0
+                self.radix.note(0)
             return True
         if (self._admitting is not None and self.prefill_chunk
                 and req.prompt.size > self.prefill_chunk):
@@ -1324,6 +1798,13 @@ class ContinuousEngine:
             # step, decode chunks interleave between pieces — a 1024-
             # token arrival must not stall every streaming slot for a
             # full prefill dispatch
+            if hit is not None:
+                # a hit that still needs pieces for its remainder is a
+                # hit all the same — the exported counters must agree
+                # with the LRU's own stats
+                self._obs["serve_prefix_cache_hits_total"].inc()
+                self._obs["serve_prefix_cache_hit_tokens_total"].inc(
+                    hit[0])
             self._admitting = {
                 "slot": slot, "req": req,
                 "fill": hit[0] if hit is not None else 0,
@@ -1332,6 +1813,8 @@ class ContinuousEngine:
             self._advance_admission()
             return True
         if hit is not None:
+            self._obs["serve_prefix_cache_hits_total"].inc()
+            self._obs["serve_prefix_cache_hit_tokens_total"].inc(hit[0])
             self._admit_from_prefix(slot, req, *hit)
             self._slots[slot] = req
             return True
@@ -1346,6 +1829,7 @@ class ContinuousEngine:
                 self.eos_token_id, self.pad_id, sampling=sampling),
             lambda: self._device.admit_padded(
                 padded, req.prompt.size, slot, *sampling))
+        self._n_prefill_tokens += int(req.prompt.size)
         self._slots[slot] = req
         return True
 
@@ -1384,6 +1868,7 @@ class ContinuousEngine:
                     self.model, self.params, cache1, jnp.asarray(padded),
                     jnp.asarray(fill, jnp.int32),
                     jnp.asarray(rem.size, jnp.int32))
+            self._n_prefill_tokens += int(rem.size)
         if self._device.state is None:
             self._device.state = self._device._init_state(cache1)
         with self._device._mesh_ctx():
@@ -1444,7 +1929,61 @@ class ContinuousEngine:
     def _note_prefill_piece(self, n: int) -> None:
         self._n_prefill_chunks += 1
         self._step_prefill_tokens += int(n)
+        self._n_prefill_tokens += int(n)
         self._obs["serve_prefill_chunk_tokens"].observe(n)
+
+    def _start_paged_admission(self, slot: int, req: _Request,
+                               match=None) -> None:
+        """Begin a piecewise paged admission, seeded from the radix
+        prefix cache when it matches: matched FULL pages are shared
+        read-only (refcount +1, installed verbatim at the head of the
+        admission's block-table row), a match ending inside a
+        partially-filled tail page clones that page copy-on-write into
+        a fresh one, and the pieces start at the match boundary — the
+        prefill forward and pool writes cover the UNIQUE SUFFIX only,
+        while the piece's attention reads the shared prefix pages
+        through the same row."""
+        cfg = self.model.cfg
+        a = {"slot": slot, "req": req, "fill": 0, "paged": True,
+             "row": np.full((cfg.max_pages_per_slot,), cfg.kv_num_pages,
+                            np.int32),
+             "pages": [], "shared": [], "cow": None}
+        if self.radix is not None:
+            # count=False: the effective match can still SHRINK below
+            # (COW degrade under pool pressure) — the hit/miss note
+            # lands after it is final, so the router's hit-rate signal
+            # never reads warmer than what admissions actually skipped
+            matched, shared, cow = (
+                match if match is not None
+                else self.radix.match(req.prompt, count=False))
+            if cow is not None:
+                # pin the source while the clone allocates (allocation
+                # may LRU-evict resident pages — never the pinned src)
+                self._ref_pages([cow[0]])
+                dst = self._take_pages(1)
+                if dst is None:
+                    # pool can't cover the clone right now: degrade to
+                    # the page boundary — full pages still share, only
+                    # the tail rows recompute
+                    self._unref_pages([cow[0]])
+                    matched -= cow[1]
+                    cow = None
+                else:
+                    a["cow"] = (cow[0], dst[0])
+                    a["pages"].append(dst[0])
+            self.radix.note(matched)
+            if matched:
+                self._ref_pages(shared)
+                a["shared"] = shared
+                a["row"][:len(shared)] = shared
+                if a["cow"] is not None:
+                    a["row"][len(shared)] = a["cow"][1]
+                a["fill"] = matched
+                self._obs["serve_prefix_cache_hits_total"].inc()
+                self._obs["serve_prefix_cache_hit_tokens_total"].inc(
+                    matched)
+        self._admitting = a
+        self._advance_admission()
 
     def _advance_admission_paged(self) -> None:
         """One piece of a PAGED chunked-prefill admission: extend the
@@ -1452,12 +1991,14 @@ class ContinuousEngine:
         land), run the batch-1 multi-token slot-decode forward that
         writes the piece's K/V straight into the pool, and — on the
         final piece — claim the decode extent's pages and activate the
-        slot. Announce mode replays the identical piece (fill + row on
-        the OP_CB_ADMIT wire) on every worker. Pool dry -> the
-        admission stalls (no piece; the alloc-failure counter
-        increments once per stalled STEP, so its rate reads as
-        stall duration) and retries at the next chunk boundary after
-        frees."""
+        slot. Announce mode replays the identical piece (fill + row +
+        the radix COW clone on the OP_CB_ADMIT wire) on every worker;
+        a radix-hit admission's FIRST piece carries the nonzero match
+        boundary as its fill, so worker block tables stay
+        bit-identical. Pool dry -> the admission stalls (no piece; the
+        alloc-failure counter increments once per stalled STEP, so its
+        rate reads as stall duration) and retries at the next chunk
+        boundary after frees."""
         a = self._admitting
         req, fill = a["req"], a["fill"]
         cfg = self.model.cfg
@@ -1465,31 +2006,42 @@ class ContinuousEngine:
         # same near-context-limit clamp as the dense path: a full-width
         # pad past max_seq_len would write real rows at clamped
         # positions
-        w = min(self.prefill_chunk, cfg.max_seq_len - fill)
+        if self.prefill_chunk:
+            w = min(self.prefill_chunk, cfg.max_seq_len - fill)
+        else:
+            # radix-hit admission on an unchunked engine: the whole
+            # suffix is ONE piece, width quantized to 32-multiples
+            # (same compiled-program discipline as the dense extend)
+            rem = req.prompt.size - fill
+            w = min(-(-int(rem) // 32) * 32, cfg.max_seq_len - fill)
         piece = req.prompt[fill:fill + w]
         final = fill + piece.size == req.prompt.size
         # pages covering the piece's REAL tokens; the final piece also
         # claims the full decode extent — the engine never allocates
-        # mid-decode (PR 2's zero-recompile invariant)
+        # mid-decode (PR 2's zero-recompile invariant). Shared prefix
+        # pages (+ the COW clone) already cover [0, match).
+        covered = len(a["shared"]) + len(a["pages"])
         need_tokens = (req.prompt.size + req.max_new_tokens if final
                        else fill + piece.size)
-        need = -(-need_tokens // ps) - len(a["pages"])
+        need = -(-need_tokens // ps) - covered
         if need > 0:
-            if need > len(self._free_pages):
+            taken = self._take_pages(need)
+            if taken is None:
                 self._n_page_alloc_failures += 1
                 self._obs["serve_kv_page_alloc_failures_total"].inc()
                 return  # stall; frees at later chunk boundaries
                 #         return pages and the admission resumes
-            taken = [self._free_pages.pop() for _ in range(need)]
-            a["row"][len(a["pages"]):len(a["pages"]) + need] = taken
+            a["row"][covered:covered + need] = taken
             a["pages"].extend(taken)
-            self._update_page_gauges()
         padded = right_pad(piece, w, self.pad_id)
         sampling = (float(req.temperature),
                     float(req.top_p if req.top_p is not None else 1.0),
                     int(req.seed))
+        cow = a["cow"]
 
         def device():
+            if cow is not None:
+                self._device.copy_page(*cow)
             logits1 = self._device.prefill_chunk(
                 padded, fill, piece.size, a["row"])
             if final:
@@ -1503,30 +2055,70 @@ class ContinuousEngine:
                     self.num_slots, padded, piece.size, a["slot"],
                     self.eos_token_id, self.pad_id,
                     sampling=sampling if final else None,
-                    pages=a["row"], chunk_fill=fill, final=final),
+                    pages=a["row"], chunk_fill=fill, final=final,
+                    cow=cow),
                 device)
         except BaseException:
             # a failed piece must not leak the admission's pages (the
             # caller may keep driving this engine)
             self._drop_admitting()
             raise
+        if cow is not None:
+            # the clone ran: drop the source pin (the trie's own ref
+            # keeps the page alive for future matches)
+            a["cow"] = None
+            self._unref_pages([cow[0]])
         a["fill"] = fill + piece.size
         self._note_prefill_piece(piece.size)
         if final:
             self._slots[a["slot"]] = req
-            self._note_pages(a["slot"], a["pages"])
+            self._note_pages(a["slot"], a["shared"] + a["pages"])
             self._admitting = None
 
     def _drop_admitting(self) -> None:
         """Abandon the in-flight piecewise admission (cancel, deadline,
-        failed piece): paged admissions return their pages to the free
-        list — the slot's table row was never set, so whatever the
-        pieces wrote is unreachable and safely overwritten by the
-        pages' next owner."""
+        failed piece): paged admissions drop every page reference they
+        hold — owned pages return to the free list, shared prefix
+        pages fall back to their trie/other-slot refs, and a pending
+        COW source loses its pin. The slot's table row was never set,
+        so whatever the pieces wrote is unreachable and safely
+        overwritten by the pages' next owner."""
         a, self._admitting = self._admitting, None
-        if a is not None and a.get("paged") and a["pages"]:
-            self._free_pages.extend(a["pages"])
-            self._update_page_gauges()
+        if a is None or not a.get("paged"):
+            return
+        if a.get("cow") is not None:
+            self._unref_pages([a["cow"][0]])
+        drop = list(a.get("shared", ())) + list(a["pages"])
+        if drop:
+            self._unref_pages(drop)
+
+    def _radix_insert(self, slot: int, req: _Request) -> None:
+        """Index a FINISHED request's pages in the radix cache: they
+        hold valid KV for prompt + emitted tokens (minus a trailing
+        eos, which is emitted but never fed back — its KV row was
+        never written), so a future prompt sharing that prefix skips
+        its prefill. Near the context limit the insert is skipped:
+        rows that are still live on device after the host-side finish
+        (budget-terminated slots decode until the free lands, up to
+        ``(pipeline_depth + 1) * chunk`` steps of overshoot) can reach
+        position ``max_seq_len``, where the paged write's table-index
+        clamp would land a garbage row at the LAST page's first
+        offset — cheap to exclude, impossible to repair."""
+        pages = self._slot_pages.get(slot)
+        if not pages:
+            return
+        s_max = self.model.cfg.max_seq_len
+        if (req.prompt.size + req.max_new_tokens
+                + (self.pipeline_depth + 1) * self.chunk >= s_max):
+            return
+        toks = [int(t) for t in req.prompt] + list(req.tokens)
+        if (self.eos_token_id is not None and toks
+                and toks[-1] == self.eos_token_id):
+            toks.pop()
+        if not toks:
+            return
+        n_pages = -(-len(toks) // self.model.cfg.kv_page_size)
+        self._adopt_into_trie(toks, pages[:n_pages])
 
     def _admit_batch(self, free: List[int]) -> None:
         """Batched-admission fast path (single-host): take the FIFO
@@ -1548,6 +2140,10 @@ class ContinuousEngine:
             if (self.prefix_cache is not None
                     and self.prefix_cache.lookup(req.prompt, peek=True)):
                 break  # the hit path is cheaper than a fresh prefill
+            if (self.radix is not None
+                    and self.radix.match(req.prompt, peek=True)[0]):
+                break  # radix hit: the shared-page route skips the
+                #        prefix prefill entirely — cheaper than batching
             if self.prefill_chunk and req.prompt.size > self.prefill_chunk:
                 break  # piecewise route
             sb = bucket_length(req.prompt.size, self.buckets)
@@ -1595,12 +2191,18 @@ class ContinuousEngine:
                                             samplings, pages=pages_b)
         except BaseException:
             for taken in takens:  # failed admit must not leak pages
-                self._free_pages.extend(taken)
+                self._unref_pages(taken)
             raise
+        self._n_prefill_tokens += sum(int(r.prompt.size) for r in group)
         for i, (slot, req) in enumerate(zip(free[:k], group)):
             self._slots[slot] = req
             if self.paged:
                 self._note_pages(slot, takens[i])
+            if self.radix is not None:
+                # batched admissions are all misses by construction
+                # (the grouping loop breaks on any radix peek hit) —
+                # they must cool the recent window like any other miss
+                self.radix.note(0)
         del self._queue[:k]
         self._n_batch_admits += k
 
@@ -1651,6 +2253,16 @@ class ContinuousEngine:
                 self._obs["serve_requests_rejected_total"].labels(
                     reason="deadline").inc(queued_expired)
         return expired
+
+    @property
+    def warm_capacity(self) -> int:
+        """How many warmed prefixes a rebuilt engine should replay
+        (the serving front retains that many token lists): the dense
+        LRU's entry capacity, or a small fixed horizon for the radix
+        cache (its residency is page-bounded, not entry-bounded)."""
+        if self.prefix_cache is not None:
+            return self.prefix_cache.capacity
+        return 8 if self.radix is not None else 0
 
     def queue_depth(self) -> int:
         """Requests waiting for a slot (admission queue length)."""
@@ -1816,6 +2428,12 @@ class ContinuousEngine:
                 newly_done.append(req)
                 if self._slots.get(slot) is req:
                     del self._slots[slot]
+                if self.radix is not None:
+                    # completed prefixes stay resident: adopt the
+                    # slot's pages into the trie BEFORE the slot's
+                    # refs drop, so the next same-prefix prompt
+                    # admits at the match boundary
+                    self._radix_insert(slot, req)
                 # slot's live flag must drop so its rows stop advancing
                 self._free_slot(slot)
         self._n_finished += len(newly_done)
@@ -1897,13 +2515,16 @@ class ContinuousEngine:
             "solo_admits": self._n_solo_admits,
             "dispatched_steps": self._n_dispatched_steps,
             "prefill_chunks": self._n_prefill_chunks,
+            "prefill_tokens_computed": self._n_prefill_tokens,
             **({"step_token_budget": self.step_token_budget}
                if self.step_token_budget else {}),
             "admitting": (self._admitting["req"].rid
                           if self._admitting is not None else None),
             "inflight": bool(self._inflight_q),
             **({"prefix_cache": self.prefix_cache.stats}
-               if self.prefix_cache is not None else {}),
+               if self.prefix_cache is not None else
+               {"prefix_cache": self.radix.stats}
+               if self.radix is not None else {}),
             **({"paged": {
                 "page_size": self.model.cfg.kv_page_size,
                 "pages_total": self.model.cfg.kv_num_pages,

@@ -269,8 +269,9 @@ class _ContinuousFront:
             toks = [int(t) for t in prefix_ids]
             if toks not in self._warmed:
                 self._warmed.append(toks)
-                cap = self.engine.prefix_cache.capacity
-                del self._warmed[:-cap]  # match the LRU's horizon
+                cap = self.engine.warm_capacity  # dense LRU entries,
+                #   or the radix cache's fixed re-warm horizon
+                del self._warmed[:-cap]
             return n
 
     def abandon(self, rid: int) -> None:
@@ -653,6 +654,11 @@ class BundleServer:
             "kv_pages_free": None,
             "inflight_http": inflight_http,
             "draining": self.draining,
+            # radix prefix cache: ACTUAL cache contents + measured hit
+            # rate, so the router's affinity can score on what the
+            # replica really holds instead of hashed ownership alone
+            "prefix_cache_pages": 0,
+            "prefix_hit_rate": 0.0,
         }
         if self._front is not None:
             stats = self._front.engine.stats
@@ -664,6 +670,19 @@ class BundleServer:
             if paged:
                 out["kv_pages_free"] = (paged["pages_total"]
                                         - paged["pages_in_use"])
+            pc = stats.get("prefix_cache")
+            if pc:
+                out["prefix_cache_pages"] = int(
+                    pc.get("resident_pages", pc.get("entries", 0)))
+                if "recent_hit_rate" in pc:
+                    # radix: windowed over the last admissions, so the
+                    # router's spill allowance tracks what the cache
+                    # absorbs NOW, not its lifetime average
+                    out["prefix_hit_rate"] = pc["recent_hit_rate"]
+                else:  # dense LRU: cumulative is all it keeps
+                    asked = pc["hits"] + pc["misses"]
+                    out["prefix_hit_rate"] = (
+                        round(pc["hits"] / asked, 4) if asked else 0.0)
         return out
 
     # -- generation ------------------------------------------------------
@@ -1021,9 +1040,13 @@ class BundleServer:
                 lines.append(f"# TYPE {name} {kind}")
                 lines.append(f"{name} {stats[key]}")
             for key, val in (stats.get("prefix_cache") or {}).items():
+                if not isinstance(val, (int, float)):
+                    continue  # the radix stats carry a "kind" tag —
+                    #           not a number, not exposable
                 name = ("pyspark_tf_gke_tpu_serve_continuous_"
                         f"prefix_cache_{key}")
-                kind = ("counter" if key in ("hits", "misses")
+                kind = ("counter" if key in ("hits", "misses",
+                                             "hit_tokens", "evictions")
                         else "gauge")
                 lines.append(f"# TYPE {name} {kind}")
                 lines.append(f"{name} {val}")
@@ -1375,9 +1398,18 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "(device ops replayed over the announce wire)")
     p.add_argument("--prefix-cache", type=int,
                    default=int(e("PREFIX_CACHE", "0")),
-                   help="LRU entries of prefilled shared prompt "
-                        "prefixes (POST /v1/warm); requires "
-                        "--continuous-slots, single-host")
+                   help="prefix caching (0 = off; requires "
+                        "--continuous-slots). PAGED bundles get the "
+                        "engine-level radix cache over the KV page "
+                        "pool — completed prompts stay resident as "
+                        "refcounted pages, same-prefix admissions "
+                        "share them copy-on-write and prefill only "
+                        "the suffix; the value caps the cache's "
+                        "RESIDENT pages (use the pool size for "
+                        "whole-pool caching; composes with "
+                        "multi-host). Dense bundles keep the batch-1 "
+                        "LRU with this many entries (POST /v1/warm; "
+                        "single-host)")
     p.add_argument("--prefill-chunk", "--prefill-chunk-tokens",
                    dest="prefill_chunk", type=int,
                    default=int(e("PREFILL_CHUNK", "0")),

@@ -194,7 +194,12 @@ OP_SPECULATIVE = 3
 #        bit2 = FINAL piece (the worker also replays activate_slot()
 #        at fill+true_len with the sampling lane — chunk progress on
 #        the wire is what keeps worker block tables bit-identical to
-#        process 0's schedule). With a PAGED model
+#        process 0's schedule), bit3 = radix-cache COW clone (an int32
+#        payload [src_page, dst_page] follows the fill — the worker
+#        replays copy_page() BEFORE the piece, mirroring process 0's
+#        copy-on-write of a shared partially-filled tail page; a
+#        cache-hit admission's first piece also carries the nonzero
+#        match boundary as its fill). With a PAGED model
 #        (CausalLMConfig.kv_num_pages) one more payload follows: the
 #        slot's sentinel-padded page allocation [max_pages_per_slot]
 #        int32 — process 0's engine owns the page pool and every
@@ -275,7 +280,8 @@ def mh_lock():
 def announce_cb_admit(num_slots: int, padded, true_len: int, slot: int,
                       eos_token_id, pad_id: int,
                       sampling=None, pages=None,
-                      chunk_fill=None, final: bool = False) -> None:
+                      chunk_fill=None, final: bool = False,
+                      cow=None) -> None:
     """Process 0 (caller already holds the announce lock): publish one
     slot-admit op. ``padded`` is the [1, S_bucket] right-padded prompt
     (or one chunked-prefill PIECE); ``sampling`` an optional
@@ -285,19 +291,26 @@ def announce_cb_admit(num_slots: int, padded, true_len: int, slot: int,
     their own model config). ``chunk_fill`` marks a chunked-prefill
     piece starting at that offset; ``final`` marks the piece that
     activates the slot (paged chunked prefill rides this same op so
-    workers replay the identical piece schedule)."""
+    workers replay the identical piece schedule); ``cow`` an optional
+    ``(src_page, dst_page)`` radix-cache copy-on-write clone the
+    worker replays BEFORE the piece (a cache-hit admission's first
+    piece also carries the nonzero match boundary as its fill)."""
     header = np.zeros(_HEADER_LEN, np.int32)
     eos = -1 if eos_token_id is None else int(eos_token_id)
     has_sampling = int(sampling is not None and sampling[0] > 0)
     flags = has_sampling
     if chunk_fill is not None:
         flags |= 2 | (4 if final else 0)
+    if cow is not None:
+        flags |= 8
     header[:8] = [OP_CB_ADMIT, num_slots, padded.shape[1], int(true_len),
                   eos, slot, pad_id, flags]
     _bcast(header)
     _bcast(np.asarray(padded, np.int32))
     if chunk_fill is not None:
         _bcast(np.asarray([chunk_fill], np.int32))
+    if cow is not None:
+        _bcast(np.asarray(list(cow), np.int32))
     if has_sampling:
         # floats (temperature, top_p) + the seed as its OWN int64
         # payload: a float32 round-trip would corrupt ~all urandom
@@ -593,16 +606,19 @@ def serve_worker_loop(model, params, mesh: Mesh,
             # ordered stream — consume them BEFORE anything that can
             # fail, or a failed op would leave the next header read
             # misaligned
-            padded = samp = pages = chunk_fill = None
+            padded = samp = pages = chunk_fill = cow = None
             final = False
             if op == OP_CB_ADMIT:
                 # header slot 8 is the flags bitfield: bit0 sampling,
-                # bit1 chunked-prefill piece, bit2 final piece
+                # bit1 chunked-prefill piece, bit2 final piece,
+                # bit3 radix-cache COW page clone
                 padded = np.asarray(_bcast(np.zeros((1, s), np.int32)))
                 if sampling & 2:  # chunked piece: its start offset
                     chunk_fill = int(np.asarray(
                         _bcast(np.zeros(1, np.int32)))[0])
                     final = bool(sampling & 4)
+                if sampling & 8:  # radix COW clone: (src, dst) pages
+                    cow = np.asarray(_bcast(np.zeros(2, np.int32)))
                 if sampling & 1:
                     floats = np.asarray(_bcast(np.zeros(2, np.float32)))
                     seed = int(np.asarray(
@@ -628,7 +644,12 @@ def serve_worker_loop(model, params, mesh: Mesh,
                         # the final piece activates the slot at the
                         # prompt's full fill (chunk_fill + true piece
                         # len) with the sampling lane — identical
-                        # schedule, identical block tables
+                        # schedule, identical block tables. A radix
+                        # cache hit's COW clone replays first, so the
+                        # shared tail page forks identically here.
+                        if cow is not None:
+                            cb_replica.copy_page(int(cow[0]),
+                                                 int(cow[1]))
                         logits1 = cb_replica.prefill_chunk(
                             padded, chunk_fill, max_new, pages)
                         if final:

@@ -867,11 +867,12 @@ def test_paged_cancel_releases_pages():
 
 def test_paged_gates_dense_only_features():
     _, paged, params = _paged_model()
-    # the prefix cache still stages dense batch-1 trees — gated; chunked
-    # prefill writes straight into the pool and is supported
-    with pytest.raises(ValueError, match="paged"):
-        ContinuousEngine(paged, params, num_slots=2, chunk=2,
-                         prefix_cache_size=2)
+    # prefix caching on a PAGED engine builds the radix cache over the
+    # page pool (the dense-staging gate is gone); chunked prefill
+    # writes straight into the pool and is supported
+    eng = ContinuousEngine(paged, params, num_slots=2, chunk=2,
+                           prefix_cache_size=2)
+    assert eng.radix is not None and eng.prefix_cache is None
     ContinuousEngine(paged, params, num_slots=2, chunk=2,
                      prefill_chunk=32)
     # buckets that aren't page-aligned are filtered; none left -> raise

@@ -1,0 +1,411 @@
+"""Radix prefix cache over the paged KV pool (train/continuous.py
+``RadixPrefixCache`` + engine COW page sharing).
+
+Two oracles:
+
+* **Token parity** — a request admitted through shared prefix pages
+  (including a copy-on-write tail-page clone) must produce EXACTLY the
+  tokens solo ``generate()`` produces. Reuse must be invisible in the
+  output.
+* **Refcount invariants** — across admit / cancel / deadline / drain /
+  eviction, every page is either free or referenced, never both; the
+  free list + the refcount table partition the pool; every trie-indexed
+  page holds a reference. A violated invariant is either a leak (pool
+  shrinks until livelock) or a double free (two requests sharing a page
+  that one of them is rewriting).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pyspark_tf_gke_tpu.models.causal_lm import CausalLM, CausalLMConfig
+from pyspark_tf_gke_tpu.train.continuous import (ContinuousEngine,
+                                                 RadixPrefixCache)
+
+from tests.test_continuous import _paged_model, _reference_tokens
+
+
+def _check_page_invariants(eng) -> None:
+    """free ∪ referenced partitions the pool; trie pages are always
+    referenced (the trie holds exactly one ref per indexed page)."""
+    total = eng.model.cfg.kv_num_pages
+    free = eng._free_pages
+    refd = set(eng._page_refs)
+    assert len(free) == len(set(free)), "duplicate page in the free list"
+    assert not (set(free) & refd), "page both free and referenced"
+    assert len(free) + len(refd) == total, (
+        f"pages lost: {len(free)} free + {len(refd)} referenced != "
+        f"{total}")
+    assert all(n > 0 for n in eng._page_refs.values())
+    if eng.radix is not None:
+        trie = eng.radix.indexed_pages()
+        assert len(trie) == len(set(trie)), "page indexed twice"
+        assert set(trie) <= refd, "trie references an unreferenced page"
+        assert len(trie) == eng.radix.resident_pages
+
+
+# ---- trie unit tests (pure python, no device work) --------------------------
+
+
+def test_trie_match_page_granularity_and_cow():
+    c = RadixPrefixCache(page_size=4, capacity_pages=16)
+    seq = list(range(100, 110))  # 2 full pages + tail of 2
+    adopted, released = c.insert(seq, [7, 8, 9])
+    assert adopted == [7, 8, 9] and released == []
+    assert c.resident_pages == 3
+    # full-page + in-tail match, capped at len(prompt) - 1
+    t, pages, cow = c.match(seq + [999])
+    assert t == 10 and pages == [7, 8] and cow == (9, 2)
+    # divergence mid-page -> COW with the common rows only
+    t, pages, cow = c.match([100, 101, 102, 103, 104, 105, 777, 888])
+    assert t == 6 and pages == [7] and cow == (8, 2)
+    # the cap: an exact-prompt repeat must leave >= 1 token to compute
+    t, pages, cow = c.match(seq)
+    assert t == 9 and pages == [7, 8] and cow == (9, 1)
+    # no match
+    t, pages, cow = c.match([1, 2, 3, 4, 5])
+    assert t == 0 and pages == [] and cow is None
+    assert c.hits == 3 and c.misses == 1 and c.hit_tokens == 25
+
+
+def test_trie_insert_dedup_and_tail_upgrade():
+    c = RadixPrefixCache(page_size=4, capacity_pages=16)
+    c.insert([1, 2, 3, 4, 5, 6], [10, 11])          # full + tail(2)
+    # same prefix, longer tail: the tail node UPGRADES to the fuller
+    # page and releases the old one; the full page is NOT re-adopted
+    # (the trie keeps its original page 10, dedup drops page 20)
+    adopted, released = c.insert([1, 2, 3, 4, 5, 6, 7], [20, 21])
+    assert adopted == [21] and released == [11]
+    assert c.resident_pages == 2
+    t, pages, cow = c.match([1, 2, 3, 4, 5, 6, 7, 9])
+    assert t == 7 and pages == [10] and cow == (21, 3)
+    # shorter duplicate: fully covered, nothing adopted or released
+    adopted, released = c.insert([1, 2, 3, 4, 5], [30, 31])
+    assert adopted == [] and released == []
+    # divergent sibling sharing an in-page prefix
+    adopted, _ = c.insert([1, 2, 3, 4, 5, 8], [40, 41])
+    assert adopted == [41]
+    t, pages, cow = c.match([1, 2, 3, 4, 5, 8, 9])
+    assert t == 6 and cow == (41, 2)
+
+
+def test_trie_lru_eviction_leaf_first_and_busy_pinning():
+    c = RadixPrefixCache(page_size=2, capacity_pages=16)
+    c.insert([1, 2, 3, 4], [0, 1])   # chain root->(1,2)->(3,4)
+    c.insert([5, 6], [2])
+    c.match([1, 2, 3, 4, 9])         # touch the chain: (5,6) is LRU
+    got = c.evict(1, busy=lambda p: False)
+    assert got == [2]
+    # leaf-first: the chain's leaf (page 1) must go before its parent
+    got = c.evict(2, busy=lambda p: False)
+    assert got == [1, 0]
+    assert c.resident_pages == 0
+    # busy pages (slot-shared) are pinned
+    c.insert([1, 2], [5])
+    assert c.evict(1, busy=lambda p: True) == []
+    assert c.resident_pages == 1
+
+
+def test_hit_rate_is_windowed_and_only_admissions_count():
+    # the hit rate is a ROUTING signal (/loadz -> affinity spill
+    # allowance): it must track what the cache absorbs NOW, and only
+    # real admission outcomes may feed it
+    c = RadixPrefixCache(page_size=4, capacity_pages=16)
+    c.insert(list(range(8)), [0, 1])
+    c.match(list(range(8)) + [99])                    # admission hit
+    assert c.hits == 1 and c.recent_hit_rate == 1.0
+    # touch-only walk (warm no-op, engine pre-COW match): LRU moves,
+    # stats don't — repeated warms must not inflate the rate
+    c.match(list(range(8)) + [99], count=False)
+    assert c.hits == 1 and c.misses == 0
+    # an explicit note() lands the final outcome (post-COW-degrade)
+    c.note(0)
+    assert c.misses == 1 and c.recent_hit_rate == 0.5
+    # a cold streak decays the WINDOWED rate to zero within one
+    # window even though the lifetime counters remember the hit
+    for i in range(64):
+        c.match([1000 + i, 2000 + i])
+    assert c.recent_hit_rate == 0.0 and c.hits == 1
+    assert c.stats["recent_hit_rate"] == 0.0
+
+
+# ---- engine integration -----------------------------------------------------
+
+
+def test_radix_hit_cow_parity_and_suffix_only_prefill():
+    # fast tier-1 anchor: request A populates the cache at completion;
+    # request B shares a NON-page-aligned prefix (24 tokens, page 16 ->
+    # 1 full shared page + an 8-row COW clone) and must (a) decode
+    # token-exactly vs solo generate, (b) prefill only its unique
+    # suffix.
+    model, paged, params = _paged_model(page_size=16, num_pages=24)
+    rng = np.random.default_rng(50)
+    shared = rng.integers(1, 97, 24)
+    a = np.concatenate([shared, rng.integers(1, 97, 7)])
+    b = np.concatenate([shared, rng.integers(1, 97, 9)])
+    eng = ContinuousEngine(paged, params, num_slots=2, chunk=3,
+                           buckets=(16, 32, 64), prefix_cache_size=24)
+    ra = eng.submit(a, max_new_tokens=6)
+    results = dict(eng.run_until_drained())
+    assert results[ra] == _reference_tokens(model, params, a, 6)
+    computed_after_a = eng.stats["prefill_tokens_computed"]
+    assert computed_after_a == a.size  # cold: the whole prompt
+    assert eng.stats["prefix_cache"]["resident_pages"] > 0
+    # the cold admission took the DIRECT (non-piecewise) path and must
+    # still cool the windowed hit rate — /loadz reads it
+    assert eng.stats["prefix_cache"]["misses"] == 1
+    assert eng.stats["prefix_cache"]["recent_hit_rate"] == 0.0
+
+    rb = eng.submit(b, max_new_tokens=6)
+    results = dict(eng.run_until_drained())
+    assert results[rb] == _reference_tokens(model, params, b, 6), \
+        "COW-shared admission diverged from solo generate"
+    st = eng.stats["prefix_cache"]
+    assert st["hits"] == 1 and st["hit_tokens"] == shared.size
+    assert st["recent_hit_rate"] == 0.5  # one miss (A), one hit (B)
+    # the whole point: B paid prefill for its unique suffix only
+    assert (eng.stats["prefill_tokens_computed"] - computed_after_a
+            == b.size - shared.size)
+    _check_page_invariants(eng)
+
+
+@pytest.mark.slow  # heavy compile set; tier-1 keeps the fast anchor
+def test_radix_exact_repeat_and_multiturn_extension():
+    # an exact repeat matches up to len-1 (the last token recomputes
+    # the carried logits); a multi-turn follow-up whose prompt extends
+    # prompt+completion matches the GENERATED pages too
+    model, paged, params = _paged_model(page_size=16, num_pages=32)
+    rng = np.random.default_rng(51)
+    p1 = rng.integers(1, 97, 21)
+    eng = ContinuousEngine(paged, params, num_slots=2, chunk=3,
+                           buckets=(16, 32, 64), prefix_cache_size=32)
+    r1 = eng.submit(p1, max_new_tokens=8)
+    results = dict(eng.run_until_drained())
+    gen1 = results[r1]
+    assert gen1 == _reference_tokens(model, params, p1, 8)
+    # exact repeat
+    r2 = eng.submit(p1, max_new_tokens=8)
+    results = dict(eng.run_until_drained())
+    assert results[r2] == gen1
+    # multi-turn: prompt = prior prompt + prior completion + new turn
+    p3 = np.concatenate([p1, np.asarray(gen1, np.int32),
+                         rng.integers(1, 97, 5)])
+    r3 = eng.submit(p3, max_new_tokens=6)
+    results = dict(eng.run_until_drained())
+    assert results[r3] == _reference_tokens(model, params, p3, 6)
+    st = eng.stats["prefix_cache"]
+    assert st["hits"] == 2
+    # the multi-turn match covered prompt AND completion pages
+    assert st["hit_tokens"] >= (p1.size - 1) + p1.size + len(gen1) - 1
+    _check_page_invariants(eng)
+
+
+@pytest.mark.slow  # heavy compile set
+def test_radix_eos_completion_inserts_written_extent_only():
+    # eos is emitted but never fed back (no KV row): the cached entry
+    # must exclude it, and a follow-up extending prompt+completion
+    # WITHOUT the eos must still match and stay token-exact
+    model, paged, params = _paged_model(page_size=16, num_pages=32)
+    rng = np.random.default_rng(52)
+    prompt = rng.integers(1, 97, 12)
+    solo = _reference_tokens(model, params, prompt, 10)
+    eos = solo[4]
+    eng = ContinuousEngine(paged, params, num_slots=1, chunk=4,
+                           eos_token_id=eos, buckets=(16, 32),
+                           prefix_cache_size=32)
+    r1 = eng.submit(prompt, max_new_tokens=10)
+    results = dict(eng.run_until_drained())
+    expect = _reference_tokens(model, params, prompt, 10, eos=eos)
+    assert results[r1] == expect and results[r1][-1] == eos
+    follow = np.concatenate(
+        [prompt, np.asarray(expect[:-1], np.int32),
+         rng.integers(1, 97, 4)])
+    r2 = eng.submit(follow, max_new_tokens=6)
+    results = dict(eng.run_until_drained())
+    assert results[r2] == _reference_tokens(model, params, follow, 6,
+                                            eos=eos)
+    assert eng.stats["prefix_cache"]["hits"] == 1
+    _check_page_invariants(eng)
+
+
+@pytest.mark.slow  # heavy compile set
+def test_radix_lru_eviction_under_pool_pressure():
+    # pool of 8 pages: resident cache pages must LRU-evict to admit
+    # new work (cache residency never starves admissions), with exact
+    # parity throughout
+    model, paged, params = _paged_model(page_size=16, num_pages=8)
+    rng = np.random.default_rng(53)
+    eng = ContinuousEngine(paged, params, num_slots=2, chunk=3,
+                           buckets=(16, 32), prefix_cache_size=8,
+                           batch_admit=False)
+    for i in range(6):
+        p = rng.integers(1, 97, 20)
+        r = eng.submit(p, max_new_tokens=8)
+        results = dict(eng.run_until_drained())
+        assert results[r] == _reference_tokens(model, params, p, 8), \
+            f"request {i} diverged under eviction pressure"
+        _check_page_invariants(eng)
+    st = eng.stats["prefix_cache"]
+    assert st["evictions"] > 0, "pool pressure never evicted"
+    assert st["resident_pages"] <= 8
+
+
+@pytest.mark.slow  # heavy compile set
+def test_radix_refcount_invariants_across_lifecycle():
+    # admit (hit + miss + chunked), cancel queued/active/mid-admission,
+    # deadline expiry, decode-ahead frees, drain — the page accounting
+    # must stay exact through all of it
+    model, paged, params = _paged_model(page_size=16, num_pages=32)
+    rng = np.random.default_rng(54)
+    shared = rng.integers(1, 97, 24)
+    eng = ContinuousEngine(paged, params, num_slots=2, chunk=3,
+                           buckets=(16, 32, 64), prefix_cache_size=32,
+                           prefill_chunk=32, pipeline_depth=1,
+                           batch_admit=False)
+    # seed the cache
+    r0 = eng.submit(np.concatenate([shared, rng.integers(1, 97, 5)]),
+                    max_new_tokens=5)
+    results = dict(eng.run_until_drained())
+    assert len(results[r0]) == 5
+    _check_page_invariants(eng)
+    # cancel an ACTIVE hit-admitted request mid-decode
+    r1 = eng.submit(np.concatenate([shared, rng.integers(1, 97, 6)]),
+                    max_new_tokens=40)
+    eng.step()
+    assert eng.cancel(r1)
+    _check_page_invariants(eng)
+    # cancel a chunked admission mid-flight (holds shared + owned)
+    r2 = eng.submit(np.concatenate([shared, rng.integers(1, 97, 60)]),
+                    max_new_tokens=5)
+    eng.step()
+    if eng.stats["admitting"] == r2:
+        assert eng.cancel(r2)
+    else:  # already admitted whole — cancel the active slot instead
+        eng.cancel(r2)
+    _check_page_invariants(eng)
+    # deadline expiry on a hit-admitted request
+    r3 = eng.submit(np.concatenate([shared, rng.integers(1, 97, 4)]),
+                    max_new_tokens=40, deadline_s=0.03)
+    eng.step()
+    time.sleep(0.05)
+    eng.step()
+    _check_page_invariants(eng)
+    # normal traffic drains clean afterwards
+    p = np.concatenate([shared, rng.integers(1, 97, 8)])
+    r4 = eng.submit(p, max_new_tokens=6)
+    results = dict(eng.run_until_drained())
+    assert results[r4] == _reference_tokens(model, params, p, 6)
+    _check_page_invariants(eng)
+    # every non-free page is now trie-resident only (no live slots)
+    assert all(n == 1 for n in eng._page_refs.values())
+
+
+@pytest.mark.slow  # heavy compile set
+def test_radix_warm_prefix_paged_and_chunked_hit():
+    # warm_prefix on the PAGED engine (the satellite fix: it used to
+    # raise) lands the prefix in trie-owned pages; a chunked-prefill
+    # admission then starts its pieces at the match boundary
+    model, paged, params = _paged_model(page_size=16, num_pages=32)
+    rng = np.random.default_rng(55)
+    system = rng.integers(1, 97, 40)
+    eng = ContinuousEngine(paged, params, num_slots=2, chunk=3,
+                           buckets=(16, 32, 64), prefix_cache_size=32,
+                           prefill_chunk=32)
+    assert eng.warm_prefix(system) == 40
+    assert eng.stats["prefix_cache"]["resident_pages"] == 3  # 40 tok
+    _check_page_invariants(eng)
+    warm_computed = eng.stats["prefill_tokens_computed"]
+    p = np.concatenate([system, rng.integers(1, 97, 50)])
+    r = eng.submit(p, max_new_tokens=5)
+    results = dict(eng.run_until_drained())
+    assert results[r] == _reference_tokens(model, params, p, 5)
+    assert eng.stats["prefix_cache"]["hits"] == 1
+    # pieces covered the suffix only
+    assert (eng.stats["prefill_tokens_computed"] - warm_computed
+            == p.size - system.size)
+    # re-warm of a cached prefix is a no-op (already resident)
+    assert eng.warm_prefix(system) == 40
+    assert (eng.stats["prefill_tokens_computed"] - warm_computed
+            == p.size - system.size)
+    _check_page_invariants(eng)
+
+
+@pytest.mark.slow  # full engine run through the replayed wire ops
+def test_radix_announce_stream_replays_with_nonzero_match():
+    # Record the OP_CB_* stream of a radix-hit run (single process:
+    # _bcast is identity) and replay it through serve_worker_loop: the
+    # wire must carry the nonzero match boundary (chunk_fill) and the
+    # COW clone (flags bit3) so worker replicas install identical
+    # block tables. Exact parity + full stream consumption.
+    from pyspark_tf_gke_tpu.train import serving
+
+    model, paged, params = _paged_model(page_size=16, num_pages=24)
+    rng = np.random.default_rng(56)
+    shared = rng.integers(1, 97, 24)  # non-aligned -> COW on the hit
+    p1 = np.concatenate([shared, rng.integers(1, 97, 5)])
+    p2 = np.concatenate([shared, rng.integers(1, 97, 8)])
+    stream = []
+    real = serving._bcast
+
+    def recording(x):
+        stream.append(np.asarray(x).copy())
+        return real(x)
+
+    serving._bcast = recording
+    try:
+        eng = ContinuousEngine(paged, params, num_slots=2, chunk=3,
+                               buckets=(16, 32, 64),
+                               prefix_cache_size=24, announce=True)
+        r1 = eng.submit(p1, max_new_tokens=5)
+        results = dict(eng.run_until_drained())
+        r2 = eng.submit(p2, max_new_tokens=5)
+        results.update(dict(eng.run_until_drained()))
+        serving.announce_shutdown()
+    finally:
+        serving._bcast = real
+    assert results[r1] == _reference_tokens(model, params, p1, 5)
+    assert results[r2] == _reference_tokens(model, params, p2, 5)
+    assert eng.stats["prefix_cache"]["hits"] == 1
+    flags = [int(h[7]) for h in stream
+             if h.shape == (8,) and h[0] == serving.OP_CB_ADMIT]
+    assert any(f & 8 for f in flags), "COW clone never hit the wire"
+    assert any(f & 2 for f in flags), "no piecewise admit on the wire"
+
+    replay = list(stream)
+
+    def replaying(x):
+        got = replay.pop(0)
+        assert got.shape == np.asarray(x).shape, (
+            f"wire desync: worker expects {np.asarray(x).shape}, "
+            f"stream has {got.shape}")
+        return got
+
+    serving._bcast = replaying
+    try:
+        served = serving.serve_worker_loop(paged, params, mesh=None)
+    finally:
+        serving._bcast = real
+    assert not replay, f"{len(replay)} broadcast(s) never consumed"
+    assert served > 0
+
+
+def test_radix_near_context_limit_skips_insert():
+    # a request whose device rows could overshoot to max_seq_len (the
+    # paged write's table-index clamp) must NOT be indexed — cheap to
+    # exclude, impossible to repair. max_seq_len 128, chunk 3:
+    # 100 + 25 + (0+1)*3 >= 128 -> skipped.
+    _, paged, params = _paged_model(page_size=16, num_pages=16)
+    rng = np.random.default_rng(57)
+    eng = ContinuousEngine(paged, params, num_slots=1, chunk=3,
+                           buckets=(16, 32, 64, 128),
+                           prefix_cache_size=16)
+    r = eng.submit(rng.integers(1, 97, 100), max_new_tokens=25)
+    results = dict(eng.run_until_drained())
+    assert len(results[r]) == 25
+    assert eng.stats["prefix_cache"]["resident_pages"] == 0
+    _check_page_invariants(eng)
+    assert not eng._page_refs  # everything back in the pool

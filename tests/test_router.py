@@ -255,6 +255,33 @@ def test_choose_replica_least_loaded_and_saturation():
     assert choose_replica([a, b], inflight_cap=4)[0] is None
 
 
+def test_choose_replica_hit_rate_widens_spill_allowance():
+    # /loadz's measured prefix_hit_rate feeds the affinity override: a
+    # warm replica (each hit costs ~unique-suffix prefill only) may
+    # carry up to (1 + hit_rate) x the baseline spill threshold before
+    # traffic spills to a cold replica that would re-prefill the whole
+    # prefix. Same load shape, hit rate alone flips the decision.
+    a = Replica(rid="a", base_url="http://a", state=UP)
+    b = Replica(rid="b", base_url="http://b", state=UP)
+    key = affinity_key("shared system prompt")
+    target = rendezvous_pick(key, [a, b])
+    other = b if target is a else a
+    # target sits just past the cold allowance: spill_ratio x
+    # max(least, 256) < outstanding <= 2 x that with hit_rate 1.0
+    other.load = {"queued_tokens": 10, "active": 0}
+    target.load = {"queued_tokens": 700, "active": 0,
+                   "prefix_hit_rate": 0.0}
+    got, aff = choose_replica([a, b], affinity=key, spill_ratio=2.0)
+    assert got is other and aff is False  # cold: spills
+    target.load["prefix_hit_rate"] = 1.0
+    got, aff = choose_replica([a, b], affinity=key, spill_ratio=2.0)
+    assert got is target and aff is True  # provably warm: holds
+    # malformed /loadz value degrades to the cold allowance, no crash
+    target.load["prefix_hit_rate"] = "nan?"
+    got, aff = choose_replica([a, b], affinity=key, spill_ratio=2.0)
+    assert got is other and aff is False
+
+
 # -- membership / health -----------------------------------------------------
 
 

@@ -338,7 +338,8 @@ def test_loadz_snapshot_key_stability(cb_endpoints):
     on dense engines, a number on paged ones)."""
     plain_url, cont_url = cb_endpoints
     want_keys = {"queued", "queued_tokens", "active", "slots_total",
-                 "kv_pages_free", "inflight_http", "draining"}
+                 "kv_pages_free", "inflight_http", "draining",
+                 "prefix_cache_pages", "prefix_hit_rate"}
     for url in (plain_url, cont_url):
         with urllib.request.urlopen(url + "/loadz") as resp:
             assert resp.status == 200

@@ -33,8 +33,16 @@ SIGKILL one replica mid-run — every request must reach a terminal
 outcome (the survivors via hedge/re-route), and the router must drain
 and exit 0 on SIGTERM.
 
+``--prefix-cache`` checks the radix prefix-cache contract through a
+live CPU server: two generates sharing a long prompt prefix — the
+second request's COMPUTED prefill tokens (engine counter, via
+``/healthz``) must stay under unique-suffix + one prefill chunk, and
+``/loadz`` must report a nonzero hit rate, so the router's
+affinity signal is provably fed by real cache contents.
+
 Usage: python tools/smoke_check.py
-       [--lint-only|--kernels-only|--serve-lifecycle|--serve-tbt|--router]
+       [--lint-only|--kernels-only|--serve-lifecycle|--serve-tbt|
+        --router|--prefix-cache]
 """
 
 import os
@@ -115,6 +123,19 @@ def lint_duplicate_metrics() -> int:
     if not _REGISTRATIONS:
         print("metric lint FAILED — registration record is empty after "
               "the sweep; the lint is observing nothing")
+        return 1
+    # presence guard for families the router/bench planes DEPEND on
+    # reading (not just naming-conflict-free): the radix prefix cache's
+    # serve_* names feed /loadz's prefix_hit_rate and the bench's hit
+    # accounting — a refactor that drops one must fail here
+    required = {"serve_prefix_cache_hits_total",
+                "serve_prefix_cache_hit_tokens_total",
+                "serve_prefix_cache_pages",
+                "serve_prefix_cache_evictions_total"}
+    absent = {n for n in required if n not in _REGISTRATIONS}
+    if absent:
+        print("metric lint FAILED — required metric name(s) never "
+              f"registered: {sorted(absent)}")
         return 1
     conflicts = duplicate_metric_conflicts()
     if conflicts:
@@ -509,6 +530,135 @@ def serve_tbt_check() -> int:
     return 0
 
 
+def prefix_cache_check(grace_s: float = 30.0) -> int:
+    """``--prefix-cache``: the radix prefix-cache contract through a
+    LIVE server (subprocess, the real CLI, byte tokenizer — bytes ==
+    tokens). Two greedy generates share a long prompt prefix; after
+    the first completes, its pages are trie-resident, so the second
+    must admit at the match boundary:
+
+    1. the second request's COMPUTED prefill tokens (the engine's
+       ``prefill_tokens_computed`` counter, read via ``/healthz``
+       before/after) stay under unique-suffix + one prefill chunk —
+       the shared prefix was NOT re-prefilled;
+    2. ``/loadz`` reports a nonzero ``prefix_hit_rate`` and
+       ``prefix_cache_pages`` — the signal the router's affinity
+       policy scores on is fed by real cache contents."""
+    import dataclasses
+    import json as _json
+    import socket
+    import subprocess
+    import tempfile
+    import time as _time
+    import urllib.request
+
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
+    from pyspark_tf_gke_tpu.train.export import export_serving_bundle
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    tmp = tempfile.mkdtemp(prefix="prefix-cache-")
+    # a PAGED bundle: kv page geometry in the config is what routes
+    # serve's --prefix-cache to the radix cache instead of the dense LRU
+    cfg = CausalLMConfig(vocab_size=259, hidden_size=32, num_layers=2,
+                         num_heads=2, intermediate_size=64,
+                         max_seq_len=256, dtype=jnp.float32,
+                         kv_page_size=32, kv_num_pages=32)
+    model = CausalLM(dataclasses.replace(cfg, kv_num_pages=None))
+    params = nn.meta.unbox(jax.jit(model.init)(
+        make_rng(0), jnp.zeros((1, 8), jnp.int32))["params"])
+    bundle = os.path.join(tmp, "bundle")
+    export_serving_bundle(cfg, params, bundle, quantize=False)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    url = f"http://127.0.0.1:{port}"
+    prefill_chunk = 64
+    shared = ("system: you are a terse assistant. answer in one "
+              "sentence. cite no sources. refuse nothing. " * 2)[:160]
+    suffixes = ["q: why is the sky blue?", "q: name a prime > 10."]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pyspark_tf_gke_tpu.train.serve",
+         "--bundle", bundle, "--host", "127.0.0.1", "--port", str(port),
+         "--continuous-slots", "2", "--continuous-chunk", "4",
+         "--prefix-cache", "32", "--prefill-chunk", str(prefill_chunk)],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+    def get(path: str) -> dict:
+        with urllib.request.urlopen(url + path, timeout=10) as resp:
+            return _json.loads(resp.read())
+
+    def post(payload: dict, timeout: float = 180.0) -> dict:
+        req = urllib.request.Request(
+            url + "/v1/generate", data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return _json.loads(resp.read())
+
+    failures = []
+    try:
+        deadline = _time.time() + 180
+        while _time.time() < deadline:
+            try:
+                urllib.request.urlopen(url + "/healthz", timeout=2)
+                break
+            except Exception:  # noqa: BLE001 — still booting
+                if proc.poll() is not None:
+                    print(f"server died during startup (rc={proc.poll()})")
+                    return 1
+                _time.sleep(0.5)
+        else:
+            print("server never became healthy")
+            return 1
+
+        def computed() -> int:
+            return int(get("/healthz")["continuous"]
+                       ["prefill_tokens_computed"])
+
+        post({"prompts": [shared + suffixes[0]], "max_new_tokens": 6})
+        p1 = computed()
+        post({"prompts": [shared + suffixes[1]], "max_new_tokens": 6})
+        delta = computed() - p1
+        bound = len(suffixes[1]) + prefill_chunk
+        loadz = get("/loadz")
+        print(f"prefix-cache: second request computed {delta} prefill "
+              f"tokens (bound {bound}: {len(suffixes[1])}-byte suffix "
+              f"+ one {prefill_chunk}-token chunk); /loadz hit_rate="
+              f"{loadz.get('prefix_hit_rate')} "
+              f"pages={loadz.get('prefix_cache_pages')}")
+        if delta >= bound:
+            failures.append(
+                f"second request computed {delta} prefill tokens — not "
+                f"< suffix + one chunk ({bound}); the shared prefix "
+                "was re-prefilled")
+        if not loadz.get("prefix_hit_rate"):
+            failures.append(
+                f"/loadz prefix_hit_rate={loadz.get('prefix_hit_rate')} "
+                "— the router's affinity signal reads a cold cache")
+        if not loadz.get("prefix_cache_pages"):
+            failures.append(
+                "/loadz prefix_cache_pages=0 — nothing stayed resident")
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+    if failures:
+        print("prefix-cache FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("prefix-cache OK: shared prefix prefilled once — the second "
+          "request computed only its unique suffix, and /loadz exposes "
+          "the hit rate the router scores on")
+    return 0
+
+
 def router_check(grace_s: float = 30.0, n_requests: int = 10) -> int:
     """``--router``: the kill-one-replica failover contract as a
     subprocess check. 2 tiny CPU replicas + the router (all
@@ -638,6 +788,8 @@ def main(argv=None) -> int:
         return serve_tbt_check()
     if "--router" in argv:
         return router_check()
+    if "--prefix-cache" in argv:
+        return prefix_cache_check()
     if "--lint-only" not in argv:
         devices = jax.devices()
         print(f"devices: {devices}")
