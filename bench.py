@@ -1874,7 +1874,8 @@ def annotate_variant_regression(argv, result: dict) -> None:
            if regressed else ""))
 
 
-def append_history(argv, result: dict) -> None:
+def append_history(argv, result: dict,
+                   host_load_pre: Optional[float] = None) -> None:
     """Append a successful measurement to the committed evidence trail.
 
     Round 1 and round 2 both lost their perf evidence to tunnel outages
@@ -1897,14 +1898,21 @@ def append_history(argv, result: dict) -> None:
     # Host-contention disclosure: dispatch-bound step times on this
     # 1-vCPU host inflate under concurrent compilation (the 2026-08-02
     # cnn entry measured 1,898 img/s vs ~3,470 idle because a test run
-    # shared the core). Record the 1-minute load average at append time
-    # so a polluted entry is distinguishable from a clean one IN the
-    # trail, not only in session notes. loadavg ~1 = this process alone;
-    # >~1.5 = something else was competing.
+    # shared the core). Record the 1-minute load average both as the
+    # measurement STARTED (host_load_1m_pre, sampled by the runner
+    # before the workload subprocess launched) and at append time
+    # (host_load_1m) — a competitor that exits before the run finishes
+    # dilutes out of the post-run average but is still visible in the
+    # pre sample, so contention DURING the run is captured, not only
+    # contention that survives to append (ADVICE.md round 5). loadavg
+    # ~1 = this process alone; >~1.5 = something else was competing —
+    # on EITHER sample.
     try:
         entry["host_load_1m"] = round(os.getloadavg()[0], 2)
     except OSError:  # pragma: no cover - non-POSIX
         pass
+    if host_load_pre is not None:
+        entry["host_load_1m_pre"] = round(float(host_load_pre), 2)
     try:
         # The obs event-trail primitive: ONE O_APPEND write per line, so
         # a capture racing the chip-watcher (or a second bench process)
@@ -2180,8 +2188,18 @@ def orchestrate(argv, skip_probe: bool = False) -> int:
     last = ""
     last_rc = 1  # what the structured exit context reports; a timeout
     # (no child rc) keeps the generic 1
+    pre_load = None
     for attempt in range(RUN_ATTEMPTS):
         try:
+            # loadavg as the measurement STARTS (per attempt — the
+            # successful attempt's sample is the one recorded):
+            # contention early in a long run, or from a competitor
+            # that exits before append time, is invisible in the
+            # append-time sample alone (ADVICE.md round 5)
+            try:
+                pre_load = os.getloadavg()[0]
+            except OSError:  # pragma: no cover - non-POSIX
+                pre_load = None
             proc = subprocess.run(
                 cmd, capture_output=True, text=True, timeout=RUN_TIMEOUT_S,
             )
@@ -2224,7 +2242,7 @@ def orchestrate(argv, skip_probe: bool = False) -> int:
             except Exception as exc:  # noqa: BLE001
                 log(f"variant A/B guard failed (ignored): {exc!r}")
             print(json.dumps(result))
-            append_history(argv, result)
+            append_history(argv, result, host_load_pre=pre_load)
             return 0
         last = f"rc={proc.returncode}: {proc.stderr.strip()[-800:]}"
         last_rc = proc.returncode

@@ -560,6 +560,37 @@ def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "serve_kv_page_alloc_failures_total",
             "Admission attempts deferred because the page pool could "
             "not cover the request (it stays queued)"),
+        # multi-tenant fairness / quotas (DWRR admission + per-tenant
+        # token buckets; every request carries a tenant — "default"
+        # when the client sends none, so single-tenant deployments
+        # still populate these families)
+        "serve_tenant_requests_total": r.counter(
+            "serve_tenant_requests_total",
+            "Requests admitted past the tenant quota/share gates, "
+            "by tenant",
+            labelnames=("tenant",)),
+        "serve_tenant_rejected_total": r.counter(
+            "serve_tenant_rejected_total",
+            "Requests shed PER-TENANT (quota exhausted or queue share "
+            "exceeded) — other tenants kept admitting",
+            labelnames=("tenant", "reason")),  # tenant_quota |
+        #                                        tenant_queue_full
+        "serve_tenant_tokens_total": r.counter(
+            "serve_tenant_tokens_total",
+            "New tokens decoded into each tenant's requests (counted "
+            "at delivery, when the unused quota charge refunds)",
+            labelnames=("tenant",)),
+        "serve_tenant_queue_depth": r.gauge(
+            "serve_tenant_queue_depth",
+            "Requests waiting for a KV slot, by tenant (the DWRR "
+            "subqueue lengths)",
+            labelnames=("tenant",)),
+        "serve_capacity_free_tokens": r.gauge(
+            "serve_capacity_free_tokens",
+            "Routable token headroom this replica advertises on "
+            "/loadz capacity_free (admission-budget or KV-page bound, "
+            "whichever is tighter) — the closed-loop autoscale "
+            "signal's per-replica term"),
         # data plane
         "data_prefetch_queue_depth": r.gauge(
             "data_prefetch_queue_depth",
@@ -610,4 +641,34 @@ def router_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "router_request_latency_ms",
             "End-to-end routed request latency (also feeds the "
             "adaptive hedge delay's p99 estimate)"),
+        # closed-loop capacity signal (k8s HPA external metrics — see
+        # infra/k8s/tpu/tpu-serve-hpa.yaml): free headroom vs demand
+        # plus the fleet's queue delay distribution
+        "router_capacity_free_total": r.gauge(
+            "router_capacity_free_total",
+            "Sum of routable replicas' /loadz capacity_free (token "
+            "headroom the fleet can still absorb; 0 = saturated — "
+            "scale up)"),
+        "router_demand_tokens_total": r.gauge(
+            "router_demand_tokens_total",
+            "Sum of outstanding tokens across replicas (queued + "
+            "router-side in flight) — the demand side of the "
+            "autoscale ratio (HPA AverageValue target: tokens one "
+            "replica should carry)"),
+        "router_queue_delay_ms": r.histogram(
+            "router_queue_delay_ms",
+            "Replica-reported admission-queue delay (/loadz "
+            "queue_delay_ms), observed once per replica per probe "
+            "sweep — its p99 is the HPA latency signal"),
+        "router_tenant_inflight": r.gauge(
+            "router_tenant_inflight",
+            "Requests this router currently has in flight per tenant "
+            "(the hedge/spill budget accounting)",
+            labelnames=("tenant",)),
+        "router_tenant_sheds_total": r.counter(
+            "router_tenant_sheds_total",
+            "Per-tenant 429s relayed to clients (tenant over quota or "
+            "queue share on the replica) — NOT a replica-health event: "
+            "no backoff, no re-route, no DOWN marking",
+            labelnames=("tenant",)),
     }

@@ -218,6 +218,15 @@ class ReplicaSet:
         if self._obs is not None:
             self._obs["router_replica_up"].labels(replica=rid).set(
                 1 if state == UP else 0)
+            if load is not None:
+                # replica-reported admission-queue delay: one histogram
+                # observation per replica per probe sweep — the p99 of
+                # this series is the HPA latency signal
+                qd = load.get("queue_delay_ms")
+                hist = self._obs.get("router_queue_delay_ms")
+                if hist is not None and isinstance(qd, (int, float)) \
+                        and not isinstance(qd, bool):
+                    hist.observe(float(qd))
         if prev != state:
             logger.info("replica %s: %s -> %s%s", rid, prev, state,
                         f" ({reason})" if reason else "")
@@ -259,6 +268,34 @@ class ReplicaSet:
                 r.inflight = max(0, r.inflight - 1)
                 r.inflight_tokens = max(0,
                                         r.inflight_tokens - int(tokens))
+
+    def update_autoscale(self) -> dict:
+        """Fold the fleet's capacity/demand terms into the autoscale
+        gauges and return them: ``capacity_free_total`` (sum of UP
+        replicas' /loadz token headroom — 0 means saturated, scale up),
+        ``demand_tokens_total`` (queued + router-side in-flight tokens
+        — the HPA AverageValue numerator), ``queue_delay_ms_max`` (the
+        worst replica's last-probed admission delay). Called after
+        every probe sweep and from the gateway's /healthz."""
+        with self._lock:
+            ups = [r for r in self._replicas.values() if r.state == UP]
+            cap = sum(int(r.load.get("capacity_free") or 0) for r in ups)
+            demand = sum(r.outstanding_tokens() for r in ups)
+            delays = [r.load.get("queue_delay_ms") for r in ups]
+        delay_max = max(
+            (float(d) for d in delays
+             if isinstance(d, (int, float)) and not isinstance(d, bool)),
+            default=0.0)
+        if self._obs is not None:
+            g = self._obs.get("router_capacity_free_total")
+            if g is not None:
+                g.set(cap)
+            g = self._obs.get("router_demand_tokens_total")
+            if g is not None:
+                g.set(demand)
+        return {"capacity_free_total": cap,
+                "demand_tokens_total": demand,
+                "queue_delay_ms_max": round(delay_max, 2)}
 
     def snapshot(self) -> List[dict]:
         """JSON-ready table for the router's own /healthz."""
@@ -314,6 +351,7 @@ class HealthProber:
         if len(reps) <= 1:
             for r in reps:
                 self._probe_one(r)
+            self.replicas.update_autoscale()
             return
         threads = [threading.Thread(target=self._probe_one, args=(r,),
                                     name=f"router-probe-{i}", daemon=True)
@@ -322,6 +360,8 @@ class HealthProber:
             t.start()
         for t in threads:
             t.join(timeout=self.timeout_s + 5.0)
+        # fold the fresh sweep into the closed-loop autoscale gauges
+        self.replicas.update_autoscale()
 
     def _probe_one(self, r: Replica) -> None:
         try:

@@ -115,6 +115,91 @@ class RouterServer:
         self.draining = threading.Event()
         self._http_lock = threading.Lock()
         self._http_inflight = 0
+        # per-tenant in-flight accounting: the hedge/spill budget is a
+        # shared resource — a tenant already dominating the router's
+        # in-flight set must not double its own load with hedges while
+        # lighter tenants wait behind the duplicated work
+        self._tenant_lock = threading.Lock()
+        self._tenant_inflight: dict = {}
+        # metric-label cardinality bound: the router has no tenant
+        # spec, so client-chosen ids are untrusted — the first 64
+        # distinct names get their own label series, the rest fold
+        # into "*" (the in-flight ACCOUNTING dict stays exact either
+        # way; it self-cleans at request exit)
+        self._tenant_label_names: set = set()
+
+    def _tenant_label(self, tenant: str) -> str:
+        if (tenant in self._tenant_label_names
+                or len(self._tenant_label_names) < 64):
+            self._tenant_label_names.add(tenant)
+            return tenant
+        return "*"
+
+    # -- per-tenant accounting -------------------------------------------
+
+    @staticmethod
+    def tenant_of(req: dict, header: Optional[str] = None) -> str:
+        """One extraction point, mirroring the replica's: X-Tenant
+        header wins, then the body field, then "default"."""
+        if header:
+            return str(header)
+        t = req.get("tenant") if isinstance(req, dict) else None
+        return str(t) if t else "default"
+
+    def _tenant_enter(self, tenant: str) -> None:
+        with self._tenant_lock:
+            n = self._tenant_inflight.get(tenant, 0) + 1
+            self._tenant_inflight[tenant] = n
+            label = self._tenant_label(tenant)
+            if label == "*":  # folded: the series carries the sum of
+                #   every beyond-cap tenant, not one tenant's count
+                n = sum(v for k, v in self._tenant_inflight.items()
+                        if k not in self._tenant_label_names)
+        self._obs["router_tenant_inflight"].labels(tenant=label).set(n)
+
+    def _tenant_exit(self, tenant: str) -> None:
+        with self._tenant_lock:
+            n = max(0, self._tenant_inflight.get(tenant, 0) - 1)
+            if n:
+                self._tenant_inflight[tenant] = n
+            else:
+                self._tenant_inflight.pop(tenant, None)
+            label = self._tenant_label(tenant)
+            if label == "*":
+                n = sum(v for k, v in self._tenant_inflight.items()
+                        if k not in self._tenant_label_names)
+        self._obs["router_tenant_inflight"].labels(tenant=label).set(n)
+
+    def _tenant_may_hedge(self, tenant: str) -> bool:
+        """Hedge budget gate: a lone tenant hedges freely (nothing to
+        protect — the pre-tenancy behavior), but once several tenants
+        are in flight, one holding more than half the router's
+        in-flight set (floor 2) has consumed its share — its requests
+        run un-hedged so the duplicated work can't squeeze the
+        others."""
+        with self._tenant_lock:
+            mine = self._tenant_inflight.get(tenant, 0)
+            total = sum(self._tenant_inflight.values())
+        if total - mine <= 0:
+            return True
+        return mine <= max(2, total // 2)
+
+    def _note_shed(self, rid: str, retry_after: Optional[str],
+                   tenant_shed: Optional[str]) -> bool:
+        """Fold one 429/503 verdict into replica state. A PER-TENANT
+        shed (the replica set ``X-Tenant-Shed``: that tenant is over
+        its quota or queue share) is a verdict about the tenant — count
+        it, leave the replica fully in rotation, and return True (the
+        caller surfaces it without burning the re-route). A global shed
+        backs the replica off for its Retry-After as before."""
+        if tenant_shed:
+            with self._tenant_lock:
+                label = self._tenant_label(str(tenant_shed))
+            self._obs["router_tenant_sheds_total"].labels(
+                tenant=label).inc()
+            return True
+        self.replicas.note_backoff(rid, parse_retry_after(retry_after))
+        return False
 
     # -- in-flight accounting (drain) ------------------------------------
 
@@ -177,14 +262,16 @@ class RouterServer:
     # -- forwarding ------------------------------------------------------
 
     def _forward_once(self, replica: Replica, path: str, body: bytes,
-                      tokens: int) -> ReplicaCall:
+                      tokens: int,
+                      headers: Optional[dict] = None) -> ReplicaCall:
         """One proxied request; transport failure marks the replica DOWN
-        (passive health) and re-raises for the caller's failover."""
+        (passive health) and re-raises for the caller's failover.
+        ``headers``: extra request headers (the propagated X-Tenant)."""
         self.replicas.track(replica.rid, tokens)
         call = ReplicaCall(replica.base_url,
                            timeout_s=self.request_timeout_s)
         try:
-            call.request("POST", path, body=body)
+            call.request("POST", path, body=body, headers=headers)
         except ReplicaUnreachable:
             self.replicas.untrack(replica.rid, tokens)
             if not call.cancelled:
@@ -197,10 +284,15 @@ class RouterServer:
         self._obs["router_requests_total"].labels(
             replica=replica_rid, outcome=outcome).inc()
 
-    def route_json(self, path: str, req: dict
+    def route_json(self, path: str, req: dict,
+                   tenant: Optional[str] = None
                    ) -> Tuple[int, dict, Tuple[Tuple[str, str], ...]]:
         """Route a non-streamed JSON POST end to end. Returns
-        (status, body, extra headers) for the HTTP layer."""
+        (status, body, extra headers) for the HTTP layer. ``tenant``:
+        the resolved tenant id (HTTP layer passes the header value);
+        falls back to the body field — propagated to the replica as
+        X-Tenant and charged against the hedge budget."""
+        tenant = self.tenant_of(req, tenant)
         body = json.dumps(req).encode()
         affinity = (self._affinity_for(req)
                     if path in ("/v1/generate", "/v1/warm") else None)
@@ -208,16 +300,23 @@ class RouterServer:
         t0 = time.perf_counter()
         tried: List[str] = []
 
-        primary = self.pick(affinity)
-        if primary is None:
-            self._count("none", "shed")
-            return 503, {"error": "no routable replica",
-                         "reason": "no_replicas"}, (("Retry-After", "1"),)
+        self._tenant_enter(tenant)
+        try:
+            primary = self.pick(affinity)
+            if primary is None:
+                self._count("none", "shed")
+                return 503, {"error": "no routable replica",
+                             "reason": "no_replicas"}, (
+                                 ("Retry-After", "1"),)
 
-        status, out, hdrs, terminal_rid = self._route_with_failover(
-            primary, path, body, tokens, tried,
-            hedge=(self.hedge_enabled and path == "/v1/generate"
-                   and not req.get("stream")))
+            status, out, hdrs, terminal_rid = self._route_with_failover(
+                primary, path, body, tokens, tried,
+                hedge=(self.hedge_enabled and path == "/v1/generate"
+                       and not req.get("stream")
+                       and self._tenant_may_hedge(tenant)),
+                headers={"X-Tenant": tenant})
+        finally:
+            self._tenant_exit(tenant)
         dt_ms = (time.perf_counter() - t0) * 1000.0
         self._obs["router_request_latency_ms"].observe(dt_ms)
         if 200 <= status < 300:
@@ -254,21 +353,25 @@ class RouterServer:
         hdrs: Tuple[Tuple[str, str], ...] = ()
         ra = call.header("Retry-After")
         if ra is not None:
-            hdrs = (("Retry-After", ra),)
+            hdrs += (("Retry-After", ra),)
+        ts = call.header("X-Tenant-Shed")
+        if ts is not None:
+            hdrs += (("X-Tenant-Shed", ts),)
         return status, out, hdrs
 
     def _route_with_failover(self, primary: Replica, path: str,
                              body: bytes, tokens: int, tried: List[str],
-                             hedge: bool):
+                             hedge: bool, headers=None):
         """primary -> (maybe hedge) -> (maybe one re-route). Returns
         (status, body, headers, terminal_replica_rid)."""
         tried.append(primary.rid)
         try:
             if hedge:
                 status, out, hdrs, rid = self._call_hedged(
-                    primary, path, body, tokens, tried)
+                    primary, path, body, tokens, tried, headers=headers)
             else:
-                call = self._forward_once(primary, path, body, tokens)
+                call = self._forward_once(primary, path, body, tokens,
+                                          headers=headers)
                 status, out, hdrs = self._finish_call(call, primary,
                                                       tokens)
                 rid = primary.rid
@@ -282,26 +385,36 @@ class RouterServer:
                                 error=str(exc)[:200])
             return self._reroute_once(path, body, tokens, tried,
                                       shed_status=502,
-                                      shed_error=str(exc))
+                                      shed_error=str(exc),
+                                      headers=headers)
         if status in (429, 503):
-            # backpressure: honor Retry-After on the shedding replica,
-            # then ONE re-route to the next best
-            backoff = parse_retry_after(dict(hdrs).get("Retry-After"))
-            self.replicas.note_backoff(rid, backoff)
+            hd = dict(hdrs)
+            if self._note_shed(rid, hd.get("Retry-After"),
+                               hd.get("X-Tenant-Shed")):
+                # PER-TENANT shed: the verdict is about the tenant, not
+                # the replica — surface it as-is (Retry-After from the
+                # tenant's own bucket). No re-route: a tenant over its
+                # quota must not consume the spill budget by hopping
+                # replicas, and the replica stays fully in rotation
+                # for every other tenant.
+                return status, out, hdrs, rid
+            # global backpressure: the Retry-After backoff landed in
+            # _note_shed; ONE re-route to the next best
             self._obs["router_reroutes_total"].labels(
                 reason="backpressure").inc()
-            self.event_log.emit("router_reroute", path=path,
-                                reason="backpressure", shed_by=rid,
-                                retry_after_s=backoff)
+            self.event_log.emit(
+                "router_reroute", path=path, reason="backpressure",
+                shed_by=rid,
+                retry_after_s=parse_retry_after(hd.get("Retry-After")))
             return self._reroute_once(path, body, tokens, tried,
                                       shed_status=status,
                                       shed_error=out.get("error", ""),
-                                      shed_hdrs=hdrs)
+                                      shed_hdrs=hdrs, headers=headers)
         return status, out, hdrs, rid
 
     def _reroute_once(self, path: str, body: bytes, tokens: int,
                       tried: List[str], *, shed_status: int,
-                      shed_error: str, shed_hdrs=()):
+                      shed_error: str, shed_hdrs=(), headers=None):
         """The single permitted re-route. A second failure — of any
         kind — surfaces to the client; the router never turns one
         request into a retry storm against a struggling fleet."""
@@ -315,7 +428,8 @@ class RouterServer:
             }, (tuple(shed_hdrs) or (("Retry-After", "1"),)), tried[-1]
         tried.append(nxt.rid)
         try:
-            call = self._forward_once(nxt, path, body, tokens)
+            call = self._forward_once(nxt, path, body, tokens,
+                                      headers=headers)
             status, out, hdrs = self._finish_call(call, nxt, tokens)
         except ReplicaUnreachable as exc:
             return 502, {"error": f"re-routed request failed too: "
@@ -324,13 +438,15 @@ class RouterServer:
         if status in (429, 503):
             # the fallback shed too: its Retry-After is honored (stop
             # offering it work) even though the request now surfaces —
-            # the next request must not hammer the same pair
-            self.replicas.note_backoff(
-                nxt.rid, parse_retry_after(dict(hdrs).get("Retry-After")))
+            # the next request must not hammer the same pair. A
+            # tenant-scoped shed leaves the fallback in rotation.
+            hd = dict(hdrs)
+            self._note_shed(nxt.rid, hd.get("Retry-After"),
+                            hd.get("X-Tenant-Shed"))
         return status, out, hdrs, nxt.rid
 
     def _call_hedged(self, primary: Replica, path: str, body: bytes,
-                     tokens: int, tried: List[str]):
+                     tokens: int, tried: List[str], headers=None):
         """Primary + (after the adaptive delay) one hedge; the first
         USABLE response wins and the loser is cancelled (socket close —
         the replica's own deadline machinery reclaims the work). Each
@@ -368,7 +484,7 @@ class RouterServer:
                 calls.append(call)
             self.replicas.track(replica.rid, tokens)
             try:
-                call.request("POST", path, body=body)
+                call.request("POST", path, body=body, headers=headers)
                 status = call.status
                 out = call.read_json()
             except ReplicaUnreachable as exc:
@@ -443,9 +559,11 @@ class RouterServer:
                     got.append(r)
             for r in got:
                 if r[2] in (429, 503):
-                    self.replicas.note_backoff(
-                        r[0].rid,
-                        parse_retry_after(r[1].header("Retry-After")))
+                    # tenant-scoped loser sheds leave the replica in
+                    # rotation (the verdict is about the tenant)
+                    self._note_shed(
+                        r[0].rid, r[1].header("Retry-After"),
+                        r[1].header("X-Tenant-Shed"))
                 self.replicas.untrack(r[0].rid, tokens)
                 r[1].close()
 
@@ -461,14 +579,19 @@ class RouterServer:
         hdrs: Tuple[Tuple[str, str], ...] = ()
         ra = call.header("Retry-After")
         if ra is not None:
-            hdrs = (("Retry-After", ra),)
+            hdrs += (("Retry-After", ra),)
+        ts = call.header("X-Tenant-Shed")
+        if ts is not None:
+            hdrs += (("X-Tenant-Shed", ts),)  # a surfacing tenant shed
+            #   keeps its marker so the failover layer relays, not
+            #   re-routes
         self.replicas.untrack(replica.rid, tokens)
         call.close()
         return status, out, hdrs, replica.rid
 
     # -- streaming -------------------------------------------------------
 
-    def open_stream(self, req: dict):
+    def open_stream(self, req: dict, tenant: Optional[str] = None):
         """Route a streamed generate. Returns ``(replica, call,
         first_lines, tokens)``: for a 200 the stream is PRIMED — the
         response lines up to and including the first ``data:`` event
@@ -482,6 +605,7 @@ class RouterServer:
         replay is not a concern); if no other replica can take it, the
         FIRST shed verdict is relayed. Other non-200 verdicts return
         unprimed (JSON body, relayed verbatim)."""
+        tenant = self.tenant_of(req, tenant)
         body = json.dumps(req).encode()
         tokens = self._token_ask(req)
         affinity = self._affinity_for(req)
@@ -497,18 +621,23 @@ class RouterServer:
             tried.append(replica.rid)
             try:
                 call = self._forward_once(replica, "/v1/generate", body,
-                                          tokens)
+                                          tokens,
+                                          headers={"X-Tenant": tenant})
             except ReplicaUnreachable as exc:
                 self._note_stream_reroute(replica.rid, str(exc))
                 continue
             if call.status in (429, 503) and shed is None \
                     and attempt == 0:
-                # backpressure before any bytes reached the client:
-                # honor Retry-After and try the next-best replica once,
-                # exactly like the non-streamed path
-                self.replicas.note_backoff(
-                    replica.rid,
-                    parse_retry_after(call.header("Retry-After")))
+                if self._note_shed(replica.rid,
+                                   call.header("Retry-After"),
+                                   call.header("X-Tenant-Shed")):
+                    # per-tenant shed: relay it as-is — no spill to a
+                    # second replica (the tenant would double its quota
+                    # by hopping), replica stays in rotation
+                    return replica, call, [], tokens
+                # global backpressure before any bytes reached the
+                # client: the backoff landed in _note_shed; try the
+                # next-best replica once, like the non-streamed path
                 self._obs["router_reroutes_total"].labels(
                     reason="backpressure").inc()
                 self.event_log.emit("router_reroute",
@@ -518,6 +647,13 @@ class RouterServer:
                 shed = (replica, call)
                 continue
             if call.status != 200:
+                if call.status in (429, 503):
+                    # second-attempt shed (the one permitted re-route
+                    # also shed): honored here so the relay layer only
+                    # relays
+                    self._note_shed(replica.rid,
+                                    call.header("Retry-After"),
+                                    call.header("X-Tenant-Shed"))
                 if shed is not None:
                     self.replicas.untrack(shed[0].rid, tokens)
                     shed[1].close()
@@ -559,6 +695,12 @@ class RouterServer:
         routable = len(self.replicas.routable())
         self._obs["router_replicas_routable"].set(routable)
         status = 200 if routable and not self.draining.is_set() else 503
+        autoscale = self.replicas.update_autoscale()
+        autoscale["replicas_routable"] = routable
+        with self._tenant_lock:
+            autoscale["demand_inflight"] = sum(
+                self._tenant_inflight.values())
+            tenants = dict(self._tenant_inflight)
         return status, {
             "status": ("draining" if self.draining.is_set()
                        else "ok" if routable else "no_replicas"),
@@ -568,6 +710,12 @@ class RouterServer:
                       "delay_ms": round(self.hedge_delay_s() * 1000.0, 1)},
             "affinity_tokens": self.affinity_tokens,
             "inflight_cap": self.inflight_cap,
+            # the closed-loop capacity signal, in one JSON block an
+            # HPA external-metrics adapter (or a human) can read:
+            # free headroom vs demand, worst queue delay, and what the
+            # Prometheus families expose continuously
+            "autoscale": autoscale,
+            "tenants_inflight": tenants,
         }
 
 
@@ -610,13 +758,14 @@ def _make_handler(router: RouterServer):
             self.end_headers()
             self.wfile.write(body)
 
-        def _stream(self, req: dict):
+        def _stream(self, req: dict, tenant=None):
             """Relay a replica's SSE stream byte-for-byte. Failures
             before the first event already failed over inside
             open_stream; once bytes flow, a death surfaces as an error
             event + [DONE] — never a silent replay from another
             replica."""
-            replica, call, first_lines, tokens = router.open_stream(req)
+            replica, call, first_lines, tokens = router.open_stream(
+                req, tenant=tenant)
             if call is None:
                 return self._reply(
                     503, {"error": "no routable replica for the stream",
@@ -625,14 +774,17 @@ def _make_handler(router: RouterServer):
             try:
                 if call.status != 200:
                     # replica rejected before streaming (400/429/503):
-                    # relay its JSON verdict + headers verbatim
+                    # relay its JSON verdict + headers verbatim (shed
+                    # backoff / tenant accounting already folded in by
+                    # open_stream — this layer only relays)
                     out = call.read_json()
                     hdrs = ()
                     ra = call.header("Retry-After")
                     if ra is not None:
-                        router.replicas.note_backoff(
-                            replica.rid, parse_retry_after(ra))
-                        hdrs = (("Retry-After", ra),)
+                        hdrs += (("Retry-After", ra),)
+                    ts = call.header("X-Tenant-Shed")
+                    if ts is not None:
+                        hdrs += (("X-Tenant-Shed", ts),)
                     router._count(replica.rid,
                                   "shed" if call.status in (429, 503)
                                   else "client_error"
@@ -717,10 +869,16 @@ def _make_handler(router: RouterServer):
             if not isinstance(req, dict):
                 return self._reply(400, {"error": "body must be a JSON "
                                                   "object"})
+            tenant = router.tenant_of(req, self.headers.get("X-Tenant"))
             try:
                 if self.path == "/v1/generate" and req.get("stream"):
-                    return self._stream(req)
-                status, out, hdrs = router.route_json(self.path, req)
+                    router._tenant_enter(tenant)
+                    try:
+                        return self._stream(req, tenant=tenant)
+                    finally:
+                        router._tenant_exit(tenant)
+                status, out, hdrs = router.route_json(self.path, req,
+                                                      tenant=tenant)
             except OSError as exc:
                 # replica-side transport errors all surface as
                 # ReplicaUnreachable, so a raw OSError here is the

@@ -127,3 +127,120 @@ def post_generate(base_url: str, prompt: str, max_new_tokens: int = 6,
         headers={"Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=timeout_s) as resp:
         return json.loads(resp.read())
+
+
+def post_tenant(base_url: str, prompt: str, tenant: str,
+                max_new_tokens: int = 6, timeout_s: float = 120.0):
+    """One tenant-tagged generate that NEVER raises on an HTTP error
+    verdict: returns ``(status, body, latency_ms)`` — 429s are data to
+    the fairness scenarios, not exceptions. Transport failures return
+    status 0 with the error string in the body."""
+    import urllib.error
+
+    req = urllib.request.Request(
+        base_url + "/v1/generate",
+        data=json.dumps({"prompts": [prompt],
+                         "max_new_tokens": max_new_tokens}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Tenant": tenant})
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            body = json.loads(resp.read())
+            status = resp.status
+    except urllib.error.HTTPError as exc:
+        try:
+            body = json.loads(exc.read() or b"{}")
+        except ValueError:
+            body = {}
+        body.setdefault("retry_after", exc.headers.get("Retry-After"))
+        body.setdefault("tenant_shed", exc.headers.get("X-Tenant-Shed"))
+        status = exc.code
+    except Exception as exc:  # noqa: BLE001 — transport failure is an
+        #   outcome the scenarios assert on, not a crash
+        return 0, {"error": repr(exc)}, (time.monotonic() - t0) * 1000.0
+    return status, body, (time.monotonic() - t0) * 1000.0
+
+
+def run_noisy_neighbor(url: str, *, light_requests: int = 10,
+                       light_budget: int = 6, flood_threads: int = 3,
+                       flood_budget: int = 12,
+                       light_prompt: str = "light request",
+                       mid_flood_hook=None,
+                       timeout_s: float = 120.0) -> dict:
+    """THE noisy-neighbor scenario, shared by ``tools/smoke_check.py
+    --fairness`` and the slow chaos soak in ``tests/test_fairness.py``:
+    ``flood_threads`` greedy "noisy"-tenant loops hammer ``url`` while
+    the "light" tenant runs ``light_requests`` serial generates.
+    ``mid_flood_hook`` (optional) fires once, halfway through the light
+    sequence — the scale-up/down injection point (start or SIGKILL a
+    replica). Returns per-tenant outcome tallies + the light tenant's
+    latency list; every request reaches a terminal outcome before this
+    returns (the flood stops and joins)."""
+    import threading
+
+    out = {
+        "light": {"ok": 0, "lat_ms": [], "errors": []},
+        "noisy": {"ok": 0, "tenant_429": 0, "other_429": 0,
+                  "shed_503": 0, "errors": []},
+        "noisy_attempts": 0,
+    }
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def flood(i: int):
+        n = 0
+        while not stop.is_set():
+            status, body, _dt = post_tenant(
+                url, f"noisy {i} {n}", "noisy",
+                max_new_tokens=flood_budget, timeout_s=timeout_s)
+            n += 1
+            with lock:
+                out["noisy_attempts"] += 1
+                if status == 200:
+                    out["noisy"]["ok"] += 1
+                elif status == 429 and (
+                        str(body.get("reason", "")).startswith("tenant_")
+                        or body.get("tenant_shed")):
+                    out["noisy"]["tenant_429"] += 1
+                elif status == 429:
+                    out["noisy"]["other_429"] += 1
+                elif status == 503:
+                    # router/replica drain or no-replica blips during a
+                    # scale event: terminal, counted, not a loss
+                    out["noisy"]["shed_503"] += 1
+                else:
+                    out["noisy"]["errors"].append((status, str(body)[:200]))
+            if status == 429:
+                time.sleep(0.05)  # a real client honors Retry-After;
+                #   a zero-sleep loop would just measure socket churn
+
+    threads = [threading.Thread(target=flood, args=(i,), daemon=True)
+               for i in range(flood_threads)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(light_requests):
+            if mid_flood_hook is not None and i == light_requests // 2:
+                mid_flood_hook()
+            status, body, dt = post_tenant(
+                url, f"{light_prompt} {i}", "light",
+                max_new_tokens=light_budget, timeout_s=timeout_s)
+            if status == 200:
+                out["light"]["ok"] += 1
+                out["light"]["lat_ms"].append(dt)
+            else:
+                out["light"]["errors"].append((status, str(body)[:200]))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=timeout_s)
+    return out
+
+
+def percentile(xs, q: float) -> float:
+    """Nearest-rank percentile of a latency list (0 when empty)."""
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * (len(xs) - 1)))]

@@ -95,6 +95,14 @@ class _Request:
     max_new_tokens: int
     tokens: List[int] = field(default_factory=list)
     done: bool = False
+    # multi-tenant fairness: every request belongs to a tenant (the
+    # serving front defaults absent ids to "default"); the DWRR
+    # admission scheduler arbitrates between tenants' subqueues and
+    # the front's quota buckets charge/refund per tenant
+    tenant: str = "default"
+    # time.monotonic() at submit — /loadz queue_delay_ms (the HPA
+    # latency signal) is the age of the OLDEST queued request
+    enqueued_at: float = 0.0
     # streaming: called with each newly decoded token group, on the
     # engine's driver thread (keep it cheap — enqueue and return)
     on_tokens: Optional[callable] = None
@@ -484,6 +492,106 @@ class RadixPrefixCache:
                 "misses": self.misses, "hit_tokens": self.hit_tokens,
                 "evictions": self.evictions,
                 "recent_hit_rate": round(self.recent_hit_rate, 4)}
+
+
+def _request_cost(req: "_Request") -> int:
+    """A request's token footprint for fair-share accounting: prompt +
+    full generation budget — the same upper bound bounded admission and
+    the quota buckets charge (refunds reconcile unused budget later;
+    the scheduler must arbitrate on the worst case it admits)."""
+    return int(req.prompt.size) + int(req.max_new_tokens)
+
+
+class DwrrScheduler:
+    """Deficit-weighted round robin over per-tenant subqueues.
+
+    Each tenant's subqueue is its arrival-ordered subsequence of the
+    engine's admission queue (FIFO or LPT within a tenant — whatever
+    the engine's ``schedule`` produced). Every rotation visit banks
+    ``quantum * weight`` tokens of deficit; a tenant may admit its
+    head-of-line request when its deficit covers the request's token
+    cost (prompt + budget), paying the cost down on admission. Over a
+    saturated queue the admitted-token shares converge to the weight
+    ratio regardless of request sizes — the classic DWRR guarantee —
+    while an idle tenant's unused deficit is dropped the moment its
+    subqueue empties (no banking credit while absent, so a returning
+    tenant cannot burst past its share).
+
+    Pure host-side bookkeeping (no device state): the engine consults
+    :meth:`pick` only once it has actually seen two distinct tenants —
+    a single-tenant engine never enters this class and keeps the exact
+    pre-fairness FIFO/LPT admission order (the FIFO-equivalent fast
+    path the cb bench pins)."""
+
+    def __init__(self, weights: Optional[Dict[str, float]] = None,
+                 quantum: int = 256):
+        if quantum < 1:
+            raise ValueError(f"quantum must be >= 1, got {quantum}")
+        self.weights: Dict[str, float] = {}
+        for name, w in (weights or {}).items():
+            w = float(w)
+            if w <= 0:
+                raise ValueError(
+                    f"tenant {name!r} weight must be > 0, got {w}")
+            self.weights[name] = w
+        self.quantum = int(quantum)
+        self._deficit: Dict[str, float] = {}
+        self._rr: Deque[str] = deque()  # rotation over queued tenants
+        # cumulative admitted token cost per tenant (stats + the
+        # share-convergence tests' observable)
+        self.admitted_tokens: Dict[str, int] = {}
+
+    def weight(self, tenant: str) -> float:
+        """Configured weight; unknown tenants fall back to the ``*``
+        wildcard entry, then 1.0 — an unconfigured tenant competes at
+        baseline weight instead of being refused."""
+        w = self.weights.get(tenant)
+        if w is None:
+            w = self.weights.get("*", 1.0)
+        return float(w)
+
+    def pick(self, queue: List["_Request"]) -> int:
+        """Index into ``queue`` of the request to admit next. The
+        rotation/deficit state persists across calls; tenants that
+        left the queue are dropped (deficit reset — no banking)."""
+        heads: Dict[str, int] = {}
+        for i, req in enumerate(queue):
+            if req.tenant not in heads:
+                heads[req.tenant] = i
+        if len(heads) <= 1:
+            return 0  # one tenant queued: its own order stands
+        present = set(heads)
+        for t in list(self._deficit):
+            if t not in present:
+                del self._deficit[t]
+        if any(t not in present for t in self._rr):
+            self._rr = deque(t for t in self._rr if t in present)
+        for t in heads:  # first-appearance order joins at the back
+            if t not in self._rr:
+                self._rr.append(t)
+        # rotate, banking quanta, until a head-of-line is affordable;
+        # bounded: each full rotation banks quantum*weight for every
+        # tenant and costs are bounded by max_seq_len, so the guard is
+        # never the exit in practice — it exists so a pathological
+        # weight/quantum config degrades to round-robin, not a wedge
+        for _ in range(10000):
+            t = self._rr[0]
+            cost = _request_cost(queue[heads[t]])
+            if self._deficit.get(t, 0.0) >= cost:
+                return heads[t]
+            self._deficit[t] = (self._deficit.get(t, 0.0)
+                                + self.quantum * self.weight(t))
+            self._rr.rotate(-1)
+        return heads[self._rr[0]]
+
+    def charge(self, req: "_Request") -> None:
+        """Pay one admitted request's cost down from its tenant's
+        deficit and tally it (the share the convergence tests
+        measure)."""
+        t = req.tenant
+        cost = _request_cost(req)
+        self._deficit[t] = self._deficit.get(t, 0.0) - cost
+        self.admitted_tokens[t] = self.admitted_tokens.get(t, 0) + cost
 
 
 def _seed_key_data(seed) -> jnp.ndarray:
@@ -1186,7 +1294,9 @@ class ContinuousEngine:
                  pipeline_depth: int = 0,
                  adaptive_chunk: bool = False,
                  batch_admit: bool = True,
-                 schedule: str = "fifo", obs=None):
+                 schedule: str = "fifo",
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 obs=None):
         if num_slots < 1 or chunk < 1:
             raise ValueError("num_slots and chunk must be >= 1")
         if schedule not in ("fifo", "longest"):
@@ -1355,6 +1465,15 @@ class ContinuousEngine:
             self._page_bytes_per_layer = per_page
         self._rid = itertools.count()
         self._queue: List[_Request] = []
+        # -- multi-tenant fairness: DWRR over per-tenant subqueues ----------
+        # The scheduler is consulted only once TWO distinct tenants have
+        # actually submitted (``_fair_active``): a single-tenant engine —
+        # including every pre-tenancy caller — admits in the exact
+        # FIFO/LPT order it always did, at zero extra cost per step (the
+        # cb bench's FIFO-equivalent fast path).
+        self._fair = DwrrScheduler(tenant_weights)
+        self._first_tenant: Optional[str] = None
+        self._fair_active = False
         self._slots: Dict[int, _Request] = {}
         # piecewise admission in flight (chunked prefill): at most one,
         # holding its reserved slot + the partially-built cache tree
@@ -1386,7 +1505,8 @@ class ContinuousEngine:
     def submit(self, prompt_ids, max_new_tokens: int,
                on_tokens=None, temperature: float = 0.0,
                top_p: Optional[float] = None, seed: int = 0,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               tenant: str = "default") -> int:
         if temperature and temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if deadline_s is not None and deadline_s <= 0:
@@ -1428,9 +1548,16 @@ class ContinuousEngine:
                     f"request needs {need} KV pages but the pool has "
                     f"{total} (page_size "
                     f"{self.model.cfg.kv_page_size})")
+        tenant = str(tenant) or "default"
+        if self._first_tenant is None:
+            self._first_tenant = tenant
+        elif not self._fair_active and tenant != self._first_tenant:
+            self._fair_active = True  # two distinct tenants seen: the
+            #   DWRR picker (and its queue scan) engages from here on
         req = _Request(next(self._rid), prompt, max_new_tokens,
                        on_tokens=on_tokens, temperature=float(temperature),
-                       top_p=top_p, seed=int(seed),
+                       top_p=top_p, seed=int(seed), tenant=tenant,
+                       enqueued_at=time.monotonic(),
                        deadline=(time.monotonic() + float(deadline_s)
                                  if deadline_s is not None else None))
         if self.schedule == "longest":
@@ -2204,6 +2331,12 @@ class ContinuousEngine:
                 # they must cool the recent window like any other miss
                 self.radix.note(0)
         del self._queue[:k]
+        for req in group:
+            # per-tenant admitted-token tally (stats parity with the
+            # solo path; batch admit only runs single-tenant)
+            self._fair.admitted_tokens[req.tenant] = (
+                self._fair.admitted_tokens.get(req.tenant, 0)
+                + _request_cost(req))
         self._n_batch_admits += k
 
     def _expire_deadlines(self) -> List[_Request]:
@@ -2264,16 +2397,60 @@ class ContinuousEngine:
             return self.prefix_cache.capacity
         return 8 if self.radix is not None else 0
 
-    def queue_depth(self) -> int:
-        """Requests waiting for a slot (admission queue length)."""
-        return len(self._queue)
+    def queue_depth(self, tenant: Optional[str] = None) -> int:
+        """Requests waiting for a slot (admission queue length);
+        ``tenant`` filters to one tenant's subqueue (the per-tenant
+        queue-share shed check)."""
+        if tenant is None:
+            return len(self._queue)
+        return sum(1 for r in self._queue if r.tenant == tenant)
 
-    def queued_tokens(self) -> int:
+    def queued_tokens(self, tenant: Optional[str] = None) -> int:
         """Token footprint of the admission queue: prompt + budget per
         queued request (the bound ``max_queued_tokens`` shedding uses —
-        an upper bound on the KV the queue will claim)."""
-        return sum(int(r.prompt.size) + int(r.max_new_tokens)
-                   for r in self._queue)
+        an upper bound on the KV the queue will claim). ``tenant``
+        filters to one subqueue."""
+        return sum(_request_cost(r) for r in self._queue
+                   if tenant is None or r.tenant == tenant)
+
+    def outstanding_requests(self) -> List[_Request]:
+        """Every request the engine has accepted but not yet delivered
+        (queued, in-slot, mid-admission; ``done`` ones excluded). The
+        serving front settles these — quota refunds — when a failed
+        device step forces an engine rebuild: their charges would
+        otherwise leak with the dead engine."""
+        out = [r for r in self._queue if not r.done]
+        out += [r for r in self._slots.values() if not r.done]
+        if (self._admitting is not None
+                and not self._admitting["req"].done):
+            out.append(self._admitting["req"])
+        return out
+
+    def queue_delay_ms(self) -> float:
+        """Age of the OLDEST queued request in milliseconds (0 when the
+        queue is empty) — the replica-side admission-delay term of the
+        autoscale signal (/loadz ``queue_delay_ms``)."""
+        if not self._queue:
+            return 0.0
+        oldest = min(r.enqueued_at for r in self._queue)
+        return max(0.0, (time.monotonic() - oldest) * 1000.0)
+
+    def tenant_stats(self) -> Dict[str, dict]:
+        """Per-tenant snapshot: subqueue depth/footprint + cumulative
+        admitted token cost (what the DWRR shares converge over)."""
+        out: Dict[str, dict] = {}
+        for r in self._queue:
+            t = out.setdefault(r.tenant,
+                               {"queued": 0, "queued_tokens": 0,
+                                "admitted_tokens": 0})
+            t["queued"] += 1
+            t["queued_tokens"] += _request_cost(r)
+        for tenant, adm in self._fair.admitted_tokens.items():
+            t = out.setdefault(tenant,
+                               {"queued": 0, "queued_tokens": 0,
+                                "admitted_tokens": 0})
+            t["admitted_tokens"] = int(adm)
+        return out
 
     def _admit_waiting(self) -> None:
         reserved = (self._admitting["slot"]
@@ -2281,18 +2458,33 @@ class ContinuousEngine:
         free = [s for s in range(self.num_slots)
                 if s not in self._slots and s != reserved]
         if (self.batch_admit and len(free) >= 2 and len(self._queue) >= 2
-                and not self.announce and self._admitting is None):
+                and not self.announce and self._admitting is None
+                and not self._fair_active):
             # the batched prefill is not on the OP_CB_* wire — announce
             # mode keeps the per-request ops (same single-host gate as
-            # the prefix cache and chunked prefill)
+            # the prefix cache and chunked prefill). A multi-tenant
+            # queue also skips it: the batch takes the QUEUE PREFIX,
+            # which would let one tenant's burst jump the DWRR order.
             self._admit_batch(free)
             free = [s for s in range(self.num_slots)
                     if s not in self._slots and s != reserved]
         while free and self._queue:
-            if not self._try_admit(free[0], self._queue[0]):
-                break  # piecewise admission busy; FIFO holds
+            # single tenant: index 0 — the exact pre-fairness FIFO/LPT
+            # order. Multi-tenant: the DWRR pick arbitrates between the
+            # tenants' subqueues by weighted deficit.
+            idx = self._fair.pick(self._queue) if self._fair_active else 0
+            req = self._queue[idx]
+            if not self._try_admit(free[0], req):
+                break  # piecewise admission busy / pool dry; the pick
+                #        (and its banked deficit) holds for next step
             free.pop(0)
-            self._queue.pop(0)
+            self._queue.pop(idx)
+            if self._fair_active:
+                self._fair.charge(req)
+            else:
+                self._fair.admitted_tokens[req.tenant] = (
+                    self._fair.admitted_tokens.get(req.tenant, 0)
+                    + _request_cost(req))
             self._n_solo_admits += 1
 
     # -- the loop --------------------------------------------------------
@@ -2506,6 +2698,9 @@ class ContinuousEngine:
         return {
             "queued": len(self._queue),
             "queued_tokens": self.queued_tokens(),
+            "queue_delay_ms": round(self.queue_delay_ms(), 2),
+            "tenants": self.tenant_stats(),
+            "fair_active": self._fair_active,
             "active": len(self._slots),
             "finished": self._n_finished,
             "deadline_expired": self._n_deadline_expired,

@@ -46,6 +46,7 @@ import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -75,15 +76,21 @@ def _bucket(n: int, cap: int) -> int:
 
 class RequestRejected(RuntimeError):
     """Load-shed / drain rejection BEFORE any device work: maps to HTTP
-    429 (``queue_full``) or 503 (``draining``) with a ``Retry-After``
-    header — overload degrades to fast rejection, not collapse."""
+    429 (``queue_full``, ``tenant_quota``, ``tenant_queue_full``) or
+    503 (``draining``) with a ``Retry-After`` header — overload
+    degrades to fast rejection, not collapse. ``tenant`` is set on
+    PER-TENANT sheds (quota / queue share): the handler surfaces it as
+    the ``X-Tenant-Shed`` response header so the router knows the
+    verdict is about one tenant, not replica health — no backoff, no
+    re-route, no DOWN marking."""
 
     def __init__(self, reason: str, message: str, status: int,
-                 retry_after_s: int = 1):
+                 retry_after_s: int = 1, tenant: Optional[str] = None):
         super().__init__(message)
         self.reason = reason
         self.status = int(status)
         self.retry_after_s = int(retry_after_s)
+        self.tenant = tenant
 
 
 def _draining_rejection() -> RequestRejected:
@@ -94,6 +101,145 @@ def _draining_rejection() -> RequestRejected:
         "draining",
         "server is draining (shutting down); retry against a live "
         "replica", status=503, retry_after_s=5)
+
+
+class TokenBucket:
+    """Refillable token-rate quota for ONE tenant: ``rate`` tokens/sec
+    refill up to ``burst``. Admission charges the request's worst-case
+    footprint (prompt + max_new_tokens) via :meth:`try_take`; the front
+    refunds the UNUSED generation budget when the request delivers —
+    so a quota shed can only ever happen at admission, never
+    mid-stream (the charge already covers the whole generation).
+    Thread-safe: handler threads take, the driver thread refunds."""
+
+    def __init__(self, rate_per_s: float, burst: float):
+        if rate_per_s <= 0:
+            raise ValueError(f"rate must be > 0, got {rate_per_s}")
+        if burst < 1:
+            raise ValueError(f"burst must be >= 1, got {burst}")
+        self.rate = float(rate_per_s)
+        self.burst = float(burst)
+        self._level = float(burst)  # start full: a fresh server must
+        #   not 429 its first request
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def _refill(self, now: float) -> None:
+        self._level = min(self.burst,
+                          self._level + (now - self._last) * self.rate)
+        self._last = now
+
+    def try_take(self, n: float) -> bool:
+        with self._lock:
+            self._refill(time.monotonic())
+            if self._level >= n:
+                self._level -= n
+                return True
+            return False
+
+    def refund(self, n: float) -> None:
+        """Return unused charge (clamped to ``burst`` — a refund can
+        never bank more than the bucket holds)."""
+        if n <= 0:
+            return
+        with self._lock:
+            self._refill(time.monotonic())
+            self._level = min(self.burst, self._level + float(n))
+
+    def retry_after_s(self, n: float) -> int:
+        """Whole seconds until ``n`` tokens will be available at the
+        refill rate — the per-tenant ``Retry-After`` a quota shed
+        carries (computed from THIS tenant's own bucket, not a global
+        constant)."""
+        with self._lock:
+            self._refill(time.monotonic())
+            if self._level >= n:
+                return 1
+            need = min(float(n), self.burst) - self._level
+        return max(1, int(-(-need // self.rate)))
+
+    @property
+    def level(self) -> float:
+        with self._lock:
+            self._refill(time.monotonic())
+            return self._level
+
+
+def parse_tenant_spec(spec) -> Optional[Dict[str, dict]]:
+    """Parse the ``--tenants`` / ``SERVE_TENANTS`` spec into
+    ``{tenant: {"weight": float, "rate": float|None, "burst": float}}``.
+
+    Two forms:
+
+    * JSON object — ``{"light": {"weight": 3},
+      "noisy": {"weight": 1, "rate": 200, "burst": 400}}``;
+    * compact — ``light=3,noisy=1:200:400`` i.e.
+      ``name=weight[:rate[:burst]]``.
+
+    ``weight`` drives the engine's DWRR admission share and the
+    per-tenant slice of ``--max-queue-depth`` / ``--max-queued-tokens``.
+    ``rate`` (tokens/sec, absent = unmetered) + ``burst`` (default
+    2x rate) build the tenant's :class:`TokenBucket`. A ``"*"`` entry
+    sets the defaults for tenants not named in the spec. Empty/None
+    spec -> None (tenancy off: the pre-tenancy single-queue
+    behavior)."""
+    if not spec:
+        return None
+    if isinstance(spec, dict):
+        raw = spec
+    else:
+        spec = str(spec).strip()
+        if spec.startswith("{"):
+            raw = json.loads(spec)
+            if not isinstance(raw, dict):
+                raise ValueError(f"tenant spec must be a JSON object, "
+                                 f"got {type(raw).__name__}")
+        else:
+            raw = {}
+            for part in spec.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                name, _, rest = part.partition("=")
+                if not name or not rest:
+                    raise ValueError(
+                        f"bad tenant spec entry {part!r} (want "
+                        "name=weight[:rate[:burst]])")
+                fields = rest.split(":")
+                entry: dict = {"weight": float(fields[0])}
+                if len(fields) > 1 and fields[1]:
+                    entry["rate"] = float(fields[1])
+                if len(fields) > 2 and fields[2]:
+                    entry["burst"] = float(fields[2])
+                if len(fields) > 3:
+                    raise ValueError(
+                        f"bad tenant spec entry {part!r}: too many "
+                        "fields")
+                raw[name.strip()] = entry
+    out: Dict[str, dict] = {}
+    for name, entry in raw.items():
+        if not isinstance(entry, dict):
+            entry = {"weight": entry}  # {"light": 3} shorthand
+        weight = float(entry.get("weight", 1.0))
+        if weight <= 0:
+            raise ValueError(
+                f"tenant {name!r} weight must be > 0, got {weight}")
+        rate = entry.get("rate")
+        rate = float(rate) if rate is not None else None
+        if rate is not None and rate <= 0:
+            raise ValueError(
+                f"tenant {name!r} rate must be > 0, got {rate}")
+        burst = entry.get("burst")
+        burst = (float(burst) if burst is not None
+                 else (2.0 * rate if rate is not None else None))
+        unknown = set(entry) - {"weight", "rate", "burst"}
+        if unknown:
+            raise ValueError(
+                f"tenant {name!r}: unknown field(s) {sorted(unknown)}")
+        out[str(name)] = {"weight": weight, "rate": rate, "burst": burst}
+    if not out:
+        return None
+    return out
 
 
 class DeadlineExceeded(RuntimeError):
@@ -121,12 +267,26 @@ class _ContinuousFront:
                  pipeline_depth: int = 0, adaptive_chunk: bool = False,
                  schedule: str = "fifo", obs=None, event_log=None,
                  max_queue_depth: int = 0, max_queued_tokens: int = 0,
-                 chaos=None, heartbeat=None):
+                 chaos=None, heartbeat=None, tenants=None):
+        # multi-tenant fairness/quotas: parsed spec (parse_tenant_spec
+        # output or an equivalent dict), or None = tenancy off (every
+        # request rides the "default" tenant; admission bounds stay
+        # GLOBAL, exactly the pre-tenancy behavior)
+        self._tenants = parse_tenant_spec(tenants)
+        self._tenant_weights = ({name: cfg["weight"]
+                                 for name, cfg in self._tenants.items()}
+                                if self._tenants else None)
+        self._buckets: Dict[str, TokenBucket] = {}
+        if self._tenants:
+            for name, cfg in self._tenants.items():
+                if cfg["rate"] is not None:
+                    self._buckets[name] = TokenBucket(cfg["rate"],
+                                                      cfg["burst"])
         self._engine_args = (model, params, eos_id, num_slots, chunk,
                              mesh, announce, prefix_cache_size,
                              prefill_chunk, step_token_budget,
                              pipeline_depth, adaptive_chunk,
-                             schedule)
+                             schedule, self._tenant_weights)
         self._announce = announce
         self._obs = obs if obs is not None else platform_families()
         self._event_log = (event_log if event_log is not None
@@ -161,7 +321,8 @@ class _ContinuousFront:
 
         (model, params, eos_id, num_slots, chunk, mesh, announce,
          prefix_cache_size, prefill_chunk, step_token_budget,
-         pipeline_depth, adaptive_chunk, schedule) = self._engine_args
+         pipeline_depth, adaptive_chunk, schedule,
+         tenant_weights) = self._engine_args
         return ContinuousEngine(model, params, num_slots=num_slots,
                                 chunk=chunk, eos_token_id=eos_id,
                                 mesh=mesh, announce=announce,
@@ -170,61 +331,235 @@ class _ContinuousFront:
                                 step_token_budget=step_token_budget,
                                 pipeline_depth=pipeline_depth,
                                 adaptive_chunk=adaptive_chunk,
-                                schedule=schedule, obs=self._obs)
+                                schedule=schedule,
+                                tenant_weights=tenant_weights,
+                                obs=self._obs)
 
-    def _check_admission(self, prompt_len: int,
-                         max_new_tokens: int) -> None:
+    # -- tenancy helpers -------------------------------------------------
+
+    def resolve_tenant(self, tenant: Optional[str]) -> str:
+        """Normalize a CLIENT-SUPPLIED tenant id to the identity the
+        fairness machinery runs on. No ``--tenants`` spec: always
+        "default" — untrusted X-Tenant values must not be able to flip
+        the engine out of its single-tenant FIFO/batch-admit fast path
+        or mint unbounded metric label values on an unconfigured
+        server. With a spec: ids named in it pass through; everything
+        else folds into the ONE ``*`` aggregate — unlisted ids share a
+        slice, a quota bucket and a label, so rotating fabricated
+        tenant names gains an attacker nothing (no per-id queue share,
+        no per-id state growth). Isolation is something you configure
+        by naming the tenant."""
+        if self._tenants is None:
+            return "default"
+        t = str(tenant) if tenant else "default"
+        return t if (t in self._tenants and t != "*") else "*"
+
+    def _tenant_share(self, tenant: str, bound: int) -> int:
+        """This (resolved) tenant's weight-proportional slice of a
+        global admission bound (``max_queue_depth`` /
+        ``max_queued_tokens``). The denominator is the sum of ALL spec
+        weights (an explicit ``*`` entry included); a spec without
+        ``*`` gives the unlisted-tenant aggregate an implicit weight
+        1.0 that widens only its OWN denominator — named tenants keep
+        their natural shares, and every fabricated id shares the one
+        aggregate slice, so shares sum to ~the bound regardless of how
+        many ids a client invents."""
+        cfgs = self._tenants
+        total = sum(c["weight"] for c in cfgs.values())
+        if tenant == "*" and "*" not in cfgs:
+            w = 1.0
+            total += w
+        else:
+            w = cfgs[tenant]["weight"]
+        return max(1, int(bound * w / max(total, w)))
+
+    def _bucket_for(self, tenant: str) -> Optional[TokenBucket]:
+        """The (resolved) tenant's quota bucket, or None (unmetered).
+        One bucket per SPEC ENTRY only — unlisted tenants were already
+        folded into ``*`` by :meth:`resolve_tenant`, so the bucket map
+        is bounded by the spec and the refund path can never miss a
+        bucket the charge path used."""
+        if not self._tenants:
+            return None
+        return self._buckets.get(tenant)
+
+    def _shed_tenant(self, tenant: str, reason: str, message: str,
+                     retry_after_s: int) -> None:
+        self._obs["serve_requests_rejected_total"].labels(
+            reason=reason).inc()
+        self._obs["serve_tenant_rejected_total"].labels(
+            tenant=tenant, reason=reason).inc()
+        raise RequestRejected(reason, message, status=429,
+                              retry_after_s=retry_after_s, tenant=tenant)
+
+    def charge_tokens(self, tenant: Optional[str], n: int) -> str:
+        """Charge ``n`` tokens of NON-ENGINE device work (the
+        whole-batch /v1/score path) against the tenant's quota bucket.
+        Exact work, charged up front, no refund. Returns the resolved
+        tenant; raises the same per-tenant 429 / terminal-400 taxonomy
+        as admission — a tenant throttled on generate must not
+        saturate the device unmetered through score."""
+        tenant = self.resolve_tenant(tenant)
+        bucket = self._bucket_for(tenant)
+        if bucket is None:
+            return tenant
+        if n > bucket.burst:
+            raise ValueError(
+                f"score batch of {n} tokens exceeds tenant {tenant!r} "
+                f"quota burst {bucket.burst:g} — split the batch")
+        if not bucket.try_take(n):
+            self._shed_tenant(
+                tenant, "tenant_quota",
+                f"tenant {tenant!r} token quota exhausted (score "
+                f"batch needs {n} tokens; refill {bucket.rate:g}/s)",
+                retry_after_s=bucket.retry_after_s(n))
+        return tenant
+
+    def _settle(self, req) -> None:
+        """One engine-delivered request's quota reconciliation: refund
+        the UNUSED generation budget to its tenant's bucket (charged as
+        prompt + max_new_tokens at admission, so a deadline expiry or
+        early eos returns the difference) and count delivered tokens.
+        Runs on the driver thread, once per delivery."""
+        bucket = self._buckets.get(req.tenant)
+        if bucket is not None:
+            unused = int(req.max_new_tokens) - len(req.tokens)
+            if unused > 0:
+                bucket.refund(unused)
+        if req.tokens:
+            self._obs["serve_tenant_tokens_total"].labels(
+                tenant=req.tenant).inc(len(req.tokens))
+
+    def _check_admission(self, prompt_len: int, max_new_tokens: int,
+                         tenant: str = "default") -> None:
         """Bounded admission + drain gate (caller holds ``self.lock``).
         Raises :class:`RequestRejected` — BEFORE the engine sees the
-        request, so shedding costs no device work and no KV pages."""
+        request, so shedding costs no device work and no KV pages.
+
+        Shed ordering: drain first (503 — replica lifecycle beats
+        everything), then the terminal footprint check (400), then —
+        with a ``--tenants`` spec — the PER-TENANT gates: queue share
+        (this tenant's weight-proportional slice of the global bounds)
+        and token-rate quota, each a 429 carrying the tenant and a
+        Retry-After computed from that tenant's own state. Without a
+        spec the global bounds apply verbatim (pre-tenancy behavior).
+        A tenant over its share/quota sheds while every other tenant
+        keeps admitting — the global queue never rejects a tenant that
+        is inside its own share."""
         if self.draining.is_set():
             self._obs["serve_requests_rejected_total"].labels(
                 reason="draining").inc()
             raise _draining_rejection()
+        ask = int(prompt_len) + int(max_new_tokens)
+        if self.max_queued_tokens and ask > self.max_queued_tokens:
+            # the request ALONE busts the budget: no amount of
+            # retrying can ever clear that — terminal 400 (caller
+            # error), not a 429 retry-forever loop
+            raise ValueError(
+                f"request footprint {ask} tokens (prompt + budget) "
+                f"exceeds max_queued_tokens {self.max_queued_tokens}")
+        if self._tenants is None:
+            if self.max_queue_depth:
+                depth = self.engine.queue_depth()
+                if depth >= self.max_queue_depth:
+                    self._obs["serve_requests_rejected_total"].labels(
+                        reason="queue_full").inc()
+                    raise RequestRejected(
+                        "queue_full",
+                        f"admission queue full ({depth} waiting >= "
+                        f"max_queue_depth {self.max_queue_depth})",
+                        status=429, retry_after_s=1)
+            if self.max_queued_tokens:
+                queued = self.engine.queued_tokens()
+                if queued + ask > self.max_queued_tokens:
+                    self._obs["serve_requests_rejected_total"].labels(
+                        reason="queue_full").inc()
+                    raise RequestRejected(
+                        "queue_full",
+                        f"queued-token budget exhausted ({queued} queued "
+                        f"+ {ask} requested > max_queued_tokens "
+                        f"{self.max_queued_tokens})",
+                        status=429, retry_after_s=1)
+            return
+        # -- per-tenant gates (tenancy configured) -----------------------
         if self.max_queue_depth:
-            depth = self.engine.queue_depth()
-            if depth >= self.max_queue_depth:
-                self._obs["serve_requests_rejected_total"].labels(
-                    reason="queue_full").inc()
-                raise RequestRejected(
-                    "queue_full",
-                    f"admission queue full ({depth} waiting >= "
+            share = self._tenant_share(tenant, self.max_queue_depth)
+            depth = self.engine.queue_depth(tenant)
+            if depth >= share:
+                self._shed_tenant(
+                    tenant, "tenant_queue_full",
+                    f"tenant {tenant!r} admission-queue share full "
+                    f"({depth} waiting >= share {share} of "
                     f"max_queue_depth {self.max_queue_depth})",
-                    status=429, retry_after_s=1)
+                    retry_after_s=1)
         if self.max_queued_tokens:
-            queued = self.engine.queued_tokens()
-            ask = int(prompt_len) + int(max_new_tokens)
-            if ask > self.max_queued_tokens:
-                # the request ALONE busts the budget: no amount of
-                # retrying can ever clear that — terminal 400 (caller
-                # error), not a 429 retry-forever loop
+            share = self._tenant_share(tenant, self.max_queued_tokens)
+            if ask > share:
                 raise ValueError(
-                    f"request footprint {ask} tokens (prompt + budget) "
-                    f"exceeds max_queued_tokens {self.max_queued_tokens}")
-            if queued + ask > self.max_queued_tokens:
-                self._obs["serve_requests_rejected_total"].labels(
-                    reason="queue_full").inc()
-                raise RequestRejected(
-                    "queue_full",
-                    f"queued-token budget exhausted ({queued} queued + "
-                    f"{ask} requested > max_queued_tokens "
+                    f"request footprint {ask} tokens exceeds tenant "
+                    f"{tenant!r} queued-token share {share}")
+            queued = self.engine.queued_tokens(tenant)
+            if queued + ask > share:
+                self._shed_tenant(
+                    tenant, "tenant_queue_full",
+                    f"tenant {tenant!r} queued-token share exhausted "
+                    f"({queued} queued + {ask} requested > share "
+                    f"{share} of max_queued_tokens "
                     f"{self.max_queued_tokens})",
-                    status=429, retry_after_s=1)
+                    retry_after_s=1)
+        bucket = self._bucket_for(tenant)
+        if bucket is not None:
+            if ask > bucket.burst:
+                raise ValueError(
+                    f"request footprint {ask} tokens exceeds tenant "
+                    f"{tenant!r} quota burst {bucket.burst:g} — it can "
+                    "never admit at any retry")
+            if not bucket.try_take(ask):
+                # Retry-After from THIS tenant's refill rate: the shed
+                # is a quota verdict about the tenant, and the header
+                # tells it exactly when its own bucket will cover the
+                # request — other tenants' admission is untouched
+                self._shed_tenant(
+                    tenant, "tenant_quota",
+                    f"tenant {tenant!r} token quota exhausted "
+                    f"(request needs {ask} tokens; refill "
+                    f"{bucket.rate:g}/s)",
+                    retry_after_s=bucket.retry_after_s(ask))
 
     def submit(self, prompt_ids, max_new_tokens: int,
                temperature: float = 0.0, top_p=None,
-               seed: int = 0, deadline_s=None) -> int:
+               seed: int = 0, deadline_s=None,
+               tenant: str = "default") -> int:
         """Queue a request (non-blocking); pair with ``wait``.
         ``deadline_s``: seconds from now the client still cares about
         the answer — past it the engine expires the request at the next
-        chunk boundary and ``wait`` raises :class:`DeadlineExceeded`."""
+        chunk boundary and ``wait`` raises :class:`DeadlineExceeded`.
+        ``tenant``: fairness/quota identity (header/body-extracted by
+        the HTTP layer; "default" when absent) — normalized here, so
+        unlisted ids fold into the ``*`` aggregate and a no-spec
+        server never sees anything but "default"."""
+        tenant = self.resolve_tenant(tenant)
         done = threading.Event()
         with self.lock:
-            self._check_admission(len(prompt_ids), max_new_tokens)
-            rid = self.engine.submit(prompt_ids, max_new_tokens,
-                                     temperature=temperature, top_p=top_p,
-                                     seed=seed, deadline_s=deadline_s)
+            self._check_admission(len(prompt_ids), max_new_tokens,
+                                  tenant=tenant)
+            try:
+                rid = self.engine.submit(prompt_ids, max_new_tokens,
+                                         temperature=temperature,
+                                         top_p=top_p, seed=seed,
+                                         deadline_s=deadline_s,
+                                         tenant=tenant)
+            except BaseException:
+                # the quota charge landed in _check_admission; a failed
+                # engine submit must hand it back or the tenant pays
+                # for a request that never queued
+                bucket = self._buckets.get(tenant)
+                if bucket is not None:
+                    bucket.refund(len(prompt_ids) + int(max_new_tokens))
+                raise
             self._results[rid] = [done, None, None]
+        self._obs["serve_tenant_requests_total"].labels(
+            tenant=tenant).inc()
         self.new_work.set()
         return rid
 
@@ -282,22 +617,36 @@ class _ContinuousFront:
             self._results.pop(rid, None)
 
     def submit_stream(self, prompt_ids, max_new_tokens: int,
-                      deadline_s=None):
+                      deadline_s=None, tenant: str = "default"):
         """Streaming variant: returns (rid, queue). The queue receives
         token-id lists as they decode, then a terminal item — [] on
         completion, an Exception on engine failure / deadline expiry /
         shutdown. The consumer must drain it (bounded: max_new_tokens
-        items + terminal)."""
+        items + terminal). Quota note: the tenant charge covers the
+        FULL budget at admission, so a stream can never be
+        quota-killed mid-flight — the unused remainder refunds at the
+        terminal delivery."""
         import queue as _queue
 
+        tenant = self.resolve_tenant(tenant)
         q = _queue.Queue()
         done = threading.Event()
         with self.lock:
-            self._check_admission(len(prompt_ids), max_new_tokens)
-            rid = self.engine.submit(prompt_ids, max_new_tokens,
-                                     on_tokens=q.put,
-                                     deadline_s=deadline_s)
+            self._check_admission(len(prompt_ids), max_new_tokens,
+                                  tenant=tenant)
+            try:
+                rid = self.engine.submit(prompt_ids, max_new_tokens,
+                                         on_tokens=q.put,
+                                         deadline_s=deadline_s,
+                                         tenant=tenant)
+            except BaseException:
+                bucket = self._buckets.get(tenant)
+                if bucket is not None:
+                    bucket.refund(len(prompt_ids) + int(max_new_tokens))
+                raise
             self._results[rid] = [done, None, q]  # same shape as submit
+        self._obs["serve_tenant_requests_total"].labels(
+            tenant=tenant).inc()
         self.new_work.set()
         return rid, q
 
@@ -327,6 +676,11 @@ class _ContinuousFront:
                         self._chaos.maybe_fail(self._chaos_step)
                     finished = self.engine.step() if busy else []
                     for req in finished:
+                        # quota refund + per-tenant token accounting for
+                        # every delivery (completion AND expiry) — a
+                        # deadline-expired request hands its unused
+                        # generation budget back to its tenant's bucket
+                        self._settle(req)
                         slot = self._results.get(req.rid)
                         if slot is not None:
                             if req.expired:
@@ -355,6 +709,16 @@ class _ContinuousFront:
                     self._event_log.emit(
                         "engine_rebuilt", inflight=len(self._results),
                         error=f"{type(exc).__name__}: {exc}"[:500])
+                    try:
+                        # the dead engine's accepted-but-undelivered
+                        # requests never reach step()'s delivery path:
+                        # settle them HERE or their quota charges leak
+                        # and the tenant pays 429s for work that was
+                        # never done
+                        for req in self.engine.outstanding_requests():
+                            self._settle(req)
+                    except Exception:  # noqa: BLE001 — refunds must
+                        pass           # not block the rebuild
                     for slot in self._results.values():
                         if slot[1] is None:
                             slot[1] = exc
@@ -444,7 +808,8 @@ class BundleServer:
                  adaptive_chunk: bool = False, schedule: str = "fifo",
                  registry=None, event_log=None,
                  max_queue_depth: int = 0, max_queued_tokens: int = 0,
-                 chaos_spec: str = "", heartbeat_file: str = ""):
+                 chaos_spec: str = "", heartbeat_file: str = "",
+                 tenants_spec: str = ""):
         from pyspark_tf_gke_tpu.data.text import get_tokenizer
         from pyspark_tf_gke_tpu.train.export import load_serving_bundle
         from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
@@ -562,7 +927,8 @@ class BundleServer:
                 event_log=self.event_log,
                 max_queue_depth=max_queue_depth,
                 max_queued_tokens=max_queued_tokens,
-                chaos=chaos, heartbeat=heartbeat)
+                chaos=chaos, heartbeat=heartbeat,
+                tenants=tenants_spec)
 
     # -- drain lifecycle -------------------------------------------------
 
@@ -643,7 +1009,11 @@ class BundleServer:
         router scores replicas by ``queued_tokens``/``active`` and
         gates on ``draining``; whole-batch servers (no slot engine)
         report zeros so the router can still rank them by in-flight
-        HTTP load."""
+        HTTP load. ``capacity_free`` (routable token headroom, the
+        tightest of the admission-token budget and the KV page pool),
+        ``queue_delay_ms`` (oldest queued request's age) and the
+        per-tenant ``tenants`` map feed the router's closed-loop
+        autoscale signal and per-tenant dashboards."""
         with self._inflight_lock:
             inflight_http = self._inflight_http
         out = {
@@ -659,6 +1029,11 @@ class BundleServer:
             # replica really holds instead of hashed ownership alone
             "prefix_cache_pages": 0,
             "prefix_hit_rate": 0.0,
+            # autoscale/tenancy terms (zeros for whole-batch servers:
+            # no admission queue to have headroom or delay in)
+            "capacity_free": 0,
+            "queue_delay_ms": 0.0,
+            "tenants": {},
         }
         if self._front is not None:
             stats = self._front.engine.stats
@@ -666,23 +1041,38 @@ class BundleServer:
             out["queued_tokens"] = stats["queued_tokens"]
             out["active"] = stats["active"]
             out["slots_total"] = stats["num_slots"]
+            out["queue_delay_ms"] = stats.get("queue_delay_ms", 0.0)
             paged = stats.get("paged")
             if paged:
                 out["kv_pages_free"] = (paged["pages_total"]
                                         - paged["pages_in_use"])
-            pc = stats.get("prefix_cache")
-            if pc:
-                out["prefix_cache_pages"] = int(
-                    pc.get("resident_pages", pc.get("entries", 0)))
-                if "recent_hit_rate" in pc:
-                    # radix: windowed over the last admissions, so the
-                    # router's spill allowance tracks what the cache
-                    # absorbs NOW, not its lifetime average
-                    out["prefix_hit_rate"] = pc["recent_hit_rate"]
-                else:  # dense LRU: cumulative is all it keeps
-                    asked = pc["hits"] + pc["misses"]
-                    out["prefix_hit_rate"] = (
-                        round(pc["hits"] / asked, 4) if asked else 0.0)
+            # routable token headroom: how many more prompt+budget
+            # tokens this replica would ADMIT right now — the tightest
+            # of the bounded-admission budget and (paged engines) the
+            # free KV pages' token extent; an unbounded dense engine
+            # falls back to free slots x max_seq_len (crude but
+            # monotone in real headroom)
+            caps = []
+            if self._front.max_queued_tokens:
+                caps.append(self._front.max_queued_tokens
+                            - stats["queued_tokens"])
+            if paged:
+                caps.append((paged["pages_total"]
+                             - paged["pages_in_use"])
+                            * paged["page_size"])
+            if not caps:
+                caps.append((stats["num_slots"] - stats["active"])
+                            * self.model.cfg.max_seq_len)
+            out["capacity_free"] = max(0, min(caps))
+            self._obs["serve_capacity_free_tokens"].set(
+                out["capacity_free"])
+            tenants = {}
+            for name, t in (stats.get("tenants") or {}).items():
+                tenants[name] = {"queued": t["queued"],
+                                 "queued_tokens": t["queued_tokens"]}
+                self._obs["serve_tenant_queue_depth"].labels(
+                    tenant=name).set(t["queued"])
+            out["tenants"] = tenants
         return out
 
     # -- generation ------------------------------------------------------
@@ -690,7 +1080,7 @@ class BundleServer:
     def generate(self, prompts, max_new_tokens: int = 64,
                  temperature: float = 0.0, top_k=None, top_p=None,
                  num_beams: int = 0, repetition_penalty=None,
-                 deadline_s=None) -> list:
+                 deadline_s=None, tenant: str = "default") -> list:
         """Batch completion. Prompts are grouped by token length so each
         group decodes as one batched call; the batch dimension pads up
         to power-of-2 buckets (repeating the first row) so mixed traffic
@@ -777,7 +1167,7 @@ class BundleServer:
                         ids, max_new_tokens, temperature=temp,
                         top_p=top_p,
                         seed=int.from_bytes(os.urandom(4), "little"),
-                        deadline_s=deadline_s)))
+                        deadline_s=deadline_s, tenant=tenant)))
             except Exception:
                 # a mid-batch rejection (queue filled between rows) must
                 # not strand the rows already submitted
@@ -904,7 +1294,7 @@ class BundleServer:
                     "prefix_cache")}
 
     def generate_stream(self, prompt: str, max_new_tokens: int = 64,
-                        deadline_s=None):
+                        deadline_s=None, tenant: str = "default"):
         """Greedy streaming completion through the slot engine: yields
         one event dict per decoded token group (``token_ids`` plus the
         full ``text`` so far — full text, not a delta, so multibyte
@@ -933,7 +1323,8 @@ class BundleServer:
         eos_id = getattr(self.tokenizer, "eos_id", None)
         t0 = time.perf_counter()
         rid, q = self._front.submit_stream(ids, max_new_tokens,
-                                           deadline_s=deadline_s)
+                                           deadline_s=deadline_s,
+                                           tenant=tenant)
         toks, finished, yielded = [], False, False
         try:
             while True:
@@ -1060,6 +1451,9 @@ class BundleServer:
             self._obs["serve_slots_total"].set(stats["num_slots"])
             self._obs["serve_slots_active"].set(stats["active"])
             self._obs["serve_queue_depth"].set(stats["queued"])
+            for name, t in (stats.get("tenants") or {}).items():
+                self._obs["serve_tenant_queue_depth"].labels(
+                    tenant=name).set(t["queued"])
 
     def metrics_text(self) -> str:
         """Prometheus exposition text: the full shared registry
@@ -1082,12 +1476,16 @@ class BundleServer:
 
     # -- scoring ---------------------------------------------------------
 
-    def score(self, texts) -> list:
+    def score(self, texts, tenant: str = "default") -> list:
         """Per-text total NLL in nats + scored token count. Texts longer
         than max_seq_len are truncated (reported via ``truncated``);
         texts shorter than 2 tokens have no next-token NLL and come back
         ``{"skipped": true, "tokens": 0}`` rather than failing the
-        batch (remote perplexity eval feeds arbitrary documents)."""
+        batch (remote perplexity eval feeds arbitrary documents).
+        With a ``--tenants`` spec, the batch's scored-token total is
+        charged against the tenant's quota bucket up front (exact
+        work, no refund) — score is not an unmetered side door around
+        a generate throttle."""
         if not texts:
             return []
         if len(texts) > MAX_BATCH:
@@ -1103,6 +1501,9 @@ class BundleServer:
                               "skipped": True}
                 continue
             rows.append((i, ids[:cap], len(ids) > cap))
+        if rows and self._front is not None:
+            self._front.charge_tokens(
+                tenant, sum(len(ids) for _, ids, _ in rows))
         if rows:
             lengths = [len(ids) for _, ids, _ in rows]
             seq_len = _bucket(max(lengths), cap)
@@ -1131,6 +1532,24 @@ class BundleServer:
 # -- HTTP plumbing -----------------------------------------------------------
 
 
+def _shed_headers(exc: RequestRejected):
+    """Response headers for one shed: Retry-After always; per-tenant
+    sheds also carry ``X-Tenant-Shed`` so the router can tell a tenant
+    verdict (surface it, keep the replica in rotation) from replica
+    overload (back the replica off)."""
+    hdrs = [("Retry-After", str(exc.retry_after_s))]
+    if getattr(exc, "tenant", None):
+        hdrs.append(("X-Tenant-Shed", str(exc.tenant)))
+    return tuple(hdrs)
+
+
+def _shed_body(exc: RequestRejected) -> dict:
+    body = {"error": str(exc), "reason": exc.reason}
+    if getattr(exc, "tenant", None):
+        body["tenant"] = exc.tenant
+    return body
+
+
 def _make_handler(server: BundleServer):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -1152,7 +1571,7 @@ def _make_handler(server: BundleServer):
             self.end_headers()
             self.wfile.write(body)
 
-        def _stream_generate(self, req, prompts):
+        def _stream_generate(self, req, prompts, tenant="default"):
             """Server-sent events: one ``data:`` line per token group,
             a terminal entry with the assembled completion, then
             ``data: [DONE]``. Greedy single-prompt only (that's the
@@ -1175,14 +1594,14 @@ def _make_handler(server: BundleServer):
                     prompts[0],
                     max_new_tokens=int(req.get("max_new_tokens", 64)),
                     deadline_s=(float(deadline_ms) / 1000.0
-                                if deadline_ms is not None else None))
+                                if deadline_ms is not None else None),
+                    tenant=tenant)
                 first = next(events)  # validation errors surface BEFORE
                 #   the 200 status line is committed
             except RequestRejected as exc:
                 server.record_metrics()
-                return self._reply(
-                    exc.status, {"error": str(exc), "reason": exc.reason},
-                    headers=(("Retry-After", str(exc.retry_after_s)),))
+                return self._reply(exc.status, _shed_body(exc),
+                                   headers=_shed_headers(exc))
             except (TypeError, ValueError) as exc:
                 server.record_metrics(failed=True)
                 return self._reply(400, {"error": str(exc)})
@@ -1287,6 +1706,17 @@ def _make_handler(server: BundleServer):
                     req, dict) else None
                 deadline_s = (float(deadline_ms) / 1000.0
                               if deadline_ms is not None else None)
+                # tenant identity: X-Tenant header wins, then the body
+                # field, then "default" — one extraction point shared
+                # by the blocking and streaming generate paths (the
+                # router forwards the same header)
+                tenant = self.headers.get("X-Tenant") or (
+                    req.get("tenant") if isinstance(req, dict)
+                    else None) or "default"
+                if not isinstance(tenant, str):
+                    server.record_metrics(failed=True)
+                    return self._reply(
+                        400, {"error": "'tenant' must be a string"})
                 if self.path == "/v1/generate":
                     prompts = req.get("prompts")
                     if prompts is None and "prompt" in req:
@@ -1298,7 +1728,8 @@ def _make_handler(server: BundleServer):
                             400, {"error": "'prompts' must be a list of "
                                            "strings (or 'prompt': str)"})
                     if req.get("stream"):
-                        return self._stream_generate(req, prompts)
+                        return self._stream_generate(req, prompts,
+                                                     tenant=tenant)
                     out = server.generate(
                         prompts,
                         max_new_tokens=int(req.get("max_new_tokens", 64)),
@@ -1307,7 +1738,7 @@ def _make_handler(server: BundleServer):
                         top_p=req.get("top_p"),
                         num_beams=int(req.get("num_beams", 0)),
                         repetition_penalty=req.get("repetition_penalty"),
-                        deadline_s=deadline_s)
+                        deadline_s=deadline_s, tenant=tenant)
                     server.record_metrics(generate_entries=out)
                     self._reply(200, {"completions": out})
                 elif self.path == "/v1/warm":
@@ -1327,7 +1758,7 @@ def _make_handler(server: BundleServer):
                         return self._reply(
                             400, {"error": "'texts' must be a list of "
                                            "strings"})
-                    scores = server.score(texts)
+                    scores = server.score(texts, tenant=tenant)
                     server.record_metrics(score=True)
                     self._reply(200, {"scores": scores})
                 else:
@@ -1336,11 +1767,11 @@ def _make_handler(server: BundleServer):
             except RequestRejected as exc:
                 # load shedding is not a server fault: counted in the
                 # rejected{reason} family (incremented at the raise
-                # site), not in requests_failed
+                # site), not in requests_failed. Per-tenant sheds carry
+                # the tenant in body + X-Tenant-Shed header.
                 server.record_metrics()
-                self._reply(
-                    exc.status, {"error": str(exc), "reason": exc.reason},
-                    headers=(("Retry-After", str(exc.retry_after_s)),))
+                self._reply(exc.status, _shed_body(exc),
+                            headers=_shed_headers(exc))
             except DeadlineExceeded as exc:
                 # the dedicated deadline counter (incremented where the
                 # expiry was detected) carries the signal
@@ -1477,6 +1908,20 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="bounded admission by token budget: shed when "
                         "queued prompt+budget tokens would exceed this "
                         "(0 = unbounded)")
+    p.add_argument("--tenants", default=e("SERVE_TENANTS", ""),
+                   help="multi-tenant fairness/quota spec: JSON "
+                        "('{\"light\": {\"weight\": 3}, \"noisy\": "
+                        "{\"weight\": 1, \"rate\": 200, \"burst\": "
+                        "400}}') or compact "
+                        "name=weight[:rate[:burst]],... — weights "
+                        "drive DWRR admission shares and each "
+                        "tenant's slice of --max-queue-depth/"
+                        "--max-queued-tokens; rate (tokens/sec) + "
+                        "burst build per-tenant token buckets "
+                        "(429 + Retry-After from the tenant's own "
+                        "refill; other tenants keep admitting). A "
+                        "'*' entry configures unlisted tenants. "
+                        "Empty = tenancy off (global bounds)")
     p.add_argument("--drain-timeout", type=float,
                    default=float(e("DRAIN_TIMEOUT", "30")),
                    help="seconds SIGTERM waits for in-flight requests "
@@ -1578,7 +2023,8 @@ def main(argv=None) -> int:
         max_queue_depth=args.max_queue_depth,
         max_queued_tokens=args.max_queued_tokens,
         chaos_spec=args.chaos,
-        heartbeat_file=args.heartbeat_file)
+        heartbeat_file=args.heartbeat_file,
+        tenants_spec=args.tenants)
     if args.chaos:
         logger.warning("serve-side chaos injection ACTIVE: %s", args.chaos)
     logger.info("bundle loaded: %s", server.health())

@@ -162,6 +162,41 @@ def test_tpu_router_manifest_conventions():
     assert float(env["ROUTER_DRAIN_TIMEOUT"]) + 5 < grace
 
 
+def test_tpu_serve_hpa_conventions():
+    """The HPA must close the loop against REAL names: it targets the
+    serve Deployment by its manifest name, and every external metric it
+    scales on is a family the router actually registers (a metric
+    rename must fail here, not silently freeze autoscaling)."""
+    docs = _load("infra/k8s/tpu/tpu-serve-hpa.yaml")
+    hpa = next(d for d in docs if d["kind"] == "HorizontalPodAutoscaler")
+    serve = _load("infra/k8s/tpu/tpu-serve.yaml")
+    serve_dep = next(d for d in serve if d["kind"] == "Deployment")
+    ref = hpa["spec"]["scaleTargetRef"]
+    assert ref["kind"] == "Deployment"
+    assert ref["name"] == serve_dep["metadata"]["name"]
+    # the router keeps a hedging/failover pair alive at minimum
+    assert hpa["spec"]["minReplicas"] >= 2
+    from pyspark_tf_gke_tpu.obs.metrics import (
+        MetricsRegistry,
+        router_families,
+    )
+
+    registered = set(router_families(MetricsRegistry()))
+    metric_names = [m["external"]["metric"]["name"]
+                    for m in hpa["spec"]["metrics"]
+                    if m["type"] == "External"]
+    assert metric_names, "HPA scales on no external metrics"
+    for name in metric_names:
+        # adapter-derived quantiles ride the base histogram family
+        # (router_queue_delay_ms_p99 -> router_queue_delay_ms)
+        base = name[:-4] if name.endswith("_p99") else name
+        assert base in registered, (name, sorted(registered))
+    # scale-down waits out transient headroom (prefix caches are
+    # per-replica state a shrink throws away)
+    down = hpa["spec"]["behavior"]["scaleDown"]
+    assert down["stabilizationWindowSeconds"] >= 120
+
+
 def test_tpu_serve_multihost_manifest_conventions():
     """The multi-host serving StatefulSet must agree with the CLI's
     addressing contract: hostname-ordinal process ids, pod-0 headless

@@ -59,12 +59,16 @@ class StubReplica:
     def __init__(self):
         self.load = {"queued": 0, "queued_tokens": 0, "active": 0,
                      "slots_total": 2, "kv_pages_free": None,
-                     "inflight_http": 0, "draining": False}
+                     "inflight_http": 0, "draining": False,
+                     "capacity_free": 0, "queue_delay_ms": 0.0,
+                     "tenants": {}}
         self.delay_s = 0.0
         self.shed = None            # (status, retry_after_s) or None
+        self.shed_tenant = None     # X-Tenant-Shed value on sheds
         self.stream_events = None   # list of dicts; "DIE" cuts the wire
         self.stream_die_before_first = False
         self.received = []          # (path, request dict)
+        self.tenant_headers = []    # X-Tenant header per POST
         self.tag = "!"
 
         server = self
@@ -100,13 +104,21 @@ class StubReplica:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
                 server.received.append((self.path, req))
+                server.tenant_headers.append(
+                    self.headers.get("X-Tenant"))
                 if server.delay_s:
                     time.sleep(server.delay_s)
                 if server.shed is not None:
                     status, ra = server.shed
-                    return self._reply(
-                        status, {"error": "shed", "reason": "queue_full"},
-                        headers=(("Retry-After", str(ra)),))
+                    hdrs = [("Retry-After", str(ra))]
+                    body = {"error": "shed", "reason": "queue_full"}
+                    if server.shed_tenant:
+                        hdrs.append(("X-Tenant-Shed",
+                                     server.shed_tenant))
+                        body["reason"] = "tenant_quota"
+                        body["tenant"] = server.shed_tenant
+                    return self._reply(status, body,
+                                       headers=tuple(hdrs))
                 if req.get("stream"):
                     self.close_connection = True
                     self.send_response(200)
@@ -632,6 +644,131 @@ def test_router_honors_engine_retry_after_seconds(tmp_path):
     finally:
         httpd.shutdown()
         stub.stop()
+
+
+# -- per-tenant shed semantics (multi-tenant overload isolation) -------------
+
+
+def test_tenant_shed_round_trips_with_marker_headers():
+    """A per-tenant 429 produced by the REAL serve handler carries the
+    tenant's own Retry-After AND the X-Tenant-Shed marker — the bytes
+    the router's tenant-vs-replica shed distinction parses."""
+    from pyspark_tf_gke_tpu.train.serve import RequestRejected
+
+    rejected = RequestRejected(
+        "tenant_quota", "tenant 'noisy' token quota exhausted",
+        status=429, retry_after_s=42, tenant="noisy")
+    fake = _SheddingBundleServer(exc=rejected)
+    httpd, url = _serve_fake(fake)
+    try:
+        call = ReplicaCall(url, timeout_s=10).request(
+            "POST", "/v1/generate",
+            body=json.dumps({"prompts": ["x"]}).encode())
+        assert call.status == 429
+        assert parse_retry_after(call.header("Retry-After")) == 42.0
+        assert call.header("X-Tenant-Shed") == "noisy"
+        body = call.read_json()
+        assert body["reason"] == "tenant_quota"
+        assert body["tenant"] == "noisy"
+        call.close()
+    finally:
+        httpd.shutdown()
+
+
+def test_router_surfaces_tenant_shed_without_backoff_or_reroute(
+        stubs, tmp_path):
+    """A tenant-scoped 429 is a verdict about the TENANT: the router
+    relays it (Retry-After + X-Tenant-Shed intact) but does NOT back
+    the replica off, does NOT burn the re-route on it, and keeps the
+    replica fully routable for other tenants."""
+    a, b = stubs
+    a.shed = (429, 7)
+    a.shed_tenant = "noisy"
+    router, _ = _router_for(stubs, tmp_path, hedge=False,
+                            affinity_tokens=0)
+    # make a the least-loaded pick
+    router.replicas.get(b.url).load = {"queued_tokens": 500}
+    status, out, hdrs = router.route_json(
+        "/v1/generate", {"prompts": ["x"], "max_new_tokens": 2},
+        tenant="noisy")
+    assert status == 429
+    hd = dict(hdrs)
+    assert hd.get("X-Tenant-Shed") == "noisy"
+    assert out.get("tenant") == "noisy"
+    # no re-route: the fallback stub never saw a generate
+    assert all(p != "/v1/generate" for p, _ in b.received)
+    # no backoff: the shedding replica stays routable NOW
+    rec = router.replicas.get(a.url)
+    assert rec.backoff_until <= time.monotonic()
+    assert rec in router.replicas.routable()
+    reg = router.registry
+    assert reg.get("router_tenant_sheds_total").labels(
+        tenant="noisy").value == 1
+    # a GLOBAL shed on the same replica still backs it off (contrast)
+    a.shed_tenant = None
+    status, out, _ = router.route_json(
+        "/v1/generate", {"prompts": ["x"], "max_new_tokens": 2})
+    assert status == 200  # re-routed to b this time
+    assert router.replicas.get(a.url).backoff_until > time.monotonic()
+
+
+def test_router_propagates_tenant_header(stubs, tmp_path):
+    a, b = stubs
+    router, _ = _router_for(stubs, tmp_path, hedge=False,
+                            affinity_tokens=0)
+    status, _, _ = router.route_json(
+        "/v1/generate", {"prompts": ["x"], "max_new_tokens": 2},
+        tenant="acme")
+    assert status == 200
+    assert "acme" in (a.tenant_headers + b.tenant_headers)
+    # body-field tenant propagates too (no header on the client side)
+    status, _, _ = router.route_json(
+        "/v1/generate", {"prompts": ["y"], "max_new_tokens": 2,
+                         "tenant": "bodyco"})
+    assert status == 200
+    assert "bodyco" in (a.tenant_headers + b.tenant_headers)
+
+
+def test_tenant_hedge_budget_gate(stubs, tmp_path):
+    """A lone tenant hedges freely; a tenant holding more than half of
+    the router's in-flight set (floor 2) loses the hedge budget until
+    it drains — one greedy tenant can't double its own load."""
+    router, _ = _router_for(stubs, tmp_path)
+    assert router._tenant_may_hedge("solo")  # nothing in flight
+    for _ in range(8):
+        router._tenant_enter("noisy")
+    assert router._tenant_may_hedge("noisy")  # alone: pre-tenancy rule
+    router._tenant_enter("light")
+    assert router._tenant_may_hedge("light")      # 1 <= max(2, 4)
+    assert not router._tenant_may_hedge("noisy")  # 8 > max(2, 4)
+    for _ in range(8):
+        router._tenant_exit("noisy")
+    assert router._tenant_may_hedge("noisy")      # budget restored
+
+
+def test_router_autoscale_signal_from_loadz(stubs, tmp_path):
+    """The closed-loop capacity signal: /loadz capacity_free and
+    queue_delay_ms fold into router_capacity_free_total /
+    router_demand_tokens_total / router_queue_delay_ms at every probe
+    sweep, and /healthz exposes the same terms for the HPA adapter."""
+    a, b = stubs
+    a.load = dict(a.load, capacity_free=300, queue_delay_ms=12.5,
+                  queued_tokens=40)
+    b.load = dict(b.load, capacity_free=200, queue_delay_ms=2.0,
+                  queued_tokens=10)
+    router, prober = _router_for(stubs, tmp_path)
+    prober.probe_once()
+    reg = router.registry
+    assert reg.get("router_capacity_free_total").value == 500
+    assert reg.get("router_demand_tokens_total").value == 50
+    assert reg.get("router_queue_delay_ms").count >= 2
+    _, health = router.health()
+    auto = health["autoscale"]
+    assert auto["capacity_free_total"] == 500
+    assert auto["demand_tokens_total"] == 50
+    assert auto["queue_delay_ms_max"] == 12.5
+    assert auto["replicas_routable"] == 2
+    assert auto["demand_inflight"] == 0
 
 
 # -- get_json helper ---------------------------------------------------------

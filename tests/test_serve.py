@@ -339,7 +339,8 @@ def test_loadz_snapshot_key_stability(cb_endpoints):
     plain_url, cont_url = cb_endpoints
     want_keys = {"queued", "queued_tokens", "active", "slots_total",
                  "kv_pages_free", "inflight_http", "draining",
-                 "prefix_cache_pages", "prefix_hit_rate"}
+                 "prefix_cache_pages", "prefix_hit_rate",
+                 "capacity_free", "queue_delay_ms", "tenants"}
     for url in (plain_url, cont_url):
         with urllib.request.urlopen(url + "/loadz") as resp:
             assert resp.status == 200
@@ -347,6 +348,12 @@ def test_loadz_snapshot_key_stability(cb_endpoints):
         assert set(out) == want_keys
         assert out["draining"] is False
         assert out["kv_pages_free"] is None  # dense engine / whole-batch
+        # autoscale terms: a whole-batch server has no admission queue
+        # (zeros); the slot engine advertises real token headroom
+        assert isinstance(out["capacity_free"], int)
+        assert isinstance(out["tenants"], dict)
+    with urllib.request.urlopen(cont_url + "/loadz") as resp:
+        assert json.loads(resp.read())["capacity_free"] > 0
     with urllib.request.urlopen(cont_url + "/loadz") as resp:
         cont = json.loads(resp.read())
     assert cont["slots_total"] == 2  # the slot engine's pool

@@ -40,9 +40,15 @@ second request's COMPUTED prefill tokens (engine counter, via
 ``/loadz`` must report a nonzero hit rate, so the router's
 affinity signal is provably fed by real cache contents.
 
+``--fairness`` checks multi-tenant overload isolation through a live
+CPU server with a ``--tenants`` spec: three flooding noisy-tenant
+threads vs one serial light tenant — the light tenant completes every
+request with bounded p99 while every shed the flood draws is a
+PER-TENANT 429 (tenant_quota / tenant_queue_full), never a global one.
+
 Usage: python tools/smoke_check.py
        [--lint-only|--kernels-only|--serve-lifecycle|--serve-tbt|
-        --router|--prefix-cache]
+        --router|--prefix-cache|--fairness]
 """
 
 import os
@@ -131,7 +137,20 @@ def lint_duplicate_metrics() -> int:
     required = {"serve_prefix_cache_hits_total",
                 "serve_prefix_cache_hit_tokens_total",
                 "serve_prefix_cache_pages",
-                "serve_prefix_cache_evictions_total"}
+                "serve_prefix_cache_evictions_total",
+                # multi-tenant fairness + the closed-loop autoscale
+                # signal: /loadz capacity_free and the HPA manifest
+                # (infra/k8s/tpu/tpu-serve-hpa.yaml) depend on these
+                # names existing — a rename must fail here first
+                "serve_tenant_requests_total",
+                "serve_tenant_rejected_total",
+                "serve_tenant_tokens_total",
+                "serve_tenant_queue_depth",
+                "serve_capacity_free_tokens",
+                "router_capacity_free_total",
+                "router_demand_tokens_total",
+                "router_queue_delay_ms",
+                "router_tenant_sheds_total"}
     absent = {n for n in required if n not in _REGISTRATIONS}
     if absent:
         print("metric lint FAILED — required metric name(s) never "
@@ -778,6 +797,111 @@ def router_check(grace_s: float = 30.0, n_requests: int = 10) -> int:
     return 0
 
 
+def fairness_check(grace_s: float = 30.0) -> int:
+    """``--fairness``: the multi-tenant overload-isolation contract
+    through a LIVE CPU server (the real CLI with a ``--tenants`` spec).
+    Three greedy "noisy"-tenant threads flood the replica while the
+    "light" tenant runs serial requests:
+
+    1. the light tenant completes EVERY request (goodput 1.0 — DWRR
+       admission + its private queue share keep it admitting),
+    2. its p99 stays within a bounded factor of its isolated-run p99
+       (the flood cannot starve it, only share slots with it),
+    3. the noisy tenant's sheds are all PER-TENANT 429s
+       (tenant_quota / tenant_queue_full + X-Tenant-Shed) — the
+       global queue never rejects anyone,
+    4. zero lost requests: every outcome is a 200 or an explicit shed,
+    5. ``/loadz`` exports the per-tenant queue map + capacity_free
+       (the router's autoscale signal is fed by real state)."""
+    import json as _json
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from pyspark_tf_gke_tpu.router.localfleet import (
+        export_tiny_bundle,
+        free_port,
+        launch_replica,
+        percentile,
+        post_tenant,
+        run_noisy_neighbor,
+        wait_healthy,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="fairness-smoke-")
+    bundle = export_tiny_bundle(os.path.join(tmp, "bundle"))
+    port = free_port()
+    url = f"http://127.0.0.1:{port}"
+    proc = launch_replica(
+        bundle, port, quiet=False,
+        extra_args=("--tenants", "light=3,noisy=1:60:120",
+                    "--max-queue-depth", "6"))
+    failures = []
+    try:
+        import time as _time
+        wait_healthy(url, _time.time() + 180, proc=proc)
+        # warm the compiled shapes so the isolated baseline is steady
+        for t in ("light", "noisy"):
+            post_tenant(url, "warm", t, max_new_tokens=6)
+        iso = []
+        for i in range(4):
+            status, _body, dt = post_tenant(url, f"iso {i}", "light",
+                                            max_new_tokens=6)
+            if status == 200:
+                iso.append(dt)
+        p99_iso = percentile(iso, 0.99)
+        out = run_noisy_neighbor(url, light_requests=8, light_budget=6,
+                                 flood_threads=3, flood_budget=12)
+        p99_flood = percentile(out["light"]["lat_ms"], 0.99)
+        bound = max(25.0 * max(p99_iso, 250.0), 5000.0)
+        print(f"fairness: light {out['light']['ok']}/8 ok, p99 "
+              f"{p99_flood:.0f}ms flooded vs {p99_iso:.0f}ms isolated "
+              f"(bound {bound:.0f}ms); noisy ok={out['noisy']['ok']} "
+              f"tenant_429={out['noisy']['tenant_429']} "
+              f"other_429={out['noisy']['other_429']} "
+              f"errors={len(out['noisy']['errors'])} over "
+              f"{out['noisy_attempts']} attempts")
+        if out["light"]["errors"] or out["light"]["ok"] != 8:
+            failures.append(
+                f"light tenant lost requests: {out['light']['errors']}")
+        if p99_flood > bound:
+            failures.append(
+                f"light p99 {p99_flood:.0f}ms blew the bounded factor "
+                f"({bound:.0f}ms) — the flood starved it")
+        if out["noisy"]["tenant_429"] < 1:
+            failures.append(
+                "the flood never drew a per-tenant 429 — quotas/shares "
+                "are not engaging")
+        if out["noisy"]["other_429"]:
+            failures.append(
+                f"{out['noisy']['other_429']} GLOBAL 429(s) fired — "
+                "shedding must be per-tenant under a tenants spec")
+        if out["noisy"]["errors"]:
+            failures.append(
+                f"noisy tenant hit non-shed errors: "
+                f"{out['noisy']['errors'][:3]}")
+        with urllib.request.urlopen(url + "/loadz", timeout=10) as resp:
+            loadz = _json.loads(resp.read())
+        if "capacity_free" not in loadz or "tenants" not in loadz:
+            failures.append(f"/loadz missing tenancy keys: "
+                            f"{sorted(loadz)}")
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+    if failures:
+        print("fairness FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("fairness OK: light tenant kept goodput 1.0 with bounded p99 "
+          "under a 3-thread flood; every shed was a per-tenant 429")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--kernels-only" in argv:
@@ -790,6 +914,8 @@ def main(argv=None) -> int:
         return router_check()
     if "--prefix-cache" in argv:
         return prefix_cache_check()
+    if "--fairness" in argv:
+        return fairness_check()
     if "--lint-only" not in argv:
         devices = jax.devices()
         print(f"devices: {devices}")
