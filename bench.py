@@ -1455,9 +1455,24 @@ def bench_io(smoke: bool = False) -> dict:
     schema = schema_for(arrays)
 
     with tempfile.TemporaryDirectory() as td:
+        # write A/B: serial (the pre-pipeline baseline, 24k rows/sec on
+        # the committed trail) vs one-worker-thread-per-shard. Outputs
+        # are byte-identical (tests pin it); only the wall clock moves.
+        t_s0 = time.perf_counter()
+        serial_paths = ntr.write_tfrecord_shards(
+            arrays, os.path.join(td, "serial"), num_shards=n_shards,
+            num_workers=1)
+        write_serial_dt = time.perf_counter() - t_s0
+        for p in serial_paths:
+            os.remove(p)  # page cache aside, keep the read set single
+
         prefix = os.path.join(td, "bench")
         t_w0 = time.perf_counter()
-        ntr.write_tfrecord_shards(arrays, prefix, num_shards=n_shards)
+        # explicit one-thread-per-shard (the default caps at cpu_count,
+        # which would silently fall back to serial on a 1-vCPU host and
+        # A/B nothing)
+        ntr.write_tfrecord_shards(arrays, prefix, num_shards=n_shards,
+                                  num_workers=n_shards)
         write_dt = time.perf_counter() - t_w0
 
         def read_all() -> int:
@@ -1486,6 +1501,10 @@ def bench_io(smoke: bool = False) -> dict:
         "batch_size": batch_size,
         "native": ntr.native_available(),
         "write_rows_per_sec": round(total / write_dt, 1),
+        "write_rows_per_sec_serial": round(total / write_serial_dt, 1),
+        "write_parallel_speedup": round(write_serial_dt / write_dt, 2),
+        "write_workers": n_shards,
+        "host_cpus": os.cpu_count(),
     }
 
 

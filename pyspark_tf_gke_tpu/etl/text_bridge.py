@@ -138,10 +138,17 @@ def write_token_shards(
     text_col: str = "text",
     tokenizer_spec: str = "byte",
     num_shards: int = 16,
+    manifest_path: str = None,
 ) -> List[str]:
     """Spark action: repartition the corpus DataFrame and write one
     packed-token TFRecord shard per partition (plus the metadata
-    sidecar)."""
+    sidecar).
+
+    ``manifest_path``: append the completed shard set to a
+    :class:`~pyspark_tf_gke_tpu.pipeline.manifest.ShardSetManifest` as
+    one new generation for the continuous pipeline's trainer tail
+    (docs/PIPELINE.md) — appended after the action and the sidecar
+    land, so a tailing trainer never sees unfinished shards."""
     import functools
 
     body = functools.partial(
@@ -155,4 +162,11 @@ def write_token_shards(
     paths = (df.select(text_col).repartition(num_shards)
                .rdd.mapPartitionsWithIndex(body).collect())
     write_shard_metadata(output_prefix, seq_len, tokenizer_spec)
+    if manifest_path:
+        from pyspark_tf_gke_tpu.pipeline.manifest import ShardSetManifest
+
+        ShardSetManifest(manifest_path).append(
+            paths, meta={"source": "etl.text_bridge",
+                         "prefix": output_prefix, "seq_len": seq_len,
+                         "tokenizer": tokenizer_spec})
     return paths

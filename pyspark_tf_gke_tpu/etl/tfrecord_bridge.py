@@ -142,10 +142,17 @@ def write_dataframe_shards(
     feature_cols: Sequence[str],
     label_col: str = None,
     num_shards: int = 16,
+    manifest_path: str = None,
 ) -> List[str]:
     """Spark action: repartition to ``num_shards`` and write one TFRecord
     file per partition: ``{output_prefix}-{i:05d}-of-{N:05d}.tfrecord``.
-    Works with any Hadoop-visible FS (gs://, file:/)."""
+    Works with any Hadoop-visible FS (gs://, file:/).
+
+    ``manifest_path``: append the completed shard set to a
+    :class:`~pyspark_tf_gke_tpu.pipeline.manifest.ShardSetManifest` as
+    one new generation — the continuous pipeline's trainer side tails
+    it (docs/PIPELINE.md). The append happens AFTER the Spark action
+    returns, so the manifest only ever names finished shards."""
     import functools
 
     write_partition = functools.partial(
@@ -155,7 +162,15 @@ def write_dataframe_shards(
         label_col=label_col,
         num_shards=num_shards,
     )
-    return df.repartition(num_shards).rdd.mapPartitionsWithIndex(write_partition).collect()
+    paths = (df.repartition(num_shards).rdd
+             .mapPartitionsWithIndex(write_partition).collect())
+    if manifest_path:
+        from pyspark_tf_gke_tpu.pipeline.manifest import ShardSetManifest
+
+        ShardSetManifest(manifest_path).append(
+            paths, meta={"source": "etl.tfrecord_bridge",
+                         "prefix": output_prefix})
+    return paths
 
 
 def _write_bytes(path: str, data: bytes) -> None:

@@ -591,6 +591,45 @@ def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "/loadz capacity_free (admission-budget or KV-page bound, "
             "whichever is tighter) — the closed-loop autoscale "
             "signal's per-replica term"),
+        # bundle hot-swap (serving side of the continuous pipeline)
+        "serve_bundle_generation": r.gauge(
+            "serve_bundle_generation",
+            "Generation of the bundle currently SERVING traffic — "
+            "advances only after a reload's canary generate succeeds "
+            "(also on /healthz and /loadz as bundle_generation)"),
+        "serve_bundle_reloads_total": r.counter(
+            "serve_bundle_reloads_total",
+            "POST /admin/reload outcomes: ok (swapped, canary passed) "
+            "| rolled_back (bad bundle; previous generation restored) "
+            "| rejected (auth/compat/409 — nothing swapped)",
+            labelnames=("outcome",)),
+        # pipeline plane (the coordinator's control loop — jax-free,
+        # so these register on whatever registry the bastion process
+        # scrapes/exports)
+        "pipeline_rounds_total": r.counter(
+            "pipeline_rounds_total",
+            "Completed ingest->train->export->publish rounds"),
+        "pipeline_stage_seconds": r.histogram(
+            "pipeline_stage_seconds",
+            "Wall-clock seconds per pipeline stage run (retries "
+            "included)",
+            labelnames=("stage",),
+            buckets=(0.1, 0.5, 1, 5, 15, 60, 300, 1800, 7200)),
+        "pipeline_stage_failures_total": r.counter(
+            "pipeline_stage_failures_total",
+            "Stage runs that exhausted their retries (the state file "
+            "keeps pointing at the failed stage for resume)",
+            labelnames=("stage",)),
+        "pipeline_bundle_generation": r.gauge(
+            "pipeline_bundle_generation",
+            "Latest bundle generation the coordinator CONFIRMED "
+            "serving on the fleet (/loadz bundle_generation reached "
+            "it on every published replica)"),
+        "pipeline_freshness_seconds": r.gauge(
+            "pipeline_freshness_seconds",
+            "Data-landed -> serving-traffic latency of the last "
+            "published round: publish confirmation time minus the "
+            "round's ingest manifest landing time"),
         # data plane
         "data_prefetch_queue_depth": r.gauge(
             "data_prefetch_queue_depth",

@@ -1,0 +1,51 @@
+"""Continuous pipeline plane: the coordinator-driven ETL→train→publish
+loop that turns the three batch-shaped planes (``etl/`` feature
+pipelines, the trainer, the router+BundleServer serving fleet) into one
+demonstrable system — the reference platform's bastion role,
+implemented as a first-party control loop (docs/PIPELINE.md).
+
+Jax-free by the platform's convention (like ``router/``): the
+coordinator makes no device calls — the local stage set lazy-imports
+the data/train planes inside stage bodies, and a production deployment
+swaps those for k8s-Job launchers.
+
+Entry point: ``python -m pyspark_tf_gke_tpu.pipeline`` (the
+``infra/k8s/tpu/tpu-pipeline.yaml`` Deployment runs it on CPU nodes,
+bastion-style).
+"""
+
+from pyspark_tf_gke_tpu.pipeline.coordinator import (
+    STAGES,
+    PipelineCoordinator,
+    PipelineState,
+    StageFailed,
+    resolve_replicas,
+)
+from pyspark_tf_gke_tpu.pipeline.manifest import (
+    ShardSetManifest,
+    write_atomic_json,
+)
+from pyspark_tf_gke_tpu.pipeline.publish import (
+    confirm_generation,
+    reload_replica,
+    rolling_publish,
+)
+from pyspark_tf_gke_tpu.pipeline.stages import (
+    LocalPipelineConfig,
+    make_local_stages,
+)
+
+__all__ = [
+    "STAGES",
+    "PipelineCoordinator",
+    "PipelineState",
+    "StageFailed",
+    "ShardSetManifest",
+    "LocalPipelineConfig",
+    "make_local_stages",
+    "resolve_replicas",
+    "reload_replica",
+    "confirm_generation",
+    "rolling_publish",
+    "write_atomic_json",
+]

@@ -1,0 +1,161 @@
+"""``python -m pyspark_tf_gke_tpu.pipeline`` — run the continuous
+ETL→train→export→publish loop (docs/PIPELINE.md).
+
+The flags/env mirror the serve CLI's conventions; the admin token for
+the fleet's ``POST /admin/reload`` endpoints comes from
+``SERVE_ADMIN_TOKEN`` (env only — a token on the command line would
+leak into ``ps`` output and pod specs)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+from pyspark_tf_gke_tpu.pipeline.coordinator import (
+    PipelineCoordinator,
+    StageFailed,
+)
+from pyspark_tf_gke_tpu.pipeline.stages import (
+    LocalPipelineConfig,
+    make_local_stages,
+)
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+logger = get_logger("pipeline.main")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    e = os.environ.get
+    p = argparse.ArgumentParser(
+        description="Continuous ETL->train->export->publish coordinator")
+    p.add_argument("--work-dir", default=e("PIPELINE_WORK_DIR", ""),
+                   required=not e("PIPELINE_WORK_DIR"),
+                   help="root for shards/, checkpoints/, bundles/ and "
+                        "the state file")
+    p.add_argument("--rounds", type=int,
+                   default=int(e("PIPELINE_ROUNDS", "0")),
+                   help="rounds to run before exiting (0 = run until "
+                        "SIGTERM)")
+    p.add_argument("--interval", type=float,
+                   default=float(e("PIPELINE_INTERVAL", "0")),
+                   help="seconds to sleep between rounds (0 = "
+                        "back-to-back); the sleep is SIGTERM-interruptible")
+    p.add_argument("--rows-per-round", type=int,
+                   default=int(e("PIPELINE_ROWS_PER_ROUND", "2048")))
+    p.add_argument("--seq-len", type=int,
+                   default=int(e("PIPELINE_SEQ_LEN", "64")))
+    p.add_argument("--num-shards", type=int,
+                   default=int(e("PIPELINE_NUM_SHARDS", "4")))
+    p.add_argument("--steps-per-round", type=int,
+                   default=int(e("PIPELINE_STEPS_PER_ROUND", "8")))
+    p.add_argument("--batch-size", type=int,
+                   default=int(e("PIPELINE_BATCH_SIZE", "8")))
+    p.add_argument("--learning-rate", type=float,
+                   default=float(e("PIPELINE_LEARNING_RATE", "1e-3")))
+    p.add_argument("--hidden-size", type=int,
+                   default=int(e("PIPELINE_HIDDEN_SIZE", "32")))
+    p.add_argument("--num-layers", type=int,
+                   default=int(e("PIPELINE_NUM_LAYERS", "2")))
+    p.add_argument("--num-heads", type=int,
+                   default=int(e("PIPELINE_NUM_HEADS", "2")))
+    p.add_argument("--intermediate-size", type=int,
+                   default=int(e("PIPELINE_INTERMEDIATE_SIZE", "64")))
+    p.add_argument("--tokenizer", default=e("PIPELINE_TOKENIZER", "byte"))
+    p.add_argument("--quantize", action="store_true",
+                   default=e("PIPELINE_QUANTIZE", "") == "1",
+                   help="export int8 weight-quantized bundles")
+    p.add_argument("--bundle-url-prefix",
+                   default=e("PIPELINE_BUNDLE_URL_PREFIX", ""),
+                   help="how REPLICAS address published bundles when "
+                        "that differs from the coordinator's local "
+                        "path (work dir on a GCS FUSE mount, fleet "
+                        "pulling gs:// URLs): the published bundle's "
+                        "basename is appended to this prefix")
+    p.add_argument("--replicas", default=e("PIPELINE_REPLICAS", ""),
+                   help="comma-separated serving replicas to hot-swap "
+                        "published bundles into: http://host:port "
+                        "entries and/or dns://service:port (headless "
+                        "Service, one replica per A record). Empty = "
+                        "bundles land on disk only")
+    p.add_argument("--max-unavailable", type=int,
+                   default=int(e("PIPELINE_MAX_UNAVAILABLE", "1")),
+                   help="replicas reloading concurrently during a "
+                        "rolling publish")
+    p.add_argument("--confirm-timeout", type=float,
+                   default=float(e("PIPELINE_CONFIRM_TIMEOUT", "60")),
+                   help="seconds to wait for /loadz to advertise the "
+                        "new bundle_generation per replica")
+    p.add_argument("--no-canary", action="store_true",
+                   default=e("PIPELINE_NO_CANARY", "") == "1",
+                   help="skip the replicas' post-swap canary generate "
+                        "(NOT recommended: canary failure is what "
+                        "triggers server-side rollback)")
+    p.add_argument("--stage-attempts", type=int,
+                   default=int(e("PIPELINE_STAGE_ATTEMPTS", "3")))
+    p.add_argument("--state-file", default=e("PIPELINE_STATE_FILE", ""),
+                   help="crash-resume state path (default "
+                        "WORK_DIR/pipeline_state.json)")
+    p.add_argument("--heartbeat-file", default=e("HEARTBEAT_FILE", ""),
+                   help="node-local liveness file beaten once per stage "
+                        "(k8s exec probe watches its age)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    cfg = LocalPipelineConfig(
+        work_dir=args.work_dir,
+        rows_per_round=args.rows_per_round,
+        seq_len=args.seq_len,
+        num_shards=args.num_shards,
+        tokenizer=args.tokenizer,
+        steps_per_round=args.steps_per_round,
+        batch_size=args.batch_size,
+        learning_rate=args.learning_rate,
+        hidden_size=args.hidden_size,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        intermediate_size=args.intermediate_size,
+        quantize=args.quantize,
+        # raw entries (dns:// included): the publish stage re-resolves
+        # every round, so the rollout tracks the live fleet
+        replicas=tuple(e.strip() for e in args.replicas.split(",")
+                       if e.strip()),
+        admin_token=os.environ.get("SERVE_ADMIN_TOKEN", ""),
+        max_unavailable=args.max_unavailable,
+        confirm_timeout_s=args.confirm_timeout,
+        canary=not args.no_canary,
+        bundle_url_prefix=args.bundle_url_prefix,
+    )
+    heartbeat = None
+    if args.heartbeat_file:
+        from pyspark_tf_gke_tpu.train.resilience import Heartbeat
+
+        heartbeat = Heartbeat(args.heartbeat_file, every_steps=1)
+    coord = PipelineCoordinator(
+        make_local_stages(cfg),
+        state_path=(args.state_file
+                    or os.path.join(args.work_dir, "pipeline_state.json")),
+        rounds=args.rounds,
+        interval_s=args.interval,
+        stage_attempts=args.stage_attempts,
+        heartbeat=heartbeat)
+
+    if threading.current_thread() is threading.main_thread():
+        # SIGTERM drain: finish the stage in flight, persist state,
+        # exit 0 — the replacement pod resumes from the state file
+        signal.signal(signal.SIGTERM,
+                      lambda signum, frame: coord.request_stop())
+    try:
+        return coord.run()
+    except StageFailed as exc:
+        logger.error("pipeline stopped: %s (state file points at the "
+                     "failed stage; restart resumes there)", exc)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,292 @@
+"""The pipeline coordinator: the reference's bastion as a control loop.
+
+The source platform is DRIVEN from outside the cluster — a bastion host
+sequences Spark ETL, parameter-server training, and artifact handling
+(PAPER.md L3–L7). This module is that role made first-party: a jax-free
+control loop that runs **rounds** of
+
+    ingest  →  train  →  export  →  publish
+
+where each stage is a plain callable (the local in-process stage set
+lives in :mod:`pyspark_tf_gke_tpu.pipeline.stages`; a production
+deployment can swap any stage for a k8s-Job launcher without touching
+the loop). The loop owns exactly the concerns a bastion script always
+grows by hand, done properly once:
+
+* **crash resume** — after every stage the coordinator persists a state
+  file (atomic tmp+fsync+rename, same contract as the shard manifest);
+  a restarted coordinator resumes at the first unfinished stage of the
+  interrupted round instead of re-ingesting/re-training work that
+  already landed;
+* **per-stage retry** — transient stage failures ride the shared
+  ``retry_with_backoff`` policy (events + ``retries_total{op}``), and a
+  stage that exhausts its retries stops the loop with the state file
+  still pointing at it;
+* **observability** — ``pipeline_rounds_total``,
+  ``pipeline_stage_seconds{stage}``, ``pipeline_bundle_generation``,
+  and ``pipeline_freshness_seconds`` (data-landed → serving-traffic
+  latency, the loop's end-to-end SLO) on the shared registry, plus
+  ``pipeline_*`` events on the trail;
+* **SIGTERM drain** — :meth:`PipelineCoordinator.request_stop` finishes
+  the current stage, persists state, and exits 0 (the k8s rolling-
+  restart contract; the next pod resumes from the state file).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Mapping, Optional, Sequence
+
+from pyspark_tf_gke_tpu.obs.events import get_event_log
+from pyspark_tf_gke_tpu.obs.metrics import platform_families
+from pyspark_tf_gke_tpu.pipeline.manifest import write_atomic_json
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+logger = get_logger("pipeline.coordinator")
+
+STAGES = ("ingest", "train", "export", "publish")
+STATE_FORMAT = "pyspark_tf_gke_tpu.pipeline_state.v1"
+
+
+class StageFailed(RuntimeError):
+    """A stage exhausted its retries; ``stage`` names it and the state
+    file still points at it, so the next coordinator run re-enters the
+    round exactly there."""
+
+    def __init__(self, stage: str, cause: BaseException):
+        super().__init__(f"stage {stage!r} failed: "
+                         f"{type(cause).__name__}: {cause}")
+        self.stage = stage
+        self.cause = cause
+
+
+class PipelineState:
+    """The coordinator's durable resume point.
+
+    ``round`` is the 1-based round in progress (or about to start);
+    ``stage_index`` the next stage to run within it; ``outputs`` the
+    completed stages' return dicts for the CURRENT round (inputs to the
+    later stages — e.g. export's bundle dir feeds publish);
+    ``completed_rounds`` / ``bundle_generation`` are the loop's
+    cumulative progress. Everything JSON-serializable by construction.
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        self.round = 1
+        self.stage_index = 0
+        self.outputs: Dict[str, dict] = {}
+        # cross-round durable scratch (e.g. the train stage's consumed-
+        # batches stream offset) — NOT reset when a round completes
+        self.extra: Dict[str, dict] = {}
+        self.completed_rounds = 0
+        self.bundle_generation = 0
+        self.load()
+
+    def load(self) -> bool:
+        import json
+
+        try:
+            with open(self.path) as fh:
+                data = json.load(fh)
+        except (FileNotFoundError, ValueError):
+            return False
+        self.round = int(data.get("round", 1))
+        self.stage_index = int(data.get("stage_index", 0))
+        self.outputs = dict(data.get("outputs", {}))
+        self.extra = dict(data.get("extra", {}))
+        self.completed_rounds = int(data.get("completed_rounds", 0))
+        self.bundle_generation = int(data.get("bundle_generation", 0))
+        return True
+
+    def save(self) -> None:
+        write_atomic_json(self.path, {
+            "format": STATE_FORMAT,
+            "round": self.round,
+            "stage_index": self.stage_index,
+            "outputs": self.outputs,
+            "extra": self.extra,
+            "completed_rounds": self.completed_rounds,
+            "bundle_generation": self.bundle_generation,
+            "updated_at": time.time(),
+        })
+
+
+class PipelineCoordinator:
+    """Drives ingest→train→export→publish rounds with durable resume.
+
+    ``stages`` maps each name in :data:`STAGES` to a callable
+    ``stage(state: PipelineState, outputs: dict) -> dict`` where
+    ``outputs`` holds the current round's completed stage results and
+    the return dict becomes ``outputs[name]``. Stage callables must be
+    idempotent at round granularity (re-running a completed-then-
+    crashed-before-save stage must be safe) — the local stage set is.
+    """
+
+    def __init__(self, stages: Mapping[str, Callable],
+                 state_path: str,
+                 rounds: int = 0,
+                 interval_s: float = 0.0,
+                 stage_attempts: int = 3,
+                 retry_base_delay_s: float = 0.5,
+                 heartbeat=None,
+                 obs=None, event_log=None):
+        missing = [s for s in STAGES if s not in stages]
+        if missing:
+            raise ValueError(f"stage map is missing {missing}")
+        self.stages = dict(stages)
+        self.state = PipelineState(state_path)
+        self.rounds = int(rounds)  # 0 = run until stopped
+        self.interval_s = float(interval_s)
+        self.stage_attempts = int(stage_attempts)
+        self.retry_base_delay_s = float(retry_base_delay_s)
+        self.heartbeat = heartbeat  # train.resilience.Heartbeat
+        self._obs = obs if obs is not None else platform_families()
+        self._event_log = (event_log if event_log is not None
+                           else get_event_log())
+        self._stop = threading.Event()
+        self._beats = 0
+
+    # -- lifecycle -------------------------------------------------------
+
+    def request_stop(self) -> None:
+        """SIGTERM drain: finish the stage in flight, persist state,
+        return from :meth:`run` cleanly. Idempotent."""
+        self._stop.set()
+
+    @property
+    def stopping(self) -> bool:
+        return self._stop.is_set()
+
+    def _beat(self) -> None:
+        self._beats += 1
+        if self.heartbeat is not None:
+            try:
+                self.heartbeat.beat(self._beats, force=True)
+            except OSError:
+                pass  # liveness must never take the loop down
+
+    # -- the loop --------------------------------------------------------
+
+    def _run_stage(self, name: str) -> dict:
+        from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
+
+        fn = self.stages[name]
+        t0 = time.perf_counter()
+        self._event_log.emit("pipeline_stage_start", stage=name,
+                             round=self.state.round)
+        try:
+            out = retry_with_backoff(
+                lambda: fn(self.state, dict(self.state.outputs)),
+                attempts=self.stage_attempts,
+                base_delay_s=self.retry_base_delay_s,
+                op=f"pipeline_{name}")
+        except Exception as exc:  # noqa: BLE001 — surfaced typed below
+            self._obs["pipeline_stage_failures_total"].labels(
+                stage=name).inc()
+            self._event_log.emit(
+                "pipeline_stage_failed", stage=name,
+                round=self.state.round,
+                error=f"{type(exc).__name__}: {exc}"[:500])
+            raise StageFailed(name, exc) from exc
+        dt = time.perf_counter() - t0
+        self._obs["pipeline_stage_seconds"].labels(stage=name).observe(dt)
+        self._event_log.emit("pipeline_stage_end", stage=name,
+                             round=self.state.round,
+                             seconds=round(dt, 3))
+        return out if isinstance(out, dict) else {}
+
+    def run_round(self) -> None:
+        """Run the current round from its resume point; advances the
+        state file after every stage. Raises :class:`StageFailed` with
+        the state still pointing at the failed stage."""
+        while self.state.stage_index < len(STAGES):
+            name = STAGES[self.state.stage_index]
+            self._beat()
+            out = self._run_stage(name)
+            self.state.outputs[name] = out
+            self.state.stage_index += 1
+            if name == "publish":
+                gen = int(out.get("generation",
+                                  self.state.bundle_generation))
+                if out.get("published"):
+                    self.state.bundle_generation = gen
+                    self._obs["pipeline_bundle_generation"].set(gen)
+                    landed = (self.state.outputs.get("ingest") or {}).get(
+                        "landed_at")
+                    if landed:
+                        fresh = max(0.0, time.time() - float(landed))
+                        self._obs["pipeline_freshness_seconds"].set(fresh)
+                        self._event_log.emit(
+                            "pipeline_published", round=self.state.round,
+                            generation=gen,
+                            freshness_s=round(fresh, 3))
+            self.state.save()
+        # round complete: reset for the next one
+        self.state.completed_rounds += 1
+        self.state.round += 1
+        self.state.stage_index = 0
+        self.state.outputs = {}
+        self.state.save()
+        self._obs["pipeline_rounds_total"].inc()
+        self._event_log.emit("pipeline_round_end",
+                             completed=self.state.completed_rounds)
+
+    def run(self) -> int:
+        """Round loop until ``rounds`` complete (0 = forever) or a stop
+        is requested. Returns 0 on clean exit/drain; raises
+        :class:`StageFailed` when a stage exhausts its retries."""
+        # a crash between the post-publish save and the round-complete
+        # save persists stage_index == len(STAGES); run_round's loop
+        # handles it (falls straight to round completion) — the resume
+        # label must not index past the stage list
+        i = self.state.stage_index
+        self._event_log.emit(
+            "pipeline_started", resume_round=self.state.round,
+            resume_stage=(STAGES[i] if i < len(STAGES)
+                          else "round-complete"),
+            completed_rounds=self.state.completed_rounds)
+        while not self._stop.is_set():
+            if self.rounds and self.state.completed_rounds >= self.rounds:
+                break
+            self.run_round()
+            if self.interval_s and not self._stop.is_set():
+                # interruptible sleep between rounds (SIGTERM-prompt)
+                self._stop.wait(self.interval_s)
+        self._event_log.emit(
+            "pipeline_stopped", completed_rounds=self.state.completed_rounds,
+            drained=self._stop.is_set())
+        return 0
+
+
+def resolve_replicas(spec: str) -> Sequence[str]:
+    """Expand a ``--replicas`` spec into base URLs.
+
+    Comma-separated entries; each is either a literal ``http://host:port``
+    or ``dns://name:port`` — resolved to one URL per A record, the same
+    headless-Service convention the router's discovery uses (each serve
+    pod must be addressed INDIVIDUALLY for a rolling publish)."""
+    import socket
+
+    out = []
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if entry.startswith("dns://"):
+            hostport = entry[len("dns://"):]
+            host, _, port = hostport.partition(":")
+            port = int(port or 8000)
+            try:
+                infos = socket.getaddrinfo(host, port, proto=socket.IPPROTO_TCP)
+            except OSError as exc:
+                raise ValueError(f"cannot resolve {entry!r}: {exc}") from exc
+            addrs = sorted({info[4][0] for info in infos})
+            out.extend(f"http://{a}:{port}" for a in addrs)
+        else:
+            out.append(entry.rstrip("/"))
+    return out

@@ -1,0 +1,154 @@
+"""Shard-set manifest: the incremental ETL→train hand-off.
+
+The batch-shaped planes exchange data by glob convention (``prefix-*``
+patterns); a CONTINUOUS loop needs an explicit, ordered record of which
+shards are COMPLETE — a half-written TFRecord file matching the glob
+would feed the trainer torn protos. :class:`ShardSetManifest` is that
+record: a JSONL file where each line is one *generation* — a set of
+finished shard paths plus metadata, stamped with a monotonically
+increasing generation number and a wall-clock landing time.
+
+Durability/atomicity contract (what the tests pin):
+
+* appends rewrite the whole file to a temp sibling, ``fsync`` it, and
+  ``os.replace`` onto the manifest path — a reader (the trainer's
+  ``tail_shards`` source, possibly in another process) always sees a
+  complete, parseable file: either the pre-append or the post-append
+  state, never a torn line;
+* generation numbers are assigned under an ``fcntl`` file lock (plus a
+  process-local mutex), so concurrent appenders — N Spark bridge
+  executors landing shards — get distinct, strictly increasing
+  generations;
+* reads take no lock at all: the rename is the synchronization.
+
+Producers call :meth:`append` AFTER their shard files are fully
+written and closed (the ``etl/`` bridges and
+``data.native_tfrecord.write_tfrecord_shards`` both finish their
+writes before returning paths). Consumers poll :meth:`generation` /
+:meth:`shards` — cheap (one small file read) and safe at any moment.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+MANIFEST_FORMAT = "pyspark_tf_gke_tpu.shard_manifest.v1"
+
+
+def write_atomic_json(path: str, payload: dict) -> None:
+    """tmp + fsync + rename: the one durable-small-state write used by
+    the manifest and the coordinator's resume state file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+        fh.write("\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+class ShardSetManifest:
+    """Append-only JSONL manifest of completed TFRecord shard sets."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        self._mutex = threading.Lock()  # in-process appenders
+        self._lock_path = f"{self.path}.lock"
+
+    # -- reading (lock-free) --------------------------------------------
+
+    def records(self) -> List[dict]:
+        """Every generation record, in append order. A torn TRAILING
+        line (possible only if a writer bypassed the atomic-rename
+        contract) is dropped rather than failing the tail."""
+        try:
+            with open(self.path) as fh:
+                raw = fh.read()
+        except FileNotFoundError:
+            return []
+        out: List[dict] = []
+        for line in raw.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                break  # incomplete tail — everything before it is valid
+        return out
+
+    def generation(self) -> int:
+        """Latest generation number (0 = empty manifest)."""
+        recs = self.records()
+        return int(recs[-1]["generation"]) if recs else 0
+
+    def shards(self, since_generation: int = 0) -> List[str]:
+        """All shard paths in generations > ``since_generation``, in
+        generation order (within a generation, producer order)."""
+        out: List[str] = []
+        for rec in self.records():
+            if int(rec["generation"]) > int(since_generation):
+                out.extend(rec["shards"])
+        return out
+
+    def wait_for_generation(self, generation: int, timeout_s: float,
+                            poll_s: float = 0.05) -> bool:
+        """Block until the manifest reaches ``generation`` (True) or
+        ``timeout_s`` elapses (False) — the trainer's cold-start gate."""
+        deadline = time.monotonic() + float(timeout_s)
+        while self.generation() < int(generation):
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+        return True
+
+    # -- appending ------------------------------------------------------
+
+    def append(self, shards: Sequence[str],
+               meta: Optional[Dict] = None) -> int:
+        """Record one completed shard set; returns its generation.
+
+        Safe against concurrent appenders in this process (mutex) and
+        across processes (``fcntl.flock`` on a sidecar lock file): the
+        generation is read, incremented, and the rewritten file renamed
+        in, all inside the critical section."""
+        shards = [str(s) for s in shards]
+        if not shards:
+            raise ValueError("refusing to append an empty shard set")
+        with self._mutex:
+            lock_fh = open(self._lock_path, "a+")
+            try:
+                try:
+                    import fcntl
+
+                    fcntl.flock(lock_fh.fileno(), fcntl.LOCK_EX)
+                except ImportError:  # non-POSIX: mutex-only
+                    pass
+                recs = self.records()
+                gen = (int(recs[-1]["generation"]) if recs else 0) + 1
+                rec = {
+                    **(meta or {}),
+                    # fixed keys LAST: caller metadata can annotate a
+                    # generation but never forge its number or shards
+                    "format": MANIFEST_FORMAT,
+                    "generation": gen,
+                    "shards": shards,
+                    "landed_at": time.time(),
+                }
+                tmp = f"{self.path}.tmp.{os.getpid()}.{threading.get_ident()}"
+                with open(tmp, "w") as fh:
+                    for r in recs:
+                        fh.write(json.dumps(r) + "\n")
+                    fh.write(json.dumps(rec) + "\n")
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                os.replace(tmp, self.path)
+                return gen
+            finally:
+                lock_fh.close()  # closing drops the flock

@@ -1,0 +1,145 @@
+"""Fleet publish: rolling bundle hot-swap across serving replicas.
+
+The serving side owns the heavy machinery (off-driver load, compat
+checks, canary, rollback — ``train/serve.py`` ``reload_bundle``); this
+module is the coordinator's thin, jax-free client for it:
+
+* :func:`reload_replica` — one ``POST /admin/reload`` (token via the
+  ``X-Admin-Token`` header) returning the replica's verdict;
+* :func:`confirm_generation` — poll ``GET /loadz`` until
+  ``bundle_generation`` reaches the target (the same signal the
+  router's prober reads, so "confirmed" == "the router can see it");
+* :func:`rolling_publish` — batches of at most ``max_unavailable``
+  replicas reload concurrently; each batch must confirm before the
+  next starts, and ANY failure stops the rollout — at least
+  ``N - max_unavailable`` replicas are serving (old or new generation,
+  never broken: a failed reload rolls back server-side) at every
+  moment of the rollout.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional, Sequence
+
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+logger = get_logger("pipeline.publish")
+
+
+def _read_json(resp) -> dict:
+    try:
+        return json.loads(resp.read().decode())
+    except (ValueError, UnicodeDecodeError):
+        return {}
+
+
+def reload_replica(base_url: str, bundle_dir: str, generation: int,
+                   token: str = "", canary: bool = True,
+                   timeout_s: float = 120.0) -> dict:
+    """POST /admin/reload on one replica. Returns
+    ``{"ok": bool, "status": int, "body": dict}`` — transport errors
+    and HTTP error statuses both land as ``ok=False`` with the body the
+    replica sent (the rollback verdict rides it)."""
+    payload = {"bundle": bundle_dir, "generation": int(generation),
+               "canary": bool(canary)}
+    req = urllib.request.Request(
+        base_url.rstrip("/") + "/admin/reload",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 **({"X-Admin-Token": token} if token else {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return {"ok": True, "status": resp.status,
+                    "body": _read_json(resp)}
+    except urllib.error.HTTPError as exc:
+        body = _read_json(exc)
+        return {"ok": False, "status": exc.code, "body": body}
+    except Exception as exc:  # noqa: BLE001 — transport failure
+        return {"ok": False, "status": 0,
+                "body": {"error": f"{type(exc).__name__}: {exc}"}}
+
+
+def confirm_generation(base_url: str, generation: int,
+                       timeout_s: float = 60.0,
+                       poll_s: float = 0.25) -> bool:
+    """Poll /loadz until the replica advertises ``bundle_generation >=
+    generation`` and is not draining. The generation only advances
+    after a successful canary, so True means the new bundle is
+    SERVING, not merely loaded."""
+    deadline = time.monotonic() + float(timeout_s)
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    base_url.rstrip("/") + "/loadz", timeout=5) as resp:
+                load = _read_json(resp)
+            if (int(load.get("bundle_generation") or 0) >= int(generation)
+                    and not load.get("draining")):
+                return True
+        except Exception:  # noqa: BLE001 — mid-swap blip: keep polling
+            pass
+        time.sleep(poll_s)
+    return False
+
+
+def rolling_publish(replicas: Sequence[str], bundle_dir: str,
+                    generation: int, token: str = "",
+                    max_unavailable: int = 1,
+                    confirm_timeout_s: float = 60.0,
+                    canary: bool = True,
+                    reload_timeout_s: float = 120.0) -> dict:
+    """Hot-swap ``bundle_dir`` across the fleet, at most
+    ``max_unavailable`` replicas at a time.
+
+    Returns ``{"ok", "published", "generation", "results"}`` where
+    ``results`` is one entry per replica attempted (replicas after a
+    failed batch are NOT attempted — they keep serving the old
+    generation). A replica counts as published only after
+    :func:`confirm_generation` sees the new generation live."""
+    import threading
+
+    replicas = [r.rstrip("/") for r in replicas]
+    max_unavailable = max(1, int(max_unavailable))
+    results: List[dict] = []
+    published = 0
+    ok = True
+    for i in range(0, len(replicas), max_unavailable):
+        batch = replicas[i:i + max_unavailable]
+        batch_results: List[Optional[dict]] = [None] * len(batch)
+
+        def one(j: int, url: str) -> None:
+            out = reload_replica(url, bundle_dir, generation,
+                                 token=token, canary=canary,
+                                 timeout_s=reload_timeout_s)
+            if out["ok"] and not confirm_generation(
+                    url, generation, timeout_s=confirm_timeout_s):
+                out = {**out, "ok": False,
+                       "body": {**out.get("body", {}),
+                                "error": "generation never confirmed "
+                                         "on /loadz"}}
+            batch_results[j] = {"replica": url, **out}
+
+        threads = [threading.Thread(target=one, args=(j, url),
+                                    name=f"publish-{url}")
+                   for j, url in enumerate(batch)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for res in batch_results:
+            results.append(res)
+            if res["ok"]:
+                published += 1
+                logger.info("published generation %d to %s",
+                            generation, res["replica"])
+            else:
+                ok = False
+                logger.error("publish FAILED on %s: %s", res["replica"],
+                             res["body"])
+        if not ok:
+            break  # stop the rollout; untouched replicas keep serving
+    return {"ok": ok, "published": published,
+            "generation": int(generation), "results": results}

@@ -1,0 +1,308 @@
+"""Default in-process stage set for the pipeline coordinator.
+
+One callable per stage, all configured by :class:`LocalPipelineConfig`.
+The coordinator itself is jax-free; these local stages lazy-import the
+data/train planes INSIDE their bodies, so building the stage map costs
+nothing and a deployment that swaps a stage for a k8s-Job launcher
+never pays for the planes it doesn't run in-process.
+
+The local set closes the loop end to end on one box (the smoke gate
+``tools/smoke_check.py --pipeline`` and the CPU tests drive it):
+
+* **ingest** — materialize ``rows_per_round`` packed-token rows as
+  native TFRecord shards (parallel writer) and append them to the
+  shard manifest as one new generation. The row source is pluggable
+  (``row_source``); the default synthesizes byte-tokenizer text so the
+  loop runs anywhere.
+* **train** — build-or-restore the tiny CausalLM + Trainer, tail the
+  manifest through :class:`~pyspark_tf_gke_tpu.data.native_tfrecord.
+  ManifestTailSource` (new generations join at epoch boundaries;
+  ``consumed_batches`` persists in the coordinator state so a restart
+  resumes the EXACT deterministic batch stream mid-epoch), run
+  ``steps_per_round`` optimizer steps, checkpoint.
+* **export** — write the serving bundle for this round's generation
+  (``bundles/gen-NNNN``), quantization off by default at toy scale.
+* **publish** — rolling hot-swap across the serving fleet via
+  :func:`pyspark_tf_gke_tpu.pipeline.publish.rolling_publish`; with no
+  replicas configured the stage is a no-op (bundle still lands on disk
+  for a later fleet).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Dict, Optional, Sequence
+
+from pyspark_tf_gke_tpu.pipeline.manifest import ShardSetManifest
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+logger = get_logger("pipeline.stages")
+
+
+@dataclasses.dataclass
+class LocalPipelineConfig:
+    """Knobs for the in-process stage set (CLI maps env/flags here)."""
+
+    work_dir: str
+    # ingest
+    rows_per_round: int = 2048
+    seq_len: int = 64
+    num_shards: int = 4
+    tokenizer: str = "byte"
+    row_source: Optional[Callable[[int, "LocalPipelineConfig"], dict]] = None
+    # train
+    steps_per_round: int = 8
+    batch_size: int = 8
+    learning_rate: float = 1e-3
+    hidden_size: int = 32
+    num_layers: int = 2
+    num_heads: int = 2
+    intermediate_size: int = 64
+    # export
+    quantize: bool = False
+    # publish
+    replicas: Sequence[str] = ()
+    admin_token: str = ""
+    max_unavailable: int = 1
+    confirm_timeout_s: float = 60.0
+    canary: bool = True
+    # how REPLICAS address a published bundle, when that differs from
+    # the coordinator's local path — e.g. work_dir is a GCS FUSE mount
+    # and the fleet pulls gs:// URLs (the serve side's _resolve_bundle
+    # spools remote bundles locally): "gs://bucket/pipeline/loop/bundles"
+    bundle_url_prefix: str = ""
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.work_dir, "shards", "manifest.jsonl")
+
+    @property
+    def checkpoint_dir(self) -> str:
+        return os.path.join(self.work_dir, "checkpoints")
+
+    def bundle_dir(self, generation: int) -> str:
+        return os.path.join(self.work_dir, "bundles", f"gen-{generation:04d}")
+
+
+def _synthetic_rows(round_no: int, cfg: LocalPipelineConfig) -> dict:
+    """Default row source: deterministic-per-round pseudo-text packed to
+    ``seq_len`` token rows — enough signal for the loss to move and for
+    every round's data (and therefore weights) to differ."""
+    import numpy as np
+
+    from pyspark_tf_gke_tpu.data.text import get_tokenizer, pack_tokens
+
+    tokenizer = get_tokenizer(cfg.tokenizer)
+    rng = np.random.default_rng(1000 + round_no)
+    words = ["spark", "tpu", "shard", "bundle", "train", "serve",
+             f"round{round_no}", "pipeline", "manifest", "publish"]
+    docs = (" ".join(rng.choice(words, size=12)) for _ in
+            range(max(1, cfg.rows_per_round // 4)))
+    rows = []
+    for packed in pack_tokens(docs, tokenizer, cfg.seq_len):
+        rows.append(np.asarray(packed, dtype=np.int64))
+        if len(rows) >= cfg.rows_per_round:
+            break
+    return {"input_ids": np.stack(rows)}
+
+
+def ingest_stage(cfg: LocalPipelineConfig):
+    def ingest(state, outputs) -> dict:
+        from pyspark_tf_gke_tpu.data.native_tfrecord import (
+            write_tfrecord_shards,
+        )
+
+        manifest = ShardSetManifest(cfg.manifest_path)
+        # idempotent at round granularity: a crash AFTER the append but
+        # BEFORE the coordinator persisted the stage would otherwise
+        # re-append the same rows as a duplicate generation on resume,
+        # skewing every later epoch's length and the consumed-batches
+        # resume accounting
+        for rec in manifest.records():
+            if rec.get("round") == state.round:
+                logger.info(
+                    "ingest round %d: generation %d already landed; "
+                    "resuming without re-appending", state.round,
+                    rec["generation"])
+                return {"data_generation": int(rec["generation"]),
+                        "rows": rec.get("rows"),
+                        "landed_at": rec["landed_at"]}
+        source = cfg.row_source or _synthetic_rows
+        arrays = source(state.round, cfg)
+        n = len(next(iter(arrays.values())))
+        prefix = os.path.join(cfg.work_dir, "shards",
+                              f"round-{state.round:04d}")
+        paths = write_tfrecord_shards(arrays, prefix,
+                                      num_shards=cfg.num_shards)
+        gen = manifest.append(paths, meta={"rows": n,
+                                           "round": state.round})
+        logger.info("ingest round %d: %d rows -> %d shards "
+                    "(data generation %d)", state.round, n, len(paths), gen)
+        return {"data_generation": gen, "rows": n,
+                "landed_at": time.time()}
+
+    return ingest
+
+
+def _build_trainer(cfg: LocalPipelineConfig):
+    """The one model/trainer construction recipe the train and export
+    stages share, plus a zero-sample initial state — a config knob
+    threaded through only one of them would silently rebuild a model
+    whose shapes mismatch the trained checkpoint."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pyspark_tf_gke_tpu.data.text import get_tokenizer
+    from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
+    from pyspark_tf_gke_tpu.parallel.mesh import make_mesh
+    from pyspark_tf_gke_tpu.train.trainer import TASKS, Trainer
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    tokenizer = get_tokenizer(cfg.tokenizer)
+    model_cfg = CausalLMConfig(
+        vocab_size=tokenizer.vocab_size, hidden_size=cfg.hidden_size,
+        num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+        intermediate_size=cfg.intermediate_size,
+        max_seq_len=cfg.seq_len, dtype=jnp.float32)
+    model = CausalLM(model_cfg)
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    trainer = Trainer(model, TASKS["causal_lm"](), mesh,
+                      learning_rate=cfg.learning_rate)
+    sample = {"input_ids": np.zeros((cfg.batch_size, cfg.seq_len),
+                                    np.int32)}
+    state0 = trainer.init_state(make_rng(0), sample)
+    return model_cfg, trainer, state0
+
+
+def train_stage(cfg: LocalPipelineConfig):
+    def train(state, outputs) -> dict:
+        import jax
+        import numpy as np
+
+        from pyspark_tf_gke_tpu.data.native_tfrecord import (
+            ManifestTailSource,
+        )
+        from pyspark_tf_gke_tpu.data.tfrecord import schema_for
+        from pyspark_tf_gke_tpu.train.checkpoint import CheckpointManager
+
+        _, trainer, state0 = _build_trainer(cfg)
+
+        # the tail source resumes the deterministic batch stream at the
+        # coordinator-persisted offset — a restarted coordinator
+        # continues mid-stream instead of re-training from row 0
+        consumed = int((state.extra.get("train_progress") or {}).get(
+            "consumed_batches", 0))
+        schema = schema_for(
+            {"input_ids": np.zeros((1, cfg.seq_len), np.int64)})
+        source = ManifestTailSource(
+            cfg.manifest_path, schema, cfg.batch_size,
+            consumed_batches=consumed, wait_timeout_s=60.0)
+
+        ckpt = CheckpointManager(cfg.checkpoint_dir)
+        try:
+            if ckpt.latest_step() is not None:
+                state0 = ckpt.restore(state0)
+            # prefetch=0: the device-prefetch worker would draw AHEAD of
+            # the optimizer, inflating consumed_batches past the steps
+            # actually trained — exact stream resume needs the two equal
+            st, history = trainer.fit(
+                state0, source, epochs=1,
+                steps_per_epoch=cfg.steps_per_round, prefetch=0)
+            ckpt.save(st, history, force=True)
+            ckpt.wait()
+        finally:
+            ckpt.close()
+        loss = float(history["loss"][-1]) if history.get("loss") else None
+        # survives the round-end outputs reset: next round's train
+        # stage resumes the deterministic stream here
+        state.extra["train_progress"] = {
+            "consumed_batches": source.consumed_batches}
+        return {"consumed_batches": source.consumed_batches,
+                "global_step": int(jax.device_get(st.step)),
+                "loss": loss}
+
+    return train
+
+
+def export_stage(cfg: LocalPipelineConfig):
+    def export(state, outputs) -> dict:
+        from pyspark_tf_gke_tpu.train.checkpoint import CheckpointManager
+        from pyspark_tf_gke_tpu.train.export import export_serving_bundle
+
+        model_cfg, _, st = _build_trainer(cfg)
+        ckpt = CheckpointManager(cfg.checkpoint_dir)
+        try:
+            if ckpt.latest_step() is None:
+                raise FileNotFoundError(
+                    f"no checkpoint under {cfg.checkpoint_dir} — did the "
+                    "train stage run?")
+            st = ckpt.restore(st)
+        finally:
+            ckpt.close()
+        generation = state.round  # one bundle generation per round
+        out_dir = cfg.bundle_dir(generation)
+        export_serving_bundle(model_cfg, st.params, out_dir,
+                              quantize=cfg.quantize,
+                              tokenizer_spec=cfg.tokenizer,
+                              extra_meta={"pipeline_generation": generation,
+                                          "pipeline_round": state.round})
+        logger.info("export round %d: bundle generation %d -> %s",
+                    state.round, generation, out_dir)
+        return {"bundle_dir": out_dir, "generation": generation}
+
+    return export
+
+
+def publish_stage(cfg: LocalPipelineConfig):
+    def publish(state, outputs) -> dict:
+        from pyspark_tf_gke_tpu.pipeline.coordinator import (
+            resolve_replicas,
+        )
+
+        export_out = outputs.get("export") or {}
+        bundle_dir = export_out.get("bundle_dir")
+        generation = int(export_out.get("generation", state.round))
+        # dns:// entries re-resolve EVERY round: a long-running
+        # coordinator must publish to the fleet as it is now (HPA
+        # scale-ups, rescheduled pods), not a boot-time snapshot
+        replicas = resolve_replicas(",".join(cfg.replicas))
+        if not replicas:
+            logger.info("publish round %d: no replicas configured; "
+                        "bundle generation %d stays on disk",
+                        state.round, generation)
+            return {"published": 0, "generation": generation,
+                    "results": []}
+        if not bundle_dir:
+            raise ValueError("publish has no bundle_dir from export")
+        if cfg.bundle_url_prefix:
+            bundle_dir = (cfg.bundle_url_prefix.rstrip("/") + "/"
+                          + os.path.basename(bundle_dir.rstrip("/")))
+        from pyspark_tf_gke_tpu.pipeline.publish import rolling_publish
+
+        report = rolling_publish(
+            replicas, bundle_dir, generation,
+            token=cfg.admin_token,
+            max_unavailable=cfg.max_unavailable,
+            confirm_timeout_s=cfg.confirm_timeout_s,
+            canary=cfg.canary)
+        if not report["ok"]:
+            raise RuntimeError(
+                f"rolling publish of generation {generation} failed: "
+                f"{report['results']}")
+        return {"published": report["published"],
+                "generation": generation, "results": report["results"]}
+
+    return publish
+
+
+def make_local_stages(cfg: LocalPipelineConfig) -> Dict[str, Callable]:
+    os.makedirs(cfg.work_dir, exist_ok=True)
+    return {
+        "ingest": ingest_stage(cfg),
+        "train": train_stage(cfg),
+        "export": export_stage(cfg),
+        "publish": publish_stage(cfg),
+    }

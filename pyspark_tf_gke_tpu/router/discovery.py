@@ -307,6 +307,11 @@ class ReplicaSet:
                 "inflight": r.inflight,
                 "inflight_tokens": r.inflight_tokens,
                 "backoff_s": round(max(0.0, r.backoff_until - now), 3),
+                # rollout visibility: the replica's serving bundle
+                # generation as last probed (/loadz) — one router
+                # /healthz read shows a mixed-generation fleet mid-
+                # publish (None until the first probe answers)
+                "bundle_generation": r.load.get("bundle_generation"),
                 "load": r.load,
             } for r in sorted(self._replicas.values(),
                               key=lambda x: x.rid)]

@@ -66,8 +66,14 @@ def export_serving_bundle(
     quantize: bool = True,
     tokenizer_spec: str = "byte",
     quantize_min_size: int = 4096,
+    extra_meta: Optional[dict] = None,
 ) -> str:
-    """Write a self-contained serving bundle. Returns ``out_dir``."""
+    """Write a self-contained serving bundle. Returns ``out_dir``.
+
+    ``extra_meta``: caller annotations merged into ``config.json``
+    (reserved keys win) — the pipeline coordinator stamps
+    ``pipeline_generation`` here so a replica serving the bundle
+    advertises that generation on ``/loadz``."""
     os.makedirs(out_dir, exist_ok=True)
     if quantize and not is_quantized(params):
         params = jax.jit(
@@ -76,6 +82,7 @@ def export_serving_bundle(
     cfg_dict = dataclasses.asdict(cfg)
     cfg_dict["dtype"] = jnp.dtype(cfg.dtype).name
     meta = {
+        **(extra_meta or {}),
         "format": "pyspark_tf_gke_tpu.serving_bundle.v1",
         "model": "causal_lm",
         "quantized": bool(is_quantized(params)),

@@ -36,15 +36,25 @@ import os
 import random
 import time
 from typing import (Callable, Dict, Iterable, Mapping, Optional, Sequence,
-                    TypeVar)
-
-import jax
+                    Tuple, TypeVar)
 
 from pyspark_tf_gke_tpu.obs.events import get_event_log
 from pyspark_tf_gke_tpu.utils.fs import is_remote
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
 logger = get_logger("train.resilience")
+
+
+def _process_coords() -> Tuple[int, int]:
+    """(process_index, process_count) — from jax when it's available,
+    else (0, 1). Lazy so jax-free control planes (the pipeline
+    coordinator, bastion-side watchdogs) can use this module's
+    retry/heartbeat helpers without a device runtime behind them."""
+    try:
+        import jax
+    except ImportError:  # bastion box without an accelerator stack
+        return 0, 1
+    return jax.process_index(), jax.process_count()
 
 T = TypeVar("T")
 
@@ -76,7 +86,7 @@ class Heartbeat:
         # local fake slices); single-process-per-pod deployments don't
         # need the placeholder. replace(), not format(): other literal
         # braces in the path must pass through untouched.
-        path = path.replace("{process_index}", str(jax.process_index()))
+        path = path.replace("{process_index}", str(_process_coords()[0]))
         self.path = path
         self.every_steps = max(1, every_steps)
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -84,11 +94,12 @@ class Heartbeat:
     def beat(self, step: int, force: bool = False) -> None:
         if not force and step % self.every_steps:
             return
+        index, count = _process_coords()
         payload = {
             "step": int(step),
             "time": time.time(),
-            "process_index": jax.process_index(),
-            "process_count": jax.process_count(),
+            "process_index": index,
+            "process_count": count,
         }
         tmp = f"{self.path}.tmp"
         with open(tmp, "w") as fh:

@@ -103,6 +103,33 @@ def _draining_rejection() -> RequestRejected:
         "replica", status=503, retry_after_s=5)
 
 
+def _reloading_rejection() -> RequestRejected:
+    """Terminal handed to a request the bundle hot-swap could not drain
+    within its grace window: explicit, retryable (the freshly swapped
+    bundle serves the retry) — never a silent drop or a hang."""
+    return RequestRejected(
+        "reloading",
+        "bundle hot-swap interrupted this request; retry", status=503,
+        retry_after_s=1)
+
+
+class ReloadInFlight(RuntimeError):
+    """A bundle reload is already running (HTTP 409): reloads serialize
+    — the coordinator retries after the in-flight one settles."""
+
+
+class BundleReloadError(RuntimeError):
+    """A reload failed (HTTP 502). ``rolled_back`` says whether the new
+    bundle got as far as serving before the canary failed (True: the
+    PREVIOUS generation was reinstalled and serves) or never installed
+    at all (False: nothing changed). Either way the advertised
+    ``bundle_generation`` did not advance."""
+
+    def __init__(self, message: str, rolled_back: bool):
+        super().__init__(message)
+        self.rolled_back = bool(rolled_back)
+
+
 class TokenBucket:
     """Refillable token-rate quota for ONE tenant: ``rate`` tokens/sec
     refill up to ``burst``. Admission charges the request's worst-case
@@ -581,8 +608,11 @@ class _ContinuousFront:
                 f"continuous decode timed out after {timeout_s}s")
         with self.lock:
             result = self._results.pop(rid)[1]
-        if isinstance(result, (DeadlineExceeded, EngineShutdown)):
-            raise result  # typed: the handler maps these to 504 / 500
+        if isinstance(result, (DeadlineExceeded, EngineShutdown,
+                               RequestRejected)):
+            # typed: the handler maps these to 504 / 500 / the shed's
+            # own status (a hot-swap 'reloading' terminal is a 503)
+            raise result
         if isinstance(result, Exception):
             raise RuntimeError(
                 f"continuous engine failed this request: {result}")
@@ -615,6 +645,21 @@ class _ContinuousFront:
         with self.lock:
             self.engine.cancel(rid)
             self._results.pop(rid, None)
+
+    def submit_internal(self, prompt_ids, max_new_tokens: int) -> int:
+        """Engine submit that BYPASSES the admission/quota/drain gates —
+        for server-internal probes only (the bundle hot-swap canary): a
+        canary shed by overload or a drained tenant bucket would roll
+        back a perfectly good bundle exactly when the fleet is busiest.
+        The reserved tenant name keeps it out of every client bucket
+        (no charge, so no refund at delivery either)."""
+        done = threading.Event()
+        with self.lock:
+            rid = self.engine.submit(prompt_ids, max_new_tokens,
+                                     tenant="__internal__")
+            self._results[rid] = [done, None, None]
+        self.new_work.set()
+        return rid
 
     def submit_stream(self, prompt_ids, max_new_tokens: int,
                       deadline_s=None, tenant: str = "default"):
@@ -650,6 +695,82 @@ class _ContinuousFront:
         self.new_work.set()
         return rid, q
 
+    def _deliver_finished(self, finished) -> None:
+        """Deliver one step's finished requests to their waiters:
+        quota refund + per-tenant token accounting for every delivery
+        (completion AND expiry — a deadline-expired request hands its
+        unused generation budget back to its tenant's bucket), then the
+        result/terminal. Caller holds ``self.lock`` (the driver loop
+        and the hot-swap drain both run it)."""
+        for req in finished:
+            self._settle(req)
+            slot = self._results.get(req.rid)
+            if slot is None:
+                continue
+            if req.expired:
+                err = DeadlineExceeded(
+                    f"request deadline exceeded after "
+                    f"{len(req.tokens)} decoded token(s)")
+                slot[1] = err
+                slot[0].set()
+                if slot[2] is not None:
+                    slot[2].put(err)
+                continue
+            slot[1] = req.tokens
+            slot[0].set()
+            if slot[2] is not None:  # streaming terminal
+                slot[2].put([])
+
+    def swap_model(self, model, params, eos_id, drain_s: float = 30.0):
+        """Bundle hot-swap: replace the engine's model/params/eos.
+
+        Holds the front lock end to end, so HTTP submits (and the
+        driver loop) WAIT rather than race the swap. The OLD engine is
+        stepped to completion right here — in-flight requests and open
+        streams keep delivering tokens and finish on the weights they
+        started on — bounded by ``drain_s``; anything still unfinished
+        past the bound gets an explicit retryable 'reloading' terminal
+        (503 + Retry-After), the same contract as every other shed:
+        zero hangs, zero silent drops. The NEW engine then starts
+        empty; warmed prefixes are dropped (they were tokenized and
+        prefilled under the old bundle)."""
+        with self.lock:
+            args = list(self._engine_args)
+            args[0], args[1], args[2] = model, params, eos_id
+            self._engine_args = tuple(args)
+            deadline = time.monotonic() + float(drain_s)
+            try:
+                while time.monotonic() < deadline:
+                    stats = self.engine.stats
+                    busy = bool(stats["active"] or stats["queued"]
+                                or stats["admitting"] is not None
+                                or stats["inflight"])
+                    if not busy:
+                        break
+                    self._deliver_finished(self.engine.step())
+            except Exception:  # noqa: BLE001 — drain is best-effort;
+                # the explicit-terminal sweep below covers the leftovers
+                logger.exception(
+                    "old engine failed while draining for a bundle swap")
+            try:
+                # accepted-but-undelivered requests: refund their quota
+                # charges before the old engine is dropped
+                for req in self.engine.outstanding_requests():
+                    self._settle(req)
+            except Exception:  # noqa: BLE001 — refunds must not block
+                pass           # the swap
+            err = _reloading_rejection()
+            for slot in self._results.values():
+                if slot[1] is None and not slot[0].is_set():
+                    self._obs["serve_requests_rejected_total"].labels(
+                        reason="reloading").inc()
+                    slot[1] = err
+                    slot[0].set()
+                    if slot[2] is not None:
+                        slot[2].put(err)
+            self.engine = self._new_engine()
+            self._warmed.clear()
+
     def _loop(self):
         beat = 0
         while not self.stop.is_set():
@@ -674,28 +795,8 @@ class _ContinuousFront:
                         self._chaos_step += 1
                         self._chaos.maybe_slow(self._chaos_step)
                         self._chaos.maybe_fail(self._chaos_step)
-                    finished = self.engine.step() if busy else []
-                    for req in finished:
-                        # quota refund + per-tenant token accounting for
-                        # every delivery (completion AND expiry) — a
-                        # deadline-expired request hands its unused
-                        # generation budget back to its tenant's bucket
-                        self._settle(req)
-                        slot = self._results.get(req.rid)
-                        if slot is not None:
-                            if req.expired:
-                                err = DeadlineExceeded(
-                                    f"request deadline exceeded after "
-                                    f"{len(req.tokens)} decoded token(s)")
-                                slot[1] = err
-                                slot[0].set()
-                                if slot[2] is not None:
-                                    slot[2].put(err)
-                                continue
-                            slot[1] = req.tokens
-                            slot[0].set()
-                            if slot[2] is not None:  # streaming terminal
-                                slot[2].put([])
+                    self._deliver_finished(
+                        self.engine.step() if busy else [])
                 except Exception as exc:  # noqa: BLE001 — driver thread
                     # One failed step must not brick serving: the engine
                     # state may be mid-chunk garbage, so fail every
@@ -809,27 +910,25 @@ class BundleServer:
                  registry=None, event_log=None,
                  max_queue_depth: int = 0, max_queued_tokens: int = 0,
                  chaos_spec: str = "", heartbeat_file: str = "",
-                 tenants_spec: str = ""):
-        from pyspark_tf_gke_tpu.data.text import get_tokenizer
-        from pyspark_tf_gke_tpu.train.export import load_serving_bundle
+                 tenants_spec: str = "", admin_token: str = ""):
         from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
 
-        # bundle loads retry with backoff: a GCS blip or a bundle
-        # mid-upload should cost seconds, not a CrashLoopBackOff cycle.
-        # Deterministic config errors fail FAST instead of masquerading
-        # as storage outages: a mistyped --bundle (FileNotFoundError),
-        # a corrupt/unsupported config.json (ValueError incl.
-        # JSONDecodeError, KeyError/TypeError from missing fields).
-        _permanent = (FileNotFoundError, ValueError, KeyError, TypeError)
-        self.model, params, self.meta = retry_with_backoff(
-            lambda: load_serving_bundle(bundle_dir), op="bundle_load",
-            give_up_on=_permanent)
+        self.mesh = mesh
+        self._int8_kv = bool(int8_kv)
         self.draft_model = self.draft_params = None
         self.draft_bundle_dir = draft_bundle_dir
+        self.model, self.params, self.meta, self.tokenizer = (
+            self._load_and_verify(bundle_dir))
         if draft_bundle_dir:
             # speculative decoding: single-prompt greedy requests verify
             # a cheap draft's proposals in chunk forwards — same tokens,
             # fewer target steps (models/speculative.py)
+            _permanent = (FileNotFoundError, ValueError, KeyError,
+                          TypeError)
+            from pyspark_tf_gke_tpu.train.export import (
+                load_serving_bundle,
+            )
+
             self.draft_model, self.draft_params, _ = retry_with_backoff(
                 lambda: load_serving_bundle(draft_bundle_dir),
                 op="bundle_load", give_up_on=_permanent)
@@ -838,35 +937,25 @@ class BundleServer:
                 raise ValueError(
                     f"draft bundle vocab {self.draft_model.cfg.vocab_size} "
                     f"!= target vocab {self.model.cfg.vocab_size}")
-        if int8_kv and not self.model.cfg.kv_cache_quant:
-            # cache layout is a serving-time choice (params unchanged) —
-            # allow turning it on for bundles exported without the flag
-            import dataclasses
+            if mesh is not None:
+                from pyspark_tf_gke_tpu.train.serving import (
+                    shard_params_for_serving,
+                )
 
-            from pyspark_tf_gke_tpu.models import CausalLM
-
-            self.model = CausalLM(
-                dataclasses.replace(self.model.cfg, kv_cache_quant=True))
-        self.tokenizer = get_tokenizer(self.meta.get("tokenizer", "byte"))
-        if self.tokenizer.vocab_size > self.model.cfg.vocab_size:
-            raise ValueError(
-                f"bundle tokenizer vocab {self.tokenizer.vocab_size} exceeds "
-                f"model vocab {self.model.cfg.vocab_size}")
-        self.mesh = mesh
-        if mesh is not None:
-            from pyspark_tf_gke_tpu.train.serving import (
-                shard_params_for_serving,
-            )
-
-            params = shard_params_for_serving(self.model, params, mesh)
-            if self.draft_model is not None:
                 # the draft rides the same mesh — unsharded draft arrays
                 # would forfeit its tp memory/latency win and break on
                 # multi-host meshes
                 self.draft_params = shard_params_for_serving(
                     self.draft_model, self.draft_params, mesh)
-        self.params = params
         self.bundle_dir = bundle_dir
+        # bundle hot-swap (the pipeline plane's publish path): one
+        # reload at a time; the generation only advances after a
+        # successful swap + canary, and rides /healthz + /loadz so the
+        # coordinator (and the router's prober) can confirm a rollout
+        self.admin_token = admin_token
+        self._reload_lock = threading.Lock()
+        self.bundle_generation = int(
+            self.meta.get("pipeline_generation", 1))
         self.multi_host = jax.process_count() > 1
         if self.multi_host and mesh is None:
             raise ValueError("multi-host serving needs a mesh spanning "
@@ -883,6 +972,7 @@ class BundleServer:
         self.registry = registry if registry is not None else get_registry()
         self._obs = platform_families(self.registry)
         install_runtime_metrics(self.registry)
+        self._obs["serve_bundle_generation"].set(self.bundle_generation)
         self.event_log = (event_log if event_log is not None
                           else get_event_log())
         # drain lifecycle: SIGTERM (or begin_drain) flips this, /healthz
@@ -929,6 +1019,201 @@ class BundleServer:
                 max_queued_tokens=max_queued_tokens,
                 chaos=chaos, heartbeat=heartbeat,
                 tenants=tenants_spec)
+
+    # -- bundle loading / hot-swap ---------------------------------------
+
+    def _load_and_verify(self, bundle_dir: str):
+        """Load + verify one serving bundle into ``(model, params,
+        meta, tokenizer)`` — ONE path shared by construction and
+        :meth:`reload_bundle`, so a hot-swapped bundle passes exactly
+        the checks a boot-time bundle does.
+
+        Loads retry with backoff: a GCS blip or a bundle mid-upload
+        should cost seconds, not a CrashLoopBackOff cycle.
+        Deterministic config errors fail FAST instead of masquerading
+        as storage outages: a mistyped path (FileNotFoundError), a
+        corrupt/unsupported config.json (ValueError incl.
+        JSONDecodeError, KeyError/TypeError from missing fields)."""
+        from pyspark_tf_gke_tpu.data.text import get_tokenizer
+        from pyspark_tf_gke_tpu.train.export import load_serving_bundle
+        from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
+
+        _permanent = (FileNotFoundError, ValueError, KeyError, TypeError)
+        model, params, meta = retry_with_backoff(
+            lambda: load_serving_bundle(bundle_dir), op="bundle_load",
+            give_up_on=_permanent)
+        if self._int8_kv and not model.cfg.kv_cache_quant:
+            # cache layout is a serving-time choice (params unchanged) —
+            # allow turning it on for bundles exported without the flag
+            import dataclasses
+
+            from pyspark_tf_gke_tpu.models import CausalLM
+
+            model = CausalLM(
+                dataclasses.replace(model.cfg, kv_cache_quant=True))
+        tokenizer = get_tokenizer(meta.get("tokenizer", "byte"))
+        if tokenizer.vocab_size > model.cfg.vocab_size:
+            raise ValueError(
+                f"bundle tokenizer vocab {tokenizer.vocab_size} exceeds "
+                f"model vocab {model.cfg.vocab_size}")
+        if (self.draft_model is not None
+                and self.draft_model.cfg.vocab_size
+                != model.cfg.vocab_size):
+            raise ValueError(
+                f"bundle vocab {model.cfg.vocab_size} != configured "
+                f"draft bundle vocab {self.draft_model.cfg.vocab_size}")
+        if self.mesh is not None:
+            from pyspark_tf_gke_tpu.train.serving import (
+                shard_params_for_serving,
+            )
+
+            params = shard_params_for_serving(model, params, self.mesh)
+        return model, params, meta, tokenizer
+
+    def _check_swap_compat(self, meta: dict, model) -> None:
+        """Hot-swap compatibility: the new bundle must speak the SAME
+        request contract as the one serving — tokenizer spec and vocab
+        pinned (a request racing the swap may encode under one bundle
+        and decode under the other; with these pinned that race is
+        harmless). Architecture/size changes within the same contract
+        (layers, heads, max_seq_len, kv layout) are fine — the engine
+        is rebuilt around the new config. Bigger migrations are a
+        blue/green fleet swap, not a hot reload."""
+        old_spec = self.meta.get("tokenizer", "byte")
+        new_spec = meta.get("tokenizer", "byte")
+        if new_spec != old_spec:
+            raise ValueError(
+                f"incompatible bundle: tokenizer {new_spec!r} != "
+                f"serving tokenizer {old_spec!r}")
+        if model.cfg.vocab_size != self.model.cfg.vocab_size:
+            raise ValueError(
+                f"incompatible bundle: vocab {model.cfg.vocab_size} != "
+                f"serving vocab {self.model.cfg.vocab_size}")
+
+    def _install_bundle(self, model, params, meta, tokenizer,
+                        bundle_dir: str, drain_s: float = 30.0) -> None:
+        """Point the serving surfaces at a (verified) bundle. The
+        whole-batch path swaps under the device lock; the slot engine
+        swaps through :meth:`_ContinuousFront.swap_model` (drains
+        in-flight work on the OLD weights, explicit terminals past the
+        grace bound, fresh engine after)."""
+        with self._lock:
+            self.model = model
+            self.params = params
+            self.meta = meta
+            self.tokenizer = tokenizer
+            self.bundle_dir = bundle_dir
+        if self._front is not None:
+            self._front.swap_model(
+                model, params, getattr(tokenizer, "eos_id", None),
+                drain_s=drain_s)
+
+    def _canary(self) -> None:
+        """One tiny generate through the freshly swapped bundle — the
+        gate between 'loaded' and 'serving': only after it returns does
+        the advertised generation advance. Slot-engine servers probe
+        through :meth:`_ContinuousFront.submit_internal`, bypassing the
+        admission/quota gates — a canary 429'd by overload would roll
+        back a good bundle precisely when the system is busiest."""
+        ids = self.tokenizer.encode("canary")
+        if self._front is not None:
+            rid = self._front.submit_internal(ids, 2)
+            self._front.wait(rid, timeout_s=120)
+            return
+        out = self.generate(["canary"], max_new_tokens=2)
+        if not out or "completion" not in out[0]:
+            raise RuntimeError(f"canary generate returned {out!r}")
+
+    def reload_bundle(self, bundle_dir: str, generation=None,
+                      canary: bool = True,
+                      drain_s: float = 30.0) -> dict:
+        """Hot-swap to the bundle at ``bundle_dir`` (the pipeline
+        coordinator's publish path; ``POST /admin/reload``).
+
+        Sequence: load+verify off the driver thread (same retried path
+        as boot) → compat check → swap in (in-flight work drains on the
+        old weights) → canary generate → advance the advertised
+        ``bundle_generation``. A load/compat failure swaps NOTHING; a
+        canary failure reinstalls the previous bundle — either way the
+        old generation keeps serving and the error is typed
+        (:class:`BundleReloadError`, HTTP 502). One reload at a time
+        (:class:`ReloadInFlight`, HTTP 409). Single-host only: a
+        multi-host swap needs the params re-announced to every worker
+        replica — roll the pods instead."""
+        if self.multi_host:
+            raise ValueError(
+                "bundle hot-swap is single-host only — multi-host "
+                "fleets roll pods through the k8s rolling update")
+        if generation is not None:
+            # coerce BEFORE any swap: a malformed generation failing
+            # after the canary would leave the new bundle serving with
+            # the advertised generation never advanced
+            try:
+                generation = int(generation)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"'generation' must be an integer, got "
+                    f"{generation!r}") from None
+        if not self._reload_lock.acquire(blocking=False):
+            raise ReloadInFlight(
+                "a bundle reload is already in flight; retry after it "
+                "settles")
+        try:
+            self.event_log.emit("bundle_reload_started",
+                                bundle=bundle_dir,
+                                current_generation=self.bundle_generation)
+            old = (self.model, self.params, self.meta, self.tokenizer,
+                   self.bundle_dir)
+            try:
+                model, params, meta, tokenizer = (
+                    self._load_and_verify(bundle_dir))
+                self._check_swap_compat(meta, model)
+            except Exception as exc:
+                self._obs["serve_bundle_reloads_total"].labels(
+                    outcome="rejected").inc()
+                self.event_log.emit(
+                    "bundle_reload_failed", bundle=bundle_dir,
+                    rolled_back=False,
+                    error=f"{type(exc).__name__}: {exc}"[:500])
+                raise BundleReloadError(
+                    f"bundle rejected before swap: {exc}",
+                    rolled_back=False) from exc
+            self._install_bundle(model, params, meta, tokenizer,
+                                 bundle_dir, drain_s=drain_s)
+            if canary:
+                try:
+                    self._canary()
+                except Exception as exc:  # noqa: BLE001 — any canary
+                    # failure must leave the OLD generation serving
+                    logger.exception(
+                        "canary generate failed after bundle swap; "
+                        "rolling back to %s", old[4])
+                    self._install_bundle(*old, drain_s=drain_s)
+                    self._obs["serve_bundle_reloads_total"].labels(
+                        outcome="rolled_back").inc()
+                    self.event_log.emit(
+                        "bundle_reload_rolled_back", bundle=bundle_dir,
+                        restored=old[4],
+                        error=f"{type(exc).__name__}: {exc}"[:500])
+                    raise BundleReloadError(
+                        f"canary generate failed (previous bundle "
+                        f"restored): {exc}", rolled_back=True) from exc
+            gen = (generation if generation is not None
+                   else int(meta.get("pipeline_generation",
+                                     self.bundle_generation + 1)))
+            self.bundle_generation = gen
+            self._obs["serve_bundle_generation"].set(gen)
+            self._obs["serve_bundle_reloads_total"].labels(
+                outcome="ok").inc()
+            self.event_log.emit("bundle_reload_succeeded",
+                                bundle=bundle_dir, generation=gen,
+                                canary=bool(canary))
+            logger.info("bundle hot-swapped: %s (generation %d)",
+                        bundle_dir, gen)
+            return {"ok": True, "bundle": bundle_dir,
+                    "bundle_generation": gen, "canary": bool(canary)}
+        finally:
+            self._reload_lock.release()
 
     # -- drain lifecycle -------------------------------------------------
 
@@ -984,6 +1269,7 @@ class BundleServer:
         return {
             "status": "draining" if self.draining else "ok",
             "bundle": self.bundle_dir,
+            "bundle_generation": self.bundle_generation,
             "model": self.meta.get("model"),
             "quantized": bool(self.meta.get("quantized")),
             "vocab_size": self.model.cfg.vocab_size,
@@ -1024,6 +1310,10 @@ class BundleServer:
             "kv_pages_free": None,
             "inflight_http": inflight_http,
             "draining": self.draining,
+            # hot-swap rollout signal: advances only after a successful
+            # swap + canary, so the coordinator's publish confirmation
+            # and the router's prober read the SERVING generation
+            "bundle_generation": self.bundle_generation,
             # radix prefix cache: ACTUAL cache contents + measured hit
             # rate, so the router's affinity can score on what the
             # replica really holds instead of hashed ownership alone
@@ -1331,7 +1621,8 @@ class BundleServer:
                 item = q.get(timeout=600)
                 if isinstance(item, Exception):
                     if isinstance(item, (DeadlineExceeded,
-                                         EngineShutdown)):
+                                         EngineShutdown,
+                                         RequestRejected)):
                         raise item
                     raise RuntimeError(
                         f"continuous engine failed this request: {item}")
@@ -1750,6 +2041,45 @@ def _make_handler(server: BundleServer):
                     out = server.warm_prefix(prefix)
                     server.record_metrics()
                     self._reply(200, out)
+                elif self.path == "/admin/reload":
+                    # bundle hot-swap (the coordinator's publish path).
+                    # Token-gated via env: no SERVE_ADMIN_TOKEN on the
+                    # server -> the endpoint does not exist operationally
+                    # (403); set it and the caller must present it in
+                    # X-Admin-Token. The reload itself serializes (409
+                    # while one is in flight) and rolls back on failure.
+                    if not server.admin_token:
+                        server.record_metrics()
+                        server._obs["serve_bundle_reloads_total"].labels(
+                            outcome="rejected").inc()
+                        return self._reply(403, {
+                            "error": "admin endpoint disabled (set "
+                                     "SERVE_ADMIN_TOKEN to enable)"})
+                    import hmac
+
+                    # constant-time: a byte-wise != would leak the
+                    # token prefix-by-prefix through response timing
+                    if not hmac.compare_digest(
+                            self.headers.get("X-Admin-Token") or "",
+                            server.admin_token):
+                        server.record_metrics()
+                        server._obs["serve_bundle_reloads_total"].labels(
+                            outcome="rejected").inc()
+                        return self._reply(
+                            401, {"error": "bad or missing X-Admin-Token"})
+                    bundle = req.get("bundle")
+                    if not isinstance(bundle, str) or not bundle:
+                        server.record_metrics(failed=True)
+                        return self._reply(
+                            400, {"error": "'bundle' must be a bundle "
+                                           "directory path"})
+                    generation = req.get("generation")
+                    out = server.reload_bundle(
+                        _resolve_bundle(bundle),
+                        generation=generation,
+                        canary=bool(req.get("canary", True)))
+                    server.record_metrics()
+                    self._reply(200, out)
                 elif self.path == "/v1/score":
                     texts = req.get("texts")
                     if not isinstance(texts, list) or not all(
@@ -1777,6 +2107,19 @@ def _make_handler(server: BundleServer):
                 # expiry was detected) carries the signal
                 server.record_metrics()
                 self._reply(504, {"error": str(exc)})
+            except ReloadInFlight as exc:
+                server.record_metrics()
+                server._obs["serve_bundle_reloads_total"].labels(
+                    outcome="rejected").inc()
+                self._reply(409, {"error": str(exc)})
+            except BundleReloadError as exc:
+                # the old generation is serving either way; the body
+                # says whether a swap happened and was rolled back
+                server.record_metrics(failed=True)
+                self._reply(502, {
+                    "error": str(exc),
+                    "rolled_back": exc.rolled_back,
+                    "bundle_generation": server.bundle_generation})
             except (TypeError, ValueError) as exc:
                 # TypeError too: int(None)/float([]) from JSON null/list
                 # field values is caller error, not a server fault
@@ -2024,7 +2367,10 @@ def main(argv=None) -> int:
         max_queued_tokens=args.max_queued_tokens,
         chaos_spec=args.chaos,
         heartbeat_file=args.heartbeat_file,
-        tenants_spec=args.tenants)
+        tenants_spec=args.tenants,
+        # env-only by design: a token flag would leak into ps output
+        # and pod specs; the k8s manifest mounts it from a Secret
+        admin_token=os.environ.get("SERVE_ADMIN_TOKEN", ""))
     if args.chaos:
         logger.warning("serve-side chaos injection ACTIVE: %s", args.chaos)
     logger.info("bundle loaded: %s", server.health())

@@ -1,0 +1,342 @@
+"""Bundle hot-swap on a live BundleServer: the serving half of the
+continuous pipeline (``reload_bundle`` / ``POST /admin/reload``).
+
+What's pinned here: the single-load assumption is GONE — everything
+captured from the bundle at construction (model, params, tokenizer,
+meta, the engine's weights) follows a swap; the advertised
+``bundle_generation`` advances only after a successful swap + canary;
+a corrupt or incompatible publish leaves the old generation serving;
+reloads serialize (409) and are token-gated; a swap landing mid-stream
+gives every in-flight request an explicit terminal outcome."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
+from pyspark_tf_gke_tpu.train.export import export_serving_bundle
+from pyspark_tf_gke_tpu.train.serve import (
+    BundleReloadError,
+    BundleServer,
+    ReloadInFlight,
+    start_http_server,
+)
+from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+CFG = dict(vocab_size=259, hidden_size=32, num_layers=2, num_heads=2,
+           intermediate_size=64, max_seq_len=64, dtype=jnp.float32)
+TOKEN = "test-admin-token"
+
+
+def _export(tmp, name, seed, generation, cfg_overrides=None):
+    cfg = CausalLMConfig(**{**CFG, **(cfg_overrides or {})})
+    model = CausalLM(cfg)
+    params = nn.meta.unbox(jax.jit(model.init)(
+        make_rng(seed), jnp.zeros((1, 8), jnp.int32))["params"])
+    out = str(tmp / name)
+    export_serving_bundle(
+        cfg, params, out, quantize=False,
+        extra_meta={"pipeline_generation": generation})
+    return out
+
+
+@pytest.fixture(scope="module")
+def swap_env(tmp_path_factory):
+    """One continuous-slots server on bundle A (generation 1), plus a
+    same-shape bundle B (different seed → different weights, stamped
+    generation 2) and hostile bundles for the failure paths."""
+    tmp = tmp_path_factory.mktemp("hot-swap")
+    bundle_a = _export(tmp, "A", seed=0, generation=1)
+    bundle_b = _export(tmp, "B", seed=7, generation=2)
+    bundle_vocab = _export(tmp, "V", seed=1, generation=3,
+                           cfg_overrides={"vocab_size": 300})
+    corrupt = tmp / "corrupt"
+    corrupt.mkdir()
+    (corrupt / "config.json").write_text("{definitely not json")
+
+    server = BundleServer(bundle_a, continuous_slots=2,
+                          continuous_chunk=2, prefix_cache_size=2,
+                          admin_token=TOKEN)
+    httpd = start_http_server(server, host="127.0.0.1", port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    env = {
+        "server": server, "url": url,
+        "bundles": {"a": bundle_a, "b": bundle_b,
+                    "vocab": bundle_vocab, "corrupt": str(corrupt)},
+    }
+    yield env
+    httpd.shutdown()
+    server._front.shutdown()
+
+
+def _post(url, path, payload, token=None):
+    headers = {"Content-Type": "application/json"}
+    if token is not None:
+        headers["X-Admin-Token"] = token
+    req = urllib.request.Request(url + path,
+                                 data=json.dumps(payload).encode(),
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read() or b"{}")
+
+
+def _get(url, path):
+    with urllib.request.urlopen(url + path, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _completion(url, prompt, n=8):
+    code, body = _post(url, "/v1/generate",
+                       {"prompts": [prompt], "max_new_tokens": n})
+    assert code == 200, body
+    return body["completions"][0]["completion"]
+
+
+def _reinstall(env, bundle_key, generation):
+    """Reset the module-scoped server to a known bundle between tests."""
+    server = env["server"]
+    server.reload_bundle(env["bundles"][bundle_key],
+                         generation=generation)
+    assert server.bundle_generation == generation
+
+
+def test_loadz_and_healthz_carry_generation(swap_env):
+    load = _get(swap_env["url"], "/loadz")
+    health = _get(swap_env["url"], "/healthz")
+    assert load["bundle_generation"] == health["bundle_generation"]
+    assert load["bundle_generation"] >= 1  # stamped from bundle meta
+
+
+def test_admin_reload_token_gate(swap_env):
+    url, bundles = swap_env["url"], swap_env["bundles"]
+    code, _ = _post(url, "/admin/reload", {"bundle": bundles["b"]})
+    assert code == 401
+    code, _ = _post(url, "/admin/reload", {"bundle": bundles["b"]},
+                    token="wrong")
+    assert code == 401
+    # generation must not have moved on auth failures
+    assert _get(url, "/loadz")["bundle_generation"] == \
+        swap_env["server"].bundle_generation
+
+
+def test_admin_reload_disabled_without_token_config(tmp_path):
+    """No SERVE_ADMIN_TOKEN on the server -> the endpoint does not
+    exist operationally (403 even with a correct-looking header)."""
+    bundle = _export(tmp_path, "solo", seed=3, generation=1)
+    server = BundleServer(bundle)  # no admin_token
+    httpd = start_http_server(server, host="127.0.0.1", port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        code, body = _post(url, "/admin/reload", {"bundle": bundle},
+                           token="anything")
+        assert code == 403
+        assert "disabled" in body["error"]
+    finally:
+        httpd.shutdown()
+
+
+def test_swap_serves_new_weights_and_regresses_nothing_stale(swap_env):
+    """THE single-load regression (ROADMAP item-4(a) gap): generate,
+    swap to a bundle with different weights, and the very next generate
+    must produce the NEW bundle's tokens — engine params, tokenizer,
+    meta, and generation all follow the swap."""
+    url, server = swap_env["url"], swap_env["server"]
+    _reinstall(swap_env, "a", 1)
+    out_a = _completion(url, "hello swap")
+    code, body = _post(url, "/admin/reload",
+                       {"bundle": swap_env["bundles"]["b"]}, token=TOKEN)
+    assert code == 200
+    assert body["ok"] and body["bundle_generation"] == 2
+    out_b = _completion(url, "hello swap")
+    assert out_b != out_a  # different weights actually serve
+
+    # ground truth: a fresh server on bundle B produces exactly this
+    fresh = BundleServer(swap_env["bundles"]["b"])
+    expect = fresh.generate(["hello swap"], max_new_tokens=8)[0][
+        "completion"]
+    assert out_b == expect
+    # generation-stamped surfaces moved together
+    assert _get(url, "/loadz")["bundle_generation"] == 2
+    assert _get(url, "/healthz")["bundle_generation"] == 2
+    assert server.meta.get("pipeline_generation") == 2
+    assert server.bundle_dir == swap_env["bundles"]["b"]
+
+
+def test_corrupt_bundle_leaves_old_generation_serving(swap_env):
+    url = swap_env["url"]
+    _reinstall(swap_env, "a", 1)
+    before = _completion(url, "stability")
+    code, body = _post(url, "/admin/reload",
+                       {"bundle": swap_env["bundles"]["corrupt"]},
+                       token=TOKEN)
+    assert code == 502
+    assert body["rolled_back"] is False  # rejected before any swap
+    assert body["bundle_generation"] == 1
+    assert _get(url, "/loadz")["bundle_generation"] == 1
+    assert _completion(url, "stability") == before
+
+
+def test_incompatible_vocab_rejected(swap_env):
+    url = swap_env["url"]
+    _reinstall(swap_env, "a", 1)
+    code, body = _post(url, "/admin/reload",
+                       {"bundle": swap_env["bundles"]["vocab"]},
+                       token=TOKEN)
+    assert code == 502
+    assert "vocab" in body["error"]
+    assert _get(url, "/loadz")["bundle_generation"] == 1
+
+
+def test_canary_failure_rolls_back_to_previous_bundle(swap_env):
+    """A bundle that loads and passes compat but cannot serve (canary
+    generate fails) must be rolled back: old weights serve, generation
+    does not advance — '/loadz bundle_generation only advances on a
+    successful canary'."""
+    url, server = swap_env["url"], swap_env["server"]
+    _reinstall(swap_env, "a", 1)
+    before = _completion(url, "canary check")
+    orig_canary = server._canary
+    server._canary = lambda: (_ for _ in ()).throw(
+        RuntimeError("canary exploded"))
+    try:
+        with pytest.raises(BundleReloadError) as ei:
+            server.reload_bundle(swap_env["bundles"]["b"])
+        assert ei.value.rolled_back is True
+    finally:
+        server._canary = orig_canary
+    assert server.bundle_generation == 1
+    assert _get(url, "/loadz")["bundle_generation"] == 1
+    assert server.bundle_dir == swap_env["bundles"]["a"]
+    assert _completion(url, "canary check") == before
+
+
+def test_second_reload_conflicts_409(swap_env):
+    url, server = swap_env["url"], swap_env["server"]
+    assert server._reload_lock.acquire(blocking=False)
+    try:
+        code, body = _post(url, "/admin/reload",
+                           {"bundle": swap_env["bundles"]["b"]},
+                           token=TOKEN)
+        assert code == 409
+        with pytest.raises(ReloadInFlight):
+            server.reload_bundle(swap_env["bundles"]["b"])
+    finally:
+        server._reload_lock.release()
+
+
+def test_swap_mid_stream_reaches_explicit_terminal(swap_env):
+    """A swap landing while a stream decodes: the front drains the old
+    engine inside the swap, so the stream finishes its full budget on
+    the OLD weights and terminates with [DONE] — no hang, no silent
+    cut — while the next request serves from the new bundle."""
+    url = swap_env["url"]
+    _reinstall(swap_env, "a", 1)
+    events, done = [], threading.Event()
+
+    def stream():
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            data=json.dumps({"prompt": "mid-stream swap ",
+                             "max_new_tokens": 40,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                for raw in resp:
+                    line = raw.strip()
+                    if line.startswith(b"data: "):
+                        events.append(line[len(b"data: "):].decode())
+        except Exception as exc:  # noqa: BLE001 — recorded for asserts
+            events.append(f"TRANSPORT-ERROR {exc!r}")
+        finally:
+            done.set()
+
+    t = threading.Thread(target=stream)
+    t.start()
+    # wait until the stream actually decodes, then swap under it
+    deadline = 10
+    import time
+
+    t0 = time.monotonic()
+    while not events and time.monotonic() - t0 < deadline:
+        time.sleep(0.01)
+    assert events, "stream never started"
+    code, body = _post(url, "/admin/reload",
+                       {"bundle": swap_env["bundles"]["b"]}, token=TOKEN)
+    assert code == 200, body
+    assert done.wait(60), "stream HUNG through the swap"
+    t.join()
+    assert events[-1] == "[DONE]"
+    bodies = [json.loads(e) for e in events[:-1]
+              if not e.startswith("TRANSPORT-ERROR")]
+    # explicit terminal outcome: the assembled completion or a typed
+    # error event — never silence
+    assert any(b.get("done") or b.get("error") for b in bodies), events
+    # and the post-swap plane serves generation 2
+    assert _get(url, "/loadz")["bundle_generation"] == 2
+    _completion(url, "after the swap")
+
+
+def test_multi_host_reload_refuses(swap_env, monkeypatch):
+    server = swap_env["server"]
+    monkeypatch.setattr(server, "multi_host", True)
+    with pytest.raises(ValueError, match="single-host"):
+        server.reload_bundle(swap_env["bundles"]["b"])
+    monkeypatch.setattr(server, "multi_host", False)
+
+
+def test_warmed_prefixes_dropped_on_swap(swap_env):
+    """warm_prefix retains token lists for rebuild re-warm; a swapped
+    bundle's tokenizer may disagree with them, so the swap drops the
+    retained list instead of replaying stale prefills."""
+    server = swap_env["server"]
+    _reinstall(swap_env, "a", 1)
+    server.warm_prefix("a shared prefix for the cache")
+    assert server._front._warmed
+    _reinstall(swap_env, "b", 2)
+    assert server._front._warmed == []
+
+
+def test_malformed_generation_rejected_before_any_swap(swap_env):
+    """A bad caller-supplied generation must fail at entry — not after
+    the engine swapped, which would leave the new bundle serving under
+    the old advertised generation."""
+    url = swap_env["url"]
+    _reinstall(swap_env, "a", 1)
+    before = _completion(url, "gen guard")
+    code, body = _post(url, "/admin/reload",
+                       {"bundle": swap_env["bundles"]["b"],
+                        "generation": "oops"}, token=TOKEN)
+    assert code == 400
+    assert _get(url, "/loadz")["bundle_generation"] == 1
+    assert _completion(url, "gen guard") == before  # nothing swapped
+
+
+def test_canary_bypasses_admission_gates(swap_env, monkeypatch):
+    """Overload must not veto a rollout: even with every client-facing
+    admission gate shedding, the canary probes the new engine through
+    the internal path and the reload succeeds."""
+    from pyspark_tf_gke_tpu.train.serve import RequestRejected
+
+    server = swap_env["server"]
+    _reinstall(swap_env, "a", 1)
+
+    def shed(*a, **k):
+        raise RequestRejected("queue_full", "synthetic overload",
+                              status=429)
+
+    monkeypatch.setattr(server._front, "_check_admission", shed)
+    out = server.reload_bundle(swap_env["bundles"]["b"], generation=2)
+    assert out["ok"] and out["bundle_generation"] == 2

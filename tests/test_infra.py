@@ -96,9 +96,16 @@ def test_tpu_serve_manifest_conventions():
     c = pod["containers"][0]
     assert c["command"][-1] == "pyspark_tf_gke_tpu.train.serve"
     assert c["ports"][0]["containerPort"] == port
-    env = {e["name"]: e["value"] for e in c["env"]}
+    # secretKeyRef entries (the admin token) carry no literal "value"
+    env = {e["name"]: e.get("value") for e in c["env"]}
     assert env["SERVE_PORT"] == str(port)
     assert env["BUNDLE_DIR"].startswith("gs://")
+    # the hot-swap admin endpoint is enabled from the shared Secret the
+    # pipeline coordinator publishes with (tpu-pipeline.yaml)
+    token_env = next(e for e in c["env"]
+                     if e["name"] == "SERVE_ADMIN_TOKEN")
+    assert token_env["valueFrom"]["secretKeyRef"]["name"] == \
+        "serve-admin-token"
     # startup + readiness stay on /healthz (it answers 503 draining so
     # readiness fails the moment SIGTERM lands)
     for probe in ("startupProbe", "readinessProbe"):
@@ -235,3 +242,57 @@ def test_tpu_serve_multihost_manifest_conventions():
     assert execs[0] == execs[1] == execs[2]
     assert execs[0]["command"][0] == "python"
     assert "urllib.request" in execs[0]["command"][2]
+
+
+def test_tpu_pipeline_manifest_conventions():
+    """The pipeline coordinator Deployment is the reference's bastion
+    made first-party: CPU nodes (no TPU claims), exactly one replica
+    with Recreate (two coordinators racing one state file would
+    double-publish), the admin token from the SAME Secret the serve
+    pods read, replica addressing via the router's headless-Service
+    discovery convention, and heartbeat-age liveness."""
+    docs = _load("infra/k8s/tpu/tpu-pipeline.yaml")
+    secret = next(d for d in docs if d["kind"] == "Secret")
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    assert secret["metadata"]["name"] == "serve-admin-token"
+
+    assert dep["spec"]["replicas"] == 1
+    assert dep["spec"]["strategy"]["type"] == "Recreate"
+    pod = dep["spec"]["template"]["spec"]
+    c = pod["containers"][0]
+    assert c["command"][-1] == "pyspark_tf_gke_tpu.pipeline"
+    # bastion-style: CPU nodes — no TPU resource claims, no TPU
+    # node selector
+    assert "google.com/tpu" not in c.get("resources", {}).get(
+        "requests", {})
+    assert "cloud.google.com/gke-tpu-accelerator" not in pod.get(
+        "nodeSelector", {})
+
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    # rolling publish addresses replicas individually through the
+    # SAME headless Service the router discovers on (tpu-router.yaml)
+    router_docs = _load("infra/k8s/tpu/tpu-router.yaml")
+    headless = next(d for d in router_docs if d["kind"] == "Service"
+                    and d["spec"].get("clusterIP") == "None")
+    assert env["PIPELINE_REPLICAS"] == (
+        f"dns://{headless['metadata']['name']}:"
+        f"{headless['spec']['ports'][0]['port']}")
+    # the publish token comes from the shared Secret (serve pods
+    # mount the same one — test_tpu_serve_manifest_conventions)
+    token_env = next(e for e in c["env"]
+                     if e["name"] == "SERVE_ADMIN_TOKEN")
+    assert token_env["valueFrom"]["secretKeyRef"]["name"] == \
+        secret["metadata"]["name"]
+    # replicas pull bundles by URL; the coordinator writes them on the
+    # FUSE-mounted work dir
+    assert env["PIPELINE_BUNDLE_URL_PREFIX"].startswith("gs://")
+    assert env["PIPELINE_WORK_DIR"].startswith("/gcs/")
+    # SIGTERM drain: finish the stage, persist state, exit 0 — the
+    # grace window must leave real room for a stage tail
+    assert pod["terminationGracePeriodSeconds"] >= 60
+    # liveness = heartbeat AGE (stdlib exec, tpu-worker idiom), beaten
+    # once per stage by the coordinator loop
+    probe = c["livenessProbe"]["exec"]["command"]
+    assert probe[0] == "python"
+    assert "HEARTBEAT_FILE" in probe[2]
+    assert env["HEARTBEAT_FILE"]

@@ -1,0 +1,592 @@
+"""The continuous pipeline plane, minus the device work: shard-set
+manifest semantics (atomicity, generation monotonicity, concurrent
+append vs tail), coordinator control flow (crash resume at the failed
+stage, per-stage retry, SIGTERM-style drain), the rolling publish
+client against stub replicas, the manifest tail data source, and the
+parallel TFRecord shard writer. The jax end of the loop (train →
+export → live hot-swap) lives in tests/test_hot_swap.py and the
+``tools/smoke_check.py --pipeline`` gate."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pyspark_tf_gke_tpu.pipeline import (
+    PipelineCoordinator,
+    PipelineState,
+    ShardSetManifest,
+    StageFailed,
+    resolve_replicas,
+    rolling_publish,
+)
+
+# ---------------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_append_and_read(tmp_path):
+    m = ShardSetManifest(str(tmp_path / "manifest.jsonl"))
+    assert m.generation() == 0
+    assert m.shards() == []
+    g1 = m.append(["a-00000", "a-00001"], meta={"rows": 10})
+    g2 = m.append(["b-00000"])
+    assert (g1, g2) == (1, 2)
+    assert m.generation() == 2
+    assert m.shards() == ["a-00000", "a-00001", "b-00000"]
+    assert m.shards(since_generation=1) == ["b-00000"]
+    recs = m.records()
+    assert [r["generation"] for r in recs] == [1, 2]
+    assert recs[0]["rows"] == 10
+    assert all("landed_at" in r for r in recs)
+
+
+def test_manifest_rejects_empty_shard_set(tmp_path):
+    m = ShardSetManifest(str(tmp_path / "m.jsonl"))
+    with pytest.raises(ValueError):
+        m.append([])
+
+
+def test_manifest_meta_cannot_forge_generation(tmp_path):
+    m = ShardSetManifest(str(tmp_path / "m.jsonl"))
+    m.append(["s"], meta={"generation": 999, "shards": ["forged"]})
+    rec = m.records()[0]
+    assert rec["generation"] == 1
+    assert rec["shards"] == ["s"]
+
+
+def test_manifest_concurrent_append_vs_tail(tmp_path):
+    """8 appender threads × 25 generations with a reader tailing the
+    whole time: every read must parse (atomic rename — no torn lines),
+    generations must never regress mid-tail, and the final manifest
+    holds exactly 200 strictly increasing generations."""
+    path = str(tmp_path / "manifest.jsonl")
+    m = ShardSetManifest(path)
+    stop = threading.Event()
+    reader_problems = []
+
+    def tail():
+        reader = ShardSetManifest(path)
+        last = 0
+        while not stop.is_set():
+            try:
+                recs = reader.records()
+            except Exception as exc:  # noqa: BLE001 — that's the bug
+                reader_problems.append(f"read raised {exc!r}")
+                return
+            gens = [r["generation"] for r in recs]
+            if gens != sorted(gens):
+                reader_problems.append(f"unsorted generations {gens[-5:]}")
+            if gens and gens[-1] < last:
+                reader_problems.append(
+                    f"generation regressed {last} -> {gens[-1]}")
+            last = gens[-1] if gens else last
+
+    def appender(i):
+        for k in range(25):
+            m.append([f"w{i}-{k}"])
+
+    reader = threading.Thread(target=tail)
+    reader.start()
+    writers = [threading.Thread(target=appender, args=(i,))
+               for i in range(8)]
+    for w in writers:
+        w.start()
+    for w in writers:
+        w.join()
+    stop.set()
+    reader.join()
+    assert not reader_problems, reader_problems
+    gens = [r["generation"] for r in m.records()]
+    assert gens == list(range(1, 201))
+
+
+def test_manifest_tolerates_torn_trailing_line(tmp_path):
+    """A writer that bypassed the atomic contract (or a mid-write
+    crash on a non-atomic filesystem) must cost only the torn tail,
+    not the tail source's whole view."""
+    path = str(tmp_path / "m.jsonl")
+    m = ShardSetManifest(path)
+    m.append(["good"])
+    with open(path, "a") as fh:
+        fh.write('{"generation": 2, "shards": ["half')
+    assert [r["generation"] for r in m.records()] == [1]
+    assert m.generation() == 1
+    # the next append rewrites the file whole: the torn line is gone
+    assert m.append(["next"]) == 2
+    assert [r["generation"] for r in m.records()] == [1, 2]
+
+
+def test_manifest_wait_for_generation(tmp_path):
+    m = ShardSetManifest(str(tmp_path / "m.jsonl"))
+    assert not m.wait_for_generation(1, timeout_s=0.1)
+    t = threading.Thread(target=lambda: (time.sleep(0.1),
+                                         m.append(["s"])))
+    t.start()
+    assert m.wait_for_generation(1, timeout_s=5)
+    t.join()
+
+
+# ---------------------------------------------------------------------------
+# coordinator
+# ---------------------------------------------------------------------------
+
+
+def _stage_map(calls, fail=None):
+    """Stub stages recording (name, round); ``fail`` maps a stage name
+    to a callable(state) -> bool deciding whether to raise."""
+    def mk(name):
+        def fn(state, outputs):
+            calls.append((name, state.round))
+            if fail and name in fail and fail[name](state):
+                raise RuntimeError(f"{name} boom")
+            return {"stage": name, "round": state.round,
+                    **({"landed_at": time.time()} if name == "ingest"
+                       else {}),
+                    **({"published": 1, "generation": state.round}
+                       if name == "publish" else {})}
+        return fn
+
+    return {n: mk(n) for n in ("ingest", "train", "export", "publish")}
+
+
+def test_coordinator_runs_rounds_in_stage_order(tmp_path):
+    calls = []
+    coord = PipelineCoordinator(
+        _stage_map(calls), state_path=str(tmp_path / "state.json"),
+        rounds=2, retry_base_delay_s=0)
+    assert coord.run() == 0
+    assert calls == [(s, r) for r in (1, 2)
+                     for s in ("ingest", "train", "export", "publish")]
+    assert coord.state.completed_rounds == 2
+    assert coord.state.bundle_generation == 2
+
+
+def test_coordinator_resumes_at_failed_stage(tmp_path):
+    """Crash mid-round: the state file points at the failed stage, and
+    a NEW coordinator process re-enters the round exactly there — the
+    already-completed ingest/train/export must not rerun."""
+    state_path = str(tmp_path / "state.json")
+    calls = []
+    coord = PipelineCoordinator(
+        _stage_map(calls, fail={"publish": lambda s: True}),
+        state_path=state_path, rounds=1, stage_attempts=1,
+        retry_base_delay_s=0)
+    with pytest.raises(StageFailed) as ei:
+        coord.run()
+    assert ei.value.stage == "publish"
+    assert [c[0] for c in calls] == ["ingest", "train", "export",
+                                    "publish"]
+    # the durable state survived the "crash"
+    st = PipelineState(state_path)
+    assert st.round == 1
+    assert st.stage_index == 3  # publish
+    assert set(st.outputs) == {"ingest", "train", "export"}
+
+    calls2 = []
+    coord2 = PipelineCoordinator(
+        _stage_map(calls2), state_path=state_path, rounds=1,
+        retry_base_delay_s=0)
+    assert coord2.run() == 0
+    # ONLY the failed stage ran on resume
+    assert calls2 == [("publish", 1)]
+    assert coord2.state.completed_rounds == 1
+
+
+def test_coordinator_stage_retry_consumes_transient_failure(tmp_path):
+    calls = []
+    seen = {"failed": False}
+
+    def once(state):
+        if not seen["failed"]:
+            seen["failed"] = True
+            return True
+        return False
+
+    coord = PipelineCoordinator(
+        _stage_map(calls, fail={"train": once}),
+        state_path=str(tmp_path / "state.json"), rounds=1,
+        stage_attempts=2, retry_base_delay_s=0)
+    assert coord.run() == 0
+    assert [c[0] for c in calls] == ["ingest", "train", "train",
+                                    "export", "publish"]
+
+
+def test_coordinator_drain_finishes_current_round(tmp_path):
+    """request_stop mid-round (the SIGTERM handler's path) finishes the
+    round in flight — stages already paid for complete — then exits 0
+    instead of starting another round."""
+    calls = []
+    coord = PipelineCoordinator(_stage_map(calls), rounds=0,
+                                state_path=str(tmp_path / "state.json"),
+                                retry_base_delay_s=0)
+    orig = coord.stages["train"]
+
+    def stop_during_train(state, outputs):
+        coord.request_stop()
+        return orig(state, outputs)
+
+    # the coordinator copies the stage map at construction — mutate its
+    # own copy so the stop lands mid-round
+    coord.stages["train"] = stop_during_train
+    assert coord.run() == 0
+    assert coord.state.completed_rounds == 1
+    assert [c[0] for c in calls] == ["ingest", "train", "export",
+                                    "publish"]
+
+
+def test_coordinator_freshness_and_round_metrics(tmp_path):
+    from pyspark_tf_gke_tpu.obs.metrics import (
+        MetricsRegistry,
+        platform_families,
+    )
+
+    reg = MetricsRegistry()
+    obs = platform_families(reg)
+    calls = []
+    coord = PipelineCoordinator(
+        _stage_map(calls), state_path=str(tmp_path / "state.json"),
+        rounds=1, retry_base_delay_s=0, obs=obs)
+    coord.run()
+    assert obs["pipeline_rounds_total"].value == 1
+    assert obs["pipeline_bundle_generation"].value == 1
+    assert obs["pipeline_freshness_seconds"].value >= 0
+    # one observation per stage
+    assert obs["pipeline_stage_seconds"].labels(stage="train").count == 1
+
+
+def test_state_file_is_atomic_json(tmp_path):
+    st = PipelineState(str(tmp_path / "state.json"))
+    st.round = 3
+    st.stage_index = 2
+    st.outputs = {"ingest": {"rows": 5}}
+    st.extra = {"train_progress": {"consumed_batches": 12}}
+    st.save()
+    with open(st.path) as fh:
+        data = json.load(fh)
+    assert data["round"] == 3
+    st2 = PipelineState(st.path)
+    assert (st2.round, st2.stage_index) == (3, 2)
+    assert st2.extra["train_progress"]["consumed_batches"] == 12
+
+
+# ---------------------------------------------------------------------------
+# rolling publish (stub replicas — no jax, no model)
+# ---------------------------------------------------------------------------
+
+
+class _StubReplica:
+    """Minimal /admin/reload + /loadz pair with scriptable verdicts."""
+
+    def __init__(self, token="tok", reload_status=200, confirm=True):
+        import http.server
+
+        self.token = token
+        self.reload_status = reload_status
+        self.confirm = confirm
+        self.generation = 1
+        self.reload_calls = []
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._reply(200, {"bundle_generation": stub.generation,
+                                  "draining": False})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                stub.reload_calls.append(
+                    (time.monotonic(),
+                     self.headers.get("X-Admin-Token"), req))
+                if self.headers.get("X-Admin-Token") != stub.token:
+                    return self._reply(401, {"error": "bad token"})
+                if stub.reload_status != 200:
+                    return self._reply(stub.reload_status,
+                                       {"error": "scripted failure",
+                                        "rolled_back": True})
+                if stub.confirm:
+                    stub.generation = int(req["generation"])
+                self._reply(200, {"ok": True,
+                                  "bundle_generation": req["generation"]})
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                     Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def test_rolling_publish_all_replicas(tmp_path):
+    reps = [_StubReplica() for _ in range(3)]
+    try:
+        out = rolling_publish([r.url for r in reps], "/b", 2,
+                              token="tok", max_unavailable=1,
+                              confirm_timeout_s=5)
+        assert out["ok"] and out["published"] == 3
+        assert all(r.generation == 2 for r in reps)
+        # max_unavailable=1: strictly sequential — each replica's
+        # reload lands only after the previous one confirmed
+        times = [r.reload_calls[0][0] for r in reps]
+        assert times == sorted(times)
+    finally:
+        for r in reps:
+            r.close()
+
+
+def test_rolling_publish_stops_on_failure(tmp_path):
+    reps = [_StubReplica(), _StubReplica(reload_status=502),
+            _StubReplica()]
+    try:
+        out = rolling_publish([r.url for r in reps], "/b", 2,
+                              token="tok", max_unavailable=1,
+                              confirm_timeout_s=5)
+        assert not out["ok"]
+        assert out["published"] == 1
+        # the rollout stopped: replica 3 was never touched
+        assert reps[2].reload_calls == []
+        assert reps[2].generation == 1
+    finally:
+        for r in reps:
+            r.close()
+
+
+def test_rolling_publish_fails_without_confirmation(tmp_path):
+    rep = _StubReplica(confirm=False)  # 200 but /loadz never advances
+    try:
+        out = rolling_publish([rep.url], "/b", 2, token="tok",
+                              confirm_timeout_s=0.5)
+        assert not out["ok"]
+        assert "never confirmed" in out["results"][0]["body"]["error"]
+    finally:
+        rep.close()
+
+
+def test_rolling_publish_bad_token_fails(tmp_path):
+    rep = _StubReplica(token="right")
+    try:
+        out = rolling_publish([rep.url], "/b", 2, token="wrong",
+                              confirm_timeout_s=1)
+        assert not out["ok"]
+        assert out["results"][0]["status"] == 401
+        assert rep.generation == 1
+    finally:
+        rep.close()
+
+
+def test_resolve_replicas_literals_and_dns():
+    assert resolve_replicas("http://a:1, http://b:2/") == [
+        "http://a:1", "http://b:2"]
+    # localhost resolves somewhere on every box
+    urls = resolve_replicas("dns://localhost:8123")
+    assert urls and all(u.endswith(":8123") for u in urls)
+    assert resolve_replicas("") == []
+
+
+# ---------------------------------------------------------------------------
+# parallel shard writer + manifest tail source
+# ---------------------------------------------------------------------------
+
+
+def _arrays(n=101, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 100, (n, seq)).astype(np.int64),
+            "label": rng.integers(0, 2, (n,)).astype(np.int64)}
+
+
+def test_parallel_writer_bytes_match_serial(tmp_path):
+    from pyspark_tf_gke_tpu.data.native_tfrecord import (
+        write_tfrecord_shards,
+    )
+
+    arrays = _arrays()
+    serial = write_tfrecord_shards(arrays, str(tmp_path / "s"),
+                                   num_shards=4, num_workers=1)
+    threaded = write_tfrecord_shards(arrays, str(tmp_path / "p"),
+                                     num_shards=4, num_workers=4)
+    assert len(serial) == len(threaded) == 4
+    for a, b in zip(serial, threaded):
+        with open(a, "rb") as fa, open(b, "rb") as fb:
+            assert fa.read() == fb.read()
+
+
+def test_parallel_writer_relays_worker_exception(tmp_path):
+    from pyspark_tf_gke_tpu.data.native_tfrecord import (
+        write_tfrecord_shards,
+    )
+
+    arrays = _arrays(n=40)
+    # a schema naming a missing column fails INSIDE the worker threads;
+    # the exception must surface at the caller, with no torn shard
+    # files left for a manifest to pick up
+    bad_schema = {"input_ids": ("int", (16,)),
+                  "missing": ("int", ())}
+    with pytest.raises(KeyError):
+        write_tfrecord_shards(arrays, str(tmp_path / "bad"),
+                              num_shards=4, num_workers=4,
+                              schema=bad_schema)
+    assert not [p for p in os.listdir(tmp_path)
+                if p.startswith("bad-")]
+
+
+def test_tail_source_picks_up_generation_at_epoch_boundary(tmp_path):
+    from pyspark_tf_gke_tpu.data.native_tfrecord import (
+        ManifestTailSource,
+        write_tfrecord_shards,
+    )
+    from pyspark_tf_gke_tpu.data.tfrecord import schema_for
+
+    arrays = _arrays(n=64)
+    schema = schema_for(arrays)
+    manifest = str(tmp_path / "manifest.jsonl")
+    m = ShardSetManifest(manifest)
+    m.append(write_tfrecord_shards(arrays, str(tmp_path / "g1"),
+                                   num_shards=2))
+    src = ManifestTailSource(manifest, schema, 8, wait_timeout_s=5)
+    spe1 = src._it.steps_per_epoch
+    assert spe1 == 8  # 64 rows / batch 8
+    for _ in range(3):
+        batch = next(src)
+        assert batch["input_ids"].dtype == np.int32
+        assert batch["input_ids"].shape == (8, 16)
+    # a generation lands MID-epoch: the current pass must not change...
+    m.append(write_tfrecord_shards(_arrays(n=32, seed=1),
+                                   str(tmp_path / "g2"), num_shards=2))
+    for _ in range(spe1 - 3):
+        next(src)
+    assert src._it.n == 64
+    # ...and the next epoch includes it
+    next(src)
+    assert src._it.n == 96
+    assert src.data_generation == 2
+
+
+def test_tail_source_resume_replays_exact_stream(tmp_path):
+    from pyspark_tf_gke_tpu.data.native_tfrecord import (
+        ManifestTailSource,
+        write_tfrecord_shards,
+    )
+    from pyspark_tf_gke_tpu.data.tfrecord import schema_for
+
+    arrays = _arrays(n=40)
+    schema = schema_for(arrays)
+    manifest = str(tmp_path / "m.jsonl")
+    ShardSetManifest(manifest).append(
+        write_tfrecord_shards(arrays, str(tmp_path / "g1"), num_shards=2))
+    src = ManifestTailSource(manifest, schema, 8, wait_timeout_s=5)
+    stream = [next(src)["input_ids"] for _ in range(12)]  # 2.4 epochs
+    assert src.consumed_batches == 12
+    # a fresh source (the restarted coordinator) fast-forwards to any
+    # persisted offset and replays the identical remaining stream
+    for offset in (0, 5, 7, 11):
+        resumed = ManifestTailSource(manifest, schema, 8,
+                                     consumed_batches=offset,
+                                     wait_timeout_s=5)
+        replay = [next(resumed)["input_ids"]
+                  for _ in range(12 - offset)]
+        for want, got in zip(stream[offset:], replay):
+            np.testing.assert_array_equal(want, got)
+
+
+def test_tail_source_times_out_on_empty_manifest(tmp_path):
+    from pyspark_tf_gke_tpu.data.native_tfrecord import ManifestTailSource
+    from pyspark_tf_gke_tpu.data.tfrecord import schema_for
+
+    schema = schema_for(_arrays(n=2))
+    with pytest.raises(FileNotFoundError):
+        ManifestTailSource(str(tmp_path / "m.jsonl"), schema, 8,
+                           wait_timeout_s=0.2)
+
+
+def test_etl_bridges_append_manifest_generation(tmp_path):
+    """The Spark bridge actions append their COMPLETED shard set to the
+    manifest (one generation per action) — stubbed Spark chain, real
+    writer bodies, so no cluster needed."""
+    from pyspark_tf_gke_tpu.etl.text_bridge import write_token_shards
+
+    class _FakeRDD:
+        def __init__(self, parts):
+            self._parts = parts
+
+        def mapPartitionsWithIndex(self, fn):
+            out = []
+            for i, part in enumerate(self._parts):
+                out.extend(fn(i, iter(part)))
+            return _FakeCollected(out)
+
+    class _FakeCollected:
+        def __init__(self, items):
+            self._items = items
+
+        def collect(self):
+            return self._items
+
+    class _FakeDF:
+        def __init__(self, parts):
+            self._parts = parts
+
+        def select(self, col):
+            return self
+
+        def repartition(self, n):
+            assert n == len(self._parts)
+            return self
+
+        @property
+        def rdd(self):
+            return _FakeRDD(self._parts)
+
+    docs = [[{"text": "spark feeds the tpu"}],
+            [{"text": "the tpu trains the bundle"}]]
+    manifest = str(tmp_path / "manifest.jsonl")
+    paths = write_token_shards(
+        _FakeDF(docs), str(tmp_path / "corpus"), seq_len=16,
+        num_shards=2, manifest_path=manifest)
+    m = ShardSetManifest(manifest)
+    rec = m.records()[-1]
+    assert rec["generation"] == 1
+    assert rec["shards"] == paths
+    assert rec["source"] == "etl.text_bridge"
+    assert all(os.path.exists(p) for p in paths)
+
+
+def test_ingest_stage_is_idempotent_per_round(tmp_path):
+    """Crash-resume safety: re-running ingest for a round whose
+    generation already landed must NOT append a duplicate (duplicate
+    rows would skew every later epoch's length and the
+    consumed-batches resume accounting)."""
+    from types import SimpleNamespace
+
+    from pyspark_tf_gke_tpu.pipeline.stages import (
+        LocalPipelineConfig,
+        ingest_stage,
+    )
+
+    cfg = LocalPipelineConfig(work_dir=str(tmp_path), rows_per_round=8,
+                              seq_len=16, num_shards=2)
+    ingest = ingest_stage(cfg)
+    state = SimpleNamespace(round=1)
+    first = ingest(state, {})
+    again = ingest(state, {})
+    assert first["data_generation"] == again["data_generation"] == 1
+    m = ShardSetManifest(cfg.manifest_path)
+    assert m.generation() == 1
+    # a NEW round still appends
+    assert ingest(SimpleNamespace(round=2), {})["data_generation"] == 2

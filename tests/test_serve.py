@@ -339,6 +339,7 @@ def test_loadz_snapshot_key_stability(cb_endpoints):
     plain_url, cont_url = cb_endpoints
     want_keys = {"queued", "queued_tokens", "active", "slots_total",
                  "kv_pages_free", "inflight_http", "draining",
+                 "bundle_generation",
                  "prefix_cache_pages", "prefix_hit_rate",
                  "capacity_free", "queue_delay_ms", "tenants"}
     for url in (plain_url, cont_url):

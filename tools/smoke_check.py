@@ -46,9 +46,16 @@ threads vs one serial light tenant — the light tenant completes every
 request with bounded p99 while every shed the flood draws is a
 PER-TENANT 429 (tenant_quota / tenant_queue_full), never a global one.
 
+``--pipeline`` checks the continuous ETL→train→publish loop end to
+end: two coordinator rounds (ingest synthetic rows → native TFRecord
+manifest → train → export), a live CPU replica hot-swapped to the new
+bundle generation MID generate-stream (explicit stream terminal, zero
+drops), a corrupt-bundle publish rolled off with the old generation
+intact, and a clean SIGTERM drain.
+
 Usage: python tools/smoke_check.py
        [--lint-only|--kernels-only|--serve-lifecycle|--serve-tbt|
-        --router|--prefix-cache|--fairness]
+        --router|--prefix-cache|--fairness|--pipeline]
 """
 
 import os
@@ -150,7 +157,18 @@ def lint_duplicate_metrics() -> int:
                 "router_capacity_free_total",
                 "router_demand_tokens_total",
                 "router_queue_delay_ms",
-                "router_tenant_sheds_total"}
+                "router_tenant_sheds_total",
+                # continuous pipeline plane: the coordinator's round
+                # loop and the serving fleet's hot-swap rollout signal
+                # (docs/PIPELINE.md) — the publish confirmation reads
+                # bundle_generation, so these names are load-bearing
+                "pipeline_rounds_total",
+                "pipeline_stage_seconds",
+                "pipeline_stage_failures_total",
+                "pipeline_bundle_generation",
+                "pipeline_freshness_seconds",
+                "serve_bundle_generation",
+                "serve_bundle_reloads_total"}
     absent = {n for n in required if n not in _REGISTRATIONS}
     if absent:
         print("metric lint FAILED — required metric name(s) never "
@@ -902,6 +920,223 @@ def fairness_check(grace_s: float = 30.0) -> int:
     return 0
 
 
+def pipeline_check(grace_s: float = 90.0) -> int:
+    """``--pipeline``: the continuous ETL→train→publish loop end to end
+    on a CPU box (docs/PIPELINE.md), with the hot-swap exercised the
+    way production will hit it — MID-STREAM:
+
+    1. round 1 (in-process coordinator): ingest synthetic rows → native
+       TFRecord shards + manifest generation 1 → train a few steps →
+       export bundle generation 1 (no replicas yet);
+    2. a BundleServer subprocess serves generation 1 (admin token set);
+    3. round 2 runs with the replica configured; its publish stage
+       first opens a generate STREAM against the replica and waits for
+       the first token event, then fires the rolling publish — the
+       swap lands with the stream in flight;
+    4. require: the stream reaches an explicit terminal ([DONE], with
+       either its full completion or a typed error event — never a
+       hang or silent cut), /loadz advertises bundle_generation 2, a
+       post-swap generate serves, and pipeline_freshness_seconds was
+       recorded;
+    5. a corrupt-bundle publish must FAIL the rollout while the
+       replica keeps serving generation 2 (rollback contract);
+    6. SIGTERM → the server drains and exits 0.
+    """
+    import dataclasses
+    import json as _json
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    from pyspark_tf_gke_tpu.obs.metrics import platform_families
+    from pyspark_tf_gke_tpu.pipeline import (
+        LocalPipelineConfig,
+        PipelineCoordinator,
+        make_local_stages,
+        rolling_publish,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="pipeline-smoke-")
+    token = "smoke-token"
+    cfg = LocalPipelineConfig(
+        work_dir=tmp, rows_per_round=96, seq_len=64, num_shards=2,
+        steps_per_round=3, batch_size=4, hidden_size=32, num_layers=2,
+        num_heads=2, intermediate_size=64)
+    state_path = os.path.join(tmp, "state.json")
+    failures = []
+
+    print("pipeline round 1: ingest -> train -> export ...")
+    PipelineCoordinator(make_local_stages(cfg), state_path=state_path,
+                        rounds=1).run()
+    bundle1 = cfg.bundle_dir(1)
+    if not os.path.exists(os.path.join(bundle1, "config.json")):
+        print(f"round 1 produced no bundle at {bundle1}")
+        return 1
+
+    with socket.socket() as s:  # free port; tiny reuse race is fine here
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    url = f"http://127.0.0.1:{port}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SERVE_ADMIN_TOKEN=token)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pyspark_tf_gke_tpu.train.serve",
+         "--bundle", bundle1, "--host", "127.0.0.1", "--port", str(port),
+         "--continuous-slots", "2", "--continuous-chunk", "2",
+         "--drain-timeout", "30"],
+        env=env)
+
+    def get(path: str) -> dict:
+        with urllib.request.urlopen(url + path, timeout=5) as resp:
+            return _json.loads(resp.read())
+
+    def post(payload: dict, timeout: float = 120.0) -> dict:
+        req = urllib.request.Request(
+            url + "/v1/generate", data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return _json.loads(resp.read())
+
+    stream_out: dict = {"events": []}
+    first_event = threading.Event()
+
+    def stream():
+        """One SSE generate held open across the swap; every line
+        recorded so the terminal contract is checkable."""
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            data=_json.dumps({"prompt": "pipeline smoke ",
+                              "max_new_tokens": 40,
+                              "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                for raw in resp:
+                    line = raw.strip()
+                    if line.startswith(b"data: "):
+                        stream_out["events"].append(
+                            line[len(b"data: "):].decode())
+                        first_event.set()
+        except Exception as exc:  # noqa: BLE001 — checked below
+            stream_out["error"] = repr(exc)
+        finally:
+            first_event.set()
+
+    try:
+        deadline = _time.time() + 180
+        while _time.time() < deadline:
+            try:
+                if get("/loadz").get("bundle_generation") == 1:
+                    break
+            except Exception:  # noqa: BLE001 — still booting
+                if proc.poll() is not None:
+                    print(f"server died during startup (rc={proc.poll()})")
+                    return 1
+            _time.sleep(0.5)
+        else:
+            print("server never became healthy")
+            return 1
+        post({"prompts": ["warm"], "max_new_tokens": 2})  # compile now
+
+        # round 2: same coordinator state, replica configured — but the
+        # publish stage opens the stream FIRST so the swap is provably
+        # mid-flight
+        cfg2 = dataclasses.replace(cfg, replicas=(url,),
+                                   admin_token=token)
+        stages = make_local_stages(cfg2)
+        real_publish = stages["publish"]
+
+        def publish_with_stream_in_flight(state, outputs):
+            t = threading.Thread(target=stream, name="smoke-stream")
+            t.start()
+            if not first_event.wait(30):
+                raise RuntimeError("stream never delivered its first "
+                                   "event before the publish")
+            out = real_publish(state, outputs)
+            out["stream_thread_started"] = True
+            return out
+
+        stages["publish"] = publish_with_stream_in_flight
+        print("pipeline round 2: ingest -> train -> export -> publish "
+              "(hot-swap mid-stream) ...")
+        PipelineCoordinator(stages, state_path=state_path, rounds=2).run()
+
+        t = [x for x in threading.enumerate()
+             if x.name == "smoke-stream"]
+        if t:
+            t[0].join(timeout=grace_s)
+            if t[0].is_alive():
+                failures.append("in-flight stream HUNG through the swap")
+        events = stream_out["events"]
+        if "error" in stream_out:
+            failures.append(f"stream transport error: {stream_out['error']}")
+        elif not events or events[-1] != "[DONE]":
+            failures.append(f"stream lacks a [DONE] terminal: {events[-2:]}")
+        else:
+            # explicit outcome: either the assembled completion ("done")
+            # or a typed error event — silence is the only failure
+            bodies = [_json.loads(e) for e in events[:-1] if e != "[DONE]"]
+            if not any(b.get("done") or b.get("error") for b in bodies):
+                failures.append(
+                    f"stream ended without an explicit outcome event "
+                    f"({len(bodies)} events)")
+
+        load = get("/loadz")
+        if load.get("bundle_generation") != 2:
+            failures.append(f"post-publish bundle_generation "
+                            f"{load.get('bundle_generation')}, want 2")
+        out = post({"prompts": ["after swap"], "max_new_tokens": 4})
+        if "completions" not in out:
+            failures.append(f"post-swap generate failed: {out}")
+        fresh = platform_families()["pipeline_freshness_seconds"].value
+        if not fresh > 0:
+            failures.append(f"pipeline_freshness_seconds not recorded "
+                            f"({fresh})")
+
+        # rollback: a corrupt bundle publish must leave gen 2 serving
+        bad = os.path.join(tmp, "corrupt-bundle")
+        os.makedirs(bad, exist_ok=True)
+        with open(os.path.join(bad, "config.json"), "w") as fh:
+            fh.write("{this is not json")
+        report = rolling_publish([url], bad, 3, token=token)
+        if report["ok"] or report["published"]:
+            failures.append(f"corrupt publish REPORTED success: {report}")
+        load = get("/loadz")
+        if load.get("bundle_generation") != 2:
+            failures.append(
+                f"corrupt publish moved bundle_generation to "
+                f"{load.get('bundle_generation')} (want 2 still serving)")
+        out = post({"prompts": ["still serving"], "max_new_tokens": 4})
+        if "completions" not in out:
+            failures.append(f"generate after corrupt publish failed: {out}")
+
+        proc.send_signal(signal.SIGTERM)
+        try:
+            rc = proc.wait(timeout=grace_s)
+            if rc != 0:
+                failures.append(f"server exited {rc} after SIGTERM, want 0")
+        except subprocess.TimeoutExpired:
+            failures.append(f"server still alive {grace_s}s after SIGTERM")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    if failures:
+        print("pipeline FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("pipeline OK: 2 rounds ingest->train->export->publish; "
+          "hot-swap landed mid-stream with an explicit stream terminal; "
+          "generation 2 serving; corrupt publish rolled off with the old "
+          "generation intact; server drained 0")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--kernels-only" in argv:
@@ -916,6 +1151,8 @@ def main(argv=None) -> int:
         return prefix_cache_check()
     if "--fairness" in argv:
         return fairness_check()
+    if "--pipeline" in argv:
+        return pipeline_check()
     if "--lint-only" not in argv:
         devices = jax.devices()
         print(f"devices: {devices}")
