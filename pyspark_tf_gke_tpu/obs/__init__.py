@@ -17,7 +17,11 @@ single metrics plane they all converge on:
   (RSS, device count, live-array bytes), guarded so CPU-only CI runs;
 * :mod:`~pyspark_tf_gke_tpu.obs.export` — node-exporter textfile
   writer (atomic rename on an interval thread) and the ``/metrics`` +
-  ``/events`` HTTP handler logic the serving plane mounts.
+  ``/events`` + ``/traces`` HTTP handler logic the serving plane
+  mounts;
+* :mod:`~pyspark_tf_gke_tpu.obs.trace` — end-to-end request tracing:
+  W3C ``traceparent`` propagation, contextvar-carried spans, and a
+  bounded flight recorder with sampling + always-on slow capture.
 
 Naming scheme (enforced by tools/smoke_check.py's duplicate lint and
 documented in docs/OBSERVABILITY.md): ``<plane>_<thing>_<unit>`` with
@@ -46,6 +50,15 @@ from pyspark_tf_gke_tpu.obs.metrics import (
     platform_families,
     set_registry,
 )
+from pyspark_tf_gke_tpu.obs.trace import (
+    Span,
+    TraceRecorder,
+    current_span,
+    current_trace_id,
+    format_traceparent,
+    parse_traceparent,
+    use_span,
+)
 
 __all__ = [
     "Counter",
@@ -62,4 +75,11 @@ __all__ = [
     "append_jsonl_line",
     "get_event_log",
     "set_event_log",
+    "Span",
+    "TraceRecorder",
+    "current_span",
+    "current_trace_id",
+    "format_traceparent",
+    "parse_traceparent",
+    "use_span",
 ]

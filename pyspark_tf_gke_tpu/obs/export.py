@@ -92,12 +92,15 @@ class TextfileExporter:
 def handle_obs_request(
         path: str, registry: MetricsRegistry,
         event_log: Optional[EventLog] = None,
-        extra_exposition: str = "") -> Optional[Tuple[int, str, bytes]]:
+        extra_exposition: str = "",
+        tracer=None) -> Optional[Tuple[int, str, bytes]]:
     """GET dispatch for the observability endpoints.
 
     Returns ``(status, content_type, body)`` for ``/metrics``,
-    ``/metrics.json`` and ``/events[?n=N]``, or ``None`` for paths this
-    module doesn't own (caller falls through to its own routes).
+    ``/metrics.json``, ``/events[?n=N]`` and (when ``tracer`` — an
+    ``obs.trace.TraceRecorder`` — is provided)
+    ``/traces[?slow_ms=F&trace_id=HEX&n=N]``, or ``None`` for paths
+    this module doesn't own (caller falls through to its own routes).
     ``extra_exposition`` is appended verbatim to ``/metrics`` — the
     serving front uses it for its legacy-name alias block.
     """
@@ -119,5 +122,25 @@ def handle_obs_request(
         events = event_log.tail(n) if event_log is not None else []
         body = json.dumps({"events": events,
                            "path": getattr(event_log, "path", None)})
+        return 200, "application/json", body.encode()
+    if route == "/traces" and tracer is not None:
+        slow_ms = trace_id = None
+        n = 64
+        for part in query.split("&"):
+            key, _, val = part.partition("=")
+            try:
+                if key == "slow_ms" and val:
+                    slow_ms = float(val)
+                elif key == "trace_id" and val:
+                    trace_id = val
+                elif key == "n" and val:
+                    n = max(1, min(int(val), 1024))
+            except ValueError:
+                return (400, "application/json",
+                        b'{"error": "bad /traces query parameter"}')
+        body = json.dumps({**tracer.snapshot(),
+                           "traces": tracer.traces(
+                               slow_ms=slow_ms, trace_id=trace_id,
+                               limit=n)})
         return 200, "application/json", body.encode()
     return None

@@ -264,12 +264,20 @@ class Histogram(_Metric):
         self._counts = [0] * len(bs)
         self._sum = 0.0
         self._count = 0
+        # last exemplar (trace id) per bucket index — JSON snapshot
+        # only; the Prometheus text output is unchanged (the 0.0.4
+        # text format has no exemplar syntax)
+        self._exemplars: Dict[int, str] = {}
 
     def _make_child(self) -> "Histogram":
         # children share the parent's bucket layout, not the defaults
         return Histogram(self.name, self.help, buckets=self.buckets)
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
+        """Record one observation. ``exemplar`` (optional): a trace id
+        to remember as this bucket's LAST exemplar — surfaced in the
+        JSON snapshot so a latency bucket links to a concrete trace in
+        ``GET /traces`` (Prometheus text exposition unchanged)."""
         v = float(value)
         with self._lock:
             self._sum += v
@@ -284,6 +292,8 @@ class Histogram(_Metric):
                 else:
                     lo = mid + 1
             self._counts[lo] += 1
+            if exemplar is not None:
+                self._exemplars[lo] = str(exemplar)
 
     @property
     def count(self) -> int:
@@ -297,12 +307,13 @@ class Histogram(_Metric):
 
     def _state(self):
         with self._lock:
-            return list(self._counts), self._sum, self._count
+            return (list(self._counts), self._sum, self._count,
+                    dict(self._exemplars))
 
     def _expose(self) -> List[str]:
         lines: List[str] = []
         for lv, leaf in self._series():
-            counts, total, n = leaf._state()
+            counts, total, n, _ = leaf._state()
             cum = 0
             for ub, c in zip(leaf.buckets, counts):
                 cum += c
@@ -316,10 +327,17 @@ class Histogram(_Metric):
         return lines
 
     def _snapshot_one(self):
-        counts, total, n = self._state()
-        return {"count": n, "sum": total,
-                "buckets": {_format_value(ub): c
-                            for ub, c in zip(self.buckets, counts)}}
+        counts, total, n, exemplars = self._state()
+        out = {"count": n, "sum": total,
+               "buckets": {_format_value(ub): c
+                           for ub, c in zip(self.buckets, counts)}}
+        if exemplars:
+            # per-bucket last trace id (keyed by the bucket's upper
+            # bound) — join a tail bucket to its trace in GET /traces
+            out["exemplars"] = {
+                _format_value(self.buckets[i]): tid
+                for i, tid in sorted(exemplars.items())}
+        return out
 
 
 class MetricsRegistry:
@@ -630,6 +648,13 @@ def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "Data-landed -> serving-traffic latency of the last "
             "published round: publish confirmation time minus the "
             "round's ingest manifest landing time"),
+        # request tracing (obs/trace.py flight recorder on the serve
+        # plane; the retention rate — sampled + slow-captured traces
+        # entering the GET /traces ring)
+        "serve_traces_recorded_total": r.counter(
+            "serve_traces_recorded_total",
+            "Traces retained into the serve plane's flight-recorder "
+            "ring (sampled, or slower than --trace-slow-ms)"),
         # data plane
         "data_prefetch_queue_depth": r.gauge(
             "data_prefetch_queue_depth",
@@ -704,6 +729,10 @@ def router_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "Requests this router currently has in flight per tenant "
             "(the hedge/spill budget accounting)",
             labelnames=("tenant",)),
+        "router_traces_recorded_total": r.counter(
+            "router_traces_recorded_total",
+            "Traces retained into the router's flight-recorder ring "
+            "(sampled, or slower than --trace-slow-ms)"),
         "router_tenant_sheds_total": r.counter(
             "router_tenant_sheds_total",
             "Per-tenant 429s relayed to clients (tenant over quota or "

@@ -41,6 +41,7 @@ from typing import Callable, Dict, Mapping, Optional, Sequence
 
 from pyspark_tf_gke_tpu.obs.events import get_event_log
 from pyspark_tf_gke_tpu.obs.metrics import platform_families
+from pyspark_tf_gke_tpu.obs.trace import TraceRecorder, use_span
 from pyspark_tf_gke_tpu.pipeline.manifest import write_atomic_json
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
@@ -134,7 +135,7 @@ class PipelineCoordinator:
                  stage_attempts: int = 3,
                  retry_base_delay_s: float = 0.5,
                  heartbeat=None,
-                 obs=None, event_log=None):
+                 obs=None, event_log=None, tracer=None):
         missing = [s for s in STAGES if s not in stages]
         if missing:
             raise ValueError(f"stage map is missing {missing}")
@@ -148,6 +149,13 @@ class PipelineCoordinator:
         self._obs = obs if obs is not None else platform_families()
         self._event_log = (event_log if event_log is not None
                            else get_event_log())
+        # round-level lineage: ONE trace per round (rounds are rare —
+        # sample everything), a child span per stage, and the trace id
+        # stamped into the ingest manifest meta + the exported bundle's
+        # extra_meta, so a serving generation links back to the round
+        # that produced it (the stages read it off the contextvar)
+        self.tracer = (tracer if tracer is not None
+                       else TraceRecorder(sample=1.0))
         self._stop = threading.Event()
         self._beats = 0
 
@@ -172,20 +180,24 @@ class PipelineCoordinator:
 
     # -- the loop --------------------------------------------------------
 
-    def _run_stage(self, name: str) -> dict:
+    def _run_stage(self, name: str, parent=None) -> dict:
         from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
 
         fn = self.stages[name]
         t0 = time.perf_counter()
         self._event_log.emit("pipeline_stage_start", stage=name,
                              round=self.state.round)
+        span = self.tracer.start_span(f"pipeline.{name}", parent=parent,
+                                      attrs={"round": self.state.round})
         try:
-            out = retry_with_backoff(
-                lambda: fn(self.state, dict(self.state.outputs)),
-                attempts=self.stage_attempts,
-                base_delay_s=self.retry_base_delay_s,
-                op=f"pipeline_{name}")
+            with use_span(span):
+                out = retry_with_backoff(
+                    lambda: fn(self.state, dict(self.state.outputs)),
+                    attempts=self.stage_attempts,
+                    base_delay_s=self.retry_base_delay_s,
+                    op=f"pipeline_{name}")
         except Exception as exc:  # noqa: BLE001 — surfaced typed below
+            span.finish(status=f"error:{type(exc).__name__}")
             self._obs["pipeline_stage_failures_total"].labels(
                 stage=name).inc()
             self._event_log.emit(
@@ -193,6 +205,7 @@ class PipelineCoordinator:
                 round=self.state.round,
                 error=f"{type(exc).__name__}: {exc}"[:500])
             raise StageFailed(name, exc) from exc
+        span.finish(status="ok")
         dt = time.perf_counter() - t0
         self._obs["pipeline_stage_seconds"].labels(stage=name).observe(dt)
         self._event_log.emit("pipeline_stage_end", stage=name,
@@ -203,29 +216,43 @@ class PipelineCoordinator:
     def run_round(self) -> None:
         """Run the current round from its resume point; advances the
         state file after every stage. Raises :class:`StageFailed` with
-        the state still pointing at the failed stage."""
-        while self.state.stage_index < len(STAGES):
-            name = STAGES[self.state.stage_index]
-            self._beat()
-            out = self._run_stage(name)
-            self.state.outputs[name] = out
-            self.state.stage_index += 1
-            if name == "publish":
-                gen = int(out.get("generation",
-                                  self.state.bundle_generation))
-                if out.get("published"):
-                    self.state.bundle_generation = gen
-                    self._obs["pipeline_bundle_generation"].set(gen)
-                    landed = (self.state.outputs.get("ingest") or {}).get(
-                        "landed_at")
-                    if landed:
-                        fresh = max(0.0, time.time() - float(landed))
-                        self._obs["pipeline_freshness_seconds"].set(fresh)
-                        self._event_log.emit(
-                            "pipeline_published", round=self.state.round,
-                            generation=gen,
-                            freshness_s=round(fresh, 3))
-            self.state.save()
+        the state still pointing at the failed stage. The whole round
+        rides ONE trace (``pipeline.round``) with a child span per
+        stage; a resumed round opens a fresh trace for the remaining
+        stages (the ids differ, the manifest/bundle stamps came from
+        the round that actually ran the stage)."""
+        round_span = None
+        if self.state.stage_index < len(STAGES):
+            round_span = self.tracer.start_span(
+                "pipeline.round", attrs={"round": self.state.round})
+        try:
+            while self.state.stage_index < len(STAGES):
+                name = STAGES[self.state.stage_index]
+                self._beat()
+                out = self._run_stage(name, parent=round_span)
+                self.state.outputs[name] = out
+                self.state.stage_index += 1
+                if name == "publish":
+                    gen = int(out.get("generation",
+                                      self.state.bundle_generation))
+                    if out.get("published"):
+                        self.state.bundle_generation = gen
+                        self._obs["pipeline_bundle_generation"].set(gen)
+                        landed = (self.state.outputs.get("ingest")
+                                  or {}).get("landed_at")
+                        if landed:
+                            fresh = max(0.0, time.time() - float(landed))
+                            self._obs["pipeline_freshness_seconds"].set(
+                                fresh)
+                            self._event_log.emit(
+                                "pipeline_published",
+                                round=self.state.round,
+                                generation=gen,
+                                freshness_s=round(fresh, 3))
+                self.state.save()
+        finally:
+            if round_span is not None:
+                round_span.finish()
         # round complete: reset for the next one
         self.state.completed_rounds += 1
         self.state.round += 1

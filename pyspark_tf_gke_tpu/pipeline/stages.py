@@ -136,8 +136,15 @@ def ingest_stage(cfg: LocalPipelineConfig):
                               f"round-{state.round:04d}")
         paths = write_tfrecord_shards(arrays, prefix,
                                       num_shards=cfg.num_shards)
-        gen = manifest.append(paths, meta={"rows": n,
-                                           "round": state.round})
+        from pyspark_tf_gke_tpu.obs.trace import current_trace_id
+
+        # round-level lineage: the coordinator's round trace id rides
+        # the manifest meta, so a shard generation joins the trace
+        # that produced it (and, via export, the serving bundle)
+        meta = {"rows": n, "round": state.round}
+        if current_trace_id():
+            meta["trace_id"] = current_trace_id()
+        gen = manifest.append(paths, meta=meta)
         logger.info("ingest round %d: %d rows -> %d shards "
                     "(data generation %d)", state.round, n, len(paths), gen)
         return {"data_generation": gen, "rows": n,
@@ -244,11 +251,19 @@ def export_stage(cfg: LocalPipelineConfig):
             ckpt.close()
         generation = state.round  # one bundle generation per round
         out_dir = cfg.bundle_dir(generation)
+        from pyspark_tf_gke_tpu.obs.trace import current_trace_id
+
+        extra_meta = {"pipeline_generation": generation,
+                      "pipeline_round": state.round}
+        if current_trace_id():
+            # a replica serving this bundle advertises a generation
+            # whose producing round is one /traces (or trail) lookup
+            # away — the serving plane's lineage back-pointer
+            extra_meta["trace_id"] = current_trace_id()
         export_serving_bundle(model_cfg, st.params, out_dir,
                               quantize=cfg.quantize,
                               tokenizer_spec=cfg.tokenizer,
-                              extra_meta={"pipeline_generation": generation,
-                                          "pipeline_round": state.round})
+                              extra_meta=extra_meta)
         logger.info("export round %d: bundle generation %d -> %s",
                     state.round, generation, out_dir)
         return {"bundle_dir": out_dir, "generation": generation}

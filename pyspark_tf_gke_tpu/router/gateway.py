@@ -44,6 +44,7 @@ from typing import List, Optional, Tuple
 from pyspark_tf_gke_tpu.obs.events import get_event_log
 from pyspark_tf_gke_tpu.obs.export import handle_obs_request
 from pyspark_tf_gke_tpu.obs.metrics import get_registry, router_families
+from pyspark_tf_gke_tpu.obs.trace import TraceRecorder, use_span
 from pyspark_tf_gke_tpu.router.client import (
     ReplicaCall,
     ReplicaUnreachable,
@@ -98,11 +99,20 @@ class RouterServer:
                  hedge_max_ms: float = 2000.0,
                  hedge: bool = True,
                  request_timeout_s: float = 600.0,
-                 registry=None, event_log=None):
+                 registry=None, event_log=None,
+                 trace_sample: float = 0.01,
+                 trace_slow_ms: float = 1000.0):
         self.registry = registry if registry is not None else get_registry()
         self._obs = router_families(self.registry)
         self.event_log = (event_log if event_log is not None
                           else get_event_log())
+        # request tracing: the router adopts or mints traceparent at
+        # ingress and propagates it on every forward/hedge/stream leg,
+        # so one trace id spans the router AND the replica's engine
+        # timeline (join via GET /traces on either process)
+        self.tracer = TraceRecorder(
+            sample=trace_sample, slow_ms=trace_slow_ms,
+            counter=self._obs["router_traces_recorded_total"])
         self.replicas = ReplicaSet(replicas, obs=self._obs,
                                    event_log=self.event_log)
         self.affinity_tokens = int(affinity_tokens)
@@ -285,13 +295,16 @@ class RouterServer:
             replica=replica_rid, outcome=outcome).inc()
 
     def route_json(self, path: str, req: dict,
-                   tenant: Optional[str] = None
+                   tenant: Optional[str] = None, span=None
                    ) -> Tuple[int, dict, Tuple[Tuple[str, str], ...]]:
         """Route a non-streamed JSON POST end to end. Returns
         (status, body, extra headers) for the HTTP layer. ``tenant``:
         the resolved tenant id (HTTP layer passes the header value);
         falls back to the body field — propagated to the replica as
-        X-Tenant and charged against the hedge budget."""
+        X-Tenant and charged against the hedge budget. ``span``: the
+        request's trace span — its traceparent rides every leg so the
+        replica's engine timeline joins this trace, and the router
+        records its route/hedge/reroute decisions as span events."""
         tenant = self.tenant_of(req, tenant)
         body = json.dumps(req).encode()
         affinity = (self._affinity_for(req)
@@ -299,26 +312,35 @@ class RouterServer:
         tokens = self._token_ask(req)
         t0 = time.perf_counter()
         tried: List[str] = []
+        headers = {"X-Tenant": tenant}
+        if span is not None:
+            headers["traceparent"] = span.traceparent()
 
         self._tenant_enter(tenant)
         try:
             primary = self.pick(affinity)
             if primary is None:
+                if span is not None:
+                    span.event("shed", reason="no_replicas")
                 self._count("none", "shed")
                 return 503, {"error": "no routable replica",
                              "reason": "no_replicas"}, (
                                  ("Retry-After", "1"),)
 
+            if span is not None:
+                span.event("route", replica=primary.rid,
+                           affinity=affinity is not None)
             status, out, hdrs, terminal_rid = self._route_with_failover(
                 primary, path, body, tokens, tried,
                 hedge=(self.hedge_enabled and path == "/v1/generate"
                        and not req.get("stream")
                        and self._tenant_may_hedge(tenant)),
-                headers={"X-Tenant": tenant})
+                headers=headers, span=span)
         finally:
             self._tenant_exit(tenant)
         dt_ms = (time.perf_counter() - t0) * 1000.0
-        self._obs["router_request_latency_ms"].observe(dt_ms)
+        self._obs["router_request_latency_ms"].observe(
+            dt_ms, exemplar=(span.trace_id if span is not None else None))
         if 200 <= status < 300:
             self.latency.observe(dt_ms)
             self._count(terminal_rid, "ok")
@@ -361,14 +383,15 @@ class RouterServer:
 
     def _route_with_failover(self, primary: Replica, path: str,
                              body: bytes, tokens: int, tried: List[str],
-                             hedge: bool, headers=None):
+                             hedge: bool, headers=None, span=None):
         """primary -> (maybe hedge) -> (maybe one re-route). Returns
         (status, body, headers, terminal_replica_rid)."""
         tried.append(primary.rid)
         try:
             if hedge:
                 status, out, hdrs, rid = self._call_hedged(
-                    primary, path, body, tokens, tried, headers=headers)
+                    primary, path, body, tokens, tried, headers=headers,
+                    span=span)
             else:
                 call = self._forward_once(primary, path, body, tokens,
                                           headers=headers)
@@ -383,10 +406,13 @@ class RouterServer:
             self.event_log.emit("router_reroute", path=path,
                                 reason="failover", failed=tried[-1],
                                 error=str(exc)[:200])
+            if span is not None:
+                span.event("reroute", reason="failover",
+                           failed=tried[-1])
             return self._reroute_once(path, body, tokens, tried,
                                       shed_status=502,
                                       shed_error=str(exc),
-                                      headers=headers)
+                                      headers=headers, span=span)
         if status in (429, 503):
             hd = dict(hdrs)
             if self._note_shed(rid, hd.get("Retry-After"),
@@ -406,15 +432,19 @@ class RouterServer:
                 "router_reroute", path=path, reason="backpressure",
                 shed_by=rid,
                 retry_after_s=parse_retry_after(hd.get("Retry-After")))
+            if span is not None:
+                span.event("reroute", reason="backpressure", shed_by=rid)
             return self._reroute_once(path, body, tokens, tried,
                                       shed_status=status,
                                       shed_error=out.get("error", ""),
-                                      shed_hdrs=hdrs, headers=headers)
+                                      shed_hdrs=hdrs, headers=headers,
+                                      span=span)
         return status, out, hdrs, rid
 
     def _reroute_once(self, path: str, body: bytes, tokens: int,
                       tried: List[str], *, shed_status: int,
-                      shed_error: str, shed_hdrs=(), headers=None):
+                      shed_error: str, shed_hdrs=(), headers=None,
+                      span=None):
         """The single permitted re-route. A second failure — of any
         kind — surfaces to the client; the router never turns one
         request into a retry storm against a struggling fleet."""
@@ -427,6 +457,8 @@ class RouterServer:
                 "reason": "no_reroute_target",
             }, (tuple(shed_hdrs) or (("Retry-After", "1"),)), tried[-1]
         tried.append(nxt.rid)
+        if span is not None:
+            span.event("route", replica=nxt.rid, rerouted=True)
         try:
             call = self._forward_once(nxt, path, body, tokens,
                                       headers=headers)
@@ -446,7 +478,8 @@ class RouterServer:
         return status, out, hdrs, nxt.rid
 
     def _call_hedged(self, primary: Replica, path: str, body: bytes,
-                     tokens: int, tried: List[str], headers=None):
+                     tokens: int, tried: List[str], headers=None,
+                     span=None):
         """Primary + (after the adaptive delay) one hedge; the first
         USABLE response wins and the loser is cancelled (socket close —
         the replica's own deadline machinery reclaims the work). Each
@@ -516,6 +549,10 @@ class RouterServer:
                                     primary=primary.rid,
                                     hedge=hedge_rep.rid,
                                     delay_ms=round(delay * 1000.0, 1))
+                if span is not None:
+                    span.event("hedge", primary=primary.rid,
+                               hedge=hedge_rep.rid,
+                               delay_ms=round(delay * 1000.0, 1))
                 threading.Thread(target=leg, args=(hedge_rep,),
                                  daemon=True).start()
             first = results.get()  # one leg WILL answer or error
@@ -576,6 +613,8 @@ class RouterServer:
             # only a USABLE hedge answer is a win — a shed verdict that
             # surfaced because every leg shed is a relay, not a rescue
             self._obs["router_hedge_wins_total"].inc()
+            if span is not None:
+                span.event("hedge_win", replica=replica.rid)
         hdrs: Tuple[Tuple[str, str], ...] = ()
         ra = call.header("Retry-After")
         if ra is not None:
@@ -591,7 +630,8 @@ class RouterServer:
 
     # -- streaming -------------------------------------------------------
 
-    def open_stream(self, req: dict, tenant: Optional[str] = None):
+    def open_stream(self, req: dict, tenant: Optional[str] = None,
+                    span=None):
         """Route a streamed generate. Returns ``(replica, call,
         first_lines, tokens)``: for a 200 the stream is PRIMED — the
         response lines up to and including the first ``data:`` event
@@ -610,6 +650,9 @@ class RouterServer:
         tokens = self._token_ask(req)
         affinity = self._affinity_for(req)
         tried: List[str] = []
+        headers = {"X-Tenant": tenant}
+        if span is not None:
+            headers["traceparent"] = span.traceparent()
         # a held shed verdict: still tracked, relayed only if no later
         # attempt produces anything better (_stream untracks + closes)
         shed = None
@@ -619,11 +662,16 @@ class RouterServer:
             if replica is None:
                 break
             tried.append(replica.rid)
+            if span is not None:
+                span.event("route", replica=replica.rid,
+                           stream=True, rerouted=attempt > 0)
             try:
                 call = self._forward_once(replica, "/v1/generate", body,
-                                          tokens,
-                                          headers={"X-Tenant": tenant})
+                                          tokens, headers=headers)
             except ReplicaUnreachable as exc:
+                if span is not None:
+                    span.event("reroute", reason="stream_connect",
+                               failed=replica.rid)
                 self._note_stream_reroute(replica.rid, str(exc))
                 continue
             if call.status in (429, 503) and shed is None \
@@ -672,6 +720,9 @@ class RouterServer:
                 call.close()
                 self.replicas.set_state(replica.rid, DOWN,
                                         reason="died before first event")
+                if span is not None:
+                    span.event("reroute", reason="stream",
+                               failed=replica.rid)
                 self._note_stream_reroute(replica.rid, str(exc))
                 continue
             if shed is not None:
@@ -725,6 +776,7 @@ class RouterServer:
 def _make_handler(router: RouterServer):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        _span = None  # the request's trace span (POST paths set it)
 
         def log_message(self, fmt, *args):
             logger.info("%s %s", self.address_string(), fmt % args)
@@ -734,6 +786,11 @@ def _make_handler(router: RouterServer):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if self._span is not None:
+                # the SAME id the replica echoes — end-to-end join key,
+                # present on sheds (429/503) and errors too
+                self.send_header("X-Request-Id", self._span.trace_id)
+                self._span.set("http.status", code)
             for name, value in headers:
                 self.send_header(name, value)
             if self.close_connection:
@@ -747,7 +804,8 @@ def _make_handler(router: RouterServer):
                 code, payload = router.health()
                 return self._reply(code, payload)
             out = handle_obs_request(self.path, router.registry,
-                                     router.event_log)
+                                     router.event_log,
+                                     tracer=router.tracer)
             if out is None:
                 return self._reply(404,
                                    {"error": f"unknown path {self.path}"})
@@ -765,7 +823,7 @@ def _make_handler(router: RouterServer):
             event + [DONE] — never a silent replay from another
             replica."""
             replica, call, first_lines, tokens = router.open_stream(
-                req, tenant=tenant)
+                req, tenant=tenant, span=self._span)
             if call is None:
                 return self._reply(
                     503, {"error": "no routable replica for the stream",
@@ -796,6 +854,10 @@ def _make_handler(router: RouterServer):
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
+                if self._span is not None:
+                    self.send_header("X-Request-Id",
+                                     self._span.trace_id)
+                    self._span.set("http.status", 200)
                 self.end_headers()
                 saw_done = False
                 try:
@@ -840,8 +902,23 @@ def _make_handler(router: RouterServer):
                 call.close()
 
         def do_POST(self):
+            self._span = router.tracer.start_span(
+                "router.request",
+                parent=self.headers.get("traceparent"),
+                attrs={"path": self.path.partition("?")[0]})
+            try:
+                with use_span(self._span):
+                    self._do_post_outer()
+            finally:
+                self._span.finish()
+                # per-connection handler instance: a later GET on the
+                # same keep-alive socket must not echo this span's id
+                self._span = None
+
+        def _do_post_outer(self):
             if router.draining.is_set():
                 self.close_connection = True
+                self._span.event("shed", reason="draining")
                 return self._reply(
                     503, {"error": "router is draining",
                           "reason": "draining"},
@@ -878,7 +955,8 @@ def _make_handler(router: RouterServer):
                     finally:
                         router._tenant_exit(tenant)
                 status, out, hdrs = router.route_json(self.path, req,
-                                                      tenant=tenant)
+                                                      tenant=tenant,
+                                                      span=self._span)
             except OSError as exc:
                 # replica-side transport errors all surface as
                 # ReplicaUnreachable, so a raw OSError here is the
@@ -954,6 +1032,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default=float(e("ROUTER_HEDGE_MAX_MS", "2000")))
     p.add_argument("--request-timeout", type=float,
                    default=float(e("ROUTER_REQUEST_TIMEOUT", "600")))
+    p.add_argument("--trace-sample", type=float,
+                   default=float(e("ROUTER_TRACE_SAMPLE", "0.01")),
+                   help="fraction of routed requests retained in the "
+                        "router's /traces flight recorder; traceparent "
+                        "ids always propagate to replicas regardless")
+    p.add_argument("--trace-slow-ms", type=float,
+                   default=float(e("ROUTER_TRACE_SLOW_MS", "1000")),
+                   help="always-on slow capture: requests slower than "
+                        "this are retained even when unsampled (0=off)")
     p.add_argument("--drain-timeout", type=float,
                    default=float(e("ROUTER_DRAIN_TIMEOUT", "15")),
                    help="seconds SIGTERM waits before stopping the "
@@ -981,7 +1068,9 @@ def main(argv=None) -> int:
         hedge=not args.no_hedge,
         hedge_min_ms=args.hedge_min_ms,
         hedge_max_ms=args.hedge_max_ms,
-        request_timeout_s=args.request_timeout)
+        request_timeout_s=args.request_timeout,
+        trace_sample=args.trace_sample,
+        trace_slow_ms=args.trace_slow_ms)
     prober = HealthProber(
         router.replicas, interval_s=args.probe_interval,
         timeout_s=args.probe_timeout, fail_threshold=args.fail_threshold,

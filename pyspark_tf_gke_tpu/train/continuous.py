@@ -119,6 +119,13 @@ class _Request:
     # time-between-tokens (serve_tbt_ms) clock; None until the first
     # tokens land (the first gap is TTFT, not TBT)
     last_emit: Optional[float] = None
+    # request-attached trace span (obs/trace.py, or None): the engine
+    # annotates the request's OWN span — queue wait, admission route,
+    # prefill pieces, first token, token deliveries — so the timeline
+    # lands on the trace the HTTP layer opened without the engine ever
+    # knowing about transports. Every annotation is guarded on None:
+    # bench/direct callers pay one attribute check per event site.
+    span: Optional[object] = None
 
 
 def _prefill_padded(model: CausalLM, params, padded_ids, true_len):
@@ -1506,7 +1513,7 @@ class ContinuousEngine:
                on_tokens=None, temperature: float = 0.0,
                top_p: Optional[float] = None, seed: int = 0,
                deadline_s: Optional[float] = None,
-               tenant: str = "default") -> int:
+               tenant: str = "default", span=None) -> int:
         if temperature and temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if deadline_s is not None and deadline_s <= 0:
@@ -1559,7 +1566,8 @@ class ContinuousEngine:
                        top_p=top_p, seed=int(seed), tenant=tenant,
                        enqueued_at=time.monotonic(),
                        deadline=(time.monotonic() + float(deadline_s)
-                                 if deadline_s is not None else None))
+                                 if deadline_s is not None else None),
+                       span=span)
         if self.schedule == "longest":
             # insertion point keeps the queue budget-descending; ties
             # stay FIFO (stable insert after equal budgets)
@@ -1897,6 +1905,7 @@ class ContinuousEngine:
             self._n_prefill_tokens += int(req.prompt.size)
             self._note_pages(slot, taken)
             self._slots[slot] = req
+            self._trace_admit(req, slot, "paged")
             if self.radix is not None:
                 # this path only runs when the peek matched nothing
                 # (hits route piecewise): a MISS must land in the
@@ -1937,11 +1946,16 @@ class ContinuousEngine:
                 "fill": hit[0] if hit is not None else 0,
                 "cache1": hit[1] if hit is not None else None,
             }
+            self._trace_admit(req, slot, "chunked",
+                              prefix_hit_tokens=(hit[0] if hit is not None
+                                                 else 0))
             self._advance_admission()
             return True
         if hit is not None:
             self._obs["serve_prefix_cache_hits_total"].inc()
             self._obs["serve_prefix_cache_hit_tokens_total"].inc(hit[0])
+            self._trace_admit(req, slot, "prefix",
+                              prefix_hit_tokens=hit[0])
             self._admit_from_prefix(slot, req, *hit)
             self._slots[slot] = req
             return True
@@ -1958,7 +1972,24 @@ class ContinuousEngine:
                 padded, req.prompt.size, slot, *sampling))
         self._n_prefill_tokens += int(req.prompt.size)
         self._slots[slot] = req
+        self._trace_admit(req, slot, "dense")
         return True
+
+    def _trace_admit(self, req: _Request, slot: int, route: str,
+                     **fields) -> None:
+        """Span events at the moment a request wins a KV slot: the
+        measured queue wait (submit → admission — the span-level answer
+        to 'was it queued behind a prefill chunk?') and the admission
+        route with its prefix-cache verdict. One None check for
+        untraced requests."""
+        sp = req.span
+        if sp is None:
+            return
+        sp.event("queue_wait", rid=req.rid,
+                 ms=round((time.monotonic() - req.enqueued_at) * 1000.0,
+                          3))
+        sp.event("admission", rid=req.rid, slot=slot, route=route,
+                 **fields)
 
     def _admit_from_prefix(self, slot: int, req: _Request, fill: int,
                            cache1, logits1) -> None:
@@ -2043,7 +2074,7 @@ class ContinuousEngine:
                     jnp.asarray(padded), jnp.asarray(fill, jnp.int32),
                     jnp.asarray(piece.size, jnp.int32))
         a["cache1"], a["fill"] = cache1, fill + piece.size
-        self._note_prefill_piece(piece.size)
+        self._note_prefill_piece(piece.size, req)
         if a["fill"] == req.prompt.size:
             self._device.insert(
                 cache1, logits1, a["slot"], req.prompt.size,
@@ -2053,11 +2084,14 @@ class ContinuousEngine:
             self._slots[a["slot"]] = req
             self._admitting = None
 
-    def _note_prefill_piece(self, n: int) -> None:
+    def _note_prefill_piece(self, n: int,
+                            req: Optional[_Request] = None) -> None:
         self._n_prefill_chunks += 1
         self._step_prefill_tokens += int(n)
         self._n_prefill_tokens += int(n)
         self._obs["serve_prefill_chunk_tokens"].observe(n)
+        if req is not None and req.span is not None:
+            req.span.event("prefill_chunk", rid=req.rid, tokens=int(n))
 
     def _start_paged_admission(self, slot: int, req: _Request,
                                match=None) -> None:
@@ -2109,6 +2143,9 @@ class ContinuousEngine:
                 self._obs["serve_prefix_cache_hits_total"].inc()
                 self._obs["serve_prefix_cache_hit_tokens_total"].inc(
                     matched)
+        self._trace_admit(req, slot, "paged_chunked",
+                          prefix_hit_tokens=int(a["fill"]),
+                          cow=a["cow"] is not None)
         self._admitting = a
         self._advance_admission()
 
@@ -2196,7 +2233,7 @@ class ContinuousEngine:
             a["cow"] = None
             self._unref_pages([cow[0]])
         a["fill"] = fill + piece.size
-        self._note_prefill_piece(piece.size)
+        self._note_prefill_piece(piece.size, req)
         if final:
             self._slots[a["slot"]] = req
             self._note_pages(a["slot"], a["shared"] + a["pages"])
@@ -2323,6 +2360,7 @@ class ContinuousEngine:
         self._n_prefill_tokens += sum(int(r.prompt.size) for r in group)
         for i, (slot, req) in enumerate(zip(free[:k], group)):
             self._slots[slot] = req
+            self._trace_admit(req, slot, "batch")
             if self.paged:
                 self._note_pages(slot, takens[i])
             if self.radix is not None:
@@ -2603,6 +2641,15 @@ class ContinuousEngine:
                 if req.last_emit is not None:
                     self._obs["serve_tbt_ms"].observe(
                         (now - req.last_emit) * 1000.0)
+                if req.span is not None:
+                    if req.last_emit is None:
+                        req.span.event(
+                            "first_token", rid=req.rid,
+                            ttft_ms=round(
+                                (now - req.enqueued_at) * 1000.0, 3))
+                    else:
+                        req.span.event("tokens", rid=req.rid,
+                                       n=len(new_toks))
                 req.last_emit = now
             req.tokens.extend(new_toks)
             if req.on_tokens is not None and new_toks:

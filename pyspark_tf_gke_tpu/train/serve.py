@@ -56,6 +56,7 @@ from pyspark_tf_gke_tpu.obs.events import get_event_log
 from pyspark_tf_gke_tpu.obs.export import handle_obs_request
 from pyspark_tf_gke_tpu.obs.metrics import get_registry, platform_families
 from pyspark_tf_gke_tpu.obs.runtime import install_runtime_metrics
+from pyspark_tf_gke_tpu.obs.trace import TraceRecorder, use_span
 from pyspark_tf_gke_tpu.parallel.distributed import as_host_array
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
@@ -556,7 +557,7 @@ class _ContinuousFront:
     def submit(self, prompt_ids, max_new_tokens: int,
                temperature: float = 0.0, top_p=None,
                seed: int = 0, deadline_s=None,
-               tenant: str = "default") -> int:
+               tenant: str = "default", span=None) -> int:
         """Queue a request (non-blocking); pair with ``wait``.
         ``deadline_s``: seconds from now the client still cares about
         the answer — past it the engine expires the request at the next
@@ -564,7 +565,9 @@ class _ContinuousFront:
         ``tenant``: fairness/quota identity (header/body-extracted by
         the HTTP layer; "default" when absent) — normalized here, so
         unlisted ids fold into the ``*`` aggregate and a no-spec
-        server never sees anything but "default"."""
+        server never sees anything but "default". ``span``: the
+        request's trace span (obs/trace.py) — the engine annotates its
+        queue/admission/prefill/token timeline onto it."""
         tenant = self.resolve_tenant(tenant)
         done = threading.Event()
         with self.lock:
@@ -575,7 +578,7 @@ class _ContinuousFront:
                                          temperature=temperature,
                                          top_p=top_p, seed=seed,
                                          deadline_s=deadline_s,
-                                         tenant=tenant)
+                                         tenant=tenant, span=span)
             except BaseException:
                 # the quota charge landed in _check_admission; a failed
                 # engine submit must hand it back or the tenant pays
@@ -662,7 +665,8 @@ class _ContinuousFront:
         return rid
 
     def submit_stream(self, prompt_ids, max_new_tokens: int,
-                      deadline_s=None, tenant: str = "default"):
+                      deadline_s=None, tenant: str = "default",
+                      span=None):
         """Streaming variant: returns (rid, queue). The queue receives
         token-id lists as they decode, then a terminal item — [] on
         completion, an Exception on engine failure / deadline expiry /
@@ -683,7 +687,7 @@ class _ContinuousFront:
                 rid = self.engine.submit(prompt_ids, max_new_tokens,
                                          on_tokens=q.put,
                                          deadline_s=deadline_s,
-                                         tenant=tenant)
+                                         tenant=tenant, span=span)
             except BaseException:
                 bucket = self._buckets.get(tenant)
                 if bucket is not None:
@@ -704,6 +708,14 @@ class _ContinuousFront:
         and the hot-swap drain both run it)."""
         for req in finished:
             self._settle(req)
+            if req.span is not None:
+                # terminal outcome on the request's OWN span — the last
+                # engine-side event of the timeline (the HTTP layer
+                # still stamps the status code it maps this to)
+                req.span.event(
+                    "terminal", rid=req.rid,
+                    outcome="deadline" if req.expired else "ok",
+                    new_tokens=len(req.tokens))
             slot = self._results.get(req.rid)
             if slot is None:
                 continue
@@ -910,7 +922,9 @@ class BundleServer:
                  registry=None, event_log=None,
                  max_queue_depth: int = 0, max_queued_tokens: int = 0,
                  chaos_spec: str = "", heartbeat_file: str = "",
-                 tenants_spec: str = "", admin_token: str = ""):
+                 tenants_spec: str = "", admin_token: str = "",
+                 trace_sample: float = 0.01,
+                 trace_slow_ms: float = 1000.0):
         from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
 
         self.mesh = mesh
@@ -975,6 +989,14 @@ class BundleServer:
         self._obs["serve_bundle_generation"].set(self.bundle_generation)
         self.event_log = (event_log if event_log is not None
                           else get_event_log())
+        # request tracing (obs/trace.py): every HTTP request gets a
+        # span that adopts the client's traceparent (or mints a root);
+        # the engine annotates the request's queue/admission/prefill/
+        # token timeline onto it, GET /traces serves the retained ring.
+        # sample 0 + slow 0 short-circuits to id-propagation only.
+        self.tracer = TraceRecorder(
+            sample=trace_sample, slow_ms=trace_slow_ms,
+            counter=self._obs["serve_traces_recorded_total"])
         # drain lifecycle: SIGTERM (or begin_drain) flips this, /healthz
         # starts answering 503 draining, admission stops, and drain()
         # waits out the in-flight work
@@ -1370,7 +1392,8 @@ class BundleServer:
     def generate(self, prompts, max_new_tokens: int = 64,
                  temperature: float = 0.0, top_k=None, top_p=None,
                  num_beams: int = 0, repetition_penalty=None,
-                 deadline_s=None, tenant: str = "default") -> list:
+                 deadline_s=None, tenant: str = "default",
+                 span=None) -> list:
         """Batch completion. Prompts are grouped by token length so each
         group decodes as one batched call; the batch dimension pads up
         to power-of-2 buckets (repeating the first row) so mixed traffic
@@ -1457,7 +1480,8 @@ class BundleServer:
                         ids, max_new_tokens, temperature=temp,
                         top_p=top_p,
                         seed=int.from_bytes(os.urandom(4), "little"),
-                        deadline_s=deadline_s, tenant=tenant)))
+                        deadline_s=deadline_s, tenant=tenant,
+                        span=span)))
             except Exception:
                 # a mid-batch rejection (queue filled between rows) must
                 # not strand the rows already submitted
@@ -1584,7 +1608,8 @@ class BundleServer:
                     "prefix_cache")}
 
     def generate_stream(self, prompt: str, max_new_tokens: int = 64,
-                        deadline_s=None, tenant: str = "default"):
+                        deadline_s=None, tenant: str = "default",
+                        span=None):
         """Greedy streaming completion through the slot engine: yields
         one event dict per decoded token group (``token_ids`` plus the
         full ``text`` so far — full text, not a delta, so multibyte
@@ -1614,7 +1639,7 @@ class BundleServer:
         t0 = time.perf_counter()
         rid, q = self._front.submit_stream(ids, max_new_tokens,
                                            deadline_s=deadline_s,
-                                           tenant=tenant)
+                                           tenant=tenant, span=span)
         toks, finished, yielded = [], False, False
         try:
             while True:
@@ -1669,13 +1694,18 @@ class BundleServer:
             "latency_ms": round((time.perf_counter() - t0) * 1000.0, 2),
             "done": True,
         }
-        self.record_metrics(generate_entries=[entry])
+        self.record_metrics(generate_entries=[entry],
+                            trace_id=(span.trace_id
+                                      if span is not None else None))
         yield entry
 
     def record_metrics(self, *, generate_entries=None, score: bool = False,
-                       failed: bool = False) -> None:
+                       failed: bool = False,
+                       trace_id: Optional[str] = None) -> None:
         """Fold one request into the shared registry (handler-thread
-        safe — every metric holds its own lock)."""
+        safe — every metric holds its own lock). ``trace_id`` rides the
+        latency histogram as the bucket's exemplar: the JSON snapshot
+        links each latency bucket to a concrete trace in /traces."""
         m = self._obs
         m["serve_requests_total"].inc()
         if failed:
@@ -1688,7 +1718,7 @@ class BundleServer:
                 e.get("new_tokens", 0) for e in generate_entries))
             m["serve_generate_latency_ms"].observe(max(
                 (e.get("latency_ms", 0.0) for e in generate_entries),
-                default=0.0))
+                default=0.0), exemplar=trace_id)
 
     def _legacy_metrics_text(self) -> str:
         """The pre-obs exposition names, aliased onto registry values —
@@ -1844,6 +1874,7 @@ def _shed_body(exc: RequestRejected) -> dict:
 def _make_handler(server: BundleServer):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        _span = None  # the request's trace span (POST paths set it)
 
         def log_message(self, fmt, *args):  # route through our logger
             logger.info("%s %s", self.address_string(), fmt % args)
@@ -1853,6 +1884,12 @@ def _make_handler(server: BundleServer):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if self._span is not None:
+                # EVERY response (successes and 429/503/504 sheds
+                # alike) echoes the trace id — a user report quoting
+                # X-Request-Id joins straight to GET /traces
+                self.send_header("X-Request-Id", self._span.trace_id)
+                self._span.set("http.status", code)
             for name, value in headers:
                 self.send_header(name, value)
             if self.close_connection:
@@ -1886,10 +1923,12 @@ def _make_handler(server: BundleServer):
                     max_new_tokens=int(req.get("max_new_tokens", 64)),
                     deadline_s=(float(deadline_ms) / 1000.0
                                 if deadline_ms is not None else None),
-                    tenant=tenant)
+                    tenant=tenant, span=self._span)
                 first = next(events)  # validation errors surface BEFORE
                 #   the 200 status line is committed
             except RequestRejected as exc:
+                if self._span is not None:
+                    self._span.event("shed", reason=exc.reason)
                 server.record_metrics()
                 return self._reply(exc.status, _shed_body(exc),
                                    headers=_shed_headers(exc))
@@ -1901,7 +1940,20 @@ def _make_handler(server: BundleServer):
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Connection", "close")
+            if self._span is not None:
+                self.send_header("X-Request-Id", self._span.trace_id)
+                self._span.set("http.status", 200)
             self.end_headers()
+            try:
+                if self._span is not None:
+                    # first SSE line: a comment carrying the trace id,
+                    # so stream consumers (which never see response
+                    # headers through some SSE clients) can still join
+                    # the stream to /traces
+                    self.wfile.write(
+                        f": trace_id={self._span.trace_id}\n\n".encode())
+            except OSError:
+                pass
             try:
                 for event in itertools.chain([first], events):
                     self.wfile.write(
@@ -1946,7 +1998,9 @@ def _make_handler(server: BundleServer):
                 extra = server._legacy_metrics_text()
             out = handle_obs_request(self.path, server.registry,
                                      server.event_log,
-                                     extra_exposition=extra)
+                                     extra_exposition=extra,
+                                     tracer=getattr(server, "tracer",
+                                                    None))
             if out is None:
                 return self._reply(404,
                                    {"error": f"unknown path {self.path}"})
@@ -1959,9 +2013,25 @@ def _make_handler(server: BundleServer):
 
         def do_POST(self):
             server._http_enter()  # drain() waits for this to reach zero
+            tracer = getattr(server, "tracer", None)
+            if tracer is not None:
+                # adopt the caller's traceparent (the router's, or an
+                # end client's) or mint a new root; malformed input
+                # degrades to a fresh trace, never an error
+                self._span = tracer.start_span(
+                    "serve.request",
+                    parent=self.headers.get("traceparent"),
+                    attrs={"path": self.path.partition("?")[0]})
             try:
-                self._do_POST()
+                with use_span(self._span):
+                    self._do_POST()
             finally:
+                if self._span is not None:
+                    self._span.finish()
+                # handler instances live per keep-alive CONNECTION, not
+                # per request: a later GET on the same socket must not
+                # echo (or stamp onto) this finished span
+                self._span = None
                 server._http_exit()
 
         def _do_POST(self):
@@ -1974,6 +2044,8 @@ def _make_handler(server: BundleServer):
                 server._obs["serve_requests_rejected_total"].labels(
                     reason="draining").inc()
                 exc = _draining_rejection()
+                if self._span is not None:
+                    self._span.event("shed", reason=exc.reason)
                 return self._reply(
                     exc.status, {"error": str(exc), "reason": exc.reason},
                     headers=(("Retry-After", str(exc.retry_after_s)),))
@@ -2029,8 +2101,12 @@ def _make_handler(server: BundleServer):
                         top_p=req.get("top_p"),
                         num_beams=int(req.get("num_beams", 0)),
                         repetition_penalty=req.get("repetition_penalty"),
-                        deadline_s=deadline_s, tenant=tenant)
-                    server.record_metrics(generate_entries=out)
+                        deadline_s=deadline_s, tenant=tenant,
+                        span=self._span)
+                    server.record_metrics(
+                        generate_entries=out,
+                        trace_id=(self._span.trace_id
+                                  if self._span is not None else None))
                     self._reply(200, {"completions": out})
                 elif self.path == "/v1/warm":
                     prefix = req.get("prefix")
@@ -2099,6 +2175,13 @@ def _make_handler(server: BundleServer):
                 # rejected{reason} family (incremented at the raise
                 # site), not in requests_failed. Per-tenant sheds carry
                 # the tenant in body + X-Tenant-Shed header.
+                if self._span is not None:
+                    # the shed VERDICT on the trace: reason + (tenant
+                    # sheds) whose quota it was — the 'why' a 429'd
+                    # user report needs
+                    self._span.event(
+                        "shed", reason=exc.reason,
+                        **({"tenant": exc.tenant} if exc.tenant else {}))
                 server.record_metrics()
                 self._reply(exc.status, _shed_body(exc),
                             headers=_shed_headers(exc))
@@ -2265,6 +2348,20 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "refill; other tenants keep admitting). A "
                         "'*' entry configures unlisted tenants. "
                         "Empty = tenancy off (global bounds)")
+    p.add_argument("--trace-sample", type=float,
+                   default=float(e("TRACE_SAMPLE", "0.01")),
+                   help="fraction of requests whose traces are "
+                        "RETAINED in the /traces flight recorder "
+                        "(0..1). Ids always propagate (traceparent "
+                        "in, X-Request-Id out) regardless; 0 with "
+                        "--trace-slow-ms 0 disables recording "
+                        "entirely (id propagation only)")
+    p.add_argument("--trace-slow-ms", type=float,
+                   default=float(e("TRACE_SLOW_MS", "1000")),
+                   help="always-on slow capture: any request slower "
+                        "than this is retained in /traces even when "
+                        "the sampler skipped it — tail latency is "
+                        "never lost to sampling (0 = off)")
     p.add_argument("--drain-timeout", type=float,
                    default=float(e("DRAIN_TIMEOUT", "30")),
                    help="seconds SIGTERM waits for in-flight requests "
@@ -2368,6 +2465,8 @@ def main(argv=None) -> int:
         chaos_spec=args.chaos,
         heartbeat_file=args.heartbeat_file,
         tenants_spec=args.tenants,
+        trace_sample=args.trace_sample,
+        trace_slow_ms=args.trace_slow_ms,
         # env-only by design: a token flag would leak into ps output
         # and pod specs; the k8s manifest mounts it from a Secret
         admin_token=os.environ.get("SERVE_ADMIN_TOKEN", ""))

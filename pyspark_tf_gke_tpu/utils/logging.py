@@ -14,10 +14,31 @@ import sys
 from typing import Optional, Union
 
 _FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+# Env-gated trace correlation (off by default): with
+# ``PYSPARK_TF_GKE_TPU_LOG_TRACE=1`` every record carries the active
+# request/round trace id (``-`` outside a trace), so existing log lines
+# join ``GET /traces`` without any call-site change.
+_TRACE_FORMAT = ("%(asctime)s %(levelname)s %(name)s "
+                 "trace_id=%(trace_id)s: %(message)s")
 
 # Loggers whose level was pinned by an explicit ``level=`` argument —
 # a later default-level call must not silently reset them.
 _explicit_levels: set = set()
+
+
+class _TraceIdFilter(logging.Filter):
+    """Stamps ``record.trace_id`` from the contextvar-carried current
+    span. A filter (not a formatter subclass) so the stock Formatter
+    keeps working; resolution is one contextvar read per record."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            from pyspark_tf_gke_tpu.obs.trace import current_trace_id
+
+            record.trace_id = current_trace_id() or "-"
+        except Exception:  # noqa: BLE001 — logging must never raise
+            record.trace_id = "-"
+        return True
 
 
 def _env_level() -> Optional[int]:
@@ -57,7 +78,11 @@ def get_logger(name: str,
     # Guard against duplicated handlers when called twice for the same name.
     if not logger.handlers:
         handler = logging.StreamHandler(sys.stdout)
-        handler.setFormatter(logging.Formatter(_FORMAT))
+        if os.environ.get("PYSPARK_TF_GKE_TPU_LOG_TRACE", "") == "1":
+            handler.setFormatter(logging.Formatter(_TRACE_FORMAT))
+            handler.addFilter(_TraceIdFilter())
+        else:
+            handler.setFormatter(logging.Formatter(_FORMAT))
         logger.addHandler(handler)
         logger.propagate = False
     return logger

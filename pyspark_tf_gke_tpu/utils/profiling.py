@@ -1,15 +1,24 @@
 """Profiling / step-time observability.
 
 The reference has no profiling subsystem (SURVEY §5 — only the Spark Web
-UI and ``kubectl top`` polling); this is the first-class replacement:
+UI and ``kubectl top`` polling); this is the first-class replacement,
+and it is no longer a disjoint store: everything here lands on the
+shared ``obs/`` plane (docs/OBSERVABILITY.md):
 
 * ``profile_trace`` — context manager around ``jax.profiler`` trace
   capture (open the output dir with TensorBoard / xprof to see per-op
-  MXU/HBM utilization);
-* ``StepTimer`` — rolling step-time stats with compile-step exclusion,
-  feeding the history's ``step_time_ms`` / ``examples_per_sec`` metrics
-  (the BASELINE.json north-star numbers);
-* ``annotate`` — named trace spans (``jax.profiler.TraceAnnotation``).
+  MXU/HBM utilization); emits a ``profile_trace_written`` event on the
+  shared trail so a capture is findable from the same place as every
+  other operational event;
+* ``StepTimer`` — rolling step-time stats with compile-step exclusion;
+  observations also land on the shared registry's
+  ``train_step_time_ms`` histogram (same steady-step semantics — the
+  first step is excluded), so an ad-hoc timed loop is scrapable
+  without a Trainer;
+* ``annotate`` — named spans visible in BOTH viewers: a
+  ``jax.profiler.TraceAnnotation`` for xprof AND, when a request/round
+  trace is active (``obs.trace.current_span``), a child span on that
+  trace — device-level profiling joins the distributed timeline.
 """
 
 from __future__ import annotations
@@ -38,20 +47,60 @@ def profile_trace(output_dir: Optional[str]) -> Iterator[None]:
     finally:
         jax.profiler.stop_trace()
         logger.info("profiler trace written to %s", output_dir)
+        try:
+            from pyspark_tf_gke_tpu.obs.events import get_event_log
+
+            get_event_log().emit("profile_trace_written",
+                                 output_dir=str(output_dir))
+        except Exception:  # noqa: BLE001 — the capture itself succeeded;
+            pass           # the trail note is best-effort
 
 
-def annotate(name: str):
-    """Named span visible in the trace viewer."""
-    return jax.profiler.TraceAnnotation(name)
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named span visible in the trace viewer — and, when a distributed
+    trace is active on this thread, as a child span of it: one
+    ``annotate("decode_chunk")`` shows up in xprof AND in the request's
+    ``GET /traces`` timeline."""
+    from pyspark_tf_gke_tpu.obs.trace import current_span, use_span
+
+    parent = current_span()
+    span = None
+    if parent is not None and parent.recorder is not None:
+        span = parent.recorder.start_span(str(name), parent=parent)
+    with jax.profiler.TraceAnnotation(str(name)):
+        if span is None:
+            yield
+            return
+        with use_span(span):
+            try:
+                yield
+            finally:
+                span.finish()
 
 
 class StepTimer:
-    """Rolling wall-clock stats over steps; excludes the first (compile)."""
+    """Rolling wall-clock stats over steps; excludes the first (compile).
 
-    def __init__(self):
+    Steady-step durations also observe into ``metric`` — by default the
+    shared registry's ``train_step_time_ms`` histogram (lazily
+    resolved), the same family/semantics the Trainer's fit loop
+    records, so a hand-rolled step loop is scrapable with zero extra
+    wiring. Pass ``metric=False`` to keep a timer registry-silent
+    (micro-benchmarks that must not pollute the live histogram)."""
+
+    def __init__(self, metric=None):
         self._times = []
         self._t0 = None
         self._first_excluded = False
+        self._metric = metric
+
+    def _resolve_metric(self):
+        if self._metric is None:
+            from pyspark_tf_gke_tpu.obs.metrics import platform_families
+
+            self._metric = platform_families()["train_step_time_ms"]
+        return self._metric
 
     def start(self) -> None:
         self._t0 = time.perf_counter()
@@ -65,6 +114,9 @@ class StepTimer:
             self._first_excluded = True
             return
         self._times.append(dt)
+        metric = self._resolve_metric()
+        if metric:
+            metric.observe(dt * 1000.0)
 
     @property
     def count(self) -> int:

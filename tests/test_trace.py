@@ -1,0 +1,541 @@
+"""End-to-end request tracing (obs/trace.py) — the correlation layer.
+
+Fast tier-1 set: traceparent parse/format round-trips (malformed input
+mints a new root, never an error), flight-recorder ring bounding under
+concurrent writers, contextvar isolation across threads, sampling /
+slow-capture retention semantics, histogram exemplars, the engine's
+span timeline, the pipeline round trace, and router→serve propagation
+through the REAL serve handler bytes (the same pattern as the
+Retry-After round-trip tests). The heavy concurrent soak is
+slow-marked.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pyspark_tf_gke_tpu.obs.events import EventLog
+from pyspark_tf_gke_tpu.obs.export import handle_obs_request
+from pyspark_tf_gke_tpu.obs.metrics import MetricsRegistry, platform_families
+from pyspark_tf_gke_tpu.obs.trace import (
+    Span,
+    TraceRecorder,
+    current_span,
+    current_trace_id,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+    use_span,
+)
+from pyspark_tf_gke_tpu.router.client import ReplicaCall
+
+
+# -- traceparent parse/format -------------------------------------------------
+
+
+def test_traceparent_round_trip():
+    tid, sid = new_trace_id(), new_span_id()
+    assert len(tid) == 32 and len(sid) == 16
+    for sampled in (True, False):
+        header = format_traceparent(tid, sid, sampled)
+        assert parse_traceparent(header) == (tid, sid, sampled)
+    assert format_traceparent(tid, sid, True).endswith("-01")
+    assert format_traceparent(tid, sid, False).endswith("-00")
+
+
+def test_traceparent_malformed_inputs():
+    tid, sid = "ab" * 16, "cd" * 8
+    good = f"00-{tid}-{sid}-01"
+    assert parse_traceparent(good) == (tid, sid, True)
+    bad = [
+        None, 42, "", "garbage", good[:-4],            # truncated
+        good.replace("00-", "ff-"),                     # forbidden version
+        f"00-{tid}-{sid}-01-extra",                     # v00 extra field
+        f"00-{'0' * 32}-{sid}-01",                      # all-zero trace
+        f"00-{tid}-{'0' * 16}-01",                      # all-zero span
+        f"00-{tid[:-2]}-{sid}-01",                      # short trace id
+        f"00-{tid.upper()}-{sid}-01",                   # uppercase hex
+        f"00-{tid}-{sid}-zz",                           # non-hex flags
+        f"0-{tid}-{sid}-01",                            # short version
+    ]
+    for value in bad:
+        assert parse_traceparent(value) is None, value
+    # future versions parse when the v00 prefix shape holds (spec
+    # forward-compat), extra fields allowed
+    assert parse_traceparent(f"42-{tid}-{sid}-01-future") == (tid, sid,
+                                                              True)
+
+
+def test_malformed_header_mints_new_root():
+    rec = TraceRecorder(sample=1.0)
+    span = rec.start_span("req", parent="not-a-traceparent")
+    assert span.parent_id is None
+    assert len(span.trace_id) == 32 and span.trace_id != "0" * 32
+    span.finish()
+    assert rec.traces()[0]["trace_id"] == span.trace_id
+
+
+def test_header_adoption_and_child_spans():
+    rec = TraceRecorder(sample=0.0, slow_ms=0.0)  # disabled: ids only
+    tid, sid = new_trace_id(), new_span_id()
+    span = rec.start_span("req",
+                          parent=format_traceparent(tid, sid, True))
+    assert span.trace_id == tid and span.parent_id == sid
+    child_rec = TraceRecorder(sample=1.0)
+    child = child_rec.start_span("child", parent=span)
+    assert child.trace_id == tid and child.parent_id == span.span_id
+
+
+# -- sampling / slow capture / disabled short-circuit -------------------------
+
+
+def test_disabled_recorder_short_circuits_to_ids_only():
+    rec = TraceRecorder(sample=0.0, slow_ms=0.0)
+    assert not rec.enabled
+    span = rec.start_span("req")
+    assert not span.recording
+    span.event("first_token", ttft_ms=1.0)
+    span.set("k", "v")
+    assert span.events == [] and span.attrs == {}
+    assert len(span.traceparent()) == 55  # ids still propagate
+    span.finish()
+    assert rec.traces() == []
+    assert rec._live == {}  # nothing accumulates
+
+
+def test_slow_capture_retains_unsampled_tail():
+    rec = TraceRecorder(sample=0.0, slow_ms=5.0)
+    fast = rec.start_span("fast")
+    fast.finish()
+    assert rec.traces() == []  # under the threshold, unsampled: dropped
+    slow = rec.start_span("slow")
+    time.sleep(0.02)
+    slow.finish()
+    kept = rec.traces()
+    assert len(kept) == 1 and kept[0]["duration_ms"] >= 5.0
+    assert kept[0]["sampled"] is False
+    # the sampled flag from an upstream hop wins over the local sampler
+    sampled_in = rec.start_span(
+        "joined", parent=format_traceparent(new_trace_id(),
+                                            new_span_id(), True))
+    sampled_in.finish()
+    assert len(rec.traces()) == 2
+
+
+def test_incoming_unsampled_flag_suppresses_retention():
+    rec = TraceRecorder(sample=1.0, slow_ms=0.0)
+    span = rec.start_span(
+        "req", parent=format_traceparent(new_trace_id(), new_span_id(),
+                                         False))
+    span.finish()
+    assert rec.traces() == []  # upstream said unsampled; no slow capture
+
+
+def test_retention_counter_increments():
+    reg = MetricsRegistry()
+    counter = reg.counter("traces_kept_total")
+    rec = TraceRecorder(sample=1.0, counter=counter)
+    rec.start_span("a").finish()
+    rec.start_span("b").finish()
+    assert counter.value == 2
+
+
+def test_trace_jsonl_export(tmp_path):
+    path = str(tmp_path / "traces.jsonl")
+    rec = TraceRecorder(sample=1.0, jsonl_path=path)
+    span = rec.start_span("req")
+    span.event("first_token", ttft_ms=3.0)
+    span.finish()
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 1
+    assert lines[0]["trace_id"] == span.trace_id
+    assert lines[0]["spans"][0]["events"][0]["name"] == "first_token"
+
+
+# -- ring bounding / concurrency ---------------------------------------------
+
+
+def _hammer(rec, n, out):
+    try:
+        for i in range(n):
+            parent = rec.start_span(f"root-{i}")
+            child = rec.start_span("child", parent=parent)
+            child.event("tick", i=i)
+            child.finish()
+            parent.finish()
+    except Exception as exc:  # noqa: BLE001 — surfaced by the test
+        out.append(exc)
+
+
+def test_ring_bounded_under_concurrent_writers():
+    rec = TraceRecorder(sample=1.0, max_traces=8)
+    errors = []
+    threads = [threading.Thread(target=_hammer, args=(rec, 50, errors))
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    kept = rec.traces(limit=1024)
+    assert len(kept) == 8  # ring bound holds
+    assert all(len(t["spans"]) == 2 for t in kept)
+    assert rec._live == {}  # every trace completed and left the map
+
+
+def test_abandoned_spans_do_not_leak():
+    rec = TraceRecorder(sample=1.0, max_traces=4)
+    for i in range(100):
+        rec.start_span(f"never-finished-{i}")  # deliberately leaked
+    assert len(rec._live) <= 4 * rec.max_traces
+
+
+@pytest.mark.slow
+def test_ring_soak_many_concurrent_writers():
+    rec = TraceRecorder(sample=0.5, slow_ms=1.0, max_traces=32)
+    errors = []
+    threads = [threading.Thread(target=_hammer, args=(rec, 500, errors))
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors
+    assert len(rec.traces(limit=4096)) <= 32
+    assert rec._live == {}
+
+
+# -- contextvar isolation -----------------------------------------------------
+
+
+def test_contextvar_isolation_across_threads():
+    rec = TraceRecorder(sample=1.0)
+    seen = {}
+    barrier = threading.Barrier(2, timeout=10)
+
+    def worker(name):
+        span = rec.start_span(name)
+        with use_span(span):
+            barrier.wait()  # both threads hold their span concurrently
+            seen[name] = (current_span().name, current_trace_id())
+            barrier.wait()
+        span.finish()
+
+    threads = [threading.Thread(target=worker, args=(n,))
+               for n in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert seen["a"][0] == "a" and seen["b"][0] == "b"
+    assert seen["a"][1] != seen["b"][1]
+    assert current_span() is None  # nothing bleeds out
+
+
+def test_use_span_none_is_a_noop():
+    with use_span(None) as sp:
+        assert sp is None and current_span() is None
+
+
+# -- histogram exemplars ------------------------------------------------------
+
+
+def test_histogram_exemplars_in_snapshot_not_in_text():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms")
+    h.observe(3.0)                       # exemplar-free observation
+    h.observe(5.0, exemplar="ab" * 16)   # lands in the 8ms bucket
+    snap = reg.snapshot()["lat_ms"]
+    assert snap["exemplars"] == {"8": "ab" * 16}
+    assert "exemplar" not in reg.exposition()  # prom text unchanged
+    h2 = reg.histogram("plain_ms")
+    h2.observe(1.0)
+    assert "exemplars" not in reg.snapshot()["plain_ms"]
+
+
+# -- /traces endpoint ---------------------------------------------------------
+
+
+def test_traces_endpoint_filters():
+    rec = TraceRecorder(sample=1.0)
+    a = rec.start_span("a")
+    a.finish()
+    b = rec.start_span("b")
+    time.sleep(0.02)
+    b.finish()
+    code, ctype, body = handle_obs_request("/traces", MetricsRegistry(),
+                                           tracer=rec)
+    out = json.loads(body)
+    assert code == 200 and len(out["traces"]) == 2
+    assert out["enabled"] is True and out["sample"] == 1.0
+    code, _, body = handle_obs_request(
+        f"/traces?trace_id={b.trace_id}", MetricsRegistry(), tracer=rec)
+    out = json.loads(body)
+    assert [t["trace_id"] for t in out["traces"]] == [b.trace_id]
+    code, _, body = handle_obs_request("/traces?slow_ms=5000",
+                                       MetricsRegistry(), tracer=rec)
+    assert json.loads(body)["traces"] == []
+    code, _, _ = handle_obs_request("/traces?slow_ms=junk",
+                                    MetricsRegistry(), tracer=rec)
+    assert code == 400
+    # without a tracer the route stays unowned (404 at the caller)
+    assert handle_obs_request("/traces", MetricsRegistry()) is None
+
+
+# -- engine timeline ----------------------------------------------------------
+
+
+def test_engine_annotates_request_span():
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
+    from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    cfg = CausalLMConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                         num_heads=2, intermediate_size=64,
+                         max_seq_len=128, dtype=jnp.float32)
+    model = CausalLM(cfg)
+    params = nn.meta.unbox(jax.jit(model.init)(
+        make_rng(0), jnp.ones((1, 8), jnp.int32))["params"])
+    eng = ContinuousEngine(model, params, num_slots=2, chunk=4)
+    rec = TraceRecorder(sample=1.0)
+    span = rec.start_span("serve.request")
+    rng = np.random.default_rng(0)
+    eng.submit(rng.integers(1, 97, 12), max_new_tokens=8, span=span)
+    eng.submit(rng.integers(1, 97, 12), max_new_tokens=4)  # untraced
+    list(eng.run_until_drained())
+    span.finish()
+    [trace] = rec.traces()
+    events = trace["spans"][0]["events"]
+    names = [e["name"] for e in events]
+    assert names[0] == "queue_wait" and names[1] == "admission"
+    assert "first_token" in names
+    ttft = next(e for e in events if e["name"] == "first_token")
+    assert ttft["ttft_ms"] > 0
+
+
+# -- router -> serve propagation through the REAL handler bytes ---------------
+
+
+class _TracedBundleServer:
+    """The minimum surface serve.py's handler touches, PLUS a real
+    TraceRecorder — so the traceparent adoption, the X-Request-Id echo
+    and the shed event are produced by the production handler code and
+    checked against real wire bytes (same pattern as the Retry-After
+    round-trip tests)."""
+
+    def __init__(self, exc=None):
+        self._exc = exc
+        self.draining = False
+        self.registry = MetricsRegistry()
+        self.event_log = None  # handle_obs_request tolerates None
+        self._obs = platform_families(self.registry)
+        self.tracer = TraceRecorder(sample=1.0)
+
+    def record_metrics(self, **kw):
+        pass
+
+    def _http_enter(self):
+        pass
+
+    def _http_exit(self):
+        pass
+
+    def generate(self, prompts, **kw):
+        span = kw.get("span")
+        if span is not None:
+            span.event("first_token", ttft_ms=1.0)
+        if self._exc is not None:
+            raise self._exc
+        return [{"prompt": p, "completion": p, "new_tokens": 1,
+                 "latency_ms": 1.0} for p in prompts]
+
+
+def _serve_fake(fake):
+    from pyspark_tf_gke_tpu.train.serve import start_http_server
+
+    httpd = start_http_server(fake, host="127.0.0.1", port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _wait_trace(recorder, trace_id, timeout_s=5.0):
+    """The handler finishes its span just AFTER the response bytes
+    leave — poll the ring briefly instead of racing the handler
+    thread."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        found = recorder.traces(trace_id=trace_id)
+        if found:
+            return found
+        time.sleep(0.01)
+    return recorder.traces(trace_id=trace_id)
+
+
+def test_serve_handler_adopts_traceparent_and_echoes_request_id():
+    fake = _TracedBundleServer()
+    httpd, url = _serve_fake(fake)
+    tid = new_trace_id()
+    try:
+        call = ReplicaCall(url, timeout_s=10).request(
+            "POST", "/v1/generate",
+            body=json.dumps({"prompts": ["x"]}).encode(),
+            headers={"traceparent": format_traceparent(
+                tid, new_span_id(), True)})
+        assert call.status == 200
+        assert call.header("X-Request-Id") == tid
+        call.read_json()
+        call.close()
+    finally:
+        httpd.shutdown()
+    [trace] = _wait_trace(fake.tracer, tid)
+    [span] = trace["spans"]
+    assert span["name"] == "serve.request"
+    assert span["attrs"]["http.status"] == 200
+    assert [e["name"] for e in span["events"]] == ["first_token"]
+
+
+def test_keep_alive_get_does_not_echo_previous_posts_trace_id():
+    """Handler instances live per keep-alive CONNECTION: a GET after a
+    POST on the same socket must not carry the POST's X-Request-Id
+    (the stale-span regression)."""
+    import http.client
+
+    fake = _TracedBundleServer()
+    httpd, url = _serve_fake(fake)
+    try:
+        host, port = httpd.server_address[:2]
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("POST", "/v1/generate",
+                     body=json.dumps({"prompts": ["x"]}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        post_rid = resp.getheader("X-Request-Id")
+        resp.read()
+        assert post_rid  # the POST itself echoes its trace id
+        conn.request("GET", "/metrics.json")  # SAME connection
+        resp = conn.getresponse()
+        assert resp.getheader("X-Request-Id") is None
+        resp.read()
+        conn.close()
+    finally:
+        httpd.shutdown()
+
+
+def test_serve_handler_traces_shed_with_request_id():
+    from pyspark_tf_gke_tpu.train.serve import RequestRejected
+
+    fake = _TracedBundleServer(exc=RequestRejected(
+        "tenant_quota", "tenant 'noisy' quota exhausted", status=429,
+        retry_after_s=7, tenant="noisy"))
+    httpd, url = _serve_fake(fake)
+    try:
+        call = ReplicaCall(url, timeout_s=10).request(
+            "POST", "/v1/generate",
+            body=json.dumps({"prompts": ["x"]}).encode())
+        assert call.status == 429
+        tid = call.header("X-Request-Id")
+        assert tid and len(tid) == 32  # sheds echo the id too
+        call.close()
+    finally:
+        httpd.shutdown()
+    [trace] = _wait_trace(fake.tracer, tid)
+    events = [e for s in trace["spans"] for e in s["events"]]
+    shed = next(e for e in events if e["name"] == "shed")
+    assert shed["reason"] == "tenant_quota" and shed["tenant"] == "noisy"
+    assert trace["spans"][0]["attrs"]["http.status"] == 429
+
+
+def test_router_propagates_trace_to_real_serve_handler(tmp_path):
+    """router span -> traceparent header -> REAL serve handler ->
+    serve-side trace under the SAME id, with the router's route
+    decision on its own span: the end-to-end join the flight recorders
+    exist for, on real bytes."""
+    from pyspark_tf_gke_tpu.router.discovery import UP, Replica
+    from pyspark_tf_gke_tpu.router.gateway import RouterServer
+
+    fake = _TracedBundleServer()
+    httpd, url = _serve_fake(fake)
+    try:
+        router = RouterServer(
+            [Replica(rid=url, base_url=url)],
+            hedge=False, affinity_tokens=0,
+            registry=MetricsRegistry(),
+            event_log=EventLog(str(tmp_path / "ev.jsonl")),
+            trace_sample=1.0)
+        router.replicas.set_state(url, UP, load={})
+        span = router.tracer.start_span("router.request")
+        status, out, hdrs = router.route_json(
+            "/v1/generate", {"prompts": ["x"], "max_new_tokens": 2},
+            span=span)
+        span.finish()
+        assert status == 200
+        # ONE trace id on both sides of the wire
+        assert _wait_trace(fake.tracer, span.trace_id), \
+            "serve never joined the router's trace"
+        [rt] = router.tracer.traces(trace_id=span.trace_id)
+        names = [e["name"] for s in rt["spans"] for e in s["events"]]
+        assert "route" in names
+        # the latency histogram carries the trace id as an exemplar
+        snap = router.registry.snapshot()["router_request_latency_ms"]
+        assert span.trace_id in snap.get("exemplars", {}).values()
+    finally:
+        httpd.shutdown()
+
+
+# -- pipeline round trace -----------------------------------------------------
+
+
+def test_pipeline_round_opens_one_trace_with_stage_spans(tmp_path):
+    from pyspark_tf_gke_tpu.pipeline.coordinator import PipelineCoordinator
+
+    seen = {}
+
+    def stage(name):
+        def run(state, outputs):
+            seen[name] = current_trace_id()
+            return {"stage": name}
+
+        return run
+
+    coord = PipelineCoordinator(
+        {n: stage(n) for n in ("ingest", "train", "export", "publish")},
+        state_path=str(tmp_path / "state.json"), rounds=1,
+        obs=platform_families(MetricsRegistry()),
+        event_log=EventLog(str(tmp_path / "ev.jsonl")))
+    coord.run()
+    # every stage saw ONE nonzero trace id — the round's
+    ids = set(seen.values())
+    assert len(ids) == 1 and None not in ids
+    [trace] = coord.tracer.traces(trace_id=ids.pop())
+    names = sorted(s["name"] for s in trace["spans"])
+    assert names == ["pipeline.export", "pipeline.ingest",
+                     "pipeline.publish", "pipeline.round",
+                     "pipeline.train"]
+
+
+def test_ingest_stage_stamps_trace_id_into_manifest(tmp_path):
+    from pyspark_tf_gke_tpu.pipeline.coordinator import PipelineState
+    from pyspark_tf_gke_tpu.pipeline.manifest import ShardSetManifest
+    from pyspark_tf_gke_tpu.pipeline.stages import (
+        LocalPipelineConfig,
+        ingest_stage,
+    )
+
+    cfg = LocalPipelineConfig(work_dir=str(tmp_path), rows_per_round=8,
+                              seq_len=16, num_shards=1)
+    state = PipelineState(str(tmp_path / "state.json"))
+    rec = TraceRecorder(sample=1.0)
+    span = rec.start_span("pipeline.round")
+    with use_span(span):
+        ingest_stage(cfg)(state, {})
+    span.finish()
+    [record] = list(ShardSetManifest(cfg.manifest_path).records())
+    assert record["trace_id"] == span.trace_id  # meta merges flat

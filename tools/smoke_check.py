@@ -53,9 +53,18 @@ bundle generation MID generate-stream (explicit stream terminal, zero
 drops), a corrupt-bundle publish rolled off with the old generation
 intact, and a clean SIGTERM drain.
 
+``--trace`` checks the end-to-end tracing contract live: a generate
+with an injected ``traceparent`` through a router subprocess + 1 CPU
+replica must surface the SAME trace id on both processes' ``/traces``
+(serve-side timeline carrying queue-wait/admission/prefill-chunk/
+first-token/terminal events), echo it as ``X-Request-Id`` including on
+a per-tenant 429 shed (with the shed verdict on the trace), and a
+pipeline round's trace id must be recoverable from the published
+bundle's meta.
+
 Usage: python tools/smoke_check.py
        [--lint-only|--kernels-only|--serve-lifecycle|--serve-tbt|
-        --router|--prefix-cache|--fairness|--pipeline]
+        --router|--prefix-cache|--fairness|--pipeline|--trace]
 """
 
 import os
@@ -168,7 +177,16 @@ def lint_duplicate_metrics() -> int:
                 "pipeline_bundle_generation",
                 "pipeline_freshness_seconds",
                 "serve_bundle_generation",
-                "serve_bundle_reloads_total"}
+                "serve_bundle_reloads_total",
+                # request tracing: the /traces flight recorders'
+                # retention counters, and the histograms that carry
+                # per-bucket trace-id exemplars in the JSON snapshot
+                # (docs/OBSERVABILITY.md "Tracing") — renames must
+                # fail here first
+                "serve_traces_recorded_total",
+                "router_traces_recorded_total",
+                "serve_generate_latency_ms",
+                "router_request_latency_ms"}
     absent = {n for n in required if n not in _REGISTRATIONS}
     if absent:
         print("metric lint FAILED — required metric name(s) never "
@@ -1137,6 +1155,209 @@ def pipeline_check(grace_s: float = 90.0) -> int:
     return 0
 
 
+def trace_check(grace_s: float = 30.0) -> int:
+    """``--trace``: the end-to-end tracing contract, live.
+
+    1 CPU replica (chunked prefill on, trace sample 1.0, a metered
+    tenant) behind the real router CLI (trace sample 1.0):
+
+    1. a generate with an INJECTED ``traceparent`` routed through the
+       router echoes the injected trace id back as ``X-Request-Id``,
+       and ``GET /traces?trace_id=`` on BOTH processes returns spans
+       under that same id — the cross-process join works on real wire
+       bytes;
+    2. the serve-side span's timeline carries the full slot lifecycle:
+       queue-wait, admission, prefill-chunk (the prompt is longer than
+       the chunk), first-token (TTFT), and terminal events;
+    3. a per-tenant quota shed (429) still echoes its trace id and its
+       trace records the shed verdict — the 429 a user reports is one
+       /traces lookup from its reason;
+    4. one in-process pipeline round's trace id is recoverable from
+       the published bundle's meta — serving-generation → producing-
+       round lineage."""
+    import json as _json
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from pyspark_tf_gke_tpu.obs.trace import (
+        format_traceparent,
+        new_span_id,
+        new_trace_id,
+    )
+    from pyspark_tf_gke_tpu.router.localfleet import (
+        export_tiny_bundle,
+        free_port,
+        launch_replica,
+        launch_router,
+        wait_healthy,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="trace-smoke-")
+    bundle = export_tiny_bundle(os.path.join(tmp, "bundle"))
+    port, router_port = free_port(), free_port()
+    replica_url = f"http://127.0.0.1:{port}"
+    router_url = f"http://127.0.0.1:{router_port}"
+    proc = launch_replica(
+        bundle, port, quiet=False,
+        extra_args=("--trace-sample", "1.0", "--trace-slow-ms", "0",
+                    "--prefill-chunk", "32",
+                    "--tenants", "smoke=1:0.5:40"))
+    router_proc = None
+    failures = []
+
+    def post(base, payload, headers=None, timeout=120.0):
+        """POST /v1/generate -> (status, body, response headers) —
+        HTTP error verdicts are data here, not exceptions."""
+        req = urllib.request.Request(
+            base + "/v1/generate", data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json",
+                     **(headers or {})})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, _json.loads(resp.read()), resp.headers
+        except urllib.error.HTTPError as exc:
+            try:
+                body = _json.loads(exc.read() or b"{}")
+            except ValueError:
+                body = {}
+            return exc.code, body, exc.headers
+
+    def get(base, path):
+        with urllib.request.urlopen(base + path, timeout=10) as resp:
+            return _json.loads(resp.read())
+
+    try:
+        import time as _time
+
+        deadline = _time.time() + 180
+        wait_healthy(replica_url, deadline, proc=proc)
+        router_proc = launch_router(
+            [port], router_port, quiet=False,
+            extra_args=("--trace-sample", "1.0", "--trace-slow-ms", "0",
+                        "--no-hedge", "--drain-timeout", "1"))
+        wait_healthy(router_url, deadline, proc=router_proc)
+        # warm/compile on an unmetered tenant so the traced request's
+        # timing (and the smoke tenant's token bucket) stay clean
+        post(router_url, {"prompts": ["warm the compiled shapes"],
+                          "max_new_tokens": 4})
+
+        # -- 1+2: injected traceparent, one id across both processes --
+        trace_id = new_trace_id()
+        parent = format_traceparent(trace_id, new_span_id(), sampled=True)
+        # > --prefill-chunk bytes (byte tokenizer), so the admission
+        # takes the chunked route and the timeline gets its
+        # prefill_chunk events; prompt + budget stays under max_seq_len
+        prompt = "trace this request through every hop it takes"
+        status, body, hdrs = post(
+            router_url, {"prompts": [prompt], "max_new_tokens": 8},
+            headers={"traceparent": parent})
+        if status != 200 or "completions" not in body:
+            failures.append(f"routed traced generate failed: {status} "
+                            f"{str(body)[:200]}")
+        if hdrs.get("X-Request-Id") != trace_id:
+            failures.append(
+                f"X-Request-Id {hdrs.get('X-Request-Id')} != injected "
+                f"trace id {trace_id}")
+        found_events = []
+        for name, base in (("router", router_url),
+                           ("serve", replica_url)):
+            out = get(base, f"/traces?trace_id={trace_id}")
+            spans = [s for t in out.get("traces", ())
+                     for s in t["spans"]]
+            if not spans:
+                failures.append(
+                    f"{name} /traces has NO spans under the injected "
+                    f"trace id (got {len(out.get('traces', ()))} traces)")
+                continue
+            if name == "serve":
+                found_events = sorted({e["name"] for s in spans
+                                       for e in s["events"]})
+        wanted = {"queue_wait", "admission", "prefill_chunk",
+                  "first_token", "terminal"}
+        missing = wanted - set(found_events)
+        if missing:
+            failures.append(
+                f"serve-side timeline is missing {sorted(missing)} "
+                f"(has {found_events})")
+        print(f"trace: id {trace_id[:16]}… spans on router AND serve; "
+              f"serve events: {found_events}")
+
+        # -- 3: a per-tenant shed still traces + echoes the id --------
+        shed_headers = {"X-Tenant": "smoke"}
+        post(router_url, {"prompts": ["quota setup abcdef"],
+                          "max_new_tokens": 16}, headers=shed_headers)
+        status, body, hdrs = post(
+            router_url, {"prompts": ["quota breaker abcde"],
+                         "max_new_tokens": 16}, headers=shed_headers)
+        shed_trace = hdrs.get("X-Request-Id")
+        if status != 429:
+            failures.append(f"quota shed expected 429, got {status} "
+                            f"{str(body)[:200]}")
+        elif not shed_trace:
+            failures.append("429 shed carried no X-Request-Id")
+        else:
+            out = get(replica_url, f"/traces?trace_id={shed_trace}")
+            events = {e["name"] for t in out.get("traces", ())
+                      for s in t["spans"] for e in s["events"]}
+            if "shed" not in events:
+                failures.append(
+                    f"shed trace {shed_trace[:16]}… lacks the shed "
+                    f"verdict event (has {sorted(events)})")
+            else:
+                print(f"trace: 429 shed traced as {shed_trace[:16]}… "
+                      "with its shed verdict")
+
+        # -- 4: pipeline round trace id lands in the bundle meta ------
+        from pyspark_tf_gke_tpu.pipeline import (
+            LocalPipelineConfig,
+            PipelineCoordinator,
+            make_local_stages,
+        )
+
+        cfg = LocalPipelineConfig(
+            work_dir=os.path.join(tmp, "pipe"), rows_per_round=64,
+            seq_len=64, num_shards=2, steps_per_round=2, batch_size=4,
+            hidden_size=32, num_layers=2, num_heads=2,
+            intermediate_size=64)
+        coord = PipelineCoordinator(
+            make_local_stages(cfg),
+            state_path=os.path.join(tmp, "pipe", "state.json"), rounds=1)
+        coord.run()
+        with open(os.path.join(cfg.bundle_dir(1), "config.json")) as fh:
+            meta = _json.load(fh)
+        round_trace = meta.get("trace_id")
+        ring_ids = {t["trace_id"] for t in coord.tracer.traces()}
+        if not round_trace:
+            failures.append(f"bundle meta carries no trace_id: "
+                            f"{sorted(meta)}")
+        elif round_trace not in ring_ids:
+            failures.append(
+                f"bundle trace_id {round_trace[:16]}… not in the "
+                "coordinator's flight recorder")
+        else:
+            print(f"trace: pipeline round trace {round_trace[:16]}… "
+                  "recoverable from the published bundle meta")
+    finally:
+        for p in (router_proc, proc):
+            if p is not None and p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=grace_s)
+                except Exception:  # noqa: BLE001
+                    p.kill()
+                    p.wait(timeout=10)
+    if failures:
+        print("trace FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("trace OK: one trace id spans router and serve, the serve "
+          "timeline carries the full slot lifecycle, sheds trace too, "
+          "and the pipeline round's trace id rides the bundle meta")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--kernels-only" in argv:
@@ -1153,6 +1374,8 @@ def main(argv=None) -> int:
         return fairness_check()
     if "--pipeline" in argv:
         return pipeline_check()
+    if "--trace" in argv:
+        return trace_check()
     if "--lint-only" not in argv:
         devices = jax.devices()
         print(f"devices: {devices}")
