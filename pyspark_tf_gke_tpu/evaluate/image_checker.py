@@ -45,7 +45,12 @@ class ManualImageChecker:
         step = mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
-        restored = mgr.restore(step)
+        # CheckpointManager.save() goes through StandardSave (one
+        # "default" item); a bare restore(step) on current orbax asks
+        # the composite handler to restore an item it has no handler
+        # for and raises KeyError. StandardRestore() (no target tree —
+        # the checkpoint's own topology) mirrors the save path.
+        restored = mgr.restore(step, args=ocp.args.StandardRestore())
         mgr.close()
         # TrainState layout: {'params': ..., ...} or the state pytree itself
         params = restored.get("params") if isinstance(restored, dict) else restored.params

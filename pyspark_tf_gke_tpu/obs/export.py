@@ -126,6 +126,7 @@ def handle_obs_request(
     if route == "/traces" and tracer is not None:
         slow_ms = trace_id = None
         n = 64
+        jsonl = False
         for part in query.split("&"):
             key, _, val = part.partition("=")
             try:
@@ -135,12 +136,25 @@ def handle_obs_request(
                     trace_id = val
                 elif key == "n" and val:
                     n = max(1, min(int(val), 1024))
+                elif key == "format" and val:
+                    if val not in ("json", "jsonl"):
+                        return (400, "application/json",
+                                b'{"error": "format must be json '
+                                b'or jsonl"}')
+                    jsonl = val == "jsonl"
             except ValueError:
                 return (400, "application/json",
                         b'{"error": "bad /traces query parameter"}')
-        body = json.dumps({**tracer.snapshot(),
-                           "traces": tracer.traces(
-                               slow_ms=slow_ms, trace_id=trace_id,
-                               limit=n)})
+        traces = tracer.traces(slow_ms=slow_ms, trace_id=trace_id,
+                               limit=n)
+        if jsonl:
+            # line-delimited export: one completed trace per line, no
+            # envelope — ``tools/replay.py extract`` (and any jq/awk
+            # pipeline) streams it line by line instead of loading the
+            # whole ring into one JSON document; bounded by ?n= like
+            # the JSON form
+            body = "".join(json.dumps(t) + "\n" for t in traces)
+            return 200, "application/x-ndjson", body.encode()
+        body = json.dumps({**tracer.snapshot(), "traces": traces})
         return 200, "application/json", body.encode()
     return None

@@ -740,3 +740,57 @@ def router_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "no backoff, no re-route, no DOWN marking",
             labelnames=("tenant",)),
     }
+
+
+def replay_families(registry: Optional[MetricsRegistry] = None) -> dict:
+    """Register (idempotently) the replay plane's metric families.
+
+    The replay driver (``pyspark_tf_gke_tpu/replay/driver.py``) is a
+    CLIENT — a jax-free load generator replaying a workload spec
+    against a fleet — so its families measure what the client saw
+    (TTFT/TBT/latency per replayed request, outcome taxonomy,
+    open-loop scheduling health), which is the ground truth SLO
+    reports and the capacity model's agreement check are built on.
+    Defined here so the whole platform's metric names keep one
+    definition site and the duplicate-name lint covers them."""
+    r = registry if registry is not None else get_registry()
+    return {
+        "replay_requests_total": r.counter(
+            "replay_requests_total",
+            "Replayed requests by terminal outcome "
+            "(ok | shed | deadline | error)",
+            labelnames=("outcome",)),
+        "replay_tenant_requests_total": r.counter(
+            "replay_tenant_requests_total",
+            "Replayed requests by tenant and terminal outcome (the "
+            "fairness-ratio inputs)",
+            labelnames=("tenant", "outcome")),
+        "replay_sheds_total": r.counter(
+            "replay_sheds_total",
+            "Replayed requests the fleet shed, by server-reported "
+            "reason (queue_full | tenant_quota | tenant_queue_full | "
+            "draining | ...) — the shed taxonomy SLO assertions read",
+            labelnames=("reason",)),
+        "replay_ttft_ms": r.histogram(
+            "replay_ttft_ms",
+            "Client-measured time to first token per streamed replayed "
+            "request (fire -> first data: token event)"),
+        "replay_tbt_ms": r.histogram(
+            "replay_tbt_ms",
+            "Client-measured time between token deliveries within one "
+            "replayed stream (the client-side mirror of serve_tbt_ms)"),
+        "replay_request_latency_ms": r.histogram(
+            "replay_request_latency_ms",
+            "End-to-end latency per replayed request (all outcomes)"),
+        "replay_sched_lag_ms": r.histogram(
+            "replay_sched_lag_ms",
+            "How late the open-loop driver fired each request vs its "
+            "spec offset — client-side scheduling error; a large tail "
+            "means the DRIVER was starved and the measurement is "
+            "polluted"),
+        "replay_goodput": r.gauge(
+            "replay_goodput",
+            "Fraction of the last replay's requests that completed OK "
+            "within their deadline — THE trace-replay serving metric "
+            "(DistServe/Mooncake's SLO attainment)"),
+    }

@@ -382,6 +382,36 @@ def use_span(span: Optional[Span]):
         _current_span.reset(token)
 
 
+# -- request-shape annotation (the replay-extraction contract) ----------------
+
+# The attribute key set replay extraction reads off a request's span
+# (pyspark_tf_gke_tpu/replay/extract.py; pinned by test so the
+# contract can't silently rot): every submitted request carries these
+# three, plus deadline_ms when the client sent a deadline. ONE
+# definition site — the engine, the serve front and the extractor all
+# import it from here.
+REQUEST_SHAPE_ATTRS = ("tenant", "prompt_tokens", "max_new_tokens")
+REQUEST_SHAPE_OPTIONAL_ATTRS = ("deadline_ms",)
+
+
+def annotate_request_shape(span: Optional[Span], *, tenant,
+                           prompt_tokens, max_new_tokens,
+                           deadline_s=None) -> None:
+    """Stamp the request SHAPE — everything a workload spec needs —
+    onto the request's span. Called by the serve front BEFORE the
+    admission gates (a shed request is still demand the capacity
+    planner must see) and by the engine at submit (direct engine
+    callers get the same contract). Idempotent: both call sites write
+    the same values. None span = untraced request, no-op."""
+    if span is None:
+        return
+    span.set("tenant", str(tenant))
+    span.set("prompt_tokens", int(prompt_tokens))
+    span.set("max_new_tokens", int(max_new_tokens))
+    if deadline_s is not None:
+        span.set("deadline_ms", round(float(deadline_s) * 1000.0, 3))
+
+
 # There is deliberately NO process-default recorder: each plane's entry
 # point (BundleServer, RouterServer, PipelineCoordinator) owns its own
 # TraceRecorder, and everything downstream reaches the live trace only

@@ -1,0 +1,486 @@
+"""Offline capacity model: predict a spec's outcome before running it.
+
+The model is a request-level discrete-event simulation of the same
+admission math the serving plane exposes on ``/loadz`` and the router
+scores on:
+
+* routing = least-outstanding-tokens across replicas (the router's
+  ``queued_tokens + active`` scoring) with the router's SINGLE
+  re-route on a refusal; a replica refuses when its queue bounds
+  (``max_queued_tokens`` / ``max_queue_depth`` — serve's
+  ``--max-queued-tokens``/``--max-queue-depth``) would be exceeded
+  (a ``queue_full`` shed), and — with ``router_backoff_s`` set — a
+  refusal starts that replica's Retry-After backoff, so a storm where
+  every replica has shed once yields ``no_replicas`` sheds until a
+  backoff expires, exactly like the real gateway,
+* each replica = ``slots_per_replica`` parallel servers over a KV page
+  budget (``ceil((prompt + output) / page_size)`` pages held for the
+  request's lifetime — the engine's zero-mid-decode-alloc discipline),
+* service time = ``prompt_tokens * (1 - prefix_hit_rate) /
+  prefill_tokens_per_sec + output_tokens / decode_tokens_per_sec``
+  (+ a fixed per-request overhead) — prefix hits elide prefill work
+  exactly as the radix cache does,
+* queued requests expire at their deadline before admission, and an
+  in-slot finish past the deadline is a deadline outcome (the engine
+  cancels at chunk boundaries).
+
+What it deliberately does NOT model: DWRR inter-tenant ordering
+(queues are FIFO — fairness predictions need the replay, not the
+model), chunked-prefill interleaving, and prefix-cache WARMUP (the
+hit rate is an input, not a simulation). Those are second-order for
+the questions this answers — "how many replicas for this trace", "what
+queue delay does this HPA target imply" — and the
+prediction-vs-replay band (:func:`check_agreement`, asserted by
+``smoke_check --replay``) is the honesty check that the simplification
+stays within bounds.
+
+Rates come from :func:`calibrate_rates` (a few serial requests against
+an idle fleet), so the model predicts QUEUEING behavior on top of
+measured service speed rather than guessing both.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import math
+from collections import deque
+from typing import List, Optional
+
+from pyspark_tf_gke_tpu.replay.spec import WorkloadSpec
+from pyspark_tf_gke_tpu.replay.stats import pct as _pct
+from pyspark_tf_gke_tpu.replay.stats import summary as _summary
+
+
+@dataclasses.dataclass
+class FleetModel:
+    """The capacity inputs: fleet shape + service rates + cache
+    assumption. ``kv_pages`` None models a dense (slot-only) engine."""
+
+    replicas: int = 2
+    slots_per_replica: int = 2
+    kv_pages: Optional[int] = None          # per replica
+    page_size: int = 16
+    max_queued_tokens: Optional[int] = None  # per replica
+    max_queue_depth: Optional[int] = None    # per replica
+    prefill_tokens_per_sec: float = 2000.0
+    decode_tokens_per_sec: float = 50.0      # per slot
+    overhead_ms: float = 0.0                 # fixed per-request
+    prefix_hit_rate: float = 0.0             # assumed, in [0, 1)
+    # router Retry-After honoring: a replica that sheds a global 429
+    # is offered no new work for this long (serve's queue_full
+    # Retry-After is 1 s). 0 = model the replicas alone (no router in
+    # front). With it on, the model reproduces the router's overload
+    # CLIFF: once every replica has shed once, arrivals get
+    # "no_replicas" until a backoff expires — which is exactly what a
+    # measured flash crowd through the real router shows.
+    router_backoff_s: float = 0.0
+
+    def validate(self) -> "FleetModel":
+        if self.replicas < 1 or self.slots_per_replica < 1:
+            raise ValueError("replicas and slots_per_replica must be >= 1")
+        if self.prefill_tokens_per_sec <= 0 \
+                or self.decode_tokens_per_sec <= 0:
+            raise ValueError("service rates must be > 0")
+        if not 0.0 <= self.prefix_hit_rate < 1.0:
+            raise ValueError("prefix_hit_rate must be in [0, 1)")
+        if self.router_backoff_s < 0:
+            raise ValueError("router_backoff_s must be >= 0")
+        return self
+
+    def service_s(self, prompt_tokens: int, output_tokens: int) -> float:
+        """Zero-load service time of one request — the closed form the
+        zero-load test pins."""
+        prefill = (prompt_tokens * (1.0 - self.prefix_hit_rate)
+                   / self.prefill_tokens_per_sec)
+        decode = output_tokens / self.decode_tokens_per_sec
+        return self.overhead_ms / 1000.0 + prefill + decode
+
+
+class _SimRequest:
+    __slots__ = ("arrival", "tenant", "tokens", "pages", "service_s",
+                 "decode_s", "deadline_abs", "start", "finish",
+                 "outcome")
+
+    def __init__(self, arrival, tenant, tokens, pages, service_s,
+                 decode_s, deadline_abs):
+        self.arrival = arrival
+        self.tenant = tenant
+        self.tokens = tokens
+        self.pages = pages
+        self.service_s = service_s
+        self.decode_s = decode_s
+        self.deadline_abs = deadline_abs
+        self.start = None
+        self.finish = None
+        self.outcome = "queued"
+
+
+class _SimReplica:
+    def __init__(self, model: FleetModel):
+        self.slots_free = model.slots_per_replica
+        self.pages_free = model.kv_pages
+        self.queue: "deque[_SimRequest]" = deque()
+        self.queued_tokens = 0
+        self.outstanding_tokens = 0
+        self.finishes: list = []  # heap of (finish_time, seq, req)
+        self._seq = itertools.count()
+
+    def accepts(self, model: FleetModel, req: _SimRequest) -> bool:
+        if model.max_queue_depth is not None \
+                and len(self.queue) >= model.max_queue_depth:
+            return False
+        if model.max_queued_tokens is not None \
+                and self.queued_tokens + req.tokens \
+                > model.max_queued_tokens:
+            return False
+        return True
+
+    def try_admit(self, now: float) -> None:
+        while self.queue and self.slots_free > 0:
+            req = self.queue[0]
+            if req.deadline_abs is not None and now > req.deadline_abs:
+                # expired in queue — the engine sheds BEFORE admission
+                self.queue.popleft()
+                self.queued_tokens -= req.tokens
+                self.outstanding_tokens -= req.tokens
+                req.start = req.deadline_abs
+                req.outcome = "deadline"
+                continue
+            if self.pages_free is not None \
+                    and req.pages > self.pages_free:
+                return  # head-of-line waits for pages, like the engine
+            self.queue.popleft()
+            self.queued_tokens -= req.tokens
+            self.slots_free -= 1
+            if self.pages_free is not None:
+                self.pages_free -= req.pages
+            req.start = now
+            req.finish = now + req.service_s
+            heapq.heappush(self.finishes,
+                           (req.finish, next(self._seq), req))
+
+    def advance(self, t: float) -> None:
+        while self.finishes and self.finishes[0][0] <= t:
+            ft, _, req = heapq.heappop(self.finishes)
+            self.slots_free += 1
+            if self.pages_free is not None:
+                self.pages_free += req.pages
+            self.outstanding_tokens -= req.tokens
+            req.outcome = ("deadline"
+                           if req.deadline_abs is not None
+                           and req.finish > req.deadline_abs else "ok")
+            self.try_admit(ft)
+
+
+def predict(model: FleetModel, spec: WorkloadSpec, *,
+            speedup: float = 1.0) -> dict:
+    """Simulate ``spec`` through ``model`` and return a report shaped
+    like the replay driver's (same keys the SLO evaluator and
+    :func:`check_agreement` read), with an extra ``queue_delay_ms``
+    summary — the /loadz ``queue_delay_ms`` analog."""
+    model.validate()
+    if speedup <= 0:
+        raise ValueError("speedup must be > 0")
+    reps = [_SimReplica(model) for _ in range(model.replicas)]
+    sims: List[_SimRequest] = []
+    for r in spec.requests:
+        tokens = r.prompt_tokens + r.output_tokens
+        pages = (math.ceil(tokens / model.page_size)
+                 if model.kv_pages is not None else 0)
+        arrival = r.offset_s / speedup
+        deadline_abs = (arrival + r.deadline_ms / 1000.0
+                        if r.deadline_ms is not None else None)
+        hit_frac = model.prefix_hit_rate if r.prefix_group else 0.0
+        service = FleetModel.service_s(
+            dataclasses.replace(model, prefix_hit_rate=hit_frac),
+            r.prompt_tokens, r.output_tokens)
+        decode_s = r.output_tokens / model.decode_tokens_per_sec
+        sims.append(_SimRequest(arrival, r.tenant, tokens, pages,
+                                service, decode_s, deadline_abs))
+
+    shed_reasons: dict = {}
+    backoff_until = [0.0] * len(reps)
+
+    def _enqueue(rep, req):
+        rep.queue.append(req)
+        rep.queued_tokens += req.tokens
+        rep.outstanding_tokens += req.tokens
+        rep.try_admit(req.arrival)
+
+    for req in sims:  # arrivals are offset-sorted (spec invariant)
+        for rep in reps:
+            rep.advance(req.arrival)
+        if model.kv_pages is not None and req.pages > model.kv_pages:
+            req.outcome = "error"  # terminal 400: bigger than the pool
+            continue
+        # the router's view: backed-off replicas are not offered work
+        avail = sorted(
+            (i for i in range(len(reps))
+             if req.arrival >= backoff_until[i]),
+            key=lambda i: reps[i].outstanding_tokens)
+        if not avail:
+            req.outcome = "shed"
+            shed_reasons["no_replicas"] = (
+                shed_reasons.get("no_replicas", 0) + 1)
+            continue
+        # primary pick + the router's single re-route on a 429; each
+        # refusal starts that replica's Retry-After backoff
+        placed = False
+        for attempt, i in enumerate(avail[:2]):
+            if reps[i].accepts(model, req):
+                _enqueue(reps[i], req)
+                placed = True
+                break
+            if model.router_backoff_s > 0:
+                backoff_until[i] = max(
+                    backoff_until[i],
+                    req.arrival + model.router_backoff_s)
+        if not placed:
+            req.outcome = "shed"
+            shed_reasons["queue_full"] = (
+                shed_reasons.get("queue_full", 0) + 1)
+    for rep in reps:
+        rep.advance(float("inf"))
+
+    outcomes = {"ok": 0, "shed": 0, "deadline": 0, "error": 0}
+    qdelay, lat, ttft = [], [], []
+    tenants: dict = {}
+    good = 0
+    for req in sims:
+        out = req.outcome if req.outcome != "queued" else "error"
+        outcomes[out] = outcomes.get(out, 0) + 1
+        t = tenants.setdefault(
+            req.tenant, {"ok": 0, "shed": 0, "deadline": 0, "error": 0,
+                         "lat_ms": []})
+        t[out] += 1
+        if req.start is not None:
+            qdelay.append(max(0.0, (req.start - req.arrival) * 1000.0))
+        if out == "ok":
+            good += 1
+            latency = (req.finish - req.arrival) * 1000.0
+            lat.append(latency)
+            t["lat_ms"].append(latency)
+            # predicted TTFT = queue delay + overhead + prefill
+            # = latency minus the decode phase
+            ttft.append(latency - req.decode_s * 1000.0)
+    n = len(sims)
+    tenant_out = {}
+    ok_rates = []
+    for name, t in sorted(tenants.items()):
+        total = t["ok"] + t["shed"] + t["deadline"] + t["error"]
+        ok_rate = round(t["ok"] / total, 4) if total else 1.0
+        ok_rates.append(ok_rate)
+        tenant_out[name] = {
+            "requests": total, "ok": t["ok"], "shed": t["shed"],
+            "deadline": t["deadline"], "error": t["error"],
+            "ok_rate": ok_rate,
+            "latency_p99_ms": _pct(t["lat_ms"], 0.99),
+        }
+    return {
+        "kind": "pyspark_tf_gke_tpu.replay_prediction",
+        "spec": {"name": spec.name, "seed": spec.seed, "n_requests": n,
+                 "duration_s": round(spec.duration_s, 3)},
+        "speedup": speedup,
+        "model": dataclasses.asdict(model),
+        "outcomes": outcomes,
+        "sheds": dict(sorted(shed_reasons.items())),
+        # None on an empty spec, like the driver: a prediction over
+        # nothing must fail SLO bounds as unmeasurable, never pass
+        "goodput": round(good / n, 4) if n else None,
+        "queue_delay_ms": _summary(qdelay),
+        "latency_ms": _summary(lat),
+        "ttft_ms": _summary(ttft),
+        "tenants": tenant_out,
+        "tenant_ok_rate_ratio": (
+            (round(min(ok_rates) / max(ok_rates), 4)
+             if max(ok_rates) > 0 else 1.0)
+            if ok_rates else None),
+    }
+
+
+def _stream_stats(report: dict) -> Optional[dict]:
+    oks = [r for r in report.get("requests", [])
+           if r["outcome"] == "ok" and r["ttft_ms"]]
+    if not oks:
+        return None
+    ttft_s = sum(r["ttft_ms"] for r in oks) / len(oks) / 1000.0
+    lat_s = sum(r["latency_ms"] for r in oks) / len(oks) / 1000.0
+    toks = sum(r["tokens_out"] for r in oks) / len(oks)
+    return {"n": len(oks), "ttft_s": ttft_s, "lat_s": lat_s,
+            "makespan_s": max(r["latency_ms"] for r in oks) / 1000.0,
+            "toks": toks,
+            "decode_rate": max(toks - 1, 1) / max(lat_s - ttft_s, 1e-6)}
+
+
+def calibrate_rates(base_url: str, *, prompt_tokens: int = 24,
+                    output_tokens: int = 8, n: int = 2,
+                    concurrency: int = 1,
+                    total_slots: Optional[int] = None,
+                    timeout_s: float = 120.0) -> dict:
+    """Measure service rates against an (assumed idle) fleet.
+
+    Phase 1 — ``n`` SERIAL streamed requests, each seeing an empty
+    system: prefill rate from TTFT, idle decode rate from the
+    post-first-token stream.
+
+    Phase 2 (``concurrency`` > 1) — ``concurrency`` SIMULTANEOUS
+    streams: the service rate with every slot busy, which is the rate
+    that governs behavior exactly when queueing matters. On a
+    shared-core host (the CPU smoke) the loaded rate can be far below
+    the serial one (engine step loop + HTTP threads + the driver all
+    contend for one core); feeding the LOADED rate to the capacity
+    model is what keeps its saturation predictions honest.
+
+    When ``total_slots`` (the fleet's slot count) is given and
+    ``concurrency`` exceeds it, the loaded phase is read as a
+    THROUGHPUT measurement: the batch drains through ``total_slots``
+    servers, so effective per-request service time =
+    ``total_slots × makespan / concurrency`` — this folds EVERY
+    per-request cost the fleet pays under load (HTTP accept, GIL,
+    engine bookkeeping) into the rate, which is exactly the quantity
+    the discrete-event model simulates. Without it, the per-stream
+    decode window is used (in-slot time only — an underestimate of
+    per-request cost on a contended host). The returned
+    ``decode_tokens_per_sec`` is the loaded estimate when measured,
+    the serial rate otherwise (``decode_tokens_per_sec_serial``
+    always carries phase 1)."""
+    from pyspark_tf_gke_tpu.replay.driver import replay_spec
+    from pyspark_tf_gke_tpu.replay.spec import SpecRequest, WorkloadSpec
+
+    spec = WorkloadSpec(
+        name="calibration", seed=1234,
+        requests=[SpecRequest(offset_s=float(i) * 2.0,
+                              prompt_tokens=prompt_tokens,
+                              output_tokens=output_tokens)
+                  for i in range(max(1, int(n)))]).validate()
+    report = replay_spec(spec, base_url, speedup=1.0, stream=True,
+                         include_requests=True, timeout_s=timeout_s)
+    serial = _stream_stats(report)
+    if serial is None:
+        raise RuntimeError(
+            f"calibration got no ok streamed requests: "
+            f"{report['outcomes']}")
+    loaded = None
+    if concurrency > 1:
+        spec2 = WorkloadSpec(
+            name="calibration_loaded", seed=1234,
+            requests=[SpecRequest(offset_s=0.0,
+                                  prompt_tokens=prompt_tokens,
+                                  output_tokens=output_tokens)
+                      for _ in range(int(concurrency))]).validate()
+        # two rounds, keep the second: the first concurrent round can
+        # pay one-time costs (stream-path compiles on a replica the
+        # serial phase never touched) that are not the steady-state
+        # rate the model needs
+        for _ in range(2):
+            loaded = _stream_stats(
+                replay_spec(spec2, base_url, speedup=1.0, stream=True,
+                            include_requests=True,
+                            timeout_s=timeout_s)) or loaded
+    prefill_rate = prompt_tokens / max(serial["ttft_s"], 1e-6)
+    decode_serial = round(serial["decode_rate"], 3)
+    decode = decode_serial
+    if loaded is not None:
+        if total_slots and concurrency > total_slots:
+            # throughput read: batch of C drains through S servers in
+            # makespan M ⇒ service_eff = S·M/C; subtract the (serial)
+            # prefill share, the rest is the effective decode rate
+            service_eff = (total_slots * loaded["makespan_s"]
+                           / concurrency)
+            decode_window = max(service_eff
+                                - prompt_tokens / prefill_rate, 1e-6)
+            decode = round(min(output_tokens / decode_window,
+                               serial["decode_rate"]), 3)
+        else:
+            decode = round(min(loaded["decode_rate"],
+                               serial["decode_rate"]), 3)
+    return {
+        "prefill_tokens_per_sec": round(prefill_rate, 3),
+        "decode_tokens_per_sec": decode,
+        "decode_tokens_per_sec_serial": decode_serial,
+        "calibration": {
+            "n": serial["n"], "concurrency": int(concurrency),
+            "total_slots": total_slots,
+            "ttft_ms": round(serial["ttft_s"] * 1000.0, 3),
+            "latency_ms": round(serial["lat_s"] * 1000.0, 3),
+            "tokens_out_mean": round(serial["toks"], 2),
+            "loaded_n": loaded["n"] if loaded else 0,
+            "loaded_latency_ms": (round(loaded["lat_s"] * 1000.0, 3)
+                                  if loaded else None),
+            "loaded_makespan_ms": (
+                round(loaded["makespan_s"] * 1000.0, 3)
+                if loaded else None),
+        },
+    }
+
+
+def check_agreement(predicted: dict, measured: dict, *,
+                    p99_band: float = 4.0, shed_band_abs: int = 4,
+                    shed_band_rel: float = 0.5) -> dict:
+    """Assert the capacity model's prediction and a measured replay
+    agree within the documented band (docs/REPLAY.md): p99 latency
+    within a multiplicative ``p99_band`` either way, shed counts
+    within ``max(shed_band_abs, shed_band_rel * max(pred, meas))``.
+    The band is deliberately wide — the model predicts queueing shape
+    on a 1-vCPU CPU smoke, not microseconds — and the check exists so
+    a model that drifts ORDER-OF-MAGNITUDE wrong (wrong admission
+    math, wrong routing) fails loudly in CI."""
+    checks = []
+    p_p99 = (predicted.get("latency_ms") or {}).get("p99")
+    # like-with-like: the prediction's latency covers COMPLETED
+    # requests only, so prefer the driver's ok-only summary (a
+    # shed-dominated replay would otherwise pit millisecond 429s
+    # against the model's ok-request drain times)
+    m_p99 = ((measured.get("latency_ok_ms")
+              or measured.get("latency_ms") or {}).get("p99"))
+    if p_p99 is None or m_p99 is None:
+        # nothing completed on one side: agreement is only meaningful
+        # if BOTH sides say so
+        checks.append({"name": "latency_p99_ms", "predicted": p_p99,
+                       "measured": m_p99,
+                       "ok": (p_p99 is None) == (m_p99 is None)})
+    else:
+        lo, hi = p_p99 / p99_band, p_p99 * p99_band
+        checks.append({"name": "latency_p99_ms", "predicted": p_p99,
+                       "measured": m_p99, "band": p99_band,
+                       "ok": lo <= m_p99 <= hi})
+    p_shed = (predicted.get("outcomes") or {}).get("shed", 0)
+    m_shed = (measured.get("outcomes") or {}).get("shed", 0)
+    tol = max(shed_band_abs, shed_band_rel * max(p_shed, m_shed))
+    checks.append({"name": "sheds", "predicted": p_shed,
+                   "measured": m_shed, "tolerance": round(tol, 2),
+                   "ok": abs(p_shed - m_shed) <= tol})
+    return {"ok": all(c["ok"] for c in checks), "checks": checks,
+            "p99_band": p99_band, "shed_band_abs": shed_band_abs,
+            "shed_band_rel": shed_band_rel}
+
+
+def derive_hpa_targets(*, kv_pages: int = 256, page_size: int = 16,
+                       decode_chunk_tokens: int = 64,
+                       decode_tokens_per_sec: float = 128.0) -> dict:
+    """The HPA metric targets in ``infra/k8s/tpu/tpu-serve-hpa.yaml``
+    as DERIVED numbers (``tools/replay.py hpa`` prints this):
+
+    * ``router_demand_tokens_total`` AverageValue = one replica's KV
+      pool extent (``kv_pages * page_size``): demand beyond one pool
+      queues, so ``replicas = ceil(demand / extent)`` keeps queues
+      short — the textbook external-metric ratio.
+    * ``router_queue_delay_ms_p99`` Value = the wall time one decode
+      chunk takes to stream (``decode_chunk_tokens /
+      decode_tokens_per_sec``): a request queued longer than that
+      waits longer than the work in front of it produces — add
+      replicas even when token demand looks flat."""
+    extent = int(kv_pages) * int(page_size)
+    delay_ms = decode_chunk_tokens / decode_tokens_per_sec * 1000.0
+    return {
+        "router_demand_tokens_avg": extent,
+        "router_queue_delay_ms_p99": round(delay_ms, 1),
+        "derivation": {
+            "kv_pages": kv_pages, "page_size": page_size,
+            "pool_token_extent": extent,
+            "decode_chunk_tokens": decode_chunk_tokens,
+            "decode_tokens_per_sec": decode_tokens_per_sec,
+        },
+    }

@@ -1,0 +1,337 @@
+"""Open-loop replay driver: fire a workload spec at a live endpoint.
+
+Open-loop means arrivals follow the SPEC's clock, not the server's:
+a request fires at ``offset_s / speedup`` after the run starts whether
+or not earlier requests have finished — the only load model under
+which overload is observable (a closed loop self-throttles exactly
+when the system saturates, which is the moment you're trying to
+measure; see the open- vs closed-loop distinction the serving
+literature leans on). Each request is its own thread (specs are
+hundreds of requests, not millions); ``sched_lag_ms`` records how far
+behind the driver itself fell so a CPU-starved client can't silently
+masquerade as server latency.
+
+Per-request capture rides the streaming endpoint: TTFT is the gap
+from fire to the first ``data:`` token event, TBT the gaps between
+successive token events — the same client-visible definitions the
+engine's ``serve_tbt_ms`` histogram uses on the other side of the
+wire. Sheds (429/503/504) are OUTCOMES, not errors: the report
+carries the full taxonomy (reason + tenant) so SLO assertions can
+distinguish "the flood tenant was correctly quota-shed" from "the
+light tenant lost goodput".
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+from pyspark_tf_gke_tpu.replay.spec import WorkloadSpec, build_prompt
+from pyspark_tf_gke_tpu.replay.stats import pct as _pct
+from pyspark_tf_gke_tpu.replay.stats import summary as _summary
+
+
+def _classify_error_text(text: str) -> str:
+    return "deadline" if "deadline" in text.lower() else "error"
+
+
+class _RequestResult:
+    __slots__ = ("index", "tenant", "status", "outcome", "reason",
+                 "ttft_ms", "latency_ms", "tokens_out", "deadline_ms",
+                 "sched_lag_ms", "tbt_ms")
+
+    def __init__(self, index: int, tenant: str, deadline_ms):
+        self.index = index
+        self.tenant = tenant
+        self.status = 0
+        self.outcome = "error"
+        self.reason: Optional[str] = None
+        self.ttft_ms: Optional[float] = None
+        self.latency_ms: Optional[float] = None
+        self.tokens_out = 0
+        self.deadline_ms = deadline_ms
+        self.sched_lag_ms = 0.0
+        self.tbt_ms: List[float] = []
+
+    def to_dict(self) -> dict:
+        return {"i": self.index, "tenant": self.tenant,
+                "status": self.status, "outcome": self.outcome,
+                "reason": self.reason,
+                "ttft_ms": (round(self.ttft_ms, 3)
+                            if self.ttft_ms is not None else None),
+                "latency_ms": (round(self.latency_ms, 3)
+                               if self.latency_ms is not None else None),
+                "tokens_out": self.tokens_out,
+                "deadline_ms": self.deadline_ms,
+                "sched_lag_ms": round(self.sched_lag_ms, 3)}
+
+
+def _fire_stream(url: str, prompt: str, res: _RequestResult,
+                 output_tokens: int, timeout_s: float) -> None:
+    """One streaming generate; fills ``res`` in place."""
+    body = {"prompts": [prompt], "max_new_tokens": int(output_tokens),
+            "stream": True}
+    if res.deadline_ms is not None:
+        body["deadline_ms"] = float(res.deadline_ms)
+    req = urllib.request.Request(
+        url + "/v1/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Tenant": res.tenant})
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            res.status = resp.status
+            last_emit = None
+            done_seen = False
+            error_outcome = None
+            for raw in resp:
+                line = raw.decode("utf-8", errors="replace").strip()
+                if not line or line.startswith(":"):
+                    continue  # keep-alives + the trace_id comment
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    done_seen = True
+                    break
+                event = json.loads(payload)
+                now = time.monotonic()
+                if "error" in event:
+                    # mid-stream terminal (deadline expiry, engine
+                    # failure): the 200 is committed, the verdict
+                    # arrives as an event
+                    error_outcome = _classify_error_text(
+                        str(event["error"]))
+                    continue
+                toks = event.get("token_ids")
+                if toks:
+                    if last_emit is None:
+                        res.ttft_ms = (now - t0) * 1000.0
+                    else:
+                        res.tbt_ms.append((now - last_emit) * 1000.0)
+                    last_emit = now
+                    res.tokens_out += len(toks)
+            res.latency_ms = (time.monotonic() - t0) * 1000.0
+            if error_outcome is not None:
+                res.outcome = error_outcome
+                res.reason = error_outcome
+            elif done_seen:
+                res.outcome = "ok"
+            else:
+                # EOF without [DONE]: the replica died mid-stream
+                res.outcome = "error"
+                res.reason = "eof_without_done"
+    except urllib.error.HTTPError as exc:
+        res.status = exc.code
+        res.latency_ms = (time.monotonic() - t0) * 1000.0
+        try:
+            info = json.loads(exc.read() or b"{}")
+        except ValueError:
+            info = {}
+        res.reason = info.get("reason") or (
+            "deadline" if exc.code == 504 else f"http_{exc.code}")
+        res.outcome = ("deadline" if exc.code == 504
+                       else "shed" if exc.code in (429, 503)
+                       else "error")
+    except Exception as exc:  # noqa: BLE001 — transport failure is an
+        #   outcome the report counts, never a driver crash
+        res.latency_ms = (time.monotonic() - t0) * 1000.0
+        res.reason = f"transport:{type(exc).__name__}"
+        res.outcome = "error"
+
+
+def _fire_blocking(url: str, prompt: str, res: _RequestResult,
+                   output_tokens: int, timeout_s: float) -> None:
+    """Non-streaming fallback (whole-batch servers): latency only —
+    TTFT/TBT need the stream."""
+    body = {"prompts": [prompt], "max_new_tokens": int(output_tokens)}
+    if res.deadline_ms is not None:
+        body["deadline_ms"] = float(res.deadline_ms)
+    req = urllib.request.Request(
+        url + "/v1/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Tenant": res.tenant})
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            out = json.loads(resp.read())
+            res.status = resp.status
+            res.latency_ms = (time.monotonic() - t0) * 1000.0
+            comps = out.get("completions") or []
+            res.tokens_out = sum(int(c.get("new_tokens", 0))
+                                 for c in comps)
+            res.outcome = "ok"
+    except urllib.error.HTTPError as exc:
+        res.status = exc.code
+        res.latency_ms = (time.monotonic() - t0) * 1000.0
+        try:
+            info = json.loads(exc.read() or b"{}")
+        except ValueError:
+            info = {}
+        res.reason = info.get("reason") or (
+            "deadline" if exc.code == 504 else f"http_{exc.code}")
+        res.outcome = ("deadline" if exc.code == 504
+                       else "shed" if exc.code in (429, 503)
+                       else "error")
+    except Exception as exc:  # noqa: BLE001
+        res.latency_ms = (time.monotonic() - t0) * 1000.0
+        res.reason = f"transport:{type(exc).__name__}"
+        res.outcome = "error"
+
+
+def replay_spec(spec: WorkloadSpec, base_url: str, *,
+                speedup: float = 1.0, stream: bool = True,
+                timeout_s: float = 120.0,
+                include_requests: bool = False,
+                registry=None) -> dict:
+    """Replay ``spec`` against ``base_url`` and return the measured
+    report (the input :func:`pyspark_tf_gke_tpu.replay.slo.evaluate_slo`
+    and :func:`pyspark_tf_gke_tpu.replay.capacity.check_agreement`
+    consume).
+
+    ``speedup`` compresses the spec's clock (2.0 = twice as fast);
+    deadlines are NOT scaled — they are part of the request contract,
+    not the arrival process. Every request reaches a terminal outcome
+    before this returns. ``registry`` (an obs ``MetricsRegistry``,
+    default the process registry) receives the ``replay_*`` family
+    observations so a long replay is scrapable while it runs."""
+    if speedup <= 0:
+        raise ValueError("speedup must be > 0")
+    from pyspark_tf_gke_tpu.obs.metrics import replay_families
+
+    fams = replay_families(registry)
+    base_url = base_url.rstrip("/")
+    results = [_RequestResult(i, r.tenant, r.deadline_ms)
+               for i, r in enumerate(spec.requests)]
+    prompts = [build_prompt(spec, i) for i in range(len(spec.requests))]
+    fire = _fire_stream if stream else _fire_blocking
+    threads: List[threading.Thread] = []
+    t_start = time.monotonic()
+    for i, r in enumerate(spec.requests):
+        due = t_start + r.offset_s / speedup
+        delay = due - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        res = results[i]
+        res.sched_lag_ms = max(0.0, (time.monotonic() - due) * 1000.0)
+
+        th = threading.Thread(
+            target=fire,
+            args=(base_url, prompts[i], res, r.output_tokens, timeout_s),
+            daemon=True)
+        threads.append(th)
+        th.start()
+    for th in threads:
+        th.join(timeout=timeout_s + 30)
+    for i, th in enumerate(threads):
+        if th.is_alive():
+            # a straggler that outlived its join window (e.g. a
+            # drip-feeding stream that never trips the socket
+            # timeout): REPLACE its record instead of reading the one
+            # its thread still mutates — the report must never
+            # aggregate a result another thread is writing
+            res = _RequestResult(i, spec.requests[i].tenant,
+                                 spec.requests[i].deadline_ms)
+            res.outcome = "error"
+            res.reason = "driver_timeout"
+            res.sched_lag_ms = results[i].sched_lag_ms
+            results[i] = res
+    wall_s = time.monotonic() - t_start
+
+    # -- aggregate --------------------------------------------------------
+    outcomes = {"ok": 0, "shed": 0, "deadline": 0, "error": 0}
+    sheds: dict = {}
+    ttft, tbt, lat, lat_ok, lag = [], [], [], [], []
+    tenants: dict = {}
+    good = 0
+    for res in results:
+        outcomes[res.outcome] = outcomes.get(res.outcome, 0) + 1
+        if res.outcome == "shed" and res.reason:
+            sheds[res.reason] = sheds.get(res.reason, 0) + 1
+        t = tenants.setdefault(
+            res.tenant, {"ok": 0, "shed": 0, "deadline": 0, "error": 0,
+                         "lat_ms": []})
+        t[res.outcome] += 1
+        if res.ttft_ms is not None:
+            ttft.append(res.ttft_ms)
+        tbt.extend(res.tbt_ms)
+        if res.latency_ms is not None:
+            lat.append(res.latency_ms)
+            if res.outcome == "ok":
+                lat_ok.append(res.latency_ms)
+                t["lat_ms"].append(res.latency_ms)
+        lag.append(res.sched_lag_ms)
+        met = (res.outcome == "ok"
+               and (res.deadline_ms is None
+                    or (res.latency_ms is not None
+                        and res.latency_ms <= res.deadline_ms)))
+        if met:
+            good += 1
+        fams["replay_requests_total"].labels(outcome=res.outcome).inc()
+        fams["replay_tenant_requests_total"].labels(
+            tenant=res.tenant, outcome=res.outcome).inc()
+        if res.reason and res.outcome == "shed":
+            fams["replay_sheds_total"].labels(reason=res.reason).inc()
+        if res.ttft_ms is not None:
+            fams["replay_ttft_ms"].observe(res.ttft_ms)
+        for gap in res.tbt_ms:
+            fams["replay_tbt_ms"].observe(gap)
+        if res.latency_ms is not None:
+            fams["replay_request_latency_ms"].observe(res.latency_ms)
+        fams["replay_sched_lag_ms"].observe(res.sched_lag_ms)
+
+    n = len(results)
+    # an EMPTY replay measured nothing: report None so SLO bounds fail
+    # as unmeasurable instead of passing vacuously (slo.py's contract)
+    goodput = round(good / n, 4) if n else None
+    if goodput is not None:
+        fams["replay_goodput"].set(goodput)
+    tenant_out = {}
+    ok_rates = []
+    for name, t in sorted(tenants.items()):
+        total = t["ok"] + t["shed"] + t["deadline"] + t["error"]
+        ok_rate = round(t["ok"] / total, 4) if total else 1.0
+        ok_rates.append(ok_rate)
+        tenant_out[name] = {
+            "requests": total, "ok": t["ok"], "shed": t["shed"],
+            "deadline": t["deadline"], "error": t["error"],
+            "ok_rate": ok_rate,
+            "latency_p99_ms": _pct(t["lat_ms"], 0.99),
+        }
+    report = {
+        "kind": "pyspark_tf_gke_tpu.replay_report",
+        "spec": {"name": spec.name, "seed": spec.seed,
+                 "n_requests": n,
+                 "duration_s": round(spec.duration_s, 3)},
+        "speedup": speedup,
+        "stream": stream,
+        "wall_s": round(wall_s, 3),
+        "achieved_rps": round(n / wall_s, 3) if wall_s else None,
+        "outcomes": outcomes,
+        "sheds": dict(sorted(sheds.items())),
+        "goodput": goodput,
+        "ttft_ms": _summary(ttft),
+        "tbt_ms": _summary(tbt),
+        "latency_ms": _summary(lat),
+        # COMPLETED requests only — the population the capacity
+        # model's latency prediction describes (a fast 429 is not a
+        # latency sample), so check_agreement compares like with like
+        "latency_ok_ms": _summary(lat_ok),
+        "sched_lag_ms": _summary(lag),
+        "tenants": tenant_out,
+        # min/max per-tenant ok-rate ratio: 1.0 = perfectly fair (or a
+        # single tenant; all-shed counts as uniformly bad = fair);
+        # None when nothing replayed — the SLO bound must fail, not
+        # pass vacuously
+        "tenant_ok_rate_ratio": (
+            (round(min(ok_rates) / max(ok_rates), 4)
+             if max(ok_rates) > 0 else 1.0)
+            if ok_rates else None),
+    }
+    if include_requests:
+        report["requests"] = [r.to_dict() for r in results]
+    return report

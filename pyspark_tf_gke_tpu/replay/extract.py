@@ -1,0 +1,174 @@
+"""Build a workload spec from a ``GET /traces`` export.
+
+The flight recorder (PR 9) captures each request's full timeline; the
+engine/front stamp the request-shape attributes this module reads
+(:data:`REQUEST_SHAPE_KEYS` — pinned by test so replay extraction
+can't silently rot when the span vocabulary evolves). A spec
+extracted here carries NO user content: prompt text is re-synthesized
+at replay time from the spec seed, only the shapes survive.
+
+Input accepts all three forms a ``/traces`` endpoint produces:
+
+* the JSON object body (``{"traces": [...]}``) of ``GET /traces``,
+* the line-delimited ``GET /traces?format=jsonl`` export (one trace
+  object per line — streamable, bounded by ``?n=``),
+* a bare JSON array of trace objects (hand-assembled exports).
+
+Sheds and deadline expiries are DEMAND too: a request the server
+refused still arrived, so it extracts into the spec with its full
+requested budget — replaying a trace from an overloaded fleet against
+a bigger one must re-offer the load the small fleet shed.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional, Union
+
+# ONE definition site for the span-attribute contract between the
+# serving plane and replay extraction: obs/trace.py both defines the
+# key set and writes it (annotate_request_shape); the engine test pins
+# it. deadline_ms is optional — absent when the client sent none.
+from pyspark_tf_gke_tpu.obs.trace import (
+    REQUEST_SHAPE_ATTRS as REQUEST_SHAPE_KEYS,
+)
+from pyspark_tf_gke_tpu.replay.spec import SpecRequest, WorkloadSpec
+
+# reserved tenant names that are not client demand (the hot-swap
+# canary admits through submit_internal under this name)
+_INTERNAL_TENANTS = {"__internal__"}
+
+
+def parse_traces(payload: Union[str, bytes, list, dict]) -> List[dict]:
+    """Normalize any ``/traces`` export form into a list of trace
+    dicts."""
+    if isinstance(payload, bytes):
+        payload = payload.decode("utf-8", errors="replace")
+    if isinstance(payload, str):
+        text = payload.strip()
+        if not text:
+            return []
+        parsed = None
+        if text.startswith("{") or text.startswith("["):
+            # try ONE document first: the GET /traces envelope (also
+            # pretty-printed — a `| jq .` round trip must still
+            # parse), a bare array, or a one-trace jsonl export (the
+            # object's own keys decide which, below). A multi-line
+            # jsonl body fails this parse ("extra data") and falls
+            # through to the per-line path.
+            try:
+                parsed = json.loads(text)
+            except ValueError:
+                parsed = None
+        if parsed is not None:
+            payload = parsed
+        else:
+            out = []
+            for ln in text.splitlines():
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    out.append(json.loads(ln))
+                except ValueError:
+                    continue  # torn tail line of a live export
+            return out
+    if isinstance(payload, dict):
+        if "traces" in payload:
+            return list(payload["traces"] or [])
+        return [payload]  # a bare trace object
+    return list(payload or [])
+
+
+def _shape_span(trace: dict) -> Optional[dict]:
+    """The trace's request-shape span: the one carrying
+    ``prompt_tokens`` (the serve handler's span; its name is not the
+    contract — the attrs are, so direct-engine traces extract too)."""
+    for span in trace.get("spans") or []:
+        attrs = span.get("attrs") or {}
+        if all(k in attrs for k in REQUEST_SHAPE_KEYS):
+            return span
+    return None
+
+
+def _terminal_tokens(span: dict) -> Optional[int]:
+    for ev in reversed(span.get("events") or []):
+        if ev.get("name") == "terminal":
+            try:
+                return int(ev.get("new_tokens"))
+            except (TypeError, ValueError):
+                return None
+    return None
+
+
+def _terminal_outcome(span: dict) -> str:
+    for ev in reversed(span.get("events") or []):
+        if ev.get("name") == "terminal":
+            return str(ev.get("outcome", "ok"))
+        if ev.get("name") == "shed":
+            return "shed"
+    return "unknown"
+
+
+def spec_from_traces(traces: Iterable[dict], *, name: str = "extracted",
+                     seed: int = 0,
+                     keep_internal: bool = False) -> WorkloadSpec:
+    """Convert trace dicts into a replayable spec.
+
+    Arrival offsets are each shape span's wall-clock start relative to
+    the earliest one. ``output_tokens`` is the ACTUAL completion
+    length for ok requests (an early eos replays as the shorter
+    request it was) and the full requested budget for sheds/expiries
+    (refused demand is still demand). Prefix structure: a request
+    whose admission recorded ``prefix_hit_tokens > 0`` keeps that
+    count as ``prefix_tokens`` under one shared group per extract —
+    the exact inter-request grouping is not recoverable from shapes
+    alone (the recorder never stores prompt content), so extraction
+    preserves the cache-relevant VOLUME of sharing, not the cluster
+    topology; REPLAY.md documents the approximation."""
+    rows = []
+    observed = {"ok": 0, "deadline": 0, "shed": 0, "unknown": 0}
+    for trace in traces:
+        span = _shape_span(trace)
+        if span is None:
+            continue
+        attrs = span["attrs"]
+        tenant = str(attrs["tenant"])
+        if tenant in _INTERNAL_TENANTS and not keep_internal:
+            continue
+        prompt_tokens = int(attrs["prompt_tokens"])
+        budget = int(attrs["max_new_tokens"])
+        outcome = _terminal_outcome(span)
+        observed[outcome] = observed.get(outcome, 0) + 1
+        actual = _terminal_tokens(span)
+        output_tokens = (actual if outcome == "ok" and actual
+                         else budget)
+        hit = 0
+        for ev in span.get("events") or []:
+            if ev.get("name") == "admission":
+                try:
+                    hit = int(ev.get("prefix_hit_tokens") or 0)
+                except (TypeError, ValueError):
+                    hit = 0
+        row = SpecRequest(
+            offset_s=float(span.get("start", 0.0)),  # rebased below
+            tenant=tenant,
+            prompt_tokens=prompt_tokens,
+            output_tokens=max(1, output_tokens),
+            deadline_ms=(float(attrs["deadline_ms"])
+                         if attrs.get("deadline_ms") is not None
+                         else None))
+        if 0 < hit < prompt_tokens:
+            row.prefix_group = "observed"
+            row.prefix_tokens = hit
+        rows.append(row)
+    if rows:
+        t0 = min(r.offset_s for r in rows)
+        for r in rows:
+            r.offset_s = max(0.0, r.offset_s - t0)
+    spec = WorkloadSpec(
+        name=name, seed=seed,
+        meta={"source": "traces", "observed_outcomes": observed},
+        requests=rows)
+    spec.requests.sort(key=lambda r: r.offset_s)
+    return spec.validate()

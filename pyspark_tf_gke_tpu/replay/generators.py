@@ -1,0 +1,243 @@
+"""Synthetic workload generators: the scenario library.
+
+Each generator is a pure function ``(rng, params) -> [SpecRequest]``
+registered in :data:`GENERATORS`; :func:`synth_spec` seeds a private
+``random.Random`` so the same ``(kind, seed, params)`` triple always
+produces an identical spec (pinned by test). Arrival processes are
+non-homogeneous Poisson, sampled by thinning against the scenario's
+rate envelope — the open-loop burstiness real traffic has and a
+uniform-interval generator would hide.
+
+The scenarios:
+
+* ``steady`` — constant-rate Poisson, uniform shape mix (the control).
+* ``diurnal`` — a sinusoidal day compressed into ``duration_s``: rate
+  swings between ``rate_rps * (1 ± amplitude)``; the autoscaler's
+  bread-and-butter input.
+* ``flash_crowd`` — steady base rate, then a burst window at
+  ``burst_mult`` times the base starting at ``burst_at`` (fraction of
+  the duration) — the overload scenario the capacity model's shed
+  prediction is checked against.
+* ``tenant_flood`` — a well-behaved ``light`` tenant at the base rate
+  plus an adversarial ``flood`` tenant ramping to ``flood_mult`` times
+  the base in the middle third; the DWRR/quota isolation scenario.
+* ``longtail`` — log-normal prompt lengths (many short, a heavy tail
+  of near-context-limit prompts) at a steady rate; the chunked-prefill
+  interference scenario.
+* ``shared_prefix`` — ``n_groups`` prefix clusters (Zipf-weighted
+  popularity) sharing ``prefix_tokens`` leading tokens; the radix
+  cache / router-affinity scenario.
+
+Every generator respects ``max_seq_len``: prompt + output never
+exceeds it, so a spec synthesized for the tiny CPU bundle (64) or a
+production config (8k) is valid by construction.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Callable, Dict, List, Optional
+
+from pyspark_tf_gke_tpu.replay.spec import SpecRequest, WorkloadSpec
+
+
+def _poisson_arrivals(rng: random.Random, duration_s: float,
+                      rate_fn: Callable[[float], float],
+                      rate_max: float) -> List[float]:
+    """Non-homogeneous Poisson by thinning: candidate arrivals at
+    ``rate_max``, kept with probability ``rate_fn(t)/rate_max``."""
+    out, t = [], 0.0
+    if rate_max <= 0:
+        return out
+    while True:
+        t += rng.expovariate(rate_max)
+        if t >= duration_s:
+            return out
+        if rng.random() < rate_fn(t) / rate_max:
+            out.append(t)
+
+
+def _clamp_shape(prompt: int, output: int, max_seq_len: int):
+    prompt = max(1, min(prompt, max_seq_len - 1))
+    output = max(1, min(output, max_seq_len - prompt))
+    return prompt, output
+
+
+def _sample_prompt(rng: random.Random, lo: int, hi: int) -> int:
+    return rng.randint(min(lo, hi), max(lo, hi))
+
+
+def _gen_steady(rng, *, duration_s, rate_rps, prompt_tokens,
+                output_tokens, max_seq_len, deadline_ms, **_):
+    reqs = []
+    for t in _poisson_arrivals(rng, duration_s, lambda _t: rate_rps,
+                               rate_rps):
+        p = _sample_prompt(rng, prompt_tokens // 2, prompt_tokens)
+        p, o = _clamp_shape(p, output_tokens, max_seq_len)
+        reqs.append(SpecRequest(offset_s=t, prompt_tokens=p,
+                                output_tokens=o, deadline_ms=deadline_ms))
+    return reqs
+
+
+def _gen_diurnal(rng, *, duration_s, rate_rps, prompt_tokens,
+                 output_tokens, max_seq_len, deadline_ms,
+                 amplitude=0.8, **_):
+    def rate(t):
+        # trough at t=0, peak at duration/2 — one compressed "day"
+        return rate_rps * (1.0 + amplitude * math.sin(
+            2.0 * math.pi * t / duration_s - math.pi / 2.0))
+
+    reqs = []
+    for t in _poisson_arrivals(rng, duration_s, rate,
+                               rate_rps * (1.0 + amplitude)):
+        p = _sample_prompt(rng, prompt_tokens // 2, prompt_tokens)
+        p, o = _clamp_shape(p, output_tokens, max_seq_len)
+        reqs.append(SpecRequest(offset_s=t, prompt_tokens=p,
+                                output_tokens=o, deadline_ms=deadline_ms))
+    return reqs
+
+
+def _gen_flash_crowd(rng, *, duration_s, rate_rps, prompt_tokens,
+                     output_tokens, max_seq_len, deadline_ms,
+                     burst_mult=8.0, burst_at=0.4, burst_frac=0.25, **_):
+    t0 = burst_at * duration_s
+    t1 = t0 + burst_frac * duration_s
+
+    def rate(t):
+        return rate_rps * (burst_mult if t0 <= t < t1 else 1.0)
+
+    reqs = []
+    for t in _poisson_arrivals(rng, duration_s, rate,
+                               rate_rps * burst_mult):
+        p = _sample_prompt(rng, prompt_tokens // 2, prompt_tokens)
+        p, o = _clamp_shape(p, output_tokens, max_seq_len)
+        reqs.append(SpecRequest(offset_s=t, prompt_tokens=p,
+                                output_tokens=o, deadline_ms=deadline_ms))
+    return reqs
+
+
+def _gen_tenant_flood(rng, *, duration_s, rate_rps, prompt_tokens,
+                      output_tokens, max_seq_len, deadline_ms,
+                      flood_mult=6.0, **_):
+    reqs = []
+    for t in _poisson_arrivals(rng, duration_s, lambda _t: rate_rps,
+                               rate_rps):
+        p = _sample_prompt(rng, prompt_tokens // 2, prompt_tokens)
+        p, o = _clamp_shape(p, output_tokens, max_seq_len)
+        reqs.append(SpecRequest(offset_s=t, tenant="light",
+                                prompt_tokens=p, output_tokens=o,
+                                deadline_ms=deadline_ms))
+    lo, hi = duration_s / 3.0, 2.0 * duration_s / 3.0
+
+    def flood_rate(t):
+        return rate_rps * flood_mult if lo <= t < hi else 0.0
+
+    for t in _poisson_arrivals(rng, duration_s, flood_rate,
+                               rate_rps * flood_mult):
+        # the adversary sends BIG requests (max budget), not just many
+        p, o = _clamp_shape(prompt_tokens, output_tokens * 2, max_seq_len)
+        reqs.append(SpecRequest(offset_s=t, tenant="flood",
+                                prompt_tokens=p, output_tokens=o,
+                                deadline_ms=deadline_ms))
+    return reqs
+
+
+def _gen_longtail(rng, *, duration_s, rate_rps, prompt_tokens,
+                  output_tokens, max_seq_len, deadline_ms,
+                  sigma=1.0, **_):
+    reqs = []
+    for t in _poisson_arrivals(rng, duration_s, lambda _t: rate_rps,
+                               rate_rps):
+        # log-normal around the median prompt length; the tail reaches
+        # the context limit (clamped) — the mix chunked prefill exists
+        # to keep from stalling everyone else's decode
+        p = int(round(prompt_tokens * math.exp(rng.gauss(0.0, sigma))))
+        p, o = _clamp_shape(p, output_tokens, max_seq_len)
+        reqs.append(SpecRequest(offset_s=t, prompt_tokens=p,
+                                output_tokens=o, deadline_ms=deadline_ms))
+    return reqs
+
+
+def _gen_shared_prefix(rng, *, duration_s, rate_rps, prompt_tokens,
+                       output_tokens, max_seq_len, deadline_ms,
+                       n_groups=4, prefix_frac=0.75, **_):
+    # Zipf-ish group popularity: group i drawn ∝ 1/(i+1)
+    weights = [1.0 / (i + 1) for i in range(n_groups)]
+    total = sum(weights)
+    reqs = []
+    for t in _poisson_arrivals(rng, duration_s, lambda _t: rate_rps,
+                               rate_rps):
+        x, acc, gi = rng.random() * total, 0.0, 0
+        for i, w in enumerate(weights):
+            acc += w
+            if x < acc:
+                gi = i
+                break
+        p, o = _clamp_shape(prompt_tokens, output_tokens, max_seq_len)
+        if p < 2:
+            # a 1-token prompt has no room for a shared prefix PLUS
+            # the required unique suffix — emit it ungrouped instead
+            # of fabricating an invalid prefix_tokens
+            reqs.append(SpecRequest(offset_s=t, prompt_tokens=p,
+                                    output_tokens=o,
+                                    deadline_ms=deadline_ms))
+            continue
+        prefix = max(1, min(int(p * prefix_frac), p - 1))
+        reqs.append(SpecRequest(offset_s=t, prompt_tokens=p,
+                                output_tokens=o,
+                                prefix_group=f"g{gi}",
+                                prefix_tokens=prefix,
+                                deadline_ms=deadline_ms))
+    return reqs
+
+
+GENERATORS: Dict[str, Callable] = {
+    "steady": _gen_steady,
+    "diurnal": _gen_diurnal,
+    "flash_crowd": _gen_flash_crowd,
+    "tenant_flood": _gen_tenant_flood,
+    "longtail": _gen_longtail,
+    "shared_prefix": _gen_shared_prefix,
+}
+
+
+def synth_spec(kind: str, *, seed: int = 0, duration_s: float = 30.0,
+               rate_rps: float = 2.0, prompt_tokens: int = 24,
+               output_tokens: int = 8, max_seq_len: int = 64,
+               deadline_ms: Optional[float] = None,
+               name: Optional[str] = None, **kind_params) -> WorkloadSpec:
+    """Generate a deterministic synthetic scenario spec.
+
+    ``prompt_tokens`` is the scenario's NOMINAL prompt length (each
+    generator spreads around it its own way); ``max_seq_len`` bounds
+    prompt+output so the spec is valid for the target bundle. Unknown
+    ``kind`` raises with the available names."""
+    gen = GENERATORS.get(kind)
+    if gen is None:
+        raise ValueError(
+            f"unknown generator {kind!r}; available: "
+            f"{', '.join(sorted(GENERATORS))}")
+    if duration_s <= 0 or rate_rps <= 0:
+        raise ValueError("duration_s and rate_rps must be > 0")
+    if prompt_tokens + output_tokens > max_seq_len:
+        raise ValueError(
+            f"nominal prompt {prompt_tokens} + output {output_tokens} "
+            f"exceeds max_seq_len {max_seq_len}")
+    rng = random.Random(f"{kind}:{seed}")
+    reqs = gen(rng, duration_s=float(duration_s),
+               rate_rps=float(rate_rps), prompt_tokens=int(prompt_tokens),
+               output_tokens=int(output_tokens),
+               max_seq_len=int(max_seq_len), deadline_ms=deadline_ms,
+               **kind_params)
+    spec = WorkloadSpec(
+        name=name or kind, seed=seed,
+        meta={"generator": kind, "duration_s": float(duration_s),
+              "rate_rps": float(rate_rps),
+              "prompt_tokens": int(prompt_tokens),
+              "output_tokens": int(output_tokens),
+              "max_seq_len": int(max_seq_len),
+              **{k: v for k, v in kind_params.items()}},
+        requests=reqs)
+    spec.requests.sort(key=lambda r: r.offset_s)
+    return spec.validate()

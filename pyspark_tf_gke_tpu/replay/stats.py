@@ -1,0 +1,27 @@
+"""Shared latency statistics for the replay plane.
+
+ONE implementation of the nearest-rank percentile + summary shape —
+the driver's measured report and the capacity model's prediction are
+COMPARED against each other (``check_agreement``), so their
+percentile math must be identical by construction, not by parallel
+maintenance.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def pct(xs: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (None when empty)."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(q * (len(xs) - 1)))], 3)
+
+
+def summary(xs: List[float]) -> dict:
+    """The ``{n, p50, p99, mean, max}`` block every report carries."""
+    return {"n": len(xs), "p50": pct(xs, 0.50), "p99": pct(xs, 0.99),
+            "mean": round(sum(xs) / len(xs), 3) if xs else None,
+            "max": round(max(xs), 3) if xs else None}

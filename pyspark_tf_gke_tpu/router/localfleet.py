@@ -244,3 +244,121 @@ def percentile(xs, q: float) -> float:
         return 0.0
     xs = sorted(xs)
     return xs[min(len(xs) - 1, int(q * (len(xs) - 1)))]
+
+
+class LocalFleet:
+    """Context manager owning one complete local fleet: a tiny bundle
+    export, N CPU replica subprocesses and (optionally) the real
+    router CLI in front — the setup every fleet-level check repeats
+    (``bench.py replay``, ``smoke_check --replay``, ``tools/replay.py
+    run --localfleet``). Exit kills every process and removes the
+    temp dir; a partially-failed boot cleans up the same way."""
+
+    def __init__(self, n_replicas: int = 2, *, router: bool = True,
+                 replica_args: Sequence[str] = (),
+                 router_args: Sequence[str] = (),
+                 bundle: Optional[str] = None,
+                 boot_timeout_s: float = 600.0, quiet: bool = True):
+        self.n_replicas = int(n_replicas)
+        self.with_router = router
+        self.replica_args = tuple(replica_args)
+        self.router_args = tuple(router_args)
+        self.bundle = bundle  # pre-exported dir to reuse (callers
+        #   booting several fleets pay the export once)
+        self.boot_timeout_s = float(boot_timeout_s)
+        self.quiet = quiet
+        self.procs: list = []
+        self.router_proc: Optional[subprocess.Popen] = None
+        self.replica_ports: list = []
+        self.router_port: Optional[int] = None
+        self._tmp: Optional[str] = None
+
+    @property
+    def url(self) -> str:
+        """The fleet's front door (router when present, else the
+        first replica)."""
+        port = (self.router_port if self.with_router
+                else self.replica_ports[0])
+        return f"http://127.0.0.1:{port}"
+
+    @property
+    def replica_urls(self) -> list:
+        return [f"http://127.0.0.1:{p}" for p in self.replica_ports]
+
+    def warm(self, prompts: Sequence[str] = ("warm a", "warm b"),
+             max_new_tokens: int = 4) -> None:
+        """Hit each replica DIRECTLY (routed warms can all land on one
+        replica via affinity), so first-request JIT compiles never
+        land inside a caller's timed run."""
+        for rurl in self.replica_urls:
+            for prompt in prompts:
+                post_generate(rurl, prompt,
+                              max_new_tokens=max_new_tokens)
+
+    def wait_idle(self, timeout_s: float = 60.0) -> bool:
+        """Poll every replica's ``/loadz`` until the whole fleet
+        reports an empty engine (``queued == 0 and active == 0``) or
+        the timeout passes; returns whether it quiesced. A replica
+        still grinding a previous scenario's backlog steals the
+        shared core from whatever the caller measures next, so
+        fleet-level checks quiesce between phases. Transient poll
+        errors count as busy (a saturated replica answering late is
+        exactly the not-idle case)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            idle = True
+            for rurl in self.replica_urls:
+                try:
+                    with urllib.request.urlopen(rurl + "/loadz",
+                                                timeout=5) as resp:
+                        lz = json.loads(resp.read())
+                    if lz["queued"] or lz["active"]:
+                        idle = False
+                except Exception:  # noqa: BLE001 — late answer = busy
+                    idle = False
+            if idle:
+                return True
+            time.sleep(0.3)
+        return False
+
+    def __enter__(self) -> "LocalFleet":
+        import tempfile
+
+        self._tmp = tempfile.mkdtemp(prefix="localfleet-")
+        try:
+            bundle = self.bundle or export_tiny_bundle(
+                os.path.join(self._tmp, "bundle"),
+                timeout_s=self.boot_timeout_s)
+            self.replica_ports = [free_port()
+                                  for _ in range(self.n_replicas)]
+            self.procs = [launch_replica(bundle, p,
+                                         extra_args=self.replica_args,
+                                         quiet=self.quiet)
+                          for p in self.replica_ports]
+            deadline = time.time() + self.boot_timeout_s
+            if self.with_router:
+                self.router_port = free_port()
+                self.router_proc = launch_router(
+                    self.replica_ports, self.router_port,
+                    extra_args=self.router_args, quiet=self.quiet)
+            for p, proc in zip(self.replica_ports, self.procs):
+                wait_healthy(f"http://127.0.0.1:{p}", deadline, proc)
+            if self.router_proc is not None:
+                wait_healthy(self.url, deadline, self.router_proc)
+        except BaseException:
+            self.__exit__(None, None, None)
+            raise
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        import shutil
+
+        for p in [self.router_proc, *self.procs]:
+            if p is not None and p.poll() is None:
+                p.kill()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+        if self._tmp:
+            shutil.rmtree(self._tmp, ignore_errors=True)

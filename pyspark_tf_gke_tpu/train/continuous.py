@@ -57,6 +57,7 @@ import numpy as np
 
 from pyspark_tf_gke_tpu.models.causal_lm import CausalLM
 from pyspark_tf_gke_tpu.obs.metrics import platform_families
+from pyspark_tf_gke_tpu.obs.trace import annotate_request_shape
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
 logger = get_logger("train.continuous")
@@ -1561,6 +1562,13 @@ class ContinuousEngine:
         elif not self._fair_active and tenant != self._first_tenant:
             self._fair_active = True  # two distinct tenants seen: the
             #   DWRR picker (and its queue scan) engages from here on
+        # request SHAPE onto the trace (the replay-extraction
+        # contract; idempotent with the serve front's earlier stamp —
+        # direct engine callers get it from here)
+        annotate_request_shape(span, tenant=tenant,
+                               prompt_tokens=int(prompt.size),
+                               max_new_tokens=max_new_tokens,
+                               deadline_s=deadline_s)
         req = _Request(next(self._rid), prompt, max_new_tokens,
                        on_tokens=on_tokens, temperature=float(temperature),
                        top_p=top_p, seed=int(seed), tenant=tenant,
@@ -2415,6 +2423,13 @@ class ContinuousEngine:
         for req in expired:
             req.expired = True
             req.done = True
+            if req.span is not None:
+                # terminal verdict on the request's OWN span — emitted
+                # HERE (the state transition) so direct engine callers
+                # and the serve front read one consistent timeline
+                req.span.event("terminal", rid=req.rid,
+                               outcome="deadline",
+                               new_tokens=len(req.tokens))
         if expired:
             self._n_deadline_expired += len(expired)
             self._obs["serve_request_deadline_exceeded_total"].inc(
@@ -2665,6 +2680,13 @@ class ContinuousEngine:
             if eos_done or len(req.tokens) >= req.max_new_tokens:
                 req.done = True
                 newly_done.append(req)
+                if req.span is not None:
+                    # the span's LAST engine event: completion with the
+                    # actual emitted-token count (replay extraction's
+                    # output_tokens source)
+                    req.span.event("terminal", rid=req.rid,
+                                   outcome="ok",
+                                   new_tokens=len(req.tokens))
                 if self._slots.get(slot) is req:
                     del self._slots[slot]
                 if self.radix is not None:

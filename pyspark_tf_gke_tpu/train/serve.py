@@ -56,7 +56,11 @@ from pyspark_tf_gke_tpu.obs.events import get_event_log
 from pyspark_tf_gke_tpu.obs.export import handle_obs_request
 from pyspark_tf_gke_tpu.obs.metrics import get_registry, platform_families
 from pyspark_tf_gke_tpu.obs.runtime import install_runtime_metrics
-from pyspark_tf_gke_tpu.obs.trace import TraceRecorder, use_span
+from pyspark_tf_gke_tpu.obs.trace import (
+    TraceRecorder,
+    annotate_request_shape,
+    use_span,
+)
 from pyspark_tf_gke_tpu.parallel.distributed import as_host_array
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
@@ -569,6 +573,12 @@ class _ContinuousFront:
         request's trace span (obs/trace.py) — the engine annotates its
         queue/admission/prefill/token timeline onto it."""
         tenant = self.resolve_tenant(tenant)
+        # shape BEFORE the admission gates: a shed request is demand
+        # the replay/capacity plane must still see on its trace
+        annotate_request_shape(span, tenant=tenant,
+                               prompt_tokens=len(prompt_ids),
+                               max_new_tokens=max_new_tokens,
+                               deadline_s=deadline_s)
         done = threading.Event()
         with self.lock:
             self._check_admission(len(prompt_ids), max_new_tokens,
@@ -678,6 +688,10 @@ class _ContinuousFront:
         import queue as _queue
 
         tenant = self.resolve_tenant(tenant)
+        annotate_request_shape(span, tenant=tenant,
+                               prompt_tokens=len(prompt_ids),
+                               max_new_tokens=max_new_tokens,
+                               deadline_s=deadline_s)
         q = _queue.Queue()
         done = threading.Event()
         with self.lock:
@@ -708,14 +722,10 @@ class _ContinuousFront:
         and the hot-swap drain both run it)."""
         for req in finished:
             self._settle(req)
-            if req.span is not None:
-                # terminal outcome on the request's OWN span — the last
-                # engine-side event of the timeline (the HTTP layer
-                # still stamps the status code it maps this to)
-                req.span.event(
-                    "terminal", rid=req.rid,
-                    outcome="deadline" if req.expired else "ok",
-                    new_tokens=len(req.tokens))
+            # (the terminal span event is emitted by the ENGINE at the
+            # state transition itself — one emitter for served and
+            # direct callers alike; the HTTP layer still stamps the
+            # status code it maps the outcome to)
             slot = self._results.get(req.rid)
             if slot is None:
                 continue

@@ -1,0 +1,707 @@
+"""Trace-driven workload replay + capacity planning
+(pyspark_tf_gke_tpu/replay/).
+
+Coverage map:
+
+* spec: JSONL round trip, version/validation gates, shape histogram.
+* generators: seed determinism (pinned), per-scenario shape
+  properties (burst window, tenant mix, long tail, prefix groups).
+* prompts: deterministic synthesis, exact token lengths, group
+  prefix sharing.
+* driver: open-loop replay against a scriptable stub SSE server —
+  every request terminal, TTFT/TBT captured, shed/deadline taxonomy.
+* SLO: declarative bounds pass/fail, unknown-key rejection,
+  unmeasurable-input fails (never passes vacuously).
+* extraction: traces → spec → same shape histogram (the round-trip
+  oracle), built through the REAL TraceRecorder + the same
+  annotate_request_shape the serving plane calls.
+* capacity model: closed-form zero-load/saturation/deadline cases,
+  agreement bands, derived HPA targets.
+* the span-attribute contract pinned against a REAL engine.
+
+Everything except the engine-contract test is jax-free and fast.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from pyspark_tf_gke_tpu.obs.export import handle_obs_request
+from pyspark_tf_gke_tpu.obs.metrics import MetricsRegistry
+from pyspark_tf_gke_tpu.obs.trace import (
+    REQUEST_SHAPE_ATTRS,
+    TraceRecorder,
+    annotate_request_shape,
+)
+from pyspark_tf_gke_tpu.replay.capacity import (
+    FleetModel,
+    check_agreement,
+    derive_hpa_targets,
+    predict,
+)
+from pyspark_tf_gke_tpu.replay.driver import replay_spec
+from pyspark_tf_gke_tpu.replay.extract import (
+    parse_traces,
+    spec_from_traces,
+)
+from pyspark_tf_gke_tpu.replay.generators import GENERATORS, synth_spec
+from pyspark_tf_gke_tpu.replay.slo import evaluate_slo
+from pyspark_tf_gke_tpu.replay.spec import (
+    SpecRequest,
+    WorkloadSpec,
+    build_prompt,
+)
+
+# -- spec ---------------------------------------------------------------------
+
+
+def test_spec_save_load_round_trip(tmp_path):
+    spec = synth_spec("tenant_flood", seed=9, duration_s=6.0,
+                      rate_rps=2.0, max_seq_len=64, deadline_ms=500.0)
+    path = str(tmp_path / "spec.jsonl")
+    spec.save(path)
+    loaded = WorkloadSpec.load(path)
+    assert loaded.name == spec.name and loaded.seed == spec.seed
+    assert [r.to_dict() for r in loaded.requests] == \
+        [r.to_dict() for r in spec.requests]
+    assert loaded.shape_histogram() == spec.shape_histogram()
+    assert loaded.meta["generator"] == "tenant_flood"
+
+
+def test_spec_rejects_wrong_version_and_kind(tmp_path):
+    path = str(tmp_path / "bad.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"kind": "something_else", "version": 1})
+                 + "\n")
+    with pytest.raises(ValueError, match="not a workload spec"):
+        WorkloadSpec.load(path)
+    with open(path, "w") as fh:
+        fh.write(json.dumps(
+            {"kind": "pyspark_tf_gke_tpu.workload_spec",
+             "version": 99}) + "\n")
+    with pytest.raises(ValueError, match="version"):
+        WorkloadSpec.load(path)
+
+
+def test_spec_validation_gates():
+    with pytest.raises(ValueError, match="prompt_tokens"):
+        WorkloadSpec("x", [SpecRequest(0.0, prompt_tokens=0)]).validate()
+    with pytest.raises(ValueError, match="offsets"):
+        WorkloadSpec("x", [SpecRequest(2.0), SpecRequest(1.0)]).validate()
+    with pytest.raises(ValueError, match="prefix_tokens"):
+        WorkloadSpec("x", [SpecRequest(
+            0.0, prompt_tokens=8, prefix_group="g",
+            prefix_tokens=8)]).validate()
+    with pytest.raises(ValueError, match="deadline_ms"):
+        WorkloadSpec("x", [SpecRequest(0.0, deadline_ms=0.0)]).validate()
+
+
+# -- generators ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", sorted(GENERATORS))
+def test_generator_deterministic_under_seed(kind):
+    a = synth_spec(kind, seed=42, duration_s=8.0, rate_rps=2.0,
+                   max_seq_len=64)
+    b = synth_spec(kind, seed=42, duration_s=8.0, rate_rps=2.0,
+                   max_seq_len=64)
+    c = synth_spec(kind, seed=43, duration_s=8.0, rate_rps=2.0,
+                   max_seq_len=64)
+    assert [r.to_dict() for r in a.requests] == \
+        [r.to_dict() for r in b.requests]
+    assert a.requests, f"{kind} generated an empty spec at rate 2"
+    assert [r.to_dict() for r in a.requests] != \
+        [r.to_dict() for r in c.requests]
+    # every shape fits the context budget by construction
+    for r in a.requests:
+        assert r.prompt_tokens + r.output_tokens <= 64
+
+
+def test_flash_crowd_has_a_burst_window():
+    spec = synth_spec("flash_crowd", seed=1, duration_s=20.0,
+                      rate_rps=1.0, max_seq_len=64, burst_mult=10.0,
+                      burst_at=0.4, burst_frac=0.25)
+    t0, t1 = 0.4 * 20.0, (0.4 + 0.25) * 20.0
+    burst = [r for r in spec.requests if t0 <= r.offset_s < t1]
+    rest = [r for r in spec.requests if r.offset_s < t0
+            or r.offset_s >= t1]
+    burst_rate = len(burst) / (t1 - t0)
+    rest_rate = len(rest) / (20.0 - (t1 - t0))
+    assert burst_rate > 3 * max(rest_rate, 0.1)
+
+
+def test_tenant_flood_floods_the_middle_third():
+    spec = synth_spec("tenant_flood", seed=2, duration_s=12.0,
+                      rate_rps=1.5, max_seq_len=64, flood_mult=6.0)
+    assert set(spec.tenants) == {"flood", "light"}
+    flood = [r for r in spec.requests if r.tenant == "flood"]
+    assert flood
+    assert all(4.0 <= r.offset_s < 8.0 for r in flood)
+
+
+def test_longtail_prompt_mix_has_a_tail():
+    spec = synth_spec("longtail", seed=3, duration_s=40.0, rate_rps=3.0,
+                      prompt_tokens=16, max_seq_len=512, sigma=1.2)
+    lengths = sorted(r.prompt_tokens for r in spec.requests)
+    p50 = lengths[len(lengths) // 2]
+    assert lengths[-1] >= 4 * p50  # heavy tail reaches far past median
+
+
+def test_shared_prefix_groups_share_real_prefixes():
+    spec = synth_spec("shared_prefix", seed=4, duration_s=10.0,
+                      rate_rps=3.0, max_seq_len=64, n_groups=3)
+    groups = {}
+    for i, r in enumerate(spec.requests):
+        assert r.prefix_group is not None
+        assert 0 < r.prefix_tokens < r.prompt_tokens
+        prompt = build_prompt(spec, i)
+        assert len(prompt) == r.prompt_tokens
+        groups.setdefault(r.prefix_group, set()).add(
+            prompt[:r.prefix_tokens])
+    assert len(groups) > 1
+    for heads in groups.values():
+        assert len(heads) == 1  # one shared head per group
+    # distinct groups have distinct heads
+    all_heads = [next(iter(h)) for h in groups.values()]
+    assert len(set(all_heads)) == len(all_heads)
+
+
+def test_shared_prefix_one_token_prompts_emit_ungrouped():
+    # a 1-token prompt has no room for prefix + unique suffix: the
+    # generator must emit it ungrouped, not crash validation
+    spec = synth_spec("shared_prefix", seed=4, duration_s=5.0,
+                      rate_rps=3.0, prompt_tokens=1, output_tokens=8,
+                      max_seq_len=64)
+    assert spec.requests
+    assert all(r.prefix_group is None for r in spec.requests)
+
+
+def test_unknown_generator_rejected():
+    with pytest.raises(ValueError, match="unknown generator"):
+        synth_spec("nope", seed=0)
+
+
+def test_build_prompt_stable_across_calls():
+    spec = synth_spec("steady", seed=5, duration_s=5.0, rate_rps=2.0,
+                      max_seq_len=64)
+    assert [build_prompt(spec, i) for i in range(len(spec.requests))] \
+        == [build_prompt(spec, i) for i in range(len(spec.requests))]
+
+
+# -- driver vs a scriptable stub SSE server -----------------------------------
+
+
+class StubServer:
+    """Stdlib SSE stub: tenant 'shedme' -> 429 tenant_quota, tenant
+    'late' -> 504, everything else streams max_new_tokens token
+    events then [DONE]."""
+
+    def __init__(self, token_delay_s=0.002):
+        from http.server import (
+            BaseHTTPRequestHandler,
+            ThreadingHTTPServer,
+        )
+
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                tenant = self.headers.get("X-Tenant") or "default"
+                stub.seen.append((tenant, req))
+                if tenant == "shedme":
+                    body = json.dumps(
+                        {"error": "shed", "reason": "tenant_quota",
+                         "tenant": tenant}).encode()
+                    self.send_response(429)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header("Retry-After", "1")
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if tenant == "late":
+                    body = json.dumps(
+                        {"error": "deadline exceeded"}).encode()
+                    self.send_response(504)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                toks = int(req.get("max_new_tokens", 4))
+                self.close_connection = True
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(b": trace_id=deadbeef\n\n")
+                for i in range(toks):
+                    time.sleep(stub.token_delay_s)
+                    self.wfile.write(
+                        f"data: {json.dumps({'token_ids': [i]})}"
+                        "\n\n".encode())
+                    self.wfile.flush()
+                self.wfile.write(
+                    f"data: {json.dumps({'done': True})}\n\n".encode())
+                self.wfile.write(b"data: [DONE]\n\n")
+
+        self.token_delay_s = token_delay_s
+        self.seen = []
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def stub():
+    s = StubServer()
+    yield s
+    s.stop()
+
+
+def test_replay_all_terminal_with_ttft_tbt(stub):
+    spec = synth_spec("steady", seed=6, duration_s=3.0, rate_rps=4.0,
+                      output_tokens=6, max_seq_len=64)
+    report = replay_spec(spec, stub.url, speedup=4.0,
+                         registry=MetricsRegistry())
+    n = len(spec.requests)
+    assert sum(report["outcomes"].values()) == n
+    assert report["outcomes"]["ok"] == n
+    assert report["ttft_ms"]["n"] == n and report["ttft_ms"]["p99"] > 0
+    assert report["tbt_ms"]["n"] == n * 5  # 6 tokens -> 5 gaps each
+    assert report["goodput"] == 1.0
+    assert report["achieved_rps"] > 0
+    # open-loop health: the driver kept up with a tiny spec
+    assert report["sched_lag_ms"]["p99"] < 1000
+
+
+def test_replay_shed_and_deadline_taxonomy(stub):
+    spec = WorkloadSpec("taxonomy", seed=1, requests=[
+        SpecRequest(0.0, tenant="ok_t", output_tokens=3),
+        SpecRequest(0.01, tenant="shedme", output_tokens=3),
+        SpecRequest(0.02, tenant="late", output_tokens=3,
+                    deadline_ms=50.0),
+        SpecRequest(0.03, tenant="shedme", output_tokens=3),
+    ]).validate()
+    report = replay_spec(spec, stub.url, registry=MetricsRegistry())
+    assert report["outcomes"] == {"ok": 1, "shed": 2, "deadline": 1,
+                                  "error": 0}
+    assert report["sheds"] == {"tenant_quota": 2}
+    tenants = report["tenants"]
+    assert tenants["shedme"]["shed"] == 2
+    assert tenants["late"]["deadline"] == 1
+    assert tenants["ok_t"]["ok_rate"] == 1.0
+    # worst/best ok-rate ratio: shedme's 0 over ok_t's 1.0
+    assert report["tenant_ok_rate_ratio"] == 0.0
+    # deadline_ms forwarded on the wire
+    late = [req for t, req in stub.seen if t == "late"]
+    assert late and late[0]["deadline_ms"] == 50.0
+
+
+def test_empty_replay_is_unmeasurable_not_a_pass(stub):
+    # Poisson thinning can legitimately emit zero requests; a gate
+    # replaying an empty spec must FAIL its SLO bounds, not pass them
+    # vacuously
+    report = replay_spec(WorkloadSpec("empty", requests=[]), stub.url,
+                         registry=MetricsRegistry())
+    assert report["goodput"] is None
+    assert report["tenant_ok_rate_ratio"] is None
+    verdict = evaluate_slo(report, {"goodput_min": 0.5,
+                                    "tenant_ok_rate_ratio_min": 0.5})
+    assert not verdict["pass"]
+
+
+def test_predict_cli_reads_bare_calibration_dict(tmp_path, capsys):
+    # a bare calibrate_rates() dict carries the rate keys at TOP level
+    # (its own nested "calibration" block holds only raw timings) —
+    # `predict --calibration` must use the measured rates, not silently
+    # fall back to the CLI defaults
+    from tools.replay import main as replay_main
+
+    spec = WorkloadSpec("one", requests=[
+        SpecRequest(0.0, prompt_tokens=100, output_tokens=10)])
+    spec_path = str(tmp_path / "spec.jsonl")
+    spec.save(spec_path)
+    cal = {"prefill_tokens_per_sec": 1000.0,
+           "decode_tokens_per_sec": 100.0,
+           "decode_tokens_per_sec_serial": 120.0,
+           "calibration": {"n": 2, "ttft_ms": 10.0}}
+    cal_path = str(tmp_path / "cal.json")
+    with open(cal_path, "w") as fh:
+        json.dump(cal, fh)
+    rc = replay_main(["predict", "--spec", spec_path,
+                      "--replicas", "1", "--slots", "1",
+                      "--calibration", cal_path])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["model"]["prefill_tokens_per_sec"] == 1000.0
+    assert out["model"]["decode_tokens_per_sec"] == 100.0
+    # zero-load closed form under the calibrated rates: 100+100 ms
+    assert out["latency_ms"]["p99"] == pytest.approx(200.0)
+    # a calibration file without rates is an error, not a silent
+    # default
+    with open(cal_path, "w") as fh:
+        json.dump({"calibration": {"ttft_ms": 5.0}}, fh)
+    with pytest.raises(SystemExit, match="no service rates"):
+        replay_main(["predict", "--spec", spec_path,
+                     "--calibration", cal_path])
+
+
+def test_replay_transport_error_is_an_outcome():
+    spec = WorkloadSpec("dead", requests=[SpecRequest(0.0)]).validate()
+    # nothing listens on this port
+    report = replay_spec(spec, "http://127.0.0.1:9",
+                         timeout_s=2.0, registry=MetricsRegistry())
+    assert report["outcomes"]["error"] == 1
+    assert report["goodput"] == 0.0
+
+
+# -- SLO ----------------------------------------------------------------------
+
+
+def _ok_report():
+    return {"outcomes": {"ok": 10, "shed": 2, "deadline": 0,
+                         "error": 0},
+            "sheds": {"tenant_quota": 2},
+            "goodput": 0.92,
+            "ttft_ms": {"p50": 40.0, "p99": 200.0},
+            "tbt_ms": {"p50": 5.0, "p99": 30.0},
+            "latency_ms": {"p50": 100.0, "p99": 400.0},
+            "tenant_ok_rate_ratio": 0.8}
+
+
+def test_slo_pass_and_fail_bounds():
+    report = _ok_report()
+    good = evaluate_slo(report, {
+        "ttft_p99_ms": 500.0, "tbt_p99_ms": 50.0, "goodput_min": 0.9,
+        "tenant_ok_rate_ratio_min": 0.5,
+        "shed_reasons_allowed": ["tenant_quota"], "sheds_max": 5,
+        "errors_max": 0})
+    assert good["pass"] and all(c["ok"] for c in good["checks"])
+    bad = evaluate_slo(report, {"ttft_p99_ms": 100.0,
+                                "goodput_min": 0.99,
+                                "shed_reasons_allowed": ["queue_full"],
+                                "sheds_max": 1})
+    assert not bad["pass"]
+    failed = {c["name"] for c in bad["checks"] if not c["ok"]}
+    assert failed == {"ttft_p99_ms", "goodput_min",
+                      "shed_reasons_allowed", "sheds_max"}
+
+
+def test_slo_unknown_key_rejected_and_unmeasurable_fails():
+    with pytest.raises(ValueError, match="unknown SLO key"):
+        evaluate_slo(_ok_report(), {"goodput_mn": 0.9})
+    report = _ok_report()
+    report["ttft_ms"] = {"p50": None, "p99": None}  # blocking replay
+    verdict = evaluate_slo(report, {"ttft_p99_ms": 1000.0})
+    assert not verdict["pass"]  # unmeasurable must not pass vacuously
+
+
+# -- extraction: traces -> spec round trip ------------------------------------
+
+
+def _record_trace(rec, r, offset_s, base_ts, outcome="ok",
+                  hit_tokens=0):
+    """Fabricate one request trace through the REAL recorder using the
+    same annotate_request_shape the serving plane calls."""
+    span = rec.start_span("serve.request")
+    span.start = base_ts + offset_s  # deterministic arrival clock
+    annotate_request_shape(
+        span, tenant=r.tenant, prompt_tokens=r.prompt_tokens,
+        max_new_tokens=r.output_tokens,
+        deadline_s=(r.deadline_ms / 1000.0
+                    if r.deadline_ms is not None else None))
+    if outcome == "shed":
+        span.event("shed", reason="queue_full")
+    else:
+        span.event("admission", rid=1, slot=0, route="paged_chunked",
+                   prefix_hit_tokens=hit_tokens)
+        span.event("terminal", rid=1, outcome=outcome,
+                   new_tokens=r.output_tokens if outcome == "ok" else 0)
+    span.finish()
+
+
+def test_traces_to_spec_round_trip_preserves_shape_histogram():
+    spec = synth_spec("tenant_flood", seed=8, duration_s=6.0,
+                      rate_rps=2.0, max_seq_len=64, deadline_ms=900.0)
+    # extraction rebases arrivals to the FIRST one; shift the
+    # reference spec the same way so the oracle is exact equality
+    first = spec.requests[0].offset_s
+    for r in spec.requests:
+        r.offset_s -= first
+    rec = TraceRecorder(sample=1.0, max_traces=1024)
+    base = 1_700_000_000.0
+    for i, r in enumerate(spec.requests):
+        _record_trace(rec, r, r.offset_s, base)
+    out = spec_from_traces(rec.traces(limit=1024), name="rt", seed=1)
+    # the round-trip oracle: identical shape histogram (offsets are
+    # preserved exactly too, up to the header rebase)
+    assert out.shape_histogram() == spec.shape_histogram()
+    offs = [round(r.offset_s, 3) for r in out.requests]
+    assert offs == [round(r.offset_s, 3) for r in spec.requests]
+
+
+def test_extract_keeps_shed_demand_and_skips_canary():
+    rec = TraceRecorder(sample=1.0, max_traces=64)
+    base = 1_700_000_000.0
+    shed = SpecRequest(0.0, tenant="t1", prompt_tokens=10,
+                       output_tokens=7)
+    _record_trace(rec, shed, 0.5, base, outcome="shed")
+    canary = SpecRequest(0.0, tenant="__internal__", prompt_tokens=4,
+                         output_tokens=2)
+    _record_trace(rec, canary, 1.0, base)
+    hit = SpecRequest(0.0, tenant="t2", prompt_tokens=24,
+                      output_tokens=5)
+    _record_trace(rec, hit, 2.0, base, hit_tokens=16)
+    out = spec_from_traces(rec.traces(limit=64))
+    assert len(out.requests) == 2  # canary dropped
+    shed_row = next(r for r in out.requests if r.tenant == "t1")
+    assert shed_row.output_tokens == 7  # refused demand keeps budget
+    hit_row = next(r for r in out.requests if r.tenant == "t2")
+    assert hit_row.prefix_group == "observed"
+    assert hit_row.prefix_tokens == 16
+    assert out.meta["observed_outcomes"]["shed"] == 1
+
+
+def test_parse_traces_accepts_all_export_forms():
+    traces = [{"trace_id": "a", "spans": []},
+              {"trace_id": "b", "spans": []}]
+    assert parse_traces(traces) == traces
+    assert parse_traces(json.dumps({"traces": traces})) == traces
+    jsonl = "".join(json.dumps(t) + "\n" for t in traces)
+    assert parse_traces(jsonl) == traces
+    assert parse_traces(jsonl.encode()) == traces
+    # torn tail line tolerated
+    assert parse_traces(jsonl + '{"trace_id": "c"') == traces
+    assert parse_traces("") == []
+    # a ONE-trace jsonl export is a single line starting with "{" —
+    # it must parse as one trace, not as an empty envelope
+    assert parse_traces(json.dumps(traces[0])) == [traces[0]]
+    assert parse_traces(json.dumps(traces[0]).encode() + b"\n") == \
+        [traces[0]]
+    # a pretty-printed envelope (a `| jq .` round trip) still parses
+    pretty = json.dumps({"traces": traces}, indent=2)
+    assert parse_traces(pretty) == traces
+    assert parse_traces(json.dumps(traces, indent=2)) == traces
+
+
+def test_traces_jsonl_http_export_bounded():
+    rec = TraceRecorder(sample=1.0, max_traces=64)
+    for i in range(5):
+        rec.start_span(f"s{i}").finish()
+    code, ctype, body = handle_obs_request(
+        "/traces?format=jsonl&n=3", MetricsRegistry(), tracer=rec)
+    assert code == 200 and ctype == "application/x-ndjson"
+    lines = body.decode().strip().splitlines()
+    assert len(lines) == 3  # bounded by ?n=
+    assert all(json.loads(ln)["trace_id"] for ln in lines)
+    code, _, _ = handle_obs_request(
+        "/traces?format=yaml", MetricsRegistry(), tracer=rec)
+    assert code == 400
+    # default JSON body unchanged
+    code, ctype, body = handle_obs_request(
+        "/traces?n=2", MetricsRegistry(), tracer=rec)
+    assert code == 200 and ctype == "application/json"
+    assert len(json.loads(body)["traces"]) == 2
+
+
+# -- capacity model -----------------------------------------------------------
+
+
+def test_capacity_zero_load_closed_form():
+    m = FleetModel(replicas=1, slots_per_replica=1,
+                   prefill_tokens_per_sec=1000.0,
+                   decode_tokens_per_sec=100.0, overhead_ms=5.0)
+    spec = WorkloadSpec("one", requests=[
+        SpecRequest(0.0, prompt_tokens=100, output_tokens=10)
+    ]).validate()
+    out = predict(m, spec)
+    # 5ms overhead + 100/1000 s prefill + 10/100 s decode = 205 ms
+    assert out["latency_ms"]["p99"] == pytest.approx(205.0)
+    assert out["ttft_ms"]["p99"] == pytest.approx(105.0)
+    assert out["queue_delay_ms"]["max"] == 0.0
+    assert out["outcomes"] == {"ok": 1, "shed": 0, "deadline": 0,
+                               "error": 0}
+    assert out["goodput"] == 1.0
+
+
+def test_capacity_serial_queueing_closed_form():
+    m = FleetModel(replicas=1, slots_per_replica=1,
+                   prefill_tokens_per_sec=1000.0,
+                   decode_tokens_per_sec=100.0)
+    # two simultaneous arrivals through one server: second waits
+    # exactly one service time (0.1 + 0.1 = 200 ms)
+    spec = WorkloadSpec("two", requests=[
+        SpecRequest(0.0, prompt_tokens=100, output_tokens=10),
+        SpecRequest(0.0, prompt_tokens=100, output_tokens=10),
+    ]).validate()
+    out = predict(m, spec)
+    assert out["queue_delay_ms"]["max"] == pytest.approx(200.0)
+    assert out["latency_ms"]["max"] == pytest.approx(400.0)
+
+
+def test_capacity_saturation_sheds_exact():
+    m = FleetModel(replicas=1, slots_per_replica=1, max_queue_depth=3,
+                   prefill_tokens_per_sec=1000.0,
+                   decode_tokens_per_sec=100.0)
+    spec = WorkloadSpec("sat", requests=[
+        SpecRequest(0.0, prompt_tokens=10, output_tokens=10)
+        for _ in range(10)
+    ]).validate()
+    out = predict(m, spec)
+    # 1 in the slot + 3 queued admit; the other 6 shed
+    assert out["outcomes"]["shed"] == 6
+    assert out["sheds"] == {"queue_full": 6}
+    assert out["outcomes"]["ok"] == 4
+
+
+def test_capacity_router_backoff_cliff_closed_form():
+    m = FleetModel(replicas=2, slots_per_replica=1, max_queue_depth=1,
+                   prefill_tokens_per_sec=1000.0,
+                   decode_tokens_per_sec=10.0,  # 1 s decode each
+                   router_backoff_s=5.0)
+    spec = WorkloadSpec("cliff", requests=[
+        SpecRequest(0.0, prompt_tokens=10, output_tokens=10)
+        for _ in range(10)
+    ]).validate()
+    out = predict(m, spec)
+    # 2 in slots + 2 queued admit; the 5th refusal backs BOTH
+    # replicas off (primary + the single re-route), so the remaining
+    # arrivals inside the backoff window get the router's
+    # "no_replicas" verdict — the measured flash-crowd cliff
+    assert out["outcomes"] == {"ok": 4, "shed": 6, "deadline": 0,
+                               "error": 0}
+    assert out["sheds"] == {"no_replicas": 5, "queue_full": 1}
+
+
+def test_capacity_deadline_expiry_in_queue():
+    m = FleetModel(replicas=1, slots_per_replica=1,
+                   prefill_tokens_per_sec=1000.0,
+                   decode_tokens_per_sec=10.0)  # 1 s decode each
+    spec = WorkloadSpec("dl", requests=[
+        SpecRequest(0.0, prompt_tokens=10, output_tokens=10),
+        SpecRequest(0.0, prompt_tokens=10, output_tokens=10,
+                    deadline_ms=200.0),  # expires while queued
+    ]).validate()
+    out = predict(m, spec)
+    assert out["outcomes"]["deadline"] == 1
+    assert out["outcomes"]["ok"] == 1
+
+
+def test_capacity_empty_spec_is_unmeasurable_not_a_pass():
+    out = predict(FleetModel(), WorkloadSpec("empty", requests=[]))
+    assert out["goodput"] is None
+    assert out["tenant_ok_rate_ratio"] is None
+    assert not evaluate_slo(out, {"goodput_min": 0.9})["pass"]
+
+
+def test_capacity_single_tenant_fairness_neutral():
+    m = FleetModel(replicas=2, slots_per_replica=2,
+                   prefill_tokens_per_sec=1000.0,
+                   decode_tokens_per_sec=100.0)
+    spec = synth_spec("steady", seed=12, duration_s=5.0, rate_rps=2.0,
+                      max_seq_len=64)
+    out = predict(m, spec)
+    assert out["tenant_ok_rate_ratio"] == 1.0
+    assert list(out["tenants"]) == ["default"]
+
+
+def test_capacity_kv_page_budget_binds():
+    # 4 pages of 16 tokens; each request needs 2 pages -> at most 2
+    # in flight even though slots would allow 4
+    m = FleetModel(replicas=1, slots_per_replica=4, kv_pages=4,
+                   page_size=16, prefill_tokens_per_sec=1000.0,
+                   decode_tokens_per_sec=100.0)
+    spec = WorkloadSpec("pages", requests=[
+        SpecRequest(0.0, prompt_tokens=20, output_tokens=10)
+        for _ in range(4)
+    ]).validate()
+    out = predict(m, spec)
+    assert out["outcomes"]["ok"] == 4  # all admit eventually
+    assert out["queue_delay_ms"]["max"] > 0  # but two waited for pages
+
+
+def test_agreement_band():
+    pred = {"latency_ms": {"p99": 100.0}, "outcomes": {"shed": 10}}
+    meas_ok = {"latency_ms": {"p99": 300.0}, "outcomes": {"shed": 13}}
+    meas_bad = {"latency_ms": {"p99": 900.0}, "outcomes": {"shed": 40}}
+    assert check_agreement(pred, meas_ok, p99_band=4.0)["ok"]
+    out = check_agreement(pred, meas_bad, p99_band=4.0)
+    assert not out["ok"]
+    assert all(not c["ok"] for c in out["checks"])
+    # both-empty agreement (nothing completed on either side)
+    assert check_agreement({"latency_ms": {}, "outcomes": {}},
+                           {"latency_ms": {}, "outcomes": {}})["ok"]
+
+
+def test_hpa_targets_derive_the_manifest_numbers():
+    out = derive_hpa_targets()
+    # the numbers documented in infra/k8s/tpu/tpu-serve-hpa.yaml
+    assert out["router_demand_tokens_avg"] == 4096
+    assert out["router_queue_delay_ms_p99"] == 500.0
+
+
+# -- the span-attribute contract, pinned against a REAL engine ----------------
+
+
+def test_engine_request_span_carries_the_shape_contract():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import linen as nn
+
+    from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
+    from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    cfg = CausalLMConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                         num_heads=2, intermediate_size=64,
+                         max_seq_len=128, dtype=jnp.float32)
+    model = CausalLM(cfg)
+    params = nn.meta.unbox(jax.jit(model.init)(
+        make_rng(0), jnp.ones((1, 8), jnp.int32))["params"])
+    eng = ContinuousEngine(model, params, num_slots=2, chunk=4)
+    rec = TraceRecorder(sample=1.0)
+    span = rec.start_span("serve.request")
+    rng = np.random.default_rng(0)
+    eng.submit(rng.integers(1, 97, 12), max_new_tokens=8,
+               tenant="acme", deadline_s=120.0, span=span)
+    list(eng.run_until_drained())
+    span.finish()
+    [trace] = rec.traces()
+    attrs = trace["spans"][0]["attrs"]
+    # THE pinned contract (replay/extract.py reads exactly these):
+    # renaming or dropping one must fail here first
+    assert set(REQUEST_SHAPE_ATTRS) == {"tenant", "prompt_tokens",
+                                        "max_new_tokens"}
+    for key in REQUEST_SHAPE_ATTRS:
+        assert key in attrs, f"span attr {key!r} missing"
+    assert attrs["tenant"] == "acme"
+    assert attrs["prompt_tokens"] == 12
+    assert attrs["max_new_tokens"] == 8
+    assert attrs["deadline_ms"] == pytest.approx(120000.0)
+    events = trace["spans"][0]["events"]
+    terminal = [e for e in events if e["name"] == "terminal"]
+    assert terminal and terminal[0]["outcome"] == "ok"
+    assert terminal[0]["new_tokens"] == 8
+    # and the whole trace extracts into exactly one spec row
+    spec = spec_from_traces([trace])
+    assert len(spec.requests) == 1
+    row = spec.requests[0]
+    assert (row.tenant, row.prompt_tokens, row.output_tokens) == \
+        ("acme", 12, 8)
+    assert row.deadline_ms == pytest.approx(120000.0)

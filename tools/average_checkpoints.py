@@ -64,11 +64,16 @@ def average_checkpoints(checkpoint_dir: str, output_dir: str,
         return {k: tree[k] for k in ("params", "ema_params")
                 if tree.get(k) is not None}
 
-    total = src.restore(use[-1])  # newest: step/opt_state kept as-is
+    # StandardRestore() (no target tree): checkpoints were written via
+    # StandardSave, and a bare restore on current orbax raises the
+    # composite-handler KeyError for the "default" item
+    total = src.restore(  # newest: step/opt_state kept as-is
+        use[-1], args=ocp.args.StandardRestore())
     weight_sum = jax.tree.map(lambda l: jnp.asarray(l, jnp.float32),
                               weights_of(total))
     for step in use[:-1]:
-        other = weights_of(src.restore(step))
+        other = weights_of(
+            src.restore(step, args=ocp.args.StandardRestore()))
         weight_sum = jax.tree.map(
             lambda a, b: a + jnp.asarray(b, jnp.float32), weight_sum, other)
     n = float(len(use))

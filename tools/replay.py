@@ -1,0 +1,351 @@
+#!/usr/bin/env python
+"""Workload replay / capacity-planning CLI (jax-free).
+
+Subcommands, each printing one JSON object to stdout (and ``--out``):
+
+* ``extract``  — ``GET /traces`` export (URL or file) -> workload spec
+* ``synth``    — synthetic scenario generator -> workload spec
+* ``run``      — replay a spec against a URL or a throwaway local
+                 fleet, with optional declarative SLO assertions
+* ``predict``  — offline capacity model over the same spec
+* ``check``    — prediction-vs-replay agreement within the band
+* ``hpa``      — print the derived HPA metric targets
+                 (infra/k8s/tpu/tpu-serve-hpa.yaml documents these)
+
+The quickstart loop (docs/REPLAY.md walks it):
+
+    python tools/replay.py synth --kind flash_crowd --out crowd.jsonl
+    python tools/replay.py run --spec crowd.jsonl --localfleet 2 \\
+        --calibrate \\
+        --slo '{"goodput_min": 0.8, "errors_max": 0}' --out measured.json
+    python tools/replay.py predict --spec crowd.jsonl \\
+        --calibration measured.json --out predicted.json
+    python tools/replay.py check --predicted predicted.json \\
+        --measured measured.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:  # pragma: no cover - direct invocation
+    sys.path.insert(0, REPO_ROOT)
+
+
+def _emit(obj: dict, out_path=None) -> None:
+    text = json.dumps(obj, indent=2, sort_keys=False)
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(text + "\n")
+    print(text)
+
+
+def _load_json(path: str) -> dict:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def cmd_extract(args) -> int:
+    from pyspark_tf_gke_tpu.replay.extract import (
+        parse_traces,
+        spec_from_traces,
+    )
+
+    src = args.traces
+    if src.startswith("http://") or src.startswith("https://"):
+        url = src.rstrip("/") + f"/traces?format=jsonl&n={args.n}"
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            payload = resp.read()
+    else:
+        with open(src, "rb") as fh:
+            payload = fh.read()
+    traces = parse_traces(payload)
+    spec = spec_from_traces(traces, name=args.name, seed=args.seed,
+                            keep_internal=args.keep_internal)
+    spec.save(args.out)
+    _emit({"spec": args.out, "traces_seen": len(traces),
+           "requests": len(spec.requests),
+           "shape": spec.shape_histogram(),
+           "meta": spec.meta})
+    return 0 if spec.requests else 1
+
+
+def cmd_synth(args) -> int:
+    from pyspark_tf_gke_tpu.replay.generators import synth_spec
+
+    params = {}
+    for kv in args.param or []:
+        key, _, val = kv.partition("=")
+        if not key or not val:
+            raise SystemExit(f"--param wants key=value, got {kv!r}")
+        for conv in (int, float):
+            try:
+                params[key] = conv(val)
+                break
+            except ValueError:
+                continue
+        else:  # non-numeric values stay strings (e.g. future enum
+            params[key] = val  # params); '1e3'-style floats parse above
+    spec = synth_spec(args.kind, seed=args.seed,
+                      duration_s=args.duration, rate_rps=args.rate,
+                      prompt_tokens=args.prompt_tokens,
+                      output_tokens=args.output_tokens,
+                      max_seq_len=args.max_seq_len,
+                      deadline_ms=args.deadline_ms, name=args.name,
+                      **params)
+    spec.save(args.out)
+    _emit({"spec": args.out, "requests": len(spec.requests),
+           "shape": spec.shape_histogram(), "meta": spec.meta})
+    return 0
+
+
+def cmd_run(args) -> int:
+    from pyspark_tf_gke_tpu.replay.driver import replay_spec
+    from pyspark_tf_gke_tpu.replay.slo import evaluate_slo
+    from pyspark_tf_gke_tpu.replay.spec import WorkloadSpec
+
+    spec = WorkloadSpec.load(args.spec)
+    slo = None
+    if args.slo:
+        slo = (json.loads(args.slo) if args.slo.lstrip().startswith("{")
+               else _load_json(args.slo))
+
+    def drive(url: str) -> dict:
+        calibration = None
+        if args.calibrate:
+            from pyspark_tf_gke_tpu.replay.capacity import calibrate_rates
+
+            # BEFORE the replay: rates from an idle fleet, with the
+            # side benefit of absorbing first-request JIT compiles
+            # outside the timed window
+            calibration = calibrate_rates(
+                url, prompt_tokens=args.prompt_tokens,
+                output_tokens=args.output_tokens,
+                timeout_s=args.timeout)
+        report = replay_spec(spec, url, speedup=args.speedup,
+                             stream=not args.no_stream,
+                             timeout_s=args.timeout,
+                             include_requests=args.include_requests)
+        if calibration is not None:
+            report["calibration"] = calibration
+        if slo is not None:
+            report["slo"] = evaluate_slo(report, slo)
+        return report
+
+    if args.url:
+        report = drive(args.url)
+    else:
+        from pyspark_tf_gke_tpu.router.localfleet import LocalFleet
+
+        trace_args = ("--trace-sample", "1.0", "--trace-slow-ms", "0")
+        extra = trace_args
+        if args.tenants:
+            extra = extra + ("--tenants", args.tenants)
+        with LocalFleet(args.localfleet, router=not args.no_router,
+                        replica_args=extra,
+                        router_args=trace_args) as fleet:
+            # first-request JIT compiles must not be charged to the
+            # replayed tail
+            fleet.warm()
+            report = drive(fleet.url)
+            report["fleet"] = {"replicas": args.localfleet,
+                               "router": not args.no_router}
+    _emit(report, args.out)
+    if slo is not None and not report["slo"]["pass"]:
+        return 1
+    return 0
+
+
+def cmd_predict(args) -> int:
+    from pyspark_tf_gke_tpu.replay.capacity import FleetModel, predict
+    from pyspark_tf_gke_tpu.replay.spec import WorkloadSpec
+
+    spec = WorkloadSpec.load(args.spec)
+    prefill_tps, decode_tps = args.prefill_tps, args.decode_tps
+    if args.calibration:
+        cal = _load_json(args.calibration)
+        # accept either a bare calibrate_rates() dict (rate keys at
+        # top level — its OWN nested "calibration" block holds only
+        # raw timings) or a run report that embedded the whole dict
+        # under "calibration"
+        rates = cal
+        if "prefill_tokens_per_sec" not in rates \
+                and isinstance(cal.get("calibration"), dict):
+            rates = cal["calibration"]
+        missing = [k for k in ("prefill_tokens_per_sec",
+                               "decode_tokens_per_sec")
+                   if k not in rates]
+        if missing:
+            # BOTH rates or neither: a prediction silently mixing one
+            # measured rate with a CLI default would be wrong by
+            # orders of magnitude with no warning
+            raise SystemExit(
+                f"{args.calibration}: no service rates found "
+                f"(missing {', '.join(missing)} — expected both at "
+                "top level or under 'calibration')")
+        prefill_tps = float(rates["prefill_tokens_per_sec"])
+        decode_tps = float(rates["decode_tokens_per_sec"])
+    model = FleetModel(
+        replicas=args.replicas, slots_per_replica=args.slots,
+        kv_pages=args.kv_pages, page_size=args.page_size,
+        max_queued_tokens=args.max_queued_tokens,
+        max_queue_depth=args.max_queue_depth,
+        prefill_tokens_per_sec=prefill_tps,
+        decode_tokens_per_sec=decode_tps,
+        overhead_ms=args.overhead_ms,
+        prefix_hit_rate=args.hit_rate,
+        router_backoff_s=args.router_backoff)
+    _emit(predict(model, spec, speedup=args.speedup), args.out)
+    return 0
+
+
+def cmd_check(args) -> int:
+    from pyspark_tf_gke_tpu.replay.capacity import check_agreement
+
+    verdict = check_agreement(
+        _load_json(args.predicted), _load_json(args.measured),
+        p99_band=args.p99_band, shed_band_abs=args.shed_abs,
+        shed_band_rel=args.shed_rel)
+    _emit(verdict, args.out)
+    return 0 if verdict["ok"] else 1
+
+
+def cmd_hpa(args) -> int:
+    from pyspark_tf_gke_tpu.replay.capacity import derive_hpa_targets
+
+    _emit(derive_hpa_targets(
+        kv_pages=args.kv_pages, page_size=args.page_size,
+        decode_chunk_tokens=args.decode_chunk,
+        decode_tokens_per_sec=args.decode_tps), args.out)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tools/replay.py",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ex = sub.add_parser("extract", help="/traces export -> workload spec")
+    ex.add_argument("--traces", required=True,
+                    help="base URL of a serve REPLICA (GET "
+                         "/traces?format=jsonl is appended; the "
+                         "router's ring carries routing spans, not "
+                         "request shapes) or a path to a saved "
+                         "export (jsonl or JSON body)")
+    ex.add_argument("--out", required=True, help="spec JSONL to write")
+    ex.add_argument("--n", type=int, default=1024,
+                    help="max traces to pull from a live URL")
+    ex.add_argument("--name", default="extracted")
+    ex.add_argument("--seed", type=int, default=0)
+    ex.add_argument("--keep-internal", action="store_true",
+                    help="keep __internal__ (canary) requests")
+    ex.set_defaults(fn=cmd_extract)
+
+    sy = sub.add_parser("synth", help="synthetic scenario -> spec")
+    sy.add_argument("--kind", required=True,
+                    help="steady | diurnal | flash_crowd | tenant_flood"
+                         " | longtail | shared_prefix")
+    sy.add_argument("--out", required=True)
+    sy.add_argument("--seed", type=int, default=0)
+    sy.add_argument("--duration", type=float, default=30.0)
+    sy.add_argument("--rate", type=float, default=2.0)
+    sy.add_argument("--prompt-tokens", type=int, default=24)
+    sy.add_argument("--output-tokens", type=int, default=8)
+    sy.add_argument("--max-seq-len", type=int, default=64)
+    sy.add_argument("--deadline-ms", type=float, default=None)
+    sy.add_argument("--name", default=None)
+    sy.add_argument("--param", action="append",
+                    help="generator-specific key=value (repeatable), "
+                         "e.g. --param burst_mult=8")
+    sy.set_defaults(fn=cmd_synth)
+
+    rn = sub.add_parser("run", help="replay a spec (open loop)")
+    rn.add_argument("--spec", required=True)
+    tgt = rn.add_mutually_exclusive_group(required=True)
+    tgt.add_argument("--url", help="base URL of a running fleet")
+    tgt.add_argument("--localfleet", type=int, metavar="N",
+                     help="launch N CPU replicas (+router) just for "
+                          "this run")
+    rn.add_argument("--no-router", action="store_true",
+                    help="with --localfleet: hit replica 0 directly")
+    rn.add_argument("--tenants",
+                    help="with --localfleet: --tenants spec for the "
+                         "replicas (e.g. 'light=3,flood=1:60:120')")
+    rn.add_argument("--speedup", type=float, default=1.0)
+    rn.add_argument("--timeout", type=float, default=120.0)
+    rn.add_argument("--no-stream", action="store_true",
+                    help="blocking requests (no TTFT/TBT capture)")
+    rn.add_argument("--slo",
+                    help="declarative SLO bounds: inline JSON or a "
+                         "path (docs/REPLAY.md lists the keys); "
+                         "rc=1 when any bound fails")
+    rn.add_argument("--calibrate", action="store_true",
+                    help="measure service rates first (serial "
+                         "requests) and embed them in the report")
+    rn.add_argument("--prompt-tokens", type=int, default=24,
+                    help="calibration request shape")
+    rn.add_argument("--output-tokens", type=int, default=8)
+    rn.add_argument("--include-requests", action="store_true")
+    rn.add_argument("--out")
+    rn.set_defaults(fn=cmd_run)
+
+    pr = sub.add_parser("predict", help="offline capacity model")
+    pr.add_argument("--spec", required=True)
+    pr.add_argument("--replicas", type=int, default=2)
+    pr.add_argument("--slots", type=int, default=2)
+    pr.add_argument("--kv-pages", type=int, default=None)
+    pr.add_argument("--page-size", type=int, default=16)
+    pr.add_argument("--max-queued-tokens", type=int, default=None)
+    pr.add_argument("--max-queue-depth", type=int, default=None)
+    pr.add_argument("--prefill-tps", type=float, default=2000.0)
+    pr.add_argument("--decode-tps", type=float, default=50.0)
+    pr.add_argument("--overhead-ms", type=float, default=0.0)
+    pr.add_argument("--hit-rate", type=float, default=0.0)
+    pr.add_argument("--router-backoff", type=float, default=0.0,
+                    help="model the router's Retry-After backoff: a "
+                         "replica that refuses is offered no work for "
+                         "this many seconds (serve's queue_full "
+                         "Retry-After is 1). 0 = no router in front")
+    pr.add_argument("--speedup", type=float, default=1.0)
+    pr.add_argument("--calibration",
+                    help="JSON file with measured service rates (a "
+                         "calibrate_rates() dict, or a run report "
+                         "that embedded one) — overrides --prefill-"
+                         "tps/--decode-tps")
+    pr.add_argument("--out")
+    pr.set_defaults(fn=cmd_predict)
+
+    ck = sub.add_parser("check",
+                        help="prediction-vs-replay agreement band")
+    ck.add_argument("--predicted", required=True)
+    ck.add_argument("--measured", required=True)
+    ck.add_argument("--p99-band", type=float, default=4.0)
+    ck.add_argument("--shed-abs", type=int, default=4)
+    ck.add_argument("--shed-rel", type=float, default=0.5)
+    ck.add_argument("--out")
+    ck.set_defaults(fn=cmd_check)
+
+    hp = sub.add_parser("hpa", help="derived HPA metric targets")
+    hp.add_argument("--kv-pages", type=int, default=256)
+    hp.add_argument("--page-size", type=int, default=16)
+    hp.add_argument("--decode-chunk", type=int, default=64)
+    hp.add_argument("--decode-tps", type=float, default=128.0)
+    hp.add_argument("--out")
+    hp.set_defaults(fn=cmd_hpa)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
