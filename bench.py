@@ -1916,6 +1916,81 @@ def bench_replay(smoke: bool = False) -> dict:
     }
 
 
+def bench_chaos(smoke: bool = False) -> dict:
+    """``python bench.py chaos``: goodput recovery after a replica kill
+    during a flash-crowd replay — the chaos plane's headline scenario
+    (docs/CHAOS.md). A seeded flash crowd replays open-loop through the
+    real router against a 2-replica CPU fleet while the chaos schedule
+    SIGKILLs replica 1 mid-crowd and restarts it; the measurement is
+    the ok-rate in three windows (pre-kill / outage / post-restart),
+    the durability closure (every request exactly one terminal
+    outcome), and the post-scenario invariant verdicts on both
+    replicas. Host-only like ``router``/``replay``: runs with the TPU
+    tunnel down."""
+    from pyspark_tf_gke_tpu.chaos.invariants import (
+        check_replica,
+        check_report,
+        goodput_windows,
+    )
+    from pyspark_tf_gke_tpu.chaos.runner import ScheduleRunner
+    from pyspark_tf_gke_tpu.chaos.spec import ChaosEvent, ChaosSchedule
+    from pyspark_tf_gke_tpu.replay.driver import replay_spec
+    from pyspark_tf_gke_tpu.replay.generators import synth_spec
+    from pyspark_tf_gke_tpu.router.localfleet import LocalFleet
+
+    scale = 0.5 if smoke else 1.0
+    duration = 18.0 * scale
+    kill_at = 6.0 * scale
+    restart_after = 5.0 * scale
+    spec = synth_spec("flash_crowd", seed=23, duration_s=duration,
+                      rate_rps=2.0, prompt_tokens=16, output_tokens=8,
+                      max_seq_len=64, burst_mult=4.0, burst_frac=0.3)
+    schedule = ChaosSchedule("bench-kill-one", seed=23, events=[
+        ChaosEvent(offset_s=kill_at, action="kill", target="replica:1",
+                   restart_s=restart_after),
+    ]).validate()
+    trace_args = ("--trace-sample", "1.0", "--trace-slow-ms", "0")
+    with LocalFleet(2, router_args=trace_args,
+                    replica_args=(*trace_args, "--continuous-slots",
+                                  "1", "--max-queue-depth", "6")) as fleet:
+        fleet.warm()
+        runner = ScheduleRunner(schedule, fleet)
+        with runner:
+            report = replay_spec(spec, fleet.url, speedup=1.0,
+                                 include_requests=True)
+        closure = check_report(report, len(spec.requests))
+        fleet.wait_idle(timeout_s=60)
+        invariants = [check_replica(u) for u in fleet.replica_urls]
+    wins = goodput_windows(
+        report, [0.0, kill_at, kill_at + restart_after, duration + 1.0])
+    pre, outage, post = wins
+    recovered = post["ok_rate"]
+    return {
+        "metric": "chaos_recovered_goodput",
+        "value": recovered,
+        "unit": "ok_rate",
+        "vs_baseline": None,
+        "n_requests": len(spec.requests),
+        "outcomes": report["outcomes"],
+        "sheds": report["sheds"],
+        "goodput_overall": report["goodput"],
+        "goodput_windows": wins,
+        "pre_kill_ok_rate": pre["ok_rate"],
+        "outage_ok_rate": outage["ok_rate"],
+        "chaos_actions": runner.actions,
+        "terminal_closure": closure,
+        "replica_invariants": invariants,
+        "schedule": {"name": schedule.name, "seed": schedule.seed,
+                     "kill_at_s": kill_at,
+                     "restart_after_s": restart_after},
+        "workload": ("replica SIGKILL + restart during a flash-crowd "
+                     "replay vs 2-replica CPU localfleet + router: "
+                     "windowed goodput (pre/outage/post), exactly-one-"
+                     "terminal closure, post-scenario invariant "
+                     "checks (docs/CHAOS.md)"),
+    }
+
+
 # ---- orchestrator ----------------------------------------------------------
 
 
@@ -2309,6 +2384,10 @@ ALL_WORKLOADS = (
     # CPU localfleet, SLO-scored, flash-crowd capacity prediction
     # checked in band, /traces export round-tripped (host-only)
     ["replay"],
+    # chaos durability: replica SIGKILL + restart during a flash-crowd
+    # replay — windowed goodput recovery, exactly-one-terminal closure,
+    # post-scenario invariant checks (host-only)
+    ["chaos"],
     ["spec"],  # device-loop tok/s + the 0.75-skew fixture's acceptance
     ["generate", "--beams", "4"],  # broadcast-select reorder rebuild A/B
     # --- measured re-confirmations ---
@@ -2344,13 +2423,13 @@ def _run_matrix(extra, backend_ok: bool, skip=(),
         if list(argv) in [list(s) for s in skip]:
             continue
         log(f"=== bench matrix: {' '.join(argv)} ===")
-        if argv[0] not in ("io", "router", "replay") and not backend_ok:
+        if argv[0] not in ("io", "router", "replay", "chaos") and not backend_ok:
             print(json.dumps(_error_json(list(argv), "probe", gate_reason)))
             failures += 1
             continue
         rc = orchestrate([*argv, *extra], skip_probe=True)
         failures += 1 if rc else 0
-        if rc and argv[0] not in ("io", "router", "replay") \
+        if rc and argv[0] not in ("io", "router", "replay", "chaos") \
                 and "--smoke" not in extra and backend_ok:
             # A device workload just failed mid-matrix. The usual cause in
             # this environment is the tunnel dying UNDER the matrix (it
@@ -2461,7 +2540,7 @@ def orchestrate(argv, skip_probe: bool = False) -> int:
     # don't let a down backend block the benches that don't need it.
     # --smoke runs pin the CPU fake slice (the --run child forces the
     # platform), so a down tunnel must not block them either.
-    if (workload not in ("io", "router", "replay") and "--smoke" not in argv
+    if (workload not in ("io", "router", "replay", "chaos") and "--smoke" not in argv
             and not skip_probe and not probe_backend()):
         print(json.dumps(_error_json(
             list(argv), "probe",
@@ -2491,7 +2570,7 @@ def orchestrate(argv, skip_probe: bool = False) -> int:
         except subprocess.TimeoutExpired:
             last = f"bench run timed out after {RUN_TIMEOUT_S}s"
             log(f"[run {attempt + 1}/{RUN_ATTEMPTS}] {last}")
-            if (workload not in ("io", "router", "replay")
+            if (workload not in ("io", "router", "replay", "chaos")
                     and "--smoke" not in argv
                     and attempt < RUN_ATTEMPTS - 1):
                 # A full-RUN_TIMEOUT_S hang usually means the tunnel died
@@ -2604,6 +2683,8 @@ def run_bench(argv) -> dict:
         return bench_router(smoke=smoke)
     if workload == "replay":
         return bench_replay(smoke=smoke)
+    if workload == "chaos":
+        return bench_chaos(smoke=smoke)
     if workload == "cb":
         if "--chunked-prefill" in argv:
             return bench_chunked_prefill(smoke=smoke)

@@ -1,0 +1,36 @@
+"""System-wide deterministic fault injection (the chaos plane).
+
+The train plane had the only real fault injector (PR 3 lifted it into
+the serving driver loop); everything else — the router's transport and
+health prober, the BundleServer request front, the engine's device
+steps, checkpoint IO, the pipeline's publish path — was tested on
+sunny-day paths only. This package is the shared layer:
+
+* :mod:`~pyspark_tf_gke_tpu.chaos.inject` — named fault points +
+  seed-deterministic injectors (``ChaosInjector``), a process-global
+  install, and the lifted train-plane :class:`FaultInjector`;
+* :mod:`~pyspark_tf_gke_tpu.chaos.spec` — the versioned chaos-schedule
+  spec (sibling of ``replay/spec.py``): scheduled process-level
+  kill/stop/restart actions plus launch-time in-process injections;
+* :mod:`~pyspark_tf_gke_tpu.chaos.runner` — executes a schedule against
+  a ``router/localfleet.py`` fleet while a replay drives traffic
+  (``tools/replay.py run --chaos``);
+* :mod:`~pyspark_tf_gke_tpu.chaos.invariants` — the post-scenario
+  checker: every submitted request reached exactly one terminal
+  outcome, zero stuck slots, KV-page refcounts and pool occupancy back
+  at baseline.
+
+Everything here is stdlib-only and jax-free: the router and the replay
+driver import it without a device runtime.
+"""
+
+from pyspark_tf_gke_tpu.chaos.inject import (  # noqa: F401
+    FAULT_POINTS,
+    ChaosInjector,
+    FaultInjector,
+    InjectedFault,
+    chaos_fire,
+    get_injector,
+    install,
+    uninstall,
+)

@@ -1,0 +1,271 @@
+"""Post-scenario invariant checker: the durability contract, verified.
+
+THE invariant every chaos scenario must close on: **every submitted
+request reaches exactly one terminal outcome (ok | shed | deadline |
+error | cancelled) under any single injected fault** — no silent
+drops, no double terminals — and the serving state returns to
+baseline: zero stuck slots, the KV page pool fully accounted (every
+refcount owned by a live slot, an in-flight admission or the radix
+trie; free + referenced == total; no page both free and referenced),
+no admission wedged mid-flight.
+
+Four check surfaces, composable:
+
+* :func:`check_engine` / :func:`check_front` — in-process, against a
+  live (quiesced) ``ContinuousEngine`` / ``_ContinuousFront``: the
+  refcount discipline audited directly (tests drive faults and then
+  call these; a DELIBERATELY leaked ref must fail — the checker has
+  true-positive tests of its own).
+* :func:`check_replica` — over HTTP against a live replica
+  (``/loadz`` + ``/healthz``): the post-scenario gate
+  ``tools/replay.py run --chaos`` and ``smoke_check --chaos`` apply to
+  every surviving replica.
+* :func:`check_traces` — over a ``/traces`` export (the PR 9 flight
+  recorder): every request span carries EXACTLY one terminal verdict
+  (a ``terminal`` event, or a ``shed`` event for requests the
+  admission gates turned away).
+* :func:`check_report` — over a replay report: one terminal outcome
+  per replayed request, client-side.
+
+Every function returns ``{"ok": bool, "violations": [str, ...]}`` and
+never raises on malformed input — a checker that crashes mid-scenario
+reads as a pass to a shell ``&&`` chain.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Dict, List
+
+# the complete terminal vocabulary: ok/deadline from the engine's state
+# transitions, shed from the admission gates, error from rebuild /
+# watchdog / transport paths, cancelled from client abandonment
+TERMINAL_OUTCOMES = ("ok", "shed", "deadline", "error", "cancelled")
+
+
+def _result(violations: List[str], **extra) -> dict:
+    return {"ok": not violations, "violations": violations, **extra}
+
+
+# -- in-process ---------------------------------------------------------------
+
+
+def check_engine(engine) -> dict:
+    """Baseline invariants of a quiesced ``ContinuousEngine``.
+
+    Call after the scenario drains (queue empty, no live requests):
+    anything still occupied is a stuck slot / wedged admission, and the
+    page-pool accounting must balance to the page regardless of which
+    crash paths ran."""
+    v: List[str] = []
+    try:
+        if engine._queue:
+            v.append(f"{len(engine._queue)} request(s) stuck in the "
+                     "admission queue")
+        if engine._slots:
+            v.append(f"stuck slot(s): {sorted(engine._slots)}")
+        if engine._admitting is not None:
+            v.append("piecewise admission wedged in flight "
+                     f"(rid {engine._admitting['req'].rid})")
+        if engine._inflight_q:
+            v.append(f"{len(engine._inflight_q)} dispatched chunk(s) "
+                     "never collected")
+        if not engine.paged:
+            return _result(v)
+        total = engine.model.cfg.kv_num_pages
+        refs = dict(engine._page_refs)
+        free = list(engine._free_pages)
+        # expected refcounts: one per page per owner (slot pages, the
+        # trie's indexed pages; a quiesced engine has no admission
+        # holds left)
+        expected: Dict[int, int] = {}
+        for pages in engine._slot_pages.values():
+            for p in pages:
+                expected[p] = expected.get(p, 0) + 1
+        if engine.radix is not None:
+            for p in engine.radix.indexed_pages():
+                expected[p] = expected.get(p, 0) + 1
+        if refs != expected:
+            extra = {p: n for p, n in refs.items()
+                     if n != expected.get(p, 0)}
+            missing = {p: n for p, n in expected.items()
+                       if n != refs.get(p, 0)}
+            v.append(f"page refcounts off baseline: held={extra} "
+                     f"expected={missing}")
+        leaked = set(free) & set(refs)
+        if leaked:
+            v.append(f"page(s) both free and referenced: "
+                     f"{sorted(leaked)}")
+        if len(free) != len(set(free)):
+            v.append("duplicate pages on the free list")
+        if len(set(free)) + len(refs) != total and not leaked:
+            v.append(f"pages lost: {len(set(free))} free + "
+                     f"{len(refs)} referenced != {total} total")
+        cache_pages = (engine.radix.resident_pages
+                       if engine.radix is not None else 0)
+        in_use = total - len(set(free))
+        if in_use != cache_pages and refs == expected and not leaked:
+            v.append(f"pool occupancy off baseline: {in_use} in use "
+                     f"but only {cache_pages} cache-resident")
+    except Exception as exc:  # noqa: BLE001 — a checker crash must be
+        v.append(f"checker error: {type(exc).__name__}: {exc}")  # loud
+    return _result(v)
+
+
+def check_front(front) -> dict:
+    """Engine invariants + the front's waiter table: no request handle
+    left undelivered (a waiter with no result and no terminal is a
+    silent drop in progress)."""
+    out = check_engine(front.engine)
+    v = list(out["violations"])
+    try:
+        pending = [rid for rid, slot in front._results.items()
+                   if slot[1] is None and not slot[0].is_set()]
+        if pending:
+            v.append(f"undelivered waiter(s): {pending}")
+    except Exception as exc:  # noqa: BLE001
+        v.append(f"checker error: {type(exc).__name__}: {exc}")
+    return _result(v)
+
+
+# -- over HTTP ----------------------------------------------------------------
+
+
+def check_replica(base_url: str, timeout_s: float = 10.0) -> dict:
+    """Post-scenario gate against a LIVE replica: quiesced queue/slots,
+    pool occupancy equal to the prefix cache's residency (pages held
+    only by the trie), no wedged admission. Uses only /loadz +
+    /healthz — the same surfaces the router scores on."""
+    v: List[str] = []
+    base_url = base_url.rstrip("/")
+    try:
+        with urllib.request.urlopen(base_url + "/loadz",
+                                    timeout=timeout_s) as resp:
+            lz = json.loads(resp.read())
+        with urllib.request.urlopen(base_url + "/healthz",
+                                    timeout=timeout_s) as resp:
+            hz = json.loads(resp.read())
+    except Exception as exc:  # noqa: BLE001
+        return _result([f"replica unreachable: "
+                        f"{type(exc).__name__}: {exc}"], url=base_url)
+    if lz.get("queued"):
+        v.append(f"{lz['queued']} request(s) stuck queued")
+    if lz.get("active"):
+        v.append(f"{lz['active']} stuck slot(s)")
+    stats = hz.get("continuous") or {}
+    if stats.get("admitting") is not None:
+        v.append(f"admission wedged (rid {stats['admitting']})")
+    if stats.get("inflight"):
+        v.append("dispatched chunk(s) never collected")
+    paged = stats.get("paged")
+    if paged:
+        cache = stats.get("prefix_cache") or {}
+        resident = int(cache.get("resident_pages", 0))
+        in_use = int(paged.get("pages_in_use", 0))
+        if in_use != resident:
+            v.append(f"pool occupancy off baseline: {in_use} pages in "
+                     f"use, {resident} cache-resident")
+    return _result(v, url=base_url)
+
+
+# -- over the flight recorder -------------------------------------------------
+
+
+def _iter_traces(traces):
+    """Accept a /traces JSON body ({"traces": [...]}), a bare list, or
+    a jsonl bytes/str export — one dict per trace either way."""
+    if isinstance(traces, (bytes, str)):
+        text = traces.decode() if isinstance(traces, bytes) else traces
+        out = []
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+        return out
+    if isinstance(traces, dict):
+        return list(traces.get("traces") or [])
+    return list(traces or [])
+
+
+def check_traces(traces) -> dict:
+    """Exactly one terminal verdict per REQUEST SPAN.
+
+    A request span is any span carrying the replay shape contract
+    (``prompt_tokens`` attr — stamped by the serve front before the
+    admission gates and by the engine at submit, so shed demand counts
+    too). Its verdict is a ``terminal`` event (engine state
+    transitions: ok | deadline | error | cancelled) or a ``shed``
+    event (admission gates). Zero verdicts = a silent drop; more than
+    one = a double delivery. Canary (``__internal__``) spans are
+    exempt from the shed check but still must not double-terminal."""
+    v: List[str] = []
+    checked = 0
+    try:
+        for trace in _iter_traces(traces):
+            for span in trace.get("spans") or []:
+                attrs = span.get("attrs") or {}
+                if "prompt_tokens" not in attrs:
+                    continue
+                checked += 1
+                terminals = [e for e in span.get("events") or []
+                             if e.get("name") == "terminal"]
+                sheds = [e for e in span.get("events") or []
+                         if e.get("name") == "shed"]
+                tid = trace.get("trace_id", "?")
+                n = len(terminals) + len(sheds)
+                if n == 0:
+                    v.append(f"trace {tid}: request span has NO "
+                             "terminal verdict (silent drop)")
+                elif n > 1:
+                    v.append(
+                        f"trace {tid}: request span has {n} terminal "
+                        f"verdicts ({[e['name'] for e in terminals]} + "
+                        f"{len(sheds)} shed)")
+                for e in terminals:
+                    if e.get("outcome") not in TERMINAL_OUTCOMES:
+                        v.append(f"trace {tid}: unknown terminal "
+                                 f"outcome {e.get('outcome')!r}")
+    except Exception as exc:  # noqa: BLE001
+        v.append(f"checker error: {type(exc).__name__}: {exc}")
+    return _result(v, request_spans=checked)
+
+
+# -- over a replay report -----------------------------------------------------
+
+
+def check_report(report: dict, n_expected: int) -> dict:
+    """Client-side closure: every replayed request reached exactly one
+    terminal outcome (the driver's accounting sums to the spec)."""
+    v: List[str] = []
+    try:
+        outcomes = dict(report.get("outcomes") or {})
+        total = sum(outcomes.values())
+        if total != n_expected:
+            v.append(f"{n_expected - total} request(s) never reached a "
+                     f"terminal outcome (outcomes: {outcomes})")
+        unknown = set(outcomes) - set(TERMINAL_OUTCOMES)
+        if unknown:
+            v.append(f"unknown outcome class(es): {sorted(unknown)}")
+    except Exception as exc:  # noqa: BLE001
+        v.append(f"checker error: {type(exc).__name__}: {exc}")
+    return _result(v)
+
+
+def goodput_windows(report: dict, edges: List[float]) -> List[dict]:
+    """Windowed ok-rate over a replay report's per-request records
+    (requires ``include_requests=True``): requests bucketed by their
+    spec offset into ``[edges[i], edges[i+1])`` windows — the
+    goodput-recovery read a replica-kill scenario asserts on (ok-rate
+    before the kill, through it, after the restart)."""
+    reqs = report.get("requests") or []
+    out = []
+    for lo, hi in zip(edges, edges[1:]):
+        win = [r for r in reqs
+               if r.get("offset_s") is not None
+               and lo <= float(r["offset_s"]) < hi]
+        ok = sum(1 for r in win if r.get("outcome") == "ok")
+        out.append({"from_s": lo, "to_s": hi, "requests": len(win),
+                    "ok": ok,
+                    "ok_rate": round(ok / len(win), 4) if win else None})
+    return out

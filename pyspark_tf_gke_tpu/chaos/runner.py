@@ -1,0 +1,193 @@
+"""Execute a chaos schedule against a local fleet while traffic plays.
+
+The runner owns only the PROCESS-LEVEL events (kill/stop/restart);
+``inject`` events were already applied at launch via each process's
+``--chaos`` flag (:meth:`ChaosSchedule.launch_injections` — the caller
+threads them into the fleet's ``replica_args``/``router_args``).
+
+Timing is wall-clock relative to :meth:`ScheduleRunner.start` — start
+it at the same instant the replay driver's clock starts, and a
+``kill@2.0s`` lands two seconds into the scenario, every run. Each
+executed action is recorded (``actions``), emitted on the event trail
+(``chaos_action``) and counted (``chaos_actions_total{action}``), so a
+scenario can assert its faults actually happened — a chaos run that
+injected nothing must fail loudly, not pass vacuously.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from pyspark_tf_gke_tpu.chaos.spec import ChaosEvent, ChaosSchedule
+from pyspark_tf_gke_tpu.utils.logging import get_logger
+
+logger = get_logger("chaos.runner")
+
+
+def _target_indices(target: str, n_replicas: int) -> List[int]:
+    idx = target.partition(":")[2]
+    if idx == "*":
+        return list(range(n_replicas))
+    return [int(idx)]
+
+
+class ScheduleRunner:
+    """Background executor for one schedule against one
+    ``router/localfleet.LocalFleet``. Use as a context manager around
+    the replay call::
+
+        with ScheduleRunner(schedule, fleet):
+            report = replay_spec(spec, fleet.url, ...)
+        acted = runner.actions  # what actually fired, with wall times
+
+    Exit joins the thread (remaining events run to completion — a
+    scheduled SIGCONT must never be skipped or a replica stays frozen)
+    and SIGCONTs/restarts anything the schedule left down unless
+    ``heal_on_exit=False``.
+    """
+
+    def __init__(self, schedule: ChaosSchedule, fleet,
+                 speedup: float = 1.0, heal_on_exit: bool = True):
+        if speedup <= 0:
+            raise ValueError("speedup must be > 0")
+        self.schedule = schedule.validate()
+        self.fleet = fleet
+        self.speedup = float(speedup)
+        self.heal_on_exit = bool(heal_on_exit)
+        self.actions: List[dict] = []
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._t0: Optional[float] = None
+        self._abort = threading.Event()
+        self._stopped: set = set()   # replica idx currently SIGSTOPped
+        self._killed: set = set()    # replica idx killed, not restarted
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "ScheduleRunner":
+        self._t0 = time.monotonic()
+        self._thread = threading.Thread(target=self._run,
+                                        name="chaos-runner", daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout_s: float = 120.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    def __enter__(self) -> "ScheduleRunner":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.join()
+        if self._thread is not None and self._thread.is_alive():
+            # a schedule with events far past the traffic window must
+            # not keep mutating the fleet (or self.actions) after the
+            # context exits — abort the remainder; heal() below takes
+            # over the SIGCONTs/restarts the aborted tail owed
+            self._abort.set()
+            self._thread.join(timeout=10)
+        if self.heal_on_exit:
+            self.heal()
+
+    def heal(self) -> None:
+        """Bring every schedule-downed replica back (SIGCONT + restart)
+        so post-scenario invariant checks see a live fleet."""
+        for i in sorted(self._stopped):
+            try:
+                self.fleet.cont_replica(i)
+            except Exception:  # noqa: BLE001 — already dead is fine
+                pass
+        self._stopped.clear()
+        for i in sorted(self._killed):
+            try:
+                self.fleet.restart_replica(i)
+                self._note("restart", f"replica:{i}", healed=True)
+            except Exception:  # noqa: BLE001
+                logger.exception("heal restart of replica %d failed", i)
+        self._killed.clear()
+
+    # -- execution --------------------------------------------------------
+
+    def _note(self, action: str, target: str, **extra) -> None:
+        rec = {"action": action, "target": target,
+               "at_s": round(time.monotonic() - self._t0, 3), **extra}
+        with self._lock:
+            self.actions.append(rec)
+        try:
+            from pyspark_tf_gke_tpu.obs.events import get_event_log
+            from pyspark_tf_gke_tpu.obs.metrics import chaos_families
+
+            chaos_families()["chaos_actions_total"].labels(
+                action=action).inc()
+            get_event_log().emit("chaos_action", **rec)
+        except Exception:  # noqa: BLE001 — accounting must not stop
+            pass           # the chaos
+
+    def _run(self) -> None:
+        pending: List[tuple] = []  # (due_s, seq, fn) — seq breaks ties
+        seq = 0
+        for ev in self.schedule.process_events():
+            pending.append((ev.offset_s / self.speedup, seq,
+                            self._make_action(ev)))
+            seq += 1
+            # a kill with restart_s schedules its own relaunch; a stop
+            # schedules its SIGCONT — both as first-class entries so
+            # join() can never exit with a replica frozen mid-schedule
+            if ev.action == "kill" and ev.restart_s is not None:
+                pending.append((
+                    (ev.offset_s + ev.restart_s) / self.speedup, seq,
+                    self._make_restart(ev)))
+                seq += 1
+            if ev.action == "stop":
+                pending.append((
+                    (ev.offset_s + ev.duration_s) / self.speedup, seq,
+                    self._make_cont(ev)))
+                seq += 1
+        pending.sort(key=lambda p: (p[0], p[1]))
+        for due_s, _, fn in pending:
+            delay = self._t0 + due_s - time.monotonic()
+            if delay > 0 and self._abort.wait(delay):
+                return  # context exited: heal() owns the cleanup
+            if self._abort.is_set():
+                return
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — one failed action must
+                logger.exception("chaos action failed")  # not end the run
+
+    def _make_action(self, ev: ChaosEvent):
+        def act():
+            for i in _target_indices(ev.target, self.fleet.n_replicas):
+                if ev.action == "kill":
+                    self.fleet.kill_replica(i)
+                    self._killed.add(i)
+                    self._note("kill", f"replica:{i}")
+                elif ev.action == "stop":
+                    self.fleet.stop_replica(i)
+                    self._stopped.add(i)
+                    self._note("stop", f"replica:{i}",
+                               duration_s=ev.duration_s)
+                elif ev.action == "restart":
+                    self.fleet.restart_replica(i)
+                    self._killed.discard(i)
+                    self._note("restart", f"replica:{i}")
+        return act
+
+    def _make_restart(self, ev: ChaosEvent):
+        def act():
+            for i in _target_indices(ev.target, self.fleet.n_replicas):
+                self.fleet.restart_replica(i)
+                self._killed.discard(i)
+                self._note("restart", f"replica:{i}")
+        return act
+
+    def _make_cont(self, ev: ChaosEvent):
+        def act():
+            for i in _target_indices(ev.target, self.fleet.n_replicas):
+                self.fleet.cont_replica(i)
+                self._stopped.discard(i)
+                self._note("cont", f"replica:{i}")
+        return act

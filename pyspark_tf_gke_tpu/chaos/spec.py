@@ -1,0 +1,278 @@
+"""The versioned chaos-schedule spec: one JSONL file = one scenario.
+
+Sibling of ``replay/spec.py`` (same header-line + one-event-per-line
+shape, same sorted-offset discipline) so a chaos scenario composes with
+a workload spec: ``tools/replay.py run --chaos chaos.jsonl`` drives the
+replay clock and this schedule against the SAME local fleet, killing /
+stopping / restarting replicas at scheduled offsets while the workload
+plays.
+
+Two event classes:
+
+* **Process-level** (``kill`` / ``stop`` / ``restart``) — executed by
+  :mod:`~pyspark_tf_gke_tpu.chaos.runner` against a
+  ``router/localfleet.py`` fleet at their ``offset_s``. ``stop`` is
+  SIGSTOP for ``duration_s`` then SIGCONT: the local stand-in for both
+  a hung host AND a network partition (the process is alive but
+  unreachable — probes time out, streams stall). ``kill`` is SIGKILL;
+  ``restart_s`` relaunches the replica that many seconds later (the
+  goodput-recovery proof).
+* **In-process** (``inject``) — a :class:`ChaosInjector` spec string
+  applied AT LAUNCH via the target's ``--chaos`` flag (offset must be
+  0: count-based rules are the deterministic mechanism inside a
+  process; the schedule cannot reach into a running one). Targets:
+  ``replica:N`` / ``replica:*`` / ``router``.
+
+Determinism: :func:`synth_chaos` derives every offset from an explicit
+seeded mixer — same seed ⇒ byte-identical schedule ⇒ same fired
+faults, which is what makes a chaos run a regression test instead of a
+dice roll.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+from pyspark_tf_gke_tpu.chaos.inject import ChaosInjector
+
+SCHEDULE_KIND = "pyspark_tf_gke_tpu.chaos_schedule"
+SCHEDULE_VERSION = 1
+
+_ACTIONS = ("kill", "stop", "restart", "inject")
+
+
+def _parse_target(target: str) -> None:
+    if target == "router":
+        return
+    kind, sep, idx = target.partition(":")
+    if kind != "replica" or not sep:
+        raise ValueError(
+            f"target {target!r}: want 'router', 'replica:N' or "
+            "'replica:*'")
+    if idx != "*":
+        int(idx)  # raises on garbage
+
+
+@dataclasses.dataclass
+class ChaosEvent:
+    """One scheduled action against the fleet."""
+
+    offset_s: float
+    action: str
+    target: str
+    duration_s: float = 0.0   # stop: SIGCONT after this long
+    restart_s: Optional[float] = None  # kill: relaunch after this long
+    spec: str = ""            # inject: ChaosInjector spec string
+
+    def to_dict(self) -> dict:
+        d = {"offset_s": round(float(self.offset_s), 6),
+             "action": self.action, "target": self.target}
+        if self.duration_s:
+            d["duration_s"] = round(float(self.duration_s), 6)
+        if self.restart_s is not None:
+            d["restart_s"] = round(float(self.restart_s), 6)
+        if self.spec:
+            d["spec"] = self.spec
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChaosEvent":
+        return cls(
+            offset_s=float(d["offset_s"]),
+            action=str(d["action"]),
+            target=str(d["target"]),
+            duration_s=float(d.get("duration_s", 0.0)),
+            restart_s=(float(d["restart_s"])
+                       if d.get("restart_s") is not None else None),
+            spec=str(d.get("spec", "")),
+        )
+
+    def validate(self, i: int) -> None:
+        if self.offset_s < 0:
+            raise ValueError(f"event {i}: offset_s must be >= 0")
+        if self.action not in _ACTIONS:
+            raise ValueError(
+                f"event {i}: unknown action {self.action!r} "
+                f"(known: {_ACTIONS})")
+        _parse_target(self.target)
+        if self.action == "stop" and self.duration_s <= 0:
+            raise ValueError(
+                f"event {i}: stop needs duration_s > 0 (SIGCONT time)")
+        if self.action == "inject":
+            if self.offset_s != 0:
+                raise ValueError(
+                    f"event {i}: inject applies at LAUNCH — offset_s "
+                    "must be 0 (in-process rules are count-based; the "
+                    "schedule cannot reach into a running process)")
+            if not self.spec:
+                raise ValueError(f"event {i}: inject needs a spec")
+            # parse now: a typo'd point must fail at save/load, not
+            # silently never fire mid-scenario
+            ChaosInjector.from_spec(self.spec)
+        if self.action in ("kill", "stop", "restart") \
+                and self.target == "router":
+            raise ValueError(
+                f"event {i}: process actions target replicas (the "
+                "router under test must survive to prove recovery); "
+                "use an inject rule to fault the router in-process")
+
+
+@dataclasses.dataclass
+class ChaosSchedule:
+    """A named, seeded sequence of chaos events."""
+
+    name: str
+    events: List[ChaosEvent]
+    seed: int = 0
+    meta: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    def validate(self) -> "ChaosSchedule":
+        prev = 0.0
+        for i, ev in enumerate(self.events):
+            ev.validate(i)
+            if ev.offset_s < prev:
+                raise ValueError(
+                    f"event {i}: offsets must be non-decreasing "
+                    f"({ev.offset_s} after {prev})")
+            prev = ev.offset_s
+        return self
+
+    @property
+    def duration_s(self) -> float:
+        out = 0.0
+        for ev in self.events:
+            end = ev.offset_s + max(ev.duration_s, ev.restart_s or 0.0)
+            out = max(out, end)
+        return out
+
+    def launch_injections(self) -> Dict[str, str]:
+        """target → combined injector spec for every ``inject`` event
+        (applied via ``--chaos`` at process launch)."""
+        out: Dict[str, List[str]] = {}
+        for ev in self.events:
+            if ev.action == "inject":
+                out.setdefault(ev.target, []).append(ev.spec)
+        return {t: ",".join(specs) for t, specs in out.items()}
+
+    def process_events(self) -> List[ChaosEvent]:
+        """The scheduled (non-inject) actions, offset-sorted."""
+        return [ev for ev in self.events if ev.action != "inject"]
+
+    # -- persistence ------------------------------------------------------
+
+    def save(self, path: str) -> str:
+        self.events.sort(key=lambda ev: ev.offset_s)
+        self.validate()
+        header = {"kind": SCHEDULE_KIND, "version": SCHEDULE_VERSION,
+                  "name": self.name, "seed": int(self.seed),
+                  "meta": self.meta, "n_events": len(self.events)}
+        with open(path, "w") as fh:
+            fh.write(json.dumps(header, sort_keys=True) + "\n")
+            for ev in self.events:
+                fh.write(json.dumps(ev.to_dict(), sort_keys=True) + "\n")
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "ChaosSchedule":
+        with open(path) as fh:
+            lines = [ln for ln in fh.read().splitlines() if ln.strip()]
+        if not lines:
+            raise ValueError(f"{path}: empty chaos schedule")
+        header = json.loads(lines[0])
+        if header.get("kind") != SCHEDULE_KIND:
+            raise ValueError(
+                f"{path}: not a chaos schedule (kind="
+                f"{header.get('kind')!r}; expected {SCHEDULE_KIND!r})")
+        if int(header.get("version", -1)) != SCHEDULE_VERSION:
+            raise ValueError(
+                f"{path}: schedule version {header.get('version')!r} "
+                f"not supported (this build reads "
+                f"{SCHEDULE_VERSION})")
+        sched = cls(name=str(header.get("name", "unnamed")),
+                    seed=int(header.get("seed", 0)),
+                    meta=dict(header.get("meta") or {}),
+                    events=[ChaosEvent.from_dict(json.loads(ln))
+                            for ln in lines[1:]])
+        return sched.validate()
+
+
+# -- seeded synthesis ---------------------------------------------------------
+
+
+def _mix(seed: int, *parts) -> float:
+    """Deterministic U[0,1) from (seed, parts) — one draw off the
+    shared replay/chaos mixer (``replay/spec.py``
+    ``seeded_unit_stream``), so nothing environmental feeds schedule
+    timing and the planes' determinism cannot drift apart by copy."""
+    from pyspark_tf_gke_tpu.replay.spec import seeded_unit_stream
+
+    return next(seeded_unit_stream(
+        ":".join(str(p) for p in (seed,) + parts)))
+
+
+def synth_chaos(kind: str, *, seed: int = 0, duration_s: float = 10.0,
+                replicas: int = 2, name: Optional[str] = None,
+                **params) -> ChaosSchedule:
+    """Seeded scenario generator — same seed ⇒ identical schedule.
+
+    Kinds:
+
+    * ``kill_one`` — SIGKILL one replica mid-window (jittered around
+      the middle), relaunch ``restart_s`` (default duration/4) later:
+      THE replica-kill-mid-stream + goodput-recovery scenario.
+    * ``hang_one`` — SIGSTOP one replica for ``hang_s`` (default
+      duration/4) mid-window: the partition / hung-host shape.
+    * ``flaky_probes`` — launch-time router injection failing each
+      health probe w.p. ``prob`` (default 0.2): scheduled health
+      flapping.
+    * ``storm`` — ``n_events`` (default 3) seeded kill/stop events
+      spread over the window, round-robin across replicas.
+    """
+    events: List[ChaosEvent] = []
+    if kind == "kill_one":
+        victim = int(_mix(seed, "victim") * replicas) % replicas
+        at = duration_s * (0.35 + 0.3 * _mix(seed, "at"))
+        restart_s = float(params.pop("restart_s", duration_s / 4))
+        events.append(ChaosEvent(offset_s=at, action="kill",
+                                 target=f"replica:{victim}",
+                                 restart_s=restart_s))
+    elif kind == "hang_one":
+        victim = int(_mix(seed, "victim") * replicas) % replicas
+        at = duration_s * (0.35 + 0.3 * _mix(seed, "at"))
+        hang_s = float(params.pop("hang_s", duration_s / 4))
+        events.append(ChaosEvent(offset_s=at, action="stop",
+                                 target=f"replica:{victim}",
+                                 duration_s=hang_s))
+    elif kind == "flaky_probes":
+        prob = float(params.pop("prob", 0.2))
+        events.append(ChaosEvent(
+            offset_s=0.0, action="inject", target="router",
+            spec=f"seed={seed},router.probe:fail%{prob:g}"))
+    elif kind == "storm":
+        n = int(params.pop("n_events", 3))
+        for i in range(n):
+            at = duration_s * (0.15 + 0.7 * _mix(seed, "storm", i))
+            victim = i % replicas
+            if _mix(seed, "storm_kind", i) < 0.5:
+                events.append(ChaosEvent(
+                    offset_s=at, action="kill",
+                    target=f"replica:{victim}",
+                    restart_s=duration_s / 5))
+            else:
+                events.append(ChaosEvent(
+                    offset_s=at, action="stop",
+                    target=f"replica:{victim}",
+                    duration_s=duration_s / 5))
+    else:
+        raise ValueError(
+            f"unknown chaos kind {kind!r} (known: kill_one, hang_one, "
+            "flaky_probes, storm)")
+    if params:
+        raise ValueError(f"unknown synth_chaos params: {sorted(params)}")
+    events.sort(key=lambda ev: ev.offset_s)
+    return ChaosSchedule(
+        name=name or f"{kind}-s{seed}", seed=seed, events=events,
+        meta={"kind": kind, "duration_s": duration_s,
+              "replicas": replicas}).validate()

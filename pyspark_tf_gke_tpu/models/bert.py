@@ -176,11 +176,31 @@ class FusedLayerNorm(nn.Module):
             return y.astype(self.dtype)
         if residual is not None:
             x = x + residual
-        xf = x.astype(jnp.float32)
+        # Row-wise math stays on the BATCH sharding end to end: the
+        # mean/variance broadcasts back to x's shape would otherwise
+        # inherit the consumer matmul's contracting-dim (embed over
+        # fsdp, transposed device order) sharding through propagation,
+        # a reshard current XLA can only do by involuntary full
+        # rematerialization (the regression oracle in
+        # tests/test_embedding.py). Pinning the broadcast results makes
+        # the one reshard happen on the LN OUTPUT, an ordinary tensor.
+        def pin(t):
+            from jax.interpreters import pxla
+            from jax.sharding import NamedSharding
+
+            mesh = pxla.thread_resources.env.physical_mesh
+            if mesh is None or mesh.empty:
+                return t
+            return jax.lax.with_sharding_constraint(
+                t, NamedSharding(
+                    mesh, P(DATA_AXES, *([None] * (t.ndim - 1)))))
+
+        xf = pin(x.astype(jnp.float32))
         mean = xf.mean(-1, keepdims=True)
-        xc = xf - mean
+        xc = pin(xf - mean)
         var = (xc * xc).mean(-1, keepdims=True)
-        y = xc * jax.lax.rsqrt(var + self.epsilon) * scale[None, :] + bias[None, :]
+        y = pin(xc * jax.lax.rsqrt(var + self.epsilon)) * scale[None, :] \
+            + bias[None, :]
         return y.astype(self.dtype)
 
 

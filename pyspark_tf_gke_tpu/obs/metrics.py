@@ -530,6 +530,13 @@ def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
         "serve_engine_rebuilds_total": r.counter(
             "serve_engine_rebuilds_total",
             "Slot-engine rebuilds after a failed device step"),
+        "serve_step_watchdog_reaps_total": r.counter(
+            "serve_step_watchdog_reaps_total",
+            "Step-watchdog interventions: an engine step exceeded "
+            "--step-timeout (hung/failed device dispatch), so every "
+            "in-flight waiter was failed with an explicit error "
+            "terminal and the engine rebuilds when the step returns — "
+            "bounded request latency instead of a wedged loop"),
         # chunked prefill / token-level scheduling
         "serve_tbt_ms": r.histogram(
             "serve_tbt_ms",
@@ -793,4 +800,32 @@ def replay_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "Fraction of the last replay's requests that completed OK "
             "within their deadline — THE trace-replay serving metric "
             "(DistServe/Mooncake's SLO attainment)"),
+    }
+
+
+def chaos_families(registry: Optional[MetricsRegistry] = None) -> dict:
+    """Register (idempotently) the chaos plane's metric families.
+
+    The fault-injection layer (``pyspark_tf_gke_tpu/chaos/``) counts
+    every fired in-process fault and every schedule-driven process
+    action here, so a chaos scenario's injections and the recoveries
+    they forced (engine rebuilds, reroutes, watchdog reaps) correlate
+    on one scrape. Defined here so the whole platform's metric names
+    keep one definition site and the duplicate-name lint covers
+    them."""
+    r = registry if registry is not None else get_registry()
+    return {
+        "fault_injections_total": r.counter(
+            "fault_injections_total",
+            "In-process faults fired by the installed ChaosInjector, "
+            "by named fault point and action (fail | slow | hang) — "
+            "zero in production, where no injector is ever installed",
+            labelnames=("point", "action")),
+        "chaos_actions_total": r.counter(
+            "chaos_actions_total",
+            "Process-level chaos-schedule actions executed against a "
+            "local fleet (kill | stop | cont | restart) — the "
+            "schedule runner's accounting, asserted non-vacuous by "
+            "every scenario",
+            labelnames=("action",)),
     }

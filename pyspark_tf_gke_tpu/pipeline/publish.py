@@ -25,6 +25,7 @@ import urllib.error
 import urllib.request
 from typing import List, Optional, Sequence
 
+from pyspark_tf_gke_tpu.chaos.inject import chaos_fire
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
 logger = get_logger("pipeline.publish")
@@ -52,6 +53,11 @@ def reload_replica(base_url: str, bundle_dir: str, generation: int,
         headers={"Content-Type": "application/json",
                  **({"X-Admin-Token": token} if token else {})})
     try:
+        # chaos: the publish fault point, INSIDE the try — an injected
+        # failure lands as ok=False exactly like a transport failure,
+        # so rolling_publish's stop-the-rollout and the coordinator's
+        # resume-at-the-publish-stage run their REAL paths
+        chaos_fire("pipeline.publish", replica=base_url)
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
             return {"ok": True, "status": resp.status,
                     "body": _read_json(resp)}

@@ -42,11 +42,16 @@ def _classify_error_text(text: str) -> str:
 class _RequestResult:
     __slots__ = ("index", "tenant", "status", "outcome", "reason",
                  "ttft_ms", "latency_ms", "tokens_out", "deadline_ms",
-                 "sched_lag_ms", "tbt_ms")
+                 "sched_lag_ms", "tbt_ms", "offset_s")
 
-    def __init__(self, index: int, tenant: str, deadline_ms):
+    def __init__(self, index: int, tenant: str, deadline_ms,
+                 offset_s: float = 0.0):
         self.index = index
         self.tenant = tenant
+        # the request's SPEC offset (scenario clock, unscaled) — what
+        # windowed post-analysis (chaos goodput-recovery reads) buckets
+        # outcomes by
+        self.offset_s = float(offset_s)
         self.status = 0
         self.outcome = "error"
         self.reason: Optional[str] = None
@@ -59,6 +64,7 @@ class _RequestResult:
 
     def to_dict(self) -> dict:
         return {"i": self.index, "tenant": self.tenant,
+                "offset_s": round(self.offset_s, 6),
                 "status": self.status, "outcome": self.outcome,
                 "reason": self.reason,
                 "ttft_ms": (round(self.ttft_ms, 3)
@@ -205,7 +211,8 @@ def replay_spec(spec: WorkloadSpec, base_url: str, *,
 
     fams = replay_families(registry)
     base_url = base_url.rstrip("/")
-    results = [_RequestResult(i, r.tenant, r.deadline_ms)
+    results = [_RequestResult(i, r.tenant, r.deadline_ms,
+                              offset_s=r.offset_s)
                for i, r in enumerate(spec.requests)]
     prompts = [build_prompt(spec, i) for i in range(len(spec.requests))]
     fire = _fire_stream if stream else _fire_blocking
@@ -235,7 +242,8 @@ def replay_spec(spec: WorkloadSpec, base_url: str, *,
             # its thread still mutates — the report must never
             # aggregate a result another thread is writing
             res = _RequestResult(i, spec.requests[i].tenant,
-                                 spec.requests[i].deadline_ms)
+                                 spec.requests[i].deadline_ms,
+                                 offset_s=spec.requests[i].offset_s)
             res.outcome = "error"
             res.reason = "driver_timeout"
             res.sched_lag_ms = results[i].sched_lag_ms

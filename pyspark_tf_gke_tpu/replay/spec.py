@@ -209,24 +209,38 @@ class WorkloadSpec:
 _ALPHABET = string.ascii_lowercase + string.digits + " "
 
 
-def _chars(key: str, n: int) -> str:
-    """``n`` deterministic alphabet chars derived from ``key`` via a
-    splitmix64-style counter hash — stable across Python versions and
-    processes (``random.Random`` would also do, but a tiny explicit
-    mixer documents that NOTHING environmental feeds this)."""
+def splitmix64_stream(key: str):
+    """Deterministic uint64 stream derived from a string ``key``
+    (FNV-1a seed + splitmix64 advance) — THE seeded-randomness
+    primitive the replay AND chaos planes share: stable across Python
+    versions and processes (``random.Random`` would also do, but one
+    tiny explicit mixer documents that NOTHING environmental feeds
+    any of them, and keeps the planes' determinism guarantees from
+    diverging by copy drift)."""
     h = 1469598103934665603
     for c in key.encode():
         h = ((h ^ c) * 1099511628211) & 0xFFFFFFFFFFFFFFFF
-    out = []
     x = h or 1
-    for _ in range(n):
+    while True:
         x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
         z = x
         z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
         z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
-        z ^= z >> 31
-        out.append(_ALPHABET[z % len(_ALPHABET)])
-    return "".join(out)
+        yield z ^ (z >> 31)
+
+
+def seeded_unit_stream(key: str):
+    """U[0,1) floats over :func:`splitmix64_stream` (53-bit draws)."""
+    for z in splitmix64_stream(key):
+        yield (z >> 11) / float(1 << 53)
+
+
+def _chars(key: str, n: int) -> str:
+    """``n`` deterministic alphabet chars for ``key`` (prompt
+    synthesis; byte-identical to the pre-factoring inline mixer)."""
+    stream = splitmix64_stream(key)
+    return "".join(_ALPHABET[next(stream) % len(_ALPHABET)]
+                   for _ in range(n))
 
 
 def build_prompt(spec: WorkloadSpec, index: int) -> str:

@@ -23,6 +23,8 @@ from email.utils import parsedate_to_datetime
 from typing import Optional, Tuple
 from urllib.parse import urlsplit
 
+from pyspark_tf_gke_tpu.chaos.inject import chaos_fire
+
 
 class ReplicaUnreachable(RuntimeError):
     """Transport-level failure (connect refused/reset/timeout): the
@@ -91,6 +93,14 @@ class ReplicaCall:
                 headers: Optional[dict] = None) -> "ReplicaCall":
         hdrs = {"Content-Type": "application/json", **(headers or {})}
         try:
+            if method == "POST":
+                # chaos: the router.transport fault point — a fail
+                # rule raises INSIDE this try, so it reaches the
+                # caller as the same ReplicaUnreachable a dying pod
+                # produces and exercises the REAL passive-health +
+                # failover path (probes are GETs; they have their own
+                # point in discovery.py)
+                chaos_fire("router.transport", path=path)
             self._conn.request(method, path, body=body, headers=hdrs)
             self.response = self._conn.getresponse()
         except Exception as exc:  # noqa: BLE001 — one taxonomy: either

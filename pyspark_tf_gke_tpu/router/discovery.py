@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from pyspark_tf_gke_tpu.chaos.inject import chaos_fire
 from pyspark_tf_gke_tpu.router.client import ReplicaUnreachable, get_json
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
@@ -370,6 +371,13 @@ class HealthProber:
 
     def _probe_one(self, r: Replica) -> None:
         try:
+            # chaos: the health-probe partition fault point — a fail
+            # rule raises ReplicaUnreachable exactly like a probe
+            # timing out against a partitioned pod, so fail-threshold
+            # debouncing and first-good-probe re-admission run their
+            # REAL paths under scheduled (not accidental) timing
+            chaos_fire("router.probe", exc=ReplicaUnreachable,
+                       replica=r.rid)
             status, body = get_json(r.base_url, "/loadz",
                                     timeout_s=self.timeout_s)
             if status == 404:

@@ -800,6 +800,15 @@ def _make_handler(router: RouterServer):
 
         def do_GET(self):
             route = self.path.partition("?")[0]
+            if route == "/livez":
+                # pure liveness: a router with zero routable backends
+                # is DEGRADED (readiness /healthz says so), not dead —
+                # restarting it revives nothing. Always 200; no
+                # replica table read, no lock (the k8s livenessProbe
+                # target).
+                return self._reply(200, {
+                    "live": True,
+                    "draining": router.draining.is_set()})
             if route in ("/healthz", "/health", "/"):
                 code, payload = router.health()
                 return self._reply(code, payload)
@@ -1045,6 +1054,16 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default=float(e("ROUTER_DRAIN_TIMEOUT", "15")),
                    help="seconds SIGTERM waits before stopping the "
                         "accept loop (in-flight proxies finish)")
+    p.add_argument("--chaos", default=e("ROUTER_CHAOS", ""),
+                   help="router-side fault injection over named fault "
+                        "points (chaos/inject.py): e.g. "
+                        "'router.transport:fail@3' fails the 3rd "
+                        "forwarded request, "
+                        "'router.probe:fail%%0.2,seed=7' drops each "
+                        "health probe w.p. 0.2 (seeded) — exercises "
+                        "passive health, failover and probe-flap "
+                        "debouncing on their REAL paths; NEVER set in "
+                        "production")
     return p.parse_args(argv)
 
 
@@ -1054,6 +1073,17 @@ def main(argv=None) -> int:
         print("router needs --replicas and/or --discover",
               file=sys.stderr)
         return 2
+    if args.chaos:
+        from pyspark_tf_gke_tpu.chaos.inject import (
+            ChaosInjector,
+            install as chaos_install,
+        )
+
+        injector = ChaosInjector.from_spec(args.chaos)
+        if injector is not None:
+            chaos_install(injector)
+            logger.warning("router chaos injection ACTIVE: %s",
+                           injector.describe())
     replicas = parse_replica_list(args.replicas) if args.replicas else []
     dns_refresh = None
     if args.discover:

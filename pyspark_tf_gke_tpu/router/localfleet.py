@@ -272,6 +272,7 @@ class LocalFleet:
         self.replica_ports: list = []
         self.router_port: Optional[int] = None
         self._tmp: Optional[str] = None
+        self._bundle_dir: Optional[str] = None  # retained for restarts
 
     @property
     def url(self) -> str:
@@ -321,6 +322,44 @@ class LocalFleet:
             time.sleep(0.3)
         return False
 
+    # -- chaos hooks (chaos/runner.py drives these at scheduled offsets) --
+
+    def kill_replica(self, i: int) -> None:
+        """SIGKILL replica ``i`` (the pod-death shape: no drain, no
+        goodbye — in-flight requests to it fail at the transport)."""
+        proc = self.procs[i]
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    def stop_replica(self, i: int) -> None:
+        """SIGSTOP replica ``i``: alive but unresponsive — the local
+        stand-in for a hung host AND a network partition (probes time
+        out, open streams stall). Pair with :meth:`cont_replica`."""
+        import signal
+
+        self.procs[i].send_signal(signal.SIGSTOP)
+
+    def cont_replica(self, i: int) -> None:
+        import signal
+
+        if self.procs[i].poll() is None:
+            self.procs[i].send_signal(signal.SIGCONT)
+
+    def restart_replica(self, i: int) -> None:
+        """Relaunch replica ``i`` on its ORIGINAL port and args (the
+        k8s pod-replacement shape: same Service endpoint, fresh
+        process) and wait until it answers /healthz."""
+        if self._bundle_dir is None:
+            raise RuntimeError("fleet never booted")
+        if self.procs[i].poll() is None:
+            self.kill_replica(i)
+        self.procs[i] = launch_replica(
+            self._bundle_dir, self.replica_ports[i],
+            extra_args=self.replica_args, quiet=self.quiet)
+        wait_healthy(self.replica_urls[i],
+                     time.time() + self.boot_timeout_s, self.procs[i])
+
     def __enter__(self) -> "LocalFleet":
         import tempfile
 
@@ -329,6 +368,7 @@ class LocalFleet:
             bundle = self.bundle or export_tiny_bundle(
                 os.path.join(self._tmp, "bundle"),
                 timeout_s=self.boot_timeout_s)
+            self._bundle_dir = bundle
             self.replica_ports = [free_port()
                                   for _ in range(self.n_replicas)]
             self.procs = [launch_replica(bundle, p,

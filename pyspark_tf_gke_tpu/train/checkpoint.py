@@ -18,6 +18,7 @@ from typing import Any, Dict, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from pyspark_tf_gke_tpu.chaos.inject import chaos_fire
 from pyspark_tf_gke_tpu.obs.events import get_event_log
 from pyspark_tf_gke_tpu.utils.fs import fs_makedirs, fs_write_text, is_remote
 from pyspark_tf_gke_tpu.utils.logging import get_logger
@@ -71,6 +72,10 @@ class CheckpointManager:
         attempt_force = {"force": force}
 
         def _save():
+            # chaos: checkpoint-IO fault point, INSIDE the retried
+            # closure — injection exercises retry_with_backoff's
+            # backoff/force-overwrite path, not a bare raise
+            chaos_fire("checkpoint.save", step=step)
             force_now = attempt_force["force"]
             attempt_force["force"] = True
             self._mgr.save(step, args=ocp.args.StandardSave(state),
@@ -139,11 +144,15 @@ class CheckpointManager:
         )
         from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
 
+        def _restore():
+            chaos_fire("checkpoint.restore", step=step)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+
         # a pure read — safe to retry as-is on transient storage faults;
         # a checkpoint that simply isn't there is permanent, fail fast
         restored = retry_with_backoff(
-            lambda: self._mgr.restore(
-                step, args=ocp.args.StandardRestore(abstract)),
+            _restore,
             op="checkpoint_restore", give_up_on=(FileNotFoundError,))
         logger.info("Restored checkpoint step %d from %s", step, self.directory)
         return restored

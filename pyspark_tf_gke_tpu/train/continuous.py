@@ -55,6 +55,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pyspark_tf_gke_tpu.chaos.inject import chaos_fire
 from pyspark_tf_gke_tpu.models.causal_lm import CausalLM
 from pyspark_tf_gke_tpu.obs.metrics import platform_families
 from pyspark_tf_gke_tpu.obs.trace import annotate_request_shape
@@ -1696,10 +1697,14 @@ class ContinuousEngine:
         """Drop a request (abandoned client / front-side timeout): a
         queued request is removed; an active one frees its KV slot
         immediately so it stops burning decode steps. Returns True if
-        the request was found."""
+        the request was found. The request's span gets its terminal
+        verdict HERE (outcome="cancelled") — cancellation is a state
+        transition like completion/expiry, and the exactly-one-terminal
+        invariant (chaos/invariants.py) counts it."""
         for i, req in enumerate(self._queue):
             if req.rid == rid:
                 del self._queue[i]
+                self._trace_terminal(req, "cancelled")
                 return True
         for slot, req in list(self._slots.items()):
             if req.rid == rid:
@@ -1707,15 +1712,26 @@ class ContinuousEngine:
                 #                  must skip it at collect time
                 del self._slots[slot]
                 self._free_slot(slot)
+                self._trace_terminal(req, "cancelled")
                 return True
         if (self._admitting is not None
                 and self._admitting["req"].rid == rid):
             # mid-admission: drop the partial tree (paged: return the
             # held pages); the reserved slot was never inserted/
             # activated, so nothing live to free on device
+            req = self._admitting["req"]
             self._drop_admitting()
+            self._trace_terminal(req, "cancelled")
             return True
         return False
+
+    @staticmethod
+    def _trace_terminal(req: _Request, outcome: str) -> None:
+        """Terminal span verdict for non-delivery state transitions
+        (cancel, rebuild-forced error): one emitter, None-guarded."""
+        if req.span is not None:
+            req.span.event("terminal", rid=req.rid, outcome=outcome,
+                           new_tokens=len(req.tokens))
 
     # -- internals -------------------------------------------------------
     def _announced(self, announce_thunk, device_thunk):
@@ -1896,6 +1912,10 @@ class ContinuousEngine:
                         float(req.top_p if req.top_p is not None else 1.0),
                         int(req.seed))
             try:
+                # chaos: crash BETWEEN page allocation and the prefill
+                # landing — the refcount-discipline audit point (the
+                # except below must hand every held page back)
+                chaos_fire("engine.admit", rid=req.rid)
                 self._announced(
                     lambda wire: wire.announce_cb_admit(
                         self.num_slots, padded, req.prompt.size, slot,
@@ -2466,6 +2486,21 @@ class ContinuousEngine:
         return sum(_request_cost(r) for r in self._queue
                    if tenant is None or r.tenant == tenant)
 
+    def fail_outstanding(self, outcome: str = "error") -> List[_Request]:
+        """Mark every accepted-but-undelivered request terminally
+        failed: emit its ONE terminal span verdict (``outcome``:
+        "error" for a rebuild after a failed/hung step, "shed" for a
+        hot-swap past its drain bound) and set ``done`` so no later
+        path double-delivers. Returns them — the caller (the serving
+        front) settles quota refunds and fails the waiters. No device
+        work happens here: this runs exactly when the engine is being
+        abandoned and its device state may be mid-chunk garbage."""
+        out = self.outstanding_requests()
+        for req in out:
+            self._trace_terminal(req, outcome)
+            req.done = True
+        return out
+
     def outstanding_requests(self) -> List[_Request]:
         """Every request the engine has accepted but not yet delivered
         (queued, in-slot, mid-admission; ``done`` ones excluded). The
@@ -2598,6 +2633,13 @@ class ContinuousEngine:
         OP_CB_COLLECT in ``_collect`` — announced ops MAY legitimately
         sit between a deferred dispatch and its collect, on every
         process in the same order."""
+        # chaos: the hung/failed DEVICE STEP fault point — a fail rule
+        # raises here (the step() caller sees it exactly like a real
+        # failed dispatch: the front fails in-flight requests loudly
+        # and rebuilds the engine); a hang rule sleeps while the
+        # driver holds its lock, which is the shape the serve-side
+        # step watchdog must reap
+        chaos_fire("engine.device_step")
         any_sampling = any(r.temperature > 0
                            for r in self._slots.values())
         self._n_dispatched_steps += size

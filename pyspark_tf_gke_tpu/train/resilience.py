@@ -16,7 +16,12 @@ is the required upgrade, trainer-level and infra-consumable:
   global steps, so the recovery path is *tested*, not assumed. The
   serve-side extension (``from_chaos_spec``) adds SLOW steps — a wedged
   chunk is the other real device-loop failure shape — and injects into
-  the serving driver loop (``train/serve.py`` ``--chaos``).
+  the serving driver loop (``train/serve.py`` ``--chaos``). The
+  implementation now lives in ``pyspark_tf_gke_tpu/chaos/inject.py``
+  (re-exported here unchanged): the chaos plane lifted it into a
+  system-wide named-fault-point layer (``ChaosInjector``) covering the
+  router, the serve front, checkpoint IO and the pipeline publish
+  path — see docs/CHAOS.md.
 * :func:`run_with_recovery` — restart-with-resume wrapper: on failure,
   re-enter the training function with ``resume=True`` so it restores the
   latest orbax checkpoint (train/checkpoint.py) and continues. In-process
@@ -35,9 +40,12 @@ import json
 import os
 import random
 import time
-from typing import (Callable, Dict, Iterable, Mapping, Optional, Sequence,
-                    Tuple, TypeVar)
+from typing import Callable, Optional, Sequence, Tuple, TypeVar
 
+from pyspark_tf_gke_tpu.chaos.inject import (  # noqa: F401 — canonical
+    FaultInjector,  # home is the chaos plane; re-exported so every
+    InjectedFault,  # existing train/serve import site keeps working
+)
 from pyspark_tf_gke_tpu.obs.events import get_event_log
 from pyspark_tf_gke_tpu.utils.fs import is_remote
 from pyspark_tf_gke_tpu.utils.logging import get_logger
@@ -57,10 +65,6 @@ def _process_coords() -> Tuple[int, int]:
     return jax.process_index(), jax.process_count()
 
 T = TypeVar("T")
-
-
-class InjectedFault(RuntimeError):
-    """Raised by :class:`FaultInjector` — distinguishable from real faults."""
 
 
 class Heartbeat:
@@ -171,83 +175,6 @@ def detect_stall(paths: Sequence[str], stall_seconds: float,
                 return p  # never appeared within the startup grace
         time.sleep(poll_s)
     return None
-
-
-class FaultInjector:
-    """Deterministic chaos: raise :class:`InjectedFault` when the step
-    loop reaches any of ``fail_at_steps`` — once per step value, so the
-    post-recovery pass (which replays the same global step after resume)
-    does not immediately re-fail. ``slow_at_steps`` (step → seconds)
-    injects SLOW steps instead of failures — the wedged-device shape a
-    liveness probe must catch — each fired once as well."""
-
-    def __init__(self, fail_at_steps: Iterable[int] = (),
-                 slow_at_steps: Optional[Mapping[int, float]] = None):
-        self.pending = set(int(s) for s in fail_at_steps)
-        self.slow_pending: Dict[int, float] = {
-            int(k): float(v) for k, v in (slow_at_steps or {}).items()}
-        # the injection plan, for post-run accounting (a chaos soak
-        # asserts rebuilds == faults that actually fired)
-        self.n_faults = len(self.pending)
-        self.n_slow = len(self.slow_pending)
-
-    @classmethod
-    def from_spec(cls, spec: str) -> Optional["FaultInjector"]:
-        """Parse a "12,40" CLI/env spec; empty → None (no injection)."""
-        steps = [int(s) for s in spec.split(",") if s.strip()]
-        return cls(steps) if steps else None
-
-    @classmethod
-    def from_chaos_spec(cls, spec: str) -> Optional["FaultInjector"]:
-        """Parse the serve-side chaos spec: comma-separated tokens
-        ``fail@STEP`` (raise at driver step STEP) and
-        ``slow@STEP:SECONDS`` (sleep SECONDS at that step); a bare
-        integer is a failure (the training spec's shorthand). Empty →
-        None (no injection). ``SERVE_CHAOS="fail@10,slow@25:0.5"``
-        fails the 10th busy driver iteration and wedges the 25th."""
-        fails, slows = [], {}
-        for tok in spec.split(","):
-            tok = tok.strip()
-            if not tok:
-                continue
-            if tok.startswith("slow@"):
-                where, _, dur = tok[len("slow@"):].partition(":")
-                if not where or not dur:
-                    raise ValueError(
-                        f"chaos token {tok!r}: slow takes "
-                        f"slow@STEP:SECONDS")
-                slows[int(where)] = float(dur)
-            elif tok.startswith("fail@"):
-                fails.append(int(tok[len("fail@"):]))
-            else:
-                fails.append(int(tok))
-        if not fails and not slows:
-            return None
-        return cls(fails, slows)
-
-    @property
-    def fired_faults(self) -> int:
-        """Failures injected so far (plan minus still-pending)."""
-        return self.n_faults - len(self.pending)
-
-    def maybe_fail(self, step: int) -> None:
-        if int(step) in self.pending:
-            self.pending.discard(int(step))
-            # preemption-simulation evidence rides the shared trail: a
-            # chaos run's injected faults and its retries correlate by seq
-            get_event_log().emit("fault_injected", step=int(step))
-            raise InjectedFault(f"injected fault at step {step}")
-
-    def maybe_slow(self, step: int) -> float:
-        """Sleep (once) if ``step`` is a planned slow step; returns the
-        injected delay in seconds (0.0 when none fired)."""
-        dur = self.slow_pending.pop(int(step), None)
-        if not dur:
-            return 0.0
-        get_event_log().emit("slow_step_injected", step=int(step),
-                             seconds=float(dur))
-        time.sleep(dur)
-        return float(dur)
 
 
 def _watch_main(argv=None) -> int:

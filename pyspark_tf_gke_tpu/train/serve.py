@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pyspark_tf_gke_tpu.chaos.inject import chaos_fire
 from pyspark_tf_gke_tpu.obs.events import get_event_log
 from pyspark_tf_gke_tpu.obs.export import handle_obs_request
 from pyspark_tf_gke_tpu.obs.metrics import get_registry, platform_families
@@ -285,6 +286,15 @@ class EngineShutdown(RuntimeError):
     shuts down — a waiter must fail NOW, not at its wait() timeout."""
 
 
+class EngineWedged(RuntimeError):
+    """Terminal error the STEP WATCHDOG delivers to every in-flight
+    waiter when an engine step exceeds ``--step-timeout`` (a hung or
+    pathologically slow device dispatch): the client gets an explicit
+    error terminal NOW instead of riding out its full request timeout
+    against a wedged loop, and the engine rebuilds the moment the
+    stuck step returns."""
+
+
 class _ContinuousFront:
     """Thread front for the slot engine (train/continuous.py): ONE
     driver thread owns the device loop; HTTP handler threads submit
@@ -299,7 +309,8 @@ class _ContinuousFront:
                  pipeline_depth: int = 0, adaptive_chunk: bool = False,
                  schedule: str = "fifo", obs=None, event_log=None,
                  max_queue_depth: int = 0, max_queued_tokens: int = 0,
-                 chaos=None, heartbeat=None, tenants=None):
+                 chaos=None, heartbeat=None, tenants=None,
+                 step_timeout_s: float = 0.0):
         # multi-tenant fairness/quotas: parsed spec (parse_tenant_spec
         # output or an equivalent dict), or None = tenancy off (every
         # request rides the "default" tenant; admission bounds stay
@@ -341,12 +352,35 @@ class _ContinuousFront:
         self.lock = threading.Lock()
         self.new_work = threading.Event()
         self.stop = threading.Event()
-        # rid -> [done_event, tokens|Exception|None, stream_q|None]
+        # rid -> [done_event, tokens|Exception|None, stream_q|None].
+        # The DICT is guarded by its own lock (always inner to
+        # self.lock): the step watchdog must reap waiters while the
+        # driver thread is stuck inside engine.step() HOLDING
+        # self.lock — a single lock would let one hung device dispatch
+        # wedge the reaper too.
         self._results = {}
+        self._results_lock = threading.Lock()
         self._warmed = []  # token lists, replayed into rebuilt engines
+        # step watchdog (chaos-plane durability): when an engine step
+        # runs longer than step_timeout_s (hung/failed device
+        # dispatch), every in-flight waiter gets an explicit
+        # EngineWedged error terminal and the engine rebuilds the
+        # moment the step returns. 0 = off. _last_loop_ts is the
+        # /livez liveness signal — it stalls exactly when the driver
+        # loop does.
+        self.step_timeout_s = float(step_timeout_s)
+        self._step_started = None  # monotonic at engine.step() entry
+        self._wedged = False
+        self._last_loop_ts = time.monotonic()
         self.thread = threading.Thread(
             target=self._loop, name="continuous-engine", daemon=True)
         self.thread.start()
+        # the watchdog thread ALWAYS runs (idle no-op sweeps at 1 Hz
+        # while step_timeout_s <= 0) so the timeout really is a live
+        # attribute: a front built with the watchdog off can arm it
+        # at runtime and be reaped, not silently unprotected
+        threading.Thread(target=self._watch_steps,
+                         name="step-watchdog", daemon=True).start()
 
     def _new_engine(self):
         from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
@@ -597,14 +631,15 @@ class _ContinuousFront:
                 if bucket is not None:
                     bucket.refund(len(prompt_ids) + int(max_new_tokens))
                 raise
-            self._results[rid] = [done, None, None]
+            with self._results_lock:
+                self._results[rid] = [done, None, None]
         self._obs["serve_tenant_requests_total"].labels(
             tenant=tenant).inc()
         self.new_work.set()
         return rid
 
     def wait(self, rid: int, timeout_s: float = 600.0):
-        with self.lock:
+        with self._results_lock:
             entry = self._results.get(rid)
         if entry is None:
             raise KeyError(f"unknown or already-collected request {rid}")
@@ -616,11 +651,16 @@ class _ContinuousFront:
                 # otherwise starve the very queue that caused the
                 # timeout)
                 self.engine.cancel(rid)
-                self._results.pop(rid, None)
+                with self._results_lock:
+                    self._results.pop(rid, None)
             raise RuntimeError(
                 f"continuous decode timed out after {timeout_s}s")
-        with self.lock:
-            result = self._results.pop(rid)[1]
+        with self._results_lock:
+            # pop-if-present: the step watchdog removes reaped entries
+            # itself — the captured entry's result slot was written
+            # BEFORE its event was set either way
+            self._results.pop(rid, None)
+        result = entry[1]
         if isinstance(result, (DeadlineExceeded, EngineShutdown,
                                RequestRejected)):
             # typed: the handler maps these to 504 / 500 / the shed's
@@ -654,9 +694,22 @@ class _ContinuousFront:
 
     def abandon(self, rid: int) -> None:
         """Give up on a submitted request: free its KV slot / queue spot
-        and drop its results entry (idempotent)."""
-        with self.lock:
-            self.engine.cancel(rid)
+        and drop its results entry (idempotent). BOUNDED acquire on the
+        front lock: during a wedged step the driver holds it for the
+        whole hang, and abandon is exactly the cleanup path the
+        watchdog's bounded-latency promise routes through — when the
+        lock can't be had promptly, skip the engine-side cancel (the
+        rebuild that follows the wedge clears engine state anyway; on
+        a merely-busy engine the request runs out its budget and its
+        delivery finds no waiter) and still drop the waiter entry."""
+        acquired = self.lock.acquire(timeout=1.0)
+        try:
+            if acquired:
+                self.engine.cancel(rid)
+        finally:
+            if acquired:
+                self.lock.release()
+        with self._results_lock:
             self._results.pop(rid, None)
 
     def submit_internal(self, prompt_ids, max_new_tokens: int) -> int:
@@ -670,7 +723,8 @@ class _ContinuousFront:
         with self.lock:
             rid = self.engine.submit(prompt_ids, max_new_tokens,
                                      tenant="__internal__")
-            self._results[rid] = [done, None, None]
+            with self._results_lock:
+                self._results[rid] = [done, None, None]
         self.new_work.set()
         return rid
 
@@ -707,7 +761,9 @@ class _ContinuousFront:
                 if bucket is not None:
                     bucket.refund(len(prompt_ids) + int(max_new_tokens))
                 raise
-            self._results[rid] = [done, None, q]  # same shape as submit
+            with self._results_lock:
+                self._results[rid] = [done, None, q]  # same shape as
+                #                                       submit
         self._obs["serve_tenant_requests_total"].labels(
             tenant=tenant).inc()
         self.new_work.set()
@@ -726,22 +782,31 @@ class _ContinuousFront:
             # state transition itself — one emitter for served and
             # direct callers alike; the HTTP layer still stamps the
             # status code it maps the outcome to)
-            slot = self._results.get(req.rid)
-            if slot is None:
-                continue
-            if req.expired:
-                err = DeadlineExceeded(
-                    f"request deadline exceeded after "
-                    f"{len(req.tokens)} decoded token(s)")
-                slot[1] = err
+            with self._results_lock:
+                # delivery happens UNDER the lock, and only if nobody
+                # delivered first: a step returning right at the
+                # watchdog timeout races the reaper, and a waiter must
+                # get exactly ONE terminal — whichever side claims the
+                # still-empty slot inside the lock wins, the other
+                # skips (the reaper also removes entries, so the get
+                # below usually misses outright)
+                slot = self._results.get(req.rid)
+                if slot is None or slot[1] is not None \
+                        or slot[0].is_set():
+                    continue
+                if req.expired:
+                    err = DeadlineExceeded(
+                        f"request deadline exceeded after "
+                        f"{len(req.tokens)} decoded token(s)")
+                    slot[1] = err
+                    slot[0].set()
+                    if slot[2] is not None:
+                        slot[2].put(err)
+                    continue
+                slot[1] = req.tokens
                 slot[0].set()
-                if slot[2] is not None:
-                    slot[2].put(err)
-                continue
-            slot[1] = req.tokens
-            slot[0].set()
-            if slot[2] is not None:  # streaming terminal
-                slot[2].put([])
+                if slot[2] is not None:  # streaming terminal
+                    slot[2].put([])
 
     def swap_model(self, model, params, eos_id, drain_s: float = 30.0):
         """Bundle hot-swap: replace the engine's model/params/eos.
@@ -775,28 +840,93 @@ class _ContinuousFront:
                 logger.exception(
                     "old engine failed while draining for a bundle swap")
             try:
-                # accepted-but-undelivered requests: refund their quota
-                # charges before the old engine is dropped
-                for req in self.engine.outstanding_requests():
+                # accepted-but-undelivered requests: terminal span
+                # verdict (a reload past its drain bound is a SHED) +
+                # refund their quota charges before the old engine is
+                # dropped
+                for req in self.engine.fail_outstanding("shed"):
                     self._settle(req)
             except Exception:  # noqa: BLE001 — refunds must not block
                 pass           # the swap
             err = _reloading_rejection()
+            with self._results_lock:
+                # claim-and-write under the lock (same exactly-one-
+                # terminal discipline as _deliver_finished: the step
+                # watchdog may race this sweep)
+                for slot in self._results.values():
+                    if slot[1] is None and not slot[0].is_set():
+                        self._obs["serve_requests_rejected_total"].labels(
+                            reason="reloading").inc()
+                        slot[1] = err
+                        slot[0].set()
+                        if slot[2] is not None:
+                            slot[2].put(err)
+            self.engine = self._new_engine()
+            self._warmed.clear()
+
+    def _watch_steps(self):
+        """Watchdog thread: reap waiters stuck behind a hung engine
+        step. Touches ONLY ``_results_lock`` — the driver holds
+        ``self.lock`` for the whole stuck step, so the reaper must
+        never want it."""
+        while not self.stop.is_set():
+            timeout = self.step_timeout_s
+            started = self._step_started
+            if (timeout > 0 and started is not None
+                    and time.monotonic() - started > timeout):
+                self._reap_wedged(time.monotonic() - started)
+            # poll re-derived each sweep: the timeout is a plain
+            # attribute so operators/tests may retune it live (e.g.
+            # generous through warmup compiles, tight at steady state;
+            # 0 = disarmed — the thread idles at 1 Hz)
+            self.stop.wait(max(0.05, min(1.0, timeout / 4))
+                           if timeout > 0 else 1.0)
+
+    def _reap_wedged(self, stuck_s: float) -> None:
+        """One watchdog intervention: flag the wedge (the driver loop
+        rebuilds the engine when the stuck step returns; /livez
+        reports it meanwhile) and fail every pending waiter with an
+        explicit EngineWedged error terminal — exactly one terminal
+        per request, delivered NOW, instead of a silent hang into each
+        client's own timeout. Re-fires each poll while the step stays
+        stuck, so waiters that were mid-submit when the wedge began
+        are caught on the next sweep."""
+        first = not self._wedged
+        self._wedged = True
+        err = EngineWedged(
+            f"engine step exceeded step_timeout {self.step_timeout_s:g}s "
+            f"(stuck {stuck_s:.1f}s); the step watchdog failed this "
+            "request")
+        reaped = 0
+        with self._results_lock:
+            # entries stay in the table (wait() pops them and surfaces
+            # the TYPED EngineWedged — deleting here made a rid reaped
+            # between submit() and wait() raise a generic KeyError);
+            # the slot[1]-is-None claim prevents re-reaping, and the
+            # delivery path's own claim check prevents a returning
+            # step from double-terminating a reaped waiter
             for slot in self._results.values():
                 if slot[1] is None and not slot[0].is_set():
-                    self._obs["serve_requests_rejected_total"].labels(
-                        reason="reloading").inc()
                     slot[1] = err
                     slot[0].set()
                     if slot[2] is not None:
                         slot[2].put(err)
-            self.engine = self._new_engine()
-            self._warmed.clear()
+                    reaped += 1
+        if first or reaped:
+            self._obs["serve_step_watchdog_reaps_total"].inc()
+            self._event_log.emit("engine_watchdog_reap", reaped=reaped,
+                                 stuck_s=round(stuck_s, 3),
+                                 step_timeout_s=self.step_timeout_s)
+            logger.error(
+                "step watchdog: engine step stuck %.1fs (> %gs); "
+                "failed %d in-flight request(s); engine rebuilds when "
+                "the step returns", stuck_s, self.step_timeout_s, reaped)
 
     def _loop(self):
         beat = 0
         while not self.stop.is_set():
             beat += 1
+            self._last_loop_ts = time.monotonic()  # /livez signal
             if self._heartbeat is not None:
                 try:
                     self._heartbeat.beat(beat)
@@ -817,8 +947,24 @@ class _ContinuousFront:
                         self._chaos_step += 1
                         self._chaos.maybe_slow(self._chaos_step)
                         self._chaos.maybe_fail(self._chaos_step)
-                    self._deliver_finished(
-                        self.engine.step() if busy else [])
+                    if busy:
+                        self._step_started = time.monotonic()
+                    try:
+                        finished = self.engine.step() if busy else []
+                    finally:
+                        self._step_started = None
+                    self._deliver_finished(finished)
+                    if self._wedged:
+                        # the stuck step RETURNED: its waiters were
+                        # already reaped (completions among `finished`
+                        # settled above; their waiter entries are gone
+                        # so nothing double-delivers) — the engine
+                        # state is untrustworthy, rebuild through the
+                        # one failed-step path below
+                        self._wedged = False
+                        raise RuntimeError(
+                            "engine step exceeded the watchdog timeout; "
+                            "rebuilding")
                 except Exception as exc:  # noqa: BLE001 — driver thread
                     # One failed step must not brick serving: the engine
                     # state may be mid-chunk garbage, so fail every
@@ -835,19 +981,21 @@ class _ContinuousFront:
                     try:
                         # the dead engine's accepted-but-undelivered
                         # requests never reach step()'s delivery path:
-                        # settle them HERE or their quota charges leak
-                        # and the tenant pays 429s for work that was
-                        # never done
-                        for req in self.engine.outstanding_requests():
+                        # mark them terminally failed (exactly one
+                        # terminal span verdict each) and settle them
+                        # HERE or their quota charges leak and the
+                        # tenant pays 429s for work that was never done
+                        for req in self.engine.fail_outstanding("error"):
                             self._settle(req)
                     except Exception:  # noqa: BLE001 — refunds must
                         pass           # not block the rebuild
-                    for slot in self._results.values():
-                        if slot[1] is None:
-                            slot[1] = exc
-                            slot[0].set()
-                            if slot[2] is not None:
-                                slot[2].put(exc)
+                    with self._results_lock:
+                        for slot in self._results.values():
+                            if slot[1] is None:
+                                slot[1] = exc
+                                slot[0].set()
+                                if slot[2] is not None:
+                                    slot[2].put(exc)
                     if self._announce:
                         # workers must restart from zeros WITH us: their
                         # replica may hold the half-mutated state of the
@@ -886,8 +1034,10 @@ class _ContinuousFront:
         while True:
             with self.lock:
                 stats = self.engine.stats
-                pending = any(slot[1] is None and not slot[0].is_set()
-                              for slot in self._results.values())
+                with self._results_lock:
+                    pending = any(
+                        slot[1] is None and not slot[0].is_set()
+                        for slot in self._results.values())
                 busy = bool(stats["active"] or stats["queued"]
                             or stats["admitting"] is not None
                             or stats["inflight"])
@@ -908,12 +1058,13 @@ class _ContinuousFront:
         err = EngineShutdown(
             "serving front shut down while the request was in flight")
         with self.lock:
-            for slot in self._results.values():
-                if slot[1] is None and not slot[0].is_set():
-                    slot[1] = err
-                    slot[0].set()
-                    if slot[2] is not None:
-                        slot[2].put(err)
+            with self._results_lock:
+                for slot in self._results.values():
+                    if slot[1] is None and not slot[0].is_set():
+                        slot[1] = err
+                        slot[0].set()
+                        if slot[2] is not None:
+                            slot[2].put(err)
 
 
 class BundleServer:
@@ -934,7 +1085,9 @@ class BundleServer:
                  chaos_spec: str = "", heartbeat_file: str = "",
                  tenants_spec: str = "", admin_token: str = "",
                  trace_sample: float = 0.01,
-                 trace_slow_ms: float = 1000.0):
+                 trace_slow_ms: float = 1000.0,
+                 step_timeout_s: float = 0.0,
+                 live_stall_s: float = 120.0):
         from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
 
         self.mesh = mesh
@@ -1018,14 +1171,30 @@ class BundleServer:
             raise ValueError(
                 "--prefill-chunk requires --continuous-slots (chunked "
                 "prefill is a slot-engine feature)")
-        if continuous_slots:
-            chaos = heartbeat = None
-            if chaos_spec:
-                from pyspark_tf_gke_tpu.train.resilience import (
-                    FaultInjector,
-                )
+        # liveness signal thresholds for GET /livez (no engine lock):
+        # the driver loop's last-iteration age past live_stall_s flips
+        # /livez to 503 — the cheap httpGet form of the heartbeat-age
+        # exec probe
+        self._live_stall_s = float(live_stall_s)
+        # chaos spec: named-point tokens (POINT:ACTION@N / %P — see
+        # chaos/inject.FAULT_POINTS) install the process-global
+        # ChaosInjector, covering the request front and engine device
+        # points on ANY serving mode; legacy fail@N / slow@N:S tokens
+        # keep driving the engine DRIVER LOOP via FaultInjector below
+        chaos = None
+        if chaos_spec:
+            from pyspark_tf_gke_tpu.chaos.inject import (
+                install as chaos_install,
+                split_serve_chaos_spec,
+            )
 
-                chaos = FaultInjector.from_chaos_spec(chaos_spec)
+            chaos, named = split_serve_chaos_spec(chaos_spec)
+            if named is not None:
+                chaos_install(named)
+                logger.warning("named-point chaos injection ACTIVE: %s",
+                               named.describe())
+        if continuous_slots:
+            heartbeat = None
             if heartbeat_file:
                 from pyspark_tf_gke_tpu.train.resilience import Heartbeat
 
@@ -1050,7 +1219,8 @@ class BundleServer:
                 max_queue_depth=max_queue_depth,
                 max_queued_tokens=max_queued_tokens,
                 chaos=chaos, heartbeat=heartbeat,
-                tenants=tenants_spec)
+                tenants=tenants_spec,
+                step_timeout_s=step_timeout_s)
 
     # -- bundle loading / hot-swap ---------------------------------------
 
@@ -1071,9 +1241,15 @@ class BundleServer:
         from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
 
         _permanent = (FileNotFoundError, ValueError, KeyError, TypeError)
+
+        def _load():
+            # chaos: bundle-load fault point inside the retried closure
+            # (boot AND hot-swap reload ride this one path)
+            chaos_fire("bundle.load", bundle=bundle_dir)
+            return load_serving_bundle(bundle_dir)
+
         model, params, meta = retry_with_backoff(
-            lambda: load_serving_bundle(bundle_dir), op="bundle_load",
-            give_up_on=_permanent)
+            _load, op="bundle_load", give_up_on=_permanent)
         if self._int8_kv and not model.cfg.kv_cache_quant:
             # cache layout is a serving-time choice (params unchanged) —
             # allow turning it on for bundles exported without the flag
@@ -1319,6 +1495,27 @@ class BundleServer:
             "continuous": (self._front.engine.stats
                            if self._front is not None else None),
         }
+
+    def livez(self) -> dict:
+        """Pure LIVENESS (``GET /livez``): is this PROCESS worth
+        keeping, independent of readiness/load. Touches NO engine
+        state and takes NO lock — a wedged engine must not wedge the
+        probe that exists to detect it. ``live`` goes false only when
+        the slot engine's driver loop has not completed an iteration
+        for ``live_stall_s`` (a hung device dispatch the watchdog
+        couldn't clear) — draining, zero capacity, or a dead backend
+        are readiness verdicts (/healthz, /loadz), never liveness.
+        Whole-batch servers (no driver loop) are always live."""
+        out = {"live": True, "draining": self.draining}
+        front = self._front
+        if front is not None:
+            age = time.monotonic() - front._last_loop_ts
+            out["driver_loop_age_s"] = round(age, 3)
+            out["wedged"] = bool(front._wedged)
+            out["step_timeout_s"] = front.step_timeout_s
+            if self._live_stall_s and age > self._live_stall_s:
+                out["live"] = False
+        return out
 
     def loadz(self) -> dict:
         """One cheap JSON load snapshot (``GET /loadz``): what the
@@ -1863,6 +2060,24 @@ class BundleServer:
 # -- HTTP plumbing -----------------------------------------------------------
 
 
+def _span_shed_event(span, exc: "RequestRejected") -> None:
+    """The shed VERDICT on the request's span — skipped when the span
+    already carries a terminal event: a hot-swap drained past its
+    bound delivers a 'reloading' RequestRejected to an ADMITTED
+    request whose ``terminal(outcome=shed)`` the engine's
+    ``fail_outstanding`` already stamped, and a second verdict would
+    read as a double delivery to the exactly-one-terminal checker
+    (chaos/invariants.py). Admission-gate sheds never reach the
+    engine, so they always emit here."""
+    if span is None:
+        return
+    if any(e.get("name") == "terminal" for e in span.events):
+        return
+    span.event("shed", reason=exc.reason,
+               **({"tenant": exc.tenant}
+                  if getattr(exc, "tenant", None) else {}))
+
+
 def _shed_headers(exc: RequestRejected):
     """Response headers for one shed: Retry-After always; per-tenant
     sheds also carry ``X-Tenant-Shed`` so the router can tell a tenant
@@ -1937,8 +2152,7 @@ def _make_handler(server: BundleServer):
                 first = next(events)  # validation errors surface BEFORE
                 #   the 200 status line is committed
             except RequestRejected as exc:
-                if self._span is not None:
-                    self._span.event("shed", reason=exc.reason)
+                _span_shed_event(self._span, exc)
                 server.record_metrics()
                 return self._reply(exc.status, _shed_body(exc),
                                    headers=_shed_headers(exc))
@@ -1992,6 +2206,13 @@ def _make_handler(server: BundleServer):
                 # to watch the queue empty)
                 return self._reply(503 if server.draining else 200,
                                    server.health())
+            if route == "/livez":
+                # LIVENESS, distinct from readiness: no engine lock,
+                # no load math — 503 only when the driver loop itself
+                # has stalled past live_stall_s (the k8s livenessProbe
+                # target; draining answers 200 live)
+                out = server.livez()
+                return self._reply(200 if out["live"] else 503, out)
             if route == "/loadz":
                 # the router's prober polls this every second per
                 # replica: one dict assembly, no registry walk, no
@@ -2075,6 +2296,11 @@ def _make_handler(server: BundleServer):
                 server.record_metrics(failed=True)
                 return self._reply(400, {"error": f"bad JSON body: {exc}"})
             try:
+                # chaos: the BundleServer request-front fault point — a
+                # fail rule lands in the generic handler below as an
+                # explicit 500 error terminal (counted, never a hang);
+                # a slow rule injects scheduled front latency
+                chaos_fire("serve.request")
                 deadline_ms = req.get("deadline_ms") if isinstance(
                     req, dict) else None
                 deadline_s = (float(deadline_ms) / 1000.0
@@ -2184,15 +2410,11 @@ def _make_handler(server: BundleServer):
                 # load shedding is not a server fault: counted in the
                 # rejected{reason} family (incremented at the raise
                 # site), not in requests_failed. Per-tenant sheds carry
-                # the tenant in body + X-Tenant-Shed header.
-                if self._span is not None:
-                    # the shed VERDICT on the trace: reason + (tenant
-                    # sheds) whose quota it was — the 'why' a 429'd
-                    # user report needs
-                    self._span.event(
-                        "shed", reason=exc.reason,
-                        **({"tenant": exc.tenant} if exc.tenant else {}))
+                # the tenant in body + X-Tenant-Shed header; the shed
+                # VERDICT lands on the trace (reason + whose quota) —
+                # unless the engine already stamped the terminal
                 server.record_metrics()
+                _span_shed_event(self._span, exc)
                 self._reply(exc.status, _shed_body(exc),
                             headers=_shed_headers(exc))
             except DeadlineExceeded as exc:
@@ -2379,12 +2601,32 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "terminationGracePeriodSeconds comfortably "
                         "above it (see infra/k8s/tpu/tpu-serve.yaml)")
     p.add_argument("--chaos", default=e("SERVE_CHAOS", ""),
-                   help="serve-side fault injection into the engine "
-                        "driver loop: comma-separated fail@STEP / "
-                        "slow@STEP:SECONDS tokens (e.g. "
-                        "'fail@50,slow@80:0.5'); exercises the "
-                        "engine-rebuild path under real traffic — "
+                   help="serve-side fault injection: legacy driver-"
+                        "loop tokens (fail@STEP / slow@STEP:SECONDS, "
+                        "e.g. 'fail@50,slow@80:0.5' — the engine-"
+                        "rebuild path) and/or NAMED fault points "
+                        "(POINT:ACTION@N / POINT:ACTION%%P, e.g. "
+                        "'engine.device_step:hang@3:2,"
+                        "serve.request:fail%%0.05,seed=7' — see "
+                        "docs/CHAOS.md for the point catalog); "
                         "NEVER set in production")
+    p.add_argument("--step-timeout", type=float,
+                   default=float(e("SERVE_STEP_TIMEOUT", "0")),
+                   help="step watchdog: when one engine step (device "
+                        "dispatch) runs longer than this many "
+                        "seconds, every in-flight request is failed "
+                        "with an explicit error terminal and the "
+                        "engine rebuilds when the step returns — a "
+                        "hung device step costs bounded client "
+                        "latency instead of a wedged loop (0 = off; "
+                        "size WELL above worst-case compile + chunk "
+                        "time)")
+    p.add_argument("--live-stall", type=float,
+                   default=float(e("SERVE_LIVE_STALL", "120")),
+                   help="GET /livez answers 503 once the engine "
+                        "driver loop has not completed an iteration "
+                        "for this many seconds (the k8s livenessProbe "
+                        "target; 0 disables the stall check)")
     p.add_argument("--heartbeat-file", default=e("HEARTBEAT_FILE", ""),
                    help="node-local path the engine DRIVER LOOP beats "
                         "(train/resilience.Heartbeat); the k8s liveness "
@@ -2477,6 +2719,8 @@ def main(argv=None) -> int:
         tenants_spec=args.tenants,
         trace_sample=args.trace_sample,
         trace_slow_ms=args.trace_slow_ms,
+        step_timeout_s=args.step_timeout,
+        live_stall_s=args.live_stall,
         # env-only by design: a token flag would leak into ps output
         # and pod specs; the k8s manifest mounts it from a Secret
         admin_token=os.environ.get("SERVE_ADMIN_TOKEN", ""))

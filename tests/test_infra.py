@@ -111,15 +111,23 @@ def test_tpu_serve_manifest_conventions():
     for probe in ("startupProbe", "readinessProbe"):
         assert c[probe]["httpGet"]["path"] == "/healthz"
         assert c[probe]["httpGet"]["port"] == port
-    # liveness = heartbeat AGE via stdlib exec (tpu-worker.yaml idiom),
-    # pointed at the same file the serve CLI is told to beat, PLUS an
-    # HTTP reachability fallback (covers whole-batch mode, where no
-    # driver loop beats, and a hung accept thread)
-    probe_src = c["livenessProbe"]["exec"]["command"][2]
-    assert c["livenessProbe"]["exec"]["command"][0] == "python"
-    assert env["HEARTBEAT_FILE"] in probe_src
-    assert "/healthz" in probe_src
-    assert "HTTPError" in probe_src  # a draining 503 must count as alive
+    # liveness = GET /livez (the dedicated liveness endpoint: no
+    # engine lock, 503 only on a driver-loop stall past
+    # SERVE_LIVE_STALL; covers the wedged loop the old heartbeat-age
+    # exec probe caught, plus a hung accept thread). A draining pod
+    # answers 200 live — liveness must not kill a drain.
+    assert c["livenessProbe"]["httpGet"]["path"] == "/livez"
+    assert c["livenessProbe"]["httpGet"]["port"] == port
+    assert float(env["SERVE_LIVE_STALL"]) > 0
+    # the step watchdog is ON, sized well above compile + chunk time,
+    # and STRICTLY below the /livez stall: the in-process reap +
+    # rebuild must get to act before the pod restart preempts it (a
+    # restart mid-hang drops every in-flight request with no terminal)
+    assert float(env["SERVE_STEP_TIMEOUT"]) >= 60
+    assert float(env["SERVE_STEP_TIMEOUT"]) < float(
+        env["SERVE_LIVE_STALL"])
+    # the heartbeat file stays for bastion-side watchdogs
+    assert env["HEARTBEAT_FILE"].startswith("/tmp")
     # drain lifecycle: preStop sleep + DRAIN_TIMEOUT fit the grace window
     assert c["lifecycle"]["preStop"]["exec"]["command"]
     grace = pod["terminationGracePeriodSeconds"]
@@ -127,6 +135,12 @@ def test_tpu_serve_manifest_conventions():
     # bounded admission is ON in the canonical deployment
     assert int(env["MAX_QUEUE_DEPTH"]) > 0
     assert c["resources"]["requests"]["google.com/tpu"] == "4"
+    # voluntary disruptions evict at most one replica at a time, and
+    # the PDB selects the SAME pods the Service routes to
+    pdb = next(d for d in docs if d["kind"] == "PodDisruptionBudget")
+    assert pdb["spec"]["maxUnavailable"] == 1
+    assert pdb["spec"]["selector"]["matchLabels"] == \
+        dep["spec"]["selector"]["matchLabels"]
 
 
 def test_tpu_router_manifest_conventions():
@@ -161,12 +175,19 @@ def test_tpu_router_manifest_conventions():
         "requests", {})
     assert "nodeSelector" not in dep["spec"]["template"]["spec"]
     # readiness on /healthz, liveness decoupled from replica health
+    # (GET /livez: unconditional 200 — a router with no backends is
+    # degraded, not dead)
     assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
-    assert c["livenessProbe"]["httpGet"]["path"] == "/metrics"
+    assert c["livenessProbe"]["httpGet"]["path"] == "/livez"
     # drain fits the grace window (preStop sleep + drain timeout)
     grace = dep["spec"]["template"]["spec"][
         "terminationGracePeriodSeconds"]
     assert float(env["ROUTER_DRAIN_TIMEOUT"]) + 5 < grace
+    # one router pod max per voluntary disruption (the only front door)
+    pdb = next(d for d in docs if d["kind"] == "PodDisruptionBudget")
+    assert pdb["spec"]["maxUnavailable"] == 1
+    assert pdb["spec"]["selector"]["matchLabels"] == \
+        dep["spec"]["selector"]["matchLabels"]
 
 
 def test_tpu_serve_hpa_conventions():

@@ -114,6 +114,16 @@ def cmd_run(args) -> int:
     if args.slo:
         slo = (json.loads(args.slo) if args.slo.lstrip().startswith("{")
                else _load_json(args.slo))
+    chaos_schedule = None
+    if args.chaos:
+        from pyspark_tf_gke_tpu.chaos.spec import ChaosSchedule
+
+        if not args.localfleet:
+            raise SystemExit(
+                "--chaos needs --localfleet: the schedule kills/stops "
+                "replica PROCESSES, which only a fleet this run owns "
+                "can survive")
+        chaos_schedule = ChaosSchedule.load(args.chaos)
 
     def drive(url: str) -> dict:
         calibration = None
@@ -144,20 +154,67 @@ def cmd_run(args) -> int:
 
         trace_args = ("--trace-sample", "1.0", "--trace-slow-ms", "0")
         extra = trace_args
+        router_extra = trace_args
         if args.tenants:
             extra = extra + ("--tenants", args.tenants)
+        if chaos_schedule is not None:
+            # launch-time in-process injections from the schedule's
+            # inject events ride each process's own --chaos flag
+            inj = chaos_schedule.launch_injections()
+            for target, spec_str in inj.items():
+                if target == "router":
+                    router_extra = router_extra + ("--chaos", spec_str)
+                elif target == "replica:*":
+                    extra = extra + ("--chaos", spec_str)
+                else:
+                    raise SystemExit(
+                        f"inject target {target!r}: per-index replica "
+                        "injection is not supported here (all local "
+                        "replicas share one argv) — use replica:*")
         with LocalFleet(args.localfleet, router=not args.no_router,
                         replica_args=extra,
-                        router_args=trace_args) as fleet:
+                        router_args=router_extra) as fleet:
             # first-request JIT compiles must not be charged to the
             # replayed tail
             fleet.warm()
-            report = drive(fleet.url)
+            if chaos_schedule is None:
+                report = drive(fleet.url)
+            else:
+                from pyspark_tf_gke_tpu.chaos.invariants import (
+                    check_replica,
+                    check_report,
+                )
+                from pyspark_tf_gke_tpu.chaos.runner import ScheduleRunner
+
+                runner = ScheduleRunner(chaos_schedule, fleet,
+                                        speedup=args.speedup)
+                with runner:
+                    report = drive(fleet.url)
+                # post-scenario gate: fleet healed (runner exit), let
+                # it quiesce, then apply the durability invariants —
+                # every request terminal client-side, every surviving
+                # replica back at baseline
+                fleet.wait_idle()
+                report["chaos"] = {
+                    "schedule": chaos_schedule.name,
+                    "seed": chaos_schedule.seed,
+                    "actions": runner.actions,
+                    "report_check": check_report(report,
+                                                 len(spec.requests)),
+                    "replicas": [check_replica(u)
+                                 for u in fleet.replica_urls],
+                }
             report["fleet"] = {"replicas": args.localfleet,
                                "router": not args.no_router}
     _emit(report, args.out)
     if slo is not None and not report["slo"]["pass"]:
         return 1
+    chaos_block = report.get("chaos")
+    if chaos_block is not None:
+        bad = not chaos_block["report_check"]["ok"] or any(
+            not c["ok"] for c in chaos_block["replicas"])
+        if bad:
+            return 1
     return 0
 
 
@@ -286,6 +343,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="declarative SLO bounds: inline JSON or a "
                          "path (docs/REPLAY.md lists the keys); "
                          "rc=1 when any bound fails")
+    rn.add_argument("--chaos",
+                    help="chaos schedule (chaos/spec.py JSONL) to "
+                         "execute against the fleet WHILE the spec "
+                         "replays: kills/stops/restarts replicas at "
+                         "scheduled offsets, applies inject events at "
+                         "launch; afterwards the durability "
+                         "invariants gate rc (docs/CHAOS.md). "
+                         "Requires --localfleet")
     rn.add_argument("--calibrate", action="store_true",
                     help="measure service rates first (serial "
                          "requests) and embed them in the report")

@@ -145,6 +145,7 @@ def lint_duplicate_metrics() -> int:
     # router plane's entry point (pyspark_tf_gke_tpu/router/) — its
     # router_* names ride the same one-name-one-shape contract.
     from pyspark_tf_gke_tpu.obs.metrics import (
+        chaos_families,
         replay_families,
         router_families,
     )
@@ -153,6 +154,7 @@ def lint_duplicate_metrics() -> int:
     platform_families(scheme)
     router_families(scheme)
     replay_families(scheme)
+    chaos_families(scheme)
     install_runtime_metrics(scheme)
     if not _REGISTRATIONS:
         print("metric lint FAILED — registration record is empty after "
@@ -210,7 +212,14 @@ def lint_duplicate_metrics() -> int:
                 "replay_tbt_ms",
                 "replay_request_latency_ms",
                 "replay_sched_lag_ms",
-                "replay_goodput"}
+                "replay_goodput",
+                # chaos plane: the fault-sweep gates (--chaos, replay
+                # run --chaos, test_chaos) assert injections/actions
+                # were non-vacuous through these names, and the step
+                # watchdog's interventions must stay scrapable
+                "fault_injections_total",
+                "chaos_actions_total",
+                "serve_step_watchdog_reaps_total"}
     absent = {n for n in required if n not in _REGISTRATIONS}
     if absent:
         print("metric lint FAILED — required metric name(s) never "
@@ -1506,10 +1515,100 @@ def replay_check(grace_s: float = 30.0) -> int:
     return 0
 
 
+def chaos_check(grace_s: float = 30.0) -> int:
+    """``--chaos``: the chaos plane's durability contract, live. A tiny
+    flash-crowd replay runs against a 2-replica CPU localfleet behind
+    the real router while a chaos schedule SIGKILLs one replica
+    mid-crowd and restarts it; afterwards EVERY request must have
+    reached exactly one terminal outcome (the exactly-one-terminal
+    invariant, client-side), the surviving/restarted replicas must
+    pass the baseline invariant check (zero stuck slots, pool at
+    baseline, no wedged admission), the router must be back to two
+    routable replicas, and goodput must have RECOVERED in the
+    post-restart window."""
+    import json
+    import time
+    import urllib.request
+
+    from pyspark_tf_gke_tpu.chaos.invariants import (
+        check_replica,
+        check_report,
+        goodput_windows,
+    )
+    from pyspark_tf_gke_tpu.chaos.runner import ScheduleRunner
+    from pyspark_tf_gke_tpu.chaos.spec import ChaosEvent, ChaosSchedule
+    from pyspark_tf_gke_tpu.replay.driver import replay_spec
+    from pyspark_tf_gke_tpu.replay.generators import synth_spec
+    from pyspark_tf_gke_tpu.router.localfleet import LocalFleet
+
+    duration = 9.0
+    spec = synth_spec("flash_crowd", seed=7, duration_s=duration,
+                      rate_rps=1.5, prompt_tokens=16, output_tokens=8,
+                      max_seq_len=64, burst_mult=6.0, burst_frac=0.3)
+    kill_at, restart_after = 3.0, 3.0
+    schedule = ChaosSchedule("smoke-kill-one", seed=7, events=[
+        ChaosEvent(offset_s=kill_at, action="kill", target="replica:1",
+                   restart_s=restart_after),
+    ]).validate()
+    print(f"chaos check: {len(spec.requests)}-request flash crowd vs "
+          "2-replica fleet + router; SIGKILL replica 1 at "
+          f"{kill_at}s, restart {restart_after}s later...")
+    trace_args = ("--trace-sample", "1.0", "--trace-slow-ms", "0")
+    with LocalFleet(2, router_args=trace_args,
+                    replica_args=(*trace_args, "--continuous-slots",
+                                  "1", "--max-queue-depth", "6")) as fleet:
+        fleet.warm()
+        runner = ScheduleRunner(schedule, fleet)
+        with runner:
+            report = replay_spec(spec, fleet.url, speedup=1.0,
+                                 include_requests=True)
+        acted = {a["action"] for a in runner.actions}
+        assert {"kill", "restart"} <= acted, (
+            f"schedule was vacuous: {runner.actions}")
+
+        # 1) exactly one terminal per request, client-side
+        closure = check_report(report, len(spec.requests))
+        assert closure["ok"], closure["violations"]
+
+        # 2) the fleet quiesces and every replica is back at baseline
+        assert fleet.wait_idle(timeout_s=60), "fleet never quiesced"
+        for url in fleet.replica_urls:
+            inv = check_replica(url)
+            assert inv["ok"], f"{url}: {inv['violations']}"
+
+        # 3) the router recovered the full fleet
+        deadline = time.time() + grace_s
+        routable = 0
+        while time.time() < deadline:
+            with urllib.request.urlopen(fleet.url + "/healthz",
+                                        timeout=5) as resp:
+                routable = json.loads(resp.read())["routable"]
+            if routable == 2:
+                break
+            time.sleep(0.5)
+        assert routable == 2, f"router never re-admitted: {routable}"
+
+        # 4) goodput recovered after the restart: the final window
+        #    must serve again (the kill window may legitimately shed)
+        wins = goodput_windows(
+            report, [0.0, kill_at, kill_at + restart_after, duration + 1])
+        tail = wins[-1]
+        assert tail["requests"] > 0, f"no post-restart demand: {wins}"
+        assert tail["ok_rate"] and tail["ok_rate"] >= 0.5, (
+            f"goodput never recovered: {wins}")
+    print(f"chaos OK: outcomes {report['outcomes']}, actions "
+          f"{sorted(acted)}, goodput windows "
+          f"{[(w['requests'], w['ok_rate']) for w in wins]}, "
+          "invariants clean, router back to 2 routable")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--kernels-only" in argv:
         return kernel_interpret_sweep()
+    if "--chaos" in argv:
+        return chaos_check()
     if "--serve-lifecycle" in argv:
         return serve_lifecycle_check()
     if "--serve-tbt" in argv:
