@@ -62,6 +62,118 @@ import numpy as np
 
 from pyspark_tf_gke_tpu.models.causal_lm import CausalLM, _prefill
 
+# ---------------------------------------------------------------------------
+# THE acceptance rule — one implementation site.
+#
+# Both speculative drivers here AND the continuous-batching engine's
+# in-slot speculation (train/continuous.py ``_spec_chunk``) accept a
+# draft proposal through these helpers; the standalone ``spec`` bench
+# workload is a thin caller of the same code, so the acceptance
+# semantics cannot drift between the latency tool and the serving
+# plane.
+# ---------------------------------------------------------------------------
+
+
+def greedy_accept_len(drafts, target_picks):
+    """Greedy acceptance: number of leading draft tokens that equal the
+    target's own pick at the position before them. ``drafts [..., k]``
+    vs ``target_picks [..., k]`` (the target's argmax at positions
+    0..k-1 of the verify chunk) -> ``[...]`` int32 accepted-prefix
+    length in [0, k]. Accepting exactly this prefix makes the emitted
+    stream PROVABLY identical to plain greedy decoding of the target
+    model — the draft affects speed only, never content."""
+    match = (drafts == target_picks).astype(jnp.int32)
+    return jnp.sum(jnp.cumprod(match, axis=-1), axis=-1)
+
+
+def emit_window(drafts, correction, accepted):
+    """Fixed-width emission window ``[..., k+1]``: positions below
+    ``accepted`` carry the accepted drafts, position ``accepted`` the
+    correction/bonus token, and the tail repeats the correction (static
+    shapes; callers mask or overwrite past the frontier). Shared by the
+    device-loop driver below and the engine's spec rounds."""
+    k = drafts.shape[-1]
+    iota = jnp.arange(k + 1, dtype=jnp.int32)
+    padded = jnp.concatenate(
+        [drafts, jnp.zeros_like(drafts[..., :1])], axis=-1)
+    return jnp.where(iota < accepted[..., None], padded,
+                     correction[..., None])
+
+
+def accept_and_correct(drafts, draft_logits, target_logits, *,
+                       temps=None, topps=None, keys=None, mesh=None):
+    """Batched accept + correct, one rule per sampling lane.
+
+    ``drafts [B, k]`` proposed tokens; ``draft_logits [B, k, V]`` the
+    logits each draft token was picked from; ``target_logits
+    [B, k+1, V]`` the verify chunk's logits (position i scores the
+    token AFTER feeding draft i-1). Returns ``(accepted [B],
+    correction [B])``.
+
+    Greedy rows (``temps == 0``): accept while the draft equals the
+    target argmax — exact. Sampling rows: the standard speculative
+    rejection rule (Leviathan et al.): draft token d_i sampled from
+    q_i is kept with probability min(1, p_i(d_i)/q_i(d_i)); on the
+    first rejection the correction samples from the residual
+    ``norm(max(p - q, 0))``, and a fully-accepted proposal samples the
+    bonus token from p_k directly (the q-at-k row is zero-padded, so
+    the residual formula degenerates to exactly p_k). Temperature and
+    top-p shape BOTH distributions identically, so the rule stays a
+    valid sampler for the filtered target distribution. ``keys``
+    ``[B, 2]`` uint32 threefry key data drives the uniforms and the
+    correction draw (greedy rows never read them); pass
+    ``temps=None`` for an all-greedy pool (the sampling math compiles
+    out). ``mesh``: on a tensor-parallel mesh the sampled path must
+    replicate the small [B, k(+1), V] working sets before the nucleus
+    sort/cumsum — the same guard as the engine's ``_pick_tokens``
+    (a vocab-sharded sort would compile fresh cross-process
+    collectives mid-serving, the documented 2-process-wire deadlock
+    class)."""
+    from pyspark_tf_gke_tpu.models.causal_lm import _filter_logits
+
+    k = drafts.shape[-1]
+    tgt_pick = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)
+    a_greedy = greedy_accept_len(drafts, tgt_pick[..., :k])
+    corr_greedy = jnp.take_along_axis(
+        tgt_pick, a_greedy[..., None], axis=-1)[..., 0]
+    if temps is None:
+        return a_greedy, corr_greedy
+
+    def dist(logits):
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None, None]
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            scaled = jax.lax.with_sharding_constraint(
+                scaled, NamedSharding(mesh, PartitionSpec()))
+        return jax.nn.softmax(
+            _filter_logits(scaled, None, topps[:, None, None]), axis=-1)
+
+    q = dist(draft_logits)                                 # [B, k, V]
+    p_full = dist(target_logits)                           # [B, k+1, V]
+    q_d = jnp.take_along_axis(q, drafts[..., None], -1)[..., 0]
+    p_d = jnp.take_along_axis(p_full[:, :k], drafts[..., None],
+                              -1)[..., 0]
+    base = jax.vmap(
+        lambda kd: jax.random.wrap_key_data(kd, impl="threefry2x32"))(keys)
+    u_keys = jax.vmap(lambda kk: jax.random.fold_in(kk, 0))(base)
+    c_keys = jax.vmap(lambda kk: jax.random.fold_in(kk, 1))(base)
+    u = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(u_keys)
+    ok = (u * jnp.maximum(q_d, 1e-20) < p_d).astype(jnp.int32)
+    a_samp = jnp.sum(jnp.cumprod(ok, axis=-1), axis=-1)
+    p_a = jnp.take_along_axis(p_full, a_samp[:, None, None],
+                              axis=1)[:, 0]                # [B, V]
+    q_pad = jnp.concatenate([q, jnp.zeros_like(q[:, :1])], axis=1)
+    q_a = jnp.take_along_axis(q_pad, a_samp[:, None, None],
+                              axis=1)[:, 0]
+    resid = jnp.maximum(p_a - q_a, 0.0)
+    resid = resid / jnp.maximum(resid.sum(-1, keepdims=True), 1e-20)
+    corr_samp = jax.vmap(jax.random.categorical)(
+        c_keys, jnp.log(jnp.maximum(resid, 1e-30))).astype(jnp.int32)
+    sampled = temps > 0
+    return (jnp.where(sampled, a_samp, a_greedy),
+            jnp.where(sampled, corr_samp, corr_greedy))
+
 
 def _set_cache_index(cache, value):
     """Return a cache pytree with every scalar ``index`` leaf set to
@@ -178,15 +290,14 @@ def _device_rounds(target_model: CausalLM, target_params,
             target_model, target_params, t_next, vchunk, t_fill)
         preds = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # [g+1]
 
-        # 4. greedy acceptance + fixed-width emit: positions < a carry
+        # 4. greedy acceptance + fixed-width emit (the shared rule:
+        #    greedy_accept_len / emit_window — one implementation with
+        #    the engine's in-slot speculation): positions < a carry
         #    accepted drafts, position a the correction token, and the
         #    tail repeats the correction — written past the frontier and
         #    overwritten by the next round's window.
-        match = (drafts[0] == preds[:-1]).astype(jnp.int32)
-        a = jnp.sum(jnp.cumprod(match))
-        padded = jnp.concatenate(
-            [drafts[0], jnp.zeros((1,), jnp.int32)])
-        window = jnp.where(iota < a, padded, preds[a])
+        a = greedy_accept_len(drafts[0], preds[:-1])
+        window = emit_window(drafts[0], preds[a], a)
         all_toks = jax.lax.dynamic_update_slice(
             all_toks, window[None], (0, s_prompt + n_emitted))
         if eos_token_id is not None:
@@ -376,10 +487,9 @@ def speculative_generate(
             jnp.argmax(logits, axis=-1)))[0]  # [g+1]
 
         # 3. greedy acceptance: d_i is kept iff it equals the target's
-        #    own argmax at the position before it.
-        a = 0
-        while a < g and drafts_host[a] == preds[a]:
-            a += 1
+        #    own argmax at the position before it (the ONE shared rule).
+        a = int(greedy_accept_len(jnp.asarray(drafts_host[:g]),
+                                  jnp.asarray(preds[:g])))
         accepted_total += a
         # emit accepted drafts + the target's correction/extension token
         emitted.extend(int(t) for t in drafts_host[:a])

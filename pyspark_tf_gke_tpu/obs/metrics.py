@@ -585,6 +585,22 @@ def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "serve_kv_page_alloc_failures_total",
             "Admission attempts deferred because the page pool could "
             "not cover the request (it stays queued)"),
+        # self-draft speculative decoding (in-slot draft/verify;
+        # zero unless the engine runs with --spec-tokens > 0)
+        "serve_spec_proposed_total": r.counter(
+            "serve_spec_proposed_total",
+            "Draft tokens proposed by the speculative decoder "
+            "(budget-capped: overshoot rounds past a request's budget "
+            "don't count)"),
+        "serve_spec_accepted_total": r.counter(
+            "serve_spec_accepted_total",
+            "Proposed draft tokens the verify pass accepted — each "
+            "one is a decode token that skipped its own full-model "
+            "forward"),
+        "serve_spec_accept_rate": r.gauge(
+            "serve_spec_accept_rate",
+            "Windowed draft acceptance rate (last 64 spec chunks) — "
+            "the /loadz `spec_accept_rate` routing/capacity signal"),
         # multi-tenant fairness / quotas (DWRR admission + per-tenant
         # token buckets; every request carries a tenant — "default"
         # when the client sends none, so single-tenant deployments

@@ -76,6 +76,15 @@ class FleetModel:
     # "no_replicas" until a backoff expires — which is exactly what a
     # measured flash crowd through the real router shows.
     router_backoff_s: float = 0.0
+    # speculative-decoding what-if (serve --spec-tokens k): when a
+    # calibration (or /loadz) provides a measured `spec_accept_rate`,
+    # the effective per-slot decode rate scales by (1 + k·accept_rate)
+    # — each accepted draft token is a decode token that skipped its
+    # own full-model forward, and the standard speculative-throughput
+    # estimate is exactly that multiplier on the verify-step rate.
+    # Both default to 0 (speculation off — no rate change).
+    spec_tokens: int = 0
+    spec_accept_rate: float = 0.0
 
     def validate(self) -> "FleetModel":
         if self.replicas < 1 or self.slots_per_replica < 1:
@@ -87,14 +96,24 @@ class FleetModel:
             raise ValueError("prefix_hit_rate must be in [0, 1)")
         if self.router_backoff_s < 0:
             raise ValueError("router_backoff_s must be >= 0")
+        if self.spec_tokens < 0:
+            raise ValueError("spec_tokens must be >= 0")
+        if not 0.0 <= self.spec_accept_rate <= 1.0:
+            raise ValueError("spec_accept_rate must be in [0, 1]")
         return self
+
+    def effective_decode_rate(self) -> float:
+        """Per-slot decode tokens/sec, speculation folded in: the base
+        (verify-step) rate × (1 + spec_tokens · spec_accept_rate)."""
+        return self.decode_tokens_per_sec * (
+            1.0 + self.spec_tokens * self.spec_accept_rate)
 
     def service_s(self, prompt_tokens: int, output_tokens: int) -> float:
         """Zero-load service time of one request — the closed form the
         zero-load test pins."""
         prefill = (prompt_tokens * (1.0 - self.prefix_hit_rate)
                    / self.prefill_tokens_per_sec)
-        decode = output_tokens / self.decode_tokens_per_sec
+        decode = output_tokens / self.effective_decode_rate()
         return self.overhead_ms / 1000.0 + prefill + decode
 
 
@@ -196,7 +215,7 @@ def predict(model: FleetModel, spec: WorkloadSpec, *,
         service = FleetModel.service_s(
             dataclasses.replace(model, prefix_hit_rate=hit_frac),
             r.prompt_tokens, r.output_tokens)
-        decode_s = r.output_tokens / model.decode_tokens_per_sec
+        decode_s = r.output_tokens / model.effective_decode_rate()
         sims.append(_SimRequest(arrival, r.tenant, tokens, pages,
                                 service, decode_s, deadline_abs))
 

@@ -121,6 +121,11 @@ class _Request:
     # time-between-tokens (serve_tbt_ms) clock; None until the first
     # tokens land (the first gap is TTFT, not TBT)
     last_emit: Optional[float] = None
+    # speculative decoding tallies (spec engines only): draft tokens
+    # proposed/accepted for THIS request while it still had budget —
+    # the per-request accept-rate span event's source
+    spec_proposed: int = 0
+    spec_accepted: int = 0
     # request-attached trace span (obs/trace.py, or None): the engine
     # annotates the request's OWN span — queue wait, admission route,
     # prefill pieces, first token, token deliveries — so the timeline
@@ -951,6 +956,52 @@ def _insert_slot(state: SlotState, cache1, logits1, slot, fill,
         keys=state.keys.at[slot].set(key))
 
 
+def _pick_tokens(logits, temps, topps, keys, *, sampling: bool,
+                 mesh=None):
+    """[B] next tokens from [B, V] logits: greedy rows argmax; sampling
+    rows categorical over their own scaled, nucleus-filtered
+    distribution with their OWN (already-folded) key — reusing the
+    parity oracle's _filter_logits (its top_p comparison broadcasts,
+    so a [B, 1] per-row mass works; topp=1 keeps everything). Shared
+    by the plain decode chunk and the speculative rounds so the two
+    lanes cannot drift."""
+    from pyspark_tf_gke_tpu.models.causal_lm import _filter_logits
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if not sampling:
+        # static: a pure-greedy pool compiles WITHOUT the per-step
+        # [B, V] sort/softmax/cumsum/categorical (the dominant
+        # serving path pays one argmax, as before sampling existed)
+        return greedy
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    if mesh is not None:
+        # replicate the tiny [B, V] working set first: the nucleus
+        # sort/cumsum over a tp-sharded vocab axis would otherwise
+        # compile NEW cross-process collective patterns, and the
+        # per-row categorical brings nothing worth sharding — the
+        # replicated math keeps the sampled chunk collective-free
+        # beyond what the greedy program already does (a fresh
+        # communicator mid-serving deadlocked the 2-process wire).
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        scaled = jax.lax.with_sharding_constraint(
+            scaled, NamedSharding(mesh, PartitionSpec()))
+    filtered = _filter_logits(scaled, None, topps[:, None])
+    sampled = jax.vmap(jax.random.categorical)(keys, filtered)
+    return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+
+
+def _fold_slot_keys(keys_data, n: int):
+    """Fold every slot's threefry key forward by ``n`` and return
+    ``(new key data [B, 2], key objects [B])`` — the per-use PRNG
+    discipline of the sampling lanes."""
+    keys = jax.vmap(
+        lambda kd: jax.random.fold_in(
+            jax.random.wrap_key_data(kd, impl="threefry2x32"), n))(
+                keys_data)
+    return jax.vmap(jax.random.key_data)(keys), keys
+
+
 @functools.partial(
     jax.jit, static_argnames=("model", "chunk", "eos_token_id", "pad_id",
                               "sampling", "mesh"))
@@ -971,7 +1022,6 @@ def _decode_chunk(model: CausalLM, params, state: SlotState, *,
     step); temp-0 rows take the argmax, and their token stream is
     bit-identical to an all-greedy chunk (the sampling lanes touch
     nothing they read)."""
-    from pyspark_tf_gke_tpu.models.causal_lm import _filter_logits
     from pyspark_tf_gke_tpu.ops.quant import (dequantize_embeddings,
                                               inloop_dequantize,
                                               is_quantized)
@@ -980,33 +1030,8 @@ def _decode_chunk(model: CausalLM, params, state: SlotState, *,
     p = dequantize_embeddings(params) if quantized else params
 
     def pick(logits, temps, topps, keys):
-        """[B] tokens: greedy rows argmax; sampling rows categorical
-        over their own scaled, nucleus-filtered distribution — reusing
-        the parity oracle's _filter_logits (its top_p comparison
-        broadcasts, so a [B, 1] per-row mass works; topp=1 keeps
-        everything)."""
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        if not sampling:
-            # static: a pure-greedy pool compiles WITHOUT the per-step
-            # [B, V] sort/softmax/cumsum/categorical (the dominant
-            # serving path pays one argmax, as before sampling existed)
-            return greedy
-        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-        if mesh is not None:
-            # replicate the tiny [B, V] working set first: the nucleus
-            # sort/cumsum over a tp-sharded vocab axis would otherwise
-            # compile NEW cross-process collective patterns, and the
-            # per-row categorical brings nothing worth sharding — the
-            # replicated math keeps the sampled chunk collective-free
-            # beyond what the greedy program already does (a fresh
-            # communicator mid-serving deadlocked the 2-process wire).
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            scaled = jax.lax.with_sharding_constraint(
-                scaled, NamedSharding(mesh, PartitionSpec()))
-        filtered = _filter_logits(scaled, None, topps[:, None])
-        sampled = jax.vmap(jax.random.categorical)(keys, filtered)
-        return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+        return _pick_tokens(logits, temps, topps, keys,
+                            sampling=sampling, mesh=mesh)
 
     def step(carry, _):
         st = carry
@@ -1052,6 +1077,278 @@ def _decode_chunk(model: CausalLM, params, state: SlotState, *,
     return state, toks.T  # [B, chunk]
 
 
+# -- self-draft speculative decoding (in-slot draft/verify) -------------------
+#
+# Per slot, a cheap DRAFT model (a small companion bundle, or the target
+# itself — "self-draft" — when none is configured) proposes
+# ``spec_tokens`` continuation tokens, then ONE multi-query verify
+# forward of the target scores all k+1 positions through the SAME
+# chunked slot-decode path chunked prefill uses (paged engines: the
+# ``paged_attention_chunk`` kernel — verify IS the S>1 chunk program, no
+# new kernel). Accepted tokens advance each slot's fill counter;
+# rejected ones roll back by simply NOT advancing it — pages are
+# append-only and the position mask hides rows past the fill, so
+# rollback is free and the garbage rows are overwritten by the next
+# round's writes at the same positions. The acceptance rule lives in
+# ``models/speculative.py`` (greedy exact; sampled lanes use the
+# standard rejection rule) — ONE implementation shared with the
+# standalone ``spec`` workload.
+#
+# The draft runs a DENSE slot cache of its own (``[num_slots,
+# draft_max_seq, ...]`` rows sharing the target's per-slot fill
+# counters): drafts are cheap and transient, and a paged draft pool
+# would double the page-accounting surface for no bandwidth win. Draft
+# contents NEVER affect correctness — a cold/garbage draft row just
+# proposes tokens the verify rejects.
+
+
+@functools.partial(jax.jit, static_argnames=("model", "num_slots"))
+def _draft_zeros_cache(model: CausalLM, params, *, num_slots: int):
+    """Fresh dense draft slot cache, built by one throwaway slot-decode
+    forward (the same template trick as ``_paged_zeros_state``) and
+    zeroed."""
+    from pyspark_tf_gke_tpu.ops.quant import dequantize_tree
+
+    tok = jnp.zeros((num_slots, 1), jnp.int32)
+    pos = jnp.zeros((num_slots, 1), jnp.int32)
+    _, mutated = model.apply(
+        {"params": dequantize_tree(params)}, tok, decode=True,
+        slot_decode=True, positions=pos, mutable=["cache"])
+    return jax.tree.map(jnp.zeros_like, mutated["cache"])
+
+
+@jax.jit
+def _insert_draft_row(dcache, cache1, slot):
+    """Drop a batch-1 draft prefill's cache rows into draft slot
+    ``slot`` (the draft-side analog of ``_insert_slot``'s cache move;
+    dense prefill caches are full ``max_seq_len`` rows, so shapes line
+    up by construction)."""
+    return jax.tree.map(
+        lambda big, row: (jnp.maximum(big, row) if row.ndim == 0
+                          else big.at[slot].set(row[0])),
+        dcache, cache1)
+
+
+@jax.jit
+def _insert_draft_rows_batch(dcache, caches, slots):
+    """Batched draft-row insert (rides the batched-admission fast
+    path); pad rows carry the out-of-bounds slot sentinel and drop."""
+    return jax.tree.map(
+        lambda big, rows: (jnp.maximum(big, rows) if rows.ndim == 0
+                           else big.at[slots].set(rows, mode="drop")),
+        dcache, caches)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "draft_model", "rounds", "k",
+                              "eos_token_id", "pad_id", "sampling",
+                              "mesh"))
+def _spec_chunk(model: CausalLM, params, draft_model: CausalLM,
+                draft_params, state: SlotState, dcache, *, rounds: int,
+                k: int, eos_token_id: Optional[int], pad_id: int,
+                sampling: bool = False, mesh=None):
+    """``rounds`` speculative draft/verify rounds for ALL slots in one
+    dispatch — the spec-mode replacement for ``_decode_chunk``.
+
+    Structure (per round, batched over slots): the carried PENDING
+    token (emitted last round/entry, not yet fed) seeds a draft scan of
+    k+1 single-token draft forwards proposing d_1..d_k (the final
+    proposal is fed too, so the draft cache never gaps on a fully
+    accepted round), then ONE (k+1)-wide verify forward of the target
+    feeds [pending, d_1..d_k] at positions fill..fill+k — writing their
+    K/V and scoring every position through the chunked slot-decode
+    path. ``accept_and_correct`` (models/speculative.py) yields the
+    accepted length and the correction/bonus token; the round emits
+    [d_1..d_a, correction] (1..k+1 tokens), advances fill by exactly
+    the emitted count (rejected rows beyond stay invisible — rollback
+    is the fill counter), and eos anywhere in the window truncates it
+    and drops the row live flag, mirroring the plain chunk's
+    emit-then-latch order.
+
+    Entry emits one token from the carried logits (exactly a plain
+    step's emit) to seed the first pending; exit feeds the final
+    pending token through target AND draft (one single-token step) so
+    ``last_logits``/``positions`` leave in the plain chunk's invariant
+    — spec and non-spec chunks interleave freely and admissions see an
+    unchanged contract.
+
+    Returns ``(state, dcache, packed)`` where ``packed`` is ONE int32
+    array ``[rounds·(k+1) + 3·rounds + 2, B]`` stacking the per-round
+    emission windows, their valid lengths (the host-side compaction
+    gate — window tails past it are pad), the accepted/proposed counts
+    (the accept-rate plane) and the entry-token/final-live rows — one
+    device→host transfer (one gather on multi-process meshes) per
+    collect instead of six. ``_unpack_spec`` is the host-side
+    inverse."""
+    from pyspark_tf_gke_tpu.models.speculative import (accept_and_correct,
+                                                       emit_window)
+    from pyspark_tf_gke_tpu.ops.quant import (dequantize_embeddings,
+                                              inloop_dequantize,
+                                              is_quantized)
+
+    t_quant = is_quantized(params)
+    p_t = dequantize_embeddings(params) if t_quant else params
+    d_quant = is_quantized(draft_params)
+    p_d = dequantize_embeddings(draft_params) if d_quant else draft_params
+    b = state.live.shape[0]
+    width = k + 1
+    iota_w = jnp.arange(width, dtype=jnp.int32)
+
+    def tparams():
+        return inloop_dequantize(p_t) if t_quant else p_t
+
+    def dparams():
+        return inloop_dequantize(p_d) if d_quant else p_d
+
+    # entry: emit one token from the carried logits (the plain chunk's
+    # emit-then-step order — the eos token itself belongs to the output)
+    keys_data = state.keys
+    if sampling:
+        keys_data, keys = _fold_slot_keys(keys_data, 1)
+    else:
+        keys = None
+    t0 = _pick_tokens(state.last_logits, state.temps, state.topps, keys,
+                      sampling=sampling, mesh=mesh)
+    live0 = state.live
+    entry_tok = jnp.where(live0, t0, pad_id)
+    live = live0
+    if eos_token_id is not None:
+        live = live & (t0 != eos_token_id)
+    pending = jnp.where(live, t0, pad_id)
+
+    def round_fn(carry, _):
+        cache, dc, positions, live, pending, keys_data = carry
+
+        # 1. draft: k+1 cheap single-token forwards propose d_1..d_k
+        #    (feeding pending first, then each proposal — including
+        #    d_k, whose K/V a fully-accepted round needs next time)
+        def dstep(dcarry, j):
+            dc, cur, kd = dcarry
+            feed = jnp.where(live, cur, pad_id)
+            logits, mutated = draft_model.apply(
+                {"params": dparams(), "cache": dc}, feed[:, None],
+                decode=True, slot_decode=True,
+                positions=(positions + j)[:, None], mutable=["cache"])
+            lg = logits[:, 0]
+            if sampling:
+                kd, kk = _fold_slot_keys(kd, 3)
+            else:
+                kk = None
+            nxt = _pick_tokens(lg, state.temps, state.topps, kk,
+                               sampling=sampling, mesh=mesh)
+            return (mutated["cache"], nxt, kd), (nxt, lg)
+
+        (dc, d_last, dkd), (draft_toks, draft_logits) = jax.lax.scan(
+            dstep, (dc, pending, keys_data),
+            jnp.arange(k, dtype=jnp.int32))
+        if sampling:
+            keys_data = dkd
+        drafts = draft_toks.T                              # [B, k]
+        dlogits = jnp.moveaxis(draft_logits, 0, 1)         # [B, k, V]
+        # feed the final proposal d_k too (cache rows only — nobody
+        # reads these logits, and return_hidden skips the lm_head)
+        _, mutated = draft_model.apply(
+            {"params": dparams(), "cache": dc},
+            jnp.where(live, d_last, pad_id)[:, None], decode=True,
+            slot_decode=True, positions=(positions + k)[:, None],
+            return_hidden=True, mutable=["cache"])
+        dc = mutated["cache"]
+
+        # 2. verify: ONE (k+1)-wide chunk forward writes K/V for
+        #    [pending, d_1..d_k] at fill..fill+k and scores every
+        #    position (paged: the paged_attention_chunk S>1 program;
+        #    dead rows feed pad at frozen consecutive positions —
+        #    their writes drop via the sentinel table / land past the
+        #    fill mask)
+        vchunk = jnp.concatenate([pending[:, None], drafts], axis=1)
+        vchunk = jnp.where(live[:, None], vchunk, pad_id)
+        pos_v = positions[:, None] + iota_w[None, :]
+        logits_v, mutated = model.apply(
+            {"params": tparams(), "cache": cache}, vchunk, decode=True,
+            slot_decode=True, positions=pos_v, mutable=["cache"])
+        cache = mutated["cache"]
+
+        # 3. accept + correct (THE shared rule)
+        if sampling:
+            keys_data, akeys = _fold_slot_keys(keys_data, 4)
+            adata = jax.vmap(jax.random.key_data)(akeys)
+            a, correction = accept_and_correct(
+                drafts, dlogits, logits_v, temps=state.temps,
+                topps=state.topps, keys=adata, mesh=mesh)
+        else:
+            a, correction = accept_and_correct(drafts, dlogits, logits_v)
+
+        # 4. emit window + eos latch + fill advance (= rollback)
+        window = emit_window(drafts, correction, a)        # [B, k+1]
+        if eos_token_id is not None:
+            is_eos = (window == eos_token_id) & (iota_w[None]
+                                                 <= a[:, None])
+            any_eos = jnp.any(is_eos, axis=1)
+            eos_idx = jnp.argmax(is_eos, axis=1)
+            vlen = jnp.where(any_eos, eos_idx + 1, a + 1)
+            newlive = live & jnp.logical_not(any_eos)
+        else:
+            vlen = a + 1
+            newlive = live
+        vlen = jnp.where(live, vlen, 0)
+        emitted = jnp.where(iota_w[None] < vlen[:, None], window, pad_id)
+        # fed-valid rows this round = pending + the accepted drafts
+        # before any eos — exactly the emitted count (the correction is
+        # emitted-not-fed, eos is emitted-not-fed; both balance out)
+        positions = positions + vlen
+        proposed = jnp.where(live, k, 0).astype(jnp.int32)
+        accepted = jnp.where(live, a, 0).astype(jnp.int32)
+        pending = jnp.where(newlive, correction, pad_id)
+        return ((cache, dc, positions, newlive, pending, keys_data),
+                (emitted, vlen, accepted, proposed))
+
+    init = (state.cache, dcache, state.positions, live, pending,
+            keys_data)
+    ((cache, dcache, positions, live, pending, keys_data),
+     (windows, wlens, accepted, proposed)) = jax.lax.scan(
+        round_fn, init, None, length=rounds)
+
+    # exit: feed the final pending token through target AND draft so the
+    # carried state leaves in the plain chunk's invariant (last_logits
+    # predicts the next unemitted token; every emitted token is fed)
+    step_tok = jnp.where(live, pending, pad_id)
+    logits, mutated = model.apply(
+        {"params": tparams(), "cache": cache}, step_tok[:, None],
+        decode=True, slot_decode=True, positions=positions[:, None],
+        mutable=["cache"])
+    _, dmut = draft_model.apply(
+        {"params": dparams(), "cache": dcache}, step_tok[:, None],
+        decode=True, slot_decode=True, positions=positions[:, None],
+        return_hidden=True, mutable=["cache"])
+    state = state._replace(
+        cache=mutated["cache"],
+        positions=jnp.where(live, positions + 1, positions),
+        last_logits=logits[:, 0],
+        live=live,
+        keys=keys_data)
+    packed = jnp.concatenate([
+        windows.transpose(0, 2, 1).reshape(rounds * width, b),
+        wlens, accepted, proposed,
+        entry_tok[None].astype(jnp.int32),
+        state.live.astype(jnp.int32)[None]], axis=0)
+    return state, dmut["cache"], packed
+
+
+def _unpack_spec(packed: np.ndarray, k: int):
+    """Host-side inverse of ``_spec_chunk``'s packed output: returns
+    ``(entry_tok [B], windows [rounds, k+1, B], wlens [rounds, B],
+    accepted [rounds, B], proposed [rounds, B], live [B] bool)``."""
+    width = k + 1
+    rounds = (packed.shape[0] - 2) // (width + 3)
+    wrows = rounds * width
+    windows = packed[:wrows].reshape(rounds, width, -1)
+    wlens = packed[wrows:wrows + rounds]
+    accepted = packed[wrows + rounds:wrows + 2 * rounds]
+    proposed = packed[wrows + 2 * rounds:wrows + 3 * rounds]
+    return (packed[-2], windows, wlens, accepted, proposed,
+            packed[-1] > 0)
+
+
 class SlotDeviceState:
     """The engine's DEVICE half: the slot arrays plus the three
     replayable ops that mutate them (admit / chunk / free). Split from
@@ -1067,12 +1364,116 @@ class SlotDeviceState:
     process-0 afterthought."""
 
     def __init__(self, model: CausalLM, params, num_slots: int,
-                 mesh=None):
+                 mesh=None, draft_model: Optional[CausalLM] = None,
+                 draft_params=None, spec_tokens: int = 0):
         self.model, self.params = model, params
         self.num_slots = num_slots
         self.mesh = mesh
         self.paged = bool(getattr(model.cfg, "paged_kv", False))
         self.state: Optional[SlotState] = None
+        # speculative decoding: the draft pair + its dense slot cache.
+        # No draft configured -> SELF-draft (the target proposes for
+        # itself through a dense shadow cache — zero-config correctness
+        # mode; a small companion bundle is the perf configuration).
+        # Resolution is LAZY so worker replicas built before any spec
+        # op (spec_tokens unknown until the first spec chunk header)
+        # stay cheap.
+        self.spec_tokens = int(spec_tokens)
+        self.draft_model, self.draft_params = draft_model, draft_params
+        self._draft_resolved = False
+        self.draft_cache = None
+        if draft_model is not None or self.spec_tokens:
+            self._resolve_draft()
+
+    def _resolve_draft(self) -> None:
+        if self.draft_model is None:
+            self.draft_model, self.draft_params = self.model, self.params
+        if getattr(self.draft_model.cfg, "paged_kv", False):
+            # the draft always runs the dense slot-cache layout: cheap,
+            # transient, and never part of the page-pool accounting
+            import dataclasses as _dc
+
+            self.draft_model = CausalLM(
+                _dc.replace(self.draft_model.cfg, kv_num_pages=None),
+                self.draft_model.mesh)
+        self._draft_resolved = True
+
+    def _ensure_draft_cache(self) -> None:
+        if not self._draft_resolved:
+            self._resolve_draft()
+        if self.draft_cache is None:
+            self.draft_cache = _draft_zeros_cache(
+                self.draft_model, self.draft_params,
+                num_slots=self.num_slots)
+
+    def draft_prefill_row(self, padded: np.ndarray, true_len: int,
+                          slot: int) -> None:
+        """Prefill the DRAFT model on the full (right-padded) prompt
+        and drop its cache rows into draft slot ``slot`` — the draft's
+        half of an admission (replayed on workers via the OP_CB_ADMIT
+        draft payload). ``padded`` width must fit the draft's
+        max_seq_len (the engine skips the call for prompts that
+        don't — a cold draft row only costs acceptance, never
+        correctness)."""
+        with self._mesh_ctx():
+            self._ensure_draft_cache()
+            cache1, _ = _prefill_padded(
+                self.draft_model, self.draft_params, jnp.asarray(padded),
+                jnp.asarray(true_len, jnp.int32))
+            self.draft_cache = _insert_draft_row(
+                self.draft_cache, cache1, jnp.asarray(slot, jnp.int32))
+
+    def draft_prefill_rows_batch(self, padded: np.ndarray, true_lens,
+                                 slots) -> None:
+        """Batched draft prefill for the batched-admission fast path
+        (single-host only, like the target-side batch admit)."""
+        k, k_pad = len(slots), padded.shape[0]
+        slot_idx = np.full((k_pad,), self.num_slots, np.int32)
+        slot_idx[:k] = slots
+        with self._mesh_ctx():
+            self._ensure_draft_cache()
+            caches, _ = _prefill_padded_batch(
+                self.draft_model, self.draft_params, jnp.asarray(padded),
+                jnp.asarray(true_lens, jnp.int32))
+            self.draft_cache = _insert_draft_rows_batch(
+                self.draft_cache, caches, jnp.asarray(slot_idx))
+
+    def spec_chunk_async(self, rounds: int, eos_token_id: Optional[int],
+                         pad_id: int, sampling: bool = False,
+                         k: Optional[int] = None):
+        """Dispatch one speculative chunk (``rounds`` draft/verify
+        rounds over all slots) WITHOUT reading back: returns a 1-tuple
+        holding the PACKED int32 result array (``_unpack_spec`` is the
+        host-side inverse) — the spec analog of :meth:`chunk_async`.
+        ``k`` overrides the construction-time spec width (worker
+        replicas learn it from each chunk header)."""
+        with self._mesh_ctx():
+            self._ensure_draft_cache()
+            self.state, self.draft_cache, packed = _spec_chunk(
+                self.model, self.params, self.draft_model,
+                self.draft_params, self.state, self.draft_cache,
+                rounds=rounds,
+                k=int(k) if k is not None else self.spec_tokens,
+                eos_token_id=eos_token_id, pad_id=pad_id,
+                sampling=sampling, mesh=self.mesh)
+            return (packed,)
+
+    def fetch_tuple(self, arrays):
+        """Materialize a dispatched chunk's device arrays on the host
+        (any arity — a plain chunk is (tokens, live), a spec chunk ONE
+        packed array; gathered on multi-process meshes so every
+        process reads them)."""
+        from pyspark_tf_gke_tpu.parallel.distributed import as_host_array
+
+        with self._mesh_ctx():
+            return tuple(np.asarray(as_host_array(a)) for a in arrays)
+
+    def spec_chunk(self, rounds: int, eos_token_id: Optional[int],
+                   pad_id: int, sampling: bool = False,
+                   k: Optional[int] = None):
+        """Dispatch + immediate readback (unpipelined spec path)."""
+        return self.fetch_tuple(self.spec_chunk_async(
+            rounds, eos_token_id, pad_id, sampling=sampling, k=k))
 
     def _mesh_ctx(self):
         return self.mesh if self.mesh is not None else (
@@ -1254,13 +1655,8 @@ class SlotDeviceState:
     def fetch(self, toks, live):
         """Materialize a dispatched chunk's results on the host —
         gathered on multi-process meshes so every process can read
-        them."""
-        from pyspark_tf_gke_tpu.parallel.distributed import as_host_array
-
-        with self._mesh_ctx():
-            toks_host = np.asarray(as_host_array(toks))
-            live_host = np.asarray(as_host_array(live))
-        return toks_host, live_host
+        them (the two-array plain-chunk case of :meth:`fetch_tuple`)."""
+        return self.fetch_tuple((toks, live))
 
     def chunk(self, chunk: int, eos_token_id: Optional[int],
               pad_id: int, sampling: bool = False):
@@ -1305,6 +1701,9 @@ class ContinuousEngine:
                  batch_admit: bool = True,
                  schedule: str = "fifo",
                  tenant_weights: Optional[Dict[str, float]] = None,
+                 spec_tokens: int = 0,
+                 draft_model: Optional[CausalLM] = None,
+                 draft_params=None,
                  obs=None):
         if num_slots < 1 or chunk < 1:
             raise ValueError("num_slots and chunk must be >= 1")
@@ -1490,7 +1889,33 @@ class ContinuousEngine:
         self._n_finished = 0  # counter, not a list: a
         # long-lived server must not retain every prompt it ever served
         self._n_deadline_expired = 0
-        self._device = SlotDeviceState(model, params, num_slots, mesh)
+        # -- self-draft speculation: k draft proposals per slot-round,
+        # ONE multi-query verify chunk, accepted tokens advance the
+        # fill, rejected ones roll it back (see _spec_chunk) -----------
+        if spec_tokens < 0:
+            raise ValueError(
+                f"spec_tokens must be >= 0, got {spec_tokens}")
+        self.spec_tokens = int(spec_tokens)
+        self._spec = self.spec_tokens > 0
+        if (draft_model is not None
+                and draft_model.cfg.vocab_size != model.cfg.vocab_size):
+            raise ValueError(
+                f"draft vocab {draft_model.cfg.vocab_size} != target "
+                f"vocab {model.cfg.vocab_size}: the models must share "
+                f"a tokenizer")
+        self._self_draft = self._spec and draft_model is None
+        self._n_spec_proposed = 0
+        self._n_spec_accepted = 0
+        self._n_spec_rounds = 0
+        # windowed accept-rate (last 64 collected spec chunks): the
+        # /loadz `spec_accept_rate` signal — a pool gone cold stops
+        # advertising its warm past, like the radix hit-rate window
+        self._spec_window: Deque = deque(maxlen=64)
+        self._device = SlotDeviceState(
+            model, params, num_slots, mesh,
+            draft_model=draft_model if self._spec else None,
+            draft_params=draft_params if self._spec else None,
+            spec_tokens=self.spec_tokens)
         # shared metrics plane: slot occupancy + useful-token counters
         # (the cb bench's useful_tokens/sec, now scrapable live). One
         # lock op per CHUNK, not per token — hot-path safe. ``obs``
@@ -1873,6 +2298,33 @@ class ContinuousEngine:
         if self.paged:
             self._release_pages(slot)
 
+    def _draft_payload(self, req: _Request):
+        """``(padded [1, w], true_len)`` for the admission's draft
+        prefill, or None when speculation is off or the prompt cannot
+        fit the draft's context (the slot then runs on a COLD draft
+        row: proposals are garbage the verify rejects — slower, never
+        wrong). Width discipline mirrors the dense extend paths:
+        engine buckets first, then 32-multiples, bounded by the
+        draft's max_seq_len."""
+        if not self._spec:
+            return None
+        d_max = self._device.draft_model.cfg.max_seq_len
+        n = int(req.prompt.size)
+        if n >= d_max:
+            return None
+        cands = [x for x in self.buckets if n <= x <= d_max]
+        w = min(cands) if cands else min(-(-n // 32) * 32, d_max)
+        return right_pad(req.prompt, w, self.pad_id), n
+
+    def _draft_admit(self, slot: int, req: _Request) -> None:
+        """Draft prefill for admission routes that are single-host by
+        construction (dense prefix-hit / dense chunked / batch admit
+        fallback) — announce-mode routes ride the OP_CB_ADMIT draft
+        payload instead."""
+        dp = self._draft_payload(req)
+        if dp is not None:
+            self._device.draft_prefill_row(dp[0], dp[1], slot)
+
     def _try_admit(self, slot: int, req: _Request) -> bool:
         """Admit ``req`` into ``slot`` — immediately, via the prefix
         cache, or by STARTING a piecewise (chunked-prefill) admission.
@@ -1911,6 +2363,14 @@ class ContinuousEngine:
             sampling = (float(req.temperature),
                         float(req.top_p if req.top_p is not None else 1.0),
                         int(req.seed))
+            dp = self._draft_payload(req)
+
+            def device_admit():
+                self._device.admit_padded(
+                    padded, req.prompt.size, slot, *sampling, pages=row)
+                if dp is not None:
+                    self._device.draft_prefill_row(dp[0], dp[1], slot)
+
             try:
                 # chaos: crash BETWEEN page allocation and the prefill
                 # landing — the refcount-discipline audit point (the
@@ -1920,10 +2380,8 @@ class ContinuousEngine:
                     lambda wire: wire.announce_cb_admit(
                         self.num_slots, padded, req.prompt.size, slot,
                         self.eos_token_id, self.pad_id, sampling=sampling,
-                        pages=row),
-                    lambda: self._device.admit_padded(
-                        padded, req.prompt.size, slot, *sampling,
-                        pages=row))
+                        pages=row, draft=dp),
+                    device_admit)
             except BaseException:
                 # a failed admit must not leak its pages: the caller may
                 # catch and keep driving this engine, and leaked pages
@@ -1985,6 +2443,7 @@ class ContinuousEngine:
             self._trace_admit(req, slot, "prefix",
                               prefix_hit_tokens=hit[0])
             self._admit_from_prefix(slot, req, *hit)
+            self._draft_admit(slot, req)  # single-host path (guarded)
             self._slots[slot] = req
             return True
         sb = bucket_length(req.prompt.size, self.buckets)
@@ -1992,12 +2451,20 @@ class ContinuousEngine:
         sampling = (float(req.temperature),
                     float(req.top_p if req.top_p is not None else 1.0),
                     int(req.seed))
+        dp = self._draft_payload(req)
+
+        def device_admit():
+            self._device.admit_padded(
+                padded, req.prompt.size, slot, *sampling)
+            if dp is not None:
+                self._device.draft_prefill_row(dp[0], dp[1], slot)
+
         self._announced(
             lambda wire: wire.announce_cb_admit(
                 self.num_slots, padded, req.prompt.size, slot,
-                self.eos_token_id, self.pad_id, sampling=sampling),
-            lambda: self._device.admit_padded(
-                padded, req.prompt.size, slot, *sampling))
+                self.eos_token_id, self.pad_id, sampling=sampling,
+                draft=dp),
+            device_admit)
         self._n_prefill_tokens += int(req.prompt.size)
         self._slots[slot] = req
         self._trace_admit(req, slot, "dense")
@@ -2109,6 +2576,8 @@ class ContinuousEngine:
                 temperature=float(req.temperature),
                 top_p=float(req.top_p if req.top_p is not None else 1.0),
                 seed=int(req.seed))
+            self._draft_admit(a["slot"], req)  # dense chunked:
+            #   single-host by construction (guarded in __init__)
             self._slots[a["slot"]] = req
             self._admitting = None
 
@@ -2230,6 +2699,7 @@ class ContinuousEngine:
                     float(req.top_p if req.top_p is not None else 1.0),
                     int(req.seed))
         cow = a["cow"]
+        dp = self._draft_payload(req) if final else None
 
         def device():
             if cow is not None:
@@ -2240,6 +2710,14 @@ class ContinuousEngine:
                 self._device.activate_slot(
                     a["slot"], req.prompt.size, logits1, a["row"],
                     *sampling)
+                if dp is not None:
+                    # the draft's context spans the WHOLE prompt (the
+                    # radix match boundary included — shared pages
+                    # never cross into the draft's dense rows), so the
+                    # final piece carries the full prompt as the draft
+                    # payload
+                    self._device.draft_prefill_row(dp[0], dp[1],
+                                                   a["slot"])
 
         try:
             self._announced(
@@ -2248,7 +2726,7 @@ class ContinuousEngine:
                     self.eos_token_id, self.pad_id,
                     sampling=sampling if final else None,
                     pages=a["row"], chunk_fill=fill, final=final,
-                    cow=cow),
+                    cow=cow, draft=dp),
                 device)
         except BaseException:
             # a failed piece must not leak the admission's pages (the
@@ -2301,7 +2779,8 @@ class ContinuousEngine:
             return
         s_max = self.model.cfg.max_seq_len
         if (req.prompt.size + req.max_new_tokens
-                + (self.pipeline_depth + 1) * self.chunk >= s_max):
+                + (self.pipeline_depth + 1) * self._chunk_token_bound()
+                >= s_max):
             return
         toks = [int(t) for t in req.prompt] + list(req.tokens)
         if (self.eos_token_id is not None and toks
@@ -2381,6 +2860,20 @@ class ContinuousEngine:
         try:
             self._device.admit_padded_batch(padded, lens, free[:k],
                                             samplings, pages=pages_b)
+            if self._spec:
+                d_max = self._device.draft_model.cfg.max_seq_len
+                if sb0 <= d_max:
+                    # the group's shared bucket fits the draft: one
+                    # batched draft prefill (pad rows drop like the
+                    # target-side scatter)
+                    self._device.draft_prefill_rows_batch(
+                        padded, lens, free[:k])
+                else:
+                    # bucket too wide for the draft — fall back to the
+                    # per-request width discipline (skipping prompts
+                    # that cannot fit at all: cold rows, never wrong)
+                    for slot, req in zip(free[:k], group):
+                        self._draft_admit(slot, req)
         except BaseException:
             for taken in takens:  # failed admit must not leak pages
                 self._unref_pages(taken)
@@ -2575,6 +3068,17 @@ class ContinuousEngine:
                     + _request_cost(req))
             self._n_solo_admits += 1
 
+    def _chunk_token_bound(self) -> int:
+        """Upper bound on per-slot fill advance from ONE dispatched
+        chunk — the decode-overshoot term the near-context-limit radix
+        guard uses. Plain chunks advance by at most ``chunk``; a spec
+        chunk by 1 (entry) + rounds x (k+1) accepted+correction
+        tokens (+1 exit feed)."""
+        if not self._spec:
+            return self.chunk
+        k = self.spec_tokens
+        return 2 + max(1, self.chunk // (k + 1)) * (k + 1)
+
     # -- the loop --------------------------------------------------------
     def _effective_chunk(self) -> int:
         """Chunk size for the next dispatch. Fixed mode: ``self.chunk``.
@@ -2642,6 +3146,8 @@ class ContinuousEngine:
         chaos_fire("engine.device_step")
         any_sampling = any(r.temperature > 0
                            for r in self._slots.values())
+        if self._spec:
+            return self._dispatch_spec(size, any_sampling)
         self._n_dispatched_steps += size
         if self.announce and not self.pipeline_depth:
             toks, live = self._announced(
@@ -2661,19 +3167,126 @@ class ContinuousEngine:
                 sampling=any_sampling))
         return "dev", toks_dev, live_dev, dict(self._slots), size
 
+    def _spec_rounds(self, size: int, cap: Optional[int]) -> int:
+        """Draft/verify rounds for one spec dispatch. ``size`` bounds
+        the EMITTED tokens per slot (the chunk semantics: fixed chunk
+        or the adaptive remaining-budget size); ``cap`` (step-token
+        budget) bounds the device WORK per slot — each round costs
+        ~2k+2 forward tokens (k+1 draft feeds + the k+1-wide verify),
+        so draft AND verify tokens both count against the budget.
+        Power-of-two bucketed (jit cache discipline), floored at 1 so
+        the engine always makes progress."""
+        k = self.spec_tokens
+        r = max(1, size // (k + 1))
+        if cap is not None:
+            r = min(r, max(1, cap // (2 * k + 2)))
+        b = 1
+        while b * 2 <= r:
+            b *= 2
+        return b
+
+    def _dispatch_spec(self, rounds: int, any_sampling: bool):
+        """Spec-mode dispatch: ``rounds`` draft/verify rounds over the
+        current slots, on the same announce/deferred discipline as the
+        plain chunk (OP_CB_CHUNK header slot 7 carries spec_tokens,
+        slot 3 the round count — workers replay the identical spec
+        program; accepted counts ride the collect gathers, which is
+        what keeps worker fill counters/block tables bit-identical)."""
+        k = self.spec_tokens
+        # device-work accounting: (k+1) draft feeds + (k+1) verify
+        # positions per round, + the entry/exit feeds — the spec analog
+        # of "decode steps dispatched"
+        self._n_dispatched_steps += rounds * (2 * k + 2) + 2
+        self._n_spec_rounds += rounds
+        adv = 1 + rounds * (k + 1)  # max tokens emitted per slot
+        if self.announce and not self.pipeline_depth:
+            out = self._announced(
+                lambda wire: wire.announce_cb_chunk(
+                    self.num_slots, rounds, self.eos_token_id,
+                    self.pad_id, sampling=any_sampling,
+                    spec_tokens=k),
+                lambda: self._device.spec_chunk(
+                    rounds, self.eos_token_id, self.pad_id,
+                    sampling=any_sampling))
+            return "spec_host", out, None, dict(self._slots), adv
+        out = self._announced(
+            lambda wire: wire.announce_cb_chunk(
+                self.num_slots, rounds, self.eos_token_id,
+                self.pad_id, sampling=any_sampling, deferred=True,
+                spec_tokens=k),
+            lambda: self._device.spec_chunk_async(
+                rounds, self.eos_token_id, self.pad_id,
+                sampling=any_sampling))
+        return "spec_dev", out, None, dict(self._slots), adv
+
+    def _spec_slot_stream(self, spec_data, slot: int, req: _Request):
+        """Compact one slot's spec-chunk output into its emitted token
+        list: the entry token plus each round's window up to its valid
+        length (window tails past it are pad, never emitted). Tallies
+        proposed/accepted onto the request WHILE it still had budget —
+        the same budget-capped stat discipline as the standalone
+        drivers (overshoot rounds must not bias acceptance)."""
+        entry, windows, wlens, accepted, proposed, _live = spec_data
+        stream = [int(entry[slot])]
+        budget = req.max_new_tokens
+        prop = acc = 0
+        for r in range(windows.shape[0]):
+            if (int(proposed[r, slot])
+                    and len(req.tokens) + len(stream) < budget):
+                prop += int(proposed[r, slot])
+                acc += int(accepted[r, slot])
+            n = int(wlens[r, slot])
+            if n:
+                stream.extend(int(t) for t in windows[r, :n, slot])
+        req.spec_proposed += prop
+        req.spec_accepted += acc
+        return np.asarray(stream, np.int64), prop, acc
+
+    def _note_spec_stats(self, proposed: int, accepted: int) -> None:
+        if not proposed:
+            return
+        self._n_spec_proposed += proposed
+        self._n_spec_accepted += accepted
+        self._spec_window.append((proposed, accepted))
+        self._obs["serve_spec_proposed_total"].inc(proposed)
+        self._obs["serve_spec_accepted_total"].inc(accepted)
+        self._obs["serve_spec_accept_rate"].set(
+            round(self.spec_accept_rate(), 4))
+
+    def spec_accept_rate(self) -> float:
+        """Windowed draft acceptance rate (last 64 collected spec
+        chunks; 0.0 when speculation is off or nothing decoded yet) —
+        the /loadz `spec_accept_rate` signal."""
+        if not self._spec_window:
+            return 0.0
+        prop = sum(p for p, _ in self._spec_window)
+        acc = sum(a for _, a in self._spec_window)
+        return acc / prop if prop else 0.0
+
     def _collect(self, inflight) -> List[_Request]:
         """Read back one dispatched chunk and do the host bookkeeping
         (token append, streaming callbacks, eos/budget completion,
         frees) for the slot snapshot it was computed over."""
         kind, a, b, snapshot, _size = inflight
+        spec_data = None
         if kind == "host":
             toks, live_host = a, b
-        else:
+        elif kind == "dev":
             toks, live_host = self._announced(
                 lambda wire: wire.announce_cb_collect(self.num_slots),
                 lambda: self._device.fetch(a, b))
+        elif kind == "spec_host":
+            spec_data = _unpack_spec(a[0], self.spec_tokens)
+            live_host = spec_data[-1]
+        else:  # spec_dev: ONE packed gather at the collect
+            packed = self._announced(
+                lambda wire: wire.announce_cb_collect(self.num_slots),
+                lambda: self._device.fetch_tuple(a))
+            spec_data = _unpack_spec(packed[0], self.spec_tokens)
+            live_host = spec_data[-1]
         newly_done = []
         useful_tokens = 0
+        chunk_prop = chunk_acc = 0
         now = time.monotonic()
         for slot, req in snapshot.items():
             if req.done:
@@ -2682,7 +3295,14 @@ class ContinuousEngine:
                 # that nobody reads
                 continue
             budget = req.max_new_tokens - len(req.tokens)
-            take = toks[slot, :budget]
+            if spec_data is not None:
+                row, prop, acc = self._spec_slot_stream(
+                    spec_data, slot, req)
+                chunk_prop += prop
+                chunk_acc += acc
+                take = row[:budget]
+            else:
+                take = toks[slot, :budget]
             if self.eos_token_id is not None:
                 hit = np.nonzero(take == self.eos_token_id)[0]
                 if hit.size:
@@ -2722,6 +3342,15 @@ class ContinuousEngine:
             if eos_done or len(req.tokens) >= req.max_new_tokens:
                 req.done = True
                 newly_done.append(req)
+                if req.span is not None and req.spec_proposed:
+                    # per-request speculation quality on the trace
+                    # (shows on /traces next to TTFT/terminal)
+                    req.span.event(
+                        "spec", rid=req.rid,
+                        proposed=req.spec_proposed,
+                        accepted=req.spec_accepted,
+                        accept_rate=round(
+                            req.spec_accepted / req.spec_proposed, 4))
                 if req.span is not None:
                     # the span's LAST engine event: completion with the
                     # actual emitted-token count (replay extraction's
@@ -2740,6 +3369,8 @@ class ContinuousEngine:
                 # slot's live flag must drop so its rows stop advancing
                 self._free_slot(slot)
         self._n_finished += len(newly_done)
+        if spec_data is not None:
+            self._note_spec_stats(chunk_prop, chunk_acc)
         if useful_tokens:
             self._obs["serve_useful_tokens_total"].inc(useful_tokens)
         self._obs["serve_slots_active"].set(len(self._slots))
@@ -2770,12 +3401,20 @@ class ContinuousEngine:
             if not self._slots:
                 return expired
             size = self._effective_chunk() or self.chunk
-            return expired + self._collect(
-                self._dispatch_chunk(min(size, cap) if cap else size))
+            if self._spec:
+                # size bounds emitted tokens, cap bounds device work
+                # (draft + verify both count) — _spec_rounds folds the
+                # two into the round count
+                size = self._spec_rounds(size, cap)
+            elif cap:
+                size = min(size, cap)
+            return expired + self._collect(self._dispatch_chunk(size))
         dispatched = False
         if self._slots:
             size = self._effective_chunk()
-            if size and cap:
+            if size and self._spec:
+                size = self._spec_rounds(size, cap)
+            elif size and cap:
                 size = min(size, cap)
             if size:  # 0 = every slot's budget is already in flight
                 self._inflight_q.append(self._dispatch_chunk(size))
@@ -2824,6 +3463,17 @@ class ContinuousEngine:
             "prefill_tokens_computed": self._n_prefill_tokens,
             **({"step_token_budget": self.step_token_budget}
                if self.step_token_budget else {}),
+            **({"spec": {
+                "spec_tokens": self.spec_tokens,
+                "rounds": self._n_spec_rounds,
+                "proposed": self._n_spec_proposed,
+                "accepted": self._n_spec_accepted,
+                "accept_rate": round(
+                    self._n_spec_accepted
+                    / max(self._n_spec_proposed, 1), 4),
+                "recent_accept_rate": round(self.spec_accept_rate(), 4),
+                "self_draft": self._self_draft,
+            }} if self._spec else {}),
             "admitting": (self._admitting["req"].rid
                           if self._admitting is not None else None),
             "inflight": bool(self._inflight_q),

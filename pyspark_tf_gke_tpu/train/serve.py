@@ -310,7 +310,8 @@ class _ContinuousFront:
                  schedule: str = "fifo", obs=None, event_log=None,
                  max_queue_depth: int = 0, max_queued_tokens: int = 0,
                  chaos=None, heartbeat=None, tenants=None,
-                 step_timeout_s: float = 0.0):
+                 step_timeout_s: float = 0.0, spec_tokens: int = 0,
+                 draft_model=None, draft_params=None):
         # multi-tenant fairness/quotas: parsed spec (parse_tenant_spec
         # output or an equivalent dict), or None = tenancy off (every
         # request rides the "default" tenant; admission bounds stay
@@ -329,7 +330,8 @@ class _ContinuousFront:
                              mesh, announce, prefix_cache_size,
                              prefill_chunk, step_token_budget,
                              pipeline_depth, adaptive_chunk,
-                             schedule, self._tenant_weights)
+                             schedule, self._tenant_weights,
+                             spec_tokens, draft_model, draft_params)
         self._announce = announce
         self._obs = obs if obs is not None else platform_families()
         self._event_log = (event_log if event_log is not None
@@ -388,7 +390,8 @@ class _ContinuousFront:
         (model, params, eos_id, num_slots, chunk, mesh, announce,
          prefix_cache_size, prefill_chunk, step_token_budget,
          pipeline_depth, adaptive_chunk, schedule,
-         tenant_weights) = self._engine_args
+         tenant_weights, spec_tokens, draft_model,
+         draft_params) = self._engine_args
         return ContinuousEngine(model, params, num_slots=num_slots,
                                 chunk=chunk, eos_token_id=eos_id,
                                 mesh=mesh, announce=announce,
@@ -399,6 +402,9 @@ class _ContinuousFront:
                                 adaptive_chunk=adaptive_chunk,
                                 schedule=schedule,
                                 tenant_weights=tenant_weights,
+                                spec_tokens=spec_tokens,
+                                draft_model=draft_model,
+                                draft_params=draft_params,
                                 obs=self._obs)
 
     # -- tenancy helpers -------------------------------------------------
@@ -1087,7 +1093,8 @@ class BundleServer:
                  trace_sample: float = 0.01,
                  trace_slow_ms: float = 1000.0,
                  step_timeout_s: float = 0.0,
-                 live_stall_s: float = 120.0):
+                 live_stall_s: float = 120.0,
+                 spec_tokens: int = 0):
         from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
 
         self.mesh = mesh
@@ -1171,6 +1178,24 @@ class BundleServer:
             raise ValueError(
                 "--prefill-chunk requires --continuous-slots (chunked "
                 "prefill is a slot-engine feature)")
+        # in-engine speculative decoding: k draft proposals per slot
+        # per round, one multi-query verify — greedy token-exact vs the
+        # plain engine. With no --draft-bundle the target SELF-drafts
+        # (zero-config but allocates a dense draft shadow cache and
+        # saves nothing — deploy a small companion bundle for speed).
+        self.spec_tokens = int(spec_tokens)
+        if self.spec_tokens and not continuous_slots:
+            raise ValueError(
+                "--spec-tokens requires --continuous-slots (in-engine "
+                "speculation is a slot-engine feature; single-prompt "
+                "whole-batch speculation rides --draft-bundle alone)")
+        if self.spec_tokens and not draft_bundle_dir:
+            logger.warning(
+                "--spec-tokens %d without --draft-bundle: SELF-draft "
+                "mode (correctness/testing — the dense draft shadow "
+                "cache costs memory and the draft forwards cost as "
+                "much as the verify; deploy a small draft bundle for "
+                "the speedup)", self.spec_tokens)
         # liveness signal thresholds for GET /livez (no engine lock):
         # the driver loop's last-iteration age past live_stall_s flips
         # /livez to 503 — the cheap httpGet form of the heartbeat-age
@@ -1220,7 +1245,10 @@ class BundleServer:
                 max_queued_tokens=max_queued_tokens,
                 chaos=chaos, heartbeat=heartbeat,
                 tenants=tenants_spec,
-                step_timeout_s=step_timeout_s)
+                step_timeout_s=step_timeout_s,
+                spec_tokens=self.spec_tokens,
+                draft_model=self.draft_model,
+                draft_params=self.draft_params)
 
     # -- bundle loading / hot-swap ---------------------------------------
 
@@ -1553,6 +1581,10 @@ class BundleServer:
             "capacity_free": 0,
             "queue_delay_ms": 0.0,
             "tenants": {},
+            # in-engine speculative decoding: windowed draft acceptance
+            # (0.0 when --spec-tokens is off) — speculation quality a
+            # router/capacity model can score on
+            "spec_accept_rate": 0.0,
         }
         if self._front is not None:
             stats = self._front.engine.stats
@@ -1585,6 +1617,9 @@ class BundleServer:
             out["capacity_free"] = max(0, min(caps))
             self._obs["serve_capacity_free_tokens"].set(
                 out["capacity_free"])
+            if self.spec_tokens:
+                out["spec_accept_rate"] = round(
+                    self._front.engine.spec_accept_rate(), 4)
             tenants = {}
             for name, t in (stats.get("tenants") or {}).items():
                 tenants[name] = {"queued": t["queued"],
@@ -1667,8 +1702,15 @@ class BundleServer:
         # has no chunk boundary to cancel at, so it would decode its
         # full budget past a dead client — the slot engine (or the
         # group-checked whole-batch path) enforces deadlines instead
+        # --spec-tokens > 0: the SLOT ENGINE speculates in-slot for
+        # every request (batched draft/verify with fairness, deadlines
+        # and streaming intact), so the standalone single-prompt spec
+        # route stands down — it would serialize the pool behind one
+        # whole-batch-style call for no extra speed.
         could_spec = (self.draft_model is not None and len(prompts) == 1
                       and plain_greedy and deadline_s is None
+                      and not (self.spec_tokens and self._front
+                               is not None)
                       and len(encoded[0][1]) + max_new_tokens
                       <= self.draft_model.cfg.max_seq_len)
         if self._front is not None and engine_ok and not could_spec:
@@ -2521,6 +2563,18 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default=int(e("CONTINUOUS_CHUNK", "8")),
                    help="decode steps per engine dispatch between "
                         "admission points")
+    p.add_argument("--spec-tokens", type=int,
+                   default=int(e("SERVE_SPEC_TOKENS", "0")),
+                   help="in-engine speculative decoding: draft k "
+                        "tokens per slot per round, verify all k+1 in "
+                        "ONE multi-query forward — greedy token-exact, "
+                        ">1 token per verify when the draft agrees "
+                        "(0 = off; requires --continuous-slots; uses "
+                        "--draft-bundle as the draft, else the target "
+                        "SELF-drafts, which is correctness-only; "
+                        "draft+verify tokens count against "
+                        "--step-token-budget; accept rate on /loadz "
+                        "spec_accept_rate)")
     def _pipeline_depth(v: str) -> int:
         n = int(v)
         if not 0 <= n <= 4:
@@ -2721,6 +2775,7 @@ def main(argv=None) -> int:
         trace_slow_ms=args.trace_slow_ms,
         step_timeout_s=args.step_timeout,
         live_stall_s=args.live_stall,
+        spec_tokens=args.spec_tokens,
         # env-only by design: a token flag would leak into ps output
         # and pod specs; the k8s manifest mounts it from a Secret
         admin_token=os.environ.get("SERVE_ADMIN_TOKEN", ""))

@@ -199,24 +199,41 @@ OP_SPECULATIVE = 3
 #        replays copy_page() BEFORE the piece, mirroring process 0's
 #        copy-on-write of a shared partially-filled tail page; a
 #        cache-hit admission's first piece also carries the nonzero
-#        match boundary as its fill). With a PAGED model
-#        (CausalLMConfig.kv_num_pages) one more payload follows: the
-#        slot's sentinel-padded page allocation [max_pages_per_slot]
-#        int32 — process 0's engine owns the page pool and every
-#        worker replays the identical assignment, so block tables
-#        never diverge. Both sides derive the payload shape (and
-#        whether it exists) from the shared model config.
-# CHUNK: [op, num_slots, deferred, chunk, eos, has_sampling, pad_id, 0]
-#        (no payload; has_sampling is the STATIC flag choosing the
-#        greedy-only vs sampling-capable compiled chunk program — it
-#        must match across processes or they run different programs.
-#        deferred=0: the op ends in as_host_array gathers every process
-#        joins. deferred=1 — decode-ahead pipelining: the op is
-#        dispatch-ONLY; the gathers run at the matching OP_CB_COLLECT,
-#        so every process defers the readback identically and the
-#        collective order stays aligned)
+#        match boundary as its fill), bit4 = speculative DRAFT prefill
+#        (an int32 payload [draft_width, prompt_len] + the full
+#        right-padded prompt [1, draft_width] follow LAST — the worker
+#        replays draft_prefill_row() into its replica's dense draft
+#        cache after the admit/activation, so every replica's draft
+#        context matches process 0's; chunked-prefill pieces carry it
+#        on the FINAL piece only, because a radix-hit admission's
+#        shared-prefix tokens never cross the wire piecewise). With a
+#        PAGED model (CausalLMConfig.kv_num_pages) one more payload
+#        precedes it: the slot's sentinel-padded page allocation
+#        [max_pages_per_slot] int32 — process 0's engine owns the page
+#        pool and every worker replays the identical assignment, so
+#        block tables never diverge. Both sides derive the payload
+#        shapes (and whether they exist) from the shared model config
+#        and the flags.
+# CHUNK: [op, num_slots, deferred, chunk, eos, has_sampling, pad_id,
+#        spec_tokens] (no payload; has_sampling is the STATIC flag
+#        choosing the greedy-only vs sampling-capable compiled chunk
+#        program — it must match across processes or they run
+#        different programs. spec_tokens > 0 = SPECULATIVE chunk: the
+#        chunk field then carries the ROUND count and every process
+#        runs the identical _spec_chunk program (draft k+1 feeds + one
+#        multi-query verify per round); the per-round accepted counts
+#        ride the collect's as_host_array gathers, so every replica
+#        advances identical fill counters — bit-identical block
+#        tables. deferred=0: the op ends in as_host_array gathers
+#        every process joins. deferred=1 — decode-ahead pipelining:
+#        the op is dispatch-ONLY; the gathers run at the matching
+#        OP_CB_COLLECT, so every process defers the readback
+#        identically and the collective order stays aligned)
 # COLLECT: [op, num_slots, 0, ...] — gather the OLDEST deferred
-#        chunk's tokens/live (at most two outstanding: process 0
+#        chunk's tokens/live (spec chunks: ONE packed int32 array
+#        stacking the emission windows + per-round valid lengths /
+#        accepted / proposed counts + entry/live rows — see
+#        continuous._unpack_spec; at most two outstanding: process 0
 #        dispatches chunk N+1 before collecting chunk N)
 # FREE:  [op, num_slots, 0, 0, 0, slot, 0, 0]
 # RESET: [op, 0, ...] — drop the replica (process 0 rebuilt its engine
@@ -281,7 +298,7 @@ def announce_cb_admit(num_slots: int, padded, true_len: int, slot: int,
                       eos_token_id, pad_id: int,
                       sampling=None, pages=None,
                       chunk_fill=None, final: bool = False,
-                      cow=None) -> None:
+                      cow=None, draft=None) -> None:
     """Process 0 (caller already holds the announce lock): publish one
     slot-admit op. ``padded`` is the [1, S_bucket] right-padded prompt
     (or one chunked-prefill PIECE); ``sampling`` an optional
@@ -294,7 +311,10 @@ def announce_cb_admit(num_slots: int, padded, true_len: int, slot: int,
     workers replay the identical piece schedule); ``cow`` an optional
     ``(src_page, dst_page)`` radix-cache copy-on-write clone the
     worker replays BEFORE the piece (a cache-hit admission's first
-    piece also carries the nonzero match boundary as its fill)."""
+    piece also carries the nonzero match boundary as its fill);
+    ``draft`` an optional ``(padded_prompt [1, w], prompt_len)`` pair
+    (flags bit4) the worker replays as draft_prefill_row() — the
+    speculative-decoding draft's admission context."""
     header = np.zeros(_HEADER_LEN, np.int32)
     eos = -1 if eos_token_id is None else int(eos_token_id)
     has_sampling = int(sampling is not None and sampling[0] > 0)
@@ -303,6 +323,8 @@ def announce_cb_admit(num_slots: int, padded, true_len: int, slot: int,
         flags |= 2 | (4 if final else 0)
     if cow is not None:
         flags |= 8
+    if draft is not None:
+        flags |= 16
     header[:8] = [OP_CB_ADMIT, num_slots, padded.shape[1], int(true_len),
                   eos, slot, pad_id, flags]
     _bcast(header)
@@ -321,15 +343,27 @@ def announce_cb_admit(num_slots: int, padded, true_len: int, slot: int,
         _bcast(np.asarray([sampling[2]], np.int64))
     if pages is not None:
         _bcast(np.asarray(pages, np.int32))
+    if draft is not None:
+        # shape header first (the draft width is request-dependent),
+        # then the full right-padded prompt — LAST in the payload
+        # order so pre-spec readers' alignment is unchanged when the
+        # flag is absent
+        _bcast(np.asarray([draft[0].shape[1], draft[1]], np.int32))
+        _bcast(np.asarray(draft[0], np.int32))
 
 
 def announce_cb_chunk(num_slots: int, chunk: int, eos_token_id,
                       pad_id: int, sampling: bool = False,
-                      deferred: bool = False) -> None:
+                      deferred: bool = False,
+                      spec_tokens: int = 0) -> None:
+    """``spec_tokens > 0`` marks a SPECULATIVE chunk: ``chunk`` then
+    carries the draft/verify ROUND count and workers replay the
+    identical ``_spec_chunk`` program (the accepted counts ride the
+    collect gathers)."""
     header = np.zeros(_HEADER_LEN, np.int32)
     eos = -1 if eos_token_id is None else int(eos_token_id)
-    header[:7] = [OP_CB_CHUNK, num_slots, int(deferred), chunk, eos,
-                  int(sampling), pad_id]
+    header[:8] = [OP_CB_CHUNK, num_slots, int(deferred), chunk, eos,
+                  int(sampling), pad_id, int(spec_tokens)]
     _bcast(header)
 
 
@@ -606,12 +640,13 @@ def serve_worker_loop(model, params, mesh: Mesh,
             # ordered stream — consume them BEFORE anything that can
             # fail, or a failed op would leave the next header read
             # misaligned
-            padded = samp = pages = chunk_fill = cow = None
+            padded = samp = pages = chunk_fill = cow = draft = None
             final = False
             if op == OP_CB_ADMIT:
                 # header slot 8 is the flags bitfield: bit0 sampling,
                 # bit1 chunked-prefill piece, bit2 final piece,
-                # bit3 radix-cache COW page clone
+                # bit3 radix-cache COW page clone, bit4 speculative
+                # draft-prefill payload (full prompt, consumed LAST)
                 padded = np.asarray(_bcast(np.zeros((1, s), np.int32)))
                 if sampling & 2:  # chunked piece: its start offset
                     chunk_fill = int(np.asarray(
@@ -630,9 +665,16 @@ def serve_worker_loop(model, params, mesh: Mesh,
                     # model config on both sides
                     pages = np.asarray(_bcast(np.zeros(
                         (model.cfg.max_pages_per_slot,), np.int32)))
+                if sampling & 16:  # draft prefill: shape header, then
+                    #   the full right-padded prompt
+                    dshape = np.asarray(_bcast(np.zeros(2, np.int32)))
+                    draft = (np.asarray(_bcast(np.zeros(
+                        (1, int(dshape[0])), np.int32))), int(dshape[1]))
             try:
                 if cb_replica is None or cb_replica.num_slots != b:
-                    cb_replica = SlotDeviceState(model, params, b, mesh)
+                    cb_replica = SlotDeviceState(
+                        model, params, b, mesh, draft_model=draft_model,
+                        draft_params=draft_params)
                     # any deferred chunks belonged to the replaced
                     # replica's state — collecting them would gather
                     # stale arrays and desync from process 0
@@ -664,21 +706,40 @@ def serve_worker_loop(model, params, mesh: Mesh,
                     else:
                         cb_replica.admit_padded(padded, max_new, aux,
                                                 pages=pages)
+                    if draft is not None:
+                        # the speculative draft's admission context —
+                        # AFTER the admit/activation, mirroring
+                        # process 0's device-op order
+                        cb_replica.draft_prefill_row(
+                            draft[0], draft[1], aux)
                 elif op == OP_CB_CHUNK:
                     # aux carries the STATIC has_sampling flag: the
                     # replayed program must be the same one process 0
                     # compiled (greedy-only vs sampling-capable), or
                     # the processes execute different HLO over the
-                    # shared global slot state
+                    # shared global slot state. Header slot 8
+                    # (``sampling`` here) carries spec_tokens: > 0 =
+                    # speculative chunk, max_new = the ROUND count.
                     if s:  # deferred (decode-ahead): dispatch only,
                         #    gathers run at the matching OP_CB_COLLECT
                         if len(cb_inflight) >= 2:
                             raise RuntimeError(
                                 "deferred-chunk stream desynced: "
                                 f"{len(cb_inflight)} outstanding")
-                        cb_inflight.append(cb_replica.chunk_async(
+                        if sampling > 0:
+                            cb_inflight.append(
+                                cb_replica.spec_chunk_async(
+                                    max_new, None if eos < 0 else eos,
+                                    tk, sampling=bool(aux),
+                                    k=sampling))
+                        else:
+                            cb_inflight.append(cb_replica.chunk_async(
+                                max_new, None if eos < 0 else eos, tk,
+                                sampling=bool(aux)))
+                    elif sampling > 0:
+                        cb_replica.spec_chunk(
                             max_new, None if eos < 0 else eos, tk,
-                            sampling=bool(aux)))
+                            sampling=bool(aux), k=sampling)
                     else:
                         cb_replica.chunk(
                             max_new, None if eos < 0 else eos, tk,
@@ -688,7 +749,7 @@ def serve_worker_loop(model, params, mesh: Mesh,
                     if not cb_inflight:
                         raise RuntimeError(
                             "OP_CB_COLLECT with no deferred chunk")
-                    cb_replica.fetch(*cb_inflight.popleft())
+                    cb_replica.fetch_tuple(cb_inflight.popleft())
                 else:  # OP_CB_FREE
                     cb_replica.free(aux)
             except Exception:  # noqa: BLE001 — symmetric failures heal

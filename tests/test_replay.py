@@ -22,6 +22,7 @@ Coverage map:
 Everything except the engine-contract test is jax-free and fast.
 """
 
+import dataclasses
 import json
 import threading
 import time
@@ -537,6 +538,34 @@ def test_capacity_zero_load_closed_form():
     assert out["outcomes"] == {"ok": 1, "shed": 0, "deadline": 0,
                                "error": 0}
     assert out["goodput"] == 1.0
+
+
+def test_capacity_spec_decode_scaling_closed_form():
+    # the speculative what-if knob: decode rate scales by
+    # (1 + k·accept_rate) when a calibration provides the acceptance —
+    # k=4 at 0.75 acceptance = 4x decode, so the zero-load closed form
+    # shrinks its decode term exactly 4x (docs/REPLAY.md)
+    base = FleetModel(replicas=1, slots_per_replica=1,
+                      prefill_tokens_per_sec=1000.0,
+                      decode_tokens_per_sec=100.0, overhead_ms=5.0)
+    spec_m = dataclasses.replace(base, spec_tokens=4,
+                                 spec_accept_rate=0.75).validate()
+    assert spec_m.effective_decode_rate() == pytest.approx(400.0)
+    wl = WorkloadSpec("one", requests=[
+        SpecRequest(0.0, prompt_tokens=100, output_tokens=40)
+    ]).validate()
+    out_base = predict(base, wl)
+    out_spec = predict(spec_m, wl)
+    # 5 + 100 + 400 ms -> 5 + 100 + 100 ms
+    assert out_base["latency_ms"]["p99"] == pytest.approx(505.0)
+    assert out_spec["latency_ms"]["p99"] == pytest.approx(205.0)
+    # zero acceptance (or k=0) degenerates to the base model
+    off = dataclasses.replace(base, spec_tokens=4, spec_accept_rate=0.0)
+    assert predict(off, wl)["latency_ms"]["p99"] == pytest.approx(505.0)
+    with pytest.raises(ValueError, match="spec_accept_rate"):
+        dataclasses.replace(base, spec_accept_rate=1.5).validate()
+    with pytest.raises(ValueError, match="spec_tokens"):
+        dataclasses.replace(base, spec_tokens=-1).validate()
 
 
 def test_capacity_serial_queueing_closed_form():

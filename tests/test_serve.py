@@ -341,7 +341,8 @@ def test_loadz_snapshot_key_stability(cb_endpoints):
                  "kv_pages_free", "inflight_http", "draining",
                  "bundle_generation",
                  "prefix_cache_pages", "prefix_hit_rate",
-                 "capacity_free", "queue_delay_ms", "tenants"}
+                 "capacity_free", "queue_delay_ms", "tenants",
+                 "spec_accept_rate"}
     for url in (plain_url, cont_url):
         with urllib.request.urlopen(url + "/loadz") as resp:
             assert resp.status == 200

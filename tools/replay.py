@@ -247,6 +247,19 @@ def cmd_predict(args) -> int:
                 "top level or under 'calibration')")
         prefill_tps = float(rates["prefill_tokens_per_sec"])
         decode_tps = float(rates["decode_tokens_per_sec"])
+    accept = args.spec_accept_rate
+    if accept is not None and not args.spec_tokens:
+        # the multiplier is (1 + k·accept): a rate without k would
+        # silently model speculation OFF — same silent-mix class the
+        # calibration check above hard-errors on
+        raise SystemExit(
+            "--spec-accept-rate needs --spec-tokens k > 0 (the decode "
+            "multiplier is 1 + k*accept_rate; a rate alone models "
+            "nothing)")
+    if accept is None and args.calibration:
+        # a calibration (or a run report that embedded one) may carry
+        # the measured acceptance — e.g. copied off /loadz
+        accept = rates.get("spec_accept_rate")
     model = FleetModel(
         replicas=args.replicas, slots_per_replica=args.slots,
         kv_pages=args.kv_pages, page_size=args.page_size,
@@ -256,7 +269,9 @@ def cmd_predict(args) -> int:
         decode_tokens_per_sec=decode_tps,
         overhead_ms=args.overhead_ms,
         prefix_hit_rate=args.hit_rate,
-        router_backoff_s=args.router_backoff)
+        router_backoff_s=args.router_backoff,
+        spec_tokens=args.spec_tokens,
+        spec_accept_rate=float(accept) if accept is not None else 0.0)
     _emit(predict(model, spec, speedup=args.speedup), args.out)
     return 0
 
@@ -378,6 +393,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "replica that refuses is offered no work for "
                          "this many seconds (serve's queue_full "
                          "Retry-After is 1). 0 = no router in front")
+    pr.add_argument("--spec-tokens", type=int, default=0,
+                    help="speculative-decoding what-if: model the "
+                         "fleet serving with --spec-tokens k (the "
+                         "per-slot decode rate scales by "
+                         "1 + k*accept_rate; docs/REPLAY.md)")
+    pr.add_argument("--spec-accept-rate", type=float, default=None,
+                    help="measured draft acceptance (e.g. /loadz "
+                         "spec_accept_rate); defaults to the "
+                         "calibration's spec_accept_rate if present, "
+                         "else 0 (speculation modeled off)")
     pr.add_argument("--speedup", type=float, default=1.0)
     pr.add_argument("--calibration",
                     help="JSON file with measured service rates (a "

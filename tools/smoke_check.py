@@ -69,10 +69,14 @@ machine-readable, the offline capacity model's prediction within the
 documented band of the measured replay, and a live
 ``/traces?format=jsonl`` export round-tripped into a replayable spec.
 
+``--spec-serve`` checks in-engine speculative decoding through a live
+server: --spec-tokens completions token-identical to the plain engine,
+with a nonzero ``/loadz spec_accept_rate``.
+
 Usage: python tools/smoke_check.py
        [--lint-only|--kernels-only|--serve-lifecycle|--serve-tbt|
-        --router|--prefix-cache|--fairness|--pipeline|--trace|
-        --replay]
+        --router|--prefix-cache|--spec-serve|--fairness|--pipeline|
+        --trace|--replay]
 """
 
 import os
@@ -219,7 +223,14 @@ def lint_duplicate_metrics() -> int:
                 # watchdog's interventions must stay scrapable
                 "fault_injections_total",
                 "chaos_actions_total",
-                "serve_step_watchdog_reaps_total"}
+                "serve_step_watchdog_reaps_total",
+                # self-draft speculative decoding: /loadz
+                # spec_accept_rate, the cb --spec bench and the
+                # capacity model's (1 + k·accept) what-if knob read
+                # these — a rename must fail here first
+                "serve_spec_proposed_total",
+                "serve_spec_accepted_total",
+                "serve_spec_accept_rate"}
     absent = {n for n in required if n not in _REGISTRATIONS}
     if absent:
         print("metric lint FAILED — required metric name(s) never "
@@ -744,6 +755,128 @@ def prefix_cache_check(grace_s: float = 30.0) -> int:
     print("prefix-cache OK: shared prefix prefilled once — the second "
           "request computed only its unique suffix, and /loadz exposes "
           "the hit rate the router scores on")
+    return 0
+
+
+def spec_serve_check(grace_s: float = 30.0) -> int:
+    """``--spec-serve``: in-engine speculative decoding through a LIVE
+    server (subprocess, the real CLI — the serve wiring from
+    ``--spec-tokens``/``--draft-bundle`` down to the engine's
+    draft/verify rounds):
+
+    1. a server at ``--spec-tokens 3`` with a draft bundle answers
+       greedy generates TOKEN-IDENTICAL to a ``--spec-tokens 0``
+       server on the same bundle (the greedy-exactness contract, over
+       real HTTP);
+    2. ``/loadz`` reports ``spec_accept_rate > 0`` — speculation
+       actually ran and accepted drafts (the draft bundle here holds
+       the target's own weights, so acceptance is high by
+       construction)."""
+    import dataclasses
+    import json as _json
+    import socket
+    import subprocess
+    import tempfile
+    import time as _time
+    import urllib.request
+
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig
+    from pyspark_tf_gke_tpu.train.export import export_serving_bundle
+    from pyspark_tf_gke_tpu.utils.seeding import make_rng
+
+    tmp = tempfile.mkdtemp(prefix="spec-serve-")
+    cfg = CausalLMConfig(vocab_size=259, hidden_size=32, num_layers=2,
+                         num_heads=2, intermediate_size=64,
+                         max_seq_len=256, dtype=jnp.float32,
+                         kv_page_size=32, kv_num_pages=32)
+    model = CausalLM(dataclasses.replace(cfg, kv_num_pages=None))
+    params = nn.meta.unbox(jax.jit(model.init)(
+        make_rng(0), jnp.zeros((1, 8), jnp.int32))["params"])
+    bundle = os.path.join(tmp, "bundle")
+    export_serving_bundle(cfg, params, bundle, quantize=False)
+    # the draft bundle: same weights on the DENSE config — a real
+    # second bundle on disk, so the --draft-bundle load/vocab-check
+    # path runs; sharing the target's weights pins acceptance high
+    draft_dir = os.path.join(tmp, "draft")
+    export_serving_bundle(dataclasses.replace(cfg, kv_num_pages=None),
+                          params, draft_dir, quantize=False)
+    prompts = ["the quick brown fox jumps over ",
+               "serving plane speculative check "]
+
+    def serve_once(spec_tokens: int, want_accept: bool):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        url = f"http://127.0.0.1:{port}"
+        argv = [sys.executable, "-m", "pyspark_tf_gke_tpu.train.serve",
+                "--bundle", bundle, "--host", "127.0.0.1",
+                "--port", str(port), "--continuous-slots", "2",
+                "--continuous-chunk", "4"]
+        if spec_tokens:
+            argv += ["--spec-tokens", str(spec_tokens),
+                     "--draft-bundle", draft_dir]
+        proc = subprocess.Popen(
+            argv, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        try:
+            deadline = _time.time() + 180
+            while _time.time() < deadline:
+                try:
+                    urllib.request.urlopen(url + "/healthz", timeout=2)
+                    break
+                except Exception:  # noqa: BLE001 — still booting
+                    if proc.poll() is not None:
+                        raise RuntimeError(
+                            f"server died during startup "
+                            f"(rc={proc.poll()})")
+                    _time.sleep(0.5)
+            else:
+                raise RuntimeError("server never became healthy")
+            req = urllib.request.Request(
+                url + "/v1/generate",
+                data=_json.dumps({"prompts": prompts,
+                                  "max_new_tokens": 24}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=180) as resp:
+                out = _json.loads(resp.read())
+            texts = [c["completion"] for c in out["completions"]]
+            accept = None
+            if want_accept:
+                with urllib.request.urlopen(url + "/loadz",
+                                            timeout=10) as resp:
+                    accept = _json.loads(resp.read())["spec_accept_rate"]
+            return texts, accept
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=grace_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+
+    failures = []
+    spec_texts, accept = serve_once(3, want_accept=True)
+    plain_texts, _ = serve_once(0, want_accept=False)
+    print(f"spec-serve: accept_rate={accept} "
+          f"parity={'OK' if spec_texts == plain_texts else 'MISMATCH'}")
+    if spec_texts != plain_texts:
+        failures.append(
+            f"speculative completions diverged from --spec-tokens 0: "
+            f"{spec_texts!r} != {plain_texts!r}")
+    if not accept or accept <= 0:
+        failures.append(
+            f"/loadz spec_accept_rate={accept!r} — speculation never "
+            "accepted a draft (or the signal is dead)")
+    if failures:
+        print("spec-serve FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("spec-serve OK: --spec-tokens engine is token-identical to "
+          "the plain engine over live HTTP, with a nonzero accept rate "
+          "on /loadz")
     return 0
 
 
@@ -1617,6 +1750,8 @@ def main(argv=None) -> int:
         return router_check()
     if "--prefix-cache" in argv:
         return prefix_cache_check()
+    if "--spec-serve" in argv:
+        return spec_serve_check()
     if "--fairness" in argv:
         return fairness_check()
     if "--pipeline" in argv:
