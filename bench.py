@@ -924,6 +924,10 @@ def bench_continuous(smoke: bool = False, paged: bool = False,
             # comparison — wall-clock on a tunneled chip swings with
             # RTT drift, the step count does not
             "dispatched_steps": st["dispatched_steps"],
+            # windowed step-phase decomposition (obs/stepstats.py):
+            # host-overhead fraction + per-phase p50/p99 — the
+            # ROADMAP item-4 baseline every trail entry now carries
+            "step_phases": st["step_phases"],
             **({"paged": st["paged"]} if "paged" in st else {})}
 
     base_cfg_tps, _ = run_engine(chunk, 0)
@@ -1103,6 +1107,11 @@ def bench_continuous(smoke: bool = False, paged: bool = False,
                 (-(-n_requests // slots) * int(hi))
                 / max(admit_stats["dispatched_steps"], 1), 3),
         },
+        # the headline config's step-phase summary (host-overhead
+        # fraction + per-phase p50/p99), surfaced top-level so
+        # tools/trail_report.py renders the host/device split per
+        # entry (popped from admit_stats — one copy per trail line)
+        "step_phases": admit_stats.pop("step_phases", None),
         "tuning_grid": tried,  # every config measured for the headline
         **({"high_variance": high_variance}
            if high_variance is not None else {}),
@@ -1238,6 +1247,7 @@ def bench_chunked_prefill(smoke: bool = False) -> dict:
             "tbt_samples": len(gaps),
             "prefill_chunks": eng.stats["prefill_chunks"],
             "dispatched_steps": eng.stats["dispatched_steps"],
+            "step_phases": eng.stats["step_phases"],
             "steady_state_recompiles": jit_cache_size() - jits0,
         }
 
@@ -1272,6 +1282,10 @@ def bench_chunked_prefill(smoke: bool = False) -> dict:
         "tbt_p99_ratio": (round(on["tbt_p99_ms"] / off["tbt_p99_ms"], 3)
                           if on["tbt_p99_ms"] and off["tbt_p99_ms"]
                           else None),
+        # the headline (chunked) side's step-phase summary, surfaced
+        # top-level so tools/trail_report.py renders the host/device
+        # split for this entry (both sides keep theirs nested)
+        "step_phases": on["step_phases"],
         "prefill_chunk_tokens": prefill_chunk,
         "step_token_budget": step_budget,
         "num_slots": slots,
@@ -1379,6 +1393,7 @@ def bench_prefix_cache(smoke: bool = False) -> dict:
             "hit_tokens": pc.get("hit_tokens", 0),
             "evictions": pc.get("evictions", 0),
             "resident_pages": pc.get("resident_pages", 0),
+            "step_phases": stats["step_phases"],
         }, [done[r] for r in rids]
 
     # warmup compiles both program sets outside the timed runs (piece
@@ -1416,6 +1431,8 @@ def bench_prefix_cache(smoke: bool = False) -> dict:
         "prefill_computed_on": on["prefill_tokens_computed"],
         "prefill_computed_off": off["prefill_tokens_computed"],
         "prefill_computed_ideal": shared_len + unique_suffix_tokens,
+        "step_phases": on["step_phases"],  # headline (cached) side —
+        #   trail_report's host-overhead column reads this
         "token_parity": True,
         "shared_prefix_tokens": shared_len,
         "suffix_tokens": suffix_len,
@@ -1535,6 +1552,7 @@ def bench_spec_cb(smoke: bool = False, spec_tokens: int = 5) -> dict:
         out = {
             "tokens_per_sec_per_chip": round(got / dt / n_chips, 1),
             "dispatched_work_tokens": stats["dispatched_steps"],
+            "step_phases": stats["step_phases"],
         }
         if spec:
             out["spec"] = stats["spec"]
@@ -1558,6 +1576,8 @@ def bench_spec_cb(smoke: bool = False, spec_tokens: int = 5) -> dict:
             on["tokens_per_sec_per_chip"]
             / max(off["tokens_per_sec_per_chip"], 1e-9), 3),
         "accept_rate": on["spec"]["accept_rate"],
+        "step_phases": on["step_phases"],  # headline (spec) side —
+        #   trail_report's host-overhead column reads this
         "spec_tokens": spec_tokens,
         "token_parity": True,
         "num_slots": slots,
@@ -2164,8 +2184,12 @@ def _normalize_argv(argv) -> list:
     and sort flags (keeping value flags paired) so an operator's
     hand-typed flag order still matches the matrix entry. Two cnn
     variants (e.g. ``--bf16-moments``) normalize differently — they are
-    different measurements."""
-    drop = ("--smoke", "--no-history")
+    different measurements. ``--smoke`` is KEPT: a tiny-shape smoke
+    measurement is its own identity (recordable via ``--history``),
+    and it must never be looked up as — or stand in for — the
+    full-shape entry (the variant-regression guard and the stale
+    matrix both match on this identity)."""
+    drop = ("--no-history", "--history")
     pos, pairs = [], []
     i = 0
     args = list(argv)
@@ -2389,13 +2413,22 @@ def append_history(argv, result: dict,
     erase the fact that a measurement happened. README/PARITY cite these
     entries by timestamp. ``--smoke`` runs (tiny-shape plumbing checks)
     and explicit ``--no-history`` runs are not measurements and are not
-    recorded."""
-    if result.get("value") is None or "--smoke" in argv or "--no-history" in argv:
+    recorded — EXCEPT a smoke run invoked with an explicit
+    ``--history`` opt-in: ROADMAP's environment note makes CPU-smoke
+    A/Bs the perf oracle on this box, and some baselines (the item-4
+    ``step_phases`` host-overhead fraction) are only capturable that
+    way. The recorded argv keeps ``--smoke`` (a smoke measurement is
+    its own identity — it must never stand in for the full one) but
+    drops the ``--history`` marker (it doesn't change what was
+    measured)."""
+    if result.get("value") is None or "--no-history" in argv:
+        return
+    if "--smoke" in argv and "--history" not in argv:
         return
     entry = {
         "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
             timespec="seconds"),
-        "argv": list(argv),
+        "argv": [a for a in argv if a != "--history"],
         "result": result,
     }
     # Host-contention disclosure: dispatch-bound step times on this

@@ -50,6 +50,11 @@ from pyspark_tf_gke_tpu.obs.metrics import (
     platform_families,
     set_registry,
 )
+from pyspark_tf_gke_tpu.obs.stepstats import (
+    StepRecord,
+    StepStatsRing,
+    flops_per_token,
+)
 from pyspark_tf_gke_tpu.obs.trace import (
     Span,
     TraceRecorder,
@@ -75,6 +80,9 @@ __all__ = [
     "append_jsonl_line",
     "get_event_log",
     "set_event_log",
+    "StepRecord",
+    "StepStatsRing",
+    "flops_per_token",
     "Span",
     "TraceRecorder",
     "current_span",

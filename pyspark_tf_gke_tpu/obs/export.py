@@ -93,14 +93,17 @@ def handle_obs_request(
         path: str, registry: MetricsRegistry,
         event_log: Optional[EventLog] = None,
         extra_exposition: str = "",
-        tracer=None) -> Optional[Tuple[int, str, bytes]]:
+        tracer=None,
+        stepstats=None) -> Optional[Tuple[int, str, bytes]]:
     """GET dispatch for the observability endpoints.
 
     Returns ``(status, content_type, body)`` for ``/metrics``,
-    ``/metrics.json``, ``/events[?n=N]`` and (when ``tracer`` — an
+    ``/metrics.json``, ``/events[?n=N]``, (when ``tracer`` — an
     ``obs.trace.TraceRecorder`` — is provided)
-    ``/traces[?slow_ms=F&trace_id=HEX&n=N]``, or ``None`` for paths
-    this module doesn't own (caller falls through to its own routes).
+    ``/traces[?slow_ms=F&trace_id=HEX&n=N]`` and (when ``stepstats``
+    — an ``obs.stepstats.StepStatsRing`` — is provided)
+    ``/stepz[?n=N&min_ms=F]``, or ``None`` for paths this module
+    doesn't own (caller falls through to its own routes).
     ``extra_exposition`` is appended verbatim to ``/metrics`` — the
     serving front uses it for its legacy-name alias block.
     """
@@ -156,5 +159,28 @@ def handle_obs_request(
             body = "".join(json.dumps(t) + "\n" for t in traces)
             return 200, "application/x-ndjson", body.encode()
         body = json.dumps({**tracer.snapshot(), "traces": traces})
+        return 200, "application/json", body.encode()
+    if route == "/stepz" and stepstats is not None:
+        # the step-telemetry ring (obs/stepstats.py): newest-first raw
+        # records plus the windowed summary the /loadz fraction and
+        # the cb bench's step_phases block derive from. ?min_ms= is
+        # the slow-step filter (pair with a /traces slow_ms capture:
+        # a slow request, its slow steps, and an xprof window all
+        # cross-link through the step seq + trace ids).
+        n = 64
+        min_ms = None
+        for part in query.split("&"):
+            key, _, val = part.partition("=")
+            try:
+                if key == "n" and val:
+                    n = max(1, min(int(val), 1024))
+                elif key == "min_ms" and val:
+                    min_ms = float(val)
+            except ValueError:
+                return (400, "application/json",
+                        b'{"error": "bad /stepz query parameter"}')
+        body = json.dumps({"summary": stepstats.summary(),
+                           "steps": stepstats.snapshot(n=n,
+                                                       min_ms=min_ms)})
         return 200, "application/json", body.encode()
     return None

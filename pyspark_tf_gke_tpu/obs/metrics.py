@@ -678,6 +678,35 @@ def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "serve_traces_recorded_total",
             "Traces retained into the serve plane's flight-recorder "
             "ring (sampled, or slower than --trace-slow-ms)"),
+        # engine step telemetry (obs/stepstats.py — the ROADMAP item-4
+        # host/device decomposition; GET /stepz serves the raw ring)
+        "serve_step_host_overhead_ms": r.histogram(
+            "serve_step_host_overhead_ms",
+            "Per engine step, observed at step close: wall time minus "
+            "device-wait — the host (Python bookkeeping) share of the "
+            "step the device sat idle for on the serial loop; the "
+            "async-engine refactor's target is <10% of step time. "
+            "EXCLUDES the deliver phase (amended onto the record "
+            "after close) — /stepz and the windowed "
+            "serve_device_idle_fraction / /loadz fraction include it"),
+        "serve_step_phase_ms": r.histogram(
+            "serve_step_phase_ms",
+            "Per engine step, per phase (expire | schedule | dispatch "
+            "| device_wait | collect | deliver): exclusive wall time — "
+            "phase sums reconcile with the step wall (pinned by test)",
+            labelnames=("phase",)),
+        "serve_device_idle_fraction": r.gauge(
+            "serve_device_idle_fraction",
+            "Windowed fraction of step wall the device spent idle "
+            "(host overhead / wall over the last ~64 steps) — equals "
+            "the host-overhead fraction on today's serial step loop; "
+            "decode-ahead makes it an optimistic lower bound"),
+        "serve_mfu": r.gauge(
+            "serve_mfu",
+            "Windowed model-FLOPs utilization: (decoded + prefilled "
+            "tokens)/sec x estimated FLOPs/token / --peak-flops; 0 "
+            "when --peak-flops is unset (the CPU default — MFU is "
+            "meaningless without the chip's peak)"),
         # data plane
         "data_prefetch_queue_depth": r.gauge(
             "data_prefetch_queue_depth",

@@ -1,0 +1,437 @@
+"""Engine step telemetry: phase-level step decomposition.
+
+ROADMAP item 4 (async engine core) targets "host overhead <10% of step
+time" — a number that cannot even be STATED while the engine step loop
+(``train/continuous.py`` schedule → dispatch → block on device →
+deliver) is a black box between ``/metrics`` counters. This module is
+the measurement plane that refactor will be A/B'd against, the way the
+DistServe-goodput and vLLM-async-scheduler lineages both start from a
+step-time decomposition:
+
+* :class:`StepRecord` — one engine step's timing and batch
+  composition: per-phase wall time (the :data:`PHASES` vocabulary),
+  decode slots, prefill pieces/tokens, speculative rounds, tokens
+  delivered, queue depth at entry, and a terminal ``outcome``
+  (``ok | error | reaped``). Phase attribution is EXCLUSIVE: a nested
+  ``phase()`` context pauses its parent, so the phase sums reconcile
+  with the step wall (pinned by test).
+* :class:`StepStatsRing` — a thread-safe bounded ring of the last N
+  closed records, exposed as ``GET /stepz`` (``obs/export.py``). A
+  record enters the ring exactly ONCE, at :meth:`StepStatsRing.close`
+  (idempotent — the PR 11 watchdog's reap path amends the outcome of
+  an already-closed record, it never re-closes it); a record abandoned
+  mid-step (hung dispatch that never returns) simply never lands.
+* Derived metrics (observed at close, on the bound obs handles):
+  ``serve_step_host_overhead_ms`` (step wall minus device-wait — the
+  Python bookkeeping tax the async refactor must hide),
+  ``serve_step_phase_ms{phase}``, windowed
+  ``serve_device_idle_fraction`` and a tokens/sec-derived ``serve_mfu``
+  gauge (FLOPs/token estimated from the model config; requires a
+  ``peak_flops`` knob — 0/absent disables it, the CPU default).
+
+Measurement model (document before trusting the numbers): the serial
+engine loop blocks on the device exactly once per step — the collect's
+device→host fetch — so ``device_wait`` is *host time spent blocked on
+the device*, and ``host_overhead = wall - device_wait`` is everything
+else. On today's serial loop the device is idle during precisely that
+host remainder, so ``serve_device_idle_fraction`` equals the windowed
+host-overhead fraction; decode-ahead (``pipeline_depth > 0``) already
+overlaps one chunk and makes both metrics optimistic lower bounds on
+device busyness. The async-core refactor is exactly the change that
+will split these two numbers apart.
+
+Stdlib-only and jax-free: the ring must work in CPU-only tests and in
+host-side tools that never attach a device.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+# The phase vocabulary (docs/OBSERVABILITY.md "Step telemetry"):
+#   expire      — deadline sweep (queued + in-slot expiry)
+#   schedule    — admission work: DWRR/FIFO picks, prefill pieces,
+#                 batched admits, page allocation (prefill FORWARDS are
+#                 dispatched async here; their device time is paid at
+#                 the collect's device_wait)
+#   dispatch    — decode-chunk dispatch (host-side trace/submit; the
+#                 announce-mode unpipelined path blocks here, which the
+#                 nested device_wait context carves out)
+#   device_wait — host blocked on a device→host fetch (the one sync
+#                 point of the serial loop)
+#   collect     — host bookkeeping over fetched tokens: eos/budget
+#                 completion, streaming callbacks, frees, trie adoption
+#   deliver     — waiter wakeups + quota settlement (the serving
+#                 front's _deliver_finished; amended onto the record by
+#                 the driver loop right after the step closes)
+PHASES = ("expire", "schedule", "dispatch", "device_wait", "collect",
+          "deliver")
+
+_OUTCOMES = ("ok", "error", "reaped")
+
+
+def flops_per_token(cfg, context_len: Optional[int] = None) -> float:
+    """Decode FLOPs per generated token estimated from a
+    ``CausalLMConfig``-shaped object (attribute access only — no jax,
+    no import of the models package). The standard serving estimate:
+    ``2 × matmul params`` (every weight read is one MAC per token)
+    plus ``4 × layers × context × hidden`` for attention's QK^T + AV
+    against the KV cache, with K/V projections scaled down by GQA.
+    ``context_len`` defaults to half the model's max_seq_len (a mid-
+    generation average). Returns 0.0 when the config doesn't carry the
+    expected fields — the MFU gauge then stays disabled."""
+    try:
+        h = int(cfg.hidden_size)
+        layers = int(cfg.num_layers)
+        vocab = int(cfg.vocab_size)
+        inter = int(cfg.intermediate_size)
+        heads = int(cfg.num_heads)
+        kv_heads = int(getattr(cfg, "num_kv_heads", None) or heads)
+        ctx = int(context_len if context_len is not None
+                  else max(int(cfg.max_seq_len) // 2, 1))
+    except (AttributeError, TypeError, ValueError):
+        return 0.0
+    attn_proj = (2.0 + 2.0 * kv_heads / max(heads, 1)) * h * h
+    ffn_mats = 3 if getattr(cfg, "ffn", "gelu") == "swiglu" else 2
+    matmul_params = layers * (attn_proj + ffn_mats * h * inter) + vocab * h
+    return 2.0 * matmul_params + 4.0 * layers * ctx * h
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class StepRecord:
+    """One engine step's telemetry. Built by
+    :meth:`StepStatsRing.begin`, phases timed via the nesting-aware
+    :meth:`phase` context (exclusive attribution: entering a child
+    pauses the parent, so ``sum(phases) <= wall`` and reconciles with
+    it up to untimed gaps), closed exactly once by
+    :meth:`StepStatsRing.close`."""
+
+    __slots__ = ("seq", "t_start", "wall_ms", "phases", "decode_slots",
+                 "prefill_pieces", "prefill_tokens", "spec_rounds",
+                 "tokens_out", "queue_depth", "expired", "outcome",
+                 "closed", "_stack", "_clock")
+
+    def __init__(self, seq: int, clock=time.monotonic,
+                 queue_depth: int = 0):
+        self.seq = int(seq)
+        self._clock = clock
+        self.t_start = clock()
+        self.wall_ms = 0.0
+        self.phases: Dict[str, float] = {}
+        self.decode_slots = 0
+        self.prefill_pieces = 0
+        self.prefill_tokens = 0
+        self.spec_rounds = 0
+        self.tokens_out = 0
+        self.queue_depth = int(queue_depth)
+        self.expired = 0
+        self.outcome = "ok"
+        self.closed = False
+        self._stack: List[list] = []
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Time a phase. Nesting pauses the enclosing phase: the
+        elapsed span is attributed to exactly one phase at any
+        instant, which is what makes the phase-sum-vs-wall invariant
+        checkable."""
+        now = self._clock()
+        if self._stack:
+            top = self._stack[-1]
+            self.phases[top[0]] = (self.phases.get(top[0], 0.0)
+                                   + (now - top[1]) * 1000.0)
+        self._stack.append([name, now])
+        try:
+            yield
+        finally:
+            now = self._clock()
+            top = self._stack.pop()
+            self.phases[name] = (self.phases.get(name, 0.0)
+                                 + (now - top[1]) * 1000.0)
+            if self._stack:
+                self._stack[-1][1] = now  # parent resumes from here
+
+    @property
+    def device_wait_ms(self) -> float:
+        return self.phases.get("device_wait", 0.0)
+
+    @property
+    def host_overhead_ms(self) -> float:
+        """Step wall minus device-wait: every millisecond of Python
+        bookkeeping the device spent idle for (on the serial loop)."""
+        return max(0.0, self.wall_ms - self.device_wait_ms)
+
+    @property
+    def activity(self) -> bool:
+        """Did this step do any work worth a record? Idle spins
+        (empty queue, no slots) are discarded instead of flooding the
+        ring with zero rows."""
+        return bool(self.decode_slots or self.prefill_pieces
+                    or self.prefill_tokens or self.tokens_out
+                    or self.expired or self.outcome != "ok")
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "wall_ms": round(self.wall_ms, 3),
+            "host_overhead_ms": round(self.host_overhead_ms, 3),
+            "phases_ms": {k: round(v, 3)
+                          for k, v in sorted(self.phases.items())},
+            "decode_slots": self.decode_slots,
+            "prefill_pieces": self.prefill_pieces,
+            "prefill_tokens": self.prefill_tokens,
+            "spec_rounds": self.spec_rounds,
+            "tokens_out": self.tokens_out,
+            "queue_depth": self.queue_depth,
+            "expired": self.expired,
+            "outcome": self.outcome,
+        }
+
+
+class StepStatsRing:
+    """Thread-safe bounded ring of closed :class:`StepRecord`\\ s.
+
+    Lifecycle contract (the exactly-once invariant the chaos suite
+    pins): ``begin()`` hands out a record that is NOT in the ring;
+    ``close()`` appends it exactly once (idempotent — a second close
+    is a no-op returning False); ``mark_reaped()`` amends the outcome
+    of the already-closed record in place (the watchdog path: the
+    stuck step returned, its record closed normally, the front
+    relabels it); a record never closed (step still hung) never
+    enters the ring. ``add_deliver()`` amends the front's delivery
+    time onto the just-closed record — wall and the ``deliver`` phase
+    grow together, so the phase-sum invariant survives the amend.
+
+    One engine (or a serving front across engine REBUILDS — the front
+    owns the ring and threads it through every engine it builds, so
+    ``/stepz`` history survives a rebuild) writes; any thread reads
+    via :meth:`snapshot`/:meth:`summary`."""
+
+    def __init__(self, capacity: int = 256, window: int = 64,
+                 clock=time.monotonic):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.window = max(1, int(window))
+        self._clock = clock
+        self._ring = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last: Optional[StepRecord] = None
+        self._obs = None
+        self.flops_per_token = 0.0
+        self.peak_flops = 0.0
+
+    def bind(self, obs, flops_per_token: float = 0.0,
+             peak_flops: float = 0.0) -> "StepStatsRing":
+        """Attach metric handles (a ``platform_families`` dict) and
+        the MFU inputs; re-binding (engine rebuild) is fine — last
+        bind wins."""
+        self._obs = obs
+        self.flops_per_token = float(flops_per_token or 0.0)
+        self.peak_flops = float(peak_flops or 0.0)
+        return self
+
+    @property
+    def next_seq(self) -> int:
+        """Seq the next :meth:`begin` will assign (the profiler's
+        capture-window start marker)."""
+        with self._lock:
+            return self._seq
+
+    @property
+    def last_record(self) -> Optional[StepRecord]:
+        """Most recently CLOSED record (None before the first)."""
+        with self._lock:
+            return self._last
+
+    def begin(self, queue_depth: int = 0) -> StepRecord:
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        return StepRecord(seq, clock=self._clock,
+                          queue_depth=queue_depth)
+
+    def close(self, rec: StepRecord, outcome: Optional[str] = None
+              ) -> bool:
+        """Close + ring-append exactly once. Returns False (no-op) on
+        a second close of the same record."""
+        with self._lock:
+            if rec.closed:
+                return False
+            rec.closed = True
+            rec.wall_ms = (self._clock() - rec.t_start) * 1000.0
+            if outcome is not None:
+                if outcome not in _OUTCOMES:
+                    raise ValueError(f"unknown outcome {outcome!r}")
+                rec.outcome = outcome
+            self._ring.append(rec)
+            self._last = rec
+            self._observe_locked(rec)
+        return True
+
+    def add_deliver(self, rec: StepRecord, ms: float) -> None:
+        """Amend the front's delivery time onto a closed record (the
+        one phase that runs OUTSIDE ``engine.step()``). Wall grows by
+        the same amount, so phase sums still reconcile."""
+        ms = max(0.0, float(ms))
+        with self._lock:
+            if not rec.closed:
+                return
+            rec.phases["deliver"] = rec.phases.get("deliver", 0.0) + ms
+            rec.wall_ms += ms
+            if self._obs is not None:
+                h = self._obs.get("serve_step_phase_ms")
+                if h is not None:
+                    h.labels(phase="deliver").observe(ms)
+                self._refresh_window_gauges_locked()
+
+    def mark_reaped(self, rec: StepRecord) -> None:
+        """The watchdog reaped this step's waiters while it hung:
+        relabel its (already-closed) record. Amends in place — the
+        record was appended once at close and stays appended once."""
+        with self._lock:
+            rec.outcome = "reaped"
+
+    def discard(self, rec: StepRecord) -> None:
+        """Drop a record that never earned a ring slot (idle step).
+        Nothing to undo — begin() never inserted it."""
+
+    # -- read side --------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def snapshot(self, n: int = 64, min_ms: Optional[float] = None
+                 ) -> List[dict]:
+        """Newest-first dicts of the last ``n`` records, optionally
+        only those with ``wall_ms >= min_ms`` (the /stepz ``?n=`` /
+        ``?min_ms=`` filters). Serialized UNDER the lock: the driver
+        thread's ``add_deliver`` inserts into a record's phases dict,
+        and iterating it concurrently would raise mid-scrape."""
+        with self._lock:
+            recs = list(self._ring)
+            recs.reverse()
+            if min_ms is not None:
+                recs = [r for r in recs if r.wall_ms >= float(min_ms)]
+            return [r.to_dict() for r in recs[:max(1, int(n))]]
+
+    def host_overhead_frac(self) -> float:
+        """Windowed host-overhead fraction: sum(wall - device_wait) /
+        sum(wall) over the last ``window`` records (0.0 when empty) —
+        what ``/loadz step_host_overhead_frac`` advertises and the
+        router folds into its autoscale block."""
+        with self._lock:
+            return self._host_overhead_frac_locked()
+
+    def _host_overhead_frac_locked(self) -> float:
+        recs = list(self._ring)[-self.window:]
+        wall = sum(r.wall_ms for r in recs)
+        if wall <= 0.0:
+            return 0.0
+        host = sum(r.host_overhead_ms for r in recs)
+        return min(1.0, max(0.0, host / wall))
+
+    @staticmethod
+    def _span_s(recs: List[StepRecord]) -> float:
+        """Wall-clock span covered by a window of records: first
+        step's start to last step's end. Unlike the sum of busy-step
+        walls it INCLUDES idle gaps between steps, so throughput-like
+        derivations (tokens/sec, MFU) report real utilization, not
+        per-busy-step throughput — a replica serving one request a
+        second must not read as saturated. Floored at the busy-wall
+        sum (amends and clock quirks can't shrink it below the work
+        actually timed)."""
+        if not recs:
+            return 0.0
+        busy_s = sum(r.wall_ms for r in recs) / 1000.0
+        span = (recs[-1].t_start + recs[-1].wall_ms / 1000.0
+                - recs[0].t_start)
+        return max(span, busy_s)
+
+    def _mfu_locked(self) -> float:
+        if self.peak_flops <= 0.0 or self.flops_per_token <= 0.0:
+            return 0.0
+        recs = list(self._ring)[-self.window:]
+        span_s = self._span_s(recs)
+        if span_s <= 0.0:
+            return 0.0
+        tokens = sum(r.tokens_out + r.prefill_tokens for r in recs)
+        return tokens / span_s * self.flops_per_token / self.peak_flops
+
+    def summary(self) -> dict:
+        """Windowed aggregate: record count, host-overhead fraction,
+        per-phase p50/p99, wall p50/p99, tokens/sec and MFU — the
+        ``step_phases`` block ``engine.stats`` (and therefore the cb
+        bench trail) carries."""
+        with self._lock:
+            recs = list(self._ring)[-self.window:]
+            frac = self._host_overhead_frac_locked()
+            mfu = self._mfu_locked()
+        if not recs:
+            return {"records": 0, "host_overhead_frac": 0.0,
+                    "device_idle_fraction": 0.0, "mfu": 0.0,
+                    "wall_ms": {}, "phase_ms": {}}
+        walls = sorted(r.wall_ms for r in recs)
+        phase_ms = {}
+        for name in PHASES:
+            vals = sorted(r.phases[name] for r in recs
+                          if name in r.phases)
+            if vals:
+                phase_ms[name] = {"p50": round(_percentile(vals, 0.5), 3),
+                                  "p99": round(_percentile(vals, 0.99), 3)}
+        span_s = self._span_s(recs)
+        tokens = sum(r.tokens_out + r.prefill_tokens for r in recs)
+        return {
+            "records": len(recs),
+            "host_overhead_frac": round(frac, 4),
+            # identical to host_overhead_frac on the serial loop (see
+            # the module docstring's measurement model); kept as its
+            # own key because the async refactor splits them
+            "device_idle_fraction": round(frac, 4),
+            "mfu": round(mfu, 6),
+            # span-based (start of first windowed step -> end of the
+            # last, idle gaps included): real windowed throughput
+            "tokens_per_sec": (round(tokens / span_s, 1)
+                               if span_s else 0.0),
+            "wall_ms": {"p50": round(_percentile(walls, 0.5), 3),
+                        "p99": round(_percentile(walls, 0.99), 3)},
+            "phase_ms": phase_ms,
+        }
+
+    # -- metrics ----------------------------------------------------------
+
+    def _observe_locked(self, rec: StepRecord) -> None:
+        obs = self._obs
+        if obs is None:
+            return
+        h = obs.get("serve_step_host_overhead_ms")
+        if h is not None:
+            h.observe(rec.host_overhead_ms)
+        h = obs.get("serve_step_phase_ms")
+        if h is not None:
+            for name, ms in rec.phases.items():
+                h.labels(phase=name).observe(ms)
+        self._refresh_window_gauges_locked()
+
+    def _refresh_window_gauges_locked(self) -> None:
+        obs = self._obs
+        if obs is None:
+            return
+        g = obs.get("serve_device_idle_fraction")
+        if g is not None:
+            g.set(round(self._host_overhead_frac_locked(), 4))
+        g = obs.get("serve_mfu")
+        if g is not None:
+            g.set(round(self._mfu_locked(), 6))

@@ -398,6 +398,26 @@ def calibrate_rates(base_url: str, *, prompt_tokens: int = 24,
                 replay_spec(spec2, base_url, speedup=1.0, stream=True,
                             include_requests=True,
                             timeout_s=timeout_s)) or loaded
+    # step telemetry, read AFTER the loaded phase so the window the
+    # replica advertises covers calibration traffic: the engine's
+    # host-overhead fraction is context for the measured rates — a
+    # loaded decode rate far below serial WITH a high host fraction
+    # localizes the gap to Python bookkeeping (the ROADMAP item-4
+    # tax), not the device. Whole-batch replicas / old builds
+    # advertise nothing → None, and the fetch never fails calibration.
+    host_frac = None
+    try:
+        import json as _json
+        import urllib.request as _rq
+
+        with _rq.urlopen(base_url.rstrip("/") + "/loadz",
+                         timeout=5.0) as resp:
+            host_frac = _json.loads(resp.read()).get(
+                "step_host_overhead_frac")
+        if host_frac is not None:
+            host_frac = round(float(host_frac), 4)
+    except Exception:  # noqa: BLE001 — telemetry is context, not a rate
+        host_frac = None
     prefill_rate = prompt_tokens / max(serial["ttft_s"], 1e-6)
     decode_serial = round(serial["decode_rate"], 3)
     decode = decode_serial
@@ -422,6 +442,7 @@ def calibrate_rates(base_url: str, *, prompt_tokens: int = 24,
         "calibration": {
             "n": serial["n"], "concurrency": int(concurrency),
             "total_slots": total_slots,
+            "step_host_overhead_frac": host_frac,
             "ttft_ms": round(serial["ttft_s"] * 1000.0, 3),
             "latency_ms": round(serial["lat_s"] * 1000.0, 3),
             "tokens_out_mean": round(serial["toks"], 2),

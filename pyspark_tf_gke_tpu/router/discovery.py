@@ -283,10 +283,21 @@ class ReplicaSet:
             cap = sum(int(r.load.get("capacity_free") or 0) for r in ups)
             demand = sum(r.outstanding_tokens() for r in ups)
             delays = [r.load.get("queue_delay_ms") for r in ups]
-        delay_max = max(
-            (float(d) for d in delays
-             if isinstance(d, (int, float)) and not isinstance(d, bool)),
-            default=0.0)
+            fracs = [r.load.get("step_host_overhead_frac") for r in ups]
+
+        def _max_num(vals):
+            return max(
+                (float(v) for v in vals
+                 if isinstance(v, (int, float))
+                 and not isinstance(v, bool)),
+                default=0.0)
+
+        delay_max = _max_num(delays)
+        # worst routable replica's engine host-overhead share (/loadz
+        # step_host_overhead_frac): a fleet whose steps are majority
+        # host bookkeeping saturates below its device capacity — the
+        # capacity/demand terms alone can't see that
+        frac_max = _max_num(fracs)
         if self._obs is not None:
             g = self._obs.get("router_capacity_free_total")
             if g is not None:
@@ -296,7 +307,8 @@ class ReplicaSet:
                 g.set(demand)
         return {"capacity_free_total": cap,
                 "demand_tokens_total": demand,
-                "queue_delay_ms_max": round(delay_max, 2)}
+                "queue_delay_ms_max": round(delay_max, 2),
+                "step_host_overhead_frac_max": round(frac_max, 4)}
 
     def snapshot(self) -> List[dict]:
         """JSON-ready table for the router's own /healthz."""

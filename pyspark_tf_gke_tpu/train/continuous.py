@@ -58,6 +58,7 @@ import numpy as np
 from pyspark_tf_gke_tpu.chaos.inject import chaos_fire
 from pyspark_tf_gke_tpu.models.causal_lm import CausalLM
 from pyspark_tf_gke_tpu.obs.metrics import platform_families
+from pyspark_tf_gke_tpu.obs.stepstats import StepStatsRing, flops_per_token
 from pyspark_tf_gke_tpu.obs.trace import annotate_request_shape
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
@@ -1704,7 +1705,9 @@ class ContinuousEngine:
                  spec_tokens: int = 0,
                  draft_model: Optional[CausalLM] = None,
                  draft_params=None,
-                 obs=None):
+                 obs=None,
+                 stepstats: Optional[StepStatsRing] = None,
+                 peak_flops: float = 0.0):
         if num_slots < 1 or chunk < 1:
             raise ValueError("num_slots and chunk must be >= 1")
         if schedule not in ("fifo", "longest"):
@@ -1923,6 +1926,20 @@ class ContinuousEngine:
         # passes its own); default is the process registry.
         self._obs = obs if obs is not None else platform_families()
         self._obs["serve_slots_total"].set(num_slots)
+        # step telemetry (obs/stepstats.py): one record per step() —
+        # phase-exclusive timing + batch composition — into a bounded
+        # ring exposed as GET /stepz. The serving front passes ITS
+        # ring so history survives engine rebuilds; direct callers
+        # (bench, tests) get a private default-size one. peak_flops
+        # arms the windowed serve_mfu gauge (0 = disabled — the CPU
+        # default; FLOPs/token is estimated from the model config).
+        self.stepstats = (stepstats if stepstats is not None
+                          else StepStatsRing())
+        self.stepstats.bind(self._obs,
+                            flops_per_token=flops_per_token(model.cfg),
+                            peak_flops=peak_flops)
+        self._step_rec = None  # the in-flight step's record (set only
+        #   inside step(); _dispatch_chunk/_collect annotate through it)
         self._n_prefill_chunks = 0  # pieces processed (all admissions)
         self._n_prefill_tokens = 0  # prompt tokens actually COMPUTED
         #   by prefill forwards (pieces, buckets, extensions) — the
@@ -3080,6 +3097,14 @@ class ContinuousEngine:
         return 2 + max(1, self.chunk // (k + 1)) * (k + 1)
 
     # -- the loop --------------------------------------------------------
+    def _phase(self, name: str):
+        """Phase-timing context on the in-flight step record (no-op
+        outside step() — warm_prefix/cancel callers pay one attribute
+        check)."""
+        rec = self._step_rec
+        return rec.phase(name) if rec is not None else (
+            contextlib.nullcontext())
+
     def _effective_chunk(self) -> int:
         """Chunk size for the next dispatch. Fixed mode: ``self.chunk``.
         Adaptive mode: the largest power-of-two bucket (floored at
@@ -3146,17 +3171,24 @@ class ContinuousEngine:
         chaos_fire("engine.device_step")
         any_sampling = any(r.temperature > 0
                            for r in self._slots.values())
+        if self._step_rec is not None:
+            self._step_rec.decode_slots = max(
+                self._step_rec.decode_slots, len(self._slots))
         if self._spec:
             return self._dispatch_spec(size, any_sampling)
         self._n_dispatched_steps += size
         if self.announce and not self.pipeline_depth:
-            toks, live = self._announced(
-                lambda wire: wire.announce_cb_chunk(
-                    self.num_slots, size, self.eos_token_id,
-                    self.pad_id, sampling=any_sampling),
-                lambda: self._device.chunk(
-                    size, self.eos_token_id, self.pad_id,
-                    sampling=any_sampling))
+            # the unpipelined announce path blocks on the readback
+            # INSIDE the dispatch: carve the device sync out of the
+            # dispatch phase so host overhead stays honest
+            with self._phase("device_wait"):
+                toks, live = self._announced(
+                    lambda wire: wire.announce_cb_chunk(
+                        self.num_slots, size, self.eos_token_id,
+                        self.pad_id, sampling=any_sampling),
+                    lambda: self._device.chunk(
+                        size, self.eos_token_id, self.pad_id,
+                        sampling=any_sampling))
             return "host", toks, live, dict(self._slots), size
         toks_dev, live_dev = self._announced(
             lambda wire: wire.announce_cb_chunk(
@@ -3198,16 +3230,19 @@ class ContinuousEngine:
         # of "decode steps dispatched"
         self._n_dispatched_steps += rounds * (2 * k + 2) + 2
         self._n_spec_rounds += rounds
+        if self._step_rec is not None:
+            self._step_rec.spec_rounds += rounds
         adv = 1 + rounds * (k + 1)  # max tokens emitted per slot
         if self.announce and not self.pipeline_depth:
-            out = self._announced(
-                lambda wire: wire.announce_cb_chunk(
-                    self.num_slots, rounds, self.eos_token_id,
-                    self.pad_id, sampling=any_sampling,
-                    spec_tokens=k),
-                lambda: self._device.spec_chunk(
-                    rounds, self.eos_token_id, self.pad_id,
-                    sampling=any_sampling))
+            with self._phase("device_wait"):
+                out = self._announced(
+                    lambda wire: wire.announce_cb_chunk(
+                        self.num_slots, rounds, self.eos_token_id,
+                        self.pad_id, sampling=any_sampling,
+                        spec_tokens=k),
+                    lambda: self._device.spec_chunk(
+                        rounds, self.eos_token_id, self.pad_id,
+                        sampling=any_sampling))
             return "spec_host", out, None, dict(self._slots), adv
         out = self._announced(
             lambda wire: wire.announce_cb_chunk(
@@ -3272,16 +3307,22 @@ class ContinuousEngine:
         if kind == "host":
             toks, live_host = a, b
         elif kind == "dev":
-            toks, live_host = self._announced(
-                lambda wire: wire.announce_cb_collect(self.num_slots),
-                lambda: self._device.fetch(a, b))
+            # the serial loop's ONE blocking device sync: everything
+            # outside this context is host overhead by definition
+            with self._phase("device_wait"):
+                toks, live_host = self._announced(
+                    lambda wire: wire.announce_cb_collect(
+                        self.num_slots),
+                    lambda: self._device.fetch(a, b))
         elif kind == "spec_host":
             spec_data = _unpack_spec(a[0], self.spec_tokens)
             live_host = spec_data[-1]
         else:  # spec_dev: ONE packed gather at the collect
-            packed = self._announced(
-                lambda wire: wire.announce_cb_collect(self.num_slots),
-                lambda: self._device.fetch_tuple(a))
+            with self._phase("device_wait"):
+                packed = self._announced(
+                    lambda wire: wire.announce_cb_collect(
+                        self.num_slots),
+                    lambda: self._device.fetch_tuple(a))
             spec_data = _unpack_spec(packed[0], self.spec_tokens)
             live_host = spec_data[-1]
         newly_done = []
@@ -3371,6 +3412,8 @@ class ContinuousEngine:
         self._n_finished += len(newly_done)
         if spec_data is not None:
             self._note_spec_stats(chunk_prop, chunk_acc)
+        if self._step_rec is not None:
+            self._step_rec.tokens_out += useful_tokens
         if useful_tokens:
             self._obs["serve_useful_tokens_total"].inc(useful_tokens)
         self._obs["serve_slots_active"].set(len(self._slots))
@@ -3384,16 +3427,47 @@ class ContinuousEngine:
         With ``pipeline_depth=N`` the collect runs up to N chunks behind
         the dispatch: the chunk launched this call is read back N calls
         later, so the device works ahead while the host waits on older
-        tokens."""
-        expired = self._expire_deadlines()
+        tokens.
+
+        Step telemetry (obs/stepstats.py): every step that does work
+        closes exactly ONE record into ``self.stepstats`` — outcome
+        "ok" on return, "error" when the step raises (a failed device
+        dispatch, a chaos fail — the record closes in the except arm
+        before the exception reaches the rebuild path), and the
+        serving front relabels the record "reaped" when the watchdog
+        intervened while the step hung. A step that never returns has
+        an open record that never enters the ring — no half rows."""
+        rec = self.stepstats.begin(queue_depth=len(self._queue))
+        self._step_rec = rec
+        try:
+            finished = self._step_body(rec)
+        except BaseException:
+            self.stepstats.close(rec, outcome="error")
+            raise
+        finally:
+            self._step_rec = None
+        if rec.activity:
+            self.stepstats.close(rec)
+        else:
+            self.stepstats.discard(rec)  # idle spin: no record
+        return finished
+
+    def _step_body(self, rec) -> List[_Request]:
+        with rec.phase("expire"):
+            expired = self._expire_deadlines()
+        rec.expired = len(expired)
         # per-step prefill-token accounting for the budget: pieces run
         # here AND inside _admit_waiting (a fresh admission's first
         # piece runs from _try_admit) — the counter sees both, so the
         # admission-start step's decode chunk is capped too
         self._step_prefill_tokens = 0
-        if self._admitting is not None:
-            self._advance_admission()
-        self._admit_waiting()
+        pieces0 = self._n_prefill_chunks
+        with rec.phase("schedule"):
+            if self._admitting is not None:
+                self._advance_admission()
+            self._admit_waiting()
+        rec.prefill_pieces = self._n_prefill_chunks - pieces0
+        rec.prefill_tokens = self._step_prefill_tokens
         self._obs["serve_prefill_inflight"].set(
             1 if self._admitting is not None else 0)
         cap = self._budget_cap(self._step_prefill_tokens)
@@ -3408,7 +3482,11 @@ class ContinuousEngine:
                 size = self._spec_rounds(size, cap)
             elif cap:
                 size = min(size, cap)
-            return expired + self._collect(self._dispatch_chunk(size))
+            with rec.phase("dispatch"):
+                inflight = self._dispatch_chunk(size)
+            with rec.phase("collect"):
+                collected = self._collect(inflight)
+            return expired + collected
         dispatched = False
         if self._slots:
             size = self._effective_chunk()
@@ -3417,7 +3495,8 @@ class ContinuousEngine:
             elif size and cap:
                 size = min(size, cap)
             if size:  # 0 = every slot's budget is already in flight
-                self._inflight_q.append(self._dispatch_chunk(size))
+                with rec.phase("dispatch"):
+                    self._inflight_q.append(self._dispatch_chunk(size))
                 dispatched = True
         finished = list(expired)
         # Drain down to the target depth. With live slots, exactly one
@@ -3430,7 +3509,8 @@ class ContinuousEngine:
         while (len(self._inflight_q) > self.pipeline_depth
                or (self._inflight_q and not self._slots)
                or (self._inflight_q and not dispatched)):
-            finished += self._collect(self._inflight_q.popleft())
+            with rec.phase("collect"):
+                finished += self._collect(self._inflight_q.popleft())
             if self._slots:  # collects freed slots mid-flush: stop at
                 break        # target depth next call, after admissions
         return finished
@@ -3442,6 +3522,15 @@ class ContinuousEngine:
                or self._inflight_q):
             for req in self.step():
                 yield req.rid, req.tokens
+
+    @property
+    def busy(self) -> bool:
+        """Any work pending? The serving front's driver loop polls
+        this every iteration — it must stay O(1) (``stats`` builds the
+        full snapshot, including the windowed step-phase summary, and
+        is NOT loop-cheap)."""
+        return bool(self._queue or self._slots
+                    or self._admitting is not None or self._inflight_q)
 
     @property
     def stats(self) -> dict:
@@ -3461,6 +3550,10 @@ class ContinuousEngine:
             "dispatched_steps": self._n_dispatched_steps,
             "prefill_chunks": self._n_prefill_chunks,
             "prefill_tokens_computed": self._n_prefill_tokens,
+            # windowed step-phase decomposition (obs/stepstats.py):
+            # host-overhead fraction + per-phase p50/p99 — the cb
+            # bench's trail block and the /loadz fraction read this
+            "step_phases": self.stepstats.summary(),
             **({"step_token_budget": self.step_token_budget}
                if self.step_token_budget else {}),
             **({"spec": {

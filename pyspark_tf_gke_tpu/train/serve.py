@@ -57,6 +57,7 @@ from pyspark_tf_gke_tpu.obs.events import get_event_log
 from pyspark_tf_gke_tpu.obs.export import handle_obs_request
 from pyspark_tf_gke_tpu.obs.metrics import get_registry, platform_families
 from pyspark_tf_gke_tpu.obs.runtime import install_runtime_metrics
+from pyspark_tf_gke_tpu.obs.stepstats import StepStatsRing
 from pyspark_tf_gke_tpu.obs.trace import (
     TraceRecorder,
     annotate_request_shape,
@@ -122,6 +123,12 @@ def _reloading_rejection() -> RequestRejected:
 class ReloadInFlight(RuntimeError):
     """A bundle reload is already running (HTTP 409): reloads serialize
     — the coordinator retries after the in-flight one settles."""
+
+
+class ProfileInFlight(RuntimeError):
+    """A profiler capture is already running (HTTP 409): jax.profiler
+    holds one process-global trace session — captures serialize, same
+    contract as bundle reloads."""
 
 
 class BundleReloadError(RuntimeError):
@@ -311,7 +318,9 @@ class _ContinuousFront:
                  max_queue_depth: int = 0, max_queued_tokens: int = 0,
                  chaos=None, heartbeat=None, tenants=None,
                  step_timeout_s: float = 0.0, spec_tokens: int = 0,
-                 draft_model=None, draft_params=None):
+                 draft_model=None, draft_params=None,
+                 step_record_ring: int = 256, peak_flops: float = 0.0,
+                 tracer=None):
         # multi-tenant fairness/quotas: parsed spec (parse_tenant_spec
         # output or an equivalent dict), or None = tenancy off (every
         # request rides the "default" tenant; admission bounds stay
@@ -326,12 +335,18 @@ class _ContinuousFront:
                 if cfg["rate"] is not None:
                     self._buckets[name] = TokenBucket(cfg["rate"],
                                                       cfg["burst"])
+        # the FRONT owns the step-telemetry ring and threads it through
+        # every engine it builds, so GET /stepz history and the /loadz
+        # host-overhead fraction survive engine rebuilds
+        self.stepstats = StepStatsRing(capacity=max(1,
+                                                    int(step_record_ring)))
         self._engine_args = (model, params, eos_id, num_slots, chunk,
                              mesh, announce, prefix_cache_size,
                              prefill_chunk, step_token_budget,
                              pipeline_depth, adaptive_chunk,
                              schedule, self._tenant_weights,
-                             spec_tokens, draft_model, draft_params)
+                             spec_tokens, draft_model, draft_params,
+                             self.stepstats, float(peak_flops))
         self._announce = announce
         self._obs = obs if obs is not None else platform_families()
         self._event_log = (event_log if event_log is not None
@@ -374,6 +389,16 @@ class _ContinuousFront:
         self._step_started = None  # monotonic at engine.step() entry
         self._wedged = False
         self._last_loop_ts = time.monotonic()
+        # on-demand profiler capture (POST /admin/profile): the driver
+        # loop starts a jax.profiler trace at the next BUSY step and
+        # stops it after N busy steps, emitting profile_trace_written
+        # with the covered step-seq window + recent trace ids so an
+        # xprof capture, a /stepz window and a /traces slow trace all
+        # cross-link. One capture at a time (jax.profiler is
+        # process-global) — a second request 409s.
+        self._profile_lock = threading.Lock()
+        self._profile = None
+        self._tracer = tracer
         self.thread = threading.Thread(
             target=self._loop, name="continuous-engine", daemon=True)
         self.thread.start()
@@ -391,7 +416,7 @@ class _ContinuousFront:
          prefix_cache_size, prefill_chunk, step_token_budget,
          pipeline_depth, adaptive_chunk, schedule,
          tenant_weights, spec_tokens, draft_model,
-         draft_params) = self._engine_args
+         draft_params, stepstats, peak_flops) = self._engine_args
         return ContinuousEngine(model, params, num_slots=num_slots,
                                 chunk=chunk, eos_token_id=eos_id,
                                 mesh=mesh, announce=announce,
@@ -405,7 +430,9 @@ class _ContinuousFront:
                                 spec_tokens=spec_tokens,
                                 draft_model=draft_model,
                                 draft_params=draft_params,
-                                obs=self._obs)
+                                obs=self._obs,
+                                stepstats=stepstats,
+                                peak_flops=peak_flops)
 
     # -- tenancy helpers -------------------------------------------------
 
@@ -834,11 +861,7 @@ class _ContinuousFront:
             deadline = time.monotonic() + float(drain_s)
             try:
                 while time.monotonic() < deadline:
-                    stats = self.engine.stats
-                    busy = bool(stats["active"] or stats["queued"]
-                                or stats["admitting"] is not None
-                                or stats["inflight"])
-                    if not busy:
+                    if not self.engine.busy:
                         break
                     self._deliver_finished(self.engine.step())
             except Exception:  # noqa: BLE001 — drain is best-effort;
@@ -928,6 +951,88 @@ class _ContinuousFront:
                 "failed %d in-flight request(s); engine rebuilds when "
                 "the step returns", stuck_s, self.step_timeout_s, reaped)
 
+    def start_profile(self, output_dir: str, steps: int) -> dict:
+        """Arm an on-demand ``jax.profiler`` capture: the driver loop
+        starts the trace at the next BUSY step and stops it after
+        ``steps`` busy steps, emitting ``profile_trace_written``.
+        Raises :class:`ProfileInFlight` while one is armed/running
+        (HTTP 409 — jax.profiler holds one process-global session).
+        The capture waits for real traffic: an idle engine holds the
+        armed capture until work arrives."""
+        steps = int(steps)
+        if steps < 1:
+            raise ValueError(f"profile steps must be >= 1, got {steps}")
+        with self._profile_lock:
+            if self._profile is not None:
+                raise ProfileInFlight(
+                    "a profiler capture is already in flight")
+            self._profile = {"dir": str(output_dir), "steps": steps,
+                             "remaining": steps, "started": False,
+                             "seq_first": None, "seq_last": None}
+        return {"output_dir": str(output_dir), "steps": steps,
+                "armed": True}
+
+    def profile_in_flight(self) -> bool:
+        with self._profile_lock:
+            return self._profile is not None
+
+    def _profile_maybe_start(self) -> None:
+        """Driver-loop hook, just before a busy step: start the armed
+        capture (once)."""
+        p = self._profile
+        if p is None or p["started"]:
+            return
+        try:
+            jax.profiler.start_trace(p["dir"])
+            p["started"] = True
+            logger.info("profiler capture started -> %s (%d steps)",
+                        p["dir"], p["steps"])
+        except Exception:  # noqa: BLE001 — a broken profiler session
+            # must not take the driver loop down; disarm and report
+            logger.exception("jax.profiler.start_trace failed; "
+                             "capture disarmed")
+            with self._profile_lock:
+                self._profile = None
+
+    def _profile_note_step(self, seq: int) -> None:
+        """Driver-loop hook, after a step that CLOSED a record (no-op
+        spins don't advance a capture): count it and stop the capture
+        at zero, stamping the covered step-seq window and the
+        recorder's recent trace ids into the event — the cross-links
+        that let an xprof capture, a /stepz window and a /traces slow
+        trace name each other. ``seq`` is the just-closed record's
+        seq: first/last counted seqs bound the window, so both name
+        records that actually entered the ring (a discarded no-op
+        step's consumed seq never appears)."""
+        p = self._profile
+        if p is None or not p["started"]:
+            return
+        if p["seq_first"] is None:
+            p["seq_first"] = seq
+        p["seq_last"] = seq
+        p["remaining"] -= 1
+        if p["remaining"] > 0:
+            return
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001
+            logger.exception("jax.profiler.stop_trace failed")
+        trace_ids = []
+        if self._tracer is not None:
+            try:
+                trace_ids = [t.get("trace_id")
+                             for t in self._tracer.traces(limit=8)]
+            except Exception:  # noqa: BLE001 — best-effort cross-link
+                pass
+        self._event_log.emit(
+            "profile_trace_written", output_dir=p["dir"],
+            steps=p["steps"], step_seq_first=p["seq_first"],
+            step_seq_last=p["seq_last"], trace_ids=trace_ids)
+        logger.info("profiler capture written to %s (steps %s..%s)",
+                    p["dir"], p["seq_first"], p["seq_last"])
+        with self._profile_lock:
+            self._profile = None
+
     def _loop(self):
         beat = 0
         while not self.stop.is_set():
@@ -939,12 +1044,10 @@ class _ContinuousFront:
                 except OSError:  # liveness signal must never take the
                     pass         # driver loop down with it
             busy = False
+            seq0 = None  # first seq this iteration's step could close
             with self.lock:
                 try:
-                    stats = self.engine.stats
-                    busy = bool(stats["active"] or stats["queued"]
-                                or stats["admitting"] is not None
-                                or stats["inflight"])
+                    busy = self.engine.busy
                     if busy and self._chaos is not None:
                         # counted on BUSY iterations only (deterministic
                         # against idle-spin timing); a raise here lands
@@ -954,12 +1057,42 @@ class _ContinuousFront:
                         self._chaos.maybe_slow(self._chaos_step)
                         self._chaos.maybe_fail(self._chaos_step)
                     if busy:
+                        self._profile_maybe_start()
+                        seq0 = self.engine.stepstats.next_seq
                         self._step_started = time.monotonic()
                     try:
                         finished = self.engine.step() if busy else []
                     finally:
                         self._step_started = None
+                    t_deliver = time.monotonic()
                     self._deliver_finished(finished)
+                    if busy:
+                        # the one step phase that runs OUTSIDE
+                        # engine.step(): amend delivery time onto the
+                        # just-closed record (wall grows with it, so
+                        # the phase-sum invariant holds). seq-guarded:
+                        # a step that discarded its record (nothing to
+                        # do) must not smear delivery onto an OLD one.
+                        rec = self.engine.stepstats.last_record
+                        if (rec is not None and rec.closed
+                                and rec.seq >= seq0):
+                            self.engine.stepstats.add_deliver(
+                                rec, (time.monotonic() - t_deliver)
+                                * 1000.0)
+                            if self._wedged:
+                                # the watchdog reaped this step's
+                                # waiters while it hung: relabel the
+                                # record (amend-in-place — it was
+                                # closed exactly once above)
+                                self.engine.stepstats.mark_reaped(rec)
+                            # capture progress counts CLOSED step
+                            # records only: a busy iteration whose
+                            # step discarded its record (blocked
+                            # admission no-op spin) must not complete
+                            # the profile over zero device work — the
+                            # emitted step-seq window has to name
+                            # records that exist
+                            self._profile_note_step(rec.seq)
                     if self._wedged:
                         # the stuck step RETURNED: its waiters were
                         # already reaped (completions among `finished`
@@ -984,6 +1117,15 @@ class _ContinuousFront:
                     self._event_log.emit(
                         "engine_rebuilt", inflight=len(self._results),
                         error=f"{type(exc).__name__}: {exc}"[:500])
+                    # a failed step still closed a record (outcome=
+                    # error) into the ring: advance any armed capture
+                    # or a persistently failing engine would leave the
+                    # process-global jax trace open forever (every
+                    # later /admin/profile 409s with no disarm path)
+                    rec = self.engine.stepstats.last_record
+                    if (seq0 is not None and rec is not None
+                            and rec.closed and rec.seq >= seq0):
+                        self._profile_note_step(rec.seq)
                     try:
                         # the dead engine's accepted-but-undelivered
                         # requests never reach step()'s delivery path:
@@ -1039,14 +1181,11 @@ class _ContinuousFront:
         deadline = time.monotonic() + timeout_s
         while True:
             with self.lock:
-                stats = self.engine.stats
+                busy = self.engine.busy
                 with self._results_lock:
                     pending = any(
                         slot[1] is None and not slot[0].is_set()
                         for slot in self._results.values())
-                busy = bool(stats["active"] or stats["queued"]
-                            or stats["admitting"] is not None
-                            or stats["inflight"])
             if not pending and not busy:
                 return True
             if time.monotonic() >= deadline:
@@ -1057,6 +1196,13 @@ class _ContinuousFront:
         self.stop.set()
         self.new_work.set()
         self.thread.join(timeout=10)
+        with self._profile_lock:
+            p, self._profile = self._profile, None
+        if p is not None and p.get("started"):
+            try:  # don't leave a process-global trace session dangling
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
         # Fail every still-pending waiter NOW with a terminal shutdown
         # error — before this, a waiter blocked in wait() sat out its
         # FULL timeout (600s default) against a driver thread that was
@@ -1094,7 +1240,9 @@ class BundleServer:
                  trace_slow_ms: float = 1000.0,
                  step_timeout_s: float = 0.0,
                  live_stall_s: float = 120.0,
-                 spec_tokens: int = 0):
+                 spec_tokens: int = 0,
+                 step_record_ring: int = 256,
+                 peak_flops: float = 0.0):
         from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
 
         self.mesh = mesh
@@ -1248,7 +1396,10 @@ class BundleServer:
                 step_timeout_s=step_timeout_s,
                 spec_tokens=self.spec_tokens,
                 draft_model=self.draft_model,
-                draft_params=self.draft_params)
+                draft_params=self.draft_params,
+                step_record_ring=step_record_ring,
+                peak_flops=peak_flops,
+                tracer=self.tracer)
 
     # -- bundle loading / hot-swap ---------------------------------------
 
@@ -1453,6 +1604,51 @@ class BundleServer:
 
     # -- drain lifecycle -------------------------------------------------
 
+    def start_profile(self, output_dir: Optional[str],
+                      steps: int = 8) -> dict:
+        """On-demand profiler capture (``POST /admin/profile``, admin-
+        token-gated like ``/admin/reload``): arm a ``jax.profiler``
+        trace over the next ``steps`` BUSY engine steps, written to
+        ``output_dir`` (a fresh temp dir when omitted — the response
+        says where). Asynchronous: returns as soon as the capture is
+        armed; completion lands on the event trail as
+        ``profile_trace_written`` with the covered step-seq window and
+        recent trace ids. Raises :class:`ProfileInFlight` (409) while
+        a capture is armed/running, :class:`ValueError` (400) on a
+        whole-batch server (no step loop to profile)."""
+        if self._front is None:
+            raise ValueError(
+                "profiling requires --continuous-slots (the capture "
+                "spans engine steps; whole-batch serving has no step "
+                "loop)")
+        # validate + in-flight precheck BEFORE touching the filesystem
+        # (a client polling the endpoint while a capture runs must not
+        # leak one orphan temp dir per 409); the front's LOCKED check
+        # stays authoritative — if two arms race past the precheck,
+        # the loser's fresh temp dir is removed again below
+        if int(steps) < 1:
+            raise ValueError(f"profile steps must be >= 1, got {steps}")
+        if self._front.profile_in_flight():
+            raise ProfileInFlight(
+                "a profiler capture is already in flight")
+        created = None
+        if not output_dir:
+            import tempfile
+
+            output_dir = tempfile.mkdtemp(prefix="stepprof-")
+            created = output_dir
+        else:
+            os.makedirs(output_dir, exist_ok=True)
+        try:
+            return self._front.start_profile(output_dir, steps)
+        except ProfileInFlight:
+            if created is not None:
+                import contextlib
+
+                with contextlib.suppress(OSError):
+                    os.rmdir(created)
+            raise
+
     @property
     def draining(self) -> bool:
         return self._draining.is_set()
@@ -1585,6 +1781,13 @@ class BundleServer:
             # (0.0 when --spec-tokens is off) — speculation quality a
             # router/capacity model can score on
             "spec_accept_rate": 0.0,
+            # step telemetry (obs/stepstats.py): windowed host-overhead
+            # fraction of the engine step loop — the router's autoscale
+            # block takes the fleet max, replay/capacity calibration
+            # records it next to the measured service rates, and the
+            # ROADMAP item-4 async refactor is A/B'd against it
+            # (0.0 for whole-batch servers / before the first step)
+            "step_host_overhead_frac": 0.0,
         }
         if self._front is not None:
             stats = self._front.engine.stats
@@ -1620,6 +1823,10 @@ class BundleServer:
             if self.spec_tokens:
                 out["spec_accept_rate"] = round(
                     self._front.engine.spec_accept_rate(), 4)
+            # from the stats snapshot already in hand (summary() pre-
+            # rounds it) — no second ring-lock pass per /loadz probe
+            out["step_host_overhead_frac"] = (
+                stats["step_phases"]["host_overhead_frac"])
             tenants = {}
             for name, t in (stats.get("tenants") or {}).items():
                 tenants[name] = {"queued": t["queued"],
@@ -2138,6 +2345,26 @@ def _shed_body(exc: RequestRejected) -> dict:
     return body
 
 
+def _admin_token_error(server: BundleServer, headers):
+    """THE admin-endpoint token gate, shared by ``/admin/reload`` and
+    ``/admin/profile`` so the 403/401 discipline cannot drift between
+    them: no ``SERVE_ADMIN_TOKEN`` on the server → the endpoint does
+    not exist operationally (403); configured → the caller must
+    present it in ``X-Admin-Token``, compared constant-time
+    (hmac.compare_digest — a byte-wise ``!=`` would leak the token
+    prefix-by-prefix through response timing). Returns ``(status,
+    body)`` to reply with, or ``None`` when authorized."""
+    if not server.admin_token:
+        return 403, {"error": "admin endpoint disabled (set "
+                              "SERVE_ADMIN_TOKEN to enable)"}
+    import hmac
+
+    if not hmac.compare_digest(headers.get("X-Admin-Token") or "",
+                               server.admin_token):
+        return 401, {"error": "bad or missing X-Admin-Token"}
+    return None
+
+
 def _make_handler(server: BundleServer):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -2269,11 +2496,15 @@ def _make_handler(server: BundleServer):
             if route == "/metrics":
                 server._refresh_engine_gauges()
                 extra = server._legacy_metrics_text()
+            front = getattr(server, "_front", None)
             out = handle_obs_request(self.path, server.registry,
                                      server.event_log,
                                      extra_exposition=extra,
                                      tracer=getattr(server, "tracer",
-                                                    None))
+                                                    None),
+                                     stepstats=(front.stepstats
+                                                if front is not None
+                                                else None))
             if out is None:
                 return self._reply(404,
                                    {"error": f"unknown path {self.path}"})
@@ -2397,30 +2628,16 @@ def _make_handler(server: BundleServer):
                     self._reply(200, out)
                 elif self.path == "/admin/reload":
                     # bundle hot-swap (the coordinator's publish path).
-                    # Token-gated via env: no SERVE_ADMIN_TOKEN on the
-                    # server -> the endpoint does not exist operationally
-                    # (403); set it and the caller must present it in
-                    # X-Admin-Token. The reload itself serializes (409
+                    # Token gate shared with /admin/profile
+                    # (_admin_token_error): 403 unconfigured, 401
+                    # mismatch. The reload itself serializes (409
                     # while one is in flight) and rolls back on failure.
-                    if not server.admin_token:
+                    err = _admin_token_error(server, self.headers)
+                    if err is not None:
                         server.record_metrics()
                         server._obs["serve_bundle_reloads_total"].labels(
                             outcome="rejected").inc()
-                        return self._reply(403, {
-                            "error": "admin endpoint disabled (set "
-                                     "SERVE_ADMIN_TOKEN to enable)"})
-                    import hmac
-
-                    # constant-time: a byte-wise != would leak the
-                    # token prefix-by-prefix through response timing
-                    if not hmac.compare_digest(
-                            self.headers.get("X-Admin-Token") or "",
-                            server.admin_token):
-                        server.record_metrics()
-                        server._obs["serve_bundle_reloads_total"].labels(
-                            outcome="rejected").inc()
-                        return self._reply(
-                            401, {"error": "bad or missing X-Admin-Token"})
+                        return self._reply(err[0], err[1])
                     bundle = req.get("bundle")
                     if not isinstance(bundle, str) or not bundle:
                         server.record_metrics(failed=True)
@@ -2434,6 +2651,21 @@ def _make_handler(server: BundleServer):
                         canary=bool(req.get("canary", True)))
                     server.record_metrics()
                     self._reply(200, out)
+                elif self.path == "/admin/profile":
+                    # on-demand xprof capture over the next N busy
+                    # engine steps — same token gate (403/401) and
+                    # one-at-a-time 409 discipline as /admin/reload;
+                    # 202: the capture is ARMED, completion lands on
+                    # /events as profile_trace_written
+                    err = _admin_token_error(server, self.headers)
+                    if err is not None:
+                        server.record_metrics()
+                        return self._reply(err[0], err[1])
+                    out = server.start_profile(
+                        req.get("output_dir"),
+                        steps=int(req.get("steps", 8)))
+                    server.record_metrics()
+                    self._reply(202, out)
                 elif self.path == "/v1/score":
                     texts = req.get("texts")
                     if not isinstance(texts, list) or not all(
@@ -2468,6 +2700,9 @@ def _make_handler(server: BundleServer):
                 server.record_metrics()
                 server._obs["serve_bundle_reloads_total"].labels(
                     outcome="rejected").inc()
+                self._reply(409, {"error": str(exc)})
+            except ProfileInFlight as exc:
+                server.record_metrics()
                 self._reply(409, {"error": str(exc)})
             except BundleReloadError as exc:
                 # the old generation is serving either way; the body
@@ -2664,6 +2899,20 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "serve.request:fail%%0.05,seed=7' — see "
                         "docs/CHAOS.md for the point catalog); "
                         "NEVER set in production")
+    p.add_argument("--step-record-ring", type=int,
+                   default=int(e("SERVE_STEP_RECORD_RING", "256")),
+                   help="step telemetry: keep the last N engine-step "
+                        "records (per-phase timing + batch "
+                        "composition) in the GET /stepz ring; the "
+                        "windowed host-overhead fraction rides /loadz "
+                        "as step_host_overhead_frac (continuous-slots "
+                        "mode only)")
+    p.add_argument("--peak-flops", type=float,
+                   default=float(e("SERVE_PEAK_FLOPS", "0")),
+                   help="per-chip peak FLOPs/sec for the serve_mfu "
+                        "gauge (e.g. 1.97e14 for v5e bf16); 0 = MFU "
+                        "disabled — the CPU default, where a peak "
+                        "number would be meaningless")
     p.add_argument("--step-timeout", type=float,
                    default=float(e("SERVE_STEP_TIMEOUT", "0")),
                    help="step watchdog: when one engine step (device "
@@ -2776,6 +3025,8 @@ def main(argv=None) -> int:
         step_timeout_s=args.step_timeout,
         live_stall_s=args.live_stall,
         spec_tokens=args.spec_tokens,
+        step_record_ring=args.step_record_ring,
+        peak_flops=args.peak_flops,
         # env-only by design: a token flag would leak into ps output
         # and pod specs; the k8s manifest mounts it from a Secret
         admin_token=os.environ.get("SERVE_ADMIN_TOKEN", ""))

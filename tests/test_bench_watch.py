@@ -232,7 +232,12 @@ def test_normalize_argv_order_insensitive():
     a = bench._normalize_argv(["bert", "--seq", "2048", "--no-flash"])
     b = bench._normalize_argv(["bert", "--no-flash", "--seq", "2048"])
     assert a == b
-    assert bench._normalize_argv(["cnn", "--smoke"]) == ["cnn"]
+    # --smoke is part of the identity (a tiny-shape smoke measurement,
+    # recordable via --history, must never stand in for the full one);
+    # the --history/--no-history markers are not
+    assert bench._normalize_argv(["cnn", "--smoke"]) == ["cnn", "--smoke"]
+    assert bench._normalize_argv(["cnn", "--smoke", "--history"]) == \
+        ["cnn", "--smoke"]
     assert bench._normalize_argv([]) == ["cnn"]
     assert (bench._normalize_argv(["cnn", "--bf16-moments"])
             != bench._normalize_argv(["cnn"]))
@@ -640,7 +645,20 @@ def test_trail_report_renders_dict_disclosures():
                                     "chunk128_depth2": 1800.5}}}
     out = trail_report.row(e)
     assert '"chunk64_depth1":1700.1' in out
-    assert out.count("|") == 6  # 5 columns + borders: grid stayed one cell
+    # 6 columns + borders (incl. the step-telemetry host-overhead
+    # column): grid stayed one cell
+    assert out.count("|") == 7
+    assert "| — |" in out  # no step_phases block -> em-dash, not 0
+
+
+def test_trail_report_host_overhead_column():
+    from tools import trail_report
+
+    e = {"ts": "t1", "argv": ["cb", "--smoke"],
+         "result": {"metric": "m", "value": 1.0, "unit": "u",
+                    "step_phases": {"host_overhead_frac": 0.5947,
+                                    "records": 12}}}
+    assert "| 59.5% |" in trail_report.row(e)
 
 
 def test_outage_and_summary_lines_fit_tail_window(monkeypatch, tmp_path):

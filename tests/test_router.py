@@ -753,9 +753,9 @@ def test_router_autoscale_signal_from_loadz(stubs, tmp_path):
     sweep, and /healthz exposes the same terms for the HPA adapter."""
     a, b = stubs
     a.load = dict(a.load, capacity_free=300, queue_delay_ms=12.5,
-                  queued_tokens=40)
+                  queued_tokens=40, step_host_overhead_frac=0.31)
     b.load = dict(b.load, capacity_free=200, queue_delay_ms=2.0,
-                  queued_tokens=10)
+                  queued_tokens=10, step_host_overhead_frac=0.04)
     router, prober = _router_for(stubs, tmp_path)
     prober.probe_once()
     reg = router.registry
@@ -767,6 +767,11 @@ def test_router_autoscale_signal_from_loadz(stubs, tmp_path):
     assert auto["capacity_free_total"] == 500
     assert auto["demand_tokens_total"] == 50
     assert auto["queue_delay_ms_max"] == 12.5
+    # step telemetry folds in as the MAX over routable replicas (the
+    # worst engine's host-overhead share — /loadz
+    # step_host_overhead_frac); a replica that doesn't advertise it
+    # (old build, whole-batch) contributes nothing
+    assert auto["step_host_overhead_frac_max"] == 0.31
     assert auto["replicas_routable"] == 2
     assert auto["demand_inflight"] == 0
 

@@ -342,7 +342,7 @@ def test_loadz_snapshot_key_stability(cb_endpoints):
                  "bundle_generation",
                  "prefix_cache_pages", "prefix_hit_rate",
                  "capacity_free", "queue_delay_ms", "tenants",
-                 "spec_accept_rate"}
+                 "spec_accept_rate", "step_host_overhead_frac"}
     for url in (plain_url, cont_url):
         with urllib.request.urlopen(url + "/loadz") as resp:
             assert resp.status == 200
@@ -354,6 +354,9 @@ def test_loadz_snapshot_key_stability(cb_endpoints):
         # (zeros); the slot engine advertises real token headroom
         assert isinstance(out["capacity_free"], int)
         assert isinstance(out["tenants"], dict)
+        # step telemetry: a fraction in [0, 1] (0.0 on whole-batch —
+        # no step loop; the slot engine's windowed host-overhead share)
+        assert 0.0 <= out["step_host_overhead_frac"] <= 1.0
     with urllib.request.urlopen(cont_url + "/loadz") as resp:
         assert json.loads(resp.read())["capacity_free"] > 0
     with urllib.request.urlopen(cont_url + "/loadz") as resp:

@@ -73,10 +73,19 @@ documented band of the measured replay, and a live
 server: --spec-tokens completions token-identical to the plain engine,
 with a nonzero ``/loadz spec_accept_rate``.
 
+``--stepstats`` checks the engine step-telemetry contract live
+(docs/OBSERVABILITY.md "Step telemetry & profiling"): a CPU replica
+under a small request burst must serve a non-empty ``GET /stepz``
+ring whose per-record phase sums reconcile with the step wall, a
+populated ``serve_step_host_overhead_ms`` histogram, a ``/loadz
+step_host_overhead_frac`` in [0, 1], and ``POST /admin/profile``
+must 403 on a token-unconfigured server (the /admin/reload
+discipline).
+
 Usage: python tools/smoke_check.py
        [--lint-only|--kernels-only|--serve-lifecycle|--serve-tbt|
         --router|--prefix-cache|--spec-serve|--fairness|--pipeline|
-        --trace|--replay]
+        --trace|--replay|--stepstats]
 """
 
 import os
@@ -230,7 +239,16 @@ def lint_duplicate_metrics() -> int:
                 # these — a rename must fail here first
                 "serve_spec_proposed_total",
                 "serve_spec_accepted_total",
-                "serve_spec_accept_rate"}
+                "serve_spec_accept_rate",
+                # engine step telemetry (obs/stepstats.py): the
+                # ROADMAP item-4 host/device decomposition — /stepz,
+                # the cb bench's step_phases block, /loadz
+                # step_host_overhead_frac and the router's autoscale
+                # fold all derive from these families
+                "serve_step_host_overhead_ms",
+                "serve_step_phase_ms",
+                "serve_device_idle_fraction",
+                "serve_mfu"}
     absent = {n for n in required if n not in _REGISTRATIONS}
     if absent:
         print("metric lint FAILED — required metric name(s) never "
@@ -1524,6 +1542,169 @@ def trace_check(grace_s: float = 30.0) -> int:
     return 0
 
 
+def stepstats_check(grace_s: float = 30.0) -> int:
+    """``--stepstats``: the step-telemetry contract, live. One CPU
+    replica (continuous slots, admin token deliberately UNSET) under a
+    small request burst:
+
+    1. ``GET /stepz`` serves a non-empty ring; every record's phase
+       sums reconcile with its wall (exclusive attribution: sums never
+       exceed wall + epsilon, and the timed phases cover most of it),
+       the busy records carry batch composition, and the served steps
+       carry the ``deliver`` phase the driver loop amends on;
+    2. the ``serve_step_host_overhead_ms`` histogram is populated and
+       ``serve_device_idle_fraction`` is exported (``/metrics.json``);
+    3. ``/loadz`` advertises ``step_host_overhead_frac`` in [0, 1] —
+       the value the router's autoscale block folds in;
+    4. ``POST /admin/profile`` on a token-unconfigured server returns
+       403 (the endpoint operationally does not exist — the same
+       discipline as ``/admin/reload``)."""
+    import json as _json
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from pyspark_tf_gke_tpu.router.localfleet import (
+        export_tiny_bundle,
+        free_port,
+        launch_replica,
+        wait_healthy,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="stepstats-smoke-")
+    bundle = export_tiny_bundle(os.path.join(tmp, "bundle"))
+    port = free_port()
+    base = f"http://127.0.0.1:{port}"
+    # the 403-unconfigured leg is only meaningful if the replica
+    # really has no token: launch_replica inherits our env, so make
+    # sure a dev shell's token doesn't leak in
+    os.environ.pop("SERVE_ADMIN_TOKEN", None)
+    proc = launch_replica(bundle, port, quiet=False)
+    failures = []
+
+    def get(path: str) -> dict:
+        with urllib.request.urlopen(base + path, timeout=10) as resp:
+            return _json.loads(resp.read())
+
+    def post(path: str, payload: dict, timeout: float = 120.0):
+        req = urllib.request.Request(
+            base + path, data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, _json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            try:
+                body = _json.loads(exc.read() or b"{}")
+            except ValueError:
+                body = {}
+            return exc.code, body
+
+    try:
+        import time as _time
+
+        deadline = _time.time() + 180
+        wait_healthy(base, deadline, proc=proc)
+        # a small burst (the first request also pays compilation):
+        # enough steps that the ring, the histogram and the windowed
+        # fraction are all non-vacuously populated
+        for i in range(4):
+            status, body = post("/v1/generate",
+                                {"prompts": [f"step telemetry {i}"],
+                                 "max_new_tokens": 8})
+            if status != 200 or "completions" not in body:
+                failures.append(f"generate {i} failed: {status} "
+                                f"{str(body)[:200]}")
+
+        # -- 1: /stepz ring + phase-sum reconciliation ---------------
+        out = get("/stepz?n=64")
+        steps = out.get("steps") or []
+        summary = out.get("summary") or {}
+        if not steps:
+            failures.append("/stepz ring is EMPTY after the burst")
+        bad = []
+        for s in steps:
+            phase_sum = sum(s["phases_ms"].values())
+            # exclusive attribution: sums can't exceed wall (epsilon
+            # for float rounding); the timed phases must also cover
+            # the bulk of the step (generous floor — a shared CI core
+            # can stall between contexts)
+            if phase_sum > s["wall_ms"] + 0.5 or (
+                    s["wall_ms"] > 1.0
+                    and phase_sum < 0.5 * s["wall_ms"]):
+                bad.append(f"seq {s['seq']}: phases {phase_sum:.3f}ms "
+                           f"vs wall {s['wall_ms']:.3f}ms")
+        if bad:
+            failures.append("phase sums do not reconcile with step "
+                            f"wall: {bad[:4]}")
+        if steps and not any(s["tokens_out"] for s in steps):
+            failures.append("no step record carries tokens_out despite "
+                            "completed generates")
+        if steps and not any("deliver" in s["phases_ms"] for s in steps):
+            failures.append("no served step carries the deliver phase "
+                            "(driver-loop amend broken)")
+        if not (0.0 <= summary.get("host_overhead_frac", -1.0) <= 1.0):
+            failures.append(f"/stepz summary host_overhead_frac out of "
+                            f"range: {summary.get('host_overhead_frac')}")
+        if not failures:
+            print(f"stepstats: /stepz {len(steps)} record(s), "
+                  f"host_overhead_frac "
+                  f"{summary.get('host_overhead_frac')}, phase sums "
+                  "reconcile")
+
+        # -- 2: the derived metric families are live -----------------
+        metrics = get("/metrics.json")
+        hist = metrics.get("serve_step_host_overhead_ms") or {}
+        if not hist.get("count"):
+            failures.append("serve_step_host_overhead_ms histogram is "
+                            "empty after the burst")
+        if "serve_device_idle_fraction" not in metrics:
+            failures.append("serve_device_idle_fraction gauge missing "
+                            "from /metrics.json")
+        phases = metrics.get("serve_step_phase_ms") or {}
+        if not any(v.get("count") for v in phases.values()
+                   if isinstance(v, dict)):
+            failures.append("serve_step_phase_ms has no populated "
+                            "phase series")
+
+        # -- 3: /loadz advertises the autoscale-facing fraction ------
+        loadz = get("/loadz")
+        frac = loadz.get("step_host_overhead_frac")
+        if not (isinstance(frac, (int, float))
+                and 0.0 <= frac <= 1.0):
+            failures.append(f"/loadz step_host_overhead_frac bad: "
+                            f"{frac!r}")
+        else:
+            print(f"stepstats: /loadz step_host_overhead_frac {frac}")
+
+        # -- 4: /admin/profile 403 on an unconfigured server ---------
+        status, body = post("/admin/profile", {"steps": 2})
+        if status != 403:
+            failures.append(f"/admin/profile without SERVE_ADMIN_TOKEN "
+                            f"expected 403, got {status} "
+                            f"{str(body)[:200]}")
+        else:
+            print("stepstats: /admin/profile 403 on the unconfigured "
+                  "server")
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=grace_s)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+                proc.wait(timeout=10)
+    if failures:
+        print("stepstats FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("stepstats OK: /stepz reconciles, the host-overhead "
+          "histogram and /loadz fraction are live, and the profile "
+          "endpoint honors the admin-token gate")
+    return 0
+
+
 def replay_check(grace_s: float = 30.0) -> int:
     """``--replay``: the trace-replay + capacity-planning contract,
     live. A tiny synthetic flash-crowd spec replayed open-loop against
@@ -1760,6 +1941,8 @@ def main(argv=None) -> int:
         return trace_check()
     if "--replay" in argv:
         return replay_check()
+    if "--stepstats" in argv:
+        return stepstats_check()
     if "--lint-only" not in argv:
         devices = jax.devices()
         print(f"devices: {devices}")

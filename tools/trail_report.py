@@ -148,6 +148,18 @@ def row(e: dict) -> str:
     # a loadavg well above ~1 on the 1-vCPU bench host means another
     # process shared the core during the measurement — render it so a
     # polluted entry is visibly polluted in the published table
+    # step-telemetry column (obs/stepstats.py): the windowed
+    # host-overhead fraction from the entry's ``step_phases`` block —
+    # the ROADMAP item-4 host/device split, rendered for every entry
+    # that carries it so a perf PR's before/after is one table read.
+    # Older entries (pre step-telemetry) render an em-dash, not 0: a
+    # missing measurement is not a perfect one.
+    sp = r.get("step_phases")
+    if isinstance(sp, dict) and isinstance(
+            sp.get("host_overhead_frac"), (int, float)):
+        host_cell = f"{100 * sp['host_overhead_frac']:.1f}%"
+    else:
+        host_cell = "—"
     load_1m = e.get("host_load_1m")
     load_pre = e.get("host_load_1m_pre")
     if isinstance(load_pre, (int, float)) and not isinstance(load_pre, bool):
@@ -164,7 +176,7 @@ def row(e: dict) -> str:
     elif isinstance(load_1m, (int, float)) and not isinstance(load_1m, bool):
         extras.append(f"host_load {load_1m:g}")
     return (f"| `{' '.join(e.get('argv') or [])}` | {r.get('metric')} | "
-            f"{value_cell} | "
+            f"{value_cell} | {host_cell} | "
             f"{'; '.join(extras)} | `{e.get('ts')}` |")
 
 
@@ -173,8 +185,8 @@ END_MARK = "<!-- trail:table:end -->"
 
 
 def render_table(picked: list) -> str:
-    lines = ["| Workload | Metric | Value | Detail | Trail ts |",
-             "|---|---|---|---|---|"]
+    lines = ["| Workload | Metric | Value | Host ovh | Detail | Trail ts |",
+             "|---|---|---|---|---|---|"]
     lines += [row(e) for e in picked]
     return "\n".join(lines)
 
