@@ -2083,7 +2083,7 @@ def bench_replay(smoke: bool = False) -> dict:
     }
 
 
-def bench_chaos(smoke: bool = False) -> dict:
+def bench_chaos(smoke: bool = False, stream_mix: bool = False) -> dict:
     """``python bench.py chaos``: goodput recovery after a replica kill
     during a flash-crowd replay — the chaos plane's headline scenario
     (docs/CHAOS.md). A seeded flash crowd replays open-loop through the
@@ -2093,14 +2093,25 @@ def bench_chaos(smoke: bool = False) -> dict:
     the durability closure (every request exactly one terminal
     outcome), and the post-scenario invariant verdicts on both
     replicas. Host-only like ``router``/``replay``: runs with the TPU
-    tunnel down."""
+    tunnel down.
+
+    ``--stream`` (``stream_mix``): the streaming-mix variant — a
+    steady decode-heavy mix of LONG streamed generations sized so open
+    streams straddle the kill, measuring **stream outage goodput**:
+    the ok-rate of streams IN FLIGHT or arriving during the outage
+    window. Before PR 15 these were guaranteed losses (error terminal
+    + [DONE]); with the router's journal + continuation splice the
+    target is 1.0 — plus the zero-lost-streams gate (no
+    eof-without-[DONE] anywhere, ``chaos.invariants
+    .check_stream_report``)."""
     from pyspark_tf_gke_tpu.chaos.invariants import (
         check_replica,
         check_report,
+        check_stream_report,
         goodput_windows,
     )
     from pyspark_tf_gke_tpu.chaos.runner import ScheduleRunner
-    from pyspark_tf_gke_tpu.chaos.spec import ChaosEvent, ChaosSchedule
+    from pyspark_tf_gke_tpu.chaos.spec import synth_chaos
     from pyspark_tf_gke_tpu.replay.driver import replay_spec
     from pyspark_tf_gke_tpu.replay.generators import synth_spec
     from pyspark_tf_gke_tpu.router.localfleet import LocalFleet
@@ -2109,17 +2120,44 @@ def bench_chaos(smoke: bool = False) -> dict:
     duration = 18.0 * scale
     kill_at = 6.0 * scale
     restart_after = 5.0 * scale
-    spec = synth_spec("flash_crowd", seed=23, duration_s=duration,
-                      rate_rps=2.0, prompt_tokens=16, output_tokens=8,
-                      max_seq_len=64, burst_mult=4.0, burst_frac=0.3)
-    schedule = ChaosSchedule("bench-kill-one", seed=23, events=[
-        ChaosEvent(offset_s=kill_at, action="kill", target="replica:1",
-                   restart_s=restart_after),
-    ]).validate()
+    if stream_mix:
+        # decode-heavy: 24-token streams (prompt 16 + 24 <= 64) at a
+        # steady rate a 2-slot replica pair absorbs — the measurement
+        # is stream CONTINUITY through the kill, not shed behavior.
+        # Decode is paced (30ms/step chaos inject, the smoke gate's
+        # trick) so streams take ~0.5s+ and reliably STRADDLE the
+        # kill — otherwise the splice path could go unexercised and
+        # 1.0 would be vacuous (router_stream_resumes in the entry
+        # proves it fired)
+        spec = synth_spec("steady", seed=31, duration_s=duration,
+                          rate_rps=2.5, prompt_tokens=16,
+                          output_tokens=40, max_seq_len=64)
+        schedule = synth_chaos(
+            "kill_mid_stream", seed=31, duration_s=duration,
+            replicas=2, kill_at_s=kill_at, restart_s=restart_after,
+            victim=1, name="bench-kill-mid-stream")
+        replica_args = ("--max-queue-depth", "12", "--chaos",
+                        "engine.device_step:slow%1:0.05")
+    else:
+        spec = synth_spec("flash_crowd", seed=23, duration_s=duration,
+                          rate_rps=2.0, prompt_tokens=16,
+                          output_tokens=8, max_seq_len=64,
+                          burst_mult=4.0, burst_frac=0.3)
+        from pyspark_tf_gke_tpu.chaos.spec import (
+            ChaosEvent,
+            ChaosSchedule,
+        )
+
+        schedule = ChaosSchedule("bench-kill-one", seed=23, events=[
+            ChaosEvent(offset_s=kill_at, action="kill",
+                       target="replica:1", restart_s=restart_after),
+        ]).validate()
+        replica_args = ("--continuous-slots", "1",
+                        "--max-queue-depth", "6")
     trace_args = ("--trace-sample", "1.0", "--trace-slow-ms", "0")
+    router_resumes = None
     with LocalFleet(2, router_args=trace_args,
-                    replica_args=(*trace_args, "--continuous-slots",
-                                  "1", "--max-queue-depth", "6")) as fleet:
+                    replica_args=(*trace_args, *replica_args)) as fleet:
         fleet.warm()
         runner = ScheduleRunner(schedule, fleet)
         with runner:
@@ -2128,13 +2166,26 @@ def bench_chaos(smoke: bool = False) -> dict:
         closure = check_report(report, len(spec.requests))
         fleet.wait_idle(timeout_s=60)
         invariants = [check_replica(u) for u in fleet.replica_urls]
+        if stream_mix:
+            # how many mid-stream deaths the router actually spliced
+            # over — the non-vacuousness proof next to goodput 1.0
+            import urllib.request as _ur
+
+            with _ur.urlopen(fleet.url + "/metrics", timeout=10) as r:
+                mtext = r.read().decode()
+            router_resumes = {
+                outcome: int(float(line.rsplit(" ", 1)[1]))
+                for line in mtext.splitlines()
+                for outcome in [line.partition('outcome="')[2]
+                                .partition('"')[0]]
+                if line.startswith("router_stream_resumes_total{")}
     wins = goodput_windows(
         report, [0.0, kill_at, kill_at + restart_after, duration + 1.0])
     pre, outage, post = wins
-    recovered = post["ok_rate"]
-    return {
-        "metric": "chaos_recovered_goodput",
-        "value": recovered,
+    out = {
+        "metric": ("chaos_stream_outage_goodput" if stream_mix
+                   else "chaos_recovered_goodput"),
+        "value": outage["ok_rate"] if stream_mix else post["ok_rate"],
         "unit": "ok_rate",
         "vs_baseline": None,
         "n_requests": len(spec.requests),
@@ -2156,6 +2207,18 @@ def bench_chaos(smoke: bool = False) -> dict:
                      "terminal closure, post-scenario invariant "
                      "checks (docs/CHAOS.md)"),
     }
+    if stream_mix:
+        streams = check_stream_report(report)
+        out["stream_closure"] = streams
+        out["stream_resumes_client"] = report.get("stream_resumes", 0)
+        out["router_stream_resumes"] = router_resumes
+        out["workload"] = (
+            "streaming-mix chaos: 24-token greedy streams straddling "
+            "a replica SIGKILL + restart vs 2-replica CPU localfleet "
+            "+ router — outage-window stream goodput (router journal "
+            "+ continuation splice; zero eof-without-[DONE] gate, "
+            "docs/SERVING.md 'Stream failover & resume')")
+    return out
 
 
 # ---- orchestrator ----------------------------------------------------------
@@ -2575,6 +2638,10 @@ ALL_WORKLOADS = (
     # replay — windowed goodput recovery, exactly-one-terminal closure,
     # post-scenario invariant checks (host-only)
     ["chaos"],
+    # streaming-mix chaos: long greedy streams straddling the kill —
+    # outage-window STREAM goodput through the router's journal +
+    # continuation splice (zero lost streams; host-only)
+    ["chaos", "--stream"],
     ["spec"],  # device-loop tok/s + the 0.75-skew fixture's acceptance
     ["generate", "--beams", "4"],  # broadcast-select reorder rebuild A/B
     # --- measured re-confirmations ---
@@ -2879,7 +2946,7 @@ def run_bench(argv) -> dict:
     if workload == "replay":
         return bench_replay(smoke=smoke)
     if workload == "chaos":
-        return bench_chaos(smoke=smoke)
+        return bench_chaos(smoke=smoke, stream_mix="--stream" in argv)
     if workload == "cb":
         if "--chunked-prefill" in argv:
             return bench_chunked_prefill(smoke=smoke)

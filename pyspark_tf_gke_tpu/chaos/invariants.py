@@ -231,6 +231,85 @@ def check_traces(traces) -> dict:
     return _result(v, request_spans=checked)
 
 
+# -- stream token-exactness ---------------------------------------------------
+
+
+def check_stream_tokens(expected, received) -> dict:
+    """THE stream-splice invariant: a client stream that crossed a
+    replica kill must be token-identical to an uninterrupted control
+    run — **zero missing and zero duplicated tokens**.
+
+    ``expected`` is the control run's token-id sequence, ``received``
+    the assembled sequence a client captured through the failover.
+    Violations CLASSIFY the failure (the diagnosis a splice bug needs):
+    a duplicated run at the splice point (overlap not stripped), a
+    missing run (off-by-one the other way), a truncated tail, extra
+    tokens past the control, or outright divergence. Deliberately
+    broken splices must FAIL here — the checker has true-positive
+    tests of its own."""
+    v: List[str] = []
+    try:
+        e = [int(t) for t in expected]
+        g = [int(t) for t in received]
+    except (TypeError, ValueError) as exc:
+        return _result([f"checker error: unparseable token ids: {exc}"])
+    if g == e:
+        return _result([], tokens=len(e))
+    i = next((k for k in range(min(len(e), len(g))) if e[k] != g[k]),
+             min(len(e), len(g)))
+    if len(g) < len(e) and g == e[:len(g)]:
+        v.append(f"{len(e) - len(g)} token(s) missing from the stream "
+                 f"tail (got {len(g)} of {len(e)})")
+    elif len(g) > len(e) and g[:len(e)] == e:
+        v.append(f"{len(g) - len(e)} extra token(s) past the control "
+                 f"run (got {len(g)}, expected {len(e)})")
+    else:
+        classified = False
+        for k in range(1, 5):
+            # duplicated run: the stream re-emitted the k tokens
+            # before the splice (g = e[:i] + e[i-k:i] + e[i:], so the
+            # received suffix equals the control suffix shifted BACK)
+            if i >= k and g[i:] == e[i - k:]:
+                v.append(f"{k} duplicated token(s) at offset {i} "
+                         "(splice overlap not stripped)")
+                classified = True
+                break
+            # missing run: k tokens skipped at the splice (suffix
+            # shifted FORWARD)
+            if g[i:] == e[i + k:]:
+                v.append(f"{k} missing token(s) at offset {i} "
+                         "(splice skipped past the emitted point)")
+                classified = True
+                break
+        if not classified:
+            v.append(f"stream diverges at offset {i}: expected "
+                     f"{e[i:i + 4]}, got {g[i:i + 4]}")
+    return _result(v, tokens=len(e))
+
+
+def check_stream_report(report: dict) -> dict:
+    """Client-side stream durability over a replay report: every
+    streamed request reached ``[DONE]`` — no EOF-without-terminator
+    (the signature of an unspliced mid-stream death) and no transport
+    errors. Windowed goodput is :func:`goodput_windows`'s job; this is
+    the absolute zero-lost-streams gate."""
+    v: List[str] = []
+    try:
+        for r in report.get("requests") or []:
+            if r.get("reason") == "eof_without_done":
+                v.append(f"request {r.get('i')}: stream ended without "
+                         "[DONE] (mid-stream death reached the client)")
+            elif r.get("outcome") == "error":
+                v.append(f"request {r.get('i')}: error terminal "
+                         f"({r.get('reason')})")
+        if not (report.get("requests") or []):
+            v.append("report carries no per-request records "
+                     "(include_requests=True required)")
+    except Exception as exc:  # noqa: BLE001
+        v.append(f"checker error: {type(exc).__name__}: {exc}")
+    return _result(v)
+
+
 # -- over a replay report -----------------------------------------------------
 
 

@@ -229,9 +229,26 @@ def synth_chaos(kind: str, *, seed: int = 0, duration_s: float = 10.0,
       flapping.
     * ``storm`` — ``n_events`` (default 3) seeded kill/stop events
       spread over the window, round-robin across replicas.
+    * ``kill_mid_stream`` — SIGKILL one replica at a PINNED offset
+      (``kill_at_s``, default 0.4 × duration — late enough that
+      long-generation streams opened at t≈0 are mid-decode), relaunch
+      ``restart_s`` later: THE stream-failover scenario. Run it under
+      a streaming workload and gate on
+      :func:`~pyspark_tf_gke_tpu.chaos.invariants.check_stream_tokens`
+      — every client stream must still reach ``[DONE]`` token-exact
+      (zero missing, zero duplicated tokens through the router's
+      continuation splice).
     """
     events: List[ChaosEvent] = []
-    if kind == "kill_one":
+    if kind == "kill_mid_stream":
+        victim = int(params.pop(
+            "victim", int(_mix(seed, "victim") * replicas) % replicas))
+        at = float(params.pop("kill_at_s", duration_s * 0.4))
+        restart_s = float(params.pop("restart_s", duration_s / 4))
+        events.append(ChaosEvent(offset_s=at, action="kill",
+                                 target=f"replica:{victim}",
+                                 restart_s=restart_s))
+    elif kind == "kill_one":
         victim = int(_mix(seed, "victim") * replicas) % replicas
         at = duration_s * (0.35 + 0.3 * _mix(seed, "at"))
         restart_s = float(params.pop("restart_s", duration_s / 4))
@@ -268,11 +285,13 @@ def synth_chaos(kind: str, *, seed: int = 0, duration_s: float = 10.0,
     else:
         raise ValueError(
             f"unknown chaos kind {kind!r} (known: kill_one, hang_one, "
-            "flaky_probes, storm)")
+            "flaky_probes, storm, kill_mid_stream)")
     if params:
         raise ValueError(f"unknown synth_chaos params: {sorted(params)}")
     events.sort(key=lambda ev: ev.offset_s)
     return ChaosSchedule(
         name=name or f"{kind}-s{seed}", seed=seed, events=events,
         meta={"kind": kind, "duration_s": duration_s,
-              "replicas": replicas}).validate()
+              "replicas": replicas,
+              **({"streaming": True}
+                 if kind == "kill_mid_stream" else {})}).validate()

@@ -351,8 +351,16 @@ class ManifestTailSource:
                  batch_size: int, *, shuffle: bool = True,
                  seed: int = DEFAULT_SEED, consumed_batches: int = 0,
                  wait_timeout_s: float = 60.0, poll_s: float = 0.1,
-                 nthreads: int = 2, int_dtype=np.int32,
+                 nthreads: int = 1, int_dtype=np.int32,
                  process_index: int = 0, process_count: int = 1):
+        # nthreads defaults to 1: exact-resume REQUIRES a deterministic
+        # row order, and the native ExamplePool interleaves shard
+        # blocks nondeterministically with >1 reader thread — the
+        # seeded BatchIterator shuffle then permutes DIFFERENT
+        # underlying rows run to run, silently breaking the
+        # replay-identical contract (and its test) ~1 run in 8.
+        # Epoch loads are once-per-epoch; determinism outranks read
+        # parallelism here. Callers that don't resume may raise it.
         from pyspark_tf_gke_tpu.pipeline.manifest import ShardSetManifest
 
         self.manifest = ShardSetManifest(manifest_path)

@@ -791,6 +791,34 @@ def router_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "queue share on the replica) — NOT a replica-health event: "
             "no backoff, no re-route, no DOWN marking",
             labelnames=("tenant",)),
+        # mid-stream failover (stream continuation splicing + client
+        # resume — docs/SERVING.md "Stream failover & resume"): the
+        # journal is the front-owned ring of per-stream resume state
+        "router_stream_resumes_total": r.counter(
+            "router_stream_resumes_total",
+            "Mid-stream replica deaths the router tried to splice over "
+            "via a continuation request, by outcome (ok = continuation "
+            "opened and primed | failed = no target / continuation "
+            "rejected or diverged | exhausted = --stream-resume-max "
+            "already spent | deadline = original deadline expired)",
+            labelnames=("outcome",)),
+        "router_stream_tokens_replayed_total": r.counter(
+            "router_stream_tokens_replayed_total",
+            "Tokens replayed from the stream journal to reconnecting "
+            "clients (Last-Event-ID + X-Request-Id replay)"),
+        "router_stream_journal_entries": r.gauge(
+            "router_stream_journal_entries",
+            "Streams currently resident in the resume journal ring "
+            "(bounded by --stream-journal)"),
+        "router_stream_journal_tokens": r.gauge(
+            "router_stream_journal_tokens",
+            "Token events buffered across all journal entries (the "
+            "ring's replay memory footprint, in tokens)"),
+        "router_idempotent_replays_total": r.counter(
+            "router_idempotent_replays_total",
+            "Non-streamed generates answered from the X-Idempotency-Key "
+            "window instead of re-executing (a client retry after an "
+            "ambiguous verdict cannot double-generate)"),
     }
 
 

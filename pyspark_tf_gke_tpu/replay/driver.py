@@ -42,7 +42,8 @@ def _classify_error_text(text: str) -> str:
 class _RequestResult:
     __slots__ = ("index", "tenant", "status", "outcome", "reason",
                  "ttft_ms", "latency_ms", "tokens_out", "deadline_ms",
-                 "sched_lag_ms", "tbt_ms", "offset_s")
+                 "sched_lag_ms", "tbt_ms", "offset_s", "token_ids",
+                 "request_id", "last_event_id", "resumes")
 
     def __init__(self, index: int, tenant: str, deadline_ms,
                  offset_s: float = 0.0):
@@ -61,6 +62,14 @@ class _RequestResult:
         self.deadline_ms = deadline_ms
         self.sched_lag_ms = 0.0
         self.tbt_ms: List[float] = []
+        # stream-resume capture: the assembled token-id sequence (the
+        # chaos plane's token-exactness input), the router-echoed
+        # X-Request-Id + last `id:` line (what a reconnect replays
+        # from), and how many reconnects this request needed
+        self.token_ids: List[int] = []
+        self.request_id: Optional[str] = None
+        self.last_event_id: Optional[int] = None
+        self.resumes = 0
 
     def to_dict(self) -> dict:
         return {"i": self.index, "tenant": self.tenant,
@@ -73,29 +82,48 @@ class _RequestResult:
                                if self.latency_ms is not None else None),
                 "tokens_out": self.tokens_out,
                 "deadline_ms": self.deadline_ms,
-                "sched_lag_ms": round(self.sched_lag_ms, 3)}
+                "sched_lag_ms": round(self.sched_lag_ms, 3),
+                "resumes": self.resumes,
+                "token_ids": list(self.token_ids)}
 
 
-def _fire_stream(url: str, prompt: str, res: _RequestResult,
-                 output_tokens: int, timeout_s: float) -> None:
-    """One streaming generate; fills ``res`` in place."""
-    body = {"prompts": [prompt], "max_new_tokens": int(output_tokens),
-            "stream": True}
-    if res.deadline_ms is not None:
-        body["deadline_ms"] = float(res.deadline_ms)
+def _stream_once(url: str, res: _RequestResult, body: dict,
+                 timeout_s: float, t0: float,
+                 resume_from: Optional[int] = None) -> None:
+    """One streaming connection attempt; fills ``res`` incrementally
+    (TTFT is anchored at the ORIGINAL fire time even across resumes —
+    the client-visible contract). ``resume_from``: reconnect mode —
+    the request carries ``Last-Event-ID`` + ``X-Request-Id`` and the
+    router replays the journaled tail instead of re-generating."""
+    headers = {"Content-Type": "application/json",
+               "X-Tenant": res.tenant}
+    if resume_from is not None:
+        headers["Last-Event-ID"] = str(resume_from)
+        headers["X-Request-Id"] = res.request_id
     req = urllib.request.Request(
         url + "/v1/generate", data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json",
-                 "X-Tenant": res.tenant})
-    t0 = time.monotonic()
+        headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
             res.status = resp.status
+            if res.request_id is None:
+                res.request_id = resp.headers.get("X-Request-Id")
             last_emit = None
             done_seen = False
             error_outcome = None
+            pending_id = None
             for raw in resp:
                 line = raw.decode("utf-8", errors="replace").strip()
+                if line.startswith("id: "):
+                    # SSE contract: lastEventId commits only when the
+                    # event it labels is DISPATCHED — committing here
+                    # would let a cut between the id: and data: lines
+                    # skip that event's tokens on resume
+                    try:
+                        pending_id = int(line[4:])
+                    except ValueError:
+                        pending_id = None
+                    continue
                 if not line or line.startswith(":"):
                     continue  # keep-alives + the trace_id comment
                 if not line.startswith("data: "):
@@ -105,6 +133,9 @@ def _fire_stream(url: str, prompt: str, res: _RequestResult,
                     done_seen = True
                     break
                 event = json.loads(payload)
+                if pending_id is not None:
+                    res.last_event_id = pending_id
+                    pending_id = None
                 now = time.monotonic()
                 if "error" in event:
                     # mid-stream terminal (deadline expiry, engine
@@ -115,20 +146,22 @@ def _fire_stream(url: str, prompt: str, res: _RequestResult,
                     continue
                 toks = event.get("token_ids")
                 if toks:
-                    if last_emit is None:
+                    if last_emit is None and res.ttft_ms is None:
                         res.ttft_ms = (now - t0) * 1000.0
-                    else:
+                    elif last_emit is not None:
                         res.tbt_ms.append((now - last_emit) * 1000.0)
                     last_emit = now
                     res.tokens_out += len(toks)
+                    res.token_ids.extend(int(t) for t in toks)
             res.latency_ms = (time.monotonic() - t0) * 1000.0
             if error_outcome is not None:
                 res.outcome = error_outcome
                 res.reason = error_outcome
             elif done_seen:
                 res.outcome = "ok"
+                res.reason = None
             else:
-                # EOF without [DONE]: the replica died mid-stream
+                # EOF without [DONE]: the stream died mid-flight
                 res.outcome = "error"
                 res.reason = "eof_without_done"
     except urllib.error.HTTPError as exc:
@@ -148,6 +181,33 @@ def _fire_stream(url: str, prompt: str, res: _RequestResult,
         res.latency_ms = (time.monotonic() - t0) * 1000.0
         res.reason = f"transport:{type(exc).__name__}"
         res.outcome = "error"
+
+
+def _fire_stream(url: str, prompt: str, res: _RequestResult,
+                 output_tokens: int, timeout_s: float,
+                 resume_max: int = 0) -> None:
+    """One streaming generate; fills ``res`` in place. With
+    ``resume_max`` > 0 the driver exercises the router's client-resume
+    contract: a connection cut mid-stream (EOF without ``[DONE]``, or
+    a transport error after the first token) reconnects with
+    ``Last-Event-ID`` + ``X-Request-Id`` and the journal replays the
+    tail — the harness-side measurement of the router↔client-blip
+    durability feature."""
+    body = {"prompts": [prompt], "max_new_tokens": int(output_tokens),
+            "stream": True}
+    if res.deadline_ms is not None:
+        body["deadline_ms"] = float(res.deadline_ms)
+    t0 = time.monotonic()
+    _stream_once(url, res, body, timeout_s, t0)
+    while (res.resumes < resume_max
+           and res.outcome == "error"
+           and (res.reason == "eof_without_done"
+                or str(res.reason or "").startswith("transport:"))
+           and res.request_id is not None
+           and res.last_event_id is not None):
+        res.resumes += 1
+        _stream_once(url, res, body, timeout_s, t0,
+                     resume_from=res.last_event_id)
 
 
 def _fire_blocking(url: str, prompt: str, res: _RequestResult,
@@ -193,6 +253,7 @@ def replay_spec(spec: WorkloadSpec, base_url: str, *,
                 speedup: float = 1.0, stream: bool = True,
                 timeout_s: float = 120.0,
                 include_requests: bool = False,
+                resume_max: int = 0,
                 registry=None) -> dict:
     """Replay ``spec`` against ``base_url`` and return the measured
     report (the input :func:`pyspark_tf_gke_tpu.replay.slo.evaluate_slo`
@@ -202,9 +263,13 @@ def replay_spec(spec: WorkloadSpec, base_url: str, *,
     ``speedup`` compresses the spec's clock (2.0 = twice as fast);
     deadlines are NOT scaled — they are part of the request contract,
     not the arrival process. Every request reaches a terminal outcome
-    before this returns. ``registry`` (an obs ``MetricsRegistry``,
-    default the process registry) receives the ``replay_*`` family
-    observations so a long replay is scrapable while it runs."""
+    before this returns. ``resume_max``: streamed requests cut
+    mid-flight reconnect up to this many times via ``Last-Event-ID``
+    + ``X-Request-Id`` (the router's journal replay) — 0 preserves
+    the legacy one-shot behavior. ``registry`` (an obs
+    ``MetricsRegistry``, default the process registry) receives the
+    ``replay_*`` family observations so a long replay is scrapable
+    while it runs."""
     if speedup <= 0:
         raise ValueError("speedup must be > 0")
     from pyspark_tf_gke_tpu.obs.metrics import replay_families
@@ -215,7 +280,12 @@ def replay_spec(spec: WorkloadSpec, base_url: str, *,
                               offset_s=r.offset_s)
                for i, r in enumerate(spec.requests)]
     prompts = [build_prompt(spec, i) for i in range(len(spec.requests))]
-    fire = _fire_stream if stream else _fire_blocking
+    if stream:
+        def fire(url, prompt, res, output_tokens, t_s):
+            _fire_stream(url, prompt, res, output_tokens, t_s,
+                         resume_max=int(resume_max))
+    else:
+        fire = _fire_blocking
     threads: List[threading.Thread] = []
     t_start = time.monotonic()
     for i, r in enumerate(spec.requests):
@@ -321,6 +391,10 @@ def replay_spec(spec: WorkloadSpec, base_url: str, *,
         "achieved_rps": round(n / wall_s, 3) if wall_s else None,
         "outcomes": outcomes,
         "sheds": dict(sorted(sheds.items())),
+        # client-side reconnects the driver needed (Last-Event-ID
+        # journal replays) — 0 in a healthy run even under replica
+        # kills, since the ROUTER splices those invisibly
+        "stream_resumes": sum(r.resumes for r in results),
         "goodput": goodput,
         "ttft_ms": _summary(ttft),
         "tbt_ms": _summary(tbt),

@@ -17,7 +17,12 @@ across N of them:
   ``Retry-After`` parser both the forwarding path and the prober use;
 * :mod:`gateway`     — the HTTP server: backpressure propagation
   (honor ``Retry-After``, re-route once, never amplify retries into an
-  overloaded pod) and hedged failover for non-streamed generates.
+  overloaded pod), hedged failover for non-streamed generates, and
+  mid-stream failover (token-exact continuation splicing over a
+  replica death, ``Last-Event-ID`` client replay, ``X-Idempotency-Key``
+  dedupe);
+* :mod:`journal`     — the bounded per-stream resume journal + the
+  idempotency window backing the gateway's durability features.
 
 The router deliberately imports no jax: it is a pure control/data-plane
 process (the ``tpu-router.yaml`` Deployment runs it on a CPU node pool).

@@ -61,6 +61,18 @@ def parse_retry_after(value: Optional[str],
         return float(default_s)
 
 
+def sse_payload(line: bytes) -> Optional[str]:
+    """The ``data:`` payload of one SSE line, or ``None`` for anything
+    that isn't one (comments, ``id:`` lines, blank separators). ONE
+    parser for the gateway's relay/splice loop and its journal replay —
+    the framing the replica emits and the framing the resume path
+    replays must never drift apart by copy."""
+    line = line.strip()
+    if not line.startswith(b"data:"):
+        return None
+    return line[5:].strip().decode("utf-8", errors="replace")
+
+
 def split_base_url(base_url: str) -> Tuple[str, int]:
     """``http://host:port`` -> (host, port). The router speaks plain
     HTTP to replicas inside the cluster; a scheme other than http is a

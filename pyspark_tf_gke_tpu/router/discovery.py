@@ -236,6 +236,20 @@ class ReplicaSet:
                                      prev=prev, state=state,
                                      reason=reason[:200])
 
+    def note_passive_down(self, rid: str, reason: str = "",
+                          shield_s: float = 1.0) -> None:
+        """Passive health with a probe-race shield: mark the replica
+        DOWN *and* hold a short backoff so a probe sweep that was
+        already in flight (and answered before the death) cannot
+        re-admit the corpse for ``shield_s``. The stream-continuation
+        path routes its splice IMMEDIATELY after observing the death —
+        without the shield, pick() could hand the continuation straight
+        back to the replica that just killed the stream. A genuinely
+        recovered replica re-admits after the shield via the normal
+        first-good-probe rule."""
+        self.set_state(rid, DOWN, reason=reason)
+        self.note_backoff(rid, shield_s)
+
     def note_probe_failure(self, rid: str):
         """Count one transport failure; returns (state_before, count)
         so the prober can apply its threshold."""

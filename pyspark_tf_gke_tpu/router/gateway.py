@@ -24,8 +24,25 @@ Request lifecycle for ``POST /v1/generate`` (non-streamed):
 
 Streams (``"stream": true``): a replica death BEFORE the first event
 re-routes the whole request (nothing reached the client yet); after the
-first event the router surfaces the terminal error — re-running the
-request would silently replay tokens the client already consumed.
+first event the router SPLICES: it has journaled every token event it
+relayed (``router/journal.py``), so it builds a continuation request —
+the original prompt plus the emitted TOKEN IDS (``continuation:
+{emitted_ids}``; ids, not re-tokenized text, so the splice is exact
+even for byte runs that don't round-trip through UTF-8),
+``max_new_tokens`` reduced by the emitted count, the original deadline
+still enforced from first submit — routes it to the next-best replica
+(prefix affinity means the warm radix cache absorbs most of the
+re-prefill) and relays the continuation into the SAME open SSE
+connection; greedy decode makes the spliced stream token-exact. Resumes are capped by ``--stream-resume-max``
+(default 1, consistent with the single re-route); past the cap the
+explicit error terminal + ``[DONE]`` surfaces as before. Every SSE
+event carries an ``id: <seq>`` line, and a client that lost its
+connection to the ROUTER can replay from ``Last-Event-ID`` +
+``X-Request-Id`` against the journal — the router keeps draining the
+still-live upstream leg after a client hang-up, so a router↔client
+blip doesn't kill the request either. Non-streamed ``/v1/generate``
+accepts ``X-Idempotency-Key``: a retry after an ambiguous 502 replays
+the cached verdict instead of generating twice.
 """
 
 from __future__ import annotations
@@ -49,6 +66,14 @@ from pyspark_tf_gke_tpu.router.client import (
     ReplicaCall,
     ReplicaUnreachable,
     parse_retry_after,
+    sse_payload,
+)
+from pyspark_tf_gke_tpu.router.journal import (
+    DONE as JOURNAL_DONE,
+    FAILED as JOURNAL_FAILED,
+    LIVE as JOURNAL_LIVE,
+    IdempotencyCache,
+    StreamJournal,
 )
 from pyspark_tf_gke_tpu.router.discovery import (
     DOWN,
@@ -99,6 +124,10 @@ class RouterServer:
                  hedge_max_ms: float = 2000.0,
                  hedge: bool = True,
                  request_timeout_s: float = 600.0,
+                 stream_resume_max: int = 1,
+                 stream_journal_size: int = 256,
+                 idempotency_window_s: float = 300.0,
+                 idempotency_max: int = 1024,
                  registry=None, event_log=None,
                  trace_sample: float = 0.01,
                  trace_slow_ms: float = 1000.0):
@@ -121,6 +150,15 @@ class RouterServer:
         self.hedge_min_ms = float(hedge_min_ms)
         self.hedge_max_ms = float(hedge_max_ms)
         self.request_timeout_s = float(request_timeout_s)
+        # mid-stream failover state: the per-stream resume journal
+        # (bounded ring — every relayed SSE event lands here first, so
+        # a replica death can be spliced over and a reconnecting
+        # client can replay) and the blocking-generate idempotency
+        # window
+        self.stream_resume_max = max(0, int(stream_resume_max))
+        self.journal = StreamJournal(stream_journal_size, obs=self._obs)
+        self.idempotency = IdempotencyCache(
+            window_s=idempotency_window_s, max_entries=idempotency_max)
         self.latency = _LatencyWindow()
         self.draining = threading.Event()
         self._http_lock = threading.Lock()
@@ -353,6 +391,36 @@ class RouterServer:
         else:
             self._count(terminal_rid, "upstream_error")
         return status, out, hdrs
+
+    def route_idempotent(self, idem_key: str, req: dict,
+                         tenant: Optional[str] = None, span=None
+                         ) -> Tuple[int, dict,
+                                    Tuple[Tuple[str, str], ...]]:
+        """Non-streamed generate under an ``X-Idempotency-Key``: the
+        first request per (tenant, key) executes through
+        :meth:`route_json`, concurrent duplicates wait for its verdict,
+        and a retry inside the window replays the cached 2xx response
+        (marked ``X-Idempotent-Replay: 1``) instead of generating
+        twice. Keys are tenant-scoped — one tenant cannot poison or
+        read another tenant's cached responses by guessing keys."""
+        tenant = self.tenant_of(req, tenant)
+        cache_key = f"{tenant}\x00{idem_key}"
+
+        def _run():
+            return self.route_json("/v1/generate", req, tenant=tenant,
+                                   span=span)
+
+        result, replayed = self.idempotency.execute(
+            cache_key, _run, wait_timeout_s=self.request_timeout_s)
+        if not replayed:
+            return result
+        self._obs["router_idempotent_replays_total"].inc()
+        if span is not None:
+            span.event("idempotent_replay", key=str(idem_key)[:64])
+        self.event_log.emit("router_idempotent_replay", tenant=tenant,
+                            key=str(idem_key)[:64])
+        status, out, hdrs = result
+        return status, out, tuple(hdrs) + (("X-Idempotent-Replay", "1"),)
 
     def _finish_call(self, call: ReplicaCall, replica: Replica,
                      tokens: int) -> Tuple[int, dict,
@@ -631,7 +699,7 @@ class RouterServer:
     # -- streaming -------------------------------------------------------
 
     def open_stream(self, req: dict, tenant: Optional[str] = None,
-                    span=None):
+                    span=None, exclude: Tuple[str, ...] = ()):
         """Route a streamed generate. Returns ``(replica, call,
         first_lines, tokens)``: for a 200 the stream is PRIMED — the
         response lines up to and including the first ``data:`` event
@@ -656,6 +724,8 @@ class RouterServer:
         # a held shed verdict: still tracked, relayed only if no later
         # attempt produces anything better (_stream untracks + closes)
         shed = None
+        tried.extend(exclude)  # a continuation must not re-route back
+        #   into the replica whose death it is splicing over
         for attempt in range(2):
             replica = self.pick(affinity if attempt == 0 else None,
                                 exclude=tuple(tried))
@@ -709,6 +779,14 @@ class RouterServer:
             first_lines: List[bytes] = []
             try:
                 for line in call.iter_lines():
+                    if not line.endswith(b"\n"):
+                        # newline-less = readline hit EOF mid-write:
+                        # the replica died writing its first event —
+                        # nothing deliverable reached us, so this is
+                        # still a death-before-first-event re-route
+                        raise ReplicaUnreachable(
+                            "stream cut mid-write before the first "
+                            "complete event")
                     first_lines.append(line)
                     if line.startswith(b"data:"):
                         break
@@ -770,6 +848,388 @@ class RouterServer:
         }
 
 
+class _SpliceDiverged(RuntimeError):
+    """A continuation leg's text did not extend the emitted stream —
+    the splice cannot be token-exact, so the stream must end with an
+    explicit error terminal instead of silently diverging."""
+
+
+class _StreamRelay:
+    """One client SSE stream relayed across 1 + up-to-``resume_max``
+    upstream legs, with every relayed event journaled.
+
+    The relay owns the mid-stream failover contract end to end:
+
+    * every ``data:`` event it writes carries an ``id: <seq>`` line and
+      lands in the journal first (payload + parsed token ids + the
+      running ``text``);
+    * an upstream death after the first event builds a continuation
+      request (original prompt + the emitted token IDS, budget reduced
+      by the emitted count, the ORIGINAL deadline still enforced from
+      first submit) and splices the next replica's stream in — a
+      greedy client sees one uninterrupted, token-exact byte run;
+    * a CLIENT hang-up detaches the writer but keeps draining the
+      still-live upstream into the journal until its terminal, so a
+      reconnect (``Last-Event-ID`` + ``X-Request-Id``) replays the
+      rest; the outcome counts ``client_disconnect`` regardless of
+      which leg was live when the client left, and every leg is
+      untracked + closed on every path (leak-free lifecycle).
+    """
+
+    def __init__(self, router: RouterServer, handler, req: dict,
+                 tenant: Optional[str], span):
+        self.router = router
+        self.handler = handler
+        self.req = req
+        self.tenant = tenant
+        self.span = span
+        self.resume_max = router.stream_resume_max
+        self.writer_alive = True
+        self.entry = None
+        self.resumes = 0
+        self.emitted_tokens = 0
+        self.leg_validated = True  # first leg needs no splice check
+        prompts = req.get("prompts")
+        prompt = (prompts[0] if isinstance(prompts, list) and prompts
+                  else req.get("prompt"))
+        self.orig_prompt = prompt if isinstance(prompt, str) else ""
+        try:
+            self.orig_budget = int(req.get("max_new_tokens", 64) or 0)
+        except (TypeError, ValueError):
+            self.orig_budget = 0
+
+    # -- client-side writes ---------------------------------------------
+
+    def _write_raw(self, data: bytes) -> None:
+        """Best-effort client write: a dead client socket flips the
+        relay into detached mode (journal-only) instead of aborting —
+        the upstream leg keeps delivering so a reconnect can replay."""
+        if not self.writer_alive:
+            return
+        try:
+            self.handler.wfile.write(data)
+            self.handler.wfile.flush()
+        except OSError:
+            self.writer_alive = False
+
+    def _write_event(self, payload: str, token_ids=(),
+                     text: Optional[str] = None) -> None:
+        seq = self.router.journal.append(self.entry, payload,
+                                         token_ids=token_ids, text=text)
+        self._write_raw(f"id: {seq}\ndata: {payload}\n\n".encode())
+
+    # -- relay ----------------------------------------------------------
+
+    def run(self) -> None:
+        router, handler = self.router, self.handler
+        replica, call, first_lines, tokens = router.open_stream(
+            self.req, tenant=self.tenant, span=self.span)
+        if call is None:
+            return handler._reply(
+                503, {"error": "no routable replica for the stream",
+                      "reason": "no_replicas"},
+                headers=(("Retry-After", "1"),))
+        if call.status != 200:
+            # replica rejected before streaming (400/429/503): relay
+            # its JSON verdict + headers verbatim (shed backoff /
+            # tenant accounting already folded in by open_stream)
+            try:
+                out = call.read_json()
+                hdrs: Tuple[Tuple[str, str], ...] = ()
+                ra = call.header("Retry-After")
+                if ra is not None:
+                    hdrs += (("Retry-After", ra),)
+                ts = call.header("X-Tenant-Shed")
+                if ts is not None:
+                    hdrs += (("X-Tenant-Shed", ts),)
+                router._count(replica.rid,
+                              "shed" if call.status in (429, 503)
+                              else "client_error" if call.status < 500
+                              else "upstream_error")
+                return handler._reply(call.status, out, headers=hdrs)
+            finally:
+                router.replicas.untrack(replica.rid, tokens)
+                call.close()
+
+        # 200: commit the SSE response and journal the stream. The rid
+        # is the journal key AND the client's replay credential — the
+        # span's 128-bit trace id, or (span-less direct callers) a
+        # fresh uuid; never id()-derived (address reuse would collide
+        # journal keys and replay the wrong stream to a reconnect)
+        if self.span is not None:
+            rid = self.span.trace_id
+        else:
+            import uuid
+
+            rid = uuid.uuid4().hex
+        try:
+            handler.close_connection = True
+            handler.send_response(200)
+            handler.send_header("Content-Type", "text/event-stream")
+            handler.send_header("Cache-Control", "no-cache")
+            handler.send_header("Connection", "close")
+            handler.send_header("X-Request-Id", rid)
+            if self.span is not None:
+                self.span.set("http.status", 200)
+            handler.end_headers()
+        except OSError:
+            # the CLIENT died between open_stream and the header
+            # commit: the tracked upstream leg must still come back
+            # (the old _stream's finally discipline)
+            router.replicas.untrack(replica.rid, tokens)
+            call.cancel()
+            router._count(replica.rid, "client_disconnect")
+            return
+        self._write_raw(f": trace_id={rid}\n\n".encode())
+        deadline_ms = self.req.get("deadline_ms")
+        try:
+            deadline_s = (float(deadline_ms) / 1000.0
+                          if deadline_ms is not None else None)
+        except (TypeError, ValueError):
+            deadline_s = None
+        self.entry = router.journal.open(rid, self.req,
+                                         router.tenant_of(self.req,
+                                                          self.tenant),
+                                         deadline_s=deadline_s)
+
+        upstream_done = False
+        last_error = ""
+        terminal_rid = replica.rid
+        dead_rid = None  # the leg whose death forced the last resume
+        while True:
+            terminal_rid = replica.rid
+            try:
+                self._relay_leg(call, first_lines)
+                router.replicas.untrack(replica.rid, tokens)
+                call.close()
+                upstream_done = True
+                break
+            except _SpliceDiverged as exc:
+                # the continuation replica is HEALTHY — its stream just
+                # can't be spliced token-exactly; close the leg, no
+                # passive-health verdict, and the terminal outcome
+                # stays attributed to the DEAD leg that forced the
+                # resume (an error-rate dashboard must not blame the
+                # healthy replica for a router-side splice mismatch)
+                router.replicas.untrack(replica.rid, tokens)
+                call.close()
+                router._obs["router_stream_resumes_total"].labels(
+                    outcome="failed").inc()
+                last_error = str(exc)
+                if dead_rid is not None:
+                    terminal_rid = dead_rid
+                break
+            except ReplicaUnreachable as exc:
+                router.replicas.untrack(replica.rid, tokens)
+                call.close()
+                # passive health with the probe-race shield: the
+                # continuation pick below must not see the corpse UP
+                router.replicas.note_passive_down(
+                    replica.rid, reason="died mid-stream")
+                dead_rid = replica.rid
+                nxt = self._try_resume(replica.rid, exc)
+                if nxt == "completed":
+                    upstream_done = True
+                    break
+                if nxt is None:
+                    last_error = str(exc)
+                    break
+                replica, call, first_lines, tokens = nxt
+            except BaseException:
+                # safety net: an unexpected relay error must not leak
+                # the current leg's in-flight accounting either (the
+                # class docstring's every-path promise)
+                router.replicas.untrack(replica.rid, tokens)
+                call.close()
+                raise
+        if not upstream_done:
+            # the terminal error the client is OWED: tokens already
+            # delivered stay delivered, the stream ends with an
+            # explicit error event (journaled too — a reconnect must
+            # see the same verdict, not a hang)
+            self._write_event(json.dumps({"error": last_error or
+                                          "stream failed"}))
+            self._write_raw(b"data: [DONE]\n\n")
+        router.journal.finish(
+            self.entry, JOURNAL_DONE if upstream_done else JOURNAL_FAILED)
+        if not self.writer_alive:
+            outcome = "client_disconnect"
+        elif upstream_done:
+            outcome = "ok"
+        else:
+            outcome = "upstream_error"
+        if self.span is not None and not self.writer_alive:
+            self.span.event("client_disconnect",
+                            emitted_tokens=self.emitted_tokens)
+        router._count(terminal_rid, outcome)
+
+    def _relay_leg(self, call: ReplicaCall, first_lines) -> None:
+        """Relay one upstream leg to its ``[DONE]``. Raises
+        :class:`ReplicaUnreachable` on death (incl. clean EOF without
+        the terminator) and :class:`_SpliceDiverged` when a
+        continuation fails the token-exactness check."""
+        for line in itertools.chain(first_lines, call.iter_lines()):
+            if not line.endswith(b"\n"):
+                # readline() only returns a newline-less line at
+                # EOF/error: the replica died MID-WRITE of this event.
+                # The fragment is part of the death, not a deliverable
+                # event — relaying it would frame a truncated payload
+                # as a complete `data:` line (and journal it for every
+                # future replay)
+                raise ReplicaUnreachable(
+                    "stream cut mid-event (replica died mid-write)")
+            payload = sse_payload(line)
+            if payload is None:
+                continue  # comments / blank separators: the relay
+                #   writes its own trace comment + id framing
+            if payload == "[DONE]":
+                self._write_raw(b"data: [DONE]\n\n")
+                return
+            self._handle_data(payload)
+        raise ReplicaUnreachable(
+            "stream ended without [DONE] (replica died mid-stream)")
+
+    def _handle_data(self, payload: str) -> None:
+        try:
+            ev = json.loads(payload)
+        except ValueError:
+            ev = None
+        if not isinstance(ev, dict):
+            self._write_event(payload)
+            return
+        toks = ev.get("token_ids") or []
+        text = ev.get("text")
+        if toks and not self.leg_validated:
+            # splice sanity, once per continuation leg: the replica
+            # frames running text as ORIGINAL prompt + decode(emitted
+            # + new), so a leg whose text doesn't even extend the
+            # original prompt is not a continuation of this stream
+            # (wrong replica build / framing bug) — surface an
+            # explicit error instead of splicing garbage
+            if (isinstance(text, str) and self.orig_prompt
+                    and not text.startswith(self.orig_prompt)):
+                raise _SpliceDiverged(
+                    "continuation framing does not extend the "
+                    "original prompt (not token-exact); surfacing an "
+                    "explicit error instead of splicing")
+            self.leg_validated = True
+        if ev.get("done"):
+            # terminal entry: on a spliced stream, normalize the
+            # framing to the ORIGINAL request (the continuation-aware
+            # replica already frames it; normalizing is idempotent)
+            if self.resumes:
+                ev["prompt"] = self.orig_prompt
+                ev["new_tokens"] = self.emitted_tokens
+                ev["resumed"] = True
+                ev["resumes"] = self.resumes
+                payload = json.dumps(ev)
+            self._write_event(payload)
+            return
+        if toks:
+            self.emitted_tokens += len(toks)
+            self._write_event(payload, token_ids=toks,
+                              text=text if isinstance(text, str)
+                              else None)
+            return
+        # error terminals (deadline expiry, engine failure) and any
+        # future event kinds relay as-is — and are journaled, so a
+        # reconnect replays the same verdict
+        self._write_event(payload)
+
+    def _try_resume(self, dead_rid: str, exc: Exception):
+        """Build + open the continuation leg. Returns the new
+        ``(replica, call, first_lines, tokens)``, the string
+        ``"completed"`` when the relay synthesized a terminal itself
+        (budget already exhausted / deadline expired), or ``None``
+        when the stream must end with the error terminal."""
+        router = self.router
+        res = router._obs["router_stream_resumes_total"]
+
+        def _note(outcome, **extra):
+            res.labels(outcome=outcome).inc()
+            router.event_log.emit(
+                "router_stream_resume", outcome=outcome,
+                failed=dead_rid, rid=self.entry.rid,
+                emitted_tokens=self.emitted_tokens, **extra)
+            if self.span is not None:
+                self.span.event("resume", outcome=outcome,
+                                failed=dead_rid,
+                                emitted_tokens=self.emitted_tokens,
+                                **extra)
+
+        if self.resumes >= self.resume_max:
+            _note("exhausted")
+            return None
+        if not self.entry.token_ids or not self.orig_prompt:
+            # nothing client-visible was emitted on a leg that still
+            # died after open_stream primed it (e.g. the first event
+            # was unparseable): no splice point exists
+            _note("failed", reason="no_splice_point")
+            return None
+        remaining_s = self.entry.remaining_deadline_s()
+        if remaining_s is not None and remaining_s <= 0:
+            # the ORIGINAL deadline (anchored at first submit) is
+            # already gone: the verdict is the same one the replica
+            # would have delivered
+            self.resumes += 1
+            self.entry.resumes = self.resumes
+            _note("deadline")
+            self._write_event(json.dumps({
+                "error": "request deadline exceeded before the stream "
+                         "could resume"}))
+            self._write_raw(b"data: [DONE]\n\n")
+            return "completed"
+        remaining_budget = self.orig_budget - self.emitted_tokens
+        if remaining_budget <= 0:
+            # everything but the terminal frame was already delivered:
+            # synthesize it from the journal instead of re-generating
+            self.resumes += 1
+            self.entry.resumes = self.resumes
+            _note("ok", synthesized=True)
+            self._write_event(json.dumps({
+                "prompt": self.orig_prompt,
+                "completion": self.entry.last_text,
+                "new_tokens": self.emitted_tokens,
+                "latency_ms": round(
+                    (time.monotonic() - self.entry.created) * 1000.0, 2),
+                "done": True, "resumed": True,
+                "resumes": self.resumes}))
+            self._write_raw(b"data: [DONE]\n\n")
+            return "completed"
+        cont = dict(self.req)
+        cont.pop("prompt", None)
+        cont["prompts"] = [self.orig_prompt]
+        cont["max_new_tokens"] = remaining_budget
+        cont["stream"] = True
+        if remaining_s is not None:
+            cont["deadline_ms"] = max(1.0, remaining_s * 1000.0)
+        # token-id splice point: the replica prefills encode(prompt) +
+        # emitted_ids and frames text/counts cumulatively
+        # (train/serve.py continuation-aware SSE framing) — ids, not
+        # re-tokenized text, so the splice is exact even for byte
+        # runs that don't round-trip through UTF-8
+        cont["continuation"] = {
+            "emitted_ids": list(self.entry.token_ids)}
+        self.resumes += 1
+        self.entry.resumes = self.resumes
+        replica, call, first_lines, tokens = router.open_stream(
+            cont, tenant=self.tenant, span=self.span,
+            exclude=(dead_rid,))
+        if call is None:
+            _note("failed", reason="no_target")
+            return None
+        if call.status != 200:
+            router.replicas.untrack(replica.rid, tokens)
+            call.close()
+            _note("failed", reason=f"http_{call.status}",
+                  replica=replica.rid)
+            return None
+        _note("ok", replica=replica.rid,
+              remaining_budget=remaining_budget)
+        self.leg_validated = False
+        return replica, call, first_lines, tokens
+
+
 # -- HTTP plumbing -----------------------------------------------------------
 
 
@@ -826,89 +1286,97 @@ def _make_handler(router: RouterServer):
             self.wfile.write(body)
 
         def _stream(self, req: dict, tenant=None):
-            """Relay a replica's SSE stream byte-for-byte. Failures
-            before the first event already failed over inside
-            open_stream; once bytes flow, a death surfaces as an error
-            event + [DONE] — never a silent replay from another
-            replica."""
-            replica, call, first_lines, tokens = router.open_stream(
-                req, tenant=tenant, span=self._span)
-            if call is None:
+            """Relay a replica's SSE stream with journaled, id-framed
+            events. A death before the first event fails over inside
+            open_stream; a death after it SPLICES a continuation from
+            the next-best replica into the same connection
+            (``_StreamRelay``); only past --stream-resume-max does the
+            explicit error terminal + [DONE] surface. A request
+            carrying ``Last-Event-ID`` + ``X-Request-Id`` replays from
+            the journal instead of opening a new upstream."""
+            last_id = self.headers.get("Last-Event-ID")
+            rid = self.headers.get("X-Request-Id")
+            if last_id is not None and rid:
+                return self._stream_resume(rid, last_id,
+                                           router.tenant_of(req, tenant))
+            _StreamRelay(router, self, req, tenant, self._span).run()
+
+        def _stream_resume(self, rid: str, last_id: str, tenant: str):
+            """Client stream resume: replay journaled events with
+            seq > Last-Event-ID, then follow the entry live (the
+            original relay keeps draining its upstream after a client
+            hang-up) until its terminal state."""
+            entry = router.journal.get(rid)
+            if entry is not None and entry.tenant != tenant:
+                # replay is tenant-scoped like the idempotency window:
+                # a stolen/guessed rid from another tenant gets the
+                # SAME 404 as an unknown one (existence is information
+                # too), never the journaled tokens
+                entry = None
+            if entry is None:
                 return self._reply(
-                    503, {"error": "no routable replica for the stream",
-                          "reason": "no_replicas"},
-                    headers=(("Retry-After", "1"),))
+                    404, {"error": f"no journaled stream {rid!r} "
+                                   "(finished long ago, evicted, "
+                                   "another tenant's, or never seen)",
+                          "reason": "resume_unknown"})
             try:
-                if call.status != 200:
-                    # replica rejected before streaming (400/429/503):
-                    # relay its JSON verdict + headers verbatim (shed
-                    # backoff / tenant accounting already folded in by
-                    # open_stream — this layer only relays)
-                    out = call.read_json()
-                    hdrs = ()
-                    ra = call.header("Retry-After")
-                    if ra is not None:
-                        hdrs += (("Retry-After", ra),)
-                    ts = call.header("X-Tenant-Shed")
-                    if ts is not None:
-                        hdrs += (("X-Tenant-Shed", ts),)
-                    router._count(replica.rid,
-                                  "shed" if call.status in (429, 503)
-                                  else "client_error"
-                                  if call.status < 500
-                                  else "upstream_error")
-                    return self._reply(call.status, out, headers=hdrs)
-                self.close_connection = True
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.send_header("Cache-Control", "no-cache")
-                self.send_header("Connection", "close")
-                if self._span is not None:
-                    self.send_header("X-Request-Id",
-                                     self._span.trace_id)
-                    self._span.set("http.status", 200)
-                self.end_headers()
-                saw_done = False
-                try:
-                    for line in itertools.chain(first_lines,
-                                                call.iter_lines()):
-                        if line.strip() == b"data: [DONE]":
-                            saw_done = True
-                        self.wfile.write(line)
-                        self.wfile.flush()
-                    if not saw_done:
-                        # clean EOF without the SSE terminator: the
-                        # replica died mid-stream (a socket close reads
-                        # as EOF, not an error) — same taxonomy as a
-                        # reset
-                        raise ReplicaUnreachable(
-                            "stream ended without [DONE] (replica died "
-                            "mid-stream)")
-                    router._count(replica.rid, "ok")
-                except OSError:
-                    # the CLIENT hung up mid-relay (routine): the
-                    # replica is fine — stop relaying, count the
-                    # outcome, never write another byte at the dead
-                    # socket
-                    router._count(replica.rid, "client_disconnect")
-                except ReplicaUnreachable as exc:
-                    router.replicas.set_state(
-                        replica.rid, DOWN, reason="died mid-stream")
-                    router._count(replica.rid, "upstream_error")
-                    # the terminal error the client is OWED: tokens
-                    # already delivered stay delivered (no silent
-                    # replay from another replica), the stream ends
-                    # with an explicit error event
-                    try:
+                cursor = int(str(last_id).strip() or "0")
+            except ValueError:
+                return self._reply(
+                    400, {"error": "Last-Event-ID must be the integer "
+                                   "seq of the last received event"})
+            self.close_connection = True
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            # the ORIGINAL stream's identity, not this connection's —
+            # a second blip resumes against the same journal entry
+            self.send_header("X-Request-Id", rid)
+            self.end_headers()
+            replayed_tokens = 0
+            from_seq = cursor
+            deadline = time.monotonic() + router.request_timeout_s
+            try:
+                self.wfile.write(f": trace_id={rid}\n\n".encode())
+                self.wfile.flush()
+                state = JOURNAL_LIVE
+                while time.monotonic() < deadline:
+                    evs, state = router.journal.wait_events(
+                        entry, cursor, timeout_s=5.0)
+                    for seq, payload, ntok in evs:
                         self.wfile.write(
-                            f"data: {json.dumps({'error': str(exc)})}"
-                            "\n\n".encode())
-                        self.wfile.write(b"data: [DONE]\n\n")
-                    except OSError:
-                        pass
+                            f"id: {seq}\ndata: {payload}\n\n".encode())
+                        self.wfile.flush()
+                        cursor = seq
+                        replayed_tokens += ntok
+                    if not evs and state != JOURNAL_LIVE:
+                        break
+                if state == JOURNAL_LIVE:
+                    # waited out request_timeout with the entry still
+                    # live: a truncated replay must NOT masquerade as
+                    # a completed stream — surface the cut explicitly
+                    # (the client can reconnect again from its cursor)
+                    err = json.dumps({
+                        "error": "stream replay timed out with the "
+                                 "stream still live; reconnect from "
+                                 "Last-Event-ID"})
+                    self.wfile.write(f"data: {err}\n\n".encode())
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
+            except OSError:
+                router._count("journal", "client_disconnect")
+                return
             finally:
-                router.replicas.untrack(replica.rid, tokens)
-                call.close()
+                if replayed_tokens:
+                    router._obs[
+                        "router_stream_tokens_replayed_total"].inc(
+                            replayed_tokens)
+            if self._span is not None:
+                self._span.event("stream_replay", rid=rid,
+                                 from_seq=from_seq, to_seq=cursor,
+                                 tokens=replayed_tokens)
+            router._count("journal", "ok")
 
         def do_POST(self):
             self._span = router.tracer.start_span(
@@ -963,9 +1431,16 @@ def _make_handler(router: RouterServer):
                         return self._stream(req, tenant=tenant)
                     finally:
                         router._tenant_exit(tenant)
-                status, out, hdrs = router.route_json(self.path, req,
-                                                      tenant=tenant,
-                                                      span=self._span)
+                idem_key = self.headers.get("X-Idempotency-Key")
+                if self.path == "/v1/generate" and idem_key:
+                    # dedupe window: a client retry after an ambiguous
+                    # 502 replays the cached verdict instead of
+                    # generating twice
+                    status, out, hdrs = router.route_idempotent(
+                        idem_key, req, tenant=tenant, span=self._span)
+                else:
+                    status, out, hdrs = router.route_json(
+                        self.path, req, tenant=tenant, span=self._span)
             except OSError as exc:
                 # replica-side transport errors all surface as
                 # ReplicaUnreachable, so a raw OSError here is the
@@ -1041,6 +1516,24 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default=float(e("ROUTER_HEDGE_MAX_MS", "2000")))
     p.add_argument("--request-timeout", type=float,
                    default=float(e("ROUTER_REQUEST_TIMEOUT", "600")))
+    p.add_argument("--stream-resume-max", type=int,
+                   default=int(e("ROUTER_STREAM_RESUME_MAX", "1")),
+                   help="mid-stream replica deaths to splice over per "
+                        "stream via a continuation request (0 = legacy "
+                        "behavior: surface the error terminal); default "
+                        "1, consistent with the single re-route")
+    p.add_argument("--stream-journal", type=int,
+                   default=int(e("ROUTER_STREAM_JOURNAL", "256")),
+                   help="bounded stream-resume journal size (entries); "
+                        "each relayed stream's events are retained here "
+                        "for continuation splicing and Last-Event-ID "
+                        "client replay")
+    p.add_argument("--idempotency-window", type=float,
+                   default=float(e("ROUTER_IDEMPOTENCY_WINDOW", "300")),
+                   help="seconds a non-streamed generate's 2xx verdict "
+                        "stays replayable under its X-Idempotency-Key "
+                        "(bounded to 1024 keys; non-2xx verdicts are "
+                        "never cached)")
     p.add_argument("--trace-sample", type=float,
                    default=float(e("ROUTER_TRACE_SAMPLE", "0.01")),
                    help="fraction of routed requests retained in the "
@@ -1099,6 +1592,9 @@ def main(argv=None) -> int:
         hedge_min_ms=args.hedge_min_ms,
         hedge_max_ms=args.hedge_max_ms,
         request_timeout_s=args.request_timeout,
+        stream_resume_max=args.stream_resume_max,
+        stream_journal_size=args.stream_journal,
+        idempotency_window_s=args.idempotency_window,
         trace_sample=args.trace_sample,
         trace_slow_ms=args.trace_slow_ms)
     prober = HealthProber(

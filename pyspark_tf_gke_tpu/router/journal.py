@@ -1,0 +1,297 @@
+"""Front-owned stream-resume journal + idempotency cache.
+
+Two bounded stores the gateway keeps so a single failure — a replica
+dying mid-stream, a router↔client network blip, or an ambiguous 502 on
+a blocking generate — no longer costs the client its request:
+
+* :class:`StreamJournal` — one bounded ring of per-stream resume state
+  (the PR 14 step-ring discipline: fixed capacity, front-owned, cheap
+  appends under one lock). While the gateway relays a stream it
+  journals every SSE event it wrote to the client (seq, raw payload,
+  parsed token ids) plus everything a CONTINUATION needs if the
+  replica dies mid-stream: the original request body, tenant, the
+  deadline anchored at FIRST submit, and the accumulated emitted
+  token IDS — the splice is token-id-level (``continuation:
+  {emitted_ids}`` to the next replica), never re-tokenized text,
+  which would be lossy for non-UTF-8 byte runs. A reconnecting client
+  replays from ``Last-Event-ID`` + ``X-Request-Id`` against the same
+  entry; live entries carry a condition so a follower attaches to a
+  stream still being relayed.
+* :class:`IdempotencyCache` — a bounded ``X-Idempotency-Key`` window
+  for non-streamed ``/v1/generate``: the first request under a key
+  executes, concurrent duplicates WAIT for its verdict, and a retry
+  after the fact replays the cached 2xx response instead of
+  generating twice. Non-2xx verdicts are never cached — a retry after
+  a real failure must re-execute.
+
+Both stores are in-router memory: bounded, self-evicting, and scoped
+to the gateway process (a router restart forgets them — the client's
+retry then degrades to today's behavior, never to corruption).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+LIVE = "live"      # upstream leg(s) still delivering
+DONE = "done"      # reached [DONE] (incl. relayed engine error terminals)
+FAILED = "failed"  # upstream died and no resume could complete it
+
+
+class StreamEntry:
+    """One stream's resume state. The relay thread appends under the
+    journal lock and notifies ``cond``; reconnect followers wait on it.
+    ``events`` holds ``(seq, payload_json_str, n_tokens)`` for every
+    ``data:`` event already written (or owed) to the client."""
+
+    __slots__ = ("rid", "request", "tenant", "created", "deadline_at",
+                 "events", "tokens", "token_ids", "last_text", "state",
+                 "resumes", "cond", "evicted", "bytes", "seq")
+
+    def __init__(self, rid: str, request: dict, tenant: str,
+                 deadline_s: Optional[float] = None):
+        self.rid = rid
+        self.request = dict(request)
+        self.tenant = tenant
+        self.created = time.monotonic()
+        # the ORIGINAL deadline, anchored at first submit: a resumed
+        # continuation inherits what's left of it, never a fresh one
+        self.deadline_at = (self.created + float(deadline_s)
+                            if deadline_s is not None else None)
+        self.events: List[Tuple[int, str, int]] = []
+        self.tokens = 0
+        self.token_ids: List[int] = []  # the continuation splice point
+        self.last_text: Optional[str] = None  # running text (the
+        #   synthesized-terminal completion when the budget was spent)
+        self.state = LIVE
+        self.resumes = 0
+        self.cond = threading.Condition()
+        self.evicted = False
+        self.bytes = 0  # retained payload bytes (the ring's byte cap)
+        self.seq = 0    # id-line counter; advances even after eviction
+        #   (the client's ids must stay dense/monotonic either way)
+
+    def remaining_deadline_s(self) -> Optional[float]:
+        if self.deadline_at is None:
+            return None
+        return self.deadline_at - time.monotonic()
+
+
+class StreamJournal:
+    """Bounded rid-keyed ring of :class:`StreamEntry` — bounded in
+    ENTRIES (``max_entries``) and BYTES (``max_bytes``: the retained
+    payloads dominate memory — each token event carries the cumulative
+    ``text``, so one long stream's events are O(n²) bytes). Eviction
+    prefers finished entries (oldest first); if every entry is still
+    live the oldest live one is evicted anyway — the ring is a
+    bounded-memory promise, not a durability one (an evicted live
+    entry keeps relaying to its attached client; only reconnect
+    replay is lost)."""
+
+    def __init__(self, max_entries: int = 256,
+                 max_bytes: int = 64 << 20,
+                 obs: Optional[dict] = None):
+        self.max_entries = max(1, int(max_entries))
+        self.max_bytes = max(1 << 20, int(max_bytes))
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, StreamEntry]" = OrderedDict()
+        self._token_total = 0  # maintained incrementally: the gauges
+        #   run on EVERY relayed token event, so an O(entries) rescan
+        #   here would serialize all relay threads on the journal lock
+        self._bytes_total = 0
+        self._obs = obs or {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def _gauges_locked(self) -> None:
+        g = self._obs.get("router_stream_journal_entries")
+        if g is not None:
+            g.set(len(self._entries))
+        g = self._obs.get("router_stream_journal_tokens")
+        if g is not None:
+            g.set(self._token_total)
+
+    def _evict_locked(self, keep: Optional[StreamEntry] = None) -> None:
+        """Evict (finished-first, else oldest) until both budgets
+        hold. ``keep``: never evict the entry being appended to —
+        one in-flight stream may exceed the byte budget alone (its
+        own size is bounded by its max_new_tokens)."""
+        def over():
+            floor = 1 if keep is not None and not keep.evicted else 0
+            return len(self._entries) > floor and (
+                len(self._entries) > self.max_entries
+                or self._bytes_total > self.max_bytes)
+
+        while over():
+            victim_key = next(
+                (k for k, e in self._entries.items()
+                 if e.state != LIVE and e is not keep), None)
+            if victim_key is None:
+                victim_key = next(k for k, e in self._entries.items()
+                                  if e is not keep)
+            victim = self._entries.pop(victim_key)
+            victim.evicted = True  # its relay stops feeding the
+            #   totals (the entry no longer counts toward the ring)
+            self._token_total -= victim.tokens
+            self._bytes_total -= victim.bytes
+
+    def open(self, rid: str, request: dict, tenant: str,
+             deadline_s: Optional[float] = None) -> StreamEntry:
+        entry = StreamEntry(rid, request, tenant, deadline_s=deadline_s)
+        with self._lock:
+            self._entries[rid] = entry
+            self._entries.move_to_end(rid)
+            self._evict_locked(keep=entry)
+            self._gauges_locked()
+        return entry
+
+    def append(self, entry: StreamEntry, payload: str,
+               token_ids=(), text: Optional[str] = None) -> int:
+        """Record one client-facing ``data:`` event; returns its seq
+        (1-based, the ``id:`` line value). ``token_ids`` accumulate
+        into the entry's splice point."""
+        with entry.cond:
+            entry.seq += 1
+            seq = entry.seq
+            if not entry.evicted:
+                entry.events.append((seq, payload, len(token_ids)))
+            # token_ids/last_text still accumulate after eviction —
+            # the CONTINUATION splice needs them; only replay (the
+            # payload retention) is what eviction gives up, so an
+            # evicted live stream's payload bytes stop growing and
+            # the max_bytes promise holds
+            entry.token_ids.extend(int(t) for t in token_ids)
+            if text is not None:
+                entry.last_text = text
+            entry.cond.notify_all()
+        with self._lock:
+            # per-entry size counters advance under the JOURNAL lock so
+            # eviction (which subtracts them from the totals under the
+            # same lock) can never race an increment into a drifting
+            # total
+            if not entry.evicted:
+                entry.tokens += len(token_ids)
+                entry.bytes += len(payload)
+                self._token_total += len(token_ids)
+                self._bytes_total += len(payload)
+                if self._bytes_total > self.max_bytes:
+                    self._evict_locked(keep=entry)
+            self._gauges_locked()
+        return seq
+
+    def finish(self, entry: StreamEntry, state: str = DONE) -> None:
+        with entry.cond:
+            if entry.state == LIVE:
+                entry.state = state
+            entry.cond.notify_all()
+
+    def get(self, rid: str) -> Optional[StreamEntry]:
+        with self._lock:
+            return self._entries.get(rid)
+
+    def wait_events(self, entry: StreamEntry, after_seq: int,
+                    timeout_s: float = 10.0
+                    ) -> Tuple[List[Tuple[int, str, int]], str]:
+        """Events with seq > ``after_seq`` plus the entry's state; when
+        none are buffered and the entry is live, block up to
+        ``timeout_s`` for the relay thread to append more. Seqs are
+        dense 1-based, so the tail is a slice, not a scan."""
+        cut = max(0, int(after_seq))
+        with entry.cond:
+            evs = entry.events[cut:]
+            if not evs and entry.state == LIVE:
+                entry.cond.wait(timeout_s)
+                evs = entry.events[cut:]
+            return list(evs), entry.state
+
+
+class IdempotencyCache:
+    """Bounded dedupe window for ``X-Idempotency-Key`` requests.
+
+    :meth:`execute` runs ``fn`` at most once per key inside the
+    window: the first caller executes, concurrent callers block on the
+    executor's verdict, and later callers replay the cached result.
+    Only 2xx results are cached (``fn`` returns ``(status, body,
+    headers)``); any other verdict clears the key so a retry
+    re-executes — the cache prevents DOUBLE generation, it never
+    pins a failure."""
+
+    def __init__(self, window_s: float = 300.0, max_entries: int = 1024):
+        self.window_s = float(window_s)
+        self.max_entries = max(1, int(max_entries))
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _IdemEntry]" = OrderedDict()
+
+    def _evict_locked(self) -> None:
+        now = time.monotonic()
+        dead = [k for k, e in self._entries.items()
+                if e.result is not None and e.expires_at <= now]
+        for k in dead:
+            del self._entries[k]
+        while len(self._entries) > self.max_entries:
+            victim = next(
+                (k for k, e in self._entries.items()
+                 if e.result is not None), None)
+            if victim is None:
+                break  # every entry in flight: over-cap but bounded by
+                #        the router's own in-flight request count
+            del self._entries[victim]
+
+    def execute(self, key: str, fn, wait_timeout_s: float = 600.0):
+        """Returns ``(result, replayed)``. ``replayed`` is True when
+        the result came from the cache (or from waiting out a
+        concurrent executor) instead of running ``fn``."""
+        deadline = time.monotonic() + float(wait_timeout_s)
+        while True:
+            with self._lock:
+                self._evict_locked()
+                ent = self._entries.get(key)
+                if ent is None:
+                    ent = _IdemEntry()
+                    self._entries[key] = ent
+                    self._entries.move_to_end(key)
+                    owner = True
+                elif ent.result is not None:
+                    return ent.result, True
+                else:
+                    owner = False
+            if owner:
+                try:
+                    result = fn()
+                except BaseException:
+                    with self._lock:
+                        if self._entries.get(key) is ent:
+                            del self._entries[key]
+                    ent.event.set()
+                    raise
+                with self._lock:
+                    if 200 <= result[0] < 300:
+                        ent.result = result
+                        ent.expires_at = time.monotonic() + self.window_s
+                    elif self._entries.get(key) is ent:
+                        # non-2xx: drop the key — a retry re-executes
+                        del self._entries[key]
+                ent.event.set()
+                return result, False
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not ent.event.wait(min(remaining, 5.0)):
+                if time.monotonic() >= deadline:
+                    # waited out the window: degrade to executing
+                    # un-deduped rather than hanging the client forever
+                    return fn(), False
+            # woken (or polled): loop re-reads the entry — replay a
+            # cached 2xx, or claim ownership if the executor failed
+
+
+class _IdemEntry:
+    __slots__ = ("result", "expires_at", "event")
+
+    def __init__(self):
+        self.result = None
+        self.expires_at = 0.0
+        self.event = threading.Event()

@@ -1842,14 +1842,24 @@ class BundleServer:
                  temperature: float = 0.0, top_k=None, top_p=None,
                  num_beams: int = 0, repetition_penalty=None,
                  deadline_s=None, tenant: str = "default",
-                 span=None) -> list:
+                 seed=None, span=None) -> list:
         """Batch completion. Prompts are grouped by token length so each
         group decodes as one batched call; the batch dimension pads up
         to power-of-2 buckets (repeating the first row) so mixed traffic
         reuses a handful of compiled shapes instead of recompiling per
         group size; results return in input order. Sampling requests get
-        a fresh per-request PRNG key — a fixed seed would hand every
-        client the same 'random' completion.
+        a fresh per-request PRNG key — a fixed server-side seed would
+        hand every client the same 'random' completion — unless the
+        CLIENT pins ``seed`` (the ``/v1/generate`` body field): on the
+        slot-engine path each prompt's sampling lane draws from its own
+        ``seed + index`` key, so the completion is deterministic per
+        (prompt, seed) pair — what makes idempotent retries,
+        record/replay and sampled-lane continuations reproducible. The
+        whole-batch fallback (beams/top-k/repetition-penalty, or no
+        --continuous-slots) shares ONE ``PRNGKey(seed)`` across the
+        padded batch: deterministic per (batch, seed), but a prompt's
+        draws there depend on its batch composition. Greedy requests
+        ignore ``seed`` entirely (byte-identical with or without it).
 
         ``deadline_s``: seconds from now the client still wants the
         answer (HTTP ``deadline_ms`` / 1000). The slot engine enforces
@@ -1877,7 +1887,8 @@ class BundleServer:
             raise ValueError(f"batch of {len(prompts)} exceeds "
                              f"max batch {MAX_BATCH}")
         rng = (jax.random.PRNGKey(
-            int.from_bytes(os.urandom(4), "little"))
+            int(seed) if seed is not None
+            else int.from_bytes(os.urandom(4), "little"))
             if temperature and temperature > 0 else None)
         cfg = self.model.cfg
         eos_id = getattr(self.tokenizer, "eos_id", None)
@@ -1935,7 +1946,14 @@ class BundleServer:
                     rids.append((i, self._front.submit(
                         ids, max_new_tokens, temperature=temp,
                         top_p=top_p,
-                        seed=int.from_bytes(os.urandom(4), "little"),
+                        # client-pinned seed (per-prompt: seed + index)
+                        # makes the slot's sampling lane deterministic
+                        # end to end — it rides the OP_CB_ADMIT wire as
+                        # its own int64, so record/replay and worker
+                        # replicas draw the identical stream
+                        seed=(int(seed) + i if seed is not None
+                              else int.from_bytes(os.urandom(4),
+                                                  "little")),
                         deadline_s=deadline_s, tenant=tenant,
                         span=span)))
             except Exception:
@@ -2065,12 +2083,24 @@ class BundleServer:
 
     def generate_stream(self, prompt: str, max_new_tokens: int = 64,
                         deadline_s=None, tenant: str = "default",
-                        span=None):
+                        continuation=None, span=None):
         """Greedy streaming completion through the slot engine: yields
         one event dict per decoded token group (``token_ids`` plus the
         full ``text`` so far — full text, not a delta, so multibyte
         tokenizer sequences can't tear), then a terminal event with the
-        assembled completion. Requires --continuous-slots."""
+        assembled completion. Requires --continuous-slots.
+
+        ``continuation`` (``{"emitted_ids": [int, ...]}``): the
+        router's mid-stream failover splice. ``prompt`` is the
+        ORIGINAL prompt and ``emitted_ids`` the token ids a dead
+        replica already delivered: the engine prefills
+        ``encode(prompt) + emitted_ids`` (token-EXACT — text-level
+        re-tokenization would be lossy for non-UTF-8 byte runs) and
+        greedy decode continues precisely where the dead stream
+        stopped. Events and the terminal entry frame text/counts
+        CUMULATIVELY (``text`` = prompt + decode(emitted + new),
+        ``new_tokens`` = emitted + generated), so a client splicing
+        this leg after the originals sees one uninterrupted run."""
         if self._front is None:
             raise ValueError(
                 "streaming requires --continuous-slots (the slot engine "
@@ -2086,6 +2116,19 @@ class BundleServer:
         ids = self.tokenizer.encode(prompt)
         if not ids:
             raise ValueError("prompt tokenized to zero tokens")
+        prior_ids: list = []
+        if continuation is not None:
+            # token-id splice point: prefill = prompt ids + the ids the
+            # dead replica already delivered (NOT re-tokenized text —
+            # decode→encode is lossy for non-UTF-8 byte runs)
+            prior_ids = [int(t) for t in continuation["emitted_ids"]]
+            ids = ids + prior_ids
+            if span is not None:
+                # the resume crosses replicas inside ONE trace: the
+                # router's `resume` event names the dead leg, this one
+                # marks where the continuation picked up
+                span.event("continuation",
+                           emitted_tokens=len(prior_ids))
         cfg = self.model.cfg
         if len(ids) + max_new_tokens > cfg.max_seq_len:
             raise ValueError(
@@ -2115,12 +2158,14 @@ class BundleServer:
                     if item:
                         yielded = True
                         yield {"token_ids": item,
-                               "text": prompt + self.tokenizer.decode(toks)}
+                               "text": prompt + self.tokenizer.decode(
+                                   prior_ids + toks)}
                     break
                 toks.extend(item)
                 yielded = True
                 yield {"token_ids": item,
-                       "text": prompt + self.tokenizer.decode(toks)}
+                       "text": prompt + self.tokenizer.decode(
+                           prior_ids + toks)}
             # collect + release the results entry (event already set by
             # the time the terminal item arrives; short timeout)
             self._front.wait(rid, timeout_s=60)
@@ -2145,14 +2190,21 @@ class BundleServer:
                     self.record_metrics(failed=True)
         entry = {
             "prompt": prompt,
-            "completion": prompt + self.tokenizer.decode(toks),
-            "new_tokens": len(toks),
+            "completion": prompt + self.tokenizer.decode(
+                prior_ids + toks),
+            "new_tokens": len(prior_ids) + len(toks),
             "latency_ms": round((time.perf_counter() - t0) * 1000.0, 2),
             "done": True,
         }
-        self.record_metrics(generate_entries=[entry],
-                            trace_id=(span.trace_id
-                                      if span is not None else None))
+        if continuation is not None:
+            entry["resumed"] = True
+        # metrics count what THIS replica generated (a continuation's
+        # prior tokens were another replica's work — counting them here
+        # would double-book serve_generate_tokens_total fleet-wide)
+        self.record_metrics(generate_entries=[
+            {**entry, "new_tokens": len(toks)}],
+            trace_id=(span.trace_id
+                      if span is not None else None))
         yield entry
 
     def record_metrics(self, *, generate_entries=None, score: bool = False,
@@ -2411,13 +2463,33 @@ def _make_handler(server: BundleServer):
                     400, {"error": "streaming is greedy-only (no "
                                    "sampling/beam parameters)"})
             deadline_ms = req.get("deadline_ms")
+            continuation = req.get("continuation")
+            if continuation is not None:
+                # the router's mid-stream failover splice: the ORIGINAL
+                # prompt plus the token ids a dead replica already
+                # delivered — ids must be sane non-negative ints (the
+                # length budget is checked with the full prefill in
+                # generate_stream)
+                try:
+                    emitted = [int(t)
+                               for t in continuation["emitted_ids"]]
+                    if not emitted or any(t < 0 for t in emitted):
+                        raise ValueError
+                    continuation = {"emitted_ids": emitted}
+                except (TypeError, KeyError, ValueError):
+                    server.record_metrics(failed=True)
+                    return self._reply(
+                        400, {"error": "'continuation' must carry "
+                                       "emitted_ids: a non-empty list "
+                                       "of non-negative token ids"})
             try:
                 events = server.generate_stream(
                     prompts[0],
                     max_new_tokens=int(req.get("max_new_tokens", 64)),
                     deadline_s=(float(deadline_ms) / 1000.0
                                 if deadline_ms is not None else None),
-                    tenant=tenant, span=self._span)
+                    tenant=tenant, continuation=continuation,
+                    span=self._span)
                 first = next(events)  # validation errors surface BEFORE
                 #   the 200 status line is committed
             except RequestRejected as exc:
@@ -2599,6 +2671,15 @@ def _make_handler(server: BundleServer):
                         return self._reply(
                             400, {"error": "'prompts' must be a list of "
                                            "strings (or 'prompt': str)"})
+                    seed = req.get("seed")
+                    if seed is not None:
+                        try:
+                            seed = int(seed)
+                        except (TypeError, ValueError):
+                            server.record_metrics(failed=True)
+                            return self._reply(
+                                400, {"error": "'seed' must be an "
+                                               "integer"})
                     if req.get("stream"):
                         return self._stream_generate(req, prompts,
                                                      tenant=tenant)
@@ -2611,7 +2692,7 @@ def _make_handler(server: BundleServer):
                         num_beams=int(req.get("num_beams", 0)),
                         repetition_penalty=req.get("repetition_penalty"),
                         deadline_s=deadline_s, tenant=tenant,
-                        span=self._span)
+                        seed=seed, span=self._span)
                     server.record_metrics(
                         generate_entries=out,
                         trace_id=(self._span.trace_id

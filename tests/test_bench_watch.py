@@ -164,11 +164,12 @@ def test_orchestrate_all_rejects_cpu_fallback(monkeypatch, capsys):
     # only the host-only workloads executed (router's, replay's and
     # chaos's replicas are CPU-pinned subprocesses by design; io
     # touches no devices) — matrix order preserved
-    assert ran == [["router"], ["replay"], ["chaos"], ["io"]]
+    assert ran == [["router"], ["replay"], ["chaos"],
+                   ["chaos", "--stream"], ["io"]]
     out = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
            if ln.startswith("{")]
     errors = [o for o in out if o.get("error")]
-    assert len(errors) == len(bench.ALL_WORKLOADS) - 4
+    assert len(errors) == len(bench.ALL_WORKLOADS) - 5
 
 
 def test_probe_code_shared_between_bench_and_watcher():

@@ -386,6 +386,91 @@ def test_continuous_sampling_routes_through_engine(cb_endpoints):
     assert "beam_score" in beams[0]  # whole-batch fallback intact
 
 
+def test_seed_pins_sampled_completions(cb_endpoints):
+    """PR 15 satellite: a client-pinned ``seed`` makes SAMPLED
+    completions deterministic on both serving paths (slot engine and
+    whole-batch), greedy stays byte-identical with or without it, and
+    a garbage seed is a 400."""
+    plain_url, cont_url = cb_endpoints
+    for url in (plain_url, cont_url):
+        sampled = {"prompts": ["ab"], "max_new_tokens": 6,
+                   "temperature": 0.9, "seed": 1234}
+        a = _post(url, "/v1/generate", sampled)["completions"]
+        b = _post(url, "/v1/generate", sampled)["completions"]
+        assert a[0]["completion"] == b[0]["completion"]
+        # greedy ignores seed entirely
+        g1 = _post(url, "/v1/generate",
+                   {"prompts": ["ab"], "max_new_tokens": 6})
+        g2 = _post(url, "/v1/generate",
+                   {"prompts": ["ab"], "max_new_tokens": 6,
+                    "seed": 7})
+        assert g1["completions"][0]["completion"] == \
+            g2["completions"][0]["completion"]
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(cont_url, "/v1/generate",
+              {"prompts": ["ab"], "max_new_tokens": 2, "seed": "x"})
+    assert exc.value.code == 400
+    assert "seed" in json.loads(exc.value.read())["error"]
+
+
+def test_stream_continuation_framing(cb_endpoints):
+    """PR 15: continuation-aware SSE framing — a stream whose prompt
+    embeds previously-emitted text frames its terminal entry against
+    the ORIGINAL prompt and the CUMULATIVE token count, token-exactly
+    vs an uninterrupted control stream."""
+    _, cont_url = cb_endpoints
+
+    def stream(body):
+        req = urllib.request.Request(
+            cont_url + "/v1/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        events, terminal = [], None
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            for raw in resp:
+                line = raw.decode().strip()
+                if not line.startswith("data: ") \
+                        or line == "data: [DONE]":
+                    continue
+                ev = json.loads(line[len("data: "):])
+                if ev.get("done"):
+                    terminal = ev
+                else:
+                    events.append(ev)
+        toks = [t for ev in events for t in ev.get("token_ids") or []]
+        return events, toks, terminal
+
+    _, control, control_term = stream(
+        {"prompts": ["abc"], "stream": True, "max_new_tokens": 8})
+    assert control_term["prompt"] == "abc"
+    assert control_term["new_tokens"] == len(control)
+    assert "resumed" not in control_term
+    # simulate the router's splice: cut anywhere and re-submit the
+    # ORIGINAL prompt + the emitted token IDS (what the journal holds
+    # — ids, not text: random-weight models emit non-UTF-8 byte runs
+    # that would not survive a decode→encode round-trip)
+    cut = 3
+    assert 0 < cut < len(control)
+    cont_events, cont_toks, cont_term = stream(
+        {"prompts": ["abc"], "stream": True,
+         "max_new_tokens": len(control) - cut,
+         "continuation": {"emitted_ids": control[:cut]}})
+    # greedy continuation is token-exact past the cut, and its running
+    # text EXTENDS the original prompt (the router's splice check)
+    assert control[:cut] + cont_toks == control
+    assert all(ev["text"].startswith("abc") for ev in cont_events)
+    assert cont_term["prompt"] == "abc"
+    assert cont_term["new_tokens"] == len(control)
+    assert cont_term["resumed"] is True
+    assert cont_term["completion"] == control_term["completion"]
+    # malformed framing is a 400, not a mis-framed stream
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(cont_url, "/v1/generate",
+              {"prompts": ["abc"], "stream": True, "max_new_tokens": 4,
+               "continuation": {"emitted_ids": []}})
+    assert exc.value.code == 400
+
+
 def test_continuous_front_engine_failure_unit(tmp_path):
     # Unit-level: fault-inject engine.step once; the front must fail
     # that request with a 500-shaped error and serve the next one.

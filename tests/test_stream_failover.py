@@ -1,0 +1,684 @@
+"""Mid-stream failover (PR 15): token-exact continuation splicing,
+client stream resume from the journal, idempotent retries, and the
+stream token-exactness invariant.
+
+Fast tier: scriptable STUB replicas (the test_router.py pattern — no
+jax, no model) pin the router-side contract: a replica SIGKILL-shaped
+death after the first event is spliced over invisibly (the client's
+assembled token stream is byte-identical to an uninterrupted run), a
+client hang-up detaches the relay (journal keeps filling; outcome
+counts client_disconnect; legs close leak-free on both hang-up
+orderings), Last-Event-ID + X-Request-Id replays from the journal, and
+X-Idempotency-Key dedupes blocking retries. The live SIGKILL gate over
+real BundleServers is ``tools/smoke_check.py --failover-stream``; the
+slow localfleet variant (chaos kill-mid-stream vs a control run +
+exactly-one-terminal spans) is at the bottom.
+"""
+
+import http.client
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from pyspark_tf_gke_tpu.chaos.invariants import check_stream_tokens
+from pyspark_tf_gke_tpu.chaos.spec import synth_chaos
+from pyspark_tf_gke_tpu.obs.events import EventLog
+from pyspark_tf_gke_tpu.obs.metrics import MetricsRegistry
+from pyspark_tf_gke_tpu.router.discovery import (
+    DOWN,
+    HealthProber,
+    Replica,
+)
+from pyspark_tf_gke_tpu.router.gateway import (
+    RouterServer,
+    start_router_http_server,
+)
+from pyspark_tf_gke_tpu.router.journal import IdempotencyCache
+
+# the control run's framing: prompt "s", tokens 1..4, terminal entry
+PROMPT = "s"
+TOKENS = [1, 2, 3, 4]
+TEXTS = ["sa", "sab", "sabc", "sabcd"]
+
+
+def _event(i):
+    return {"token_ids": [TOKENS[i]], "text": TEXTS[i]}
+
+
+def _terminal(new_tokens=4, prompt=PROMPT, completion="sabcd", **extra):
+    return {"prompt": prompt, "completion": completion,
+            "new_tokens": new_tokens, "latency_ms": 1.0, "done": True,
+            **extra}
+
+
+CONTROL_EVENTS = [_event(0), _event(1), _event(2), _event(3),
+                  _terminal()]
+
+
+class StubReplica:
+    """Scriptable fake BundleServer for stream-failover scenarios:
+    plain streams serve ``stream_events`` ("DIE" cuts the wire);
+    requests carrying a ``continuation`` field serve
+    ``continuation_events`` instead (continuation-aware framing is the
+    REPLICA's job — the stub scripts what serve.py produces);
+    ``event_delay_s`` paces events so tests can hang up mid-stream."""
+
+    def __init__(self):
+        self.load = {"queued": 0, "queued_tokens": 0, "active": 0,
+                     "slots_total": 2, "kv_pages_free": None,
+                     "inflight_http": 0, "draining": False,
+                     "capacity_free": 0, "queue_delay_ms": 0.0,
+                     "tenants": {}}
+        self.stream_events = None
+        self.continuation_events = None
+        self.event_delay_s = 0.0
+        self.delay_s = 0.0
+        self.received = []
+        self.tag = "!"
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                route = self.path.partition("?")[0]
+                if route == "/loadz":
+                    return self._reply(200, server.load)
+                if route == "/healthz":
+                    return self._reply(200, {"status": "ok"})
+                return self._reply(404, {"error": "nope"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                server.received.append((self.path, req))
+                if server.delay_s:
+                    time.sleep(server.delay_s)
+                if req.get("stream"):
+                    events = (server.continuation_events
+                              if req.get("continuation") is not None
+                              and server.continuation_events is not None
+                              else server.stream_events) or []
+                    self.close_connection = True
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/event-stream")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.wfile.write(b": trace_id=stub\n\n")
+                    for ev in events:
+                        if server.event_delay_s:
+                            time.sleep(server.event_delay_s)
+                        if ev == "DIE":
+                            return  # mid-stream cut, no [DONE]
+                        self.wfile.write(
+                            f"data: {json.dumps(ev)}\n\n".encode())
+                        self.wfile.flush()
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    return
+                prompts = req.get("prompts") or [req.get("prompt", "")]
+                self._reply(200, {"completions": [
+                    {"prompt": p, "completion": p + server.tag,
+                     "new_tokens": 1, "latency_ms": 1.0}
+                    for p in prompts]})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def stubs():
+    pair = [StubReplica(), StubReplica()]
+    pair[0].tag, pair[1].tag = "@A", "@B"
+    yield pair
+    for s in pair:
+        s.stop()
+
+
+def _router_for(stub_list, tmp_path, **kw):
+    replicas = [Replica(rid=s.url, base_url=s.url) for s in stub_list]
+    router = RouterServer(
+        replicas, registry=MetricsRegistry(),
+        event_log=EventLog(str(tmp_path / "events.jsonl")),
+        request_timeout_s=30.0, affinity_tokens=0, **kw)
+    prober = HealthProber(router.replicas, interval_s=999,
+                          fail_threshold=1)
+    prober.probe_once()
+    return router
+
+
+def _serve(router):
+    httpd = start_router_http_server(router, host="127.0.0.1", port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _stream_raw(url, body=None, headers=None, read_events=None):
+    """POST a stream via http.client; returns (response headers dict,
+    [(id, payload_str)], saw_done, conn). ``read_events``: stop (and
+    leave the connection OPEN — caller closes) after this many data
+    events."""
+    import urllib.parse
+
+    parts = urllib.parse.urlsplit(url)
+    conn = http.client.HTTPConnection(parts.hostname, parts.port,
+                                      timeout=30)
+    payload = json.dumps(body if body is not None
+                         else {"prompts": [PROMPT], "stream": True,
+                               "max_new_tokens": 4}).encode()
+    conn.request("POST", "/v1/generate", body=payload,
+                 headers={"Content-Type": "application/json",
+                          **(headers or {})})
+    resp = conn.getresponse()
+    hdrs = dict(resp.getheaders())
+    events, saw_done, last_id = [], False, None
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.decode().strip()
+        if line.startswith("id: "):
+            last_id = int(line[4:])
+            continue
+        if not line.startswith("data: "):
+            continue
+        data = line[len("data: "):]
+        if data == "[DONE]":
+            saw_done = True
+            break
+        events.append((last_id, data))
+        if read_events is not None and len(events) >= read_events:
+            return hdrs, events, saw_done, conn
+    conn.close()
+    return hdrs, events, saw_done, conn
+
+
+def _tokens_of(events):
+    out = []
+    for _seq, data in events:
+        out.extend(json.loads(data).get("token_ids") or [])
+    return out
+
+
+def _wait_for(cond, timeout_s=5.0):
+    """The client sees [DONE] a hair before the relay thread finishes
+    its accounting (outcome count, journal finish, leg untrack) —
+    metric asserts poll instead of racing it."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+# -- continuation splicing ---------------------------------------------------
+
+
+def test_mid_stream_death_splices_token_exact(stubs, tmp_path):
+    """THE tentpole contract: death after the first event is spliced —
+    the client sees one uninterrupted token-exact stream with [DONE],
+    sequential ids, a normalized terminal entry, and zero errors."""
+    dying, other = stubs
+    dying.stream_events = [_event(0), _event(1), "DIE"]
+    # the continuation replica picks up at token 3 and frames the
+    # terminal the continuation-aware way (prompt_chars/emitted_tokens)
+    other.continuation_events = [
+        _event(2), _event(3),
+        _terminal(new_tokens=4, prompt=PROMPT, resumed=True)]
+    router = _router_for(stubs, tmp_path)
+    router.replicas.get(other.url).load = {"queued_tokens": 100}
+    httpd, url = _serve(router)
+    try:
+        hdrs, events, saw_done, _ = _stream_raw(url)
+        assert saw_done
+        assert hdrs.get("X-Request-Id")
+        got = _tokens_of(events)
+        verdict = check_stream_tokens(TOKENS, got)
+        assert verdict["ok"], verdict["violations"]
+        # sequential ids: 1..N with no gaps (Last-Event-ID contract)
+        assert [seq for seq, _ in events] == list(
+            range(1, len(events) + 1))
+        assert not any("error" in json.loads(d) for _, d in events)
+        terminal = json.loads(events[-1][1])
+        assert terminal["done"] and terminal["resumed"]
+        assert terminal["prompt"] == PROMPT
+        assert terminal["new_tokens"] == 4
+        # the continuation request the dead leg turned into
+        cont = [r for _, r in other.received
+                if r.get("continuation") is not None]
+        assert len(cont) == 1
+        assert cont[0]["prompts"] == [PROMPT]  # the ORIGINAL prompt
+        assert cont[0]["max_new_tokens"] == 2  # 4 - 2 emitted
+        # the splice point rides as token IDS (text re-tokenization
+        # would be lossy for non-UTF-8 byte runs)
+        assert cont[0]["continuation"] == {"emitted_ids": [1, 2]}
+        # metrics + passive health
+        assert router._obs["router_stream_resumes_total"].labels(
+            outcome="ok").value == 1
+        assert router.replicas.get(dying.url).state == DOWN
+        reqs = router._obs["router_requests_total"]
+        assert _wait_for(lambda: reqs.labels(
+            replica=other.url, outcome="ok").value == 1)
+        # leg lifecycle: nothing left tracked on either replica
+        for s in stubs:
+            assert router.replicas.get(s.url).inflight == 0
+    finally:
+        httpd.shutdown()
+
+
+def test_resume_cap_exhausted_surfaces_error_terminal(stubs, tmp_path):
+    """Both replicas die mid-stream: one splice is permitted, the
+    second death surfaces the explicit error terminal + [DONE]."""
+    a, b = stubs
+    a.stream_events = [_event(0), "DIE"]
+    b.continuation_events = [_event(1), "DIE"]
+    router = _router_for(stubs, tmp_path)
+    router.replicas.get(b.url).load = {"queued_tokens": 100}
+    httpd, url = _serve(router)
+    try:
+        _, events, saw_done, _ = _stream_raw(url)
+        assert saw_done  # the error terminal still closes with [DONE]
+        assert _tokens_of(events) == [1, 2]  # delivered stays delivered
+        assert "error" in json.loads(events[-1][1])
+        res = router._obs["router_stream_resumes_total"]
+        assert res.labels(outcome="ok").value == 1
+        assert res.labels(outcome="exhausted").value == 1
+        reqs = router._obs["router_requests_total"]
+        assert _wait_for(lambda: reqs.labels(
+            replica=b.url, outcome="upstream_error").value == 1)
+        for s in stubs:
+            assert router.replicas.get(s.url).inflight == 0
+    finally:
+        httpd.shutdown()
+
+
+def test_resume_disabled_keeps_legacy_error(stubs, tmp_path):
+    """--stream-resume-max 0 restores the pre-PR-15 behavior."""
+    a, b = stubs
+    a.stream_events = [_event(0), "DIE"]
+    b.continuation_events = [_event(1)]
+    router = _router_for(stubs, tmp_path, stream_resume_max=0)
+    router.replicas.get(b.url).load = {"queued_tokens": 100}
+    httpd, url = _serve(router)
+    try:
+        _, events, saw_done, _ = _stream_raw(url)
+        assert saw_done
+        assert _tokens_of(events) == [1]
+        assert "error" in json.loads(events[-1][1])
+        assert not [r for _, r in b.received if "continuation" in r]
+        assert router._obs["router_stream_resumes_total"].labels(
+            outcome="exhausted").value == 1
+    finally:
+        httpd.shutdown()
+
+
+# -- client resume from the journal ------------------------------------------
+
+
+def test_client_replay_from_last_event_id(stubs, tmp_path):
+    """A finished stream replays its tail from the journal: reconnect
+    with Last-Event-ID + X-Request-Id gets exactly the events after
+    the cursor, then [DONE]."""
+    a, b = stubs
+    a.stream_events = CONTROL_EVENTS
+    b.stream_events = CONTROL_EVENTS
+    router = _router_for(stubs, tmp_path)
+    httpd, url = _serve(router)
+    try:
+        hdrs, events, saw_done, _ = _stream_raw(url)
+        assert saw_done and len(events) == 5
+        rid = hdrs["X-Request-Id"]
+        rhdrs, replayed, rdone, _ = _stream_raw(
+            url, headers={"Last-Event-ID": "2", "X-Request-Id": rid})
+        assert rdone
+        assert rhdrs.get("X-Request-Id") == rid  # original identity
+        assert [seq for seq, _ in replayed] == [3, 4, 5]
+        assert _tokens_of(replayed) == [3, 4]
+        assert _wait_for(lambda: router._obs[
+            "router_stream_tokens_replayed_total"].value == 2)
+        # unknown rid → explicit 404, not a hang
+        import urllib.error
+
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            data=json.dumps({"prompts": [PROMPT],
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     "Last-Event-ID": "1",
+                     "X-Request-Id": "deadbeef"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 404
+    finally:
+        httpd.shutdown()
+
+
+def test_client_replay_is_tenant_scoped(stubs, tmp_path):
+    """A stolen/guessed X-Request-Id from another tenant gets the same
+    404 as an unknown one — never the journaled tokens (the
+    idempotency window's tenant boundary, applied to replay)."""
+    import urllib.error
+
+    a, b = stubs
+    a.stream_events = CONTROL_EVENTS
+    b.stream_events = CONTROL_EVENTS
+    router = _router_for(stubs, tmp_path)
+    httpd, url = _serve(router)
+    try:
+        hdrs, _events, saw_done, _ = _stream_raw(
+            url, body={"prompts": [PROMPT], "stream": True,
+                       "max_new_tokens": 4, "tenant": "alice"})
+        assert saw_done
+        rid = hdrs["X-Request-Id"]
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            data=json.dumps({"prompts": [PROMPT],
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Tenant": "mallory",
+                     "Last-Event-ID": "0", "X-Request-Id": rid})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 404
+        # the right tenant still replays
+        _, replayed, rdone, _ = _stream_raw(
+            url, body={"prompts": [PROMPT], "stream": True,
+                       "tenant": "alice"},
+            headers={"Last-Event-ID": "0", "X-Request-Id": rid})
+        assert rdone and _tokens_of(replayed) == TOKENS
+    finally:
+        httpd.shutdown()
+
+
+@pytest.mark.parametrize("ordering", ["hangup_then_death",
+                                      "death_then_hangup"])
+def test_client_hangup_detaches_counts_and_closes_legs(
+        stubs, tmp_path, ordering):
+    """Satellite: a client hang-up — during the ORIGINAL leg with the
+    death still to come, or during the RESUMED leg — must count
+    client_disconnect (never upstream_error), keep draining into the
+    journal so a reconnect completes the stream, and close every
+    upstream leg leak-free (zero in-flight on both replicas)."""
+    a, b = stubs
+    a.event_delay_s = 0.15
+    b.event_delay_s = 0.15
+    if ordering == "hangup_then_death":
+        # client leaves first; the death + splice happen detached
+        a.stream_events = [_event(0), _event(1), "DIE"]
+        b.continuation_events = [_event(2), _event(3), _terminal()]
+    else:
+        # death + splice first; client leaves during the resumed leg
+        a.stream_events = [_event(0), "DIE"]
+        a.event_delay_s = 0.0
+        b.continuation_events = [_event(1), _event(2), _event(3),
+                                 _terminal()]
+    router = _router_for(stubs, tmp_path)
+    router.replicas.get(b.url).load = {"queued_tokens": 100}
+    httpd, url = _serve(router)
+    try:
+        hdrs, events, _done, conn = _stream_raw(url, read_events=1)
+        rid = hdrs["X-Request-Id"]
+        conn.close()  # the hang-up — relay must detach, not die
+        # wait for the detached relay to finish draining into the
+        # journal (terminal state lands when the upstream completes)
+        deadline = time.time() + 10
+        entry = router.journal.get(rid)
+        assert entry is not None
+        while time.time() < deadline and entry.state == "live":
+            time.sleep(0.05)
+        assert entry.state == "done", entry.state
+        # reconnect: the journal completes the stream token-exactly
+        _, replayed, rdone, _ = _stream_raw(
+            url, headers={"Last-Event-ID": "1", "X-Request-Id": rid})
+        assert rdone
+        got = _tokens_of(events) + _tokens_of(replayed)
+        verdict = check_stream_tokens(TOKENS, got)
+        assert verdict["ok"], verdict["violations"]
+        # outcome taxonomy: client_disconnect on the terminal leg,
+        # ZERO upstream_error anywhere
+        reqs = router._obs["router_requests_total"]
+        assert _wait_for(lambda: reqs.labels(
+            replica=b.url, outcome="client_disconnect").value == 1)
+        for s in stubs:
+            assert reqs.labels(replica=s.url,
+                               outcome="upstream_error").value == 0
+            assert router.replicas.get(s.url).inflight == 0
+    finally:
+        httpd.shutdown()
+
+
+# -- idempotent retries ------------------------------------------------------
+
+
+def test_idempotency_key_dedupes_blocking_generate(stubs, tmp_path):
+    a, b = stubs
+    router = _router_for(stubs, tmp_path, hedge=False)
+    httpd, url = _serve(router)
+    try:
+        def post(key, tenant=None):
+            headers = {"Content-Type": "application/json",
+                       "X-Idempotency-Key": key}
+            if tenant:
+                headers["X-Tenant"] = tenant
+            req = urllib.request.Request(
+                url + "/v1/generate",
+                data=json.dumps({"prompts": ["idem"],
+                                 "max_new_tokens": 2}).encode(),
+                headers=headers)
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return (json.loads(resp.read()),
+                        resp.headers.get("X-Idempotent-Replay"))
+        first, replay1 = post("k1")
+        second, replay2 = post("k1")
+        assert replay1 is None and replay2 == "1"
+        assert first == second  # byte-identical verdict, no re-run
+        upstream = sum(len(s.received) for s in stubs)
+        assert upstream == 1
+        assert router._obs[
+            "router_idempotent_replays_total"].value == 1
+        # tenant-scoped: another tenant's identical key re-executes
+        _, replay3 = post("k1", tenant="other")
+        assert replay3 is None
+        assert sum(len(s.received) for s in stubs) == 2
+    finally:
+        httpd.shutdown()
+
+
+def test_idempotency_concurrent_duplicates_wait(stubs, tmp_path):
+    """Two in-flight requests under one key → ONE upstream execution;
+    the second waits for (and returns) the first's verdict."""
+    a, b = stubs
+    a.delay_s = b.delay_s = 0.4
+    router = _router_for(stubs, tmp_path, hedge=False)
+    httpd, url = _serve(router)
+    try:
+        results = []
+
+        def post():
+            req = urllib.request.Request(
+                url + "/v1/generate",
+                data=json.dumps({"prompts": ["c"],
+                                 "max_new_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Idempotency-Key": "race"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                results.append(json.loads(resp.read()))
+        threads = [threading.Thread(target=post) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == 2 and results[0] == results[1]
+        assert sum(len(s.received) for s in stubs) == 1
+    finally:
+        httpd.shutdown()
+
+
+def test_idempotency_cache_never_pins_failures():
+    """Unit: non-2xx verdicts are not cached — the retry re-executes;
+    2xx verdicts replay inside the window."""
+    cache = IdempotencyCache(window_s=60)
+    calls = []
+
+    def failing():
+        calls.append(1)
+        return (502, {"error": "ambiguous"}, ())
+
+    r1, replayed1 = cache.execute("k", failing)
+    r2, replayed2 = cache.execute("k", failing)
+    assert r1[0] == r2[0] == 502
+    assert not replayed1 and not replayed2
+    assert len(calls) == 2  # both executed
+
+    def ok():
+        calls.append(1)
+        return (200, {"completions": []}, ())
+
+    r3, replayed3 = cache.execute("k", ok)
+    r4, replayed4 = cache.execute("k", ok)
+    assert not replayed3 and replayed4
+    assert r3 == r4
+    assert len(calls) == 3  # the 200 executed once
+
+
+# -- the invariant checker's true positives ----------------------------------
+
+
+def test_check_stream_tokens_true_positives():
+    """A deliberately broken splice MUST fail the checker, with the
+    failure classified (the acceptance criterion's true-positive)."""
+    e = [5, 6, 7, 8, 9, 10]
+    assert check_stream_tokens(e, e)["ok"]
+    # off-by-one duplicate at the splice (overlap not stripped)
+    dup = e[:3] + [e[2]] + e[3:]
+    out = check_stream_tokens(e, dup)
+    assert not out["ok"] and "duplicated" in out["violations"][0]
+    # off-by-one skip at the splice
+    miss = e[:3] + e[4:]
+    out = check_stream_tokens(e, miss)
+    assert not out["ok"] and "missing" in out["violations"][0]
+    # truncated tail (stream never finished)
+    out = check_stream_tokens(e, e[:4])
+    assert not out["ok"] and "missing" in out["violations"][0]
+    # extra tokens past the control
+    out = check_stream_tokens(e, e + [11])
+    assert not out["ok"] and "extra" in out["violations"][0]
+    # divergence
+    out = check_stream_tokens(e, [5, 6, 99, 98, 97, 96])
+    assert not out["ok"] and "diverges" in out["violations"][0]
+
+
+def test_synth_kill_mid_stream_schedule_deterministic():
+    s1 = synth_chaos("kill_mid_stream", seed=9, duration_s=10.0,
+                     replicas=2)
+    s2 = synth_chaos("kill_mid_stream", seed=9, duration_s=10.0,
+                     replicas=2)
+    assert [e.to_dict() for e in s1.events] == \
+        [e.to_dict() for e in s2.events]
+    assert s1.meta.get("streaming") is True
+    (kill,) = s1.events
+    assert kill.action == "kill" and kill.restart_s
+    # pinned offset override (the test/smoke knob)
+    s3 = synth_chaos("kill_mid_stream", seed=9, duration_s=10.0,
+                     replicas=2, kill_at_s=3.25, victim=1)
+    assert s3.events[0].offset_s == 3.25
+    assert s3.events[0].target == "replica:1"
+
+
+# -- slow: the real thing (localfleet SIGKILL mid-stream) --------------------
+
+
+@pytest.mark.slow
+def test_kill_mid_stream_token_exact_over_localfleet(tmp_path):
+    """Satellite 3: SIGKILL the streaming replica of a real 2-replica
+    CPU fleet after >=4 emitted tokens — the client's assembled stream
+    must be token-identical to an uninterrupted control run, reach
+    [DONE] with zero error terminals, and the surviving replica's
+    /traces must close every request span with exactly one terminal
+    (the PR 9 recorder)."""
+    from pyspark_tf_gke_tpu.chaos.invariants import check_traces
+    from pyspark_tf_gke_tpu.router.localfleet import LocalFleet
+
+    prompt = "kill mid stream localfleet "
+    max_new = 28
+    trace_args = ("--trace-sample", "1.0", "--trace-slow-ms", "0")
+    slow = ("--chaos", "engine.device_step:slow%1:0.08")
+
+    def stream(url, fleet=None, kill_after=None):
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            data=json.dumps({"prompts": [prompt], "stream": True,
+                             "max_new_tokens": max_new}).encode(),
+            headers={"Content-Type": "application/json"})
+        toks, done, errs, killed = [], False, [], False
+        with urllib.request.urlopen(req, timeout=240) as resp:
+            for raw in resp:
+                line = raw.decode("utf-8", errors="replace").strip()
+                if not line.startswith("data: "):
+                    continue
+                data = line[len("data: "):]
+                if data == "[DONE]":
+                    done = True
+                    break
+                ev = json.loads(data)
+                if "error" in ev:
+                    errs.append(ev["error"])
+                toks.extend(int(t) for t in ev.get("token_ids") or [])
+                if (kill_after is not None and not killed
+                        and len(toks) >= kill_after):
+                    killed = True
+                    with urllib.request.urlopen(
+                            fleet.url + "/healthz", timeout=10) as r:
+                        snap = json.loads(r.read())["replicas"]
+                    busy = [x["replica"] for x in snap
+                            if x.get("inflight")]
+                    assert busy, snap
+                    fleet.kill_replica(
+                        fleet.replica_urls.index(busy[0]))
+        return toks, done, errs
+
+    with LocalFleet(2, router_args=trace_args,
+                    replica_args=(*trace_args, *slow)) as fleet:
+        fleet.warm()
+        control, done, errs = stream(fleet.url)
+        assert done and not errs and len(control) >= 8
+        got, done, errs = stream(fleet.url, fleet=fleet, kill_after=4)
+        assert done, "kill run never reached [DONE]"
+        assert not errs, errs
+        verdict = check_stream_tokens(control, got)
+        assert verdict["ok"], verdict["violations"]
+        # exactly-one-terminal spans on the SURVIVING replica's
+        # recorder (the killed one took its ring with it)
+        survivors = [u for i, u in enumerate(fleet.replica_urls)
+                     if fleet.procs[i].poll() is None]
+        assert survivors
+        for u in survivors:
+            with urllib.request.urlopen(u + "/traces?n=256",
+                                        timeout=10) as resp:
+                traces = json.loads(resp.read())
+            closure = check_traces(traces)
+            assert closure["ok"], closure["violations"]
+            assert closure["request_spans"] > 0

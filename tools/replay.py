@@ -140,7 +140,8 @@ def cmd_run(args) -> int:
         report = replay_spec(spec, url, speedup=args.speedup,
                              stream=not args.no_stream,
                              timeout_s=args.timeout,
-                             include_requests=args.include_requests)
+                             include_requests=args.include_requests,
+                             resume_max=args.resume_max)
         if calibration is not None:
             report["calibration"] = calibration
         if slo is not None:
@@ -352,6 +353,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "replicas (e.g. 'light=3,flood=1:60:120')")
     rn.add_argument("--speedup", type=float, default=1.0)
     rn.add_argument("--timeout", type=float, default=120.0)
+    rn.add_argument("--resume-max", type=int, default=0,
+                    help="client-side stream resumes per request: a "
+                         "stream cut mid-flight reconnects with "
+                         "Last-Event-ID + X-Request-Id and the router "
+                         "replays the journaled tail (0 = legacy "
+                         "one-shot; the report's stream_resumes counts "
+                         "reconnects used)")
     rn.add_argument("--no-stream", action="store_true",
                     help="blocking requests (no TTFT/TBT capture)")
     rn.add_argument("--slo",

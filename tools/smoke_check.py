@@ -82,10 +82,18 @@ step_host_overhead_frac`` in [0, 1], and ``POST /admin/profile``
 must 403 on a token-unconfigured server (the /admin/reload
 discipline).
 
+``--failover-stream`` checks the mid-stream failover contract live
+(docs/SERVING.md "Stream failover & resume"): SIGKILL the replica
+actually holding a streaming generation after >=4 emitted tokens —
+the client's stream must still reach ``[DONE]`` with zero error
+terminals and be TOKEN-IDENTICAL to an uninterrupted control run
+(the router's journal + continuation splice), with exactly one
+``router_stream_resumes_total{outcome="ok"}`` on the router.
+
 Usage: python tools/smoke_check.py
        [--lint-only|--kernels-only|--serve-lifecycle|--serve-tbt|
         --router|--prefix-cache|--spec-serve|--fairness|--pipeline|
-        --trace|--replay|--stepstats]
+        --trace|--replay|--stepstats|--failover-stream]
 """
 
 import os
@@ -248,7 +256,16 @@ def lint_duplicate_metrics() -> int:
                 "serve_step_host_overhead_ms",
                 "serve_step_phase_ms",
                 "serve_device_idle_fraction",
-                "serve_mfu"}
+                "serve_mfu",
+                # mid-stream failover: the smoke gate
+                # (--failover-stream), the chaos streaming-mix bench
+                # and docs/OBSERVABILITY.md's resume vocabulary read
+                # these — a rename must fail here first
+                "router_stream_resumes_total",
+                "router_stream_tokens_replayed_total",
+                "router_stream_journal_entries",
+                "router_stream_journal_tokens",
+                "router_idempotent_replays_total"}
     absent = {n for n in required if n not in _REGISTRATIONS}
     if absent:
         print("metric lint FAILED — required metric name(s) never "
@@ -1917,10 +1934,120 @@ def chaos_check(grace_s: float = 30.0) -> int:
     return 0
 
 
+def failover_stream_check(grace_s: float = 30.0) -> int:
+    """``--failover-stream``: mid-stream replica death is invisible to
+    the client, live. 2 tiny CPU replicas + the real router; decode is
+    slowed via chaos injection (``engine.device_step:slow%1``) so a
+    stream takes seconds:
+
+    1. CONTROL: one long greedy streamed generation, uninterrupted —
+       capture its token-id sequence.
+    2. KILL RUN: the same request; after ≥4 tokens arrive, SIGKILL the
+       replica actually holding the stream (read from the router's
+       /healthz in-flight snapshot). The client's stream must still
+       reach ``[DONE]`` with ZERO error terminals, and the assembled
+       token ids must be token-identical to the control
+       (``chaos.invariants.check_stream_tokens``) — the router's
+       journal + continuation splice at work.
+    3. the router's /metrics must show exactly one
+       ``router_stream_resumes_total{outcome="ok"}``.
+    """
+    import json as _json
+    import time as _time
+    import urllib.request
+
+    from pyspark_tf_gke_tpu.chaos.invariants import check_stream_tokens
+    from pyspark_tf_gke_tpu.router.localfleet import LocalFleet
+
+    prompt = "failover stream check "  # 22 byte-tokens
+    max_new = 30                       # 22 + 30 < max_seq_len 64
+
+    def stream_tokens(url, kill_after=None, fleet=None):
+        """Stream one generation; returns (token_ids, saw_done,
+        error_events, events). ``kill_after``: SIGKILL the replica
+        holding the stream once this many tokens arrived."""
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            data=_json.dumps({"prompts": [prompt], "stream": True,
+                              "max_new_tokens": max_new}).encode(),
+            headers={"Content-Type": "application/json"})
+        toks, done, errs, events, killed = [], False, [], [], False
+        with urllib.request.urlopen(req, timeout=240) as resp:
+            for raw in resp:
+                line = raw.decode("utf-8", errors="replace").strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    done = True
+                    break
+                ev = _json.loads(payload)
+                events.append(ev)
+                if "error" in ev:
+                    errs.append(ev["error"])
+                toks.extend(int(t) for t in ev.get("token_ids") or [])
+                if (kill_after is not None and not killed
+                        and len(toks) >= kill_after):
+                    killed = True
+                    _kill_streaming_replica(fleet)
+        return toks, done, errs, events
+
+    def _kill_streaming_replica(fleet):
+        with urllib.request.urlopen(fleet.url + "/healthz",
+                                    timeout=10) as resp:
+            snap = _json.loads(resp.read())["replicas"]
+        busy = [r["replica"] for r in snap if r.get("inflight")]
+        assert busy, f"no replica shows the stream in flight: {snap}"
+        victim = fleet.replica_urls.index(busy[0])
+        print(f"  SIGKILL {busy[0]} (replica {victim}) mid-stream...")
+        fleet.kill_replica(victim)
+
+    slow = ("--chaos", "engine.device_step:slow%1:0.08")
+    print("failover-stream check: 2 CPU replicas + router, decode "
+          "slowed 80ms/step, SIGKILL the streaming replica after "
+          ">=4 tokens...")
+    with LocalFleet(2, replica_args=slow, quiet=False) as fleet:
+        fleet.warm()
+        control, done, errs, _ = stream_tokens(fleet.url)
+        assert done and not errs, (done, errs)
+        assert len(control) >= 8, f"control too short: {len(control)}"
+        print(f"  control run: {len(control)} tokens, [DONE] clean")
+
+        got, done, errs, events = stream_tokens(
+            fleet.url, kill_after=4, fleet=fleet)
+        assert done, "kill run never reached [DONE]"
+        assert not errs, f"error terminal(s) surfaced: {errs}"
+        verdict = check_stream_tokens(control, got)
+        assert verdict["ok"], (
+            f"splice not token-exact: {verdict['violations']}")
+        terminal = events[-1]
+        assert terminal.get("done") and terminal.get("resumed"), terminal
+        assert terminal.get("new_tokens") == len(control), terminal
+        assert terminal.get("prompt") == prompt, terminal
+
+        deadline = _time.time() + grace_s
+        metric_ok = False
+        while _time.time() < deadline and not metric_ok:
+            with urllib.request.urlopen(fleet.url + "/metrics",
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+            metric_ok = ('router_stream_resumes_total{outcome="ok"} 1'
+                         in text)
+            if not metric_ok:
+                _time.sleep(0.5)
+        assert metric_ok, "router_stream_resumes_total{outcome=ok} != 1"
+    print(f"failover-stream OK: {len(got)} tokens token-identical to "
+          "the control through a mid-stream SIGKILL, [DONE] reached, "
+          "zero error terminals, one spliced resume on /metrics")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--kernels-only" in argv:
         return kernel_interpret_sweep()
+    if "--failover-stream" in argv:
+        return failover_stream_check()
     if "--chaos" in argv:
         return chaos_check()
     if "--serve-lifecycle" in argv:
