@@ -2083,6 +2083,56 @@ def bench_replay(smoke: bool = False) -> dict:
     }
 
 
+def _chaos_alert_timeline(router_url: str, t0_wall: float,
+                          kill_at: float, restart_after: float) -> dict:
+    """Fold the router watchtower's ``/alertz`` transition history into
+    a trail-ready alert timeline: fire/resolve offsets (seconds from
+    the chaos schedule's start anchor) and the measured detection /
+    resolve latencies for the ``replica_down`` alert the SIGKILL must
+    trip. Polls briefly so the resolve (restart re-admission +
+    --alert-clear) can land after the replay's tail."""
+    import urllib.request
+
+    firing: list = ["?"]
+    body: dict = {}
+    deadline = time.time() + 20.0
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(router_url + "/alertz?n=256",
+                                        timeout=5) as resp:
+                body = json.loads(resp.read())
+        except OSError:
+            break
+        firing = [n for n in body.get("firing", [])
+                  if n.startswith("replica_down:")]
+        if not firing:
+            break
+        time.sleep(0.5)
+    events = []
+    fire_off = resolve_off = None
+    for rec in body.get("history", []):
+        if not rec["alert"].startswith("replica_down:"):
+            continue
+        off = round(rec["wall"] - t0_wall, 3)
+        events.append({"alert": rec["alert"], "to": rec["to"],
+                       "offset_s": off})
+        if rec["to"] == "firing" and fire_off is None:
+            fire_off = off
+        if rec["to"] == "resolved":
+            resolve_off = off
+    return {
+        "events": events,
+        "fired_offset_s": fire_off,
+        "resolved_offset_s": resolve_off,
+        "detection_latency_s": (round(fire_off - kill_at, 3)
+                                if fire_off is not None else None),
+        "resolve_latency_s": (
+            round(resolve_off - (kill_at + restart_after), 3)
+            if resolve_off is not None else None),
+        "still_firing": firing,
+    }
+
+
 def bench_chaos(smoke: bool = False, stream_mix: bool = False) -> dict:
     """``python bench.py chaos``: goodput recovery after a replica kill
     during a flash-crowd replay — the chaos plane's headline scenario
@@ -2155,17 +2205,26 @@ def bench_chaos(smoke: bool = False, stream_mix: bool = False) -> dict:
         replica_args = ("--continuous-slots", "1",
                         "--max-queue-depth", "6")
     trace_args = ("--trace-sample", "1.0", "--trace-slow-ms", "0")
+    # fleet watchtower knobs, tightened so the replica_down alert's
+    # full fire -> resolve cycle fits inside the bench run: the trail
+    # entry commits the measured detection latency (ISSUE 16's
+    # chaos-native acceptance evidence)
+    alert_args = ("--probe-interval", "0.3", "--alert-for", "0",
+                  "--alert-clear", "2")
     router_resumes = None
-    with LocalFleet(2, router_args=trace_args,
+    with LocalFleet(2, router_args=(*trace_args, *alert_args),
                     replica_args=(*trace_args, *replica_args)) as fleet:
         fleet.warm()
         runner = ScheduleRunner(schedule, fleet)
+        t0_wall = time.time()  # the runner's offset anchor, wall-clock
         with runner:
             report = replay_spec(spec, fleet.url, speedup=1.0,
                                  include_requests=True)
         closure = check_report(report, len(spec.requests))
         fleet.wait_idle(timeout_s=60)
         invariants = [check_replica(u) for u in fleet.replica_urls]
+        alert_timeline = _chaos_alert_timeline(fleet.url, t0_wall,
+                                               kill_at, restart_after)
         if stream_mix:
             # how many mid-stream deaths the router actually spliced
             # over — the non-vacuousness proof next to goodput 1.0
@@ -2196,6 +2255,10 @@ def bench_chaos(smoke: bool = False, stream_mix: bool = False) -> dict:
         "pre_kill_ok_rate": pre["ok_rate"],
         "outage_ok_rate": outage["ok_rate"],
         "chaos_actions": runner.actions,
+        # the watchtower's view of the same scenario: replica_down
+        # fire/resolve offsets on the schedule's clock -> the measured
+        # alert detection latency, committed with the goodput evidence
+        "alert_timeline": alert_timeline,
         "terminal_closure": closure,
         "replica_invariants": invariants,
         "schedule": {"name": schedule.name, "seed": schedule.seed,

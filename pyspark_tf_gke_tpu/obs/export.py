@@ -94,16 +94,20 @@ def handle_obs_request(
         event_log: Optional[EventLog] = None,
         extra_exposition: str = "",
         tracer=None,
-        stepstats=None) -> Optional[Tuple[int, str, bytes]]:
+        stepstats=None,
+        watchtower=None) -> Optional[Tuple[int, str, bytes]]:
     """GET dispatch for the observability endpoints.
 
     Returns ``(status, content_type, body)`` for ``/metrics``,
     ``/metrics.json``, ``/events[?n=N]``, (when ``tracer`` — an
     ``obs.trace.TraceRecorder`` — is provided)
-    ``/traces[?slow_ms=F&trace_id=HEX&n=N]`` and (when ``stepstats``
+    ``/traces[?slow_ms=F&trace_id=HEX&n=N]``, (when ``stepstats``
     — an ``obs.stepstats.StepStatsRing`` — is provided)
-    ``/stepz[?n=N&min_ms=F]``, or ``None`` for paths this module
-    doesn't own (caller falls through to its own routes).
+    ``/stepz[?n=N&min_ms=F]`` and (when ``watchtower`` — a
+    ``router.watchtower.Watchtower`` — is provided)
+    ``/fleetz[?n=N&replica=SUBSTR]`` +
+    ``/alertz[?state=S&name=SUBSTR&n=N]``, or ``None`` for paths this
+    module doesn't own (caller falls through to its own routes).
     ``extra_exposition`` is appended verbatim to ``/metrics`` — the
     serving front uses it for its legacy-name alias block.
     """
@@ -182,5 +186,49 @@ def handle_obs_request(
         body = json.dumps({"summary": stepstats.summary(),
                            "steps": stepstats.snapshot(n=n,
                                                        min_ms=min_ms)})
+        return 200, "application/json", body.encode()
+    if route == "/fleetz" and watchtower is not None:
+        # the fleet snapshot ring (router/watchtower.py): newest
+        # rollup + per-replica records, bounded history of rollups.
+        # This payload's key set is the autopilot/HPA input contract —
+        # docs/OBSERVABILITY.md "Fleet watchtower".
+        n = 32
+        replica = None
+        for part in query.split("&"):
+            key, _, val = part.partition("=")
+            try:
+                if key == "n" and val:
+                    n = max(1, min(int(val), 1024))
+                elif key == "replica" and val:
+                    replica = val
+            except ValueError:
+                return (400, "application/json",
+                        b'{"error": "bad /fleetz query parameter"}')
+        body = json.dumps(watchtower.fleetz(n=n, replica=replica))
+        return 200, "application/json", body.encode()
+    if route == "/alertz" and watchtower is not None:
+        # live alert plane: configured SLO + windows, every alert's
+        # state-machine record, burn-rate table, transition history
+        state = name = None
+        n = 64
+        for part in query.split("&"):
+            key, _, val = part.partition("=")
+            try:
+                if key == "state" and val:
+                    if val not in ("ok", "pending", "firing",
+                                   "resolved"):
+                        return (400, "application/json",
+                                b'{"error": "state must be ok|pending'
+                                b'|firing|resolved"}')
+                    state = val
+                elif key == "name" and val:
+                    name = val
+                elif key == "n" and val:
+                    n = max(1, min(int(val), 1024))
+            except ValueError:
+                return (400, "application/json",
+                        b'{"error": "bad /alertz query parameter"}')
+        body = json.dumps(watchtower.alertz(state=state, name=name,
+                                            n=n))
         return 200, "application/json", body.encode()
     return None

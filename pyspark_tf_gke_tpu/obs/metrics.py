@@ -242,6 +242,37 @@ class Gauge(_Metric):
         return self.value
 
 
+def estimate_quantile(buckets: Sequence[float], counts: Sequence[int],
+                      q: float) -> Optional[float]:
+    """Estimate quantile ``q`` from histogram bucket counts, Prometheus
+    ``histogram_quantile`` style: linear interpolation within the
+    bucket the target rank lands in (lower bound 0 for the first
+    bucket). A rank landing in the +Inf bucket returns the last finite
+    upper bound — the honest answer is "at least this". ``counts`` are
+    per-bucket (non-cumulative), aligned with ``buckets``; returns
+    None when there are no observations."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0
+    for i, (ub, c) in enumerate(zip(buckets, counts)):
+        prev_cum = cum
+        cum += c
+        if cum >= target:
+            if ub == math.inf:
+                # can't interpolate into an unbounded bucket
+                finite = [b for b in buckets if b != math.inf]
+                return round(finite[-1], 3) if finite else None
+            lo = buckets[i - 1] if i > 0 else 0.0
+            if c <= 0:
+                return round(ub, 3)
+            frac = (target - prev_cum) / c
+            return round(lo + (ub - lo) * frac, 3)
+    finite = [b for b in buckets if b != math.inf]
+    return round(finite[-1], 3) if finite else None
+
+
 class Histogram(_Metric):
     """Cumulative-bucket histogram (Prometheus semantics): ``observe``
     adds to every bucket whose upper bound is >= the value, plus
@@ -331,6 +362,14 @@ class Histogram(_Metric):
         out = {"count": n, "sum": total,
                "buckets": {_format_value(ub): c
                            for ub, c in zip(self.buckets, counts)}}
+        if n > 0:
+            # server-side quantile estimates (bucket interpolation) so
+            # /metrics.json consumers stop re-deriving them ad hoc;
+            # the Prometheus text exposition is byte-identical
+            out["quantiles"] = {
+                f"p{int(q * 100)}": estimate_quantile(
+                    self.buckets, counts, q)
+                for q in (0.5, 0.95, 0.99)}
         if exemplars:
             # per-bucket last trace id (keyed by the bucket's upper
             # bound) — join a tail bucket to its trace in GET /traces
@@ -819,6 +858,32 @@ def router_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "Non-streamed generates answered from the X-Idempotency-Key "
             "window instead of re-executing (a client retry after an "
             "ambiguous verdict cannot double-generate)"),
+        # -- fleet watchtower (router/watchtower.py — docs/
+        # OBSERVABILITY.md "Fleet watchtower"): continuous SLO
+        # evaluation + burn-rate alerting over the probe sweep
+        "router_slo_burn_rate": r.gauge(
+            "router_slo_burn_rate",
+            "Error-budget burn rate per SLO key per sliding window "
+            "(1.0 = spending budget exactly at the allowed rate; the "
+            "replay/slo.py vocabulary evaluated live)",
+            labelnames=("slo", "window")),
+        "router_alerts_firing": r.gauge(
+            "router_alerts_firing",
+            "1 while the named alert is in the firing state, else 0 "
+            "(burn-rate SLO alerts plus structural replica_down ones)",
+            labelnames=("alert",)),
+        "router_alert_transitions_total": r.counter(
+            "router_alert_transitions_total",
+            "Alert state-machine transitions by alert name and "
+            "entered state (ok | pending | firing | resolved)",
+            labelnames=("alert", "state")),
+        "router_fleet_snapshots_total": r.counter(
+            "router_fleet_snapshots_total",
+            "Probe sweeps folded into the fleet snapshot ring"),
+        "router_fleet_snapshot_buckets": r.gauge(
+            "router_fleet_snapshot_buckets",
+            "Time buckets currently resident in the fleet snapshot "
+            "ring (bounded by the ring's maxlen)"),
     }
 
 

@@ -102,10 +102,15 @@ def flops_per_token(cfg, context_len: Optional[int] = None) -> float:
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
-    return sorted_vals[idx]
+    """Nearest-rank percentile (0.0 when empty), delegated to
+    ``replay/stats.pct`` — the ONE implementation site. Imported
+    lazily: a module-level import would pull ``replay/__init__`` (and
+    through it ``obs.trace``) while ``obs/__init__`` is itself still
+    initializing."""
+    from pyspark_tf_gke_tpu.replay.stats import pct
+
+    v = pct(list(sorted_vals), q)
+    return 0.0 if v is None else v
 
 
 class StepRecord:

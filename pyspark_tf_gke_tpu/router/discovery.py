@@ -354,13 +354,18 @@ class HealthProber:
     def __init__(self, replicas: ReplicaSet, interval_s: float = 1.0,
                  timeout_s: float = 2.0, fail_threshold: int = 2,
                  dns_refresh: Optional[Callable[[], List[Replica]]] = None,
-                 dns_every: int = 10):
+                 dns_every: int = 10,
+                 on_sweep: Optional[Callable[[], None]] = None):
         self.replicas = replicas
         self.interval_s = float(interval_s)
         self.timeout_s = float(timeout_s)
         self.fail_threshold = max(1, int(fail_threshold))
         self._dns_refresh = dns_refresh
         self._dns_every = max(1, int(dns_every))
+        # called once per completed sweep (fresh /loadz in hand) — the
+        # watchtower's aggregation + alert-evaluation tick rides here
+        # so fleet telemetry costs zero extra replica HTTP
+        self._on_sweep = on_sweep
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop,
                                         name="router-prober", daemon=True)
@@ -383,17 +388,22 @@ class HealthProber:
         if len(reps) <= 1:
             for r in reps:
                 self._probe_one(r)
-            self.replicas.update_autoscale()
-            return
-        threads = [threading.Thread(target=self._probe_one, args=(r,),
-                                    name=f"router-probe-{i}", daemon=True)
-                   for i, r in enumerate(reps)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=self.timeout_s + 5.0)
+        else:
+            threads = [threading.Thread(
+                target=self._probe_one, args=(r,),
+                name=f"router-probe-{i}", daemon=True)
+                for i, r in enumerate(reps)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=self.timeout_s + 5.0)
         # fold the fresh sweep into the closed-loop autoscale gauges
         self.replicas.update_autoscale()
+        if self._on_sweep is not None:
+            try:
+                self._on_sweep()
+            except Exception as exc:  # a sick hook must not kill probing
+                logger.warning("on_sweep hook failed: %s", exc)
 
     def _probe_one(self, r: Replica) -> None:
         try:

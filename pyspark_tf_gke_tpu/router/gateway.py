@@ -84,6 +84,11 @@ from pyspark_tf_gke_tpu.router.discovery import (
     resolve_dns_replicas,
 )
 from pyspark_tf_gke_tpu.router.policy import affinity_key, choose_replica
+from pyspark_tf_gke_tpu.router.watchtower import (
+    DEFAULT_ALERT_WINDOWS,
+    Watchtower,
+    parse_slo_spec,
+)
 from pyspark_tf_gke_tpu.utils.logging import get_logger
 
 logger = get_logger("router.gateway")
@@ -130,7 +135,11 @@ class RouterServer:
                  idempotency_max: int = 1024,
                  registry=None, event_log=None,
                  trace_sample: float = 0.01,
-                 trace_slow_ms: float = 1000.0):
+                 trace_slow_ms: float = 1000.0,
+                 slo: Optional[dict] = None,
+                 alert_windows: str = DEFAULT_ALERT_WINDOWS,
+                 alert_for_s: float = 0.0,
+                 alert_clear_s: float = 30.0):
         self.registry = registry if registry is not None else get_registry()
         self._obs = router_families(self.registry)
         self.event_log = (event_log if event_log is not None
@@ -144,6 +153,16 @@ class RouterServer:
             counter=self._obs["router_traces_recorded_total"])
         self.replicas = ReplicaSet(replicas, obs=self._obs,
                                    event_log=self.event_log)
+        # fleet watchtower: continuous SLO evaluation + burn-rate
+        # alerting (router/watchtower.py). Always constructed — the
+        # structural replica_down alerts and the /fleetz snapshot ring
+        # need no --slo spec; the burn-rate engine activates when one
+        # is given. Aggregation rides the prober's on_sweep hook
+        # (wired in main(); tests call watchtower.sweep() directly).
+        self.watchtower = Watchtower(
+            self.replicas, slo=slo, windows=alert_windows,
+            for_s=alert_for_s, clear_s=alert_clear_s,
+            obs=self._obs, event_log=self.event_log)
         self.affinity_tokens = int(affinity_tokens)
         self.inflight_cap = int(inflight_cap)
         self.hedge_enabled = bool(hedge)
@@ -361,6 +380,9 @@ class RouterServer:
                 if span is not None:
                     span.event("shed", reason="no_replicas")
                 self._count("none", "shed")
+                self.watchtower.note_request(
+                    (time.perf_counter() - t0) * 1000.0, "shed", tenant)
+                self.watchtower.note_shed("no_replicas")
                 return 503, {"error": "no routable replica",
                              "reason": "no_replicas"}, (
                                  ("Retry-After", "1"),)
@@ -381,15 +403,19 @@ class RouterServer:
             dt_ms, exemplar=(span.trace_id if span is not None else None))
         if 200 <= status < 300:
             self.latency.observe(dt_ms)
-            self._count(terminal_rid, "ok")
+            outcome = "ok"
         elif status in (429, 503):
-            self._count(terminal_rid, "shed")
+            outcome = "shed"
+            self.watchtower.note_shed(
+                out.get("reason") if isinstance(out, dict) else None)
         elif status == 502:
-            self._count(terminal_rid, "unreachable")
+            outcome = "unreachable"
         elif 400 <= status < 500:
-            self._count(terminal_rid, "client_error")
+            outcome = "client_error"
         else:
-            self._count(terminal_rid, "upstream_error")
+            outcome = "upstream_error"
+        self._count(terminal_rid, outcome)
+        self.watchtower.note_request(dt_ms, outcome, tenant)
         return status, out, hdrs
 
     def route_idempotent(self, idem_key: str, req: dict,
@@ -845,6 +871,10 @@ class RouterServer:
             # Prometheus families expose continuously
             "autoscale": autoscale,
             "tenants_inflight": tenants,
+            # watchtower heartbeat: alerts currently firing, in the
+            # readiness payload an operator already polls (full detail
+            # on GET /alertz)
+            "alerts_firing": self.watchtower.alertz()["firing"],
         }
 
 
@@ -888,6 +918,10 @@ class _StreamRelay:
         self.entry = None
         self.resumes = 0
         self.emitted_tokens = 0
+        # watchtower timing: stream accept -> first token event is the
+        # router-side TTFT; gaps between token events are TBT samples
+        self._t0 = time.perf_counter()
+        self._last_token_t: Optional[float] = None
         self.leg_validated = True  # first leg needs no splice check
         prompts = req.get("prompts")
         prompt = (prompts[0] if isinstance(prompts, list) and prompts
@@ -942,10 +976,17 @@ class _StreamRelay:
                 ts = call.header("X-Tenant-Shed")
                 if ts is not None:
                     hdrs += (("X-Tenant-Shed", ts),)
-                router._count(replica.rid,
-                              "shed" if call.status in (429, 503)
-                              else "client_error" if call.status < 500
-                              else "upstream_error")
+                outcome = ("shed" if call.status in (429, 503)
+                           else "client_error" if call.status < 500
+                           else "upstream_error")
+                router._count(replica.rid, outcome)
+                if outcome == "shed":
+                    router.watchtower.note_shed(
+                        out.get("reason") if isinstance(out, dict)
+                        else None)
+                router.watchtower.note_request(
+                    (time.perf_counter() - self._t0) * 1000.0, outcome,
+                    router.tenant_of(self.req, self.tenant))
                 return handler._reply(call.status, out, headers=hdrs)
             finally:
                 router.replicas.untrack(replica.rid, tokens)
@@ -979,6 +1020,10 @@ class _StreamRelay:
             router.replicas.untrack(replica.rid, tokens)
             call.cancel()
             router._count(replica.rid, "client_disconnect")
+            router.watchtower.note_request(
+                (time.perf_counter() - self._t0) * 1000.0,
+                "client_disconnect",
+                router.tenant_of(self.req, self.tenant))
             return
         self._write_raw(f": trace_id={rid}\n\n".encode())
         deadline_ms = self.req.get("deadline_ms")
@@ -1015,6 +1060,7 @@ class _StreamRelay:
                 call.close()
                 router._obs["router_stream_resumes_total"].labels(
                     outcome="failed").inc()
+                router.watchtower.note_stream_resume("failed")
                 last_error = str(exc)
                 if dead_rid is not None:
                     terminal_rid = dead_rid
@@ -1062,6 +1108,9 @@ class _StreamRelay:
             self.span.event("client_disconnect",
                             emitted_tokens=self.emitted_tokens)
         router._count(terminal_rid, outcome)
+        router.watchtower.note_request(
+            (time.perf_counter() - self._t0) * 1000.0, outcome,
+            router.tenant_of(self.req, self.tenant))
 
     def _relay_leg(self, call: ReplicaCall, first_lines) -> None:
         """Relay one upstream leg to its ``[DONE]``. Raises
@@ -1126,6 +1175,14 @@ class _StreamRelay:
             self._write_event(payload)
             return
         if toks:
+            now = time.perf_counter()
+            if self.emitted_tokens == 0:
+                self.router.watchtower.note_ttft(
+                    (now - self._t0) * 1000.0)
+            elif self._last_token_t is not None:
+                self.router.watchtower.note_tbt(
+                    (now - self._last_token_t) * 1000.0)
+            self._last_token_t = now
             self.emitted_tokens += len(toks)
             self._write_event(payload, token_ids=toks,
                               text=text if isinstance(text, str)
@@ -1147,6 +1204,7 @@ class _StreamRelay:
 
         def _note(outcome, **extra):
             res.labels(outcome=outcome).inc()
+            router.watchtower.note_stream_resume(outcome)
             router.event_log.emit(
                 "router_stream_resume", outcome=outcome,
                 failed=dead_rid, rid=self.entry.rid,
@@ -1274,7 +1332,8 @@ def _make_handler(router: RouterServer):
                 return self._reply(code, payload)
             out = handle_obs_request(self.path, router.registry,
                                      router.event_log,
-                                     tracer=router.tracer)
+                                     tracer=router.tracer,
+                                     watchtower=router.watchtower)
             if out is None:
                 return self._reply(404,
                                    {"error": f"unknown path {self.path}"})
@@ -1547,6 +1606,29 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default=float(e("ROUTER_DRAIN_TIMEOUT", "15")),
                    help="seconds SIGTERM waits before stopping the "
                         "accept loop (in-flight proxies finish)")
+    p.add_argument("--slo", default=e("ROUTER_SLO", ""),
+                   help="live SLO spec for the watchtower's burn-rate "
+                        "alerting: inline JSON or @path/to/slo.json, "
+                        "the replay/slo.py vocabulary unchanged (e.g. "
+                        "'{\"latency_p99_ms\": 2000, \"goodput_min\": "
+                        "0.99}'); empty = structural replica_down "
+                        "alerts only")
+    p.add_argument("--alert-windows",
+                   default=e("ROUTER_ALERT_WINDOWS",
+                             DEFAULT_ALERT_WINDOWS),
+                   help="burn-rate window pairs as short:long:burn "
+                        "seconds triples, comma-separated (SRE-workbook "
+                        "shape: a fast-burn pair pages quickly, a "
+                        "slow-burn pair catches sustained budget spend)")
+    p.add_argument("--alert-for", type=float,
+                   default=float(e("ROUTER_ALERT_FOR", "0")),
+                   help="seconds an alert condition must hold before "
+                        "pending -> firing (0 = fire on first "
+                        "confirmed evaluation tick)")
+    p.add_argument("--alert-clear", type=float,
+                   default=float(e("ROUTER_ALERT_CLEAR", "30")),
+                   help="seconds of quiet before firing -> resolved "
+                        "(hysteresis: flapping input fires once)")
     p.add_argument("--chaos", default=e("ROUTER_CHAOS", ""),
                    help="router-side fault injection over named fault "
                         "points (chaos/inject.py): e.g. "
@@ -1577,6 +1659,11 @@ def main(argv=None) -> int:
             chaos_install(injector)
             logger.warning("router chaos injection ACTIVE: %s",
                            injector.describe())
+    try:
+        slo = parse_slo_spec(args.slo)
+    except (ValueError, OSError) as exc:
+        print(f"bad --slo spec: {exc}", file=sys.stderr)
+        return 2
     replicas = parse_replica_list(args.replicas) if args.replicas else []
     dns_refresh = None
     if args.discover:
@@ -1596,11 +1683,17 @@ def main(argv=None) -> int:
         stream_journal_size=args.stream_journal,
         idempotency_window_s=args.idempotency_window,
         trace_sample=args.trace_sample,
-        trace_slow_ms=args.trace_slow_ms)
+        trace_slow_ms=args.trace_slow_ms,
+        slo=slo,
+        alert_windows=args.alert_windows,
+        alert_for_s=args.alert_for,
+        alert_clear_s=args.alert_clear)
     prober = HealthProber(
         router.replicas, interval_s=args.probe_interval,
         timeout_s=args.probe_timeout, fail_threshold=args.fail_threshold,
-        dns_refresh=dns_refresh)
+        dns_refresh=dns_refresh,
+        # the watchtower's aggregation + alert tick rides every sweep
+        on_sweep=router.watchtower.sweep)
     prober.probe_once()  # first sweep before accepting traffic
     prober.start()
     httpd = start_router_http_server(router, args.host, args.port)

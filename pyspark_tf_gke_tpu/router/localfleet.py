@@ -24,6 +24,8 @@ import time
 import urllib.request
 from typing import Optional, Sequence
 
+from pyspark_tf_gke_tpu.replay.stats import pct
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
@@ -239,11 +241,12 @@ def run_noisy_neighbor(url: str, *, light_requests: int = 10,
 
 
 def percentile(xs, q: float) -> float:
-    """Nearest-rank percentile of a latency list (0 when empty)."""
-    if not xs:
-        return 0.0
-    xs = sorted(xs)
-    return xs[min(len(xs) - 1, int(q * (len(xs) - 1)))]
+    """Nearest-rank percentile of a latency list (0 when empty).
+    Thin wrapper over ``replay/stats.pct`` — the ONE percentile
+    implementation site — keeping this module's historical empty-list
+    contract (0.0, not None)."""
+    v = pct(list(xs), q)
+    return 0.0 if v is None else v
 
 
 class LocalFleet:

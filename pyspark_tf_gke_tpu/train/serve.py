@@ -1788,6 +1788,11 @@ class BundleServer:
             # ROADMAP item-4 async refactor is A/B'd against it
             # (0.0 for whole-batch servers / before the first step)
             "step_host_overhead_frac": 0.0,
+            # windowed engine throughput from the same /stepz summary —
+            # the router watchtower's fleet rollup sums it
+            # (step_tokens_per_sec_total on GET /fleetz) without a
+            # second probe round-trip
+            "step_tokens_per_sec": 0.0,
         }
         if self._front is not None:
             stats = self._front.engine.stats
@@ -1827,6 +1832,8 @@ class BundleServer:
             # rounds it) — no second ring-lock pass per /loadz probe
             out["step_host_overhead_frac"] = (
                 stats["step_phases"]["host_overhead_frac"])
+            out["step_tokens_per_sec"] = (
+                stats["step_phases"].get("tokens_per_sec") or 0.0)
             tenants = {}
             for name, t in (stats.get("tenants") or {}).items():
                 tenants[name] = {"queued": t["queued"],

@@ -1,0 +1,529 @@
+"""Fleet watchtower: burn-rate math (closed form), the alert state
+machine (hysteresis), structural replica_down detection, the pinned
+/fleetz + /alertz contracts, snapshot-ring bounding, and the two
+satellite invariants (ONE percentile implementation, histogram
+quantile estimates without touching the text exposition)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from pyspark_tf_gke_tpu.obs.events import EventLog
+from pyspark_tf_gke_tpu.obs.export import handle_obs_request
+from pyspark_tf_gke_tpu.obs.metrics import (
+    Histogram,
+    MetricsRegistry,
+    estimate_quantile,
+    router_families,
+)
+from pyspark_tf_gke_tpu.router.discovery import (
+    DOWN,
+    UP,
+    Replica,
+    ReplicaSet,
+)
+from pyspark_tf_gke_tpu.router.watchtower import (
+    ALERT_KEYS,
+    ALERTZ_KEYS,
+    FLEET_ROLLUP_KEYS,
+    FLEETZ_KEYS,
+    REPLICA_SNAPSHOT_KEYS,
+    Watchtower,
+    parse_alert_windows,
+    parse_slo_spec,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def _replica_set(n=2, state=UP, load=None):
+    reps = []
+    for i in range(n):
+        r = Replica(rid=f"http://replica-{i}:8000",
+                    base_url=f"http://replica-{i}:8000")
+        r.state = state
+        r.load = dict(load or {"capacity_free": 100,
+                               "queue_delay_ms": 1.0,
+                               "prefix_hit_rate": 0.5,
+                               "spec_accept_rate": 0.25,
+                               "step_host_overhead_frac": 0.1,
+                               "step_tokens_per_sec": 50.0,
+                               "bundle_generation": 3,
+                               "queued": 1, "active": 2})
+        reps.append(r)
+    return ReplicaSet(reps)
+
+
+def _tower(rs=None, clock=None, **kw):
+    kw.setdefault("windows", "10:60:5")
+    kw.setdefault("clear_s", 30.0)
+    return Watchtower(rs if rs is not None else _replica_set(),
+                      clock=clock or FakeClock(), **kw)
+
+
+# -- burn-rate math (closed form) --------------------------------------------
+
+
+def test_latency_p99_burn_rate_closed_form():
+    """100 requests, 10 above the bound, p99 budget 0.01 -> the burn
+    rate is exactly (10/100)/0.01 = 10.0 in every covering window."""
+    clock = FakeClock()
+    w = _tower(clock=clock, slo={"latency_p99_ms": 100.0})
+    for i in range(100):
+        w.note_request(500.0 if i < 10 else 50.0, "ok")
+    burns = w.burn_rates()
+    assert burns == {"latency_p99_ms": {"10s": 10.0, "60s": 10.0}}
+    w.evaluate()
+    a = w.alertz()
+    assert a["firing"] == ["slo:latency_p99_ms"]
+    assert a["burn_rates"]["latency_p99_ms"]["10s"] == 10.0
+
+
+def test_goodput_burn_rate_closed_form():
+    """95 ok + 5 errors against goodput_min 0.99: bad fraction 0.05
+    over a 0.01 budget -> burn exactly 5.0; client-caused outcomes
+    are excluded from the denominator entirely."""
+    clock = FakeClock()
+    w = _tower(clock=clock, slo={"goodput_min": 0.99})
+    for _ in range(95):
+        w.note_request(10.0, "ok")
+    for _ in range(5):
+        w.note_request(10.0, "upstream_error")
+    for _ in range(50):  # excluded: the client's doing
+        w.note_request(10.0, "client_error")
+        w.note_request(10.0, "client_disconnect")
+    assert w.burn_rates()["goodput_min"]["10s"] == pytest.approx(5.0)
+    report = w.window_report(10.0)
+    assert report["goodput"] == pytest.approx(0.95)
+    assert report["outcomes"]["error"] == 5
+
+
+def test_ttft_burn_uses_first_event_timing():
+    clock = FakeClock()
+    w = _tower(clock=clock, slo={"ttft_p50_ms": 100.0})
+    for _ in range(10):
+        w.note_ttft(500.0)  # every sample over the bound
+    # bad fraction 1.0 over the p50 budget 0.5 -> burn 2.0
+    assert w.burn_rates()["ttft_p50_ms"]["10s"] == pytest.approx(2.0)
+
+
+def test_burn_below_threshold_does_not_fire():
+    clock = FakeClock()
+    w = _tower(clock=clock, slo={"latency_p99_ms": 100.0})
+    for i in range(100):  # 2% bad -> burn 2.0 < threshold 5
+        w.note_request(500.0 if i < 2 else 50.0, "ok")
+    w.evaluate()
+    assert w.alertz()["firing"] == []
+
+
+def test_min_samples_gate_blocks_thin_windows():
+    clock = FakeClock()
+    w = _tower(clock=clock, slo={"latency_p99_ms": 100.0},
+               min_samples=10)
+    for _ in range(5):  # 100% bad but only 5 samples
+        w.note_request(500.0, "ok")
+    w.evaluate()
+    assert w.alertz()["firing"] == []
+
+
+def test_sheds_max_is_a_hard_bound_with_burst_resolution():
+    clock = FakeClock()
+    w = _tower(clock=clock, slo={"sheds_max": 2}, clear_s=0.0)
+    for _ in range(3):
+        w.note_request(1.0, "shed")
+    w.evaluate()
+    assert w.alertz()["firing"] == ["slo:sheds_max"]
+    # the burst ages out of the short window -> condition clears
+    clock.advance(15.0)
+    w.evaluate()
+    assert w.alertz()["firing"] == []
+
+
+def test_windows_age_out_samples():
+    clock = FakeClock()
+    w = _tower(clock=clock, slo={"latency_p99_ms": 100.0})
+    for _ in range(100):
+        w.note_request(500.0, "ok")
+    assert w.burn_rates()["latency_p99_ms"]["10s"] == 100.0
+    clock.advance(61.0)
+    assert w.burn_rates()["latency_p99_ms"] == {"10s": 0.0, "60s": 0.0}
+
+
+# -- alert state machine -----------------------------------------------------
+
+
+def test_hysteresis_flapping_input_fires_once():
+    """Condition flaps on/off faster than clear_s: ONE firing, no
+    firestorm; it resolves only after a full quiet clear_s."""
+    clock = FakeClock()
+    rs = _replica_set(1)
+    w = _tower(rs=rs, clock=clock, clear_s=30.0)
+    rep = rs.all()[0]
+    w.sweep()  # seen UP -> eligible for replica_down
+    for flap in range(4):
+        rep.state = DOWN
+        w.evaluate()
+        clock.advance(2.0)
+        rep.state = UP
+        w.evaluate()
+        clock.advance(2.0)
+    a = w.alertz(name="replica_down")["alerts"][0]
+    assert a["state"] == "firing"
+    assert a["fire_count"] == 1
+    firings = [h for h in w.alertz()["history"] if h["to"] == "firing"]
+    assert len(firings) == 1
+    # sustained quiet -> resolved exactly once
+    rep.state = UP
+    clock.advance(31.0)
+    w.evaluate()
+    a = w.alertz(name="replica_down")["alerts"][0]
+    assert a["state"] == "resolved"
+    assert a["fire_count"] == 1
+
+
+def test_for_s_holds_pending_until_sustained():
+    clock = FakeClock()
+    rs = _replica_set(1)
+    w = _tower(rs=rs, clock=clock, for_s=5.0)
+    rep = rs.all()[0]
+    w.sweep()
+    rep.state = DOWN
+    w.evaluate()
+    assert w.alertz(name="replica_down")["alerts"][0]["state"] == "pending"
+    # a blip shorter than for_s never fires
+    rep.state = UP
+    w.evaluate()
+    assert w.alertz(name="replica_down")["alerts"][0]["state"] == "ok"
+    rep.state = DOWN
+    w.evaluate()
+    clock.advance(5.1)
+    w.evaluate()
+    assert w.alertz(name="replica_down")["alerts"][0]["state"] == "firing"
+
+
+def test_replica_down_true_positive_within_one_tick(tmp_path):
+    """The chaos contract in miniature: a replica seen UP goes DOWN ->
+    the structural alert fires on the NEXT evaluation tick (detection
+    latency is bounded by the sweep cadence when for_s=0), emits the
+    event, and resolves after recovery + clear_s."""
+    clock = FakeClock()
+    rs = _replica_set(2)
+    reg = MetricsRegistry()
+    fams = router_families(reg)
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    w = _tower(rs=rs, clock=clock, obs=fams, event_log=log,
+               clear_s=5.0)
+    w.sweep()
+    assert w.alertz()["firing"] == []
+    victim = rs.all()[0]
+    victim.state = DOWN
+    w.sweep()  # first tick after the kill
+    name = f"replica_down:{victim.rid}"
+    assert w.alertz()["firing"] == [name]
+    assert (reg.get("router_alerts_firing")
+            .labels(alert=name).value == 1)
+    kinds = [e["kind"] for e in log.tail(50)]
+    assert "router_alert" in kinds
+    victim.state = UP
+    w.sweep()  # recovery observed: the clear_s countdown starts HERE
+    assert w.alertz()["firing"] == [name]  # hysteresis holds it firing
+    clock.advance(5.1)
+    w.sweep()
+    assert w.alertz()["firing"] == []
+    a = w.alertz(name=name)["alerts"][0]
+    assert a["state"] == "resolved" and a["fire_count"] == 1
+    assert (reg.get("router_alerts_firing")
+            .labels(alert=name).value == 0)
+
+
+def test_never_up_replica_never_alerts():
+    """A replica that joined DOWN (never probed up) is not an outage —
+    only an UP->DOWN transition is."""
+    clock = FakeClock()
+    rs = _replica_set(1, state=DOWN)
+    w = _tower(rs=rs, clock=clock)
+    w.sweep()
+    w.sweep()
+    assert w.alertz()["alerts"] == []
+
+
+def test_false_positive_guard_steady_in_slo_load():
+    """Steady passing traffic over many evaluation ticks: ZERO alert
+    transitions of any kind."""
+    clock = FakeClock()
+    rs = _replica_set(2)
+    w = _tower(rs=rs, clock=clock,
+               slo={"latency_p99_ms": 1000.0, "goodput_min": 0.5,
+                    "sheds_max": 100, "errors_max": 100})
+    for tick in range(30):
+        for _ in range(20):
+            w.note_request(25.0, "ok")
+        w.sweep()
+        clock.advance(1.0)
+    a = w.alertz()
+    assert a["firing"] == []
+    assert a["history"] == []
+    assert all(x["state"] == "ok" for x in a["alerts"])
+    assert a["slo_eval"]["pass"] is True
+
+
+# -- snapshot ring -----------------------------------------------------------
+
+
+def test_fleet_rollup_reuses_autoscale_terms():
+    clock = FakeClock()
+    rs = _replica_set(2)
+    w = _tower(rs=rs, clock=clock)
+    rollup = w.sweep()
+    auto = rs.update_autoscale()
+    for key in ("capacity_free_total", "demand_tokens_total",
+                "queue_delay_ms_max", "step_host_overhead_frac_max"):
+        assert rollup[key] == auto[key]
+    assert rollup["up"] == 2 and rollup["down"] == 0
+    assert rollup["step_tokens_per_sec_total"] == pytest.approx(100.0)
+    assert rollup["bundle_generations"] == [3]
+    assert tuple(rollup) == FLEET_ROLLUP_KEYS
+
+
+def test_ring_is_time_bucketed_and_bounded():
+    clock = FakeClock()
+    w = _tower(clock=clock, bucket_s=1.0, ring_max=8)
+    for _ in range(5):  # same bucket: replaced, not appended
+        w.sweep()
+    assert len(w.ring) == 1
+    assert w.ring.sweeps_total == 5
+    for _ in range(50):
+        clock.advance(1.0)
+        w.sweep()
+    assert len(w.ring) == 8  # bounded by maxlen
+    assert w.ring.sweeps_total == 55
+
+
+def test_ring_bounded_under_concurrent_sweeps():
+    w = Watchtower(_replica_set(2), windows="10:60:5",
+                   bucket_s=0.1, ring_max=4)  # real clock
+    errors = []
+
+    def hammer():
+        try:
+            for _ in range(50):
+                w.sweep()
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(w.ring) <= 4
+    assert w.ring.sweeps_total == 200
+
+
+# -- /fleetz + /alertz contracts ---------------------------------------------
+
+
+def _get(path, w):
+    out = handle_obs_request(path, MetricsRegistry(), watchtower=w)
+    assert out is not None
+    status, ctype, body = out
+    return status, json.loads(body)
+
+
+def test_fleetz_pinned_keys_and_filters():
+    clock = FakeClock()
+    rs = _replica_set(2)
+    w = _tower(rs=rs, clock=clock)
+    w.sweep()
+    clock.advance(5.0)
+    w.sweep()
+    status, body = _get("/fleetz", w)
+    assert status == 200
+    assert tuple(body) == FLEETZ_KEYS
+    assert tuple(body["fleet"]) == FLEET_ROLLUP_KEYS
+    for rec in body["replicas"].values():
+        assert tuple(rec) == REPLICA_SNAPSHOT_KEYS
+    assert body["sweeps_total"] == 2
+    assert [tuple(h) for h in body["history"]] == [
+        FLEET_ROLLUP_KEYS] * len(body["history"])
+    # filters
+    _, one = _get("/fleetz?replica=replica-0", w)
+    assert list(one["replicas"]) == ["http://replica-0:8000"]
+    _, hist = _get("/fleetz?n=1", w)
+    assert len(hist["history"]) == 1
+    status, _ = _get("/fleetz?n=zap", w)
+    assert status == 400
+
+
+def test_alertz_pinned_keys_and_filters():
+    clock = FakeClock()
+    rs = _replica_set(2)
+    w = _tower(rs=rs, clock=clock, slo={"latency_p99_ms": 100.0})
+    w.sweep()
+    rs.all()[0].state = DOWN
+    for _ in range(20):
+        w.note_request(500.0, "ok")
+    w.sweep()
+    status, body = _get("/alertz", w)
+    assert status == 200
+    assert tuple(body) == ALERTZ_KEYS
+    for a in body["alerts"]:
+        assert tuple(a) == ALERT_KEYS
+    assert body["windows"] == [
+        {"short_s": 10.0, "long_s": 60.0, "burn": 5.0}]
+    assert set(body["firing"]) == {
+        "slo:latency_p99_ms", f"replica_down:{rs.all()[0].rid}"}
+    # filters
+    _, slo_only = _get("/alertz?name=slo:", w)
+    assert [a["name"] for a in slo_only["alerts"]] == [
+        "slo:latency_p99_ms"]
+    _, firing_only = _get("/alertz?state=firing", w)
+    assert all(a["state"] == "firing" for a in firing_only["alerts"])
+    status, _ = _get("/alertz?state=exploded", w)
+    assert status == 400
+    status, _ = _get("/alertz?n=zap", w)
+    assert status == 400
+
+
+def test_endpoints_absent_without_watchtower():
+    assert handle_obs_request("/fleetz", MetricsRegistry()) is None
+    assert handle_obs_request("/alertz", MetricsRegistry()) is None
+
+
+def test_router_serves_fleetz_alertz_over_http(tmp_path):
+    """End-to-end wiring: a real RouterServer exposes both endpoints
+    through its do_GET, and /healthz carries the firing list."""
+    from pyspark_tf_gke_tpu.router.gateway import (
+        RouterServer,
+        start_router_http_server,
+    )
+
+    rs = _replica_set(2)
+    router = RouterServer(
+        rs.all(), registry=MetricsRegistry(),
+        event_log=EventLog(str(tmp_path / "ev.jsonl")))
+    for r in router.replicas.all():
+        r.state = UP
+        r.load = rs.all()[0].load
+    router.watchtower.sweep()
+    httpd = start_router_http_server(router, "127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        fleet = json.loads(urllib.request.urlopen(url + "/fleetz").read())
+        assert tuple(fleet) == FLEETZ_KEYS
+        assert fleet["fleet"]["up"] == 2
+        alertz = json.loads(
+            urllib.request.urlopen(url + "/alertz").read())
+        assert tuple(alertz) == ALERTZ_KEYS
+        health = json.loads(
+            urllib.request.urlopen(url + "/healthz").read())
+        assert health["alerts_firing"] == []
+    finally:
+        httpd.shutdown()
+
+
+# -- config parsing ----------------------------------------------------------
+
+
+def test_parse_alert_windows():
+    ws = parse_alert_windows("60:300:10,300:1800:2")
+    assert [(w.short_s, w.long_s, w.burn) for w in ws] == [
+        (60.0, 300.0, 10.0), (300.0, 1800.0, 2.0)]
+    for bad in ("300:60:10", "60:300", "60:300:0", ""):
+        with pytest.raises(ValueError):
+            parse_alert_windows(bad)
+
+
+def test_parse_slo_spec(tmp_path):
+    assert parse_slo_spec("") == {}
+    assert parse_slo_spec('{"latency_p99_ms": 2000}') == {
+        "latency_p99_ms": 2000}
+    p = tmp_path / "slo.json"
+    p.write_text('{"goodput_min": 0.99}')
+    assert parse_slo_spec(f"@{p}") == {"goodput_min": 0.99}
+    with pytest.raises(ValueError):  # replay/slo.py's own validation
+        parse_slo_spec('{"made_up_key": 1}')
+
+
+def test_unknown_slo_key_rejected_at_construction():
+    with pytest.raises(ValueError):
+        _tower(slo={"not_a_real_slo": 1})
+
+
+# -- satellite: ONE percentile implementation --------------------------------
+
+
+def test_percentile_call_sites_share_one_implementation():
+    """replay/stats.pct is the single percentile site; the localfleet
+    and stepstats wrappers must agree with it exactly (empty-list
+    contract aside: wrappers return 0.0, pct returns None)."""
+    from pyspark_tf_gke_tpu.obs.stepstats import _percentile
+    from pyspark_tf_gke_tpu.replay.stats import pct
+    from pyspark_tf_gke_tpu.router.localfleet import percentile
+
+    cases = [[5.0], [1.0, 2.0], [3.0, 1.0, 2.0],
+             [float(i) for i in range(100)],
+             [0.1234567, 9.7654321, 4.5]]
+    for xs in cases:
+        for q in (0.0, 0.5, 0.9, 0.99, 1.0):
+            want = pct(list(xs), q)
+            assert percentile(list(xs), q) == want
+            assert _percentile(sorted(xs), q) == want
+    assert percentile([], 0.5) == 0.0
+    assert _percentile([], 0.5) == 0.0
+    assert pct([], 0.5) is None
+
+
+# -- satellite: histogram quantile estimates ---------------------------------
+
+
+def test_estimate_quantile_interpolates_within_bucket():
+    buckets = [1.0, 2.0, 4.0, float("inf")]
+    # 10 observations all in (1, 2]: the median interpolates to the
+    # bucket's midpoint, p100-ish clamps to its upper bound
+    assert estimate_quantile(buckets, [0, 10, 0, 0], 0.5) == 1.5
+    assert estimate_quantile(buckets, [0, 10, 0, 0], 1.0) == 2.0
+    # first bucket uses lower bound 0
+    assert estimate_quantile(buckets, [10, 0, 0, 0], 0.5) == 0.5
+    # a rank landing in +Inf reports the last finite bound
+    assert estimate_quantile(buckets, [0, 0, 0, 10], 0.99) == 4.0
+    assert estimate_quantile(buckets, [0, 0, 0, 0], 0.5) is None
+
+
+def test_histogram_snapshot_gains_quantiles_text_unchanged():
+    h = Histogram("t_ms", "t", buckets=[1, 2, 4])
+    text_empty = "\n".join(h._expose())
+    snap = h._snapshot_one()
+    assert "quantiles" not in snap  # no observations -> no estimates
+    for v in (1.5,) * 10:
+        h.observe(v)
+    snap = h._snapshot_one()
+    assert set(snap["quantiles"]) == {"p50", "p95", "p99"}
+    assert snap["quantiles"]["p50"] == pytest.approx(1.5, abs=0.5)
+    # the Prometheus text exposition carries no quantile series — same
+    # line names/shape as before the estimates existed
+    text = "\n".join(h._expose())
+    assert "quantile" not in text
+    assert "quantile" not in text_empty
+    assert text.count("t_ms_bucket") == 4  # 3 finite + +Inf, as ever
+
+
+def test_registry_snapshot_json_roundtrips_with_quantiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms", "latency", buckets=[10, 100])
+    h.observe(50.0)
+    snap = json.loads(reg.snapshot_json())
+    assert snap["lat_ms"]["quantiles"]["p50"] == pytest.approx(55.0)

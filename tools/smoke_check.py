@@ -90,10 +90,18 @@ terminals and be TOKEN-IDENTICAL to an uninterrupted control run
 (the router's journal + continuation splice), with exactly one
 ``router_stream_resumes_total{outcome="ok"}`` on the router.
 
+``--watchtower`` checks the fleet watchtower's chaos-native contract
+live (docs/OBSERVABILITY.md "Fleet watchtower"): a 2-replica fleet
+behind the router under light load must populate the ``/fleetz``
+rollups with ZERO alerts fired during a steady control window; then
+SIGKILL one replica — the structural ``replica_down`` alert must fire
+within the documented detection bound and resolve (fire_count exactly
+1) after the restart re-admits the replica.
+
 Usage: python tools/smoke_check.py
        [--lint-only|--kernels-only|--serve-lifecycle|--serve-tbt|
         --router|--prefix-cache|--spec-serve|--fairness|--pipeline|
-        --trace|--replay|--stepstats|--failover-stream]
+        --trace|--replay|--stepstats|--failover-stream|--watchtower]
 """
 
 import os
@@ -265,7 +273,17 @@ def lint_duplicate_metrics() -> int:
                 "router_stream_tokens_replayed_total",
                 "router_stream_journal_entries",
                 "router_stream_journal_tokens",
-                "router_idempotent_replays_total"}
+                "router_idempotent_replays_total",
+                # fleet watchtower (router/watchtower.py): the live
+                # SLO burn-rate/alerting plane and the /fleetz
+                # snapshot ring — the --watchtower gate, bench.py
+                # chaos alert timelines and the ROADMAP item-5
+                # autopilot contract read these names
+                "router_slo_burn_rate",
+                "router_alerts_firing",
+                "router_alert_transitions_total",
+                "router_fleet_snapshots_total",
+                "router_fleet_snapshot_buckets"}
     absent = {n for n in required if n not in _REGISTRATIONS}
     if absent:
         print("metric lint FAILED — required metric name(s) never "
@@ -1934,6 +1952,121 @@ def chaos_check(grace_s: float = 30.0) -> int:
     return 0
 
 
+def watchtower_check(grace_s: float = 30.0) -> int:
+    """``--watchtower``: the fleet watchtower's chaos-native contract,
+    live. A 2-replica CPU localfleet runs behind the real router with
+    fast alert knobs; under steady light load the /fleetz rollups must
+    populate and ZERO alerts may fire (false-positive guard); then one
+    replica is SIGKILLed — the structural ``replica_down`` alert must
+    FIRE within the documented detection bound (fail_threshold x
+    probe_interval + probe_timeout + one sweep tick, plus scheduling
+    slack on a loaded CPU box) — and after a restart it must RESOLVE
+    within --alert-clear + re-admission time."""
+    import json
+    import time
+    import urllib.request
+
+    from pyspark_tf_gke_tpu.router.localfleet import LocalFleet
+
+    probe_interval, probe_timeout, fail_threshold = 0.3, 1.0, 2
+    clear_s = 2.0
+    # probe-path detection bound (passive health is faster under
+    # load): threshold sweeps + one timeout + one evaluation tick
+    detect_bound = (fail_threshold * probe_interval + probe_timeout
+                    + probe_interval + 5.0)  # + CPU-box slack
+
+    def _post(url, payload):
+        req = urllib.request.Request(
+            url + "/v1/generate", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=20) as resp:
+                return resp.status
+        except Exception:  # noqa: BLE001 — shed/fail is a valid verdict
+            return None
+
+    def _alertz(url):
+        with urllib.request.urlopen(url + "/alertz", timeout=5) as resp:
+            return json.loads(resp.read())
+
+    router_args = ("--probe-interval", str(probe_interval),
+                   "--probe-timeout", str(probe_timeout),
+                   "--fail-threshold", str(fail_threshold),
+                   "--alert-for", "0", "--alert-clear", str(clear_s))
+    print("watchtower check: 2-replica fleet + router "
+          f"(probe {probe_interval}s, clear {clear_s}s); steady "
+          "control window, then SIGKILL replica 1...")
+    with LocalFleet(2, router_args=router_args,
+                    replica_args=("--continuous-slots", "1",
+                                  "--max-queue-depth", "6")) as fleet:
+        fleet.warm()
+
+        # 1) steady in-SLO control window: light load, no alerts
+        t_ctl = time.monotonic()
+        while time.monotonic() - t_ctl < 3.0:
+            _post(fleet.url, {"prompts": ["steady state probe"],
+                              "max_new_tokens": 4})
+            time.sleep(0.2)
+        a = _alertz(fleet.url)
+        fired = [h for h in a["history"] if h["to"] == "firing"]
+        assert not a["firing"] and not fired, (
+            f"false positive during steady load: {a['firing']} "
+            f"{fired}")
+
+        # 2) /fleetz rollups populated by the riding sweeps
+        with urllib.request.urlopen(fleet.url + "/fleetz",
+                                    timeout=5) as resp:
+            fz = json.loads(resp.read())
+        assert fz["sweeps_total"] > 0 and fz["fleet"], fz
+        assert fz["fleet"]["up"] == 2, fz["fleet"]
+        assert len(fz["replicas"]) == 2 and fz["history"], fz
+
+        # 3) SIGKILL replica 1 -> the structural alert fires within
+        #    the detection bound
+        victim = fleet.replica_urls[1]
+        fleet.kill_replica(1)
+        t_kill = time.monotonic()
+        fired_names: list = []
+        while time.monotonic() - t_kill < detect_bound:
+            # keep a trickle of load flowing (passive health path)
+            _post(fleet.url, {"prompts": ["post-kill probe"],
+                              "max_new_tokens": 4})
+            fired_names = _alertz(fleet.url)["firing"]
+            if any(victim in n for n in fired_names):
+                break
+            time.sleep(0.2)
+        detect_s = time.monotonic() - t_kill
+        assert any(victim in n for n in fired_names), (
+            f"replica_down:{victim} never fired within "
+            f"{detect_bound}s: {fired_names}")
+        print(f"  alert fired {detect_s:.2f}s after SIGKILL "
+              f"(bound {detect_bound:.1f}s)")
+
+        # 4) restart -> re-admission + clear_s -> resolved
+        fleet.restart_replica(1)
+        t_restart = time.monotonic()
+        resolve_bound = grace_s + clear_s
+        while time.monotonic() - t_restart < resolve_bound:
+            a = _alertz(fleet.url)
+            if not a["firing"]:
+                break
+            time.sleep(0.3)
+        resolve_s = time.monotonic() - t_restart
+        assert not a["firing"], (
+            f"alert never resolved within {resolve_bound}s after "
+            f"restart: {a['firing']}")
+        down_alert = [x for x in a["alerts"]
+                      if victim in x["name"]][0]
+        assert down_alert["state"] == "resolved", down_alert
+        assert down_alert["fire_count"] == 1, down_alert
+    print(f"watchtower OK: zero false alerts in the control window, "
+          f"fleet rollups populated ({fz['sweeps_total']} sweeps), "
+          f"kill detected in {detect_s:.2f}s "
+          f"(bound {detect_bound:.1f}s), resolved {resolve_s:.2f}s "
+          "after restart, fire_count=1")
+    return 0
+
+
 def failover_stream_check(grace_s: float = 30.0) -> int:
     """``--failover-stream``: mid-stream replica death is invisible to
     the client, live. 2 tiny CPU replicas + the real router; decode is
@@ -2050,6 +2183,8 @@ def main(argv=None) -> int:
         return failover_stream_check()
     if "--chaos" in argv:
         return chaos_check()
+    if "--watchtower" in argv:
+        return watchtower_check()
     if "--serve-lifecycle" in argv:
         return serve_lifecycle_check()
     if "--serve-tbt" in argv:
