@@ -784,7 +784,7 @@ def _chaos_ab(model, params, slots: int, chunk: int, prompts, budgets,
 
 
 def bench_continuous(smoke: bool = False, paged: bool = False,
-                     chaos: bool = False) -> dict:
+                     chaos: bool = False, serial: bool = False) -> dict:
     """Continuous batching vs whole-batch serving on the SAME request
     set (train/continuous.py). The workload that separates them is
     budget variance: a whole-batch server runs every group for its
@@ -792,7 +792,15 @@ def bench_continuous(smoke: bool = False, paged: bool = False,
     engine refills each KV slot the moment its request finishes.
     Useful-tokens/sec is the metric for BOTH sides — the engine's extra
     prefill dispatches and per-row scatter writes are inside its
-    number, the baseline's idle-slot steps are inside its."""
+    number, the baseline's idle-slot steps are inside its.
+
+    ``serial=True`` (``cb --serial``) pins the headline to the
+    UNPIPELINED loop (pipeline_depth 0) at the default chunk — the
+    async-engine-core A/B reference: ``annotate_variant_regression``
+    compares it against the committed pipelined ``cb`` baseline, and
+    every ``cb`` entry additionally carries the in-run serial
+    reference as ``serial_step_phases`` (the same-process, same-box
+    half of the host-overhead A/B)."""
     import jax
     import jax.numpy as jnp
     from flax import linen as nn
@@ -930,8 +938,19 @@ def bench_continuous(smoke: bool = False, paged: bool = False,
             "step_phases": st["step_phases"],
             **({"paged": st["paged"]} if "paged" in st else {})}
 
-    base_cfg_tps, _ = run_engine(chunk, 0)
-    if smoke:
+    # the serial reference run's stats are kept: its step_phases block
+    # (host_work_frac == host_overhead_frac on a serial loop) is the
+    # in-run A/B anchor the pipelined headline is measured against
+    base_cfg_tps, base_cfg_stats = run_engine(chunk, 0)
+    if serial:
+        # --serial: the headline IS the serial loop (the async-core
+        # A/B reference; annotate_variant_regression scores it
+        # against the committed pipelined `cb` baseline)
+        tuned_chunk, tuned_depth, tuned_adaptive = chunk, 0, False
+        tuned_sched, tuned_batch = "fifo", True
+        eng_tps, admit_stats = base_cfg_tps, dict(base_cfg_stats)
+        tried = {}
+    elif smoke:
         tuned_chunk, tuned_depth, tuned_adaptive = chunk, 1, False
         tuned_sched, tuned_batch = "fifo", True
         eng_tps, admit_stats = run_engine(tuned_chunk, tuned_depth)
@@ -1112,6 +1131,12 @@ def bench_continuous(smoke: bool = False, paged: bool = False,
         # tools/trail_report.py renders the host/device split per
         # entry (popped from admit_stats — one copy per trail line)
         "step_phases": admit_stats.pop("step_phases", None),
+        # the serial reference run's phase summary, captured in the
+        # SAME process on the SAME box: host_overhead_frac here vs the
+        # headline's is the async-core overlap A/B (on a serial loop
+        # host_work_frac == host_overhead_frac by construction)
+        "serial_step_phases": base_cfg_stats.get("step_phases"),
+        "serial_headline": bool(serial),
         "tuning_grid": tried,  # every config measured for the headline
         **({"high_variance": high_variance}
            if high_variance is not None else {}),
@@ -2476,6 +2501,11 @@ VARIANT_BASELINES = {
     "cnn --bf16-moments": ["cnn"],
     "cnn --adafactor": ["cnn"],
     "cb --paged": ["cb"],
+    # the async engine core's A/B pair: the serial (unpipelined) loop
+    # measured against the committed pipelined `cb` baseline — a
+    # serial run ABOVE the pipelined baseline would mean the overlap
+    # is hurting, the exact inversion this guard exists to flag
+    "cb --serial": ["cb"],
     "generate --kv-heads 2": ["generate"],
     "generate --int8 --kv-heads 2": ["generate", "--kv-heads", "2"],
     "generate --int8 --int8-kv --kv-heads 2":
@@ -2668,6 +2698,11 @@ ALL_WORKLOADS = (
     ["resnet50", "--nf"],
     ["cnn", "--adafactor"],  # factored-second-moment traffic lever
     ["cb"],  # continuous batching: chunk x depth autotune vs whole-batch
+    # serial A/B reference for the async engine core: identical engine
+    # with the one-deep pipeline disabled (pipeline_depth=0), headline
+    # pinned to the unpipelined loop — the committed denominator for
+    # the host-overhead claim and the inversion guard's variant side
+    ["cb", "--serial"],
     # paged KV cache A/B: same slot count, engine on the page pool +
     # ragged paged_attention decode; cache bytes tracked by pages in use
     ["cb", "--paged"],
@@ -2954,6 +2989,13 @@ def run_bench(argv) -> dict:
                                         or "--chaos" in argv):
         raise SystemExit("--chunked-prefill is its own A/B (the engine "
                          "under it is already paged)")
+    if "--serial" in argv and workload != "cb":
+        raise SystemExit("--serial applies to the cb workload only")
+    if "--serial" in argv and any(f in argv for f in (
+            "--paged", "--chaos", "--chunked-prefill", "--prefix-cache",
+            "--spec")):
+        raise SystemExit("--serial is the async-core A/B reference "
+                         "(unpipelined loop) of the plain cb workload")
     if "--prefix-cache" in argv and workload != "cb":
         raise SystemExit("--prefix-cache applies to the cb workload only")
     if "--prefix-cache" in argv and ("--paged" in argv or "--chaos" in argv
@@ -3018,7 +3060,8 @@ def run_bench(argv) -> dict:
         if "--spec" in argv:
             return bench_spec_cb(smoke=smoke)
         return bench_continuous(smoke=smoke, paged="--paged" in argv,
-                                chaos="--chaos" in argv)
+                                chaos="--chaos" in argv,
+                                serial="--serial" in argv)
     if workload == "spec":
         gamma = 4
         if "--gamma" in argv:

@@ -722,12 +722,14 @@ def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
         "serve_step_host_overhead_ms": r.histogram(
             "serve_step_host_overhead_ms",
             "Per engine step, observed at step close: wall time minus "
-            "device-wait — the host (Python bookkeeping) share of the "
-            "step the device sat idle for on the serial loop; the "
-            "async-engine refactor's target is <10% of step time. "
+            "device-wait — the host (Python bookkeeping) work of the "
+            "step. On the pipelined loop (the default) this is a COST "
+            "number, not an idle number: host work running under an "
+            "in-flight chunk's compute is hidden, and true idle is "
+            "the interval-derived serve_device_idle_fraction. "
             "EXCLUDES the deliver phase (amended onto the record "
-            "after close) — /stepz and the windowed "
-            "serve_device_idle_fraction / /loadz fraction include it"),
+            "after close) — /stepz and the windowed fractions "
+            "include it"),
         "serve_step_phase_ms": r.histogram(
             "serve_step_phase_ms",
             "Per engine step, per phase (expire | schedule | dispatch "
@@ -736,10 +738,14 @@ def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
             labelnames=("phase",)),
         "serve_device_idle_fraction": r.gauge(
             "serve_device_idle_fraction",
-            "Windowed fraction of step wall the device spent idle "
-            "(host overhead / wall over the last ~64 steps) — equals "
-            "the host-overhead fraction on today's serial step loop; "
-            "decode-ahead makes it an optimistic lower bound"),
+            "Windowed fraction of the step-window span with NO chunk "
+            "in flight on the device: 1 - union(per-chunk "
+            "dispatch->retire intervals)/span over the last ~64 steps "
+            "(retire = observed-ready: the is_ready poll at a step "
+            "top or the settle's fetch return). Matches the "
+            "historical host-work share on a serial loop; splits "
+            "below it once the pipeline overlaps host work with "
+            "compute — also /loadz step_host_overhead_frac"),
         "serve_mfu": r.gauge(
             "serve_mfu",
             "Windowed model-FLOPs utilization: (decoded + prefilled "

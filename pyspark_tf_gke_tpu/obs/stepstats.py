@@ -29,16 +29,28 @@ step-time decomposition:
   gauge (FLOPs/token estimated from the model config; requires a
   ``peak_flops`` knob — 0/absent disables it, the CPU default).
 
-Measurement model (document before trusting the numbers): the serial
-engine loop blocks on the device exactly once per step — the collect's
-device→host fetch — so ``device_wait`` is *host time spent blocked on
-the device*, and ``host_overhead = wall - device_wait`` is everything
-else. On today's serial loop the device is idle during precisely that
-host remainder, so ``serve_device_idle_fraction`` equals the windowed
-host-overhead fraction; decode-ahead (``pipeline_depth > 0``) already
-overlaps one chunk and makes both metrics optimistic lower bounds on
-device busyness. The async-core refactor is exactly the change that
-will split these two numbers apart.
+Measurement model (document before trusting the numbers): the engine
+notes one DEVICE-BUSY INTERVAL per dispatched chunk —
+``[dispatch timestamp, retire timestamp]``, where retire is the
+moment the chunk's result arrays were OBSERVED ready (a cheap
+``is_ready`` poll at the top of each step, or the fetch return for a
+chunk that was still computing when its data was needed). The pinned
+``host_overhead_frac`` / ``serve_device_idle_fraction`` is derived
+from those intervals: ``1 - union(busy intervals) / window span`` —
+the fraction of the windowed wall-clock span with NO chunk in flight
+on the device. On the serial loop every step blocks on its own chunk
+before doing bookkeeping, so the interval derivation agrees with the
+historical formula ``sum(wall - device_wait) / sum(wall)`` (the
+pre-async trail entries stay comparable); on the pipelined loop the
+two SPLIT — host bookkeeping overlapped by an in-flight chunk no
+longer counts as device idle. The historical formula is kept as
+``host_work_frac`` (the host-work share of step wall — a cost
+number, not an idle number). Caveats: retire is observed at a poll
+boundary, so busy is rounded UP to the next step entry (idle is a
+conservative floor); prefill forwards are not interval-tracked, so
+prefill-heavy windows over-report idle. A ring that was never fed
+intervals (hand-built records in tests, host-side tools) falls back
+to the historical formula for both numbers.
 
 Stdlib-only and jax-free: the ring must work in CPU-only tests and in
 host-side tools that never attach a device.
@@ -124,7 +136,7 @@ class StepRecord:
     __slots__ = ("seq", "t_start", "wall_ms", "phases", "decode_slots",
                  "prefill_pieces", "prefill_tokens", "spec_rounds",
                  "tokens_out", "queue_depth", "expired", "outcome",
-                 "closed", "_stack", "_clock")
+                 "closed", "_stack", "_clock", "device_busy_ms")
 
     def __init__(self, seq: int, clock=time.monotonic,
                  queue_depth: int = 0):
@@ -142,6 +154,11 @@ class StepRecord:
         self.expired = 0
         self.outcome = "ok"
         self.closed = False
+        # device-busy milliseconds of the chunk(s) SETTLED during this
+        # step (dispatch->retire span, summed) — the per-row /stepz
+        # view of the windowed interval derivation; 0.0 until a settle
+        # stamps it
+        self.device_busy_ms = 0.0
         self._stack: List[list] = []
 
     @contextlib.contextmanager
@@ -190,6 +207,7 @@ class StepRecord:
             "seq": self.seq,
             "wall_ms": round(self.wall_ms, 3),
             "host_overhead_ms": round(self.host_overhead_ms, 3),
+            "device_busy_ms": round(self.device_busy_ms, 3),
             "phases_ms": {k: round(v, 3)
                           for k, v in sorted(self.phases.items())},
             "decode_slots": self.decode_slots,
@@ -236,6 +254,12 @@ class StepStatsRing:
         self._obs = None
         self.flops_per_token = 0.0
         self.peak_flops = 0.0
+        # device-busy intervals [(t_dispatch, t_retire), ...] in clock
+        # seconds, noted by the engine per dispatched chunk (see the
+        # module docstring's measurement model). Sized past the record
+        # window so every windowed step's chunk(s) are still held even
+        # with spec rounds dispatching several chunks per step.
+        self._intervals = deque(maxlen=4 * self.window)
 
     def bind(self, obs, flops_per_token: float = 0.0,
              peak_flops: float = 0.0) -> "StepStatsRing":
@@ -301,6 +325,21 @@ class StepStatsRing:
                     h.labels(phase="deliver").observe(ms)
                 self._refresh_window_gauges_locked()
 
+    def note_device_interval(self, t0: float, t1: float) -> None:
+        """Record one device-busy interval: ``t0`` = chunk dispatch
+        timestamp, ``t1`` = the moment its results were OBSERVED ready
+        (an ``is_ready`` poll at the next step's top, or the fetch
+        return when the data was needed first). Clock domain must match
+        the ring's ``clock``. Feeding intervals is what switches
+        :meth:`host_overhead_frac` from the legacy serial-loop formula
+        to the true interval-union device-idle derivation."""
+        t0 = float(t0)
+        t1 = float(t1)
+        if t1 < t0:
+            t0, t1 = t1, t0
+        with self._lock:
+            self._intervals.append((t0, t1))
+
     def mark_reaped(self, rec: StepRecord) -> None:
         """The watchdog reaped this step's waiters while it hung:
         relabel its (already-closed) record. Amends in place — the
@@ -333,20 +372,66 @@ class StepStatsRing:
             return [r.to_dict() for r in recs[:max(1, int(n))]]
 
     def host_overhead_frac(self) -> float:
-        """Windowed host-overhead fraction: sum(wall - device_wait) /
-        sum(wall) over the last ``window`` records (0.0 when empty) —
-        what ``/loadz step_host_overhead_frac`` advertises and the
-        router folds into its autoscale block."""
+        """Windowed device-idle fraction — what ``/loadz
+        step_host_overhead_frac`` advertises and the router folds into
+        its autoscale block. Interval-derived when the engine has fed
+        dispatch/retire timestamps (``1 - union(busy)/span`` — see the
+        module docstring); falls back to the legacy serial-loop
+        formula ``sum(wall - device_wait)/sum(wall)`` for rings never
+        fed intervals (0.0 when empty either way)."""
         with self._lock:
             return self._host_overhead_frac_locked()
 
     def _host_overhead_frac_locked(self) -> float:
+        idle = self._device_idle_frac_locked()
+        if idle is not None:
+            return idle
+        return self._host_work_frac_locked()
+
+    def _host_work_frac_locked(self) -> float:
+        """The historical formula: the host-work share of step wall.
+        On the serial loop this IS device idle; on the pipelined loop
+        it is a cost number only (host work overlapped by an in-flight
+        chunk no longer idles the device)."""
         recs = list(self._ring)[-self.window:]
         wall = sum(r.wall_ms for r in recs)
         if wall <= 0.0:
             return 0.0
         host = sum(r.host_overhead_ms for r in recs)
         return min(1.0, max(0.0, host / wall))
+
+    def _device_idle_frac_locked(self) -> Optional[float]:
+        """True device-idle fraction over the windowed span:
+        ``1 - union(device-busy intervals) / span``, intervals clipped
+        to the window. None when no interval overlaps the window (the
+        caller falls back to the legacy formula)."""
+        recs = list(self._ring)[-self.window:]
+        if not recs:
+            return None
+        lo = recs[0].t_start
+        hi = recs[-1].t_start + recs[-1].wall_ms / 1000.0
+        span = hi - lo
+        if span <= 0.0:
+            return None
+        clipped = []
+        for (a, b) in self._intervals:
+            a = max(a, lo)
+            b = min(b, hi)
+            if b > a:
+                clipped.append((a, b))
+        if not clipped:
+            return None
+        clipped.sort()
+        busy = 0.0
+        cur_a, cur_b = clipped[0]
+        for a, b in clipped[1:]:
+            if a > cur_b:
+                busy += cur_b - cur_a
+                cur_a, cur_b = a, b
+            else:
+                cur_b = max(cur_b, b)
+        busy += cur_b - cur_a
+        return min(1.0, max(0.0, 1.0 - busy / span))
 
     @staticmethod
     def _span_s(recs: List[StepRecord]) -> float:
@@ -383,9 +468,11 @@ class StepStatsRing:
         with self._lock:
             recs = list(self._ring)[-self.window:]
             frac = self._host_overhead_frac_locked()
+            work = self._host_work_frac_locked()
             mfu = self._mfu_locked()
         if not recs:
             return {"records": 0, "host_overhead_frac": 0.0,
+                    "host_work_frac": 0.0,
                     "device_idle_fraction": 0.0, "mfu": 0.0,
                     "wall_ms": {}, "phase_ms": {}}
         walls = sorted(r.wall_ms for r in recs)
@@ -400,10 +487,14 @@ class StepStatsRing:
         tokens = sum(r.tokens_out + r.prefill_tokens for r in recs)
         return {
             "records": len(recs),
+            # interval-derived device idle when the engine feeds
+            # dispatch/retire timestamps; the legacy formula otherwise
+            # (see the module docstring's measurement model)
             "host_overhead_frac": round(frac, 4),
-            # identical to host_overhead_frac on the serial loop (see
-            # the module docstring's measurement model); kept as its
-            # own key because the async refactor splits them
+            # the historical sum(wall - device_wait)/sum(wall) — equal
+            # to host_overhead_frac on the serial loop, strictly above
+            # it once the pipeline overlaps host work with compute
+            "host_work_frac": round(work, 4),
             "device_idle_fraction": round(frac, 4),
             "mfu": round(mfu, 6),
             # span-based (start of first windowed step -> end of the

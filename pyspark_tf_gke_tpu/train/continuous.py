@@ -609,13 +609,26 @@ class DwrrScheduler:
         self.admitted_tokens[t] = self.admitted_tokens.get(t, 0) + cost
 
 
-def _seed_key_data(seed) -> jnp.ndarray:
+def _seed_key_data(seed):
     """[2] uint32 key data for the slot lane, with the impl PINNED to
     threefry2x32: _decode_chunk wraps with that impl explicitly, and the
     default-impl PRNGKey would hand back (4,)-shaped rbg data on
-    configs that set jax_default_prng_impl=rbg (common on TPU)."""
+    configs that set jax_default_prng_impl=rbg (common on TPU).
+
+    Seeds in [0, 2**32) — every seed the serving stack generates —
+    take a pure-numpy fast path: threefry key data for such a seed is
+    exactly ``[0, seed]`` under x64 on AND off (verified bit-identical
+    against ``jax.random.key``), and building it on the host instead
+    of through three eager device ops keeps admissions off the
+    dispatch queue (measured ~0.14 ms/row on the CPU bench box —
+    admission host cost is what the double-buffered loop must hide).
+    Out-of-range seeds keep the jax path, whose truncation semantics
+    depend on the x64 flag and are not worth reimplementing."""
+    s = int(seed)
+    if 0 <= s < 2**32:
+        return np.array([0, s], np.uint32)
     return jax.random.key_data(
-        jax.random.key(int(seed), impl="threefry2x32")).astype(jnp.uint32)
+        jax.random.key(s, impl="threefry2x32")).astype(jnp.uint32)
 
 
 class SlotState(NamedTuple):
@@ -1512,19 +1525,15 @@ class SlotDeviceState:
                         "engine allocates pages at admission)")
                 self.state = _insert_slot_paged(
                     self.state, cache1, logits1,
-                    jnp.asarray(slot, jnp.int32),
-                    jnp.asarray(fill, jnp.int32),
-                    jnp.asarray(pages, jnp.int32),
-                    jnp.asarray(temperature, jnp.float32),
-                    jnp.asarray(top_p, jnp.float32),
+                    np.int32(slot), np.int32(fill),
+                    np.asarray(pages, np.int32),
+                    np.float32(temperature), np.float32(top_p),
                     _seed_key_data(seed), n_rows=int(n_rows))
                 return
             self.state = _insert_slot(
                 self.state, cache1, logits1,
-                jnp.asarray(slot, jnp.int32),
-                jnp.asarray(fill, jnp.int32),
-                jnp.asarray(temperature, jnp.float32),
-                jnp.asarray(top_p, jnp.float32),
+                np.int32(slot), np.int32(fill),
+                np.float32(temperature), np.float32(top_p),
                 _seed_key_data(seed))
 
     def admit_padded(self, padded: np.ndarray, true_len: int,
@@ -1536,8 +1545,8 @@ class SlotDeviceState:
         slot's page allocation, paged mode only)."""
         with self._mesh_ctx():
             cache1, logits1 = _prefill_padded(
-                self.model, self.params, jnp.asarray(padded),
-                jnp.asarray(true_len, jnp.int32))
+                self.model, self.params, np.asarray(padded),
+                np.int32(true_len))
         self.insert(cache1, logits1, slot, true_len,
                     temperature=temperature, top_p=top_p, seed=seed,
                     pages=pages, n_rows=padded.shape[1])
@@ -1556,17 +1565,28 @@ class SlotDeviceState:
         topps = np.ones((k_pad,), np.float32)
         temps[:k] = [s[0] for s in samplings]
         topps[:k] = [s[1] for s in samplings]
-        # keys stay ON DEVICE: np.asarray(key_data) would be a
-        # synchronous device->host readback per row — k+1 RTTs that the
-        # solo admit path never pays (measured: batched admission LOST
-        # its own win to them on the tunneled chip)
-        keys = jnp.stack(
-            [_seed_key_data(s[2]) for s in samplings]
-            + [jnp.zeros((2,), jnp.uint32)] * (k_pad - k))
+        # keys assemble on the HOST when every row takes
+        # _seed_key_data's numpy fast path (the common case — serving
+        # seeds are uint32): zero eager device ops, one transfer at
+        # the jit boundary below. A row with an out-of-range seed
+        # comes back as a device array, and the whole stack falls back
+        # to jnp (np.asarray on it would be a synchronous
+        # device->host readback per row — k+1 RTTs that the solo
+        # admit path never pays; measured: batched admission LOST its
+        # own win to them on the tunneled chip).
+        key_rows = ([_seed_key_data(s[2]) for s in samplings]
+                    + [np.zeros((2,), np.uint32)] * (k_pad - k))
+        if all(isinstance(r, np.ndarray) for r in key_rows):
+            keys = np.stack(key_rows)
+        else:
+            keys = jnp.stack([jnp.asarray(r) for r in key_rows])
+        true_lens = np.asarray(true_lens, np.int32)
+        # numpy args flow straight into the jitted callees — the jit
+        # boundary moves them host->device in one C++ pass, cheaper
+        # than a Python-level eager device_put per array
         with self._mesh_ctx():
             caches, logits = _prefill_padded_batch(
-                self.model, self.params, jnp.asarray(padded),
-                jnp.asarray(true_lens, jnp.int32))
+                self.model, self.params, np.asarray(padded), true_lens)
             if self.state is None:
                 # _zeros_state only reads shape[1:] per leaf, so the
                 # k-row tree is as good a template as a batch-1 one
@@ -1576,18 +1596,13 @@ class SlotDeviceState:
                     raise ValueError(
                         "paged batch insert needs per-row pages")
                 self.state = _insert_slots_batch_paged(
-                    self.state, caches, logits,
-                    jnp.asarray(slot_idx),
-                    jnp.asarray(true_lens, jnp.int32),
-                    jnp.asarray(pages, jnp.int32),
-                    jnp.asarray(temps), jnp.asarray(topps), keys,
-                    n_rows=padded.shape[1])
+                    self.state, caches, logits, slot_idx, true_lens,
+                    np.asarray(pages, np.int32),
+                    temps, topps, keys, n_rows=padded.shape[1])
             else:
                 self.state = _insert_slots_batch(
-                    self.state, caches, logits,
-                    jnp.asarray(slot_idx),
-                    jnp.asarray(true_lens, jnp.int32),
-                    jnp.asarray(temps), jnp.asarray(topps), keys)
+                    self.state, caches, logits, slot_idx, true_lens,
+                    temps, topps, keys)
 
     def prefill_chunk(self, padded: np.ndarray, fill: int,
                       true_len: int, row):
@@ -1606,10 +1621,8 @@ class SlotDeviceState:
                 self.state = self._init_state(None)  # paged shapes come
                 #   from the model config, not a prefill template
             self.state, logits1 = _paged_prefill_chunk(
-                self.model, self.params, self.state, jnp.asarray(padded),
-                jnp.asarray(fill, jnp.int32),
-                jnp.asarray(true_len, jnp.int32),
-                jnp.asarray(row, jnp.int32))
+                self.model, self.params, self.state, np.asarray(padded),
+                np.int32(fill), np.int32(true_len), np.int32(row))
             return logits1
 
     def activate_slot(self, slot: int, fill: int, logits1, row,
@@ -1619,11 +1632,9 @@ class SlotDeviceState:
         level, carried logits, sampling lane (paged models only)."""
         with self._mesh_ctx():
             self.state = _activate_slot_paged(
-                self.state, jnp.asarray(slot, jnp.int32),
-                jnp.asarray(row, jnp.int32),
-                jnp.asarray(fill, jnp.int32), logits1,
-                jnp.asarray(temperature, jnp.float32),
-                jnp.asarray(top_p, jnp.float32),
+                self.state, np.int32(slot), np.int32(row),
+                np.int32(fill), logits1,
+                np.float32(temperature), np.float32(top_p),
                 _seed_key_data(seed))
 
     def copy_page(self, src: int, dst: int) -> None:
@@ -1634,8 +1645,7 @@ class SlotDeviceState:
             if self.state is None:
                 self.state = self._init_state(None)
             self.state = _copy_page(
-                self.state, jnp.asarray(src, jnp.int32),
-                jnp.asarray(dst, jnp.int32))
+                self.state, np.int32(src), np.int32(dst))
 
     def chunk_async(self, chunk: int, eos_token_id: Optional[int],
                     pad_id: int, sampling: bool = False):
@@ -1675,7 +1685,70 @@ class SlotDeviceState:
             # mode also resets the slot's block-table row to the
             # sentinel (its pages are about to return to the pool)
             clear = _clear_live_paged if self.paged else _clear_live
-            self.state = clear(self.state, jnp.asarray(slot, jnp.int32))
+            self.state = clear(self.state, np.int32(slot))
+
+
+def _array_leaves(x):
+    """Flatten a dispatched chunk's result pytree (arrays, tuples of
+    arrays) into its array leaves — stdlib recursion, no jax tree
+    utils, so host-array results (announce gathers) walk the same."""
+    if isinstance(x, (tuple, list)):
+        for y in x:
+            yield from _array_leaves(y)
+    elif x is not None:
+        yield x
+
+
+class _InflightStep:
+    """One dispatched-but-unsettled chunk: the engine's explicit
+    pipeline-stage state object. Carries the result handles (device
+    arrays until the settle fetches them; host arrays on the
+    unpipelined announce path), the slot->request SNAPSHOT the chunk
+    was computed over (scheduling for the NEXT step mutates
+    ``engine._slots`` freely — the settle walks this snapshot, never
+    the live table), and the dispatch/retire timestamps that feed the
+    device-busy interval derivation (obs/stepstats.py measurement
+    model).
+
+    ``kind`` vocabulary: ``dev`` / ``spec_dev`` hold un-fetched device
+    arrays; ``host`` / ``spec_host`` hold already-gathered host arrays
+    (the unpipelined announce path blocks at dispatch).
+
+    ``t_dispatch`` is stamped at ENTRY to the dispatch call: the async
+    runtime begins executing while the call is still wrapping outputs,
+    so an after-return stamp undercuts the interval by however long
+    the call took — on a contended 1-vCPU host the device can finish
+    most of a chunk inside a slow dispatch call, collapsing its busy
+    window to near zero (measured). The call-entry stamp over-counts
+    by at most the pure-host prefix of one dispatch call, which is
+    bounded and small; the after-return stamp under-counts by an
+    unbounded contention-dependent amount. ``t_retire`` is stamped at
+    the first moment the results were OBSERVED ready: a non-blocking
+    ``is_ready`` poll at a step top
+    (:meth:`ContinuousEngine.poll_retire`), or the fetch return when
+    the data was needed while still computing. None until then."""
+
+    __slots__ = ("kind", "a", "b", "snapshot", "size",
+                 "t_dispatch", "t_retire")
+
+    def __init__(self, kind, a, b, snapshot, size, t_dispatch):
+        self.kind = kind
+        self.a = a                  # tokens / packed spec results
+        self.b = b                  # live flags (None for spec kinds)
+        self.snapshot = snapshot    # slot -> _Request at dispatch
+        self.size = size            # max tokens emitted per slot
+        self.t_dispatch = float(t_dispatch)
+        self.t_retire: Optional[float] = None
+
+    def poll_ready(self) -> bool:
+        """Non-blocking: True iff every result array reports ready.
+        Host-kind results (no ``is_ready``) are ready by construction;
+        local-only, so safe under announce (no collective)."""
+        for x in _array_leaves((self.a, self.b)):
+            ready = getattr(x, "is_ready", None)
+            if ready is not None and not ready():
+                return False
+        return True
 
 
 class ContinuousEngine:
@@ -1768,8 +1841,16 @@ class ContinuousEngine:
         #   noise; see bench.py cb's device_step accounting
         from collections import deque
 
-        # (kind, toks, live, slots snapshot, chunk size)
-        self._inflight_q = deque()
+        # dispatched-but-unsettled chunks, oldest first (_InflightStep)
+        self._inflight_q: Deque[_InflightStep] = deque()
+        # admission dispatches whose device-busy interval is still
+        # open: prefill + insert work is async and never collected, so
+        # without these trackers every prefill's compute would be
+        # measured as device IDLE. Each entry polls the post-admission
+        # slot-pool state (the insert's output tree — ready only once
+        # the whole prefill->insert chain ran). Bounded: a dropped
+        # tracker only under-counts busy, and busy is a floor.
+        self._admit_q: Deque[_InflightStep] = deque(maxlen=32)
         if prefill_chunk and prefill_chunk < 32:
             raise ValueError(
                 f"prefill_chunk must be 0 (off) or >= 32, got "
@@ -3116,11 +3197,11 @@ class ContinuousEngine:
         if not self.adaptive_chunk or not self._slots:
             return self.chunk
         pending: Dict[int, int] = {}
-        for _, _, _, snapshot, size in self._inflight_q:
-            for slot, sreq in snapshot.items():
+        for fs in self._inflight_q:
+            for slot, sreq in fs.snapshot.items():
                 if self._slots.get(slot) is sreq:  # not a freed slot's
                     #       stale snapshot (those rows are dead anyway)
-                    pending[slot] = pending.get(slot, 0) + size
+                    pending[slot] = pending.get(slot, 0) + fs.size
         remaining = min(
             req.max_new_tokens - len(req.tokens) - pending.get(slot, 0)
             for slot, req in self._slots.items())
@@ -3181,6 +3262,7 @@ class ContinuousEngine:
             # the unpipelined announce path blocks on the readback
             # INSIDE the dispatch: carve the device sync out of the
             # dispatch phase so host overhead stays honest
+            t0 = time.monotonic()
             with self._phase("device_wait"):
                 toks, live = self._announced(
                     lambda wire: wire.announce_cb_chunk(
@@ -3189,7 +3271,13 @@ class ContinuousEngine:
                     lambda: self._device.chunk(
                         size, self.eos_token_id, self.pad_id,
                         sampling=any_sampling))
-            return "host", toks, live, dict(self._slots), size
+            fs = _InflightStep("host", toks, live, dict(self._slots),
+                               size, t0)
+            self._note_retired(fs, time.monotonic())
+            return fs
+        # t_dispatch stamps the dispatch-call ENTRY (see _InflightStep:
+        # the async runtime starts executing before the call returns)
+        t0 = time.monotonic()
         toks_dev, live_dev = self._announced(
             lambda wire: wire.announce_cb_chunk(
                 self.num_slots, size, self.eos_token_id,
@@ -3197,7 +3285,8 @@ class ContinuousEngine:
             lambda: self._device.chunk_async(
                 size, self.eos_token_id, self.pad_id,
                 sampling=any_sampling))
-        return "dev", toks_dev, live_dev, dict(self._slots), size
+        return _InflightStep("dev", toks_dev, live_dev,
+                             dict(self._slots), size, t0)
 
     def _spec_rounds(self, size: int, cap: Optional[int]) -> int:
         """Draft/verify rounds for one spec dispatch. ``size`` bounds
@@ -3234,6 +3323,7 @@ class ContinuousEngine:
             self._step_rec.spec_rounds += rounds
         adv = 1 + rounds * (k + 1)  # max tokens emitted per slot
         if self.announce and not self.pipeline_depth:
+            t0 = time.monotonic()
             with self._phase("device_wait"):
                 out = self._announced(
                     lambda wire: wire.announce_cb_chunk(
@@ -3243,7 +3333,11 @@ class ContinuousEngine:
                     lambda: self._device.spec_chunk(
                         rounds, self.eos_token_id, self.pad_id,
                         sampling=any_sampling))
-            return "spec_host", out, None, dict(self._slots), adv
+            fs = _InflightStep("spec_host", out, None,
+                               dict(self._slots), adv, t0)
+            self._note_retired(fs, time.monotonic())
+            return fs
+        t0 = time.monotonic()  # dispatch-call entry (see _InflightStep)
         out = self._announced(
             lambda wire: wire.announce_cb_chunk(
                 self.num_slots, rounds, self.eos_token_id,
@@ -3252,7 +3346,8 @@ class ContinuousEngine:
             lambda: self._device.spec_chunk_async(
                 rounds, self.eos_token_id, self.pad_id,
                 sampling=any_sampling))
-        return "spec_dev", out, None, dict(self._slots), adv
+        return _InflightStep("spec_dev", out, None, dict(self._slots),
+                             adv, t0)
 
     def _spec_slot_stream(self, spec_data, slot: int, req: _Request):
         """Compact one slot's spec-chunk output into its emitted token
@@ -3298,14 +3393,44 @@ class ContinuousEngine:
         acc = sum(a for _, a in self._spec_window)
         return acc / prop if prop else 0.0
 
-    def _collect(self, inflight) -> List[_Request]:
-        """Read back one dispatched chunk and do the host bookkeeping
-        (token append, streaming callbacks, eos/budget completion,
-        frees) for the slot snapshot it was computed over."""
-        kind, a, b, snapshot, _size = inflight
+    def _note_retired(self, fs: _InflightStep, t_retire: float) -> None:
+        """Stamp a chunk's retire timestamp (once) and feed its
+        [dispatch, retire] device-busy interval to the stats ring —
+        the raw input of the interval-union idle derivation."""
+        if fs.t_retire is not None:
+            return
+        fs.t_retire = t_retire
+        self.stepstats.note_device_interval(fs.t_dispatch, fs.t_retire)
+
+    def poll_retire(self) -> None:
+        """Non-blocking retire sweep: any in-flight chunk whose result
+        arrays report ready gets its retire timestamp stamped NOW, so
+        device-busy intervals end where the device actually went
+        quiet, not where the host eventually fetched. Run at the step
+        top (before this step's host work), at the step tail (after
+        the settle), and by the serve driver after delivery — each a
+        couple of ``is_ready`` calls. A chunk still computing is left
+        alone (its settle's fetch return stamps it). Local-only
+        ``is_ready`` — no collective, announce-safe."""
+        now = time.monotonic()
+        for fs in self._inflight_q:
+            if fs.t_retire is None and fs.poll_ready():
+                self._note_retired(fs, now)
+        # admission trackers drain head-first (the device queue is
+        # FIFO, so they complete in dispatch order)
+        while self._admit_q and self._admit_q[0].poll_ready():
+            self._note_retired(self._admit_q.popleft(), now)
+
+    def _collect(self, inflight: _InflightStep) -> List[_Request]:
+        """Settle one dispatched chunk: read back its results (a
+        device-to-host copy that only blocks if the chunk is still
+        computing) and do the host bookkeeping (token append,
+        streaming callbacks, eos/budget completion, frees) for the
+        slot snapshot it was computed over."""
+        kind = inflight.kind
         spec_data = None
         if kind == "host":
-            toks, live_host = a, b
+            toks, live_host = inflight.a, inflight.b
         elif kind == "dev":
             # the serial loop's ONE blocking device sync: everything
             # outside this context is host overhead by definition
@@ -3313,23 +3438,29 @@ class ContinuousEngine:
                 toks, live_host = self._announced(
                     lambda wire: wire.announce_cb_collect(
                         self.num_slots),
-                    lambda: self._device.fetch(a, b))
+                    lambda: self._device.fetch(inflight.a, inflight.b))
         elif kind == "spec_host":
-            spec_data = _unpack_spec(a[0], self.spec_tokens)
+            spec_data = _unpack_spec(inflight.a[0], self.spec_tokens)
             live_host = spec_data[-1]
         else:  # spec_dev: ONE packed gather at the collect
             with self._phase("device_wait"):
                 packed = self._announced(
                     lambda wire: wire.announce_cb_collect(
                         self.num_slots),
-                    lambda: self._device.fetch_tuple(a))
+                    lambda: self._device.fetch_tuple(inflight.a))
             spec_data = _unpack_spec(packed[0], self.spec_tokens)
             live_host = spec_data[-1]
+        # a chunk that was still computing when its data was needed:
+        # the fetch return IS the observed-ready moment
+        self._note_retired(inflight, time.monotonic())
+        if self._step_rec is not None:
+            self._step_rec.device_busy_ms += (
+                inflight.t_retire - inflight.t_dispatch) * 1000.0
         newly_done = []
         useful_tokens = 0
         chunk_prop = chunk_acc = 0
         now = time.monotonic()
-        for slot, req in snapshot.items():
+        for slot, req in inflight.snapshot.items():
             if req.done:
                 # freed/cancelled while this chunk was in flight (only
                 # possible with decode-ahead): its rows decoded garbage
@@ -3453,6 +3584,11 @@ class ContinuousEngine:
         return finished
 
     def _step_body(self, rec) -> List[_Request]:
+        # retire sweep FIRST: chunks that finished while the host was
+        # off delivering get their device-busy intervals closed at
+        # this step's entry, before any of this step's host work —
+        # idle is measured from here, conservatively
+        self.poll_retire()
         with rec.phase("expire"):
             expired = self._expire_deadlines()
         rec.expired = len(expired)
@@ -3462,10 +3598,25 @@ class ContinuousEngine:
         # admission-start step's decode chunk is capped too
         self._step_prefill_tokens = 0
         pieces0 = self._n_prefill_chunks
+        # admission-interval bracket: any schedule work that replaced
+        # the device slot-pool state dispatched prefill+insert ops —
+        # open a busy interval from the bracket entry, retired when
+        # the new state's arrays report ready (poll_retire)
+        state0 = self._device.state
+        t_sched = time.monotonic()
         with rec.phase("schedule"):
             if self._admitting is not None:
                 self._advance_admission()
             self._admit_waiting()
+        if self._device.state is not state0:
+            # track only the tiny `live` leaf: it comes ready with the
+            # rest of the insert's outputs, and holding the full state
+            # tree here would pin the superseded KV cache in device
+            # memory until the tracker retires
+            self._admit_q.append(_InflightStep(
+                "admit", getattr(self._device.state, "live",
+                                 self._device.state), None, {}, 0,
+                t_sched))
         rec.prefill_pieces = self._n_prefill_chunks - pieces0
         rec.prefill_tokens = self._step_prefill_tokens
         self._obs["serve_prefill_inflight"].set(
@@ -3513,6 +3664,27 @@ class ContinuousEngine:
                 finished += self._collect(self._inflight_q.popleft())
             if self._slots:  # collects freed slots mid-flush: stop at
                 break        # target depth next call, after admissions
+        # second retire sweep at the step tail: the chunk dispatched
+        # THIS step often finishes during the settle above — observing
+        # it here instead of at the next step's top keeps the deliver
+        # phase and inter-step gap out of its busy interval
+        self.poll_retire()
+        return finished
+
+    def quiesce(self) -> List[_Request]:
+        """Settle EVERY in-flight chunk (device sync + full host
+        bookkeeping — spans, frees, trie adoption) without
+        dispatching new work; returns requests that finished in the
+        flush. The pipeline-drain primitive: hot-swap and drain call
+        this so no speculative chunk is abandoned mid-flight when the
+        engine is about to be replaced — abandoned chunks would leak
+        page refs and eat tokens the swap's successor then re-emits.
+        Idempotent; a no-op on an empty pipeline. Announce mode
+        announces the matching OP_CB_COLLECTs, so worker replicas
+        drain their deferred window in lockstep."""
+        finished: List[_Request] = []
+        while self._inflight_q:
+            finished += self._collect(self._inflight_q.popleft())
         return finished
 
     def run_until_drained(self):

@@ -803,19 +803,32 @@ class _ContinuousFront:
         return rid, q
 
     def _deliver_finished(self, finished) -> None:
-        """Deliver one step's finished requests to their waiters:
-        quota refund + per-tenant token accounting for every delivery
-        (completion AND expiry — a deadline-expired request hands its
-        unused generation budget back to its tenant's bucket), then the
-        result/terminal. Caller holds ``self.lock`` (the driver loop
-        and the hot-swap drain both run it)."""
+        """Deliver one settled step's finished requests to their
+        waiters: quota refund + per-tenant token accounting for every
+        delivery (completion AND expiry — a deadline-expired request
+        hands its unused generation budget back to its tenant's
+        bucket), then the result/terminal. Caller holds ``self.lock``
+        (the driver loop and the hot-swap drain both run it).
+
+        The results lock is taken ONCE per settled step, not once per
+        request: on the pipelined engine delivery is the host work
+        that must fit inside the in-flight chunk's compute, and N
+        lock round-trips per step (vs the submit path and the
+        watchdog) were measurable on the 1-vCPU box. Per-token waiter
+        wakeups are unaffected — token streaming rides the engine's
+        ``on_tokens`` queues; this path only writes terminals."""
+        if not finished:
+            return
         for req in finished:
+            # quota settlement needs no waiter state — keep it outside
+            # the results lock
             self._settle(req)
-            # (the terminal span event is emitted by the ENGINE at the
-            # state transition itself — one emitter for served and
-            # direct callers alike; the HTTP layer still stamps the
-            # status code it maps the outcome to)
-            with self._results_lock:
+        # (the terminal span event is emitted by the ENGINE at the
+        # state transition itself — one emitter for served and
+        # direct callers alike; the HTTP layer still stamps the
+        # status code it maps the outcome to)
+        with self._results_lock:
+            for req in finished:
                 # delivery happens UNDER the lock, and only if nobody
                 # delivered first: a step returning right at the
                 # watchdog timeout races the reaper, and a waiter must
@@ -864,6 +877,13 @@ class _ContinuousFront:
                     if not self.engine.busy:
                         break
                     self._deliver_finished(self.engine.step())
+                # quiesce the pipeline even when the drain deadline
+                # cut the loop short: settle every in-flight chunk
+                # (bounded — at most pipeline_depth collects) so no
+                # speculative chunk is abandoned mid-flight with its
+                # tokens undelivered and its page refs held when the
+                # engine below is replaced
+                self._deliver_finished(self.engine.quiesce())
             except Exception:  # noqa: BLE001 — drain is best-effort;
                 # the explicit-terminal sweep below covers the leftovers
                 logger.exception(
@@ -1067,6 +1087,11 @@ class _ContinuousFront:
                     t_deliver = time.monotonic()
                     self._deliver_finished(finished)
                     if busy:
+                        # retire sweep after delivery: the in-flight
+                        # chunk often goes ready while the host
+                        # delivers — observe it here so the delivery
+                        # time stays out of its device-busy interval
+                        self.engine.poll_retire()
                         # the one step phase that runs OUTSIDE
                         # engine.step(): amend delivery time onto the
                         # just-closed record (wall grows with it, so
@@ -1230,7 +1255,7 @@ class BundleServer:
                  draft_bundle_dir: str = "", continuous_slots: int = 0,
                  continuous_chunk: int = 8, prefix_cache_size: int = 0,
                  prefill_chunk: int = 0, step_token_budget: int = 0,
-                 continuous_pipeline: int = 0,
+                 continuous_pipeline: int = 1,
                  adaptive_chunk: bool = False, schedule: str = "fifo",
                  registry=None, event_log=None,
                  max_queue_depth: int = 0, max_queued_tokens: int = 0,
@@ -1781,12 +1806,16 @@ class BundleServer:
             # (0.0 when --spec-tokens is off) — speculation quality a
             # router/capacity model can score on
             "spec_accept_rate": 0.0,
-            # step telemetry (obs/stepstats.py): windowed host-overhead
-            # fraction of the engine step loop — the router's autoscale
+            # step telemetry (obs/stepstats.py): windowed DEVICE-IDLE
+            # fraction of the engine step loop, derived from per-chunk
+            # dispatch/retire timestamps (1 - union(device-busy)/span;
+            # on a serial loop this matches the historical
+            # host-work-share formula, which rides the same summary as
+            # step_phases.host_work_frac) — the router's autoscale
             # block takes the fleet max, replay/capacity calibration
             # records it next to the measured service rates, and the
-            # ROADMAP item-4 async refactor is A/B'd against it
-            # (0.0 for whole-batch servers / before the first step)
+            # async engine core is A/B'd against it (0.0 for
+            # whole-batch servers / before the first step)
             "step_host_overhead_frac": 0.0,
             # windowed engine throughput from the same /stepz summary —
             # the router watchtower's fleet rollup sums it
@@ -2909,15 +2938,18 @@ def parse_args(argv=None) -> argparse.Namespace:
         return n
 
     p.add_argument("--continuous-pipeline", type=_pipeline_depth,
-                   default=int(e("CONTINUOUS_PIPELINE", "0")),
+                   default=int(e("CONTINUOUS_PIPELINE", "1")),
                    help="decode-ahead depth: keep up to N dispatched "
-                        "chunks un-collected so readback latency overlaps "
-                        "compute (measured +52%% engine tokens/sec over a "
-                        "remote-attached chip at chunk 64 depth 1; depth "
-                        ">=2 is single-host only — the engine enforces "
-                        "it; multi-host: the chunk is announced "
-                        "dispatch-only and the gathers replay at "
-                        "OP_CB_COLLECT)")
+                        "chunks un-collected so step N's host work "
+                        "(scheduling, collect bookkeeping, delivery) "
+                        "overlaps the in-flight chunk's compute "
+                        "(default 1 — the async engine core; 0 = the "
+                        "serial A/B reference loop; measured +52%% "
+                        "engine tokens/sec over a remote-attached chip "
+                        "at chunk 64 depth 1; depth >=2 is single-host "
+                        "only — the engine enforces it; multi-host: "
+                        "the chunk is announced dispatch-only and the "
+                        "gathers replay at OP_CB_COLLECT)")
     p.add_argument("--schedule", choices=("fifo", "longest"),
                    default=e("CB_SCHEDULE", "fifo"),
                    help="slot admission policy: fifo (arrival order) or "

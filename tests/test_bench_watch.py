@@ -536,7 +536,12 @@ def test_stale_matrix_against_committed_trail():
               # cb --prefix-cache ships with a host-measured entry (the
               # prefill-elision ratio is backend-agnostic); listed so a
               # future argv rename can't orphan it silently either way
-              "cb --prefix-cache"}
+              "cb --prefix-cache",
+              # the async-core A/B reference ships as a committed
+              # `cb --serial --smoke` entry (the CPU box measures host
+              # overhead, the claim under test); the full-chip run is
+              # queued behind the next chip window like its peers
+              "cb --serial"}
     assert missing <= queued, (
         f"matrix workloads with no trail entry: {sorted(missing - queued)}")
 
@@ -607,6 +612,31 @@ def test_variant_regression_guard(monkeypatch):
     bench.annotate_variant_regression(
         ["resnet50", "--fused-bn", "--smoke"], plain)
     assert "vs_variant_baseline" not in plain
+
+
+def test_serial_variant_guard_flags_inverted_overlap(monkeypatch):
+    # The async engine core's A/B pair: `cb --serial` scores the
+    # unpipelined loop against the committed pipelined `cb` baseline.
+    # A serial run ABOVE the pipelined baseline means the overlap is
+    # hurting — the inversion this mapping exists to surface — while a
+    # serial run >10% below it is the expected shape and must flag as
+    # the (here: tolerated) variant regression so the delta is on
+    # record either way.
+    base_entry = {"ts": "2026-01-01T00:00:00+00:00", "argv": ["cb"],
+                  "result": {"metric": "m", "value": 3000.0,
+                             "unit": "useful_tokens/sec/chip"}}
+    monkeypatch.setattr(bench, "_latest_history", lambda argv: base_entry)
+    serial = {"metric": "m", "value": 2400.0,
+              "unit": "useful_tokens/sec/chip"}
+    bench.annotate_variant_regression(["cb", "--serial"], serial)
+    ab = serial["vs_variant_baseline"]
+    assert ab["baseline_argv"] == "cb"
+    assert ab["ratio"] == 0.8 and ab["regression"] is True
+    inverted = {"metric": "m", "value": 3300.0,
+                "unit": "useful_tokens/sec/chip"}
+    bench.annotate_variant_regression(["cb", "--serial"], inverted)
+    assert inverted["vs_variant_baseline"]["ratio"] == 1.1
+    assert "regression" not in inverted
 
 
 def test_variant_baselines_are_matrix_workloads():

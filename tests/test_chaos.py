@@ -412,6 +412,114 @@ def test_hung_step_watchdog_reaps_then_engine_recovers():
         front.shutdown()
 
 
+def test_pipelined_fault_with_inflight_successor_single_terminal():
+    """Async engine core: pipeline_depth=1, the fault fires on the
+    dispatch of step N+1 while step N's chunk is still in flight. The
+    rebuild must unwind the speculative in-flight chunk without a
+    double delivery or a page leak: exactly one terminal per span,
+    refcount audit green on the fresh engine, new traffic serves."""
+    model, params = _tiny_model()
+    front, fam = _front(model, params, num_slots=2, chunk=2,
+                        pipeline_depth=1)
+    rec = TraceRecorder(sample=1.0)
+    try:
+        warm = front.submit([1, 2, 3], 2)
+        assert len(front.wait(warm, timeout_s=120)) == 2
+        # fail@2: dispatch 1 (step N) succeeds and is LEFT IN FLIGHT;
+        # dispatch 2 (step N+1, the scheduled successor) faults
+        install(ChaosInjector.from_spec("engine.device_step:fail@2"))
+        spans = [rec.start_span(f"req{i}") for i in range(2)]
+        rids = [front.submit([4 + i, 5, 6], 8, span=spans[i])
+                for i in range(2)]
+        outcomes = []
+        for rid in rids:
+            try:
+                front.wait(rid, timeout_s=120)
+                outcomes.append("ok")
+            except RuntimeError:
+                outcomes.append("error")
+        assert "error" in outcomes  # nobody hung, nobody double-answered
+        assert fam["serve_engine_rebuilds_total"].value == 1
+        for sp in spans:
+            sp.finish()
+        traces = check_traces(rec.traces())
+        assert traces["ok"], traces["violations"]
+        assert traces["request_spans"] == 2
+        rid = front.submit([9, 9, 9], 3)
+        assert len(front.wait(rid, timeout_s=120)) == 3
+        assert not front.engine._inflight_q
+        out = check_front(front)
+        assert out["ok"], out["violations"]
+        audit = check_engine(front.engine)
+        assert audit["ok"], audit["violations"]
+    finally:
+        front.shutdown()
+
+
+def test_pipelined_hang_watchdog_reaps_and_relabels_hung_record():
+    """pipeline_depth=1 + engine.device_step hang on step N+1's
+    dispatch while step N is in flight: the watchdog fails the waiter
+    WELL before the hang clears, the wedged step's /stepz record is
+    relabeled outcome=reaped exactly once (the RIGHT record — the
+    successor's, not the in-flight predecessor's), the rebuild unwinds
+    the pipeline, and fresh traffic serves."""
+    model, params = _tiny_model()
+    hang_s = 3.0
+    front, fam = _front(model, params, num_slots=1, chunk=2,
+                        pipeline_depth=1, step_timeout_s=60.0)
+    try:
+        warm = front.submit([1, 2, 3], 2)
+        assert len(front.wait(warm, timeout_s=120)) == 2
+        seq0 = front.stepstats.next_seq
+        front.step_timeout_s = 0.25
+        install(ChaosInjector.from_spec(
+            f"engine.device_step:hang@2:{hang_s}"))
+        rid = front.submit([4, 5, 6], 8)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="watchdog"):
+            front.wait(rid, timeout_s=30)
+        # terminal came from the WATCHDOG, not the hang's end
+        assert time.monotonic() - t0 < hang_s * 0.75
+        assert fam["serve_step_watchdog_reaps_total"].value >= 1
+        deadline = time.monotonic() + 30
+        while fam["serve_engine_rebuilds_total"].value < 1:
+            assert time.monotonic() < deadline, "engine never rebuilt"
+            time.sleep(0.05)
+        rid2 = front.submit([7, 8], 3)
+        assert len(front.wait(rid2, timeout_s=120)) == 3
+        reaped = [r for r in front.stepstats.snapshot(n=1024)
+                  if r["outcome"] == "reaped"]
+        assert len(reaped) == 1  # one hung step -> one relabeled record
+        assert reaped[0]["seq"] >= seq0
+        seqs = [r["seq"] for r in front.stepstats.snapshot(n=1024)]
+        assert len(seqs) == len(set(seqs))  # never a duplicate record
+        out = check_front(front)
+        assert out["ok"], out["violations"]
+    finally:
+        front.shutdown()
+
+
+def test_pipelined_hot_swap_quiesces_inflight_chunk():
+    """swap_model on a pipelined front: the drain loop plus the
+    explicit engine.quiesce() settle the in-flight chunk, so a request
+    caught mid-flight by a generous-drain reload still finishes with
+    its tokens delivered (on the OLD weights) and nothing leaks."""
+    model, params = _tiny_model()
+    front, _fam = _front(model, params, num_slots=1, chunk=2,
+                         pipeline_depth=1)
+    try:
+        rid = front.submit([1, 2, 3], 6)
+        front.swap_model(model, params, None, drain_s=60.0)
+        toks = front.wait(rid, timeout_s=30)
+        assert len(toks) == 6
+        assert toks == _reference_tokens(model, params, [1, 2, 3], 6)
+        assert not front.engine._inflight_q
+        out = check_front(front)
+        assert out["ok"], out["violations"]
+    finally:
+        front.shutdown()
+
+
 def test_hot_swap_past_drain_bound_single_terminal_verdict():
     """A reload that drains past its bound delivers a 'reloading'
     RequestRejected to an ADMITTED request: the engine's

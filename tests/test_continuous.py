@@ -506,6 +506,30 @@ def test_decode_ahead_cancel_inflight_is_skipped():
     assert results[rid_keep] == _reference_tokens(model, params, keep, 10)
 
 
+def test_decode_ahead_quiesce_flushes_inflight_mid_run():
+    # quiesce() is the hot-swap/drain hook: it synchronously settles
+    # every in-flight chunk (bounded — at most pipeline_depth
+    # collects), so an engine about to be replaced never abandons a
+    # speculative chunk with tokens undelivered. Resuming afterwards
+    # keeps token parity with solo generate().
+    model, params = _tiny_model()
+    rng = np.random.default_rng(12)
+    prompt = rng.integers(1, 97, 9)
+    eng = ContinuousEngine(model, params, num_slots=1, chunk=2,
+                           buckets=(16,), pipeline_depth=1)
+    rid = eng.submit(prompt, max_new_tokens=10)
+    eng.step()  # dispatches chunk 1 and leaves it in flight
+    assert eng._inflight_q
+    finished = eng.quiesce()
+    assert not eng._inflight_q
+    results = {r.rid: r.tokens for r in finished}
+    results.update(dict(eng.run_until_drained()))
+    assert results[rid] == _reference_tokens(model, params, prompt, 10)
+    assert not eng._inflight_q
+    # idempotent on an already-quiet pipeline
+    assert eng.quiesce() == []
+
+
 def test_decode_ahead_validation():
     model, params = _tiny_model()
     with pytest.raises(ValueError, match="pipeline_depth"):

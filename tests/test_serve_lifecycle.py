@@ -306,6 +306,41 @@ def test_rebuild_mid_stream_terminates_every_open_stream(lm):
         front.shutdown()
 
 
+def test_pipelined_stream_ordering_and_tbt_capture(lm):
+    """Async engine core regression: with pipeline_depth=1 and the
+    one-results-lock-per-step delivery, per-token stream wakeups still
+    arrive in generation order (terminal [] strictly after the last
+    token group), the assembled stream is bit-identical to the
+    blocking path, and the TBT histogram captured the inter-delivery
+    gaps."""
+    from tests.test_continuous import _reference_tokens
+
+    model, params = lm
+    reg = MetricsRegistry()
+    fam = platform_families(reg)
+    front = _ContinuousFront(model, params, eos_id=None, num_slots=2,
+                             chunk=2, obs=fam, pipeline_depth=1)
+    try:
+        prompt = [1, 2, 3]
+        rid, q = front.submit_stream(prompt, 12)
+        groups, toks = [], []
+        while True:
+            item = q.get(timeout=120)
+            assert not isinstance(item, Exception), item
+            if item == []:
+                break
+            groups.append(list(item))
+            toks.extend(item)
+        assert toks == _reference_tokens(model, params, prompt, 12)
+        assert len(groups) >= 2  # chunked delivery: ordering at stake
+        assert q.empty()         # nothing follows the terminal
+        # a chunk lands as one delivery -> one TBT gap per follow-up
+        assert fam["serve_tbt_ms"].count >= len(groups) - 1
+        front.abandon(rid)
+    finally:
+        front.shutdown()
+
+
 def test_chaos_spec_injects_into_driver_loop(lm):
     model, params = lm
     reg = MetricsRegistry()

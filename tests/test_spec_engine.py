@@ -303,6 +303,121 @@ def test_spec_announce_stream_replays_with_nonzero_accepts(tiny):
             == np.asarray(replicas[-1].state.positions)).all()
 
 
+@pytest.mark.slow  # heavy compile set: chunked prefill + spec + replay
+def test_pipelined_announce_stream_replays_identically_to_serial(tiny):
+    """Record/replay parity is the async-core oracle: a
+    pipeline_depth=1 announce engine must emit the SAME tokens as the
+    serial engine (and solo generate()), and the OP_CB_* stream it
+    broadcast must replay on a worker into a BIT-IDENTICAL replica —
+    block tables and fill positions — across admission (whole AND
+    chunked-prefill pieces) and speculative rounds. Workers replay the
+    one-deep pipelined schedule exactly (deferred dispatch + matching
+    collect); any host-side reorder in the pipelined loop desyncs
+    here."""
+    from pyspark_tf_gke_tpu.train import continuous as cont
+    from pyspark_tf_gke_tpu.train import serving
+
+    model, paged, params = tiny
+    rng = np.random.default_rng(17)
+    p_long = rng.integers(1, 97, 50)   # admits in chunked pieces
+    p_short = rng.integers(1, 97, 9)   # admits whole
+    kw = dict(num_slots=2, chunk=6, buckets=(16, 32, 64),
+              prefill_chunk=32, spec_tokens=K)
+
+    serial = ContinuousEngine(paged, params, **kw)
+    s1 = serial.submit(p_long, max_new_tokens=8)
+    s2 = serial.submit(p_short, max_new_tokens=6)
+    serial_results = dict(serial.run_until_drained())
+
+    stream = []
+    real = serving._bcast
+
+    def recording(x):
+        stream.append(np.asarray(x).copy())
+        return real(x)
+
+    serving._bcast = recording
+    try:
+        eng = ContinuousEngine(paged, params, announce=True,
+                               pipeline_depth=1, **kw)
+        r1 = eng.submit(p_long, max_new_tokens=8)
+        r2 = eng.submit(p_short, max_new_tokens=6)
+        results = dict(eng.run_until_drained())
+        serving.announce_shutdown()
+    finally:
+        serving._bcast = real
+    # token parity: pipelined == serial == solo generate()
+    assert results[r1] == serial_results[s1]
+    assert results[r2] == serial_results[s2]
+    assert results[r1] == _reference_tokens(model, params, p_long, 8)
+    assert results[r2] == _reference_tokens(model, params, p_short, 6)
+    assert eng.stats["spec"]["accepted"] > 0
+    assert not eng._inflight_q
+    # the wire carried chunked-admit pieces, spec-width flags, and the
+    # one-deep deferred schedule with a collect per deferred dispatch
+    admit_flags = [int(h[7]) for h in stream
+                   if h.shape == (8,) and h[0] == serving.OP_CB_ADMIT]
+    assert any(f & 2 for f in admit_flags)
+    # draft prefill rides the whole admit / the FINAL chunked piece
+    assert any(f & 16 for f in admit_flags)
+    chunk_heads = [h for h in stream
+                   if h.shape == (8,) and h[0] == serving.OP_CB_CHUNK]
+    assert {int(h[7]) for h in chunk_heads} == {K}
+    deferred = [int(h[2]) for h in chunk_heads]
+    assert any(deferred), "pipelined schedule never crossed the wire"
+    collects = sum(1 for h in stream
+                   if h.shape == (8,) and h[0] == serving.OP_CB_COLLECT)
+    assert collects == sum(deferred)
+
+    replicas = []
+    orig = cont.SlotDeviceState
+
+    class Capturing(orig):
+        def __init__(self, *a, **kw2):
+            super().__init__(*a, **kw2)
+            replicas.append(self)
+
+    replay = list(stream)
+
+    def replaying(x):
+        got = replay.pop(0)
+        assert got.shape == np.asarray(x).shape, (
+            f"wire desync: worker expects {np.asarray(x).shape}, "
+            f"stream has {got.shape}")
+        return got
+
+    cont.SlotDeviceState = Capturing
+    serving._bcast = replaying
+    try:
+        served = serving.serve_worker_loop(paged, params, mesh=None)
+    finally:
+        serving._bcast = real
+        cont.SlotDeviceState = orig
+    assert not replay and served > 0
+
+    def block_tables(state):
+        out = []
+
+        def walk(pool):
+            if hasattr(pool, "keys"):
+                if "block_table" in pool:
+                    out.append(np.asarray(pool["block_table"]))
+                else:
+                    for key in pool:
+                        walk(pool[key])
+
+        walk(state.cache)
+        return out
+
+    mine = block_tables(eng._device.state)
+    theirs = block_tables(replicas[-1].state)
+    assert mine and len(mine) == len(theirs)
+    for a, b in zip(mine, theirs):
+        assert (a == b).all(), "replica block tables diverged"
+    assert (np.asarray(eng._device.state.positions)
+            == np.asarray(replicas[-1].state.positions)).all()
+
+
 def test_spec_stats_span_events_and_validation(tiny):
     # per-request accept-rate span event (the /traces speculation-
     # quality satellite) + constructor validation

@@ -108,6 +108,95 @@ def test_host_overhead_and_idle_fraction_math():
     assert s["phase_ms"]["device_wait"]["p50"] == pytest.approx(75.0)
 
 
+def test_interval_derivation_agrees_with_legacy_on_serial_loop():
+    """On a serial loop (device interval == the device_wait phase) the
+    interval-union derivation reproduces the legacy formula, so the
+    historical 0.25 pin carries over; intervals that fall entirely
+    outside the record window are clipped away."""
+    clock = _StubClock()
+    ring = StepStatsRing(capacity=8, window=8, clock=clock)
+    # garbage interval from long before the window: must be clipped out
+    ring.note_device_interval(0.0, 50.0)
+    for _ in range(4):
+        rec = ring.begin()
+        rec.tokens_out = 100
+        with rec.phase("schedule"):
+            clock.advance(0.025)  # 25 ms host
+        t0 = clock()
+        with rec.phase("device_wait"):
+            clock.advance(0.075)  # 75 ms device
+        ring.note_device_interval(t0, clock())
+        ring.close(rec)
+    s = ring.summary()
+    assert s["host_work_frac"] == pytest.approx(0.25)
+    assert s["host_overhead_frac"] == pytest.approx(0.25)
+    assert s["device_idle_fraction"] == pytest.approx(0.25)
+
+
+def test_interval_derivation_splits_below_legacy_when_overlapped():
+    """Pipelined loop: the host keeps working while the device computes,
+    so the busy intervals cover (nearly) the whole window even though
+    the legacy per-phase formula still charges all host time as
+    overhead.  host_overhead_frac (true idle) drops below
+    host_work_frac (host cost) — the overlap-live oracle."""
+    clock = _StubClock()
+    reg = MetricsRegistry()
+    fam = platform_families(reg)
+    ring = StepStatsRing(capacity=8, window=8, clock=clock)
+    ring.bind(fam, flops_per_token=1e5, peak_flops=1e9)
+    for _ in range(4):
+        rec = ring.begin()
+        rec.tokens_out = 10
+        t0 = clock()
+        with rec.phase("schedule"):
+            clock.advance(0.090)  # 90 ms host work...
+        with rec.phase("device_wait"):
+            clock.advance(0.010)  # ...only 10 ms blocked
+        # ...but the device was computing the whole step (overlap)
+        ring.note_device_interval(t0, clock())
+        ring.close(rec)
+    s = ring.summary()
+    assert s["host_work_frac"] == pytest.approx(0.9)
+    assert s["host_overhead_frac"] == pytest.approx(0.0, abs=1e-6)
+    assert s["host_overhead_frac"] < s["host_work_frac"]
+    # the gauge the router scrapes reflects the interval-derived value
+    assert fam["serve_device_idle_fraction"].value == pytest.approx(
+        0.0, abs=1e-6)
+
+
+def test_device_busy_ms_lands_in_snapshot_rows():
+    clock = _StubClock()
+    ring = StepStatsRing(capacity=4, clock=clock)
+    rec = ring.begin()
+    rec.tokens_out = 1
+    rec.device_busy_ms = 12.5
+    with rec.phase("collect"):
+        clock.advance(0.001)
+    ring.close(rec)
+    assert ring.snapshot()[0]["device_busy_ms"] == pytest.approx(12.5)
+
+
+def test_pipelined_engine_feeds_intervals_and_measures_idle():
+    """Real pipelined engine: dispatch/retire timestamps land in the
+    ring, device_busy_ms is amended onto records, and the
+    interval-derived fraction is a valid probability that never
+    exceeds the legacy host-cost number."""
+    model, params = _tiny_model()
+    eng = ContinuousEngine(model, params, num_slots=2, chunk=2,
+                           pipeline_depth=1)
+    for i in range(3):
+        eng.submit([1 + i, 2, 3], 4)
+    done = list(eng.run_until_drained())
+    assert len(done) == 3
+    assert not eng._inflight_q
+    assert len(eng.stepstats._intervals) > 0
+    s = eng.stepstats.summary()
+    assert 0.0 <= s["host_overhead_frac"] <= 1.0
+    assert s["host_overhead_frac"] <= s["host_work_frac"] + 1e-9
+    assert any(r["device_busy_ms"] > 0
+               for r in eng.stepstats.snapshot(n=1024))
+
+
 def test_ring_bounded_under_concurrent_writers():
     ring = StepStatsRing(capacity=32)
     errors = []

@@ -257,10 +257,14 @@ def lint_duplicate_metrics() -> int:
                 "serve_spec_accepted_total",
                 "serve_spec_accept_rate",
                 # engine step telemetry (obs/stepstats.py): the
-                # ROADMAP item-4 host/device decomposition — /stepz,
-                # the cb bench's step_phases block, /loadz
-                # step_host_overhead_frac and the router's autoscale
-                # fold all derive from these families
+                # host/device decomposition — /stepz, the cb bench's
+                # step_phases block, /loadz step_host_overhead_frac
+                # and the router's autoscale fold all derive from
+                # these families. serve_device_idle_fraction is the
+                # interval-derived (dispatch/retire) idle number
+                # since the async engine core; the --stepstats gate
+                # asserts it runs strictly below the same window's
+                # legacy host-work share (overlap is live)
                 "serve_step_host_overhead_ms",
                 "serve_step_phase_ms",
                 "serve_device_idle_fraction",
@@ -1589,6 +1593,10 @@ def stepstats_check(grace_s: float = 30.0) -> int:
        carry the ``deliver`` phase the driver loop amends on;
     2. the ``serve_step_host_overhead_ms`` histogram is populated and
        ``serve_device_idle_fraction`` is exported (``/metrics.json``);
+       the async-core overlap is LIVE — the interval-derived idle
+       fraction runs strictly below the same window's legacy
+       host-work share (``host_work_frac``), which is what a serial
+       loop would have reported on this box;
     3. ``/loadz`` advertises ``step_host_overhead_frac`` in [0, 1] —
        the value the router's autoscale block folds in;
     4. ``POST /admin/profile`` on a token-unconfigured server returns
@@ -1681,10 +1689,30 @@ def stepstats_check(grace_s: float = 30.0) -> int:
         if not (0.0 <= summary.get("host_overhead_frac", -1.0) <= 1.0):
             failures.append(f"/stepz summary host_overhead_frac out of "
                             f"range: {summary.get('host_overhead_frac')}")
+        # overlap is LIVE: the replica's default engine is pipelined
+        # (--continuous-pipeline 1), so the interval-derived idle
+        # fraction must run strictly below the SAME window's legacy
+        # host-work share (on a serial loop the two coincide — see
+        # obs/stepstats.py's measurement model). Same box, same
+        # process, same steps: the serial-baseline comparison with no
+        # second server. Equality means the engine never fed
+        # dispatch/retire intervals (derivation fell back) or the
+        # pipeline never actually overlapped host work with compute.
+        idle = summary.get("host_overhead_frac")
+        work = summary.get("host_work_frac")
+        if not isinstance(work, (int, float)):
+            failures.append("/stepz summary lacks host_work_frac (the "
+                            "legacy serial-formula share)")
+        elif not (isinstance(idle, (int, float)) and idle < work):
+            failures.append(
+                f"pipeline overlap not measurable: interval-derived "
+                f"idle {idle!r} is not strictly below the legacy "
+                f"host-work share {work!r}")
         if not failures:
             print(f"stepstats: /stepz {len(steps)} record(s), "
                   f"host_overhead_frac "
-                  f"{summary.get('host_overhead_frac')}, phase sums "
+                  f"{summary.get('host_overhead_frac')} < "
+                  f"host_work_frac {work} (overlap live), phase sums "
                   "reconcile")
 
         # -- 2: the derived metric families are live -----------------

@@ -158,8 +158,23 @@ def row(e: dict) -> str:
     if isinstance(sp, dict) and isinstance(
             sp.get("host_overhead_frac"), (int, float)):
         host_cell = f"{100 * sp['host_overhead_frac']:.1f}%"
+        work = sp.get("host_work_frac")
+        if (isinstance(work, (int, float))
+                and abs(work - sp["host_overhead_frac"]) > 0.005):
+            # async engine core: host_overhead_frac is true device
+            # idle (interval-derived) and splits below the legacy
+            # host-cost formula once the loop overlaps — render both
+            # so the overlap is visible in the published table
+            host_cell += f" (host work {100 * work:.1f}%)"
     else:
         host_cell = "—"
+    ssp = r.get("serial_step_phases")
+    if isinstance(ssp, dict) and isinstance(
+            ssp.get("host_overhead_frac"), (int, float)):
+        # same-run serial (--continuous-pipeline 0) reference: the
+        # A/B for the async core without hunting a second entry
+        extras.append(
+            f"serial_host_ovh {100 * ssp['host_overhead_frac']:.1f}%")
     load_1m = e.get("host_load_1m")
     load_pre = e.get("host_load_1m_pre")
     if isinstance(load_pre, (int, float)) and not isinstance(load_pre, bool):
