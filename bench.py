@@ -2309,6 +2309,288 @@ def bench_chaos(smoke: bool = False, stream_mix: bool = False) -> dict:
     return out
 
 
+def bench_autopilot(smoke: bool = False) -> dict:
+    """``python bench.py autopilot``: the closed-loop fleet controller
+    A/B'd against a static max-size fleet, plus its chaos scenario —
+    the evidence run behind docs/AUTOPILOT.md. Host-only like
+    ``router``/``replay``/``chaos``.
+
+    Phase A (diurnal A/B): one compressed sinusoidal "day" replayed
+    twice against the same bundle — (1) an autopilot fleet that BOOTS
+    with one replica (min 1 / max 3, LocalFleetActuator through the
+    router's token-gated admin plane, capacity model CALIBRATED
+    against a live replica first); (2) a static fleet pinned at the
+    max size. Decode is paced (chaos ``slow`` inject) so the diurnal
+    peak genuinely overloads one replica and the scale signals carry
+    information. The claim: BOTH runs hold the SLO, and the autopilot
+    run spends strictly fewer replica-minutes (measured by the
+    watchtower's ``replica_minutes`` accumulator over the replay
+    window). The static run doubles as the capacity-model anchor:
+    ``predict()`` on the calibrated model is checked against its
+    measured report within the documented PR-10 agreement band.
+
+    Phase B (chaos): a flash-crowd replay under the autopilot while a
+    ``kill_mid_scaleup`` schedule SIGKILLs a boot replica at the
+    burst's midpoint — i.e. while the controller is scaling up — and
+    restarts it later. Gates: every request reaches EXACTLY one
+    terminal outcome (``check_report``), the per-replica invariant
+    audits come back green, and the decision ring shows no decision
+    applied twice."""
+    import shutil
+    import tempfile
+    import urllib.request
+
+    from pyspark_tf_gke_tpu.chaos.invariants import (
+        check_replica,
+        check_report,
+    )
+    from pyspark_tf_gke_tpu.chaos.runner import ScheduleRunner
+    from pyspark_tf_gke_tpu.chaos.spec import synth_chaos
+    from pyspark_tf_gke_tpu.replay.capacity import (
+        FleetModel,
+        calibrate_rates,
+        check_agreement,
+        predict,
+    )
+    from pyspark_tf_gke_tpu.replay.driver import replay_spec
+    from pyspark_tf_gke_tpu.replay.generators import synth_spec
+    from pyspark_tf_gke_tpu.replay.slo import evaluate_slo
+    from pyspark_tf_gke_tpu.router.autopilot import (
+        Autopilot,
+        LocalFleetActuator,
+    )
+    from pyspark_tf_gke_tpu.router.localfleet import (
+        LocalFleet,
+        export_tiny_bundle,
+    )
+
+    scale = 0.5 if smoke else 1.0
+    duration = 48.0 * scale
+    MAX_REPLICAS = 3
+    TOKEN = "bench-autopilot"
+    # same prediction-vs-replay band as bench_replay (docs/REPLAY.md)
+    P99_BAND, SHED_ABS, SHED_REL = 5.0, 5, 0.5
+    # decode paced at 50 ms/step so one 1-slot replica saturates near
+    # the diurnal peak (~2.2 rps x ~0.5 s service) — the scale signals
+    # must carry real information, not CPU-tiny-model noise
+    replica_args = ("--continuous-slots", "1", "--max-queue-depth",
+                    "32", "--chaos", "engine.device_step:slow%1:0.05")
+    router_args = ("--admin-token", TOKEN,
+                   "--probe-interval", "0.3", "--probe-timeout", "1.0",
+                   "--fail-threshold", "2",
+                   "--alert-for", "0", "--alert-clear", "2.0")
+    diurnal = synth_spec("diurnal", seed=41, duration_s=duration,
+                         rate_rps=1.2, prompt_tokens=16,
+                         output_tokens=8, max_seq_len=64)
+    diurnal_slo = {"goodput_min": 0.9, "errors_max": 0,
+                   "shed_reasons_allowed": ["queue_full",
+                                            "no_reroute_target",
+                                            "no_replicas"]}
+
+    def _fleet_rollup(url):
+        with urllib.request.urlopen(url + "/fleetz", timeout=5) as r:
+            return json.loads(r.read()).get("fleet") or {}
+
+    def _rm(url):
+        return float(_fleet_rollup(url).get("replica_minutes") or 0.0)
+
+    def _mk_autopilot(fleet, model, **kw):
+        def source():
+            with urllib.request.urlopen(fleet.url + "/fleetz",
+                                        timeout=5) as r:
+                fz = json.loads(r.read())
+            with urllib.request.urlopen(fleet.url + "/alertz",
+                                        timeout=5) as r:
+                az = json.loads(r.read())
+            return fz, az
+
+        return Autopilot(
+            model, source=source,
+            actuator=LocalFleetActuator(fleet, admin_token=TOKEN),
+            tick_s=1.0, **kw)
+
+    def _decision_summary(ap):
+        acts = [d for d in ap.decisions if d["action"] != "none"]
+        return {
+            "decisions": len(ap.decisions),
+            "scale_ups_applied": sum(
+                1 for d in acts
+                if d["action"] == "scale_up" and d["applied"]),
+            "scale_downs_applied": sum(
+                1 for d in acts
+                if d["action"] == "scale_down" and d["applied"]),
+            "vetoes": sorted({v for d in ap.decisions
+                              for v in d["vetoes"]}),
+            "peak_desired": max(
+                (d["plan"]["replicas_needed"] for d in ap.decisions),
+                default=0),
+        }
+
+    tmp = tempfile.mkdtemp(prefix="bench-autopilot-")
+    calibration = None
+    try:
+        bundle = export_tiny_bundle(os.path.join(tmp, "bundle"))
+
+        # ---- phase A1: the autopilot fleet rides the diurnal ---------
+        with LocalFleet(1, bundle=bundle, router_args=router_args,
+                        replica_args=replica_args) as fleet:
+            fleet.warm()
+            # the model the controller plans with is MEASURED, slowdown
+            # and host costs folded in (PR-10 calibration contract)
+            calibration = calibrate_rates(fleet.replica_urls[0],
+                                          prompt_tokens=20,
+                                          output_tokens=16,
+                                          concurrency=4, total_slots=1)
+            model = FleetModel(
+                replicas=1, slots_per_replica=1, max_queue_depth=32,
+                prefill_tokens_per_sec=calibration[
+                    "prefill_tokens_per_sec"],
+                decode_tokens_per_sec=calibration[
+                    "decode_tokens_per_sec"])
+            ap = _mk_autopilot(fleet, model, min_replicas=1,
+                               max_replicas=MAX_REPLICAS,
+                               stabilization_s=4.0, cooldown_s=6.0)
+            rm0 = _rm(fleet.url)
+            ap.start()
+            try:
+                ap_report = replay_spec(diurnal, fleet.url,
+                                        speedup=1.0)
+            finally:
+                ap.stop()
+            ap_minutes = _rm(fleet.url) - rm0
+            ap_verdict = evaluate_slo(ap_report, diurnal_slo)
+            ap_decisions = _decision_summary(ap)
+
+        # ---- phase A2: the static max-size fleet, same day -----------
+        with LocalFleet(MAX_REPLICAS, bundle=bundle,
+                        router_args=router_args,
+                        replica_args=replica_args) as fleet:
+            fleet.warm()
+            rm0 = _rm(fleet.url)
+            st_report = replay_spec(diurnal, fleet.url, speedup=1.0)
+            st_minutes = _rm(fleet.url) - rm0
+            st_verdict = evaluate_slo(st_report, diurnal_slo)
+        predicted = predict(
+            FleetModel(
+                replicas=MAX_REPLICAS, slots_per_replica=1,
+                max_queue_depth=32,
+                prefill_tokens_per_sec=calibration[
+                    "prefill_tokens_per_sec"],
+                decode_tokens_per_sec=calibration[
+                    "decode_tokens_per_sec"]),
+            diurnal)
+        agreement = check_agreement(
+            predicted, st_report, p99_band=P99_BAND,
+            shed_band_abs=SHED_ABS, shed_band_rel=SHED_REL)
+        agreement["predicted_p99_ms"] = predicted["latency_ms"]["p99"]
+        agreement["measured_p99_ms"] = st_report["latency_ms"]["p99"]
+
+        # ---- phase B: kill a replica mid-scale-up --------------------
+        crowd_dur = 30.0 * scale
+        crowd = synth_spec("flash_crowd", seed=29, duration_s=crowd_dur,
+                           rate_rps=1.0, prompt_tokens=16,
+                           output_tokens=8, max_seq_len=64,
+                           burst_mult=8.0, burst_frac=0.3)
+        schedule = synth_chaos(
+            "kill_mid_scaleup", seed=29, duration_s=crowd_dur,
+            replicas=2, kill_at_s=0.5 * crowd_dur,
+            restart_s=0.25 * crowd_dur, name="bench-kill-mid-scaleup")
+        with LocalFleet(2, bundle=bundle, router_args=router_args,
+                        replica_args=replica_args) as fleet:
+            fleet.warm()
+            model = FleetModel(
+                replicas=2, slots_per_replica=1, max_queue_depth=32,
+                prefill_tokens_per_sec=calibration[
+                    "prefill_tokens_per_sec"],
+                decode_tokens_per_sec=calibration[
+                    "decode_tokens_per_sec"])
+            # stabilization pinned past the run: phase B's story is the
+            # kill during scale-UP; drains are phase A's (and the smoke
+            # gate's) story, and a mid-chaos drain would tear down the
+            # very replicas the invariant audit wants to interrogate
+            ap = _mk_autopilot(fleet, model, min_replicas=2,
+                               max_replicas=MAX_REPLICAS,
+                               stabilization_s=10 * crowd_dur,
+                               cooldown_s=6.0)
+            runner = ScheduleRunner(schedule, fleet)
+            ap.start()
+            try:
+                with runner:
+                    chaos_report = replay_spec(crowd, fleet.url,
+                                               speedup=1.0,
+                                               include_requests=True)
+            finally:
+                ap.stop()
+            closure = check_report(chaos_report, len(crowd.requests))
+            fleet.wait_idle(timeout_s=60)
+            invariants = [check_replica(u) for u in fleet.replica_urls]
+            chaos_decisions = _decision_summary(ap)
+            ids = [d["id"] for d in ap.decisions]
+            chaos_decisions["ids_unique"] = len(ids) == len(set(ids))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    minutes_ratio = (round(ap_minutes / st_minutes, 4)
+                     if st_minutes > 0 else None)
+    ok = bool(
+        ap_verdict["pass"] and st_verdict["pass"]
+        and minutes_ratio is not None and minutes_ratio < 1.0
+        and agreement["ok"] and closure["ok"]
+        and all(inv["ok"] for inv in invariants)
+        and chaos_decisions["ids_unique"])
+    return {
+        "metric": "autopilot_minutes_vs_static",
+        "value": minutes_ratio,
+        "unit": "ratio",
+        "vs_baseline": None,
+        "pass": ok,
+        "n_requests": {"diurnal": len(diurnal.requests),
+                       "flash_crowd": len(crowd.requests)},
+        "calibration": calibration,
+        "diurnal": {
+            "autopilot": {
+                "replica_minutes": round(ap_minutes, 4),
+                "goodput": ap_report["goodput"],
+                "outcomes": ap_report["outcomes"],
+                "latency_p99_ms": ap_report["latency_ms"]["p99"],
+                "slo_pass": ap_verdict["pass"],
+                "slo_failed": [c["name"] for c in ap_verdict["checks"]
+                               if not c["ok"]],
+                "decisions": ap_decisions,
+            },
+            "static": {
+                "replicas": MAX_REPLICAS,
+                "replica_minutes": round(st_minutes, 4),
+                "goodput": st_report["goodput"],
+                "outcomes": st_report["outcomes"],
+                "latency_p99_ms": st_report["latency_ms"]["p99"],
+                "slo_pass": st_verdict["pass"],
+                "slo_failed": [c["name"] for c in st_verdict["checks"]
+                               if not c["ok"]],
+            },
+        },
+        "capacity_agreement": agreement,
+        "chaos": {
+            "schedule": {"name": schedule.name, "seed": schedule.seed,
+                         "kill_at_s": 0.5 * crowd_dur,
+                         "restart_after_s": 0.25 * crowd_dur},
+            "outcomes": chaos_report["outcomes"],
+            "sheds": chaos_report["sheds"],
+            "goodput": chaos_report["goodput"],
+            "terminal_closure": closure,
+            "replica_invariants": invariants,
+            "decisions": chaos_decisions,
+        },
+        "workload": ("closed-loop autopilot vs static max-size fleet "
+                     "on a compressed diurnal day (SLO + replica-"
+                     "minutes A/B, calibrated capacity model checked "
+                     "in the PR-10 band), then a flash-crowd replay "
+                     "with a replica SIGKILLed mid-scale-up — exactly-"
+                     "one-terminal closure + invariant audits "
+                     "(docs/AUTOPILOT.md)"),
+    }
+
+
 # ---- orchestrator ----------------------------------------------------------
 
 
@@ -2740,6 +3022,10 @@ ALL_WORKLOADS = (
     # outage-window STREAM goodput through the router's journal +
     # continuation splice (zero lost streams; host-only)
     ["chaos", "--stream"],
+    # closed-loop autopilot A/B: diurnal day vs static max-size fleet
+    # (SLO + replica-minutes, capacity model in band) + flash-crowd
+    # with a replica killed mid-scale-up (host-only)
+    ["autopilot"],
     ["spec"],  # device-loop tok/s + the 0.75-skew fixture's acceptance
     ["generate", "--beams", "4"],  # broadcast-select reorder rebuild A/B
     # --- measured re-confirmations ---
@@ -2761,6 +3047,11 @@ ALL_WORKLOADS = (
 GATE_ATTACH_FAILED = ("backend attach failed (probed once for the "
                       "whole matrix)")
 
+# workloads that never touch a device: io is pure TFRecord I/O, and the
+# router/replay/chaos/autopilot fleets are CPU-pinned subprocesses by
+# design — a down TPU tunnel must never gate them
+HOST_ONLY_WORKLOADS = ("io", "router", "replay", "chaos", "autopilot")
+
 
 def _run_matrix(extra, backend_ok: bool, skip=(),
                 gate_reason: str = GATE_ATTACH_FAILED) -> int:
@@ -2775,13 +3066,13 @@ def _run_matrix(extra, backend_ok: bool, skip=(),
         if list(argv) in [list(s) for s in skip]:
             continue
         log(f"=== bench matrix: {' '.join(argv)} ===")
-        if argv[0] not in ("io", "router", "replay", "chaos") and not backend_ok:
+        if argv[0] not in HOST_ONLY_WORKLOADS and not backend_ok:
             print(json.dumps(_error_json(list(argv), "probe", gate_reason)))
             failures += 1
             continue
         rc = orchestrate([*argv, *extra], skip_probe=True)
         failures += 1 if rc else 0
-        if rc and argv[0] not in ("io", "router", "replay", "chaos") \
+        if rc and argv[0] not in HOST_ONLY_WORKLOADS \
                 and "--smoke" not in extra and backend_ok:
             # A device workload just failed mid-matrix. The usual cause in
             # this environment is the tunnel dying UNDER the matrix (it
@@ -2892,7 +3183,7 @@ def orchestrate(argv, skip_probe: bool = False) -> int:
     # don't let a down backend block the benches that don't need it.
     # --smoke runs pin the CPU fake slice (the --run child forces the
     # platform), so a down tunnel must not block them either.
-    if (workload not in ("io", "router", "replay", "chaos") and "--smoke" not in argv
+    if (workload not in HOST_ONLY_WORKLOADS and "--smoke" not in argv
             and not skip_probe and not probe_backend()):
         print(json.dumps(_error_json(
             list(argv), "probe",
@@ -2922,7 +3213,7 @@ def orchestrate(argv, skip_probe: bool = False) -> int:
         except subprocess.TimeoutExpired:
             last = f"bench run timed out after {RUN_TIMEOUT_S}s"
             log(f"[run {attempt + 1}/{RUN_ATTEMPTS}] {last}")
-            if (workload not in ("io", "router", "replay", "chaos")
+            if (workload not in HOST_ONLY_WORKLOADS
                     and "--smoke" not in argv
                     and attempt < RUN_ATTEMPTS - 1):
                 # A full-RUN_TIMEOUT_S hang usually means the tunnel died
@@ -3052,6 +3343,8 @@ def run_bench(argv) -> dict:
         return bench_replay(smoke=smoke)
     if workload == "chaos":
         return bench_chaos(smoke=smoke, stream_mix="--stream" in argv)
+    if workload == "autopilot":
+        return bench_autopilot(smoke=smoke)
     if workload == "cb":
         if "--chunked-prefill" in argv:
             return bench_chunked_prefill(smoke=smoke)

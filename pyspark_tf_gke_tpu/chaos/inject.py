@@ -66,6 +66,11 @@ FAULT_POINTS: Dict[str, str] = {
     # must stop (untouched replicas keep serving) and the coordinator
     # must resume the publish stage on its next round entry
     "pipeline.publish": "replica publish (POST /admin/reload) failure",
+    # autopilot actuation: one scale-up/scale-down application raises
+    # before the action takes effect — the decision must retry with
+    # backoff and apply EXACTLY once (never double-started, never
+    # double-drained), which the autopilot tests assert in closed form
+    "autopilot.actuate": "autopilot scale actuation failure",
 }
 
 _ACTIONS = ("fail", "slow", "hang")

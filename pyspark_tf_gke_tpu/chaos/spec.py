@@ -238,6 +238,21 @@ def synth_chaos(kind: str, *, seed: int = 0, duration_s: float = 10.0,
       — every client stream must still reach ``[DONE]`` token-exact
       (zero missing, zero duplicated tokens through the router's
       continuation splice).
+    * ``kill_mid_scaleup`` — the autopilot's scale-event scenario:
+      SIGKILL one of the BOOT replicas at a pinned offset
+      (``kill_at_s``, default 0.5 × duration — inside the flash-crowd
+      window, i.e. while the autopilot is scaling up), optional
+      ``restart_s``. Victim defaults to replica 0 so the kill hits a
+      replica that existed before the scale-up (the freshly-started
+      one is not in the schedule's index space). Gate on
+      exactly-one-terminal (``check_report`` / ``check_traces``).
+    * ``hang_drain`` — the scale-DOWN chaos scenario: SIGSTOP the
+      designated drain victim (``victim``, default the highest boot
+      index — the autopilot evicts the coldest, which a cold fresh
+      replica is) at ``at_s`` (default 0.7 × duration, after a demand
+      peak) for ``hang_s`` (default duration/4): the drain the
+      autopilot requested hangs instead of exiting, and the do-no-harm
+      machinery must neither double-drain nor lose requests.
     """
     events: List[ChaosEvent] = []
     if kind == "kill_mid_stream":
@@ -258,6 +273,21 @@ def synth_chaos(kind: str, *, seed: int = 0, duration_s: float = 10.0,
     elif kind == "hang_one":
         victim = int(_mix(seed, "victim") * replicas) % replicas
         at = duration_s * (0.35 + 0.3 * _mix(seed, "at"))
+        hang_s = float(params.pop("hang_s", duration_s / 4))
+        events.append(ChaosEvent(offset_s=at, action="stop",
+                                 target=f"replica:{victim}",
+                                 duration_s=hang_s))
+    elif kind == "kill_mid_scaleup":
+        victim = int(params.pop("victim", 0)) % replicas
+        at = float(params.pop("kill_at_s", duration_s * 0.5))
+        restart_s = params.pop("restart_s", None)
+        events.append(ChaosEvent(
+            offset_s=at, action="kill", target=f"replica:{victim}",
+            restart_s=(float(restart_s)
+                       if restart_s is not None else None)))
+    elif kind == "hang_drain":
+        victim = int(params.pop("victim", replicas - 1)) % replicas
+        at = float(params.pop("at_s", duration_s * 0.7))
         hang_s = float(params.pop("hang_s", duration_s / 4))
         events.append(ChaosEvent(offset_s=at, action="stop",
                                  target=f"replica:{victim}",
@@ -285,7 +315,8 @@ def synth_chaos(kind: str, *, seed: int = 0, duration_s: float = 10.0,
     else:
         raise ValueError(
             f"unknown chaos kind {kind!r} (known: kill_one, hang_one, "
-            "flaky_probes, storm, kill_mid_stream)")
+            "flaky_probes, storm, kill_mid_stream, kill_mid_scaleup, "
+            "hang_drain)")
     if params:
         raise ValueError(f"unknown synth_chaos params: {sorted(params)}")
     events.sort(key=lambda ev: ev.offset_s)

@@ -194,6 +194,7 @@ def handle_obs_request(
         # docs/OBSERVABILITY.md "Fleet watchtower".
         n = 32
         replica = None
+        since = None
         for part in query.split("&"):
             key, _, val = part.partition("=")
             try:
@@ -201,10 +202,18 @@ def handle_obs_request(
                     n = max(1, min(int(val), 1024))
                 elif key == "replica" and val:
                     replica = val
+                elif key == "since" and val:
+                    # incremental cursor: the ``cursor`` value a
+                    # previous /fleetz read returned — history then
+                    # carries only strictly newer buckets
+                    since = float(val)
+                    if since < 0:
+                        raise ValueError(val)
             except ValueError:
                 return (400, "application/json",
                         b'{"error": "bad /fleetz query parameter"}')
-        body = json.dumps(watchtower.fleetz(n=n, replica=replica))
+        body = json.dumps(watchtower.fleetz(n=n, replica=replica,
+                                            since=since))
         return 200, "application/json", body.encode()
     if route == "/alertz" and watchtower is not None:
         # live alert plane: configured SLO + windows, every alert's

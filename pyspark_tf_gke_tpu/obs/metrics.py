@@ -973,3 +973,50 @@ def chaos_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "every scenario",
             labelnames=("action",)),
     }
+
+
+def autopilot_families(registry: Optional[MetricsRegistry] = None) -> dict:
+    """Register (idempotently) the autopilot's metric families.
+
+    The closed-loop fleet controller (``router/autopilot.py``) reads
+    the watchtower's rollups and alert plane, runs the calibrated
+    capacity arithmetic (``replay/capacity.py plan_replicas``), and
+    scales the fleet through a pluggable actuator. Every family here
+    is a controller-health signal: an autopilot that ticks but never
+    decides, or decides but keeps vetoing, is visible on one scrape.
+    Defined here so the whole platform's metric names keep one
+    definition site and the duplicate-name lint covers them."""
+    r = registry if registry is not None else get_registry()
+    return {
+        "autopilot_ticks_total": r.counter(
+            "autopilot_ticks_total",
+            "Decision passes the autopilot completed (every tick "
+            "produces a decision record, even a no-op)"),
+        "autopilot_decisions_total": r.counter(
+            "autopilot_decisions_total",
+            "Decisions by action (none | scale_up | scale_down) — "
+            "the controller's full output taxonomy",
+            labelnames=("action",)),
+        "autopilot_vetoes_total": r.counter(
+            "autopilot_vetoes_total",
+            "Scale actions the capacity arithmetic wanted but a "
+            "do-no-harm guard blocked, by reason (alerts_active | "
+            "rollout_in_progress | stabilization | cooldown | rails)",
+            labelnames=("reason",)),
+        "autopilot_actuations_total": r.counter(
+            "autopilot_actuations_total",
+            "Actuator calls by action and outcome (ok | failed) — "
+            "failed means every retry was exhausted; the decision is "
+            "dropped, never half-applied",
+            labelnames=("action", "outcome")),
+        "autopilot_actuation_retries_total": r.counter(
+            "autopilot_actuation_retries_total",
+            "Actuation attempts retried after a transient failure "
+            "(chaos point autopilot.actuate fires here) — backoff "
+            "between attempts, exactly-once application"),
+        "autopilot_replicas_desired": r.gauge(
+            "autopilot_replicas_desired",
+            "The capacity model's current replica ask (post-rails, "
+            "pre-hysteresis) — diverging from the fleet's up count "
+            "is the scale-pressure signal"),
+    }

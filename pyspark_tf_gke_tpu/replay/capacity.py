@@ -497,6 +497,69 @@ def check_agreement(predicted: dict, measured: dict, *,
             "shed_band_rel": shed_band_rel}
 
 
+def plan_replicas(model: FleetModel, *, demand_tokens: float,
+                  queue_delay_ms: float, replicas_up: int,
+                  min_replicas: int = 1, max_replicas: int = 8,
+                  drain_target_s: float = 5.0,
+                  queue_delay_target_ms: float = 500.0) -> dict:
+    """The capacity model's DECISION face: how many replicas the fleet
+    needs right now, from the two measured signals the watchtower
+    rollup (and the HPA manifest) already carry — outstanding token
+    demand (``demand_tokens_total``) and worst queue delay
+    (``queue_delay_ms_max``). Closed form (the autopilot tests pin it):
+
+    * a replica's sustained throughput is ``slots_per_replica x``
+      :meth:`FleetModel.effective_decode_rate` (decode-dominated, the
+      same rate the DES drains slots at);
+    * ``replicas_needed`` is the count that drains the measured
+      backlog within ``drain_target_s`` —
+      ``ceil(demand / (per_replica_tps * drain_target_s))``;
+    * queue delay is the second, demand-independent signal (exactly
+      the :func:`derive_hpa_targets` pairing): waiting longer than
+      ``queue_delay_target_ms`` while demand alone says the fleet is
+      big enough still asks for ONE more replica than is up;
+    * the result is clamped to ``[min_replicas, max_replicas]`` — the
+      rails are part of the plan, not the caller's afterthought.
+
+    Pure arithmetic over one rollup: no hysteresis, no cooldowns —
+    those are the AUTOPILOT's job (``router/autopilot.py``), which
+    wraps this plan in rails, stabilization windows and vetoes."""
+    model.validate()
+    if min_replicas < 1 or max_replicas < min_replicas:
+        raise ValueError("need 1 <= min_replicas <= max_replicas")
+    if drain_target_s <= 0:
+        raise ValueError("drain_target_s must be > 0")
+    per_replica_tps = (model.slots_per_replica
+                       * model.effective_decode_rate())
+    demand = max(0.0, float(demand_tokens))
+    demand_replicas = math.ceil(demand
+                                / (per_replica_tps * drain_target_s))
+    delay_bump = (queue_delay_ms is not None
+                  and float(queue_delay_ms) > queue_delay_target_ms
+                  and demand_replicas <= int(replicas_up))
+    needed = max(demand_replicas,
+                 int(replicas_up) + 1 if delay_bump else 0)
+    clamped = max(min_replicas, min(max_replicas, needed))
+    cap = int(replicas_up) * per_replica_tps * drain_target_s
+    return {
+        "kind": "pyspark_tf_gke_tpu.capacity_plan",
+        "replicas_needed": clamped,
+        "replicas_unclamped": needed,
+        "replicas_up": int(replicas_up),
+        "per_replica_tokens_per_sec": round(per_replica_tps, 3),
+        "demand_tokens": round(demand, 1),
+        "queue_delay_ms": (round(float(queue_delay_ms), 3)
+                           if queue_delay_ms is not None else None),
+        "utilization": (round(demand / cap, 4) if cap > 0 else None),
+        "signals": {"demand_replicas": demand_replicas,
+                    "queue_delay_bump": bool(delay_bump)},
+        "rails": {"min_replicas": min_replicas,
+                  "max_replicas": max_replicas,
+                  "drain_target_s": drain_target_s,
+                  "queue_delay_target_ms": queue_delay_target_ms},
+    }
+
+
 def derive_hpa_targets(*, kv_pages: int = 256, page_size: int = 16,
                        decode_chunk_tokens: int = 64,
                        decode_tokens_per_sec: float = 128.0) -> dict:

@@ -202,6 +202,60 @@ class ReplicaSet:
                                      prev=DOWN, state="removed",
                                      reason="absent from DNS")
 
+    def add(self, urls: List[str]) -> List[str]:
+        """Runtime registration (``POST /admin/replicas`` add, the
+        autopilot actuator's path after starting a replica): each URL
+        joins as a STATIC entry (never DNS-pruned), state DOWN until a
+        probe confirms it — merge-not-replace, so re-adding a known
+        URL is a no-op that keeps its live state. Returns the rids
+        actually added."""
+        added = []
+        with self._lock:
+            for url in urls:
+                url = str(url).strip().rstrip("/")
+                if not url:
+                    continue
+                if "://" not in url:
+                    url = "http://" + url
+                if url not in self._replicas:
+                    self._replicas[url] = Replica(rid=url, base_url=url)
+                    added.append(url)
+        for rid in added:
+            logger.info("replica %s added (admin)", rid)
+            if self._obs is not None:
+                self._obs["router_replica_up"].labels(replica=rid).set(0)
+            if self._event_log is not None:
+                self._event_log.emit("router_replica_state", replica=rid,
+                                     prev="absent", state=DOWN,
+                                     reason="admin add")
+        return added
+
+    def remove(self, urls: List[str]) -> List[str]:
+        """Runtime deregistration (``POST /admin/replicas`` remove, the
+        autopilot's scale-down path BEFORE draining the victim): the
+        replica leaves the routing table immediately — its open
+        streams finish (the gateway holds its own reference), it just
+        gets no new work. Unknown URLs are ignored (idempotent: a
+        retried remove must not error). Returns the rids removed."""
+        removed = []
+        with self._lock:
+            for url in urls:
+                url = str(url).strip().rstrip("/")
+                if url and "://" not in url:
+                    url = "http://" + url
+                r = self._replicas.pop(url, None)
+                if r is not None:
+                    removed.append(url)
+        for rid in removed:
+            logger.info("replica %s removed (admin)", rid)
+            if self._obs is not None:
+                self._obs["router_replica_up"].labels(replica=rid).set(0)
+            if self._event_log is not None:
+                self._event_log.emit("router_replica_state", replica=rid,
+                                     prev="?", state="removed",
+                                     reason="admin remove")
+        return removed
+
     def set_state(self, rid: str, state: str, load: Optional[dict] = None,
                   reason: str = "") -> None:
         """One transition point: metrics gauge + event emit live here so

@@ -48,6 +48,7 @@ the cached verdict instead of generating twice.
 from __future__ import annotations
 
 import argparse
+import hmac
 import itertools
 import json
 import os
@@ -139,7 +140,8 @@ class RouterServer:
                  slo: Optional[dict] = None,
                  alert_windows: str = DEFAULT_ALERT_WINDOWS,
                  alert_for_s: float = 0.0,
-                 alert_clear_s: float = 30.0):
+                 alert_clear_s: float = 30.0,
+                 admin_token: Optional[str] = None):
         self.registry = registry if registry is not None else get_registry()
         self._obs = router_families(self.registry)
         self.event_log = (event_log if event_log is not None
@@ -163,6 +165,7 @@ class RouterServer:
             self.replicas, slo=slo, windows=alert_windows,
             for_s=alert_for_s, clear_s=alert_clear_s,
             obs=self._obs, event_log=self.event_log)
+        self.admin_token = admin_token or None
         self.affinity_tokens = int(affinity_tokens)
         self.inflight_cap = int(inflight_cap)
         self.hedge_enabled = bool(hedge)
@@ -281,6 +284,49 @@ class RouterServer:
     def http_inflight(self) -> int:
         with self._http_lock:
             return self._http_inflight
+
+    # -- admin plane -----------------------------------------------------
+
+    def admin_token_error(self, supplied: Optional[str]):
+        """Token gate for the ``/admin/*`` POSTs, the replica's
+        taxonomy (train/serve.py) mirrored: 403 while no token is
+        configured (fail-closed — the admin plane must be explicitly
+        enabled), 401 on a missing/wrong token (constant-time
+        compare), ``None`` when authorized."""
+        if not self.admin_token:
+            return 403, {"error": "admin endpoint disabled "
+                                  "(set ROUTER_ADMIN_TOKEN to enable)"}
+        if not hmac.compare_digest(str(supplied or ""),
+                                   self.admin_token):
+            return 401, {"error": "bad or missing X-Admin-Token"}
+        return None
+
+    def admin_replicas(self, req: dict) -> Tuple[int, dict]:
+        """``POST /admin/replicas`` body ``{"add": [urls], "remove":
+        [urls]}`` — runtime membership edits through
+        :meth:`ReplicaSet.add`/``remove`` (merge-not-replace: existing
+        replicas keep their state/backoff; an added replica starts
+        DOWN until the prober admits it and is never pruned by DNS
+        absence). This is the autopilot's actuation door AND an
+        operator escape hatch."""
+        unknown = set(req) - {"add", "remove"}
+        if unknown:
+            return 400, {"error": f"unknown keys {sorted(unknown)} "
+                                  "(want add and/or remove)"}
+        add = req.get("add", [])
+        remove = req.get("remove", [])
+        if not isinstance(add, list) or not isinstance(remove, list):
+            return 400, {"error": "add/remove must be URL lists"}
+        if not add and not remove:
+            return 400, {"error": "body must carry add and/or remove"}
+        added = self.replicas.add([str(u) for u in add]) if add else []
+        removed = (self.replicas.remove([str(u) for u in remove])
+                   if remove else [])
+        self.event_log.emit("router_admin_replicas", added=added,
+                            removed=removed,
+                            replicas=len(self.replicas))
+        return 200, {"added": added, "removed": removed,
+                     "replicas": self.replicas.snapshot()}
 
     # -- routing ---------------------------------------------------------
 
@@ -1476,6 +1522,17 @@ def _make_handler(router: RouterServer):
                 req = json.loads(self.rfile.read(n) or b"{}")
             except (ValueError, json.JSONDecodeError) as exc:
                 return self._reply(400, {"error": f"bad JSON body: {exc}"})
+            if self.path == "/admin/replicas":
+                # token gate FIRST: an unauthorized caller learns
+                # nothing about the body's validity
+                err = router.admin_token_error(
+                    self.headers.get("X-Admin-Token"))
+                if err is not None:
+                    return self._reply(*err)
+                if not isinstance(req, dict):
+                    return self._reply(400, {"error": "body must be a "
+                                                      "JSON object"})
+                return self._reply(*router.admin_replicas(req))
             if self.path not in ("/v1/generate", "/v1/score", "/v1/warm"):
                 return self._reply(404,
                                    {"error": f"unknown path {self.path}"})
@@ -1629,6 +1686,44 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default=float(e("ROUTER_ALERT_CLEAR", "30")),
                    help="seconds of quiet before firing -> resolved "
                         "(hysteresis: flapping input fires once)")
+    p.add_argument("--admin-token", default=e("ROUTER_ADMIN_TOKEN", ""),
+                   help="shared secret for POST /admin/* (runtime "
+                        "replica registration — the autopilot's "
+                        "actuation door); empty = admin plane disabled "
+                        "(requests get 403)")
+    p.add_argument("--autopilot", choices=("off", "recommend"),
+                   default=e("ROUTER_AUTOPILOT", "off"),
+                   help="closed-loop fleet controller "
+                        "(router/autopilot.py): 'recommend' runs the "
+                        "decision loop against the in-process "
+                        "watchtower and emits autopilot_decision "
+                        "events + metrics WITHOUT actuating — the k8s "
+                        "HPA stays in charge and operators A/B the "
+                        "two before trusting the loop")
+    p.add_argument("--autopilot-tick", type=float,
+                   default=float(e("ROUTER_AUTOPILOT_TICK", "15")),
+                   help="seconds between autopilot decision passes")
+    p.add_argument("--autopilot-min", type=int,
+                   default=int(e("ROUTER_AUTOPILOT_MIN", "1")),
+                   help="autopilot scale rail: never below this many "
+                        "replicas")
+    p.add_argument("--autopilot-max", type=int,
+                   default=int(e("ROUTER_AUTOPILOT_MAX", "8")),
+                   help="autopilot scale rail: never above this many "
+                        "replicas")
+    p.add_argument("--autopilot-stabilization", type=float,
+                   default=float(e("ROUTER_AUTOPILOT_STABILIZATION",
+                                   "300")),
+                   help="seconds desired < up must hold before a "
+                        "scale-down is issued (the HPA's "
+                        "stabilizationWindowSeconds, mirrored so the "
+                        "two controllers never fight)")
+    p.add_argument("--autopilot-model",
+                   default=e("ROUTER_AUTOPILOT_MODEL", ""),
+                   help="calibrated FleetModel JSON for the capacity "
+                        "arithmetic: inline JSON or @path (the "
+                        "tools/replay.py calibrate dump); empty = "
+                        "conservative defaults")
     p.add_argument("--chaos", default=e("ROUTER_CHAOS", ""),
                    help="router-side fault injection over named fault "
                         "points (chaos/inject.py): e.g. "
@@ -1687,7 +1782,33 @@ def main(argv=None) -> int:
         slo=slo,
         alert_windows=args.alert_windows,
         alert_for_s=args.alert_for,
-        alert_clear_s=args.alert_clear)
+        alert_clear_s=args.alert_clear,
+        admin_token=args.admin_token)
+    autopilot = None
+    if args.autopilot != "off":
+        from pyspark_tf_gke_tpu.router.autopilot import (
+            Autopilot,
+            RecommendActuator,
+            load_fleet_model,
+        )
+
+        try:
+            fleet_model = load_fleet_model(args.autopilot_model)
+        except (ValueError, OSError) as exc:
+            print(f"bad --autopilot-model spec: {exc}", file=sys.stderr)
+            return 2
+        autopilot = Autopilot(
+            fleet_model,
+            source=lambda: (router.watchtower.fleetz(n=1),
+                            router.watchtower.alertz()),
+            actuator=RecommendActuator(event_log=router.event_log),
+            min_replicas=args.autopilot_min,
+            max_replicas=args.autopilot_max,
+            tick_s=args.autopilot_tick,
+            stabilization_s=args.autopilot_stabilization,
+            registry=router.registry,
+            event_log=router.event_log,
+            tracer=router.tracer)
     prober = HealthProber(
         router.replicas, interval_s=args.probe_interval,
         timeout_s=args.probe_timeout, fail_threshold=args.fail_threshold,
@@ -1696,6 +1817,12 @@ def main(argv=None) -> int:
         on_sweep=router.watchtower.sweep)
     prober.probe_once()  # first sweep before accepting traffic
     prober.start()
+    if autopilot is not None:
+        autopilot.start()
+        logger.warning("autopilot ACTIVE in %s mode (tick=%.1fs, "
+                       "rails=[%d, %d])", args.autopilot,
+                       args.autopilot_tick, args.autopilot_min,
+                       args.autopilot_max)
     httpd = start_router_http_server(router, args.host, args.port)
     router.event_log.emit("router_started",
                           replicas=[r.rid for r in router.replicas.all()],
@@ -1728,6 +1855,8 @@ def main(argv=None) -> int:
         logger.info("shutting down")
         httpd.shutdown()
     finally:
+        if autopilot is not None:
+            autopilot.stop()
         prober.stop()
     return 0
 

@@ -349,6 +349,46 @@ class LocalFleet:
         if self.procs[i].poll() is None:
             self.procs[i].send_signal(signal.SIGCONT)
 
+    # -- scale hooks (router/autopilot.py actuates through these) --------
+
+    def start_replica(self) -> str:
+        """Boot ONE additional replica (the scale-up actuation shape):
+        fresh port, same bundle and args, appended to
+        ``procs``/``replica_ports``; returns its base URL once
+        ``/healthz`` answers. The caller registers it with the router
+        (POST /admin/replicas) — a booted-but-unregistered replica
+        receives no traffic."""
+        if self._bundle_dir is None:
+            raise RuntimeError("fleet never booted")
+        port = free_port()
+        proc = launch_replica(self._bundle_dir, port,
+                              extra_args=self.replica_args,
+                              quiet=self.quiet)
+        self.replica_ports.append(port)
+        self.procs.append(proc)
+        self.n_replicas = len(self.procs)
+        url = f"http://127.0.0.1:{port}"
+        wait_healthy(url, time.time() + self.boot_timeout_s, proc)
+        return url
+
+    def drain_replica(self, i: int, timeout_s: float = 30.0) -> bool:
+        """SIGTERM replica ``i`` — the graceful-eviction shape: serve's
+        drain path finishes in-flight work, then the process exits.
+        Returns whether it exited within ``timeout_s`` (False = still
+        draining, e.g. the hung-drain chaos case — the caller decides
+        whether to escalate to :meth:`kill_replica`)."""
+        import signal
+
+        proc = self.procs[i]
+        if proc.poll() is not None:
+            return True
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=timeout_s)
+            return True
+        except subprocess.TimeoutExpired:
+            return False
+
     def restart_replica(self, i: int) -> None:
         """Relaunch replica ``i`` on its ORIGINAL port and args (the
         k8s pod-replacement shape: same Service endpoint, fresh

@@ -84,6 +84,7 @@ FLEET_ROLLUP_KEYS = (
     "step_host_overhead_frac_max", "prefix_hit_rate_mean",
     "spec_accept_rate_mean", "step_tokens_per_sec_total",
     "queued_total", "active_total", "bundle_generations",
+    "replica_minutes",
 )
 
 # per-replica record inside a bucket / the /fleetz replicas map
@@ -94,7 +95,7 @@ REPLICA_SNAPSHOT_KEYS = (
 )
 
 FLEETZ_KEYS = ("bucket_s", "ring_max", "buckets", "sweeps_total",
-               "fleet", "replicas", "history")
+               "fleet", "replicas", "history", "cursor")
 
 ALERTZ_KEYS = ("slo", "windows", "for_s", "clear_s", "min_samples",
                "alerts", "firing", "burn_rates", "history", "slo_eval")
@@ -251,11 +252,28 @@ class FleetSnapshotRing:
         with self._lock:
             return self._ring[-1][1] if self._ring else None
 
-    def history(self, n: Optional[int] = None) -> List[dict]:
-        """Oldest -> newest bucket entries (bounded by ``n``)."""
+    def history(self, n: Optional[int] = None,
+                since: Optional[float] = None) -> List[dict]:
+        """Oldest -> newest bucket entries (bounded by ``n``).
+        ``since`` is a bucket cursor (bucket start time, seconds in
+        the monotonic domain — the ``cursor`` value a previous
+        ``/fleetz`` read returned): only entries in STRICTLY newer
+        buckets are returned, so a poller re-fetches nothing."""
         with self._lock:
-            entries = [e for _, e in self._ring]
+            pairs = list(self._ring)
+        if since is not None:
+            pairs = [(b, e) for b, e in pairs
+                     if b * self.bucket_s > since + 1e-9]
+        entries = [e for _, e in pairs]
         return entries[-n:] if n else entries
+
+    def cursor(self) -> Optional[float]:
+        """Newest bucket's start time (pass back as ``since=`` to poll
+        only deltas); None while the ring is empty."""
+        with self._lock:
+            if not self._ring:
+                return None
+            return round(self._ring[-1][0] * self.bucket_s, 3)
 
 
 class Watchtower:
@@ -305,6 +323,12 @@ class Watchtower:
         self._alerts: Dict[str, Alert] = {}
         self._history: deque = deque(maxlen=256)  # transition records
         self._ever_up: set = set()
+        # cumulative UP-replica time, in minutes (the autoscaler's cost
+        # axis: SLOs held per replica-minute spent). Integrated sweep to
+        # sweep, so a 3-replica fleet accrues 3x faster than a 1-replica
+        # one; carried on every rollup.
+        self._replica_minutes = 0.0
+        self._last_sweep_mono: Optional[float] = None
         self._last_burn: Dict[str, Dict[str, float]] = {}
         self._last_slo_eval: Optional[dict] = None
 
@@ -397,6 +421,14 @@ class Watchtower:
         def mean(xs):
             return round(sum(xs) / len(xs), 4) if xs else 0.0
 
+        # replica-minutes: rectangle rule over the sweep interval with
+        # the CURRENT up count (a replica that died since the last sweep
+        # stops accruing at this sweep, not retroactively)
+        if self._last_sweep_mono is not None:
+            dt = max(0.0, now - self._last_sweep_mono)
+            self._replica_minutes += counts.get("up", 0) * dt / 60.0
+        self._last_sweep_mono = now
+
         rollup = {
             "t_s": round(now, 3),
             "wall": round(time.time(), 3),
@@ -418,6 +450,7 @@ class Watchtower:
             "queued_total": queued_total,
             "active_total": active_total,
             "bundle_generations": sorted(gens, key=str),
+            "replica_minutes": round(self._replica_minutes, 4),
         }
         entry = {"rollup": rollup, "replicas": per_replica}
         self.ring.fold(entry, now)
@@ -682,10 +715,13 @@ class Watchtower:
 
     # -- endpoint payloads (pinned key sets) ------------------------------
 
-    def fleetz(self, n: int = 32,
-               replica: Optional[str] = None) -> dict:
+    def fleetz(self, n: int = 32, replica: Optional[str] = None,
+               since: Optional[float] = None) -> dict:
         """``GET /fleetz`` body. ``n`` bounds the rollup history;
-        ``replica`` substring-filters the per-replica map."""
+        ``replica`` substring-filters the per-replica map; ``since``
+        (a ``cursor`` from a previous read) restricts ``history`` to
+        strictly newer buckets — the autopilot's incremental poll, so
+        each tick fetches deltas instead of the whole ring."""
         latest = self.ring.latest() or {"rollup": None, "replicas": {}}
         reps = latest["replicas"]
         if replica:
@@ -699,7 +735,9 @@ class Watchtower:
             "fleet": latest["rollup"],
             "replicas": reps,
             "history": [e["rollup"]
-                        for e in self.ring.history(max(1, int(n)))],
+                        for e in self.ring.history(max(1, int(n)),
+                                                   since=since)],
+            "cursor": self.ring.cursor(),
         }
 
     def alertz(self, state: Optional[str] = None,

@@ -1,0 +1,387 @@
+"""Autopilot decision engine (closed form over scripted rollups), the
+do-no-harm vetoes, hysteresis/cooldown rails, coldest-replica
+placement, exactly-once actuation under injected faults, the
+plan_replicas capacity arithmetic, and the router's token-gated
+/admin/replicas registration endpoint."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pyspark_tf_gke_tpu.chaos.inject import (
+    ChaosInjector,
+    install,
+    uninstall,
+)
+from pyspark_tf_gke_tpu.obs.events import EventLog
+from pyspark_tf_gke_tpu.obs.metrics import MetricsRegistry
+from pyspark_tf_gke_tpu.replay.capacity import FleetModel, plan_replicas
+from pyspark_tf_gke_tpu.router.autopilot import (
+    ACTIONS,
+    DECISION_KEYS,
+    Autopilot,
+    RecommendActuator,
+    load_fleet_model,
+)
+
+# slots 2 x 50 tok/s x drain target 5 s -> one replica absorbs 500
+# demand tokens; every expected size below is hand-computed from that
+MODEL = FleetModel(slots_per_replica=2, decode_tokens_per_sec=50.0)
+PER_REPLICA_TOKENS = 2 * 50.0 * 5.0
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _fleetz(up=2, demand=0.0, qdelay=1.0, gens=(3,),
+            hit_rates=(0.9, 0.1)):
+    replicas = {
+        f"http://r{i}": {"state": "up", "prefix_hit_rate": hr,
+                         "queued": 0, "active": 0}
+        for i, hr in enumerate(hit_rates)}
+    return {"fleet": {"up": up, "demand_tokens_total": demand,
+                      "queue_delay_ms_max": qdelay,
+                      "bundle_generations": list(gens)},
+            "replicas": replicas}
+
+
+def _pilot(tmp_path, source, clock=None, actuator=None, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("stabilization_s", 30.0)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("retry_backoff_s", 0.0)
+    elog = EventLog(str(tmp_path / "events.jsonl"))
+    return Autopilot(
+        MODEL, source=source,
+        actuator=actuator or RecommendActuator(event_log=elog),
+        registry=MetricsRegistry(), event_log=elog,
+        clock=clock or FakeClock(), **kw)
+
+
+# -- decision engine (closed form over scripted rollups) ---------------------
+
+
+def test_steady_demand_noop_record(tmp_path):
+    """Demand exactly filling the fleet: desired == up, no action, no
+    vetoes, and the record carries its full provenance contract."""
+    snap = _fleetz(up=2, demand=2 * PER_REPLICA_TOKENS)
+    ap = _pilot(tmp_path, lambda: (snap, {"alerts": []}))
+    d = ap.tick()
+    assert d["action"] == "none" and d["vetoes"] == []
+    assert tuple(d) == DECISION_KEYS
+    assert d["action"] in ACTIONS
+    assert d["plan"]["replicas_needed"] == 2
+    assert d["rollup"] is snap["fleet"]  # the justifying snapshot rides
+
+
+def test_sustained_burn_scales_up_model_predicted_size(tmp_path):
+    """Demand worth ceil(2600/500)=6 replicas, rails cap at 4: one
+    decision asks for the model-predicted (clamped) size, and the
+    actuator runs one provisioning step per added replica."""
+    acts = []
+
+    class Counting(RecommendActuator):
+        def scale_up(self, decision):
+            acts.append("up")
+            return f"http://new{len(acts)}"
+
+    snap = _fleetz(up=2, demand=2600.0)
+    elog = EventLog(str(tmp_path / "ev.jsonl"))
+    ap = _pilot(tmp_path, lambda: (snap, {"alerts": []}),
+                actuator=Counting(event_log=elog))
+    d = ap.tick()
+    assert d["action"] == "scale_up"
+    assert (d["from"], d["to"]) == (2, 4)
+    assert d["plan"]["replicas_unclamped"] == 6  # pre-rail ask visible
+    assert d["applied"] and d["applied_steps"] == 2
+    assert d["added"] == ["http://new1", "http://new2"]
+    assert acts == ["up", "up"]
+
+
+def test_idle_drains_coldest_by_hit_rate(tmp_path):
+    """Idle fleet: after the stabilization window the scale-down
+    evicts the replica with the LOWEST measured prefix_hit_rate —
+    never the hot one whose radix cache is earning its keep."""
+    drained = []
+
+    class Draining(RecommendActuator):
+        def scale_down(self, decision, victim):
+            drained.append(victim)
+            return True
+
+    clock = FakeClock()
+    snap = _fleetz(up=2, demand=0.0, hit_rates=(0.9, 0.1))
+    elog = EventLog(str(tmp_path / "ev.jsonl"))
+    ap = _pilot(tmp_path, lambda: (snap, {"alerts": []}), clock=clock,
+                actuator=Draining(event_log=elog))
+    d = ap.tick()
+    assert d["action"] == "none" and "stabilization" in d["vetoes"]
+    clock.advance(31.0)
+    d = ap.tick()
+    assert d["action"] == "scale_down"
+    assert (d["from"], d["to"]) == (2, 1)  # one step per decision
+    assert d["victim"] == "http://r1"  # hit rate 0.1 < 0.9
+    assert drained == ["http://r1"]
+
+
+def test_firing_alert_vetoes_scale_down(tmp_path):
+    """Do no harm: a pending/firing alert blocks eviction outright —
+    shrinking a burning fleet converts an alert into an outage. The
+    SAME snapshot scales down once the alert clears."""
+    clock = FakeClock()
+    snap = _fleetz(up=2, demand=0.0)
+    alerts = {"alerts": [{"name": "slo:goodput_min", "state": "firing"}]}
+    ap = _pilot(tmp_path, lambda: (snap, alerts), clock=clock,
+                stabilization_s=0.0)
+    clock.advance(1.0)
+    d = ap.tick()
+    assert d["action"] == "none"
+    assert "alerts_active" in d["vetoes"]
+    assert d["alerts_active"] == ["slo:goodput_min"]
+    alerts["alerts"] = [{"name": "slo:goodput_min", "state": "resolved"}]
+    clock.advance(31.0)
+    assert ap.tick()["action"] == "scale_down"
+
+
+def test_mid_rollout_vetoes_scale_down(tmp_path):
+    """Mixed bundle_generations = a publish is mid-flight: eviction
+    would fight the coordinator, so scale-down waits."""
+    clock = FakeClock()
+    snap = _fleetz(up=2, demand=0.0, gens=(3, 4))
+    ap = _pilot(tmp_path, lambda: (snap, {"alerts": []}), clock=clock,
+                stabilization_s=0.0)
+    clock.advance(1.0)
+    d = ap.tick()
+    assert d["action"] == "none"
+    assert "rollout_in_progress" in d["vetoes"]
+
+
+def test_flapping_demand_holds_exactly_one_action(tmp_path):
+    """Demand flapping high/low every tick: the cooldown absorbs the
+    flap after the first scale-up and the stabilization window blocks
+    every scale-down — exactly ONE action across the whole episode."""
+    clock = FakeClock()
+    state = {"demand": 2600.0}
+    ap = _pilot(tmp_path,
+                lambda: (_fleetz(up=2, demand=state["demand"]),
+                         {"alerts": []}),
+                clock=clock, stabilization_s=300.0, cooldown_s=300.0)
+    actions = []
+    for i in range(10):
+        state["demand"] = 2600.0 if i % 2 == 0 else 0.0
+        d = ap.tick()
+        actions.append(d["action"])
+        clock.advance(15.0)
+    assert actions[0] == "scale_up"
+    assert actions.count("none") == 9  # every later move was held
+    vetoed = [v for d in ap.decisions for v in d["vetoes"]]
+    assert "cooldown" in vetoed and "stabilization" in vetoed
+
+
+def test_rails_clamp_is_visible_not_silent(tmp_path):
+    """Fleet already at max, demand wants more: no action, but the
+    clamp is recorded as a 'rails' veto and the unclamped ask stays
+    readable in the plan."""
+    snap = _fleetz(up=4, demand=6000.0,
+                   hit_rates=(0.5, 0.5, 0.5, 0.5))
+    ap = _pilot(tmp_path, lambda: (snap, {"alerts": []}))
+    d = ap.tick()
+    assert d["action"] == "none"
+    assert d["vetoes"] == ["rails"]
+    assert d["plan"]["replicas_unclamped"] == 12
+    assert d["plan"]["replicas_needed"] == 4
+
+
+def test_queue_delay_bump_asks_for_one_more(tmp_path):
+    """Throughput says the fleet is fine but measured queue delay is
+    over target: the plan bumps by one replica (latency guard)."""
+    snap = _fleetz(up=2, demand=100.0, qdelay=900.0)
+    ap = _pilot(tmp_path, lambda: (snap, {"alerts": []}))
+    d = ap.tick()
+    assert d["action"] == "scale_up"
+    assert d["to"] == 3
+    assert d["plan"]["signals"]["queue_delay_bump"] is True
+
+
+# -- actuation: retry with backoff, exactly once -----------------------------
+
+
+def test_actuator_fault_retried_never_double_applied(tmp_path):
+    """Chaos true positive: autopilot.actuate fail@1 kills the first
+    actuation attempt; the decision is retried with backoff and the
+    actuator's side effect lands EXACTLY once."""
+    acts = []
+
+    class Counting(RecommendActuator):
+        def scale_up(self, decision):
+            acts.append("up")
+            return f"http://new{len(acts)}"
+
+    sleeps = []
+    snap = _fleetz(up=1, demand=900.0, hit_rates=(0.5,))
+    elog = EventLog(str(tmp_path / "ev.jsonl"))
+    install(ChaosInjector.from_spec("autopilot.actuate:fail@1"))
+    try:
+        ap = _pilot(tmp_path, lambda: (snap, {"alerts": []}),
+                    actuator=Counting(event_log=elog),
+                    retry_backoff_s=0.25)
+        ap._sleep = sleeps.append  # observe, don't wait
+        d = ap.tick()
+    finally:
+        uninstall()
+    assert d["action"] == "scale_up" and d["applied"]
+    assert acts == ["up"]  # the fault fired BEFORE the side effect
+    assert sleeps == [0.25]  # one backoff between the two attempts
+    # replaying an applied decision is a no-op (exactly-once)
+    assert ap._actuate(d) is True
+    assert acts == ["up"]
+
+
+def test_actuation_retries_exhaust_and_drop(tmp_path):
+    """Every attempt failing: the decision is dropped (applied=False)
+    rather than half-applied, and the loop stays alive — the next
+    tick re-measures and re-decides."""
+    snap = _fleetz(up=1, demand=900.0, hit_rates=(0.5,))
+    install(ChaosInjector.from_spec("autopilot.actuate:fail%1.0"))
+    try:
+        ap = _pilot(tmp_path, lambda: (snap, {"alerts": []}),
+                    actuate_retries=2)
+        d = ap.tick()
+    finally:
+        uninstall()
+    assert d["action"] == "scale_up" and d["applied"] is False
+    assert d["applied_steps"] == 0
+    assert ap.tick()["action"] == "scale_up"  # loop survives
+
+
+# -- plan_replicas (capacity decision API, closed form) ----------------------
+
+
+def test_plan_replicas_closed_form():
+    plan = plan_replicas(MODEL, demand_tokens=2600.0,
+                         queue_delay_ms=1.0, replicas_up=2,
+                         min_replicas=1, max_replicas=8)
+    assert plan["replicas_needed"] == 6  # ceil(2600/500)
+    assert plan["per_replica_tokens_per_sec"] == 100.0
+    assert plan["signals"]["queue_delay_bump"] is False
+    # rails clamp
+    lo = plan_replicas(MODEL, demand_tokens=0.0, queue_delay_ms=None,
+                       replicas_up=2, min_replicas=2, max_replicas=8)
+    assert lo["replicas_needed"] == 2
+    hi = plan_replicas(MODEL, demand_tokens=99999.0, queue_delay_ms=0.0,
+                       replicas_up=2, min_replicas=1, max_replicas=3)
+    assert hi["replicas_needed"] == 3 and hi["replicas_unclamped"] > 3
+    with pytest.raises(ValueError):
+        plan_replicas(MODEL, demand_tokens=1.0, queue_delay_ms=None,
+                      replicas_up=1, min_replicas=3, max_replicas=2)
+
+
+def test_load_fleet_model_specs(tmp_path):
+    assert load_fleet_model("").slots_per_replica == 2
+    m = load_fleet_model('{"slots_per_replica": 4, "calibrated_at": 1}')
+    assert m.slots_per_replica == 4  # non-field keys dropped
+    p = tmp_path / "model.json"
+    p.write_text(json.dumps({"decode_tokens_per_sec": 80.0}))
+    assert load_fleet_model(f"@{p}").decode_tokens_per_sec == 80.0
+    with pytest.raises(ValueError):
+        load_fleet_model('[1, 2]')
+
+
+# -- POST /admin/replicas (token-gated runtime registration) -----------------
+
+
+def _admin_post(url, body, token=None):
+    req = urllib.request.Request(
+        url + "/admin/replicas", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 **({"X-Admin-Token": token} if token else {})},
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+@pytest.fixture
+def _router_http(tmp_path):
+    from pyspark_tf_gke_tpu.router.discovery import Replica
+    from pyspark_tf_gke_tpu.router.gateway import (
+        RouterServer,
+        start_router_http_server,
+    )
+
+    router = RouterServer(
+        [Replica(rid="http://seed:8000", base_url="http://seed:8000")],
+        registry=MetricsRegistry(),
+        event_log=EventLog(str(tmp_path / "ev.jsonl")),
+        admin_token="sekrit")
+    httpd = start_router_http_server(router, "127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        yield router, "http://127.0.0.1:%d" % httpd.server_address[1]
+    finally:
+        httpd.shutdown()
+
+
+def test_admin_replicas_taxonomy_and_merge(_router_http):
+    router, url = _router_http
+    # 401: wrong/missing token against a configured gate
+    assert _admin_post(url, {"add": ["http://x:1"]})[0] == 401
+    assert _admin_post(url, {"add": ["http://x:1"]},
+                       token="wrong")[0] == 401
+    # 400 taxonomy: unknown keys / wrong types / empty
+    for body in ({"zap": []}, {"add": "http://x:1"}, {},
+                 {"add": [], "remove": []}):
+        code, out = _admin_post(url, body, token="sekrit")
+        assert code == 400, out
+    # 200: add is merge-not-replace — the seed replica survives, the
+    # new one enters DOWN (unproven) and is not yet routable
+    code, out = _admin_post(url, {"add": ["http://new:8000"]},
+                            token="sekrit")
+    assert code == 200
+    assert out["added"] == ["http://new:8000"]
+    table = {r["replica"]: r for r in out["replicas"]}
+    assert set(table) == {"http://seed:8000", "http://new:8000"}
+    assert table["http://new:8000"]["state"] == "down"
+    # idempotent re-add: merged, not duplicated, not reset
+    code, out = _admin_post(url, {"add": ["http://new:8000"]},
+                            token="sekrit")
+    assert code == 200 and out["added"] == []
+    # remove is immediate and idempotent
+    code, out = _admin_post(url, {"remove": ["http://new:8000"]},
+                            token="sekrit")
+    assert code == 200 and out["removed"] == ["http://new:8000"]
+    assert [r["replica"] for r in out["replicas"]] == [
+        "http://seed:8000"]
+    code, out = _admin_post(url, {"remove": ["http://new:8000"]},
+                            token="sekrit")
+    assert code == 200 and out["removed"] == []
+
+
+def test_admin_replicas_disabled_without_token(tmp_path):
+    """No --admin-token configured: the whole admin plane answers 403
+    (fail-closed), even with a token header supplied."""
+    from pyspark_tf_gke_tpu.router.discovery import Replica
+    from pyspark_tf_gke_tpu.router.gateway import RouterServer
+
+    router = RouterServer(
+        [Replica(rid="http://seed:8000", base_url="http://seed:8000")],
+        registry=MetricsRegistry(),
+        event_log=EventLog(str(tmp_path / "ev.jsonl")))
+    err = router.admin_token_error("anything")
+    assert err is not None and err[0] == 403
+    err = router.admin_token_error(None)
+    assert err[0] == 403

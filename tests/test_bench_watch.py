@@ -161,15 +161,15 @@ def test_orchestrate_all_rejects_cpu_fallback(monkeypatch, capsys):
         lambda argv, skip_probe=False: ran.append(list(argv)) or 0)
     rc = bench.orchestrate_all([])
     assert rc == 1  # device workloads all failed the gate
-    # only the host-only workloads executed (router's, replay's and
-    # chaos's replicas are CPU-pinned subprocesses by design; io
+    # only the host-only workloads executed (the router/replay/chaos/
+    # autopilot fleets are CPU-pinned subprocesses by design; io
     # touches no devices) — matrix order preserved
     assert ran == [["router"], ["replay"], ["chaos"],
-                   ["chaos", "--stream"], ["io"]]
+                   ["chaos", "--stream"], ["autopilot"], ["io"]]
     out = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
            if ln.startswith("{")]
     errors = [o for o in out if o.get("error")]
-    assert len(errors) == len(bench.ALL_WORKLOADS) - 5
+    assert len(errors) == len(bench.ALL_WORKLOADS) - len(ran)
 
 
 def test_probe_code_shared_between_bench_and_watcher():

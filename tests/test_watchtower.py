@@ -397,6 +397,51 @@ def test_alertz_pinned_keys_and_filters():
     assert status == 400
 
 
+def test_replica_minutes_accumulate_with_up_count():
+    """The rollup's replica_minutes is the rectangle-rule integral of
+    the UP count over sweep intervals: 2 replicas x 60 s = 2.0
+    replica-minutes, and a DOWN replica stops accruing."""
+    clock = FakeClock()
+    rs = _replica_set(2)
+    w = _tower(rs=rs, clock=clock, bucket_s=1.0)
+    assert w.sweep()["replica_minutes"] == 0.0  # no interval yet
+    clock.advance(60.0)
+    assert w.sweep()["replica_minutes"] == pytest.approx(2.0)
+    rs.all()[1].state = DOWN
+    clock.advance(60.0)  # one replica for a minute more
+    assert w.sweep()["replica_minutes"] == pytest.approx(3.0)
+
+
+def test_fleetz_since_cursor_is_incremental():
+    """/fleetz?since=<cursor> returns only buckets STRICTLY newer
+    than the cursor a previous read handed out; a fresh cursor
+    yields an empty history (nothing new) and a bad cursor is 400."""
+    clock = FakeClock()
+    w = _tower(clock=clock, bucket_s=1.0)
+    for _ in range(3):
+        w.sweep()
+        clock.advance(1.0)
+    _, body = _get("/fleetz", w)
+    assert body["cursor"] is not None
+    assert len(body["history"]) == 3
+    # cursor of the FIRST bucket: the later two are strictly newer
+    first_cursor = body["cursor"] - 2.0
+    _, newer = _get(f"/fleetz?since={first_cursor}", w)
+    assert len(newer["history"]) == 2
+    # the freshest cursor: nothing new yet
+    _, empty = _get(f"/fleetz?since={body['cursor']}", w)
+    assert empty["history"] == []
+    assert empty["cursor"] == body["cursor"]  # cursor always current
+    # new sweeps become visible through the same cursor
+    clock.advance(1.0)
+    w.sweep()
+    _, one = _get(f"/fleetz?since={body['cursor']}", w)
+    assert len(one["history"]) == 1
+    for bad in ("since=zap", "since=-1"):
+        status, _ = _get(f"/fleetz?{bad}", w)
+        assert status == 400
+
+
 def test_endpoints_absent_without_watchtower():
     assert handle_obs_request("/fleetz", MetricsRegistry()) is None
     assert handle_obs_request("/alertz", MetricsRegistry()) is None

@@ -174,6 +174,7 @@ def lint_duplicate_metrics() -> int:
     # router plane's entry point (pyspark_tf_gke_tpu/router/) — its
     # router_* names ride the same one-name-one-shape contract.
     from pyspark_tf_gke_tpu.obs.metrics import (
+        autopilot_families,
         chaos_families,
         replay_families,
         router_families,
@@ -182,6 +183,7 @@ def lint_duplicate_metrics() -> int:
     scheme = MetricsRegistry()
     platform_families(scheme)
     router_families(scheme)
+    autopilot_families(scheme)
     replay_families(scheme)
     chaos_families(scheme)
     install_runtime_metrics(scheme)
@@ -287,7 +289,17 @@ def lint_duplicate_metrics() -> int:
                 "router_alerts_firing",
                 "router_alert_transitions_total",
                 "router_fleet_snapshots_total",
-                "router_fleet_snapshot_buckets"}
+                "router_fleet_snapshot_buckets",
+                # autopilot (router/autopilot.py): the closed-loop
+                # fleet controller's decision/veto/actuation
+                # accounting — the --autopilot gate, bench.py
+                # autopilot A/B and docs/AUTOPILOT.md read these
+                "autopilot_ticks_total",
+                "autopilot_decisions_total",
+                "autopilot_vetoes_total",
+                "autopilot_actuations_total",
+                "autopilot_actuation_retries_total",
+                "autopilot_replicas_desired"}
     absent = {n for n in required if n not in _REGISTRATIONS}
     if absent:
         print("metric lint FAILED — required metric name(s) never "
@@ -2095,6 +2107,162 @@ def watchtower_check(grace_s: float = 30.0) -> int:
     return 0
 
 
+def autopilot_check() -> int:
+    """``--autopilot``: the closed-loop fleet controller, live. A
+    2-replica CPU localfleet runs behind the real router (admin plane
+    token-gated on); an :class:`Autopilot` driving a
+    :class:`LocalFleetActuator` polls the router's own /fleetz +
+    /alertz over HTTP. A tight flash crowd then hits the fleet:
+
+    1. the autopilot must scale 2 -> 3 within the tick bound — a real
+       third replica process boots, pre-warms, and registers through
+       ``POST /admin/replicas``;
+    2. every crowd request must complete HTTP 200 (zero lost — the
+       scale-up and later drain are invisible to clients);
+    3. after the crowd the autopilot must drain back to 2 (deregister
+       first, SIGTERM drain) once the stabilization window elapses;
+    4. exactly one applied scale_up and at least one applied
+       scale_down in the decision ring, each carrying its rollup +
+       plan provenance, and zero alerts left firing.
+    """
+    import json
+    import os
+    import tempfile
+    import threading
+    import time
+    import urllib.request
+
+    from pyspark_tf_gke_tpu.obs.events import EventLog
+    from pyspark_tf_gke_tpu.replay.capacity import FleetModel
+    from pyspark_tf_gke_tpu.router.autopilot import (Autopilot,
+                                                     LocalFleetActuator)
+    from pyspark_tf_gke_tpu.router.localfleet import LocalFleet
+
+    token = "smoke-autopilot-gate"
+    prompt = "autopilot crowd probe"
+    tick_s, stabilization_s, cooldown_s = 1.0, 2.0, 5.0
+    # a new CPU replica must boot + warm + register + be probed UP:
+    # generous bound, the assertion is that it happens at all under
+    # the crowd, driven by the autopilot alone
+    scale_up_bound = 90.0
+    drain_bound = stabilization_s + cooldown_s + 30.0
+    # small capacity model so the CPU crowd's outstanding tokens
+    # deterministically ask for >2 replicas: 1 slot x 4 tok/s x 5 s
+    # drain target = 20 demand tokens per replica
+    model = FleetModel(slots_per_replica=1, decode_tokens_per_sec=4.0)
+
+    def _get(path):
+        with urllib.request.urlopen(fleet.url + path, timeout=5) as r:
+            return json.loads(r.read())
+
+    statuses: list = []
+    crowd_stop = threading.Event()
+
+    def _crowd():
+        req_body = json.dumps({"prompts": [prompt],
+                               "max_new_tokens": 16}).encode()
+        while not crowd_stop.is_set():
+            req = urllib.request.Request(
+                fleet.url + "/v1/generate", data=req_body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    statuses.append(resp.status)
+            except Exception as exc:  # noqa: BLE001 — a lost request
+                #   is the failure this gate exists to catch
+                statuses.append(repr(exc))
+
+    router_args = ("--admin-token", token,
+                   "--probe-interval", "0.3", "--probe-timeout", "1.0",
+                   "--fail-threshold", "2",
+                   "--alert-for", "0", "--alert-clear", "2.0")
+    replica_args = ("--continuous-slots", "1", "--prefix-cache", "8",
+                    "--max-queue-depth", "64")
+    print("autopilot check: 2-replica fleet + router (admin plane on), "
+          "autopilot min=2 max=3 driving a LocalFleetActuator; "
+          "flash crowd incoming...")
+    with LocalFleet(2, router_args=router_args,
+                    replica_args=replica_args) as fleet:
+        fleet.warm()
+        with tempfile.TemporaryDirectory() as tmp:
+            ap = Autopilot(
+                model,
+                source=lambda: (_get("/fleetz"), _get("/alertz")),
+                actuator=LocalFleetActuator(
+                    fleet, admin_token=token,
+                    warm_prefixes=(prompt,)),
+                min_replicas=2, max_replicas=3,
+                tick_s=tick_s, stabilization_s=stabilization_s,
+                cooldown_s=cooldown_s,
+                event_log=EventLog(os.path.join(tmp, "events.jsonl")))
+            ap.start()
+            crowd = [threading.Thread(target=_crowd, daemon=True)
+                     for _ in range(8)]
+            try:
+                for t in crowd:
+                    t.start()
+
+                # 1) the autopilot scales 2 -> 3 under the crowd
+                t0 = time.monotonic()
+                up = 2
+                while time.monotonic() - t0 < scale_up_bound:
+                    up = _get("/fleetz")["fleet"]["up"]
+                    if up >= 3:
+                        break
+                    time.sleep(0.5)
+                scale_s = time.monotonic() - t0
+                assert up == 3, (
+                    f"never scaled to 3 within {scale_up_bound}s "
+                    f"(up={up}); decisions: "
+                    f"{[d['action'] for d in ap.decisions]}")
+                print(f"  scaled 2 -> 3 in {scale_s:.1f}s under load")
+                time.sleep(2.0)  # let the crowd exercise all 3
+            finally:
+                crowd_stop.set()
+                for t in crowd:
+                    t.join(timeout=90)
+
+            # 2) zero lost requests through scale-up
+            lost = [s for s in statuses if s != 200]
+            assert statuses and not lost, (
+                f"{len(lost)}/{len(statuses)} crowd requests lost: "
+                f"{lost[:5]}")
+
+            # 3) idle fleet drains back to 2 after stabilization
+            t1 = time.monotonic()
+            while time.monotonic() - t1 < drain_bound:
+                up = _get("/fleetz")["fleet"]["up"]
+                if up <= 2:
+                    break
+                time.sleep(0.5)
+            drain_s = time.monotonic() - t1
+            assert up == 2, (
+                f"never drained back to 2 within {drain_bound}s "
+                f"(up={up}); decisions: "
+                f"{[d['action'] for d in ap.decisions]}")
+            ap.stop()
+
+            # 4) decision-ring provenance + a quiet alert plane
+            ups = [d for d in ap.decisions
+                   if d["action"] == "scale_up" and d["applied"]]
+            downs = [d for d in ap.decisions
+                     if d["action"] == "scale_down" and d["applied"]]
+            assert len(ups) == 1, [d["action"] for d in ap.decisions]
+            assert downs, [d["action"] for d in ap.decisions]
+            for d in ups + downs:
+                assert d["plan"]["replicas_needed"] == d["to"], d
+                assert d["rollup"].get("up") == d["from"], d
+            assert downs[0]["victim"], downs[0]
+            firing = _get("/alertz")["firing"]
+            assert not firing, f"alerts left firing: {firing}"
+    print(f"autopilot OK: scaled 2 -> 3 in {scale_s:.1f}s under the "
+          f"crowd, {len(statuses)} requests all 200 (zero lost), "
+          f"drained back to 2 in {drain_s:.1f}s after it, "
+          f"{len(ap.decisions)} decisions with full provenance, "
+          "no alerts firing")
+    return 0
+
+
 def failover_stream_check(grace_s: float = 30.0) -> int:
     """``--failover-stream``: mid-stream replica death is invisible to
     the client, live. 2 tiny CPU replicas + the real router; decode is
@@ -2213,6 +2381,8 @@ def main(argv=None) -> int:
         return chaos_check()
     if "--watchtower" in argv:
         return watchtower_check()
+    if "--autopilot" in argv:
+        return autopilot_check()
     if "--serve-lifecycle" in argv:
         return serve_lifecycle_check()
     if "--serve-tbt" in argv:
