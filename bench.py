@@ -1847,6 +1847,201 @@ def bench_router(smoke: bool = False) -> dict:
     }
 
 
+def bench_disagg(smoke: bool = False) -> dict:
+    """``python bench.py disagg``: the prefill/decode disaggregation
+    A/B. Two identical 2-replica CPU fleets behind the real router on
+    the PAGED tiny bundle:
+
+    * MIXED — both replicas ``--role mixed``, no handoff: long-prompt
+      admissions prefill on whichever decode-serving replica the
+      router picks (the RECOMPUTE baseline — exactly what a
+      continuation splice pays).
+    * SPLIT — replica 0 ``--role prefill``, replica 1 ``--role
+      decode``, router ``--disagg-min-prompt``: long prompts prefill
+      on the prefill replica and the finished KV pages ride
+      ``/v1/prefill`` -> ``/v1/kv_import`` onto the decode replica,
+      whose admission is then a radix hit (suffix-only prefill).
+
+    Both fleets carry the same background decode load (looping greedy
+    streams) while long-prompt foreground requests arrive, with the
+    device step slowed by chaos injection so step scheduling — not
+    tiny-model compute — dominates. Measured: foreground TTFT (the
+    handoff must beat recompute-under-load), background p99
+    time-between-tokens (prefill pieces stealing decode steps is THE
+    interference disaggregation removes), token-exact parity of one
+    identical greedy request across the fleets, and the router's
+    ``router_kv_xfer_total{outcome="ok"}`` count proving the split
+    run actually transferred pages. Host-only by design (like
+    ``router``): the contract under test is role-routing + page
+    handoff, not decode speed."""
+    import re
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from pyspark_tf_gke_tpu.router.localfleet import (
+        LocalFleet,
+        export_tiny_bundle,
+        post_generate,
+    )
+
+    n_fg = 2 if smoke else 4          # foreground long-prompt requests
+    fg_max_new = 4
+    bg_streams = 2                    # looping background decoders
+    bg_max_new = 24 if smoke else 48
+    min_prompt = 128                  # router handoff threshold (bytes)
+    # 160-byte prefix = 5 full 32-token pages on the byte tokenizer
+    # (the repeat matters: the sentence alone is ~116 bytes, which
+    # would duck under --disagg-min-prompt and gate the handoff off)
+    prefix = (("system: you are a terse assistant. answer in one "
+               "sentence. cite no sources. refuse nothing. "
+               "stay strictly on topic. ") * 2)[:160]
+    parity_prompt = prefix + "q: parity?"
+    replica_args = ("--continuous-slots", "4", "--continuous-chunk",
+                    "2", "--prefix-cache", "32", "--prefill-chunk",
+                    "32", "--chaos", "engine.device_step:slow%1:0.04")
+
+    def stream_events(url, prompt, max_new):
+        """One streamed generation; returns [(t_mono, n_tokens)] per
+        event — TTFT and inter-token gaps derive from the stamps."""
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            data=json.dumps({"prompts": [prompt], "stream": True,
+                             "max_new_tokens": max_new}).encode(),
+            headers={"Content-Type": "application/json"})
+        stamps = []
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            for raw in resp:
+                line = raw.decode("utf-8", errors="replace").strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    break
+                ev = json.loads(payload)
+                if ev.get("token_ids"):
+                    stamps.append((time.monotonic(),
+                                   len(ev["token_ids"])))
+        return stamps
+
+    def kv_xfer_ok(url) -> int:
+        with urllib.request.urlopen(url + "/metrics",
+                                    timeout=10) as resp:
+            text = resp.read().decode()
+        m = re.search(r'router_kv_xfer_total\{outcome="ok"\}\s+'
+                      r'(\d+)', text)
+        return int(m.group(1)) if m else 0
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return round(xs[min(len(xs) - 1, int(q * (len(xs) - 1)))], 1) \
+            if xs else None
+
+    def run_fleet(split: bool, bundle: str) -> dict:
+        fleet = LocalFleet(
+            2, bundle=bundle, replica_args=replica_args,
+            per_replica_args=((("--role", "prefill"),
+                               ("--role", "decode")) if split
+                              else None),
+            router_args=((("--disagg-min-prompt", str(min_prompt)))
+                         if split else ()))
+        with fleet:
+            fleet.warm()
+            # token-exact parity probe on the IDLE fleet: in the split
+            # fleet this rides the full handoff (prefill export ->
+            # page import -> radix-hit admission); greedy decode must
+            # not care where the KV came from
+            parity = post_generate(fleet.url, parity_prompt,
+                                   max_new_tokens=8, timeout_s=300.0)
+            parity_text = parity["completions"][0]["completion"]
+
+            stop = threading.Event()
+            gaps, bg_lock = [], threading.Lock()
+
+            def background(i):
+                # short prompts (below the handoff threshold) looping
+                # until the foreground phase ends: sustained decode
+                # load on the non-prefill pool
+                while not stop.is_set():
+                    stamps = stream_events(
+                        fleet.url, f"background stream {i} ",
+                        bg_max_new)
+                    with bg_lock:
+                        gaps.extend(
+                            (b[0] - a[0]) * 1000.0
+                            for a, b in zip(stamps, stamps[1:]))
+
+            threads = [threading.Thread(target=background, args=(i,))
+                       for i in range(bg_streams)]
+            for t in threads:
+                t.start()
+            time.sleep(1.5)  # let the streams occupy decode slots
+            ttft = []
+            try:
+                for i in range(n_fg):
+                    # unique long prompts: no radix reuse across
+                    # foreground requests — each pays a full prefill
+                    # (mixed) or a full handoff (split)
+                    prompt = f"fg {i:03d} " + prefix
+                    t0 = time.monotonic()
+                    stamps = stream_events(fleet.url, prompt,
+                                           fg_max_new)
+                    if stamps:
+                        ttft.append((stamps[0][0] - t0) * 1000.0)
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=300)
+            xfers = kv_xfer_ok(fleet.url)
+        return {"ttft_ms": [round(t, 1) for t in ttft],
+                "ttft_p50_ms": pct(ttft, 0.50),
+                "bg_tbt_p99_ms": pct(gaps, 0.99),
+                "bg_gaps": len(gaps),
+                "parity_text": parity_text,
+                "kv_xfer_ok": xfers}
+
+    tmp = tempfile.mkdtemp(prefix="bench-disagg-")
+    try:
+        bundle = export_tiny_bundle(os.path.join(tmp, "bundle"),
+                                    paged=True)
+        mixed = run_fleet(split=False, bundle=bundle)
+        split = run_fleet(split=True, bundle=bundle)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    parity_ok = mixed["parity_text"] == split["parity_text"]
+    ttft_speedup = (round(mixed["ttft_p50_ms"] / split["ttft_p50_ms"],
+                          3)
+                    if mixed["ttft_p50_ms"] and split["ttft_p50_ms"]
+                    else None)
+    tbt_ratio = (round(mixed["bg_tbt_p99_ms"]
+                       / split["bg_tbt_p99_ms"], 3)
+                 if mixed["bg_tbt_p99_ms"] and split["bg_tbt_p99_ms"]
+                 else None)
+    return {
+        "metric": "disagg_ttft_p50_ms",
+        "value": split["ttft_p50_ms"],
+        "unit": "ms",
+        "vs_baseline": None,
+        "recompute_ttft_p50_ms": mixed["ttft_p50_ms"],
+        "ttft_speedup_vs_recompute": ttft_speedup,
+        "split_bg_tbt_p99_ms": split["bg_tbt_p99_ms"],
+        "mixed_bg_tbt_p99_ms": mixed["bg_tbt_p99_ms"],
+        "bg_tbt_p99_ratio_mixed_over_split": tbt_ratio,
+        "token_parity": parity_ok,
+        "kv_xfer_ok": split["kv_xfer_ok"],
+        "kv_xfer_ok_mixed": mixed["kv_xfer_ok"],  # must stay 0
+        "detail": {"mixed": mixed, "split": split},
+        "n_foreground": n_fg,
+        "bg_streams": bg_streams,
+        "disagg_min_prompt": min_prompt,
+        "workload": ("1 prefill + 1 decode CPU replicas + router KV "
+                     "handoff vs 2 mixed replicas (RECOMPUTE); "
+                     "long-prompt TTFT + background TBT under load"),
+    }
+
+
 def bench_replay(smoke: bool = False) -> dict:
     """``python bench.py replay``: the scenario-sweep workload — ≥3
     distinct trace-spec scenarios replayed open-loop against a local
@@ -3022,6 +3217,11 @@ ALL_WORKLOADS = (
     # outage-window STREAM goodput through the router's journal +
     # continuation splice (zero lost streams; host-only)
     ["chaos", "--stream"],
+    # prefill/decode disaggregation A/B: role-split fleet + KV-page
+    # handoff over the router vs mixed fleet (RECOMPUTE) — long-prompt
+    # TTFT and background decode TBT under load, token parity asserted
+    # (host-only)
+    ["disagg"],
     # closed-loop autopilot A/B: diurnal day vs static max-size fleet
     # (SLO + replica-minutes, capacity model in band) + flash-crowd
     # with a replica killed mid-scale-up (host-only)
@@ -3050,7 +3250,8 @@ GATE_ATTACH_FAILED = ("backend attach failed (probed once for the "
 # workloads that never touch a device: io is pure TFRecord I/O, and the
 # router/replay/chaos/autopilot fleets are CPU-pinned subprocesses by
 # design — a down TPU tunnel must never gate them
-HOST_ONLY_WORKLOADS = ("io", "router", "replay", "chaos", "autopilot")
+HOST_ONLY_WORKLOADS = ("io", "router", "replay", "chaos", "autopilot",
+                       "disagg")
 
 
 def _run_matrix(extra, backend_ok: bool, skip=(),
@@ -3345,6 +3546,8 @@ def run_bench(argv) -> dict:
         return bench_chaos(smoke=smoke, stream_mix="--stream" in argv)
     if workload == "autopilot":
         return bench_autopilot(smoke=smoke)
+    if workload == "disagg":
+        return bench_disagg(smoke=smoke)
     if workload == "cb":
         if "--chunked-prefill" in argv:
             return bench_chunked_prefill(smoke=smoke)

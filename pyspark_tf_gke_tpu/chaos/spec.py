@@ -246,6 +246,17 @@ def synth_chaos(kind: str, *, seed: int = 0, duration_s: float = 10.0,
       replica that existed before the scale-up (the freshly-started
       one is not in the schedule's index space). Gate on
       exactly-one-terminal (``check_report`` / ``check_traces``).
+    * ``kill_prefill_mid_xfer`` — the DISAGGREGATION chaos scenario:
+      SIGKILL the prefill replica (``victim``, default 0 — localfleet
+      role-split runs put the prefill replica first) at a pinned
+      offset (``kill_at_s``, default 0.4 × duration — while long
+      prompts are mid prefill-export/KV-handoff), relaunch
+      ``restart_s`` (default duration/4) later. Run it under a
+      long-prompt workload through a router with
+      ``--disagg-min-prompt`` set and gate on exactly-one-terminal
+      (``check_report``): every request whose handoff the kill tore
+      must land exactly once via the RECOMPUTE fallback on the decode
+      pool, and both sides' page-refcount audits must stay green.
     * ``hang_drain`` — the scale-DOWN chaos scenario: SIGSTOP the
       designated drain victim (``victim``, default the highest boot
       index — the autopilot evicts the coldest, which a cold fresh
@@ -285,6 +296,13 @@ def synth_chaos(kind: str, *, seed: int = 0, duration_s: float = 10.0,
             offset_s=at, action="kill", target=f"replica:{victim}",
             restart_s=(float(restart_s)
                        if restart_s is not None else None)))
+    elif kind == "kill_prefill_mid_xfer":
+        victim = int(params.pop("victim", 0)) % replicas
+        at = float(params.pop("kill_at_s", duration_s * 0.4))
+        restart_s = float(params.pop("restart_s", duration_s / 4))
+        events.append(ChaosEvent(offset_s=at, action="kill",
+                                 target=f"replica:{victim}",
+                                 restart_s=restart_s))
     elif kind == "hang_drain":
         victim = int(params.pop("victim", replicas - 1)) % replicas
         at = float(params.pop("at_s", duration_s * 0.7))
@@ -316,7 +334,7 @@ def synth_chaos(kind: str, *, seed: int = 0, duration_s: float = 10.0,
         raise ValueError(
             f"unknown chaos kind {kind!r} (known: kill_one, hang_one, "
             "flaky_probes, storm, kill_mid_stream, kill_mid_scaleup, "
-            "hang_drain)")
+            "kill_prefill_mid_xfer, hang_drain)")
     if params:
         raise ValueError(f"unknown synth_chaos params: {sorted(params)}")
     events.sort(key=lambda ev: ev.offset_s)
@@ -325,4 +343,6 @@ def synth_chaos(kind: str, *, seed: int = 0, duration_s: float = 10.0,
         meta={"kind": kind, "duration_s": duration_s,
               "replicas": replicas,
               **({"streaming": True}
-                 if kind == "kill_mid_stream" else {})}).validate()
+                 if kind == "kill_mid_stream" else {}),
+              **({"disagg": True}
+                 if kind == "kill_prefill_mid_xfer" else {})}).validate()

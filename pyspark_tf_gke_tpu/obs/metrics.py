@@ -624,6 +624,31 @@ def platform_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "serve_kv_page_alloc_failures_total",
             "Admission attempts deferred because the page pool could "
             "not cover the request (it stays queued)"),
+        # disaggregated prefill/decode: KV-page handoff between
+        # role-split replicas (zero on mixed-mode fleets)
+        "serve_kv_xfer_export_total": r.counter(
+            "serve_kv_xfer_export_total",
+            "KV-page exports served (prefill side of a disaggregated "
+            "handoff: radix-cached pages read back for transfer)"),
+        "serve_kv_xfer_export_pages_total": r.counter(
+            "serve_kv_xfer_export_pages_total",
+            "KV pages exported across all transfers"),
+        "serve_kv_xfer_import_total": r.counter(
+            "serve_kv_xfer_import_total",
+            "KV-page imports installed (decode side: transferred rows "
+            "scattered into the pool and adopted into the radix trie)"),
+        "serve_kv_xfer_import_pages_total": r.counter(
+            "serve_kv_xfer_import_pages_total",
+            "KV pages installed from transfers (resident pages are "
+            "reused, not re-written)"),
+        "serve_kv_xfer_bytes_total": r.counter(
+            "serve_kv_xfer_bytes_total",
+            "Serialized KV transfer payload bytes, both directions "
+            "(the handoff's network cost)"),
+        "serve_kv_xfer_failures_total": r.counter(
+            "serve_kv_xfer_failures_total",
+            "KV transfers that failed (pool exhausted, bad payload, "
+            "device error) — the caller falls back to RECOMPUTE"),
         # self-draft speculative decoding (in-slot draft/verify;
         # zero unless the engine runs with --spec-tokens > 0)
         "serve_spec_proposed_total": r.counter(
@@ -890,6 +915,41 @@ def router_families(registry: Optional[MetricsRegistry] = None) -> dict:
             "router_fleet_snapshot_buckets",
             "Time buckets currently resident in the fleet snapshot "
             "ring (bounded by the ring's maxlen)"),
+        # -- disaggregated prefill/decode (docs/SERVING.md
+        # "Disaggregated prefill/decode"): role-split routing + the
+        # router-brokered KV-page handoff between replicas
+        "router_role_replicas": r.gauge(
+            "router_role_replicas",
+            "Routable replicas per advertised /loadz role "
+            "(prefill | decode | mixed)",
+            labelnames=("role",)),
+        "router_role_demand_tokens": r.gauge(
+            "router_role_demand_tokens",
+            "Outstanding tokens per role pool (the per-role demand "
+            "half of the autoscale split — each role's HPA scales on "
+            "its own pool)",
+            labelnames=("role",)),
+        "router_role_capacity_free": r.gauge(
+            "router_role_capacity_free",
+            "Sum of /loadz capacity_free per role pool (the per-role "
+            "capacity half of the autoscale split)",
+            labelnames=("role",)),
+        "router_kv_xfer_total": r.counter(
+            "router_kv_xfer_total",
+            "Router-brokered KV-page handoffs by outcome (ok = pages "
+            "installed on the decode replica | export_miss = prefill "
+            "replica had nothing to export | failed = transfer error, "
+            "request fell back to RECOMPUTE on the normal path)",
+            labelnames=("outcome",)),
+        "router_kv_xfer_bytes_total": r.counter(
+            "router_kv_xfer_bytes_total",
+            "Serialized KV page-blob bytes moved through the router "
+            "during handoffs"),
+        "router_kv_xfer_latency_ms": r.histogram(
+            "router_kv_xfer_latency_ms",
+            "Wall time of one full handoff (prefill export + decode "
+            "import) — must stay below the RECOMPUTE prefill time it "
+            "replaces to be worth it"),
     }
 
 

@@ -560,6 +560,64 @@ def plan_replicas(model: FleetModel, *, demand_tokens: float,
     }
 
 
+def plan_role_replicas(model: FleetModel, *, by_role: dict,
+                       queue_delay_ms: Optional[float] = None,
+                       min_replicas: int = 1, max_replicas: int = 8,
+                       drain_target_s: float = 5.0,
+                       queue_delay_target_ms: float = 500.0) -> dict:
+    """Per-role capacity plan for a DISAGGREGATED fleet: one
+    :func:`plan_replicas` per role over the router's per-role
+    autoscale split (``update_autoscale()["by_role"]`` /
+    the watchtower rollup's ``roles`` block, shape
+    ``{role: {replicas, capacity_free_total, demand_tokens_total}}``).
+
+    The arithmetic is plan_replicas VERBATIM — each role just gets its
+    own service rate. ``decode``/``mixed`` replicas drain backlog at
+    ``slots_per_replica x effective_decode_rate`` (decode-dominated,
+    as before). A ``prefill`` replica's job is chunked prefill into
+    its paged pool, so its drain rate is ``prefill_tokens_per_sec``
+    per replica (prefill saturates the chip; slot count and
+    speculation are decode-side concepts). The queue-delay bump only
+    applies to non-prefill roles — queue delay is measured at decode
+    admission, and a slow KV handoff already degrades to RECOMPUTE on
+    the decode pool rather than queueing on prefill.
+
+    Feeds the per-role HPA pair in ``infra/k8s/tpu``
+    (``tpu-serve-hpa.yaml`` for decode, the prefill Deployment's HPA
+    scaling on ``router_role_demand_tokens{role="prefill"}``)."""
+    model.validate()
+    plans = {}
+    total = 0
+    for role in sorted(by_role):
+        sig = by_role[role] or {}
+        role_model = model
+        role_delay = queue_delay_ms
+        if role == "prefill":
+            # same closed form, prefill service rate: one "slot"
+            # draining at prefill_tokens_per_sec, speculation off
+            role_model = dataclasses.replace(
+                model, slots_per_replica=1,
+                decode_tokens_per_sec=model.prefill_tokens_per_sec,
+                spec_tokens=0, spec_accept_rate=0.0)
+            role_delay = None
+        plan = plan_replicas(
+            role_model,
+            demand_tokens=float(sig.get("demand_tokens_total") or 0.0),
+            queue_delay_ms=role_delay,
+            replicas_up=int(sig.get("replicas") or 0),
+            min_replicas=min_replicas, max_replicas=max_replicas,
+            drain_target_s=drain_target_s,
+            queue_delay_target_ms=queue_delay_target_ms)
+        plan["role"] = role
+        plans[role] = plan
+        total += plan["replicas_needed"]
+    return {
+        "kind": "pyspark_tf_gke_tpu.capacity_role_plan",
+        "roles": plans,
+        "replicas_needed_total": total,
+    }
+
+
 def derive_hpa_targets(*, kv_pages: int = 256, page_size: int = 16,
                        decode_chunk_tokens: int = 64,
                        decode_tokens_per_sec: float = 128.0) -> dict:

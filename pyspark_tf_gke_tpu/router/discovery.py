@@ -75,6 +75,14 @@ class Replica:
                 and (now if now is not None else time.monotonic())
                 >= self.backoff_until)
 
+    @property
+    def role(self) -> str:
+        """Disaggregated serving role as last probed (/loadz ``role``):
+        ``prefill`` | ``decode`` | ``mixed``. Replicas that predate the
+        key (or haven't answered a probe yet) read as ``mixed`` — the
+        role-blind default keeps them fully routable."""
+        return str(self.load.get("role") or "mixed")
+
     def outstanding_tokens(self) -> int:
         """Least-outstanding-tokens score: the replica's own queue
         footprint (from /loadz) plus what this router has in flight to
@@ -352,6 +360,19 @@ class ReplicaSet:
             demand = sum(r.outstanding_tokens() for r in ups)
             delays = [r.load.get("queue_delay_ms") for r in ups]
             fracs = [r.load.get("step_host_overhead_frac") for r in ups]
+            # per-role split of the same capacity/demand terms: each
+            # role pool scales on its OWN ratio (disaggregated
+            # prefill/decode — a saturated prefill pool must not hide
+            # behind an idle decode pool's headroom)
+            by_role: dict = {}
+            for r in ups:
+                rec = by_role.setdefault(
+                    r.role, {"replicas": 0, "capacity_free_total": 0,
+                             "demand_tokens_total": 0})
+                rec["replicas"] += 1
+                rec["capacity_free_total"] += int(
+                    r.load.get("capacity_free") or 0)
+                rec["demand_tokens_total"] += r.outstanding_tokens()
 
         def _max_num(vals):
             return max(
@@ -373,10 +394,21 @@ class ReplicaSet:
             g = self._obs.get("router_demand_tokens_total")
             if g is not None:
                 g.set(demand)
+            for role, rec in by_role.items():
+                for fam, key in (
+                        ("router_role_replicas", "replicas"),
+                        ("router_role_capacity_free",
+                         "capacity_free_total"),
+                        ("router_role_demand_tokens",
+                         "demand_tokens_total")):
+                    g = self._obs.get(fam)
+                    if g is not None:
+                        g.labels(role=role).set(rec[key])
         return {"capacity_free_total": cap,
                 "demand_tokens_total": demand,
                 "queue_delay_ms_max": round(delay_max, 2),
-                "step_host_overhead_frac_max": round(frac_max, 4)}
+                "step_host_overhead_frac_max": round(frac_max, 4),
+                "by_role": by_role}
 
     def snapshot(self) -> List[dict]:
         """JSON-ready table for the router's own /healthz."""

@@ -84,7 +84,12 @@ from pyspark_tf_gke_tpu.router.discovery import (
     parse_replica_list,
     resolve_dns_replicas,
 )
-from pyspark_tf_gke_tpu.router.policy import affinity_key, choose_replica
+from pyspark_tf_gke_tpu.router.policy import (
+    affinity_key,
+    choose_replica,
+    pick_prefill,
+    split_by_role,
+)
 from pyspark_tf_gke_tpu.router.watchtower import (
     DEFAULT_ALERT_WINDOWS,
     Watchtower,
@@ -119,6 +124,11 @@ class _LatencyWindow:
         return xs[min(len(xs) - 1, int(0.99 * (len(xs) - 1)))]
 
 
+class _DisaggFallback(RuntimeError):
+    """A KV-page handoff leg failed or was not worth finishing — the
+    request falls back to the normal (RECOMPUTE) routing path."""
+
+
 class RouterServer:
     """Route/forward engine behind the HTTP handler (transport-free so
     tests drive it directly)."""
@@ -141,7 +151,8 @@ class RouterServer:
                  alert_windows: str = DEFAULT_ALERT_WINDOWS,
                  alert_for_s: float = 0.0,
                  alert_clear_s: float = 30.0,
-                 admin_token: Optional[str] = None):
+                 admin_token: Optional[str] = None,
+                 disagg_min_prompt: int = 0):
         self.registry = registry if registry is not None else get_registry()
         self._obs = router_families(self.registry)
         self.event_log = (event_log if event_log is not None
@@ -166,6 +177,13 @@ class RouterServer:
             for_s=alert_for_s, clear_s=alert_clear_s,
             obs=self._obs, event_log=self.event_log)
         self.admin_token = admin_token or None
+        # disaggregated prefill/decode: single-prompt generates at
+        # least this many prompt bytes long get a KV-page handoff
+        # (prefill replica exports, the chosen decode replica imports)
+        # before routing. 0 = off; it also engages only while a
+        # prefill-role replica is routable, so mixed fleets see ZERO
+        # behavior change either way.
+        self.disagg_min_prompt = max(0, int(disagg_min_prompt))
         self.affinity_tokens = int(affinity_tokens)
         self.inflight_cap = int(inflight_cap)
         self.hedge_enabled = bool(hedge)
@@ -359,12 +377,106 @@ class RouterServer:
              exclude: Tuple[str, ...] = ()) -> Optional[Replica]:
         routable = self.replicas.routable()
         self._obs["router_replicas_routable"].set(len(routable))
+        # role split: ordinary traffic stays off prefill-role replicas
+        # while anything else is routable (their step budget belongs
+        # to handoff prefills); a fleet degraded to prefill-only still
+        # routes — roles are advisory, not a partition of correctness
+        pool, _prefill = split_by_role(routable)
         chosen, used_affinity = choose_replica(
-            routable, affinity=affinity, inflight_cap=self.inflight_cap,
+            pool, affinity=affinity, inflight_cap=self.inflight_cap,
             exclude=exclude)
         if used_affinity:
             self._obs["router_affinity_hits_total"].inc()
         return chosen
+
+    def maybe_disagg(self, path: str, req: dict, headers=None,
+                     span=None) -> Optional[Replica]:
+        """Disaggregated prefill/decode handoff: for a long
+        single-prompt generate, run the prefill on a prefill-role
+        replica (``POST /v1/prefill`` -> base64 KV page blob) and
+        install the pages on the decode replica the request will run
+        on (``POST /v1/kv_import`` -> radix-trie adoption), so its
+        admission is a local cache hit — prefill never steals the
+        decode pool's step budget, and TTFT beats the recompute it
+        replaces. Returns the warmed decode replica to pin the
+        request to, or None for the normal path: disagg off, prompt
+        short, no prefill/decode pool, or ANY transfer failure — the
+        fallback ladder bottoms out at RECOMPUTE (the replica just
+        prefills the prompt itself), never at an error."""
+        if not self.disagg_min_prompt or path != "/v1/generate":
+            return None
+        prompts = req.get("prompts")
+        prompt = (prompts[0]
+                  if isinstance(prompts, list) and len(prompts) == 1
+                  else req.get("prompt"))
+        if not isinstance(prompt, str):
+            return None
+        if (len(prompt.encode("utf-8", "surrogatepass"))
+                < self.disagg_min_prompt):
+            return None
+        routable = self.replicas.routable()
+        prefill = pick_prefill(routable)
+        decode_pool = [r for r in routable if r.role != "prefill"]
+        if prefill is None or not decode_pool:
+            return None
+        target, _aff = choose_replica(
+            decode_pool, affinity=self._affinity_for(req),
+            inflight_cap=self.inflight_cap)
+        if target is None:
+            return None
+        tokens = self._token_ask(req)
+        t0 = time.perf_counter()
+        try:
+            status, out, _h = self._finish_call(
+                self._forward_once(
+                    prefill, "/v1/prefill",
+                    json.dumps({"prompt": prompt}).encode(),
+                    tokens, headers=headers),
+                prefill, tokens)
+            if status != 200 or not isinstance(out, dict):
+                raise _DisaggFallback(
+                    f"prefill export answered {status}")
+            blob = out.get("blob")
+            if not blob:
+                # prompt shorter than one KV page on the replica's
+                # bundle shape: nothing transferable, normal path
+                self._obs["router_kv_xfer_total"].labels(
+                    outcome="export_miss").inc()
+                return None
+            body = json.dumps({"blob": blob}).encode()
+            if len(body) > MAX_BODY_BYTES:
+                raise _DisaggFallback(
+                    f"page blob ({len(body)} bytes) exceeds the "
+                    "replica body cap")
+            self._obs["router_kv_xfer_bytes_total"].inc(
+                len(blob) * 3 // 4)  # base64 -> raw payload bytes
+            status, _out, _h = self._finish_call(
+                self._forward_once(target, "/v1/kv_import", body,
+                                   tokens, headers=headers),
+                target, tokens)
+            if status != 200:
+                raise _DisaggFallback(f"kv import answered {status}")
+        except (ReplicaUnreachable, _DisaggFallback) as exc:
+            # transport failures already marked the dead leg DOWN
+            # (passive health) inside _forward_once/_finish_call; the
+            # request itself falls back to the normal path unharmed
+            self._obs["router_kv_xfer_total"].labels(
+                outcome="failed").inc()
+            self.event_log.emit(
+                "router_kv_xfer", outcome="failed",
+                prefill=prefill.rid, decode=target.rid,
+                error=str(exc)[:200])
+            if span is not None:
+                span.event("kv_xfer", outcome="failed",
+                           error=str(exc)[:200])
+            return None
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        self._obs["router_kv_xfer_latency_ms"].observe(dt_ms)
+        self._obs["router_kv_xfer_total"].labels(outcome="ok").inc()
+        if span is not None:
+            span.event("kv_xfer", outcome="ok", prefill=prefill.rid,
+                       decode=target.rid, ms=round(dt_ms, 1))
+        return target
 
     def hedge_delay_s(self) -> float:
         p99 = self.latency.p99_ms()
@@ -421,7 +533,14 @@ class RouterServer:
 
         self._tenant_enter(tenant)
         try:
-            primary = self.pick(affinity)
+            # disaggregated handoff first: a long prompt prefills on
+            # the prefill pool and the warmed decode replica becomes
+            # the pinned primary (its admission is a radix hit); any
+            # miss/failure falls through to the normal pick
+            primary = self.maybe_disagg(path, req, headers=headers,
+                                        span=span)
+            if primary is None:
+                primary = self.pick(affinity)
             if primary is None:
                 if span is not None:
                     span.event("shed", reason="no_replicas")
@@ -798,9 +917,16 @@ class RouterServer:
         shed = None
         tried.extend(exclude)  # a continuation must not re-route back
         #   into the replica whose death it is splicing over
+        # disaggregated handoff for long streamed prompts too (TTFT is
+        # where the transfer pays most): the warmed decode replica is
+        # attempt 0's choice — unless it was already tried (a
+        # continuation splice must not land back on the dead replica)
+        disagg = (None if tried else self.maybe_disagg(
+            "/v1/generate", req, headers=headers, span=span))
         for attempt in range(2):
-            replica = self.pick(affinity if attempt == 0 else None,
-                                exclude=tuple(tried))
+            replica = ((disagg if attempt == 0 else None)
+                       or self.pick(affinity if attempt == 0 else None,
+                                    exclude=tuple(tried)))
             if replica is None:
                 break
             tried.append(replica.rid)
@@ -1595,8 +1721,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="comma-separated replica base URLs "
                         "(http://host:port,...) — static membership")
     p.add_argument("--discover", default=e("ROUTER_DISCOVER", ""),
-                   help="DNS name to resolve replicas from (k8s headless "
-                        "Service: one A record per pod); merged with "
+                   help="comma-separated DNS name(s) to resolve replicas "
+                        "from (k8s headless Service: one A record per "
+                        "pod; a disaggregated fleet lists the decode and "
+                        "prefill discovery Services); merged with "
                         "--replicas")
     p.add_argument("--discover-port", type=int,
                    default=int(e("ROUTER_DISCOVER_PORT", "8000")),
@@ -1622,6 +1750,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="per-replica in-flight request cap (0 = none); "
                         "a saturated affinity target spills to the "
                         "least-loaded replica")
+    p.add_argument("--disagg-min-prompt", type=int,
+                   default=int(e("ROUTER_DISAGG_MIN_PROMPT", "0")),
+                   help="disaggregated prefill/decode: prompts at least "
+                        "this many bytes long prefill on a prefill-role "
+                        "replica and hand the KV pages to the decode "
+                        "replica (0 = off; needs a --role prefill "
+                        "replica to engage)")
     p.add_argument("--no-hedge", action="store_true",
                    default=e("ROUTER_NO_HEDGE", "") == "1",
                    help="disable hedged failover for non-streamed "
@@ -1762,8 +1897,14 @@ def main(argv=None) -> int:
     replicas = parse_replica_list(args.replicas) if args.replicas else []
     dns_refresh = None
     if args.discover:
+        names = [n.strip() for n in args.discover.split(",") if n.strip()]
+
         def dns_refresh():
-            return resolve_dns_replicas(args.discover, args.discover_port)
+            found = []
+            for name in names:
+                found.extend(
+                    resolve_dns_replicas(name, args.discover_port))
+            return found
 
         replicas = replicas + dns_refresh()
     router = RouterServer(
@@ -1783,7 +1924,8 @@ def main(argv=None) -> int:
         alert_windows=args.alert_windows,
         alert_for_s=args.alert_for,
         alert_clear_s=args.alert_clear,
-        admin_token=args.admin_token)
+        admin_token=args.admin_token,
+        disagg_min_prompt=args.disagg_min_prompt)
     autopilot = None
     if args.autopilot != "off":
         from pyspark_tf_gke_tpu.router.autopilot import (

@@ -59,11 +59,40 @@ def cpu_env() -> dict:
     return dict(os.environ, JAX_PLATFORMS="cpu")
 
 
-def export_tiny_bundle(dest: str, timeout_s: float = 600.0) -> str:
+# PAGED variant of the tiny bundle: same weights recipe, but exported
+# with KV page-pool geometry so serve's --prefix-cache routes to the
+# engine-level radix cache — the precondition for the disaggregated
+# KV-page handoff (export/import rides the radix trie). The model is
+# BUILT dense (init needs no pool) and EXPORTED paged, the same shape
+# smoke_check's --prefix-cache check uses.
+TINY_PAGED_BUNDLE_EXPORT_SRC = (
+    "import dataclasses, jax, sys\n"
+    "jax.config.update('jax_platforms', 'cpu')\n"
+    "import jax.numpy as jnp\n"
+    "from flax import linen as nn\n"
+    "from pyspark_tf_gke_tpu.models import CausalLM, CausalLMConfig\n"
+    "from pyspark_tf_gke_tpu.train.export import export_serving_bundle\n"
+    "from pyspark_tf_gke_tpu.utils.seeding import make_rng\n"
+    "cfg = CausalLMConfig(vocab_size=259, hidden_size=32,\n"
+    "                     num_layers=2, num_heads=2,\n"
+    "                     intermediate_size=64, max_seq_len=256,\n"
+    "                     kv_page_size=32, kv_num_pages=32,\n"
+    "                     dtype=jnp.float32)\n"
+    "model = CausalLM(dataclasses.replace(cfg, kv_num_pages=None))\n"
+    "params = nn.meta.unbox(jax.jit(model.init)(\n"
+    "    make_rng(0), jnp.zeros((1, 8), jnp.int32))['params'])\n"
+    "export_serving_bundle(cfg, params, sys.argv[1], quantize=False)\n")
+
+
+def export_tiny_bundle(dest: str, timeout_s: float = 600.0,
+                       paged: bool = False) -> str:
     """Export the tiny serving bundle via a CPU-pinned child process
-    (the caller's jax stays un-initialized)."""
+    (the caller's jax stays un-initialized). ``paged=True`` exports
+    the paged-KV variant (radix cache, KV-page handoff)."""
     proc = subprocess.run(
-        [sys.executable, "-c", TINY_BUNDLE_EXPORT_SRC, dest],
+        [sys.executable, "-c",
+         TINY_PAGED_BUNDLE_EXPORT_SRC if paged
+         else TINY_BUNDLE_EXPORT_SRC, dest],
         env=cpu_env(), cwd=REPO_ROOT, capture_output=True, text=True,
         timeout=timeout_s)
     if proc.returncode != 0:
@@ -259,15 +288,29 @@ class LocalFleet:
 
     def __init__(self, n_replicas: int = 2, *, router: bool = True,
                  replica_args: Sequence[str] = (),
+                 per_replica_args: Optional[
+                     Sequence[Sequence[str]]] = None,
                  router_args: Sequence[str] = (),
-                 bundle: Optional[str] = None,
+                 bundle: Optional[str] = None, paged: bool = False,
                  boot_timeout_s: float = 600.0, quiet: bool = True):
         self.n_replicas = int(n_replicas)
         self.with_router = router
         self.replica_args = tuple(replica_args)
+        # per-index extra args APPENDED to replica_args — the role-split
+        # fleet shape (replica 0 `--role prefill`, the rest `--role
+        # decode`); a restart keeps its index's args, a scale-up beyond
+        # the list gets the shared args only
+        self.per_replica_args = (None if per_replica_args is None else
+                                 tuple(tuple(a) for a in per_replica_args))
+        if (self.per_replica_args is not None
+                and len(self.per_replica_args) != self.n_replicas):
+            raise ValueError("per_replica_args must have one entry "
+                             "per replica")
         self.router_args = tuple(router_args)
         self.bundle = bundle  # pre-exported dir to reuse (callers
         #   booting several fleets pay the export once)
+        self.paged = bool(paged)  # export the paged-KV tiny bundle
+        #   (radix cache + KV-page handoff) when self-exporting
         self.boot_timeout_s = float(boot_timeout_s)
         self.quiet = quiet
         self.procs: list = []
@@ -275,6 +318,12 @@ class LocalFleet:
         self.replica_ports: list = []
         self.router_port: Optional[int] = None
         self._tmp: Optional[str] = None
+
+    def _args_for(self, i: int) -> tuple:
+        extra = (self.per_replica_args[i]
+                 if self.per_replica_args is not None
+                 and i < len(self.per_replica_args) else ())
+        return self.replica_args + tuple(extra)
         self._bundle_dir: Optional[str] = None  # retained for restarts
 
     @property
@@ -362,7 +411,7 @@ class LocalFleet:
             raise RuntimeError("fleet never booted")
         port = free_port()
         proc = launch_replica(self._bundle_dir, port,
-                              extra_args=self.replica_args,
+                              extra_args=self._args_for(len(self.procs)),
                               quiet=self.quiet)
         self.replica_ports.append(port)
         self.procs.append(proc)
@@ -399,7 +448,7 @@ class LocalFleet:
             self.kill_replica(i)
         self.procs[i] = launch_replica(
             self._bundle_dir, self.replica_ports[i],
-            extra_args=self.replica_args, quiet=self.quiet)
+            extra_args=self._args_for(i), quiet=self.quiet)
         wait_healthy(self.replica_urls[i],
                      time.time() + self.boot_timeout_s, self.procs[i])
 
@@ -410,14 +459,14 @@ class LocalFleet:
         try:
             bundle = self.bundle or export_tiny_bundle(
                 os.path.join(self._tmp, "bundle"),
-                timeout_s=self.boot_timeout_s)
+                timeout_s=self.boot_timeout_s, paged=self.paged)
             self._bundle_dir = bundle
             self.replica_ports = [free_port()
                                   for _ in range(self.n_replicas)]
             self.procs = [launch_replica(bundle, p,
-                                         extra_args=self.replica_args,
+                                         extra_args=self._args_for(i),
                                          quiet=self.quiet)
-                          for p in self.replica_ports]
+                          for i, p in enumerate(self.replica_ports)]
             deadline = time.time() + self.boot_timeout_s
             if self.with_router:
                 self.router_port = free_port()

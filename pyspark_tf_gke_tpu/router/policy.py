@@ -52,6 +52,35 @@ def affinity_key(prompt: str, k: int = DEFAULT_AFFINITY_TOKENS) -> str:
     return hashlib.sha1(head).hexdigest()[:16]
 
 
+def split_by_role(replicas: List[Replica]
+                  ) -> Tuple[List[Replica], List[Replica]]:
+    """Partition the routable set for disaggregated serving:
+    ``(decode_pool, prefill_pool)``. The decode pool carries ordinary
+    generate traffic — ``decode`` and ``mixed`` replicas, plus the
+    prefill replicas TOO when nothing else is routable (roles are
+    advisory; a fleet degraded to prefill-only must keep serving, just
+    without isolation). The prefill pool is ``prefill`` replicas only
+    — empty means the handoff path is off and everything rides the
+    normal (RECOMPUTE-equivalent) path."""
+    prefill = [r for r in replicas if r.role == "prefill"]
+    decode = [r for r in replicas if r.role != "prefill"]
+    if not decode:
+        decode = list(replicas)
+    return decode, prefill
+
+
+def pick_prefill(replicas: List[Replica]) -> Optional[Replica]:
+    """Least-outstanding-tokens choice among the PREFILL pool (no
+    affinity: prefill replicas are warmed BY the handoff, and the
+    radix export is cheap once resident on any of them). None when
+    the fleet has no routable prefill replica."""
+    _decode, prefill = split_by_role(replicas)
+    if not prefill:
+        return None
+    return min(prefill, key=lambda r: (r.outstanding_tokens(),
+                                       r.inflight, r.rid))
+
+
 def _rendezvous_weight(key: str, rid: str) -> int:
     return int.from_bytes(
         hashlib.sha1(f"{key}|{rid}".encode()).digest()[:8], "big")

@@ -84,14 +84,14 @@ FLEET_ROLLUP_KEYS = (
     "step_host_overhead_frac_max", "prefix_hit_rate_mean",
     "spec_accept_rate_mean", "step_tokens_per_sec_total",
     "queued_total", "active_total", "bundle_generations",
-    "replica_minutes",
+    "replica_minutes", "roles",
 )
 
 # per-replica record inside a bucket / the /fleetz replicas map
 REPLICA_SNAPSHOT_KEYS = (
     "state", "capacity_free", "queue_delay_ms", "prefix_hit_rate",
     "spec_accept_rate", "step_host_overhead_frac", "step_tokens_per_sec",
-    "bundle_generation", "queued", "active", "inflight",
+    "bundle_generation", "queued", "active", "inflight", "role",
 )
 
 FLEETZ_KEYS = ("bucket_s", "ring_max", "buckets", "sweeps_total",
@@ -407,6 +407,7 @@ class Watchtower:
                 "queued": int(num("queued")),
                 "active": int(num("active")),
                 "inflight": r.inflight,
+                "role": r.role,
             }
             per_replica[r.rid] = rec
             if r.state == "up":
@@ -451,6 +452,10 @@ class Watchtower:
             "active_total": active_total,
             "bundle_generations": sorted(gens, key=str),
             "replica_minutes": round(self._replica_minutes, 4),
+            # per-role split of the SAME autoscale terms — the HPA for a
+            # disaggregated fleet scales prefill and decode Deployments
+            # on their own demand/capacity, not the blended totals
+            "roles": autoscale.get("by_role", {}),
         }
         entry = {"rollup": rollup, "replicas": per_replica}
         self.ring.fold(entry, now)

@@ -880,6 +880,50 @@ def _copy_page(state: SlotState, src, dst):
     return state._replace(cache=_map_paged_layers(state.cache, layer))
 
 
+# the paged leaves that travel in a KV-page transfer, in WIRE ORDER —
+# export, import, and the OP_KV_XFER replay all iterate this tuple, so
+# the per-layer payload dicts line up across processes and replicas
+_KV_XFER_KEYS = ("k_pages", "v_pages", "k_scale_pages", "v_scale_pages")
+
+
+@jax.jit
+def _gather_pages(state: SlotState, idx):
+    """Gather the rows of pages ``idx`` from every layer's pool leaves
+    (K/V pages, int8 scale pages included) — the prefill side of a
+    disaggregated KV handoff. Returns one dict per paged layer in tree
+    walk order. Out-of-range (sentinel-padded) indices clamp; the
+    caller slices the real rows off the host copy."""
+    out = []
+
+    def layer(pool):
+        out.append({key: pool[key][idx] for key in _KV_XFER_KEYS
+                    if key in pool})
+        return pool
+
+    _map_paged_layers(state.cache, layer)
+    return out
+
+
+@jax.jit
+def _install_pages(state: SlotState, idx, blobs):
+    """Scatter transferred KV page rows into the pool at physical
+    indices ``idx`` (one dict per paged layer, float32 on the wire —
+    cast back to each leaf's pool dtype; sentinel-padded indices
+    drop) — the decode side of a disaggregated KV handoff."""
+    it = iter(blobs)
+
+    def layer(pool):
+        rec = next(it)
+        out = dict(pool)
+        for key in _KV_XFER_KEYS:
+            if key in pool:
+                out[key] = pool[key].at[idx].set(
+                    rec[key].astype(pool[key].dtype), mode="drop")
+        return out
+
+    return state._replace(cache=_map_paged_layers(state.cache, layer))
+
+
 @jax.jit
 def _clear_live_paged(state: SlotState, slot):
     """Paged free: drop the live flag AND reset the slot's block-table
@@ -1647,6 +1691,59 @@ class SlotDeviceState:
             self.state = _copy_page(
                 self.state, np.int32(src), np.int32(dst))
 
+    def read_pages(self, pages) -> List[dict]:
+        """Gather physical pages ``pages`` to the host: one dict per
+        paged layer (k_pages/v_pages [+ scale pages]) with the page
+        rows in request order (paged models only) — the export half
+        of a disaggregated KV handoff. The index vector is padded to
+        a power of two so the gather compiles one program per size
+        class, not per transfer."""
+        if not self.paged:
+            raise ValueError(
+                "read_pages needs the paged cache layout")
+        from pyspark_tf_gke_tpu.parallel.distributed import as_host_array
+
+        n = len(pages)
+        cap = 1 << max(0, (n - 1).bit_length())
+        idx = np.zeros((cap,), np.int32)
+        idx[:n] = pages  # pad rows re-read page 0; sliced off below
+        with self._mesh_ctx():
+            if self.state is None:
+                self.state = self._init_state(None)
+            gathered = _gather_pages(self.state, idx)
+            return [{key: np.asarray(as_host_array(leaf))[:n]
+                     for key, leaf in rec.items()} for rec in gathered]
+
+    def write_pages(self, pages, blobs) -> None:
+        """Install transferred KV page rows at physical indices
+        ``pages`` (paged models only) — the import half of a
+        disaggregated KV handoff, replayed on workers via OP_KV_XFER.
+        ``blobs`` is one dict per paged layer with ``len(pages)``
+        leading rows per leaf. Padded to a power of two (sentinel
+        indices drop) to bound compiled-program count."""
+        if not self.paged:
+            raise ValueError(
+                "write_pages needs the paged cache layout")
+        n = len(pages)
+        cap = 1 << max(0, (n - 1).bit_length())
+        idx = np.full((cap,), self.model.cfg.kv_num_pages, np.int32)
+        idx[:n] = pages
+        padded = []
+        for rec in blobs:
+            out = {}
+            for key, leaf in rec.items():
+                leaf = np.asarray(leaf)
+                if leaf.shape[0] < cap:
+                    leaf = np.concatenate(
+                        [leaf, np.zeros((cap - leaf.shape[0],)
+                                        + leaf.shape[1:], leaf.dtype)])
+                out[key] = leaf
+            padded.append(out)
+        with self._mesh_ctx():
+            if self.state is None:
+                self.state = self._init_state(None)
+            self.state = _install_pages(self.state, idx, padded)
+
     def chunk_async(self, chunk: int, eos_token_id: Optional[int],
                     pad_id: int, sampling: bool = False):
         """Dispatch one decode chunk over all slots (``sampling``
@@ -2214,6 +2311,107 @@ class ContinuousEngine:
         # trie refs keep the pages; the warm's own holds drop with them
         self._adopt_into_trie(prefix, list(shared) + taken,
                               holds=list(shared) + taken)
+        return int(prefix.size)
+
+    # -- disaggregated prefill/decode: KV-page handoff --------------------
+    def export_prefix_pages(self, prefix_ids) -> Optional[dict]:
+        """Prefill side of a disaggregated KV handoff: read the
+        radix-cached pages covering ``prefix_ids`` back to the host.
+        Only FULL cached pages travel (the importer's admissions
+        re-prefill any tail remainder — same rule as a local radix
+        hit). The pages are pinned (+1 ref) across the device gather
+        so pool pressure cannot recycle them mid-read. Returns None
+        when not even one full page of the prefix is cached (caller
+        should warm first), else ``{token_ids, page_size, layers}``
+        with one host-array dict per paged layer."""
+        if self.radix is None:
+            raise ValueError(
+                "KV export needs the paged radix cache "
+                "(prefix_cache_size > 0 on a paged model)")
+        prefix = np.asarray(prefix_ids, np.int32).reshape(-1)
+        if prefix.size == 0:
+            raise ValueError("empty prefix")
+        ps = self.model.cfg.kv_page_size
+        _matched, shared, _cow = self.radix.match(
+            prefix, limit=int(prefix.size), peek=True)
+        if not shared:
+            return None
+        self._ref_pages(shared)
+        try:
+            layers = self._device.read_pages(shared)
+        finally:
+            self._unref_pages(shared)
+        export = {
+            "token_ids": [int(t) for t in prefix[:len(shared) * ps]],
+            "page_size": int(ps),
+            "layers": layers,
+        }
+        self._obs["serve_kv_xfer_export_total"].inc()
+        self._obs["serve_kv_xfer_export_pages_total"].inc(len(shared))
+        return export
+
+    def import_prefix_pages(self, token_ids, layers) -> int:
+        """Decode side of a disaggregated KV handoff: install the
+        transferred page rows into this pool and adopt them into the
+        radix trie, so ONE transfer warms every follower of the
+        prefix — the importing request and all later same-prefix
+        admissions hit locally. Refcount discipline mirrors
+        ``_warm_prefix_paged`` (shared pages pinned through the
+        install, fresh pages taken at refcount 1, everything handed
+        to ``_adopt_into_trie`` with matching holds), so the chaos
+        refcount audit holds on both sides of a transfer. Announce
+        mode replays the page writes on every worker (OP_KV_XFER).
+        Returns the number of prefix tokens now derivable from cached
+        pages."""
+        if self.radix is None:
+            raise ValueError(
+                "KV import needs the paged radix cache "
+                "(prefix_cache_size > 0 on a paged model)")
+        prefix = np.asarray(token_ids, np.int32).reshape(-1)
+        cfg = self.model.cfg
+        ps = cfg.kv_page_size
+        # full pages only, and leave room for >= 1 new token (a
+        # full-context prefix could never be extended)
+        n = min(int(prefix.size), cfg.max_seq_len - 1) // ps
+        if n <= 0:
+            raise ValueError(
+                f"KV transfer smaller than one page "
+                f"(page_size {ps}, got {prefix.size} tokens)")
+        prefix = prefix[:n * ps]
+        _matched, shared, _cow = self.radix.match(
+            prefix, limit=int(prefix.size), peek=True)
+        if len(shared) >= n:
+            # already resident: touch the path (LRU) without counting
+            # — an idempotent re-import is not an admission
+            self.radix.match(prefix, limit=int(prefix.size),
+                             count=False)
+            return int(prefix.size)
+        need = n - len(shared)
+        self._ref_pages(shared)  # pin through the install below
+        taken = self._take_pages(need)
+        if taken is None:
+            self._unref_pages(shared)
+            self._obs["serve_kv_xfer_failures_total"].inc()
+            raise ValueError(
+                f"KV page pool cannot hold the transfer ({need} pages "
+                f"needed, {len(self._free_pages)} free after eviction)")
+        # install only the rows BEYOND the locally-cached pages — the
+        # resident prefix pages are reused, not overwritten
+        blobs = [{key: np.asarray(leaf)[len(shared):n]
+                  for key, leaf in rec.items()} for rec in layers]
+        try:
+            self._announced(
+                lambda wire: wire.announce_kv_xfer(
+                    self.num_slots, taken, blobs),
+                lambda: self._device.write_pages(taken, blobs))
+        except BaseException:
+            self._unref_pages(list(shared) + taken)
+            self._obs["serve_kv_xfer_failures_total"].inc()
+            raise
+        self._adopt_into_trie(prefix, list(shared) + taken,
+                              holds=list(shared) + taken)
+        self._obs["serve_kv_xfer_import_total"].inc()
+        self._obs["serve_kv_xfer_import_pages_total"].inc(need)
         return int(prefix.size)
 
     def cancel(self, rid: int) -> bool:

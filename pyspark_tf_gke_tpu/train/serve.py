@@ -725,6 +725,20 @@ class _ContinuousFront:
                 del self._warmed[:-cap]
             return n
 
+    def export_prefix_pages(self, prefix_ids):
+        """Read the radix-cached KV pages covering ``prefix_ids`` back
+        to the host (serialized with the driver loop's device work) —
+        the prefill replica's half of a disaggregated handoff."""
+        with self.lock:
+            return self.engine.export_prefix_pages(prefix_ids)
+
+    def import_prefix_pages(self, token_ids, layers) -> int:
+        """Install transferred KV pages + adopt them into the radix
+        trie (serialized with the driver loop's device work) — the
+        decode replica's half of a disaggregated handoff."""
+        with self.lock:
+            return self.engine.import_prefix_pages(token_ids, layers)
+
     def abandon(self, rid: int) -> None:
         """Give up on a submitted request: free its KV slot / queue spot
         and drop its results entry (idempotent). BOUNDED acquire on the
@@ -1267,9 +1281,20 @@ class BundleServer:
                  live_stall_s: float = 120.0,
                  spec_tokens: int = 0,
                  step_record_ring: int = 256,
-                 peak_flops: float = 0.0):
+                 peak_flops: float = 0.0,
+                 role: str = "mixed"):
         from pyspark_tf_gke_tpu.train.resilience import retry_with_backoff
 
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"role must be mixed, prefill or decode, got {role!r}")
+        # disaggregated serving role, advertised on /loadz: the router
+        # sends long-prompt admissions to `prefill` replicas and keeps
+        # ordinary generate traffic on `decode`/`mixed` ones. ADVISORY
+        # — every role still serves every endpoint, so a degraded
+        # fleet (all prefill replicas down) falls back to the normal
+        # path instead of erroring.
+        self.role = role
         self.mesh = mesh
         self._int8_kv = bool(int8_kv)
         self.draft_model = self.draft_params = None
@@ -1792,6 +1817,11 @@ class BundleServer:
             # swap + canary, so the coordinator's publish confirmation
             # and the router's prober read the SERVING generation
             "bundle_generation": self.bundle_generation,
+            # disaggregated serving role (--role / SERVE_ROLE): the
+            # router's role-split policy keys off this — prefill
+            # replicas take long-prompt handoffs, decode/mixed take
+            # generate traffic
+            "role": self.role,
             # radix prefix cache: ACTUAL cache contents + measured hit
             # rate, so the router's affinity can score on what the
             # replica really holds instead of hashed ownership alone
@@ -1834,6 +1864,12 @@ class BundleServer:
             if paged:
                 out["kv_pages_free"] = (paged["pages_total"]
                                         - paged["pages_in_use"])
+            cache = stats.get("prefix_cache")
+            if cache:
+                out["prefix_cache_pages"] = int(
+                    cache.get("resident_pages", 0))
+                out["prefix_hit_rate"] = float(
+                    cache.get("recent_hit_rate", 0.0))
             # routable token headroom: how many more prompt+budget
             # tokens this replica would ADMIT right now — the tightest
             # of the bounded-admission budget and (paged engines) the
@@ -2116,6 +2152,69 @@ class BundleServer:
         return {"prefix_tokens": n,
                 "prefix_cache": self._front.engine.stats.get(
                     "prefix_cache")}
+
+    # -- disaggregated prefill/decode (docs/SERVING.md) ------------------
+
+    def prefill_export(self, prompt: str) -> dict:
+        """``POST /v1/prefill``: chunked-prefill the prompt into the
+        radix cache and export the finished KV pages as one base64
+        ``.npz`` page blob — the prefill replica's half of a
+        disaggregated handoff. The caller (the router) ships the blob
+        to a decode replica's ``/v1/kv_import``; only FULL pages
+        travel, the decode-side admission re-prefills the tail
+        remainder exactly like a local radix hit. A repeat prompt is
+        already cached, so the export is the only device work."""
+        import base64
+
+        from pyspark_tf_gke_tpu.train.kv_transfer import pack_kv_export
+
+        if self._front is None:
+            raise ValueError("KV export requires --continuous-slots")
+        ids = self.tokenizer.encode(prompt)
+        if not ids:
+            raise ValueError("prompt tokenized to zero tokens")
+        warmed = self._front.warm_prefix(ids)
+        export = self._front.export_prefix_pages(ids)
+        if export is None:
+            # prompt shorter than one KV page: nothing transferable —
+            # the router falls back to the normal (RECOMPUTE) path
+            return {"prefix_tokens": warmed, "page_size": 0,
+                    "pages": 0, "blob": None}
+        blob = pack_kv_export(export)
+        self._obs["serve_kv_xfer_bytes_total"].inc(len(blob))
+        return {
+            "prefix_tokens": warmed,
+            "page_size": export["page_size"],
+            "pages": len(export["token_ids"]) // export["page_size"],
+            "blob": base64.b64encode(blob).decode("ascii"),
+        }
+
+    def kv_import(self, blob_b64: str) -> dict:
+        """``POST /v1/kv_import``: install a transferred KV page blob
+        into this replica's pool and adopt it into the radix trie —
+        the decode replica's half of a disaggregated handoff. One
+        import warms every follower of the prefix; re-imports are
+        idempotent (resident pages are reused, not re-written)."""
+        import base64
+
+        from pyspark_tf_gke_tpu.train.kv_transfer import unpack_kv_blob
+
+        if self._front is None:
+            raise ValueError("KV import requires --continuous-slots")
+        data = base64.b64decode(blob_b64.encode("ascii"),
+                                validate=True)
+        self._obs["serve_kv_xfer_bytes_total"].inc(len(data))
+        transfer = unpack_kv_blob(data)
+        ps = getattr(self.model.cfg, "kv_page_size", None)
+        if ps is None or transfer["page_size"] != ps:
+            raise ValueError(
+                f"KV transfer page_size {transfer['page_size']} does "
+                f"not match this replica's kv_page_size {ps} — "
+                "role-split fleets must serve one bundle shape")
+        imported = self._front.import_prefix_pages(
+            transfer["token_ids"], transfer["layers"])
+        return {"imported_tokens": imported,
+                "pages": imported // ps if ps else 0}
 
     def generate_stream(self, prompt: str, max_new_tokens: int = 64,
                         deadline_s=None, tenant: str = "default",
@@ -2783,6 +2882,29 @@ def _make_handler(server: BundleServer):
                         steps=int(req.get("steps", 8)))
                     server.record_metrics()
                     self._reply(202, out)
+                elif self.path == "/v1/prefill":
+                    # disaggregated handoff, prefill side: warm +
+                    # export the prompt's KV pages as one page blob
+                    prompt = req.get("prompt")
+                    if not isinstance(prompt, str):
+                        server.record_metrics(failed=True)
+                        return self._reply(
+                            400, {"error": "'prompt' must be a string"})
+                    out = server.prefill_export(prompt)
+                    server.record_metrics()
+                    self._reply(200, out)
+                elif self.path == "/v1/kv_import":
+                    # disaggregated handoff, decode side: install a
+                    # transferred page blob + adopt it into the trie
+                    blob = req.get("blob")
+                    if not isinstance(blob, str):
+                        server.record_metrics(failed=True)
+                        return self._reply(
+                            400, {"error": "'blob' must be a base64 "
+                                           "string"})
+                    out = server.kv_import(blob)
+                    server.record_metrics()
+                    self._reply(200, out)
                 elif self.path == "/v1/score":
                     texts = req.get("texts")
                     if not isinstance(texts, list) or not all(
@@ -3027,6 +3149,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "windowed host-overhead fraction rides /loadz "
                         "as step_host_overhead_frac (continuous-slots "
                         "mode only)")
+    p.add_argument("--role", choices=("mixed", "prefill", "decode"),
+                   default=e("SERVE_ROLE", "mixed"),
+                   help="disaggregated serving role, advertised on "
+                        "/loadz: the router sends long-prompt "
+                        "admissions to 'prefill' replicas (chunked "
+                        "prefill + KV-page export) and generate "
+                        "traffic to 'decode'/'mixed' ones. Advisory — "
+                        "every role serves every endpoint, so a "
+                        "degraded fleet falls back cleanly")
     p.add_argument("--peak-flops", type=float,
                    default=float(e("SERVE_PEAK_FLOPS", "0")),
                    help="per-chip peak FLOPs/sec for the serve_mfu "
@@ -3147,6 +3278,7 @@ def main(argv=None) -> int:
         spec_tokens=args.spec_tokens,
         step_record_ring=args.step_record_ring,
         peak_flops=args.peak_flops,
+        role=args.role,
         # env-only by design: a token flag would leak into ps output
         # and pod specs; the k8s manifest mounts it from a Secret
         admin_token=os.environ.get("SERVE_ADMIN_TOKEN", ""))

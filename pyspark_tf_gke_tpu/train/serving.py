@@ -244,6 +244,20 @@ OP_CB_CHUNK = 5
 OP_CB_FREE = 6
 OP_CB_RESET = 7
 OP_CB_COLLECT = 8
+# KV_XFER: [op, num_slots, n_pages, n_layers, n_keys, 0, 0, 0] —
+#        disaggregated prefill/decode page handoff: install KV page
+#        rows transferred from another replica at the physical page
+#        indices process 0's engine allocated (import_prefix_pages).
+#        Payloads: the page-index vector [n_pages] int32, then for
+#        each of the n_layers paged layers, for each of the first
+#        n_keys leaves of continuous._KV_XFER_KEYS, a shape header
+#        [ndim, dims...] int32 followed by the leaf rows as float32
+#        (lossless for the int8/bf16/f32 pool dtypes). The shape
+#        headers make the stream self-describing, so workers consume
+#        EVERY payload before any fallible work — alignment
+#        discipline as OP_CB_ADMIT. Trie adoption and refcounts stay
+#        on process 0; workers only scatter the pool rows.
+OP_KV_XFER = 9
 # [op, batch, prompt_len, max_new_tokens, eos (-1=none), aux,
 #  top_k (-1=none), extras (0/1/2)]
 # aux = num_beams for OP_GENERATE (beams>1 -> the deterministic beam
@@ -350,6 +364,35 @@ def announce_cb_admit(num_slots: int, padded, true_len: int, slot: int,
         # flag is absent
         _bcast(np.asarray([draft[0].shape[1], draft[1]], np.int32))
         _bcast(np.asarray(draft[0], np.int32))
+
+
+def announce_kv_xfer(num_slots: int, pages, blobs) -> None:
+    """Process 0 (caller already holds the announce lock): publish a
+    KV page-blob install — the decode-side half of a disaggregated
+    prefill/decode handoff (OP_KV_XFER). ``pages`` are the physical
+    page indices the engine allocated for the transfer; ``blobs`` one
+    host-array dict per paged layer with ``len(pages)`` rows per
+    leaf. Every leaf crosses the wire as float32 behind its own shape
+    header (see the OP_KV_XFER comment)."""
+    from pyspark_tf_gke_tpu.train.continuous import _KV_XFER_KEYS
+
+    pages = np.asarray(pages, np.int32).reshape(-1)
+    n_keys = len(blobs[0]) if blobs else 0
+    header = np.zeros(_HEADER_LEN, np.int32)
+    header[:5] = [OP_KV_XFER, num_slots, pages.size, len(blobs),
+                  n_keys]
+    _bcast(header)
+    _bcast(pages)
+    for rec in blobs:
+        for key in _KV_XFER_KEYS:
+            if key not in rec:
+                continue
+            leaf = np.asarray(rec[key], np.float32)
+            shape = np.zeros(_HEADER_LEN, np.int32)
+            shape[0] = leaf.ndim
+            shape[1:1 + leaf.ndim] = leaf.shape
+            _bcast(shape)
+            _bcast(leaf)
 
 
 def announce_cb_chunk(num_slots: int, chunk: int, eos_token_id,
@@ -602,7 +645,7 @@ def serve_worker_loop(model, params, mesh: Mesh,
         if op == OP_SHUTDOWN:
             return served
         if op in (OP_CB_ADMIT, OP_CB_CHUNK, OP_CB_FREE, OP_CB_RESET,
-                  OP_CB_COLLECT):
+                  OP_CB_COLLECT, OP_KV_XFER):
             # continuous-batching replica ops. Field mapping per the
             # OP_CB_* comment above: b=num_slots, s=s_bucket (admit) /
             # deferred flag (chunk), max_new=true_len (admit) / chunk
@@ -641,7 +684,30 @@ def serve_worker_loop(model, params, mesh: Mesh,
             # fail, or a failed op would leave the next header read
             # misaligned
             padded = samp = pages = chunk_fill = cow = draft = None
+            xfer = None
             final = False
+            if op == OP_KV_XFER:
+                # self-describing payload stream (OP_KV_XFER comment):
+                # page indices, then a shape header + float32 rows per
+                # paged-layer leaf — ALL consumed before the fallible
+                # replay. Header mapping: s=n_pages, max_new=n_layers,
+                # eos=n_keys.
+                from pyspark_tf_gke_tpu.train.continuous import (
+                    _KV_XFER_KEYS)
+
+                xfer_pages = np.asarray(_bcast(np.zeros(s, np.int32)))
+                xfer_blobs = []
+                for _ in range(max_new):
+                    rec = {}
+                    for key in _KV_XFER_KEYS[:eos]:
+                        shp = np.asarray(_bcast(np.zeros(
+                            _HEADER_LEN, np.int32)))
+                        dims = tuple(int(v)
+                                     for v in shp[1:1 + int(shp[0])])
+                        rec[key] = np.asarray(_bcast(np.zeros(
+                            dims, np.float32)))
+                    xfer_blobs.append(rec)
+                xfer = (xfer_pages, xfer_blobs)
             if op == OP_CB_ADMIT:
                 # header slot 8 is the flags bitfield: bit0 sampling,
                 # bit1 chunked-prefill piece, bit2 final piece,
@@ -750,6 +816,11 @@ def serve_worker_loop(model, params, mesh: Mesh,
                         raise RuntimeError(
                             "OP_CB_COLLECT with no deferred chunk")
                     cb_replica.fetch_tuple(cb_inflight.popleft())
+                elif op == OP_KV_XFER:
+                    # install the transferred page rows at the SAME
+                    # physical indices process 0 allocated — block
+                    # tables built over them later stay bit-identical
+                    cb_replica.write_pages(xfer[0], xfer[1])
                 else:  # OP_CB_FREE
                     cb_replica.free(aux)
             except Exception:  # noqa: BLE001 — symmetric failures heal

@@ -1,0 +1,617 @@
+"""Disaggregated prefill/decode (docs/SERVING.md "Disaggregated
+prefill/decode"): role-split routing policy, the router's KV-page
+handoff with its fallback ladder, the page-blob serialization, the
+per-role capacity plan, and the disaggregation chaos scenario.
+
+Tier split follows the repo convention: routing/serialization/capacity
+are ROUTER and HOST properties — stub replicas and pure numpy, fast
+tier. Everything that runs a real engine (export/import round-trips,
+the OP_KV_XFER wire replay, the localfleet role-split parity soak) is
+slow-marked; ``tools/smoke_check.py --disagg`` is the live subprocess
+gate for the same contract.
+"""
+
+import base64
+import json
+import re
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from pyspark_tf_gke_tpu.chaos.spec import synth_chaos
+from pyspark_tf_gke_tpu.obs.events import EventLog
+from pyspark_tf_gke_tpu.obs.metrics import MetricsRegistry, platform_families
+from pyspark_tf_gke_tpu.replay.capacity import (
+    FleetModel,
+    plan_replicas,
+    plan_role_replicas,
+)
+from pyspark_tf_gke_tpu.router.discovery import HealthProber, Replica
+from pyspark_tf_gke_tpu.router.gateway import RouterServer
+from pyspark_tf_gke_tpu.router.policy import pick_prefill, split_by_role
+from pyspark_tf_gke_tpu.train.kv_transfer import pack_kv_export, unpack_kv_blob
+
+
+# -- page-blob serialization (pure host) -------------------------------------
+
+
+def _fake_export(rng, n_pages=2, quant=False):
+    layers = []
+    for _ in range(2):
+        rec = {
+            "k_pages": rng.normal(
+                size=(n_pages, 16, 2, 8)).astype(np.float32),
+            "v_pages": rng.normal(
+                size=(n_pages, 16, 2, 8)).astype(np.float32),
+        }
+        if quant:
+            rec["k_scale_pages"] = rng.integers(
+                -128, 127, (n_pages, 16, 2), dtype=np.int8)
+        layers.append(rec)
+    return {"token_ids": list(range(n_pages * 16)), "page_size": 16,
+            "layers": layers}
+
+
+def test_kv_blob_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    export = _fake_export(rng, quant=True)
+    back = unpack_kv_blob(pack_kv_export(export))
+    assert back["token_ids"] == export["token_ids"]
+    assert back["page_size"] == 16
+    assert len(back["layers"]) == 2
+    for orig, got in zip(export["layers"], back["layers"]):
+        assert set(got) == set(orig)
+        for key in orig:
+            # dtypes ride through VERBATIM — int8 scale pages must not
+            # widen on the HTTP leg (the float32 widening is only the
+            # in-job OP_KV_XFER broadcast)
+            assert got[key].dtype == orig[key].dtype
+            np.testing.assert_array_equal(got[key], orig[key])
+
+
+def test_kv_blob_bfloat16_widens_to_float32():
+    # npz has no encoding for the bfloat16 pools (np.load would hand
+    # back raw |V2 void rows that jax rejects): the HTTP leg widens
+    # them to float32 — losslessly — and the import-side page install
+    # casts back to the pool dtype
+    import ml_dtypes
+
+    rng = np.random.default_rng(1)
+    bf16 = rng.normal(
+        size=(2, 16, 2, 8)).astype(np.float32).astype(ml_dtypes.bfloat16)
+    export = {"token_ids": list(range(32)), "page_size": 16,
+              "layers": [{"k_pages": bf16, "v_pages": bf16}]}
+    back = unpack_kv_blob(pack_kv_export(export))
+    got = back["layers"][0]["k_pages"]
+    assert got.dtype == np.float32
+    np.testing.assert_array_equal(got, bf16.astype(np.float32))
+
+
+def test_kv_blob_malformed_raises():
+    with pytest.raises(ValueError):
+        unpack_kv_blob(b"definitely not an npz archive")
+    empty = pack_kv_export(
+        {"token_ids": [1, 2], "page_size": 16, "layers": []})
+    with pytest.raises(ValueError, match="no layer pages"):
+        unpack_kv_blob(empty)
+
+
+# -- role-split routing policy -----------------------------------------------
+
+
+def _rep(rid, role=None, queued_tokens=0):
+    r = Replica(rid=rid, base_url=rid)
+    r.load = {"queued_tokens": queued_tokens, "active": 0}
+    if role is not None:
+        r.load["role"] = role
+    return r
+
+
+def test_split_by_role_and_pick_prefill():
+    p1 = _rep("p1", "prefill", queued_tokens=50)
+    p2 = _rep("p2", "prefill", queued_tokens=10)
+    d1 = _rep("d1", "decode")
+    m1 = _rep("m1")  # no role key (old build) reads as mixed
+    decode, prefill = split_by_role([p1, p2, d1, m1])
+    assert decode == [d1, m1]
+    assert prefill == [p1, p2]
+    # least-outstanding-tokens choice among the prefill pool only
+    assert pick_prefill([p1, p2, d1, m1]) is p2
+    assert pick_prefill([d1, m1]) is None
+    # degraded fleet (prefill replicas only): roles are ADVISORY — the
+    # decode pool falls back to everything so traffic keeps flowing
+    decode, prefill = split_by_role([p1, p2])
+    assert decode == [p1, p2]
+    assert prefill == [p1, p2]
+    assert split_by_role([]) == ([], [])
+
+
+# -- the router handoff (maybe_disagg) against scriptable stubs --------------
+
+
+class DisaggStub:
+    """Scriptable fake replica for the handoff legs: canned /loadz
+    (with a role), scriptable /v1/prefill blob + statuses, request
+    capture. No jax — the handoff is a router property."""
+
+    def __init__(self, role="mixed"):
+        self.load = {"queued": 0, "queued_tokens": 0, "active": 0,
+                     "slots_total": 2, "kv_pages_free": 16,
+                     "inflight_http": 0, "draining": False,
+                     "capacity_free": 100, "queue_delay_ms": 0.0,
+                     "tenants": {}, "role": role}
+        self.prefill_blob = None    # /v1/prefill {"blob": <this>}
+        self.prefill_status = 200
+        self.import_status = 200
+        self.received = []          # (path, request dict)
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                route = self.path.partition("?")[0]
+                if route == "/loadz":
+                    return self._reply(200, server.load)
+                if route == "/healthz":
+                    return self._reply(200, {"status": "ok"})
+                return self._reply(404, {"error": "nope"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                server.received.append((self.path, req))
+                if self.path == "/v1/prefill":
+                    return self._reply(server.prefill_status,
+                                       {"blob": server.prefill_blob})
+                if self.path == "/v1/kv_import":
+                    return self._reply(server.import_status,
+                                       {"cached_tokens": 160})
+                prompts = req.get("prompts") or [req.get("prompt", "")]
+                self._reply(200, {"completions": [
+                    {"prompt": p, "completion": p + "!", "new_tokens": 1,
+                     "latency_ms": 1.0} for p in prompts]})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def role_stubs():
+    pair = [DisaggStub(role="prefill"), DisaggStub(role="decode")]
+    yield pair
+    for s in pair:
+        s.stop()
+
+
+def _router(stub_list, tmp_path, **kw):
+    replicas = [Replica(rid=s.url, base_url=s.url) for s in stub_list]
+    router = RouterServer(
+        replicas, registry=MetricsRegistry(),
+        event_log=EventLog(str(tmp_path / "events.jsonl")),
+        request_timeout_s=10.0, **kw)
+    prober = HealthProber(router.replicas, interval_s=999,
+                          fail_threshold=1)
+    prober.probe_once()
+    return router
+
+
+def test_maybe_disagg_happy_path(role_stubs, tmp_path):
+    pre, dec = role_stubs
+    pre.prefill_blob = base64.b64encode(b"fake page rows").decode()
+    router = _router(role_stubs, tmp_path, disagg_min_prompt=64)
+    long_prompt = "x" * 100
+    target = router.maybe_disagg("/v1/generate",
+                                 {"prompts": [long_prompt]})
+    # the warmed DECODE replica comes back as the pinned primary
+    assert target is not None and target.rid == dec.url
+    assert pre.received == [("/v1/prefill", {"prompt": long_prompt})]
+    assert dec.received == [("/v1/kv_import",
+                             {"blob": pre.prefill_blob})]
+    reg = router.registry
+    assert reg.get("router_kv_xfer_total").labels(
+        outcome="ok").value == 1
+    assert reg.get("router_kv_xfer_bytes_total").value > 0
+    assert reg.get("router_kv_xfer_latency_ms").count == 1
+
+
+def test_maybe_disagg_gates(role_stubs, tmp_path):
+    pre, _dec = role_stubs
+    pre.prefill_blob = "Qg=="
+    router = _router(role_stubs, tmp_path, disagg_min_prompt=64)
+    # short prompt, wrong path, batched prompts: all normal-path
+    assert router.maybe_disagg("/v1/generate",
+                               {"prompts": ["short"]}) is None
+    assert router.maybe_disagg("/v1/score",
+                               {"prompts": ["x" * 100]}) is None
+    assert router.maybe_disagg("/v1/generate",
+                               {"prompts": ["x" * 100] * 2}) is None
+    assert not pre.received
+    # disagg_min_prompt unset (0) = the feature is off entirely
+    off = _router(role_stubs, tmp_path)
+    assert off.maybe_disagg("/v1/generate",
+                            {"prompts": ["x" * 100]}) is None
+    assert not pre.received
+
+
+def test_maybe_disagg_needs_both_pools(tmp_path):
+    # no prefill-role replica -> no handoff; prefill-only fleet -> the
+    # decode pool degrades to everyone but the PREFILL pool is the
+    # same replicas, so the handoff still engages nothing special —
+    # policy keeps serving either way, maybe_disagg just steps aside
+    both_decode = [DisaggStub(role="decode"), DisaggStub(role="mixed")]
+    try:
+        router = _router(both_decode, tmp_path, disagg_min_prompt=64)
+        assert router.maybe_disagg("/v1/generate",
+                                   {"prompts": ["x" * 100]}) is None
+        assert not any(s.received for s in both_decode)
+    finally:
+        for s in both_decode:
+            s.stop()
+
+
+def test_maybe_disagg_fallback_ladder(role_stubs, tmp_path):
+    """Every transfer failure melts to None (the caller routes the
+    normal RECOMPUTE path) — never an error to the client."""
+    pre, dec = role_stubs
+    router = _router(role_stubs, tmp_path, disagg_min_prompt=64)
+    req = {"prompts": ["x" * 100]}
+    outcomes = router.registry.get("router_kv_xfer_total")
+
+    # prompt below one full page on the replica's bundle: empty blob
+    pre.prefill_blob = None
+    assert router.maybe_disagg("/v1/generate", req) is None
+    assert outcomes.labels(outcome="export_miss").value == 1
+
+    # prefill leg answers an error status
+    pre.prefill_status = 500
+    assert router.maybe_disagg("/v1/generate", req) is None
+    assert outcomes.labels(outcome="failed").value == 1
+
+    # import leg answers an error status (decode pool unharmed: the
+    # request still runs there via the normal path)
+    pre.prefill_status = 200
+    pre.prefill_blob = base64.b64encode(b"rows").decode()
+    dec.import_status = 503
+    assert router.maybe_disagg("/v1/generate", req) is None
+    assert outcomes.labels(outcome="failed").value == 2
+
+    # and the happy path still works afterwards — no sticky poison
+    dec.import_status = 200
+    target = router.maybe_disagg("/v1/generate", req)
+    assert target is not None and target.rid == dec.url
+    assert outcomes.labels(outcome="ok").value == 1
+
+
+# -- per-role capacity plan --------------------------------------------------
+
+
+def test_plan_role_replicas_closed_form():
+    import dataclasses
+
+    model = FleetModel(replicas=2, slots_per_replica=2,
+                       decode_tokens_per_sec=50.0,
+                       prefill_tokens_per_sec=2000.0)
+    by_role = {
+        "decode": {"replicas": 2, "capacity_free_total": 100,
+                   "demand_tokens_total": 1000.0},
+        "prefill": {"replicas": 1, "capacity_free_total": 50,
+                    "demand_tokens_total": 30000.0},
+    }
+    out = plan_role_replicas(model, by_role=by_role,
+                             queue_delay_ms=600.0)
+    assert out["kind"] == "pyspark_tf_gke_tpu.capacity_role_plan"
+    dec, pre = out["roles"]["decode"], out["roles"]["prefill"]
+    # decode drains at slots x decode rate = 100 tok/s: demand alone
+    # says ceil(1000 / 500) = 2, and the 600 ms queue delay (> 500 ms
+    # target, demand satisfied by what's up) bumps one more
+    assert dec["replicas_needed"] == 3
+    assert dec["signals"] == {"demand_replicas": 2,
+                              "queue_delay_bump": True}
+    # prefill drains at prefill_tokens_per_sec per replica (slot count
+    # and speculation are decode-side concepts): ceil(30000 / 10000) =
+    # 3 — and the queue-delay bump NEVER applies to the prefill role
+    assert pre["replicas_needed"] == 3
+    assert pre["per_replica_tokens_per_sec"] == 2000.0
+    assert pre["signals"]["queue_delay_bump"] is False
+    assert pre["role"] == "prefill" and dec["role"] == "decode"
+    assert out["replicas_needed_total"] == 6
+    # the arithmetic is plan_replicas VERBATIM over the role's shim
+    # model — pinning equality keeps the closed form single-sourced
+    shim = dataclasses.replace(
+        model, slots_per_replica=1,
+        decode_tokens_per_sec=model.prefill_tokens_per_sec,
+        spec_tokens=0, spec_accept_rate=0.0)
+    solo = plan_replicas(shim, demand_tokens=30000.0,
+                         queue_delay_ms=None, replicas_up=1)
+    assert pre == {**solo, "role": "prefill"}
+    # empty split -> empty plan, zero total (a role-blind fleet)
+    none = plan_role_replicas(model, by_role={})
+    assert none["roles"] == {} and none["replicas_needed_total"] == 0
+
+
+# -- disaggregation chaos scenario -------------------------------------------
+
+
+def test_synth_chaos_kill_prefill_mid_xfer():
+    sched = synth_chaos("kill_prefill_mid_xfer", seed=7,
+                        duration_s=20.0, replicas=2)
+    assert sched.meta["disagg"] is True
+    assert sched.meta["kind"] == "kill_prefill_mid_xfer"
+    (ev,) = sched.events
+    # default victim 0: localfleet role-split runs put prefill first
+    assert ev.action == "kill" and ev.target == "replica:0"
+    assert ev.offset_s == pytest.approx(8.0)    # 0.4 x duration
+    assert ev.restart_s == pytest.approx(5.0)   # duration / 4
+    custom = synth_chaos("kill_prefill_mid_xfer", duration_s=20.0,
+                         replicas=3, victim=1, kill_at_s=3.5,
+                         restart_s=2.0)
+    assert custom.events[0].target == "replica:1"
+    assert custom.events[0].offset_s == 3.5
+    assert custom.events[0].restart_s == 2.0
+    with pytest.raises(ValueError, match="kill_prefill_mid_xfer"):
+        synth_chaos("not_a_kind")
+
+
+# -- engine-level transfer (real device pools; slow tier) --------------------
+
+
+def _paged_pair():
+    from tests.test_continuous import _paged_model
+
+    return _paged_model(page_size=16, num_pages=24)
+
+
+@pytest.mark.slow  # heavy compile set (warm + chunked admit + decode)
+def test_kv_export_import_roundtrip_token_parity():
+    from tests.test_continuous import _reference_tokens
+    from pyspark_tf_gke_tpu.chaos.invariants import check_engine
+    from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
+
+    model, paged, params = _paged_pair()
+    rng = np.random.default_rng(90)
+    prefix = rng.integers(1, 97, 32)  # 2 FULL 16-token pages
+    fam_a = platform_families(MetricsRegistry())
+    fam_b = platform_families(MetricsRegistry())
+    src = ContinuousEngine(paged, params, num_slots=2, chunk=3,
+                           buckets=(16, 32, 64), prefix_cache_size=16,
+                           prefill_chunk=32, obs=fam_a)
+    src.warm_prefix(prefix)
+    export = src.export_prefix_pages(prefix)
+    assert export["page_size"] == 16
+    assert export["token_ids"] == [int(t) for t in prefix]
+    assert export["layers"][0]["k_pages"].shape[0] == 2
+    assert fam_a["serve_kv_xfer_export_total"].value == 1
+    assert fam_a["serve_kv_xfer_export_pages_total"].value == 2
+
+    # the HTTP serialization leg rides along: pack -> unpack
+    back = unpack_kv_blob(pack_kv_export(export))
+
+    dst = ContinuousEngine(paged, params, num_slots=2, chunk=3,
+                           buckets=(16, 32, 64), prefix_cache_size=16,
+                           prefill_chunk=32, obs=fam_b)
+    assert dst.import_prefix_pages(back["token_ids"],
+                                   back["layers"]) == 32
+    assert fam_b["serve_kv_xfer_import_total"].value == 1
+    assert fam_b["serve_kv_xfer_import_pages_total"].value == 2
+    base_computed = dst.stats["prefill_tokens_computed"]
+
+    # a same-prefix request admits at the transferred boundary and
+    # produces EXACTLY the dense one-request generate() tokens
+    p = np.concatenate([prefix, rng.integers(1, 97, 7)])
+    rid = dst.submit(p, max_new_tokens=6)
+    results = dict(dst.run_until_drained())
+    assert results[rid] == _reference_tokens(model, params, p, 6)
+    assert dst.stats["prefix_cache"]["hits"] == 1
+    # suffix-only prefill: the transfer elided the prefix recompute
+    assert (dst.stats["prefill_tokens_computed"] - base_computed
+            == p.size - prefix.size)
+    # PR 6 refcount discipline holds on BOTH sides of the transfer
+    for eng in (src, dst):
+        verdict = check_engine(eng)
+        assert verdict["ok"], verdict["violations"]
+
+
+@pytest.mark.slow  # heavy compile set
+def test_kv_import_idempotent_and_adoption_warms_followers():
+    from tests.test_continuous import _reference_tokens
+    from pyspark_tf_gke_tpu.chaos.invariants import check_engine
+    from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
+
+    model, paged, params = _paged_pair()
+    rng = np.random.default_rng(91)
+    prefix = rng.integers(1, 97, 35)  # 2 full pages + a 3-token tail
+    src = ContinuousEngine(paged, params, num_slots=2, chunk=3,
+                           buckets=(16, 32, 64), prefix_cache_size=16,
+                           prefill_chunk=32)
+    src.warm_prefix(prefix)
+    export = src.export_prefix_pages(prefix)
+    # only FULL cached pages travel; the tail re-prefills on import side
+    assert len(export["token_ids"]) == 32
+
+    fam = platform_families(MetricsRegistry())
+    dst = ContinuousEngine(paged, params, num_slots=2, chunk=3,
+                           buckets=(16, 32, 64), prefix_cache_size=16,
+                           prefill_chunk=32, obs=fam)
+    assert dst.import_prefix_pages(export["token_ids"],
+                                   export["layers"]) == 32
+    # idempotent re-import: the covered prefix is an LRU touch, not a
+    # second install — no new pages, no counter movement
+    assert dst.import_prefix_pages(export["token_ids"],
+                                   export["layers"]) == 32
+    assert fam["serve_kv_xfer_import_total"].value == 1
+    assert fam["serve_kv_xfer_import_pages_total"].value == 2
+
+    # ONE transfer warms every follower: two same-prefix requests both
+    # hit the adopted pages with exact parity
+    p1 = np.concatenate([export["token_ids"],
+                         rng.integers(1, 97, 6)]).astype(np.int32)
+    p2 = np.concatenate([export["token_ids"],
+                         rng.integers(1, 97, 9)]).astype(np.int32)
+    r1 = dst.submit(p1, max_new_tokens=5)
+    r2 = dst.submit(p2, max_new_tokens=5)
+    results = dict(dst.run_until_drained())
+    assert results[r1] == _reference_tokens(model, params, p1, 5)
+    assert results[r2] == _reference_tokens(model, params, p2, 5)
+    assert dst.stats["prefix_cache"]["hits"] == 2
+
+    # transfers below one page are rejected before any pool work
+    with pytest.raises(ValueError, match="smaller than one page"):
+        dst.import_prefix_pages(list(range(10)), export["layers"])
+    verdict = check_engine(dst)
+    assert verdict["ok"], verdict["violations"]
+
+
+@pytest.mark.slow  # worker-loop replay builds its own device replica
+def test_kv_xfer_wire_record_replay():
+    # Record the announce stream of an import (single process: _bcast
+    # is identity), then feed it to serve_worker_loop through a
+    # monkeypatched _bcast — the worker must consume the OP_KV_XFER
+    # payloads (page indices + per-leaf shape headers + float32 rows)
+    # in order and exit cleanly at OP_SHUTDOWN.
+    from pyspark_tf_gke_tpu.train import serving
+    from pyspark_tf_gke_tpu.train.continuous import ContinuousEngine
+
+    model, paged, params = _paged_pair()
+    rng = np.random.default_rng(92)
+    prefix = rng.integers(1, 97, 32)
+    src = ContinuousEngine(paged, params, num_slots=2, chunk=3,
+                           buckets=(16, 32, 64), prefix_cache_size=16)
+    src.warm_prefix(prefix)
+    export = src.export_prefix_pages(prefix)
+
+    stream = []
+    real = serving._bcast
+
+    def recording(x):
+        stream.append(np.asarray(x).copy())
+        return real(x)
+
+    serving._bcast = recording
+    try:
+        dst = ContinuousEngine(paged, params, num_slots=2, chunk=3,
+                               buckets=(16, 32, 64),
+                               prefix_cache_size=16, announce=True)
+        assert dst.import_prefix_pages(export["token_ids"],
+                                       export["layers"]) == 32
+        serving.announce_shutdown()
+    finally:
+        serving._bcast = real
+
+    headers = [s for s in stream
+               if s.shape == (8,) and s[0] == serving.OP_KV_XFER]
+    assert len(headers) == 1
+    h = headers[0]
+    assert int(h[2]) == 2                           # n_pages
+    assert int(h[3]) == len(export["layers"])       # n_layers
+    assert int(h[4]) == len(export["layers"][0])    # keys per layer
+
+    replay = list(stream)
+
+    def replay_bcast(x):
+        got = replay.pop(0)
+        assert got.shape == np.asarray(x).shape, (
+            f"wire shape desync: worker expects {np.asarray(x).shape}, "
+            f"stream has {got.shape}")
+        return got
+
+    serving._bcast = replay_bcast
+    try:
+        serving.serve_worker_loop(paged, params, mesh=None)
+    finally:
+        serving._bcast = real
+    assert not replay, f"{len(replay)} broadcast(s) never consumed"
+
+
+# -- localfleet role-split parity (full subprocess fleet; slow tier) ---------
+
+
+def _post_json(url, path, payload, timeout=300):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.mark.slow  # boots a 2-replica subprocess fleet + router
+def test_localfleet_role_split_token_parity():
+    from pyspark_tf_gke_tpu.router.localfleet import LocalFleet
+
+    # byte tokenizer: 160 prompt bytes = exactly 5 full 32-token pages
+    shared = ("shared system preamble for the disaggregation parity "
+              "check " * 4)[:160]
+    assert len(shared) == 160
+    prompt = shared + " q: parity?"
+    with LocalFleet(2, paged=True,
+                    replica_args=("--continuous-slots", "2",
+                                  "--prefix-cache", "32",
+                                  "--prefill-chunk", "32"),
+                    per_replica_args=(("--role", "prefill"),
+                                      ("--role", "decode")),
+                    router_args=("--disagg-min-prompt", "128"),
+                    quiet=False) as fleet:
+        fleet.warm()
+        roles = []
+        for rurl in fleet.replica_urls:
+            with urllib.request.urlopen(rurl + "/loadz",
+                                        timeout=30) as resp:
+                roles.append(json.loads(resp.read())["role"])
+        assert roles == ["prefill", "decode"]
+
+        # reference: the prefill replica computes the whole prompt
+        # locally (greedy + same bundle = deterministic tokens)
+        ref = _post_json(fleet.replica_urls[0], "/v1/generate",
+                         {"prompts": [prompt], "max_new_tokens": 16})
+        # routed: long prompt -> prefill-side export -> KV handoff ->
+        # decode-side adoption -> suffix-only admission
+        via = _post_json(fleet.url, "/v1/generate",
+                         {"prompts": [prompt], "max_new_tokens": 16})
+        assert (via["completions"][0]["completion"]
+                == ref["completions"][0]["completion"])
+
+        # the handoff actually happened (not a silent RECOMPUTE)
+        with urllib.request.urlopen(fleet.url + "/metrics",
+                                    timeout=30) as resp:
+            metrics = resp.read().decode()
+        m = re.search(r'router_kv_xfer_total\{outcome="ok"\}\s+(\d+)',
+                      metrics)
+        assert m and int(m.group(1)) >= 1, "no ok KV transfer recorded"
+        # and the decode replica holds the adopted prefix pages
+        with urllib.request.urlopen(fleet.replica_urls[1] + "/loadz",
+                                    timeout=30) as resp:
+            dec_load = json.loads(resp.read())
+        assert dec_load["prefix_cache_pages"] >= 5
+
+        # refcount audit on both sides: at idle, every in-use page is
+        # trie-resident (pages_total=32 on the tiny paged bundle)
+        assert fleet.wait_idle(timeout_s=120)
+        for rurl in fleet.replica_urls:
+            with urllib.request.urlopen(rurl + "/loadz",
+                                        timeout=30) as resp:
+                load = json.loads(resp.read())
+            in_use = 32 - load["kv_pages_free"]
+            assert in_use == load["prefix_cache_pages"], (
+                rurl, load)

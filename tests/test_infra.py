@@ -165,7 +165,10 @@ def test_tpu_router_manifest_conventions():
     c = dep["spec"]["template"]["spec"]["containers"][0]
     assert c["command"][-1] == "pyspark_tf_gke_tpu.router"
     env = {e["name"]: e["value"] for e in c["env"]}
-    assert env["ROUTER_DISCOVER"] == discovery["metadata"]["name"]
+    # comma-separated discovery: decode pool first, prefill pool second
+    discover_names = [n.strip()
+                      for n in env["ROUTER_DISCOVER"].split(",")]
+    assert discover_names[0] == discovery["metadata"]["name"]
     assert int(env["ROUTER_DISCOVER_PORT"]) == 8000
     # client-facing Service port matches the router's listen port
     assert front["spec"]["ports"][0]["port"] == int(env["ROUTER_PORT"])
@@ -223,6 +226,67 @@ def test_tpu_serve_hpa_conventions():
     # per-replica state a shrink throws away)
     down = hpa["spec"]["behavior"]["scaleDown"]
     assert down["stabilizationWindowSeconds"] >= 120
+
+
+def test_tpu_serve_prefill_manifest_conventions():
+    """The disaggregated prefill pool must close its loops: its own
+    headless discovery Service (NO selector overlap with the decode
+    Deployment), SERVE_ROLE=prefill so /loadz advertises the role, the
+    router's ROUTER_DISCOVER listing the Service, and an HPA scaling
+    on the per-role demand gauge the router actually registers."""
+    docs = _load("infra/k8s/tpu/tpu-serve-prefill.yaml")
+    svc = next(d for d in docs if d["kind"] == "Service")
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    hpa = next(d for d in docs if d["kind"] == "HorizontalPodAutoscaler")
+    serve = _load("infra/k8s/tpu/tpu-serve.yaml")
+    serve_dep = next(d for d in serve if d["kind"] == "Deployment")
+
+    # headless per-pod discovery, router port convention
+    assert svc["spec"]["clusterIP"] == "None"
+    assert svc["spec"]["ports"][0]["port"] == 8000
+    assert svc["spec"]["selector"] == dep["spec"]["selector"][
+        "matchLabels"]
+    # two Deployments must never share a selector (controllers would
+    # fight over pods)
+    assert dep["spec"]["selector"]["matchLabels"] != \
+        serve_dep["spec"]["selector"]["matchLabels"]
+
+    tpl = dep["spec"]["template"]
+    assert tpl["metadata"]["labels"]["serve-role"] == "prefill"
+    c = tpl["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    assert env["SERVE_ROLE"] == "prefill"
+    # same liveness/stall ordering contract as the decode pool
+    assert float(env["SERVE_LIVE_STALL"]) > float(
+        env["SERVE_STEP_TIMEOUT"])
+    assert c["livenessProbe"]["httpGet"]["path"] == "/livez"
+
+    # the router discovers this pool (second ROUTER_DISCOVER entry)
+    router = _load("infra/k8s/tpu/tpu-router.yaml")
+    router_dep = next(d for d in router if d["kind"] == "Deployment")
+    rc = router_dep["spec"]["template"]["spec"]["containers"][0]
+    renv = {e["name"]: e["value"] for e in rc["env"]}
+    names = [n.strip() for n in renv["ROUTER_DISCOVER"].split(",")]
+    assert svc["metadata"]["name"] in names
+    # disaggregation is ON in the reference deployment
+    assert int(renv["ROUTER_DISAGG_MIN_PROMPT"]) > 0
+
+    # per-role HPA: targets THIS Deployment, scales on a registered
+    # router family with the prefill role selector
+    ref = hpa["spec"]["scaleTargetRef"]
+    assert ref["name"] == dep["metadata"]["name"]
+    from pyspark_tf_gke_tpu.obs.metrics import (
+        MetricsRegistry,
+        router_families,
+    )
+
+    registered = set(router_families(MetricsRegistry()))
+    ext = [m["external"] for m in hpa["spec"]["metrics"]
+           if m["type"] == "External"]
+    assert ext, "prefill HPA scales on no external metrics"
+    assert ext[0]["metric"]["name"] in registered
+    assert ext[0]["metric"]["selector"]["matchLabels"][
+        "role"] == "prefill"
 
 
 def test_tpu_serve_multihost_manifest_conventions():

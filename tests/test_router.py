@@ -774,6 +774,13 @@ def test_router_autoscale_signal_from_loadz(stubs, tmp_path):
     assert auto["step_host_overhead_frac_max"] == 0.31
     assert auto["replicas_routable"] == 2
     assert auto["demand_inflight"] == 0
+    # per-role split: stubs don't advertise a role, so both land in the
+    # "mixed" bucket with the SAME totals as the blended terms above
+    roles = auto["by_role"]
+    assert set(roles) == {"mixed"}
+    assert roles["mixed"]["replicas"] == 2
+    assert roles["mixed"]["capacity_free_total"] == 500
+    assert roles["mixed"]["demand_tokens_total"] == 50
 
 
 # -- get_json helper ---------------------------------------------------------

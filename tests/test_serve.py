@@ -343,7 +343,7 @@ def test_loadz_snapshot_key_stability(cb_endpoints):
                  "prefix_cache_pages", "prefix_hit_rate",
                  "capacity_free", "queue_delay_ms", "tenants",
                  "spec_accept_rate", "step_host_overhead_frac",
-                 "step_tokens_per_sec"}
+                 "step_tokens_per_sec", "role"}
     for url in (plain_url, cont_url):
         with urllib.request.urlopen(url + "/loadz") as resp:
             assert resp.status == 200

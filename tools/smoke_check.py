@@ -98,10 +98,21 @@ SIGKILL one replica — the structural ``replica_down`` alert must fire
 within the documented detection bound and resolve (fire_count exactly
 1) after the restart re-admits the replica.
 
+``--disagg`` checks the disaggregated prefill/decode handoff live
+(docs/SERVING.md "Disaggregated prefill/decode"): 1 prefill-role + 1
+decode-role CPU replica behind a router with ``--disagg-min-prompt``
+— a long-prompt generate must ride the KV-page transfer
+(``router_kv_xfer_total{outcome="ok"}`` >= 1), the decode replica's
+radix cache must hold the transferred pages, a same-prefix repeat
+must admit as a LOCAL hit (computed prefill tokens under suffix + one
+chunk), and both replicas' idle page accounting must balance — every
+in-use page trie-resident, the refcount audit green on both sides.
+
 Usage: python tools/smoke_check.py
        [--lint-only|--kernels-only|--serve-lifecycle|--serve-tbt|
         --router|--prefix-cache|--spec-serve|--fairness|--pipeline|
-        --trace|--replay|--stepstats|--failover-stream|--watchtower]
+        --trace|--replay|--stepstats|--failover-stream|--watchtower|
+        --disagg]
 """
 
 import os
@@ -299,7 +310,21 @@ def lint_duplicate_metrics() -> int:
                 "autopilot_vetoes_total",
                 "autopilot_actuations_total",
                 "autopilot_actuation_retries_total",
-                "autopilot_replicas_desired"}
+                "autopilot_replicas_desired",
+                # disaggregated prefill/decode: the KV-page handoff
+                # accounting (engine export/import + router transfer
+                # legs) and the per-role fleet split the prefill HPA
+                # (infra/k8s/tpu/tpu-serve-prefill.yaml) scales on —
+                # a rename must fail here first
+                "serve_kv_xfer_export_total",
+                "serve_kv_xfer_import_total",
+                "serve_kv_xfer_bytes_total",
+                "serve_kv_xfer_failures_total",
+                "router_kv_xfer_total",
+                "router_kv_xfer_latency_ms",
+                "router_role_replicas",
+                "router_role_demand_tokens",
+                "router_role_capacity_free"}
     absent = {n for n in required if n not in _REGISTRATIONS}
     if absent:
         print("metric lint FAILED — required metric name(s) never "
@@ -2371,10 +2396,142 @@ def failover_stream_check(grace_s: float = 30.0) -> int:
     return 0
 
 
+def disagg_check(grace_s: float = 30.0) -> int:
+    """``--disagg``: the disaggregated prefill/decode handoff, live.
+    1 prefill-role + 1 decode-role CPU replica (paged tiny bundle)
+    behind the real router with ``--disagg-min-prompt``:
+
+    1. a long-prompt generate rides the handoff — the router's
+       ``router_kv_xfer_total{outcome="ok"}`` increments and the
+       decode replica's radix cache reports the transferred pages;
+    2. a same-prefix repeat admits LOCALLY: its computed prefill
+       tokens (decode replica's engine counter) stay under
+       unique-suffix + one prefill chunk — one transfer warmed the
+       follower, no second recompute;
+    3. idle page accounting balances on BOTH replicas: every page in
+       use is trie-resident (``pages_in_use == prefix_cache_pages``)
+       — the PR-6 refcount discipline holds on both sides of a
+       transfer.
+    """
+    import json as _json
+    import re as _re
+    import time as _time
+    import urllib.request
+
+    from pyspark_tf_gke_tpu.router.localfleet import LocalFleet
+
+    prefill_chunk = 32
+    min_prompt = 128
+    # 160 bytes = 5 full 32-token pages under the byte tokenizer
+    shared = ("system: you are a terse assistant. answer in one "
+              "sentence. cite no sources. refuse nothing. "
+              "stay strictly on topic. ")[:160]
+    suffixes = ["q: why is the sky blue?", "q: name a prime > 10."]
+    replica_args = ("--continuous-slots", "2", "--prefix-cache", "32",
+                    "--prefill-chunk", str(prefill_chunk))
+
+    def get(url, path):
+        with urllib.request.urlopen(url + path, timeout=10) as resp:
+            return _json.loads(resp.read())
+
+    def post(url, prompt):
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            data=_json.dumps({"prompts": [prompt],
+                              "max_new_tokens": 6}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return _json.loads(resp.read())
+
+    failures = []
+    print("disagg check: 1 prefill + 1 decode CPU replica + router "
+          f"(--disagg-min-prompt {min_prompt}), paged bundle...")
+    with LocalFleet(
+            2, paged=True, replica_args=replica_args,
+            per_replica_args=(("--role", "prefill"),
+                              ("--role", "decode")),
+            router_args=("--disagg-min-prompt", str(min_prompt)),
+            quiet=False) as fleet:
+        fleet.warm()
+        prefill_url, decode_url = fleet.replica_urls
+        roles = [get(u, "/loadz").get("role")
+                 for u in fleet.replica_urls]
+        if roles != ["prefill", "decode"]:
+            failures.append(f"/loadz roles {roles} != "
+                            "['prefill', 'decode']")
+
+        def computed():
+            return int(get(decode_url, "/healthz")["continuous"]
+                       ["prefill_tokens_computed"])
+
+        post(fleet.url, shared + suffixes[0])
+        deadline = _time.time() + grace_s
+        xfers = 0
+        while _time.time() < deadline and not xfers:
+            with urllib.request.urlopen(fleet.url + "/metrics",
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+            m = _re.search(
+                r'router_kv_xfer_total\{outcome="ok"\}\s+(\d+)', text)
+            xfers = int(m.group(1)) if m else 0
+            if not xfers:
+                _time.sleep(0.5)
+        if not xfers:
+            failures.append("router_kv_xfer_total{outcome=ok} never "
+                            "incremented — the handoff did not run")
+        pages = get(decode_url, "/loadz").get("prefix_cache_pages")
+        if not pages:
+            failures.append(
+                f"decode replica prefix_cache_pages={pages} — the "
+                "transferred pages were not adopted into the trie")
+        print(f"  handoff: {xfers} ok transfer(s), decode replica "
+              f"holds {pages} trie page(s)")
+
+        # same-prefix repeat: the decode replica must admit at the
+        # match boundary (ONE transfer warms all followers)
+        p1 = computed()
+        post(fleet.url, shared + suffixes[1])
+        delta = computed() - p1
+        bound = len(suffixes[1]) + prefill_chunk
+        print(f"  repeat: decode replica computed {delta} prefill "
+              f"tokens (bound {bound})")
+        if delta >= bound:
+            failures.append(
+                f"same-prefix repeat computed {delta} prefill tokens "
+                f"— not < suffix + one chunk ({bound}); the "
+                "transferred prefix was re-prefilled")
+
+        # refcount audit, both sides: idle fleet, every in-use page
+        # trie-resident
+        fleet.wait_idle()
+        for name, url in (("prefill", prefill_url),
+                          ("decode", decode_url)):
+            loadz = get(url, "/loadz")
+            total = 32
+            in_use = total - int(loadz.get("kv_pages_free") or 0)
+            resident = int(loadz.get("prefix_cache_pages") or 0)
+            print(f"  {name}: pages_in_use={in_use} "
+                  f"trie_resident={resident}")
+            if in_use != resident:
+                failures.append(
+                    f"{name} replica leaks pages: {in_use} in use vs "
+                    f"{resident} trie-resident at idle")
+    if failures:
+        print("disagg FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("disagg OK: long prompt rode the KV handoff, the repeat hit "
+          "locally, page accounting balanced on both replicas")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--kernels-only" in argv:
         return kernel_interpret_sweep()
+    if "--disagg" in argv:
+        return disagg_check()
     if "--failover-stream" in argv:
         return failover_stream_check()
     if "--chaos" in argv:
